@@ -1,0 +1,24 @@
+"""polyaxon_tpu — a TPU-native ML orchestration + training framework.
+
+Re-implements the capabilities of Polyaxon (reference: sboorlagadda/polyaxon;
+mount was empty at survey time — see SURVEY.md status banner) as a brand-new
+TPU-first system:
+
+- ``schemas``:       polyflow-equivalent spec objects (Component/Operation/run
+                     kinds/matrix kinds), including the new TPU-native run
+                     kinds ``tpujob``/``jaxjob``.
+- ``polyaxonfile``:  YAML spec parsing, validation, ``--set`` overrides, presets.
+- ``compiler``:      Operation + Component -> CompiledOperation -> executable
+                     payload (TPU slice topology, jax.distributed env).
+- ``api``:           aiohttp REST API + streams service over SQLite.
+- ``scheduler``:     queue + agent + topology-aware ICI sub-slice bin-packing.
+- ``operator``:      reconciler (C++ core with Python fake-cluster backend).
+- ``runtime``:       init/sidecar equivalents + local subprocess executor.
+- ``tracking``:      traceml-equivalent event tracking/lineage.
+- ``hypertune``:     grid/random/mapping/Hyperband/Bayesian search.
+- ``models``/``ops``/``parallel``/``train``: the JAX/pallas/pjit training
+  runtime the reference never owned (Llama, ViT, ResNet, BERT, GPT-2;
+  flash/ring attention; DP/FSDP/TP/PP/SP/EP over a device mesh).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,6 @@
+from .spec import (
+    OperationSpecification,
+    check_polyaxonfile,
+    get_op_from_spec,
+    parse_set_overrides,
+)

@@ -1,0 +1,155 @@
+"""Polyaxonfile reading/validation: YAML (or JSON) -> V1Operation.
+
+Parity with upstream ``polyaxon._polyaxonfile`` (SURVEY.md §2 "Polyaxonfile
+spec"): accepts ``kind: component`` or ``kind: operation`` documents, merges
+multiple files, applies presets, ``-P name=value`` param bindings and
+``--set dotted.path=value`` spec overrides.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Optional, Union
+
+import yaml
+
+from ..schemas.base import _deep_merge
+from ..schemas.component import V1Component
+from ..schemas.io import V1Param
+from ..schemas.operation import V1Operation
+
+
+def _load_doc(source: Union[str, Path, dict]) -> dict[str, Any]:
+    if isinstance(source, dict):
+        return source
+    if not str(source).strip():
+        raise ValueError("Empty polyaxonfile source")
+    p = Path(source)
+    if p.is_file():
+        text = p.read_text()
+    elif p.is_dir():
+        raise ValueError(f"Polyaxonfile path is a directory: {source}")
+    else:
+        text = str(source)
+    data = yaml.safe_load(text)
+    if not isinstance(data, dict):
+        raise ValueError(f"Polyaxonfile must be a mapping, got {type(data).__name__}")
+    return data
+
+
+def normalize_to_operation_dict(data: dict[str, Any]) -> dict[str, Any]:
+    """Normalize a parsed document into operation *shape* (components get
+    wrapped under ``component:``, as upstream does when running a component
+    file directly), so overrides/presets address one consistent layout."""
+    kind = data.get("kind", "operation" if ("component" in data or "hubRef" in data) else None)
+    if kind == "component" or (kind is None and "run" in data):
+        return {"kind": "operation", "component": {**data, "kind": "component"}}
+    return {**data, "kind": "operation"}
+
+
+def get_op_from_spec(data: dict[str, Any]) -> V1Operation:
+    return V1Operation.from_dict(normalize_to_operation_dict(data))
+
+
+def parse_set_overrides(pairs: list[str]) -> dict[str, Any]:
+    """``--set a.b.c=value`` pairs -> nested dict. Values parse as YAML."""
+    out: dict[str, Any] = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise ValueError(f"--set expects key=value, got {pair!r}")
+        key, _, raw = pair.partition("=")
+        value = yaml.safe_load(raw) if raw != "" else None
+        node = out
+        parts = key.strip().split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+            if not isinstance(node, dict):
+                raise ValueError(f"--set path conflict at '{p}' in {key!r}")
+        node[parts[-1]] = value
+    return out
+
+
+def _apply_overrides(base: dict, override: dict) -> dict:
+    """Like ``_deep_merge`` but honors explicit ``None`` (``--set key=null``
+    clears the field instead of being silently dropped)."""
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(out.get(k), dict) and isinstance(v, dict):
+            out[k] = _apply_overrides(out[k], v)
+        elif v is None:
+            out.pop(k, None)
+        else:
+            out[k] = v
+    return out
+
+
+def check_polyaxonfile(
+    polyaxonfile: Union[str, Path, dict, list],
+    params: Optional[dict[str, Any]] = None,
+    presets: Optional[list[Union[str, Path, dict]]] = None,
+    set_overrides: Optional[list[str]] = None,
+    validate: bool = True,
+) -> V1Operation:
+    """Parse, merge, override, validate. The CLI front door (upstream
+    ``check_polyaxonfile``; SURVEY.md §3a step 1).
+
+    - ``polyaxonfile``: one or more YAML/JSON files/strings/dicts, deep-merged
+      left-to-right.
+    - ``params``: ``-P name=value`` bindings -> ``op.params``.
+    - ``presets``: preset operation fragments merged under the file
+      (file wins — presets fill gaps).
+    - ``set_overrides``: ``--set dotted.path=value`` applied last (wins).
+    """
+    sources = polyaxonfile if isinstance(polyaxonfile, list) else [polyaxonfile]
+    if not sources:
+        raise ValueError("Please provide a polyaxonfile")
+    merged: dict[str, Any] = {}
+    for s in sources:
+        merged = _deep_merge(merged, _load_doc(s))
+    merged = normalize_to_operation_dict(merged)
+
+    for preset in presets or []:
+        preset_doc = _load_doc(preset)
+        preset_doc.pop("kind", None)
+        preset_doc.pop("isPreset", None)
+        merged = _deep_merge(preset_doc, merged)  # file wins over preset
+
+    if set_overrides:
+        merged = _apply_overrides(merged, parse_set_overrides(set_overrides))
+
+    op = V1Operation.from_dict(merged)
+
+    if params:
+        bound = dict(op.params or {})
+        for name, value in params.items():
+            if isinstance(value, V1Param):
+                bound[name] = value
+            elif isinstance(value, dict) and ("value" in value or "ref" in value):
+                bound[name] = V1Param.from_dict(value)
+            else:
+                bound[name] = V1Param(value=value)
+        op.params = bound
+
+    if validate and op.has_component():
+        op.component.validate()
+        if op.params or op.component.inputs:
+            from ..schemas.io import validate_params_against_io
+
+            validate_params_against_io(op.component.inputs, op.component.outputs, op.params)
+    return op
+
+
+class OperationSpecification:
+    """Thin namespace mirroring upstream's spec entrypoints."""
+
+    @staticmethod
+    def read(source: Union[str, Path, dict]) -> V1Operation:
+        return get_op_from_spec(_load_doc(source))
+
+    @staticmethod
+    def compile_operation(op: V1Operation, component: Optional[V1Component] = None):
+        from ..schemas.operation import V1CompiledOperation
+
+        return V1CompiledOperation.from_operation(op, component)

@@ -1,0 +1,103 @@
+"""Polyflow-equivalent spec schemas (see SURVEY.md §2 "Polyflow schemas")."""
+
+from .base import BaseSchema
+from .component import V1Component
+from .connections import (
+    V1BucketConnection,
+    V1ClaimConnection,
+    V1Connection,
+    V1ConnectionKind,
+    V1GitConnection,
+    V1HostPathConnection,
+    V1K8sResource,
+)
+from .dag import V1Dag
+from .io import V1IO, V1Join, V1Param, V1Validation, validate_params_against_io
+from .k8s import (
+    V1Container,
+    V1ContainerPort,
+    V1EnvVar,
+    V1ResourceRequirements,
+    V1VolumeMount,
+)
+from .lifecycle import (
+    V1Build,
+    V1Cache,
+    V1Cloning,
+    V1CronSchedule,
+    V1DateTimeSchedule,
+    V1Environment,
+    V1EventTrigger,
+    V1Hook,
+    V1IntervalSchedule,
+    V1Plugins,
+    V1Termination,
+    TriggerPolicy,
+)
+from .matrix import (
+    V1Bayes,
+    V1FailureEarlyStopping,
+    V1GridSearch,
+    V1HpChoice,
+    V1HpGeomSpace,
+    V1HpLinSpace,
+    V1HpLogNormal,
+    V1HpLogSpace,
+    V1HpLogUniform,
+    V1HpNormal,
+    V1HpPChoice,
+    V1HpQLogNormal,
+    V1HpQLogUniform,
+    V1HpQNormal,
+    V1HpQUniform,
+    V1HpRange,
+    V1HpUniform,
+    V1Hyperband,
+    V1Hyperopt,
+    V1Iterative,
+    V1Mapping,
+    V1MetricEarlyStopping,
+    V1OptimizationMetric,
+    V1OptimizationResource,
+    V1RandomSearch,
+)
+from .operation import V1CompiledOperation, V1Operation
+from .run import (
+    V1CleanerJob,
+    V1DaskJob,
+    V1Init,
+    V1JaxJob,
+    V1Job,
+    V1KFReplica,
+    V1MPIJob,
+    V1MXJob,
+    V1Notifier,
+    V1Parallelism,
+    V1PaddleJob,
+    V1PytorchJob,
+    V1RayJob,
+    V1RayReplica,
+    V1RunKind,
+    V1SchedulingPolicy,
+    V1Service,
+    V1TFJob,
+    V1TPUJob,
+    V1Tuner,
+    V1XGBoostJob,
+)
+from .statuses import (
+    DONE_STATUSES,
+    RUNNABLE_STATUSES,
+    V1StatusCondition,
+    V1Statuses,
+    can_transition,
+    is_done,
+)
+from .tpu import (
+    ACCELERATOR_SPECS,
+    SliceTopology,
+    SubSliceAssignment,
+    default_topology,
+    pack_subslices,
+    parse_topology,
+)

@@ -1,0 +1,99 @@
+"""Base machinery for all spec schemas.
+
+Equivalent in role to upstream polyaxon's ``polyaxon._schemas.base``
+(reference mount empty — see SURVEY.md §2 "Polyflow schemas"): every spec
+object is a camelCase-serialized, strictly-validated model with
+``from_dict``/``to_dict``/``from_yaml`` round-tripping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Type, TypeVar
+
+import yaml
+from pydantic import BaseModel, ConfigDict
+
+T = TypeVar("T", bound="BaseSchema")
+
+
+def to_camel(s: str) -> str:
+    parts = s.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+class BaseSchema(BaseModel):
+    """Base for all polyflow-style spec objects.
+
+    - snake_case python attrs <-> camelCase wire format (polyaxonfile YAML).
+    - unknown fields rejected (spec typo protection, matching upstream's
+      strict marshmallow/pydantic validation behavior).
+    """
+
+    model_config = ConfigDict(
+        populate_by_name=True,
+        alias_generator=to_camel,
+        extra="forbid",
+        validate_assignment=True,
+        protected_namespaces=(),
+    )
+
+    @classmethod
+    def from_dict(cls: Type[T], data: dict[str, Any]) -> T:
+        return cls.model_validate(data)
+
+    @classmethod
+    def from_yaml(cls: Type[T], text: str) -> T:
+        data = yaml.safe_load(text)
+        if not isinstance(data, dict):
+            raise ValueError(f"Expected a mapping for {cls.__name__}, got {type(data).__name__}")
+        return cls.from_dict(data)
+
+    def to_dict(self, exclude_none: bool = True) -> dict[str, Any]:
+        return self.model_dump(by_alias=True, exclude_none=exclude_none, mode="json")
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    def clone(self: T) -> T:
+        return self.model_copy(deep=True)
+
+    def patch(self: T, other: T | dict[str, Any], strategy: str = "post_merge") -> T:
+        """Merge ``other`` into self following polyaxon patch strategies.
+
+        Strategies (upstream ``V1PatchStrategy``): ``replace``, ``isnull``
+        (only fill missing), ``post_merge`` (other wins), ``pre_merge``
+        (self wins on conflicts, recursing into dicts).
+        """
+        if isinstance(other, BaseSchema):
+            other_d = other.to_dict()
+        else:
+            other_d = dict(other)
+        mine = self.to_dict()
+        if strategy == "replace":
+            merged = other_d
+        elif strategy == "isnull":
+            # only fill fields entirely missing on self (shallow, per upstream)
+            merged = dict(mine)
+            for k, v in other_d.items():
+                if k not in merged or merged[k] is None:
+                    merged[k] = v
+        elif strategy == "post_merge":
+            merged = _deep_merge(mine, other_d)
+        elif strategy == "pre_merge":
+            merged = _deep_merge(other_d, mine)
+        else:
+            raise ValueError(f"Unknown patch strategy: {strategy}")
+        return type(self).from_dict(merged)
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    """Recursive dict merge; ``override`` wins on leaf conflicts."""
+    out = dict(base)
+    for k, v in override.items():
+        if v is None:
+            continue
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,80 @@
+"""``V1Component`` — the unit of reusable work (upstream ``V1Component``,
+SURVEY.md §2 "Polyflow schemas")."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pydantic import field_validator
+
+from .base import BaseSchema
+from .io import V1IO
+from .lifecycle import V1Build, V1Cache, V1Hook, V1Plugins, V1Termination
+from .run import RunUnion
+
+SPEC_VERSION = 1.1
+
+
+class V1Component(BaseSchema):
+    version: Optional[float] = None
+    kind: Optional[str] = None  # "component"
+    name: Optional[str] = None
+    description: Optional[str] = None
+    tags: Optional[list[str]] = None
+    presets: Optional[list[str]] = None
+    queue: Optional[str] = None
+    cache: Optional[V1Cache] = None
+    termination: Optional[V1Termination] = None
+    plugins: Optional[V1Plugins] = None
+    build: Optional[V1Build] = None
+    hooks: Optional[list[V1Hook]] = None
+    inputs: Optional[list[V1IO]] = None
+    outputs: Optional[list[V1IO]] = None
+    run: Optional[Any] = None  # RunUnion or V1Dag (validated below)
+    template: Optional[dict[str, Any]] = None
+    is_approved: Optional[bool] = None
+    cost: Optional[float] = None
+
+    @field_validator("kind")
+    @classmethod
+    def _check_kind(cls, v: Optional[str]) -> Optional[str]:
+        if v is not None and v != "component":
+            raise ValueError(f"Component kind must be 'component', got '{v}'")
+        return v
+
+    @field_validator("run", mode="before")
+    @classmethod
+    def _validate_run(cls, v: Any) -> Any:
+        if v is None or not isinstance(v, dict):
+            return v
+        kind = v.get("kind")
+        if kind == "dag":
+            from .dag import V1Dag
+
+            return V1Dag.from_dict(v)
+        if kind == "tuner":
+            from .run import V1Tuner
+
+            return V1Tuner.from_dict({k: x for k, x in v.items() if k != "kind"})
+        from pydantic import TypeAdapter
+
+        return TypeAdapter(RunUnion).validate_python(v)
+
+    def get_run_kind(self) -> Optional[str]:
+        if self.run is None:
+            return None
+        return getattr(self.run, "kind", None)
+
+    def get_io(self, name: str) -> Optional[V1IO]:
+        for io in (self.inputs or []) + (self.outputs or []):
+            if io.name == name:
+                return io
+        return None
+
+    def validate(self) -> None:
+        if self.run is None:
+            raise ValueError("Component requires a 'run' section")
+        names = [io.name for io in (self.inputs or []) + (self.outputs or [])]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(f"Duplicate IO names in component: {sorted(dupes)}")

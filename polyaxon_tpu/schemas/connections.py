@@ -1,0 +1,85 @@
+"""Connections — declarations of external stores/resources mounted into runs
+(upstream ``V1Connection`` + connection schemas; SURVEY.md §2 "FS /
+connections")."""
+
+from __future__ import annotations
+
+from typing import Any, Literal, Optional, Union
+
+from pydantic import Field
+
+from .base import BaseSchema
+
+
+class V1ConnectionKind:
+    HOST_PATH = "host_path"
+    VOLUME_CLAIM = "volume_claim"
+    GCS = "gcs"
+    S3 = "s3"
+    WASB = "wasb"
+    GIT = "git"
+    REGISTRY = "registry"
+    SSH = "ssh"
+    SLACK = "slack"
+    WEBHOOK = "webhook"
+    CUSTOM = "custom"
+
+    ARTIFACT_STORES = {HOST_PATH, VOLUME_CLAIM, GCS, S3, WASB}
+    ALL = {HOST_PATH, VOLUME_CLAIM, GCS, S3, WASB, GIT, REGISTRY, SSH, SLACK, WEBHOOK, CUSTOM}
+
+
+class V1BucketConnection(BaseSchema):
+    bucket: str
+
+
+class V1ClaimConnection(BaseSchema):
+    volume_claim: str
+    mount_path: str
+    read_only: Optional[bool] = None
+
+
+class V1HostPathConnection(BaseSchema):
+    host_path: str
+    mount_path: str
+    read_only: Optional[bool] = None
+
+
+class V1GitConnection(BaseSchema):
+    url: str
+    revision: Optional[str] = None
+    flags: Optional[list[str]] = None
+
+
+class V1K8sResource(BaseSchema):
+    name: str
+    items: Optional[list[str]] = None
+    mount_path: Optional[str] = None
+    is_requested: Optional[bool] = None
+
+
+class V1Connection(BaseSchema):
+    name: str
+    kind: str
+    description: Optional[str] = None
+    tags: Optional[list[str]] = None
+    schema_: Optional[
+        Union[V1BucketConnection, V1ClaimConnection, V1HostPathConnection, V1GitConnection, dict[str, Any]]
+    ] = Field(default=None, alias="schema")
+    secret: Optional[V1K8sResource] = None
+    config_map: Optional[V1K8sResource] = None
+    env: Optional[list[dict[str, Any]]] = None
+    annotations: Optional[dict[str, str]] = None
+
+    def is_artifact_store(self) -> bool:
+        return self.kind in V1ConnectionKind.ARTIFACT_STORES
+
+    def store_path(self) -> str:
+        """Root path/URI of the store this connection points at."""
+        s = self.schema_
+        if isinstance(s, V1BucketConnection):
+            return s.bucket
+        if isinstance(s, (V1ClaimConnection, V1HostPathConnection)):
+            return s.mount_path
+        if isinstance(s, dict):
+            return s.get("bucket") or s.get("mountPath") or s.get("hostPath") or ""
+        return ""

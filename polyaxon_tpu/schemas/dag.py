@@ -1,0 +1,79 @@
+"""``V1Dag`` — pipeline runtime: a graph of operations (upstream ``V1Dag``,
+SURVEY.md §2 "Runtime kinds" / §3c pipelines)."""
+
+from __future__ import annotations
+
+from typing import Any, Literal, Optional
+
+from pydantic import field_validator
+
+from .base import BaseSchema
+from .component import V1Component
+from .lifecycle import V1Environment
+from .matrix import EarlyStoppingUnion
+from .operation import V1Operation
+
+
+class V1Dag(BaseSchema):
+    kind: Literal["dag"] = "dag"
+    operations: Optional[list[V1Operation]] = None
+    components: Optional[list[V1Component]] = None
+    concurrency: Optional[int] = None
+    early_stopping: Optional[list[EarlyStoppingUnion]] = None
+    environment: Optional[V1Environment] = None
+    connections: Optional[list[str]] = None
+    volumes: Optional[list[dict[str, Any]]] = None
+
+    @field_validator("operations")
+    @classmethod
+    def _names(cls, ops: Optional[list[V1Operation]]) -> Optional[list[V1Operation]]:
+        if ops:
+            names = [o.name for o in ops if o.name]
+            dupes = {n for n in names if names.count(n) > 1}
+            if dupes:
+                raise ValueError(f"Duplicate operation names in dag: {sorted(dupes)}")
+        return ops
+
+    def get_component(self, name: str) -> Optional[V1Component]:
+        for c in self.components or []:
+            if c.name == name:
+                return c
+        return None
+
+    def topological_order(self) -> list[V1Operation]:
+        """Order operations respecting explicit ``dependencies`` + param refs
+        (``ops.NAME`` params imply an edge). Raises on cycles."""
+        ops = self.operations or []
+        keys = [o.name or f"op-{i}" for i, o in enumerate(ops)]
+        by_key = dict(zip(keys, ops))
+        deps: dict[str, set[str]] = {}
+        for key, o in zip(keys, ops):
+            d = set(o.dependencies or [])
+            for p in (o.params or {}).values():
+                if p.ref and p.ref.startswith("ops."):
+                    d.add(p.ref.split(".", 1)[1])
+            unknown = d - set(keys)
+            if unknown:
+                raise ValueError(
+                    f"Operation '{key}' depends on unknown operations: {sorted(unknown)}"
+                )
+            deps[key] = d
+        ordered: list[V1Operation] = []
+        done: set[str] = set()
+        visiting: set[str] = set()
+
+        def visit(key: str) -> None:
+            if key in done:
+                return
+            if key in visiting:
+                raise ValueError(f"Cycle detected in dag at operation '{key}'")
+            visiting.add(key)
+            for d in sorted(deps.get(key, ())):
+                visit(d)
+            visiting.discard(key)
+            done.add(key)
+            ordered.append(by_key[key])
+
+        for key in keys:
+            visit(key)
+        return ordered

@@ -1,0 +1,273 @@
+"""Typed inputs/outputs and params (polyflow IO layer).
+
+Equivalent to upstream ``polyaxon._flow.io`` / ``polyaxon._flow.params``
+(SURVEY.md §2 "Polyflow schemas"): components declare typed ``inputs`` /
+``outputs``; operations bind them with ``params`` whose values may be
+literals, references to other runs/ops/dag entities, or context expressions.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Union
+
+from pydantic import Field, field_validator, model_validator
+
+from .base import BaseSchema
+
+# --- IO types (upstream polyaxon `types` registry) -------------------------
+
+IO_TYPES = {
+    "any",
+    "int",
+    "float",
+    "bool",
+    "str",
+    "dict",
+    "list",
+    "uri",
+    "auth",
+    "path",
+    "file",
+    "dockerfile",
+    "git",
+    "image",
+    "event",
+    "artifacts",
+    "tensorboard",
+    "datetime",
+    "uuid",
+    "md5",
+    "sha1",
+    "sha256",
+}
+
+_PY_TYPES = {
+    "int": int,
+    "float": (int, float),
+    "bool": bool,
+    "str": str,
+    "dict": dict,
+    "list": list,
+}
+
+CONTEXT_EXPR = re.compile(r"\{\{\s*(?P<expr>[^}]+?)\s*\}\}")
+
+
+class V1Validation(BaseSchema):
+    """Value constraints for an IO (upstream ``V1Validation``)."""
+
+    delay: Optional[bool] = None
+    gt: Optional[float] = None
+    ge: Optional[float] = None
+    lt: Optional[float] = None
+    le: Optional[float] = None
+    multiple_of: Optional[float] = None
+    min_digits: Optional[int] = None
+    max_digits: Optional[int] = None
+    decimal_places: Optional[int] = None
+    regex: Optional[str] = None
+    min_length: Optional[int] = None
+    max_length: Optional[int] = None
+    contains: Optional[Any] = None
+    excludes: Optional[Any] = None
+    options: Optional[list[Any]] = None
+    min_items: Optional[int] = None
+    max_items: Optional[int] = None
+    keys: Optional[list[str]] = None
+    contains_keys: Optional[list[str]] = None
+    excludes_keys: Optional[list[str]] = None
+
+    def check(self, name: str, value: Any) -> None:
+        def fail(msg: str) -> None:
+            raise ValueError(f"IO '{name}': {msg} (value={value!r})")
+
+        if value is None:
+            return
+        if self.options is not None and value not in self.options:
+            fail(f"value not in options {self.options}")
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            if self.gt is not None and not value > self.gt:
+                fail(f"must be > {self.gt}")
+            if self.ge is not None and not value >= self.ge:
+                fail(f"must be >= {self.ge}")
+            if self.lt is not None and not value < self.lt:
+                fail(f"must be < {self.lt}")
+            if self.le is not None and not value <= self.le:
+                fail(f"must be <= {self.le}")
+            if self.multiple_of is not None and value % self.multiple_of != 0:
+                fail(f"must be a multiple of {self.multiple_of}")
+        if isinstance(value, str):
+            if self.regex is not None and not re.search(self.regex, value):
+                fail(f"does not match regex {self.regex!r}")
+            if self.min_length is not None and len(value) < self.min_length:
+                fail(f"shorter than minLength {self.min_length}")
+            if self.max_length is not None and len(value) > self.max_length:
+                fail(f"longer than maxLength {self.max_length}")
+        if isinstance(value, (list, tuple)):
+            if self.min_items is not None and len(value) < self.min_items:
+                fail(f"fewer than minItems {self.min_items}")
+            if self.max_items is not None and len(value) > self.max_items:
+                fail(f"more than maxItems {self.max_items}")
+        if isinstance(value, dict):
+            if self.keys is not None and set(value) != set(self.keys):
+                fail(f"keys must be exactly {self.keys}")
+            if self.contains_keys is not None and not set(self.contains_keys) <= set(value):
+                fail(f"must contain keys {self.contains_keys}")
+            if self.excludes_keys is not None and set(self.excludes_keys) & set(value):
+                fail(f"must not contain keys {self.excludes_keys}")
+        if self.contains is not None and isinstance(value, (list, str)) and self.contains not in value:
+            fail(f"must contain {self.contains!r}")
+        if self.excludes is not None and isinstance(value, (list, str)) and self.excludes in value:
+            fail(f"must not contain {self.excludes!r}")
+
+
+class V1IO(BaseSchema):
+    """A typed input or output declaration (upstream ``V1IO``)."""
+
+    name: str
+    description: Optional[str] = None
+    type: Optional[str] = None
+    value: Optional[Any] = None
+    is_optional: Optional[bool] = None
+    is_list: Optional[bool] = None
+    is_flag: Optional[bool] = None
+    arg_format: Optional[str] = None
+    connection: Optional[str] = None
+    to_init: Optional[bool] = None
+    to_env: Optional[str] = None
+    validation: Optional[V1Validation] = None
+    tags: Optional[list[str]] = None
+
+    @field_validator("type")
+    @classmethod
+    def _check_type(cls, v: Optional[str]) -> Optional[str]:
+        if v is not None and v not in IO_TYPES:
+            raise ValueError(f"Unknown IO type '{v}'. Valid: {sorted(IO_TYPES)}")
+        return v
+
+    def validate_value(self, value: Any) -> Any:
+        """Type-check + coerce a bound value against this IO declaration."""
+        if value is None:
+            if self.value is not None:
+                value = self.value
+            elif self.is_optional:
+                return None
+            else:
+                raise ValueError(f"Input '{self.name}' is required but no value was provided")
+        if isinstance(value, str) and CONTEXT_EXPR.search(value):
+            return value  # deferred: resolved at compile time from context
+        if self.is_list:
+            if not isinstance(value, list):
+                raise ValueError(f"Input '{self.name}' expects a list, got {type(value).__name__}")
+            items = value
+        else:
+            items = [value]
+        coerced = [self._coerce_one(v) for v in items]
+        value = coerced if self.is_list else coerced[0]
+        if self.validation:
+            self.validation.check(self.name, value)
+        return value
+
+    def _coerce_one(self, value: Any) -> Any:
+        t = self.type
+        if t in (None, "any") or value is None:
+            return value
+        py = _PY_TYPES.get(t)
+        if py is None:
+            # uri/path/file/git/... — represented as strings or dicts
+            return value
+        if t == "bool" and isinstance(value, str):
+            low = value.lower()
+            if low in ("true", "1", "yes", "y", "on"):
+                return True
+            if low in ("false", "0", "no", "n", "off"):
+                return False
+            raise ValueError(f"Input '{self.name}': cannot parse bool from {value!r}")
+        if t == "int" and isinstance(value, str):
+            return int(value)
+        if t == "float" and isinstance(value, str):
+            return float(value)
+        if t == "float" and isinstance(value, int) and not isinstance(value, bool):
+            return float(value)
+        if t == "dict" and isinstance(value, str):
+            import json
+
+            return json.loads(value)
+        if not isinstance(value, py) or (t in ("int", "float") and isinstance(value, bool)):
+            raise ValueError(
+                f"Input '{self.name}' expects type {t}, got {type(value).__name__}: {value!r}"
+            )
+        return value
+
+    def as_arg(self, value: Any) -> Optional[str]:
+        """Render this IO as a CLI argument (``argFormat``/``isFlag``)."""
+        if self.is_flag:
+            return f"--{self.name}" if value else None
+        if value is None:
+            return None
+        if self.arg_format:
+            return CONTEXT_EXPR.sub(lambda m: str(value), self.arg_format)
+        return f"--{self.name}={value}"
+
+
+class V1Param(BaseSchema):
+    """A param binding an operation value to a component input.
+
+    ``ref`` points at another entity (``runs.UUID``, ``ops.NAME``,
+    ``dag.inputs``) and ``value`` is then a context expression like
+    ``outputs.loss`` resolved against it (upstream ``V1Param``).
+    """
+
+    value: Optional[Any] = None
+    ref: Optional[str] = None
+    connection: Optional[str] = None
+    to_init: Optional[bool] = None
+    to_env: Optional[str] = None
+    context_only: Optional[bool] = None
+
+    @model_validator(mode="after")
+    def _check_ref(self) -> "V1Param":
+        if self.ref is not None and self.value is None:
+            raise ValueError("A param with a 'ref' must set 'value' to an expression on the ref")
+        return self
+
+
+class V1Join(BaseSchema):
+    """Fan-in query over upstream runs (upstream ``V1Join``)."""
+
+    query: Optional[str] = None
+    sort: Optional[str] = None
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    params: Optional[dict[str, V1Param]] = None
+
+
+def validate_params_against_io(
+    inputs: Optional[list[V1IO]],
+    outputs: Optional[list[V1IO]],
+    params: Optional[dict[str, V1Param]],
+) -> dict[str, Any]:
+    """Check an operation's params fully satisfy a component's IO contract.
+
+    Returns the resolved {name: value} map. Mirrors upstream
+    ``ops/params validation`` in ``polyaxon._flow.params``.
+    """
+    params = params or {}
+    declared = {io.name: io for io in (inputs or [])}
+    declared_out = {io.name: io for io in (outputs or [])}
+    resolved: dict[str, Any] = {}
+    for name, param in params.items():
+        if param.context_only:
+            continue
+        if name not in declared and name not in declared_out:
+            raise ValueError(
+                f"Param '{name}' was provided but the component declares no such input/output"
+            )
+    for name, io in declared.items():
+        param = params.get(name)
+        if param is not None and param.ref is not None:
+            resolved[name] = f"{{{{ {param.ref}.{param.value} }}}}"
+            continue
+        resolved[name] = io.validate_value(param.value if param is not None else None)
+    return resolved

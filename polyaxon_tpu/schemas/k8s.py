@@ -1,0 +1,84 @@
+"""Minimal Kubernetes-compatible container/pod primitives.
+
+Upstream polyaxon embeds full ``kubernetes.client`` swagger models in specs
+(SURVEY.md §2 "Compiler"); we define the small subset the framework actually
+renders, wire-compatible with K8s YAML (camelCase), so polyaxonfiles written
+for upstream parse unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pydantic import Field
+
+from .base import BaseSchema
+
+
+class V1EnvVar(BaseSchema):
+    name: str
+    value: Optional[str] = None
+    value_from: Optional[dict[str, Any]] = None
+
+
+class V1ResourceRequirements(BaseSchema):
+    limits: Optional[dict[str, Any]] = None
+    requests: Optional[dict[str, Any]] = None
+
+
+class V1VolumeMount(BaseSchema):
+    name: str
+    mount_path: Optional[str] = None
+    sub_path: Optional[str] = None
+    read_only: Optional[bool] = None
+
+
+class V1ContainerPort(BaseSchema):
+    container_port: int
+    name: Optional[str] = None
+    host_port: Optional[int] = None
+    protocol: Optional[str] = None
+
+
+class V1Container(BaseSchema):
+    """A container spec (subset of k8s core/v1 Container)."""
+
+    name: Optional[str] = None
+    image: Optional[str] = None
+    image_pull_policy: Optional[str] = None
+    command: Optional[list[str]] = None
+    args: Optional[list[str]] = None
+    env: Optional[list[V1EnvVar]] = None
+    env_from: Optional[list[dict[str, Any]]] = None
+    resources: Optional[V1ResourceRequirements] = None
+    volume_mounts: Optional[list[V1VolumeMount]] = None
+    working_dir: Optional[str] = None
+    ports: Optional[list[V1ContainerPort]] = None
+    stdin: Optional[bool] = None
+    tty: Optional[bool] = None
+
+    def get_env_dict(self) -> dict[str, str]:
+        return {e.name: e.value or "" for e in self.env or []}
+
+
+class V1Affinity(BaseSchema):
+    model_config = BaseSchema.model_config | {"extra": "allow"}
+
+
+class V1Toleration(BaseSchema):
+    key: Optional[str] = None
+    operator: Optional[str] = None
+    value: Optional[str] = None
+    effect: Optional[str] = None
+    toleration_seconds: Optional[int] = None
+
+
+class V1HostAlias(BaseSchema):
+    ip: Optional[str] = None
+    hostnames: Optional[list[str]] = None
+
+
+class V1PodDNSConfig(BaseSchema):
+    nameservers: Optional[list[str]] = None
+    searches: Optional[list[str]] = None
+    options: Optional[list[dict[str, Any]]] = None

@@ -1,0 +1,160 @@
+"""Component/operation lifecycle knobs: environment, termination, plugins,
+cache, hooks, build, schedules, events, dependencies.
+
+Maps to upstream ``polyaxon._flow`` modules ``environment/termination/plugins/
+cache/hooks/builds/schedules/events`` (SURVEY.md §2 "Polyflow schemas").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from pydantic import Field
+
+from .base import BaseSchema
+from .io import V1Param
+from .k8s import V1Affinity, V1HostAlias, V1PodDNSConfig, V1Toleration
+
+
+class V1Environment(BaseSchema):
+    """Pod-level runtime environment (upstream ``V1Environment``)."""
+
+    labels: Optional[dict[str, str]] = None
+    annotations: Optional[dict[str, str]] = None
+    node_selector: Optional[dict[str, str]] = None
+    affinity: Optional[V1Affinity] = None
+    tolerations: Optional[list[V1Toleration]] = None
+    node_name: Optional[str] = None
+    service_account_name: Optional[str] = None
+    host_aliases: Optional[list[V1HostAlias]] = None
+    security_context: Optional[dict[str, Any]] = None
+    image_pull_secrets: Optional[list[str]] = None
+    host_network: Optional[bool] = None
+    host_pid: Optional[bool] = None
+    dns_policy: Optional[str] = None
+    dns_config: Optional[V1PodDNSConfig] = None
+    scheduler_name: Optional[str] = None
+    priority_class_name: Optional[str] = None
+    priority: Optional[int] = None
+    restart_policy: Optional[str] = None
+
+
+class V1Termination(BaseSchema):
+    """Retry/TTL/timeout policy (upstream ``V1Termination``)."""
+
+    max_retries: Optional[int] = None
+    ttl: Optional[int] = None
+    timeout: Optional[int] = None
+
+
+class V1PluginsNotification(BaseSchema):
+    connections: Optional[list[str]] = None
+    trigger: Optional[str] = None
+
+
+class V1Plugins(BaseSchema):
+    """Toggles for the auxiliary machinery injected around the user container
+    (upstream ``V1Plugins``): auth sidecar, log/artifact collection, etc."""
+
+    auth: Optional[bool] = None
+    docker: Optional[bool] = None
+    shm: Optional[bool] = None
+    mount_artifacts_store: Optional[bool] = None
+    collect_artifacts: Optional[bool] = None
+    collect_logs: Optional[bool] = None
+    collect_resources: Optional[bool] = None
+    sync_statuses: Optional[bool] = None
+    auto_resume: Optional[bool] = None
+    log_level: Optional[str] = None
+    side_containers: Optional[bool] = None
+    external_host: Optional[bool] = None
+    sidecar: Optional[dict[str, Any]] = None
+    notifications: Optional[list[V1PluginsNotification]] = None
+
+
+class V1Cache(BaseSchema):
+    """Run-result caching policy (upstream ``V1Cache``)."""
+
+    disable: Optional[bool] = None
+    ttl: Optional[int] = None
+    io: Optional[list[str]] = None
+    sections: Optional[list[str]] = None
+
+
+class V1Hook(BaseSchema):
+    """Post-run hook operation (upstream ``V1Hook``)."""
+
+    connection: Optional[str] = None
+    trigger: Optional[str] = None  # succeeded | failed | stopped | done
+    hub_ref: Optional[str] = None
+    conditions: Optional[str] = None
+    presets: Optional[list[str]] = None
+    params: Optional[dict[str, V1Param]] = None
+    queue: Optional[str] = None
+    disable_defaults: Optional[bool] = None
+
+
+class V1Build(BaseSchema):
+    """Pre-run image build step (upstream ``V1Build``)."""
+
+    hub_ref: Optional[str] = None
+    connection: Optional[str] = None
+    queue: Optional[str] = None
+    presets: Optional[list[str]] = None
+    params: Optional[dict[str, V1Param]] = None
+    run_patch: Optional[dict[str, Any]] = None
+    patch_strategy: Optional[str] = None
+
+
+class V1CronSchedule(BaseSchema):
+    kind: str = Field(default="cron", frozen=True)
+    cron: str
+    start_at: Optional[str] = None
+    end_at: Optional[str] = None
+    max_runs: Optional[int] = None
+    depends_on_past: Optional[bool] = None
+
+
+class V1IntervalSchedule(BaseSchema):
+    kind: str = Field(default="interval", frozen=True)
+    frequency: Union[int, float, str]
+    start_at: Optional[str] = None
+    end_at: Optional[str] = None
+    max_runs: Optional[int] = None
+    depends_on_past: Optional[bool] = None
+
+
+class V1DateTimeSchedule(BaseSchema):
+    kind: str = Field(default="datetime", frozen=True)
+    start_at: str
+
+
+V1Schedule = Union[V1CronSchedule, V1IntervalSchedule, V1DateTimeSchedule]
+
+
+class V1EventTrigger(BaseSchema):
+    """Upstream-run event that triggers this op (upstream ``V1EventTrigger``)."""
+
+    kinds: list[str]
+    ref: str
+
+
+class V1Cloning(BaseSchema):
+    """How a run was cloned (upstream ``V1Cloning``); kinds: copy|restart|cache."""
+
+    uuid: Optional[str] = None
+    kind: Optional[str] = None
+    artifacts: Optional[list[str]] = None
+
+
+class TriggerPolicy:
+    """Upstream ``V1TriggerPolicy`` values for DAG dependencies."""
+
+    ALL_SUCCEEDED = "all_succeeded"
+    ALL_FAILED = "all_failed"
+    ALL_DONE = "all_done"
+    ONE_SUCCEEDED = "one_succeeded"
+    ONE_FAILED = "one_failed"
+    ONE_DONE = "one_done"
+
+    VALUES = {ALL_SUCCEEDED, ALL_FAILED, ALL_DONE, ONE_SUCCEEDED, ONE_FAILED, ONE_DONE}

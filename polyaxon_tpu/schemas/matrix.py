@@ -1,0 +1,279 @@
+"""Matrix (hyperparameter search) kinds + the hp search-space distributions.
+
+Parity with upstream ``polyaxon._flow.matrix`` (SURVEY.md §2 "Matrix / tuning
+kinds"): ``V1GridSearch``, ``V1RandomSearch``, ``V1Hyperband``, ``V1Bayes``,
+``V1Hyperopt``, ``V1Mapping``, ``V1Iterative`` plus early-stopping policies.
+The actual search algorithms live in ``polyaxon_tpu.hypertune``.
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Any, Literal, Optional, Union
+
+from pydantic import Field, model_validator
+
+from .base import BaseSchema
+from .run import V1Tuner
+
+# --- hp distributions -------------------------------------------------------
+
+
+class V1HpChoice(BaseSchema):
+    kind: Literal["choice"] = "choice"
+    value: list[Any]
+
+
+class V1HpPChoice(BaseSchema):
+    """Weighted choice: list of [value, probability] pairs."""
+
+    kind: Literal["pchoice"] = "pchoice"
+    value: list[Any]
+
+    @model_validator(mode="after")
+    def _check(self) -> "V1HpPChoice":
+        total = 0.0
+        for pair in self.value:
+            if not (isinstance(pair, (list, tuple)) and len(pair) == 2):
+                raise ValueError("pchoice entries must be [value, prob] pairs")
+            total += float(pair[1])
+        if abs(total - 1.0) > 1e-6:
+            raise ValueError(f"pchoice probabilities must sum to 1, got {total}")
+        return self
+
+
+class V1HpRange(BaseSchema):
+    """Discrete range [start, stop, step] (stop exclusive, like Python)."""
+
+    kind: Literal["range"] = "range"
+    value: Union[list[Any], dict[str, Any], str]
+
+    def as_tuple(self) -> tuple[float, float, float]:
+        v = self.value
+        if isinstance(v, str):
+            v = [float(x) for x in v.replace(":", ",").split(",")]
+        if isinstance(v, dict):
+            return float(v["start"]), float(v["stop"]), float(v.get("step", 1))
+        if len(v) == 2:
+            return float(v[0]), float(v[1]), 1.0
+        return float(v[0]), float(v[1]), float(v[2])
+
+
+class V1HpLinSpace(BaseSchema):
+    kind: Literal["linspace"] = "linspace"
+    value: Union[list[Any], dict[str, Any], str]
+
+    def as_tuple(self) -> tuple[float, float, int]:
+        v = self.value
+        if isinstance(v, str):
+            v = [float(x) for x in v.replace(":", ",").split(",")]
+        if isinstance(v, dict):
+            return float(v["start"]), float(v["stop"]), int(v["num"])
+        return float(v[0]), float(v[1]), int(v[2])
+
+
+class V1HpLogSpace(V1HpLinSpace):
+    kind: Literal["logspace"] = "logspace"  # type: ignore[assignment]
+
+
+class V1HpGeomSpace(V1HpLinSpace):
+    kind: Literal["geomspace"] = "geomspace"  # type: ignore[assignment]
+
+
+class _TwoParam(BaseSchema):
+    value: Union[list[Any], dict[str, Any]]
+
+    def as_pair(self, a: str, b: str) -> tuple[float, float]:
+        v = self.value
+        if isinstance(v, dict):
+            return float(v[a]), float(v[b])
+        return float(v[0]), float(v[1])
+
+
+class V1HpUniform(_TwoParam):
+    kind: Literal["uniform"] = "uniform"
+
+
+class V1HpQUniform(_TwoParam):
+    kind: Literal["quniform"] = "quniform"
+
+
+class V1HpLogUniform(_TwoParam):
+    kind: Literal["loguniform"] = "loguniform"
+
+
+class V1HpQLogUniform(_TwoParam):
+    kind: Literal["qloguniform"] = "qloguniform"
+
+
+class V1HpNormal(_TwoParam):
+    kind: Literal["normal"] = "normal"
+
+
+class V1HpQNormal(_TwoParam):
+    kind: Literal["qnormal"] = "qnormal"
+
+
+class V1HpLogNormal(_TwoParam):
+    kind: Literal["lognormal"] = "lognormal"
+
+
+class V1HpQLogNormal(_TwoParam):
+    kind: Literal["qlognormal"] = "qlognormal"
+
+
+class V1HpDateRange(BaseSchema):
+    kind: Literal["daterange"] = "daterange"
+    value: list[Any]
+
+
+class V1HpDateTimeRange(BaseSchema):
+    kind: Literal["datetimerange"] = "datetimerange"
+    value: list[Any]
+
+
+HpUnion = Annotated[
+    Union[
+        V1HpChoice, V1HpPChoice, V1HpRange, V1HpLinSpace, V1HpLogSpace,
+        V1HpGeomSpace, V1HpUniform, V1HpQUniform, V1HpLogUniform,
+        V1HpQLogUniform, V1HpNormal, V1HpQNormal, V1HpLogNormal,
+        V1HpQLogNormal, V1HpDateRange, V1HpDateTimeRange,
+    ],
+    Field(discriminator="kind"),
+]
+
+# Distributions a grid search can enumerate exhaustively.
+GRID_KINDS = {"choice", "range", "linspace", "logspace", "geomspace"}
+
+
+# --- early stopping ---------------------------------------------------------
+
+
+class V1MetricEarlyStopping(BaseSchema):
+    kind: Literal["metric_early_stopping"] = "metric_early_stopping"
+    metric: str
+    value: float
+    optimization: str = "maximize"  # maximize | minimize
+    policy: Optional[dict[str, Any]] = None
+
+
+class V1FailureEarlyStopping(BaseSchema):
+    kind: Literal["failure_early_stopping"] = "failure_early_stopping"
+    percent: float
+
+
+EarlyStoppingUnion = Annotated[
+    Union[V1MetricEarlyStopping, V1FailureEarlyStopping],
+    Field(discriminator="kind"),
+]
+
+
+class V1OptimizationMetric(BaseSchema):
+    name: str
+    optimization: str = "maximize"
+
+    @property
+    def maximize(self) -> bool:
+        return self.optimization.lower() == "maximize"
+
+
+class V1OptimizationResource(BaseSchema):
+    """The budget resource Hyperband rations (e.g. training epochs/steps)."""
+
+    name: str
+    type: str = "int"
+
+    def cast(self, v: float) -> Union[int, float]:
+        return int(v) if self.type == "int" else float(v)
+
+
+# --- matrix kinds -----------------------------------------------------------
+
+
+class _BaseSearch(BaseSchema):
+    params: dict[str, HpUnion]
+    concurrency: Optional[int] = None
+    early_stopping: Optional[list[EarlyStoppingUnion]] = None
+    tuner: Optional[V1Tuner] = None
+
+
+class V1Mapping(BaseSchema):
+    """Explicit list of param dicts to fan out (upstream ``V1Mapping``)."""
+
+    kind: Literal["mapping"] = "mapping"
+    values: list[dict[str, Any]]
+    concurrency: Optional[int] = None
+    early_stopping: Optional[list[EarlyStoppingUnion]] = None
+
+
+class V1GridSearch(_BaseSearch):
+    kind: Literal["grid"] = "grid"
+    num_runs: Optional[int] = None
+
+    @model_validator(mode="after")
+    def _gridable(self) -> "V1GridSearch":
+        for name, hp in self.params.items():
+            if hp.kind not in GRID_KINDS:
+                raise ValueError(
+                    f"Grid search param '{name}' uses non-enumerable distribution "
+                    f"'{hp.kind}'; use random/bayes/hyperband instead"
+                )
+        return self
+
+
+class V1RandomSearch(_BaseSearch):
+    kind: Literal["random"] = "random"
+    num_runs: int
+    seed: Optional[int] = None
+
+
+class V1Hyperband(_BaseSearch):
+    """Hyperband successive halving (Li et al. 2018). Bracket math in
+    ``hypertune.hyperband`` mirrors the paper: s_max = floor(log_eta(R)),
+    n_i/r_i per rung; upstream ``V1Hyperband``."""
+
+    kind: Literal["hyperband"] = "hyperband"
+    max_iterations: int
+    eta: int = 3
+    resource: V1OptimizationResource
+    metric: V1OptimizationMetric
+    resume: Optional[bool] = None
+    seed: Optional[int] = None
+
+
+class V1Bayes(_BaseSearch):
+    """Bayesian optimization with a GP surrogate (upstream ``V1Bayes``)."""
+
+    kind: Literal["bayes"] = "bayes"
+    num_initial_runs: int
+    max_iterations: int
+    metric: V1OptimizationMetric
+    utility_function: Optional[dict[str, Any]] = None  # {acquisitionFunction, kappa, eps, gamma, numWarmup, numSamples}
+    seed: Optional[int] = None
+
+
+class V1Hyperopt(_BaseSearch):
+    """TPE/rand/anneal via a hyperopt-compatible bridge (upstream ``V1Hyperopt``)."""
+
+    kind: Literal["hyperopt"] = "hyperopt"
+    algorithm: str = "tpe"  # tpe | rand | anneal
+    num_runs: int
+    max_iterations: Optional[int] = None
+    metric: V1OptimizationMetric
+    seed: Optional[int] = None
+
+
+class V1Iterative(_BaseSearch):
+    """User-driven iterative tuning loop (upstream ``V1Iterative``)."""
+
+    kind: Literal["iterative"] = "iterative"
+    max_iterations: int
+    seed: Optional[int] = None
+
+
+MatrixUnion = Annotated[
+    Union[
+        V1Mapping, V1GridSearch, V1RandomSearch, V1Hyperband,
+        V1Bayes, V1Hyperopt, V1Iterative,
+    ],
+    Field(discriminator="kind"),
+]

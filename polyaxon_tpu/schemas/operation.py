@@ -1,0 +1,193 @@
+"""``V1Operation`` — a component + bindings, ready to run; and
+``V1CompiledOperation`` — the compiler's fully-resolved output
+(upstream ``V1Operation``/``V1CompiledOperation``, SURVEY.md §3a)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pydantic import field_validator, model_validator
+
+from .base import BaseSchema, _deep_merge
+from .component import V1Component
+from .io import V1IO, V1Join, V1Param
+from .lifecycle import (
+    V1Build,
+    V1Cache,
+    V1CronSchedule,
+    V1DateTimeSchedule,
+    V1EventTrigger,
+    V1Hook,
+    V1IntervalSchedule,
+    V1Plugins,
+    V1Termination,
+    TriggerPolicy,
+)
+from .matrix import MatrixUnion
+
+
+class _OpCommon(BaseSchema):
+    version: Optional[float] = None
+    kind: Optional[str] = None  # "operation"
+    name: Optional[str] = None
+    description: Optional[str] = None
+    tags: Optional[list[str]] = None
+    presets: Optional[list[str]] = None
+    queue: Optional[str] = None
+    cache: Optional[V1Cache] = None
+    termination: Optional[V1Termination] = None
+    plugins: Optional[V1Plugins] = None
+    build: Optional[V1Build] = None
+    hooks: Optional[list[V1Hook]] = None
+    params: Optional[dict[str, V1Param]] = None
+    matrix: Optional[MatrixUnion] = None
+    joins: Optional[list[V1Join]] = None
+    schedule: Optional[Any] = None
+    events: Optional[list[V1EventTrigger]] = None
+    dependencies: Optional[list[str]] = None
+    trigger: Optional[str] = None
+    conditions: Optional[str] = None
+    skip_on_upstream_skip: Optional[bool] = None
+    run_patch: Optional[dict[str, Any]] = None
+    patch_strategy: Optional[str] = None
+    is_preset: Optional[bool] = None
+    is_approved: Optional[bool] = None
+    cost: Optional[float] = None
+
+    @field_validator("trigger")
+    @classmethod
+    def _check_trigger(cls, v: Optional[str]) -> Optional[str]:
+        if v is not None and v not in TriggerPolicy.VALUES:
+            raise ValueError(f"Unknown trigger policy '{v}'")
+        return v
+
+    @field_validator("schedule", mode="before")
+    @classmethod
+    def _parse_schedule(cls, v: Any) -> Any:
+        if v is None or not isinstance(v, dict):
+            return v
+        kinds = {
+            "cron": V1CronSchedule,
+            "interval": V1IntervalSchedule,
+            "datetime": V1DateTimeSchedule,
+        }
+        k = v.get("kind")
+        if k not in kinds:
+            raise ValueError(f"Unknown schedule kind '{k}'")
+        return kinds[k].from_dict(v)
+
+
+class V1Operation(_OpCommon):
+    # Exactly one of these identifies the component to run:
+    component: Optional[V1Component] = None  # inline (YAML `component:` or `hubRef`-free file)
+    hub_ref: Optional[str] = None
+    path_ref: Optional[str] = None
+    url_ref: Optional[str] = None
+    dag_ref: Optional[str] = None
+    template: Optional[dict[str, Any]] = None
+
+    @field_validator("kind")
+    @classmethod
+    def _check_kind(cls, v: Optional[str]) -> Optional[str]:
+        if v is not None and v != "operation":
+            raise ValueError(f"Operation kind must be 'operation', got '{v}'")
+        return v
+
+    @model_validator(mode="after")
+    def _one_ref(self) -> "V1Operation":
+        refs = [
+            r
+            for r in (self.component, self.hub_ref, self.path_ref, self.url_ref, self.dag_ref)
+            if r is not None
+        ]
+        if len(refs) > 1:
+            raise ValueError(
+                "Operation must set exactly one of: component, hubRef, pathRef, urlRef, dagRef"
+            )
+        if not refs and not self.is_preset and self.template is None:
+            raise ValueError(
+                "Operation must reference a component (one of: component, hubRef, "
+                "pathRef, urlRef, dagRef) unless it is a preset or template"
+            )
+        return self
+
+    def has_component(self) -> bool:
+        return self.component is not None
+
+
+class V1CompiledOperation(_OpCommon):
+    """The fully-resolved operation the scheduler executes: component inlined,
+    presets merged, params validated & defaulted, run patched."""
+
+    inputs: Optional[list[V1IO]] = None
+    outputs: Optional[list[V1IO]] = None
+    run: Optional[Any] = None
+
+    @field_validator("run", mode="before")
+    @classmethod
+    def _validate_run(cls, v: Any) -> Any:
+        return V1Component._validate_run(v)
+
+    @classmethod
+    def from_operation(cls, op: V1Operation, component: Optional[V1Component] = None) -> "V1CompiledOperation":
+        """Inline the component into the op; op-level fields win (upstream
+        compiler ``resolve()`` step 1, SURVEY.md §3a)."""
+        comp = component or op.component
+        if comp is None:
+            raise ValueError("Operation has no inline component and none was provided")
+        comp.validate()
+        run_d = comp.run.to_dict() if comp.run is not None else None
+        if op.run_patch:
+            strategy = op.patch_strategy or "post_merge"
+            if strategy == "replace":
+                run_d = dict(op.run_patch)
+            elif strategy == "isnull":
+                run_d = run_d or dict(op.run_patch)
+            elif strategy == "post_merge":
+                run_d = _deep_merge(run_d or {}, op.run_patch)
+            else:  # pre_merge
+                run_d = _deep_merge(dict(op.run_patch), run_d or {})
+        op_d = op.to_dict()
+        comp_d = comp.to_dict()
+
+        def pick(*fields: str) -> dict[str, Any]:
+            """op-level value wins; fall back to the component's."""
+            out = {}
+            for f in fields:
+                v = op_d.get(f)
+                if v is None:
+                    v = comp_d.get(f)
+                if v is not None:
+                    out[f] = v
+            return out
+
+        data: dict[str, Any] = {
+            "kind": "compiled_operation",
+            "tags": sorted(set(op.tags or []) | set(comp.tags or [])) or None,
+            **pick(
+                "version", "name", "description", "presets", "queue", "cache",
+                "termination", "plugins", "build", "hooks", "isApproved", "cost",
+            ),
+            # op-only sections pass through verbatim
+            **{
+                k: op_d.get(k)
+                for k in (
+                    "params", "matrix", "joins", "schedule", "events", "dependencies",
+                    "trigger", "conditions", "skipOnUpstreamSkip",
+                )
+            },
+            "inputs": comp_d.get("inputs"),
+            "outputs": comp_d.get("outputs"),
+            "run": run_d,
+        }
+        return cls.from_dict({k: v for k, v in data.items() if v is not None})
+
+    @field_validator("kind")
+    @classmethod
+    def _check_kind(cls, v: Optional[str]) -> Optional[str]:
+        if v is not None and v != "compiled_operation":
+            raise ValueError(f"CompiledOperation kind must be 'compiled_operation', got '{v}'")
+        return v
+
+    def get_run_kind(self) -> Optional[str]:
+        return getattr(self.run, "kind", None) if self.run is not None else None

@@ -1,0 +1,326 @@
+"""Runtime kinds — the ``run:`` section of a component.
+
+Parity targets (SURVEY.md §2 "Runtime kinds"): ``V1Job``, ``V1Service``,
+``V1Dag``, ``V1Tuner``, ``V1Notifier``, ``V1CleanerJob`` and the distributed
+kinds ``V1TFJob``/``V1PytorchJob``/``V1MPIJob``/``V1MXJob``/``V1XGBoostJob``/
+``V1PaddleJob``/``V1DaskJob``/``V1RayJob`` (upstream delegates these to
+Kubeflow CRDs + NCCL).  New, TPU-native kinds per the north star
+(BASELINE.json): ``V1TPUJob``/``V1JaxJob`` — a slice topology + a parallelism
+spec over a JAX device mesh; rendezvous is jax.distributed/XLA coordinator
+env, collectives ride ICI, and no NCCL exists anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Any, Literal, Optional, Union
+
+from pydantic import Field, field_validator
+
+from .base import BaseSchema
+from .io import V1Param
+from .k8s import V1Container
+from .lifecycle import V1Environment
+from .tpu import ACCELERATOR_SPECS, SliceTopology
+
+
+class V1RunKind:
+    JOB = "job"
+    SERVICE = "service"
+    DAG = "dag"
+    TUNER = "tuner"
+    NOTIFIER = "notifier"
+    CLEANER = "cleaner"
+    WATCHDOG = "watchdog"
+    TFJOB = "tfjob"
+    PYTORCHJOB = "pytorchjob"
+    MPIJOB = "mpijob"
+    MXJOB = "mxjob"
+    XGBJOB = "xgbjob"
+    PADDLEJOB = "paddlejob"
+    DASKJOB = "daskjob"
+    RAYJOB = "rayjob"
+    TPUJOB = "tpujob"
+    JAXJOB = "jaxjob"
+
+    DISTRIBUTED = {TFJOB, PYTORCHJOB, MPIJOB, MXJOB, XGBJOB, PADDLEJOB, DASKJOB, RAYJOB, TPUJOB, JAXJOB}
+    ALL = {
+        JOB, SERVICE, DAG, TUNER, NOTIFIER, CLEANER, WATCHDOG,
+        TFJOB, PYTORCHJOB, MPIJOB, MXJOB, XGBJOB, PADDLEJOB, DASKJOB, RAYJOB,
+        TPUJOB, JAXJOB,
+    }
+
+
+class V1Init(BaseSchema):
+    """One init step: fetch code/artifacts/files before the main container
+    (upstream ``V1Init``; executed by the init runtime, SURVEY.md §2)."""
+
+    artifacts: Optional[dict[str, Any]] = None  # {files:[], dirs:[], workers:int}
+    paths: Optional[list[Any]] = None
+    git: Optional[dict[str, Any]] = None  # {url, revision, flags}
+    dockerfile: Optional[dict[str, Any]] = None
+    file: Optional[dict[str, Any]] = None
+    tensorboard: Optional[dict[str, Any]] = None
+    lineage_ref: Optional[str] = None
+    model_ref: Optional[str] = None
+    artifact_ref: Optional[str] = None
+    connection: Optional[str] = None
+    path: Optional[str] = None
+    container: Optional[V1Container] = None
+
+
+class _BaseRun(BaseSchema):
+    environment: Optional[V1Environment] = None
+    connections: Optional[list[str]] = None
+    volumes: Optional[list[dict[str, Any]]] = None
+
+
+class V1Job(_BaseRun):
+    """Batch job: init steps + one main container (upstream ``V1Job``)."""
+
+    kind: Literal["job"] = "job"
+    init: Optional[list[V1Init]] = None
+    sidecars: Optional[list[V1Container]] = None
+    container: Optional[V1Container] = None
+
+
+class V1Service(_BaseRun):
+    """Long-running service with exposed ports (upstream ``V1Service``)."""
+
+    kind: Literal["service"] = "service"
+    init: Optional[list[V1Init]] = None
+    sidecars: Optional[list[V1Container]] = None
+    container: Optional[V1Container] = None
+    ports: Optional[list[int]] = None
+    rewrite_path: Optional[bool] = None
+    is_external: Optional[bool] = None
+    replicas: Optional[int] = None
+
+
+class V1KFReplica(BaseSchema):
+    """A replica group in a Kubeflow-style distributed job (upstream
+    ``V1KFReplica``): N pods sharing a role (worker/ps/master/...)."""
+
+    replicas: Optional[int] = None
+    restart_policy: Optional[str] = None
+    environment: Optional[V1Environment] = None
+    connections: Optional[list[str]] = None
+    volumes: Optional[list[dict[str, Any]]] = None
+    init: Optional[list[V1Init]] = None
+    sidecars: Optional[list[V1Container]] = None
+    container: Optional[V1Container] = None
+
+
+class V1SchedulingPolicy(BaseSchema):
+    min_available: Optional[int] = None
+    queue: Optional[str] = None
+    min_resources: Optional[dict[str, Any]] = None
+    priority_class: Optional[str] = None
+    schedule_timeout_seconds: Optional[int] = None
+
+
+class _KFJob(_BaseRun):
+    clean_pod_policy: Optional[str] = None
+    scheduling_policy: Optional[V1SchedulingPolicy] = None
+
+
+class V1TFJob(_KFJob):
+    """TensorFlow multi-worker job (upstream delegates to Kubeflow ``TFJob``;
+    our compiler maps it onto the TPU runtime — BASELINE config 3)."""
+
+    kind: Literal["tfjob"] = "tfjob"
+    enable_dynamic_worker: Optional[bool] = None
+    success_policy: Optional[str] = None
+    chief: Optional[V1KFReplica] = None
+    ps: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    evaluator: Optional[V1KFReplica] = None
+
+
+class V1PytorchJob(_KFJob):
+    """PyTorch DDP job (upstream -> Kubeflow ``PyTorchJob`` + NCCL env;
+    our compiler maps replicas -> mesh ``data`` axis — BASELINE config 2)."""
+
+    kind: Literal["pytorchjob"] = "pytorchjob"
+    master: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    nproc_per_node: Optional[int] = None
+    elastic_policy: Optional[dict[str, Any]] = None
+
+
+class V1MPIJob(_KFJob):
+    """MPI/Horovod job (upstream -> ``mpirun`` + NCCL allreduce; ours ->
+    the same user script with allreduce over ICI — BASELINE config 4)."""
+
+    kind: Literal["mpijob"] = "mpijob"
+    slots_per_worker: Optional[int] = None
+    launcher: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+
+
+class V1MXJob(_KFJob):
+    kind: Literal["mxjob"] = "mxjob"
+    mode: Optional[str] = None
+    scheduler: Optional[V1KFReplica] = None
+    server: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    tuner: Optional[V1KFReplica] = None
+    tuner_tracker: Optional[V1KFReplica] = None
+    tuner_server: Optional[V1KFReplica] = None
+
+
+class V1XGBoostJob(_KFJob):
+    kind: Literal["xgbjob"] = "xgbjob"
+    master: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+
+
+class V1PaddleJob(_KFJob):
+    kind: Literal["paddlejob"] = "paddlejob"
+    master: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+
+
+class V1DaskJob(_BaseRun):
+    kind: Literal["daskjob"] = "daskjob"
+    job: Optional[V1KFReplica] = None
+    worker: Optional[V1KFReplica] = None
+    scheduler: Optional[V1KFReplica] = None
+
+
+class V1RayReplica(V1KFReplica):
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    group_name: Optional[str] = None
+    ray_start_params: Optional[dict[str, str]] = None
+
+
+class V1RayJob(_BaseRun):
+    kind: Literal["rayjob"] = "rayjob"
+    entrypoint: Optional[str] = None
+    runtime_env: Optional[dict[str, Any]] = None
+    metadata: Optional[dict[str, str]] = None
+    ray_version: Optional[str] = None
+    head: Optional[V1RayReplica] = None
+    workers: Optional[list[V1RayReplica]] = None
+
+
+class V1Parallelism(BaseSchema):
+    """Mesh-axis sizes for the TPU runtime. Product must equal chip count.
+
+    Axes follow the scaling-book decomposition: ``data`` (pure DP),
+    ``fsdp`` (data + sharded params), ``model`` (tensor parallel),
+    ``context`` (sequence/ring-attention parallel), ``expert`` (MoE),
+    ``stage`` (pipeline). This replaces the reference's
+    replicas+NCCL description of distribution (SURVEY.md §2 table).
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    model: int = 1
+    context: int = 1
+    expert: int = 1
+    stage: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.data * self.fsdp * self.model * self.context * self.expert * self.stage
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "stage": self.stage,
+            "expert": self.expert,
+            "context": self.context,
+            "model": self.model,
+        }
+
+
+class V1TPUJob(_BaseRun):
+    """TPU-native distributed job: one process per TPU-VM host of a slice.
+
+    The operator provisions the slice via GKE nodeSelectors
+    (``gke-tpu-accelerator``/``gke-tpu-topology``), creates one pod per host,
+    and injects jax.distributed rendezvous env (coordinator address,
+    num_processes, process_id) instead of NCCL ``MASTER_ADDR``/``WORLD_SIZE``
+    (north star, BASELINE.json).
+    """
+
+    kind: Literal["tpujob"] = "tpujob"
+    accelerator: str = "v5e"
+    topology: Optional[str] = None  # e.g. "8x8"; or use `slices` alias e.g. v5e-64
+    slice_alias: Optional[str] = None  # e.g. "v5e-64"
+    num_slices: int = 1
+    parallelism: Optional[V1Parallelism] = None
+    init: Optional[list[V1Init]] = None
+    sidecars: Optional[list[V1Container]] = None
+    container: Optional[V1Container] = None
+    # Training-runtime shortcut: run a built-in model instead of a container
+    runtime: Optional[dict[str, Any]] = None  # {model, config, precision, remat, ...}
+
+    @field_validator("accelerator")
+    @classmethod
+    def _check_accelerator(cls, v: str) -> str:
+        v = v.lower()
+        if v not in ACCELERATOR_SPECS:
+            raise ValueError(f"Unknown accelerator '{v}'. Valid: {sorted(ACCELERATOR_SPECS)}")
+        return v
+
+    def get_slice(self) -> SliceTopology:
+        if self.slice_alias:
+            return SliceTopology.from_alias(self.slice_alias, self.num_slices)
+        if not self.topology:
+            raise ValueError("tpujob requires either 'topology' or 'sliceAlias'")
+        return SliceTopology(
+            accelerator=self.accelerator, topology=self.topology, num_slices=self.num_slices
+        )
+
+
+class V1JaxJob(V1TPUJob):
+    """Alias kind for spec parity with the north star naming."""
+
+    kind: Literal["jaxjob"] = "jaxjob"  # type: ignore[assignment]
+
+
+class V1Tuner(BaseSchema):
+    """The tuner component reference used by matrix pipelines
+    (upstream ``V1Tuner``)."""
+
+    hub_ref: Optional[str] = None
+    presets: Optional[list[str]] = None
+    queue: Optional[str] = None
+    params: Optional[dict[str, V1Param]] = None
+    container: Optional[V1Container] = None
+
+
+class V1Notifier(_BaseRun):
+    kind: Literal["notifier"] = "notifier"
+    connections: Optional[list[str]] = None
+    container: Optional[V1Container] = None
+
+
+class V1CleanerJob(V1Job):
+    kind: Literal["cleaner"] = "cleaner"  # type: ignore[assignment]
+
+
+# V1Dag lives in dag.py (needs V1Operation; avoids a cycle via late import)
+
+RunUnion = Annotated[
+    Union[
+        V1Job,
+        V1Service,
+        V1TFJob,
+        V1PytorchJob,
+        V1MPIJob,
+        V1MXJob,
+        V1XGBoostJob,
+        V1PaddleJob,
+        V1DaskJob,
+        V1RayJob,
+        V1TPUJob,
+        V1JaxJob,
+        V1Notifier,
+        V1CleanerJob,
+    ],
+    Field(discriminator="kind"),
+]

@@ -1,0 +1,115 @@
+"""Run status lifecycle (upstream ``polyaxon.lifecycle`` ``V1Statuses``;
+SURVEY.md §2 "API service" row)."""
+
+from __future__ import annotations
+
+import datetime
+from enum import Enum
+from typing import Optional
+
+from .base import BaseSchema
+
+
+class V1Statuses(str, Enum):
+    CREATED = "created"
+    RESUMING = "resuming"
+    ON_SCHEDULE = "on_schedule"
+    COMPILED = "compiled"
+    QUEUED = "queued"
+    SCHEDULED = "scheduled"
+    STARTING = "starting"
+    RUNNING = "running"
+    PROCESSING = "processing"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    UPSTREAM_FAILED = "upstream_failed"
+    RETRYING = "retrying"
+    UNSCHEDULABLE = "unschedulable"
+    WARNING = "warning"
+    UNKNOWN = "unknown"
+    DONE = "done"
+    SKIPPED = "skipped"
+
+
+DONE_STATUSES = {
+    V1Statuses.SUCCEEDED,
+    V1Statuses.FAILED,
+    V1Statuses.STOPPED,
+    V1Statuses.UPSTREAM_FAILED,
+    V1Statuses.SKIPPED,
+    V1Statuses.DONE,
+}
+
+RUNNABLE_STATUSES = {
+    V1Statuses.CREATED,
+    V1Statuses.RESUMING,
+    V1Statuses.COMPILED,
+    V1Statuses.QUEUED,
+    V1Statuses.RETRYING,
+}
+
+# Legal forward transitions; anything -> stopping/stopped is always allowed.
+_TRANSITIONS: dict[V1Statuses, set[V1Statuses]] = {
+    V1Statuses.CREATED: {V1Statuses.COMPILED, V1Statuses.ON_SCHEDULE, V1Statuses.RESUMING, V1Statuses.SKIPPED},
+    V1Statuses.RESUMING: {V1Statuses.COMPILED},
+    V1Statuses.ON_SCHEDULE: {V1Statuses.COMPILED},
+    V1Statuses.COMPILED: {V1Statuses.QUEUED},
+    V1Statuses.QUEUED: {V1Statuses.SCHEDULED, V1Statuses.UNSCHEDULABLE},
+    V1Statuses.UNSCHEDULABLE: {V1Statuses.QUEUED, V1Statuses.SCHEDULED, V1Statuses.FAILED},
+    V1Statuses.SCHEDULED: {V1Statuses.STARTING, V1Statuses.RUNNING, V1Statuses.FAILED},
+    V1Statuses.STARTING: {V1Statuses.RUNNING, V1Statuses.FAILED, V1Statuses.RETRYING},
+    V1Statuses.RUNNING: {
+        V1Statuses.PROCESSING,
+        V1Statuses.SUCCEEDED,
+        V1Statuses.FAILED,
+        V1Statuses.WARNING,
+        V1Statuses.RETRYING,
+    },
+    V1Statuses.PROCESSING: {V1Statuses.SUCCEEDED, V1Statuses.FAILED, V1Statuses.RUNNING},
+    V1Statuses.WARNING: {V1Statuses.RUNNING, V1Statuses.SUCCEEDED, V1Statuses.FAILED},
+    V1Statuses.RETRYING: {V1Statuses.COMPILED, V1Statuses.QUEUED, V1Statuses.FAILED},
+}
+
+
+def can_transition(src: V1Statuses, dst: V1Statuses) -> bool:
+    if src == dst:
+        return False
+    if src in DONE_STATUSES:
+        return False
+    if dst in (V1Statuses.STOPPING, V1Statuses.STOPPED, V1Statuses.UNKNOWN):
+        return True
+    return dst in _TRANSITIONS.get(src, set())
+
+
+def is_done(status: V1Statuses | str) -> bool:
+    return V1Statuses(status) in DONE_STATUSES
+
+
+class V1StatusCondition(BaseSchema):
+    """One entry in a run's status history (upstream ``V1StatusCondition``)."""
+
+    type: V1Statuses
+    status: bool = True
+    reason: Optional[str] = None
+    message: Optional[str] = None
+    last_update_time: Optional[str] = None
+    last_transition_time: Optional[str] = None
+
+    @classmethod
+    def get_condition(
+        cls,
+        type: V1Statuses,
+        reason: Optional[str] = None,
+        message: Optional[str] = None,
+    ) -> "V1StatusCondition":
+        now = datetime.datetime.now(datetime.timezone.utc).isoformat()
+        return cls(
+            type=type,
+            status=True,
+            reason=reason,
+            message=message,
+            last_update_time=now,
+            last_transition_time=now,
+        )

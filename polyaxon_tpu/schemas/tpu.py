@@ -1,0 +1,224 @@
+"""TPU slice topology math: accelerator generations, slice shapes, host
+counts, and ICI sub-slice splitting for trial packing.
+
+This replaces the reference's GPU scheduling surface (``nvidia.com/gpu``
+resources + NCCL env; SURVEY.md §2 "absent components" table) with
+first-class TPU topology objects. The hypertune scheduler uses
+``SliceTopology.subdivide`` to pack parallel trials onto ICI sub-slices
+(BASELINE config 5: 16 ViT trials on one v5e-256).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import reduce
+from typing import Optional
+
+from pydantic import field_validator, model_validator
+
+from .base import BaseSchema
+
+# Per-generation facts: chips per TPU-VM host, topology rank (2D for v5e/v6e,
+# 3D for v4/v5p), max chips in a single-host slice, HBM GiB and peak bf16
+# TFLOP/s per chip (public figures; used by the MFU meter and scheduler).
+ACCELERATOR_SPECS: dict[str, dict] = {
+    "v4": {"chips_per_host": 4, "dims": 3, "hbm_gib": 32, "bf16_tflops": 275.0},
+    "v5e": {"chips_per_host": 4, "dims": 2, "hbm_gib": 16, "bf16_tflops": 197.0},
+    "v5p": {"chips_per_host": 4, "dims": 3, "hbm_gib": 95, "bf16_tflops": 459.0},
+    "v6e": {"chips_per_host": 4, "dims": 2, "hbm_gib": 32, "bf16_tflops": 918.0},
+}
+
+# GKE accelerator type strings (nodeSelector cloud.google.com/gke-tpu-accelerator)
+GKE_ACCELERATOR = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"Bad topology string {topology!r}; expected e.g. '4x4' or '4x4x8'")
+    if not dims or any(d < 1 for d in dims):
+        raise ValueError(f"Bad topology string {topology!r}")
+    return dims
+
+
+class SliceTopology(BaseSchema):
+    """A concrete TPU slice: generation + ICI mesh shape.
+
+    ``v5e-64`` == SliceTopology(accelerator='v5e', topology='8x8').
+    """
+
+    accelerator: str
+    topology: str
+    num_slices: int = 1  # >1 = multislice over DCN (megascale)
+
+    @field_validator("accelerator")
+    @classmethod
+    def _check_acc(cls, v: str) -> str:
+        v = v.lower()
+        if v not in ACCELERATOR_SPECS:
+            raise ValueError(f"Unknown accelerator '{v}'. Valid: {sorted(ACCELERATOR_SPECS)}")
+        return v
+
+    @model_validator(mode="after")
+    def _check_topology(self) -> "SliceTopology":
+        dims = parse_topology(self.topology)
+        want = ACCELERATOR_SPECS[self.accelerator]["dims"]
+        if len(dims) not in (1, want):
+            raise ValueError(
+                f"{self.accelerator} topologies are {want}D; got '{self.topology}'"
+            )
+        return self
+
+    # -- derived quantities --------------------------------------------------
+
+    @property
+    def dims(self) -> tuple[int, ...]:
+        return parse_topology(self.topology)
+
+    @property
+    def chips_per_slice(self) -> int:
+        return reduce(lambda a, b: a * b, self.dims, 1)
+
+    @property
+    def num_chips(self) -> int:
+        return self.chips_per_slice * self.num_slices
+
+    @property
+    def chips_per_host(self) -> int:
+        spec = ACCELERATOR_SPECS[self.accelerator]
+        # single-host slices own all their chips (e.g. v5e 2x4 = 8 chips, 1 host)
+        if self.chips_per_slice <= 8 and spec["dims"] == 2:
+            return self.chips_per_slice
+        return spec["chips_per_host"]
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return max(1, math.ceil(self.chips_per_slice / self.chips_per_host))
+
+    @property
+    def num_hosts(self) -> int:
+        return self.hosts_per_slice * self.num_slices
+
+    @property
+    def bf16_tflops_per_chip(self) -> float:
+        return ACCELERATOR_SPECS[self.accelerator]["bf16_tflops"]
+
+    @property
+    def hbm_gib_per_chip(self) -> float:
+        return ACCELERATOR_SPECS[self.accelerator]["hbm_gib"]
+
+    @property
+    def gke_accelerator(self) -> str:
+        return GKE_ACCELERATOR[self.accelerator]
+
+    @property
+    def gke_topology(self) -> str:
+        return self.topology
+
+    @classmethod
+    def from_alias(cls, alias: str, num_slices: int = 1) -> "SliceTopology":
+        """Parse shorthand like 'v5e-64' / 'v5p-128' into a default topology."""
+        gen, _, chips_s = alias.partition("-")
+        gen = gen.lower()
+        if gen not in ACCELERATOR_SPECS:
+            raise ValueError(f"Unknown accelerator alias '{alias}'")
+        chips = int(chips_s)
+        return cls(accelerator=gen, topology=default_topology(gen, chips), num_slices=num_slices)
+
+    def node_selectors(self) -> dict[str, str]:
+        """GKE nodeSelector labels that place pods on this slice shape."""
+        return {
+            "cloud.google.com/gke-tpu-accelerator": self.gke_accelerator,
+            "cloud.google.com/gke-tpu-topology": self.gke_topology,
+        }
+
+    def tpu_resources(self) -> dict[str, int]:
+        """Per-pod ``google.com/tpu`` resource request (chips on one host)."""
+        return {"google.com/tpu": self.chips_per_host}
+
+    def subdivide(self, sub: "SliceTopology") -> int:
+        """How many ``sub`` slices tile into this slice (ICI-contiguous).
+
+        TPU slices split only along axis-aligned rectangles whose dims divide
+        the parent dims — this is the constraint behind topology-aware trial
+        packing (SURVEY.md §7 hard part (a)).
+        """
+        if sub.accelerator != self.accelerator:
+            return 0
+        a, b = self.dims, sub.dims
+        if len(a) != len(b):
+            return 0
+        if any(x % y != 0 for x, y in zip(a, b)):
+            return 0
+        return reduce(lambda p, q: p * q, (x // y for x, y in zip(a, b)), 1)
+
+
+def default_topology(accelerator: str, num_chips: int) -> str:
+    """Pick the standard GKE topology for a chip count (e.g. v5e 64 -> 8x8)."""
+    spec = ACCELERATOR_SPECS[accelerator]
+    if spec["dims"] == 2:
+        std = {
+            1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8",
+            64: "8x8", 128: "8x16", 256: "16x16",
+        }
+        if num_chips in std:
+            return std[num_chips]
+        side = int(math.isqrt(num_chips))
+        if side * side == num_chips:
+            return f"{side}x{side}"
+        raise ValueError(f"No standard {accelerator} topology for {num_chips} chips")
+    # 3D generations: standard shapes are 4-multiples per dim
+    std3 = {
+        8: "2x2x1", 16: "2x2x4", 32: "2x4x4", 64: "4x4x4", 128: "4x4x8",
+        256: "4x8x8", 512: "8x8x8", 1024: "8x8x16", 2048: "8x16x16",
+    }
+    if num_chips in std3:
+        return std3[num_chips]
+    raise ValueError(f"No standard {accelerator} topology for {num_chips} chips")
+
+
+@dataclass(frozen=True)
+class SubSliceAssignment:
+    """A trial's placement inside a parent slice: which rectangle of chips."""
+
+    index: int
+    origin: tuple[int, ...]
+    shape: tuple[int, ...]
+
+    @property
+    def chips(self) -> int:
+        return reduce(lambda a, b: a * b, self.shape, 1)
+
+
+def pack_subslices(parent: SliceTopology, sub: SliceTopology, n: int) -> list[SubSliceAssignment]:
+    """Assign up to ``n`` axis-aligned sub-rectangles of ``sub``'s shape inside
+    ``parent``. Raises if they don't fit. Deterministic row-major order."""
+    capacity = parent.subdivide(sub)
+    if capacity == 0:
+        raise ValueError(
+            f"Sub-slice {sub.topology} does not tile parent {parent.topology} "
+            f"({parent.accelerator})"
+        )
+    if n > capacity:
+        raise ValueError(f"Requested {n} sub-slices but only {capacity} fit")
+    pdims, sdims = parent.dims, sub.dims
+    counts = [p // s for p, s in zip(pdims, sdims)]
+    out: list[SubSliceAssignment] = []
+    # row-major enumeration over the grid of sub-slice positions
+    total = reduce(lambda a, b: a * b, counts, 1)
+    for idx in range(min(n, total)):
+        rem, coord = idx, []
+        for c in reversed(counts):
+            coord.append(rem % c)
+            rem //= c
+        coord = tuple(reversed(coord))
+        origin = tuple(c * s for c, s in zip(coord, sdims))
+        out.append(SubSliceAssignment(index=idx, origin=origin, shape=tuple(sdims)))
+    return out

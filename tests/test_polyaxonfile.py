@@ -1,0 +1,125 @@
+"""Polyaxonfile parsing tests (upstream spec-test style, SURVEY.md §4)."""
+
+import pytest
+
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile, parse_set_overrides
+from polyaxon_tpu.schemas import V1Job, V1TPUJob
+
+COMPONENT_FILE = """
+version: 1.1
+kind: component
+name: iris
+inputs:
+- {name: max_depth, type: int, value: 3}
+- {name: test_size, type: float, value: 0.2}
+run:
+  kind: job
+  container:
+    image: python:3.12
+    command: [python, iris.py]
+"""
+
+OPERATION_FILE = """
+version: 1.1
+kind: operation
+name: iris-run
+params:
+  max_depth: {value: 5}
+component:
+  name: iris
+  inputs:
+  - {name: max_depth, type: int}
+  run:
+    kind: job
+    container: {image: python:3.12}
+"""
+
+TPU_FILE = """
+version: 1.1
+kind: component
+name: llama-pretrain
+run:
+  kind: tpujob
+  sliceAlias: v5e-64
+  parallelism: {data: 4, fsdp: 8, model: 2}
+  runtime:
+    model: llama2_7b
+    precision: bf16
+"""
+
+
+def test_component_file_wrapped_in_operation():
+    op = check_polyaxonfile(COMPONENT_FILE)
+    assert op.component.name == "iris"
+    assert isinstance(op.component.run, V1Job)
+
+
+def test_operation_file():
+    op = check_polyaxonfile(OPERATION_FILE)
+    assert op.name == "iris-run"
+    assert op.params["max_depth"].value == 5
+
+
+def test_params_override():
+    op = check_polyaxonfile(COMPONENT_FILE, params={"max_depth": 7})
+    assert op.params["max_depth"].value == 7
+
+
+def test_params_unknown_rejected():
+    with pytest.raises(ValueError, match="no such input"):
+        check_polyaxonfile(COMPONENT_FILE, params={"nope": 1})
+
+
+def test_set_overrides():
+    d = parse_set_overrides(["component.run.container.image=new:img", "name=x"])
+    assert d["component"]["run"]["container"]["image"] == "new:img"
+    op = check_polyaxonfile(
+        OPERATION_FILE, set_overrides=["component.run.container.image=new:img"]
+    )
+    assert op.component.run.container.image == "new:img"
+
+
+def test_preset_file_loses_to_main():
+    preset = {"queue": "preempt", "name": "preset-name"}
+    op = check_polyaxonfile(OPERATION_FILE, presets=[preset])
+    assert op.queue == "preempt"  # filled from preset
+    assert op.name == "iris-run"  # file wins
+
+
+def test_tpujob_file():
+    op = check_polyaxonfile(TPU_FILE)
+    run = op.component.run
+    assert isinstance(run, V1TPUJob)
+    assert run.get_slice().num_chips == 64
+    assert run.parallelism.fsdp == 8
+    assert run.runtime["model"] == "llama2_7b"
+
+
+def test_file_on_disk(tmp_path):
+    p = tmp_path / "poly.yaml"
+    p.write_text(COMPONENT_FILE)
+    op = check_polyaxonfile(str(p))
+    assert op.component.name == "iris"
+
+
+def test_set_null_clears_field():
+    op = check_polyaxonfile(
+        "kind: operation\nqueue: gpu\ncomponent:\n  run: {kind: job, container: {image: x}}\n",
+        set_overrides=["queue=null"],
+    )
+    assert op.queue is None
+
+
+def test_set_on_component_file_uses_operation_shape():
+    op = check_polyaxonfile(COMPONENT_FILE, set_overrides=["component.run.container.image=z:1"])
+    assert op.component.run.container.image == "z:1"
+
+
+def test_empty_source_rejected():
+    with pytest.raises(ValueError, match="Empty polyaxonfile"):
+        check_polyaxonfile("")
+
+
+def test_unknown_accelerator_rejected_at_parse():
+    with pytest.raises(Exception, match="accelerator"):
+        check_polyaxonfile("kind: component\nrun: {kind: tpujob, accelerator: h100, topology: 8x8}\n")

@@ -1,0 +1,306 @@
+"""Spec-object tests: parse/validate round-trips (upstream test style:
+spec tests dominate, SURVEY.md §4)."""
+
+import pytest
+
+from polyaxon_tpu.schemas import (
+    V1IO,
+    V1Component,
+    V1CompiledOperation,
+    V1GridSearch,
+    V1Hyperband,
+    V1Job,
+    V1Operation,
+    V1Param,
+    V1PytorchJob,
+    V1Statuses,
+    V1TPUJob,
+    can_transition,
+    is_done,
+    validate_params_against_io,
+)
+from polyaxon_tpu.schemas.tpu import SliceTopology, pack_subslices
+
+
+class TestIO:
+    def test_typed_value_coercion(self):
+        io = V1IO(name="lr", type="float")
+        assert io.validate_value(0.1) == 0.1
+        assert io.validate_value("0.1") == 0.1
+        assert io.validate_value(3) == 3.0
+        with pytest.raises(ValueError):
+            io.validate_value("abc")
+
+    def test_required_vs_optional(self):
+        io = V1IO(name="x", type="int")
+        with pytest.raises(ValueError, match="required"):
+            io.validate_value(None)
+        io2 = V1IO.from_dict({"name": "x", "type": "int", "isOptional": True, "value": 5})
+        assert io2.validate_value(None) == 5
+
+    def test_bool_parsing(self):
+        io = V1IO(name="flag", type="bool")
+        assert io.validate_value("true") is True
+        assert io.validate_value("0") is False
+
+    def test_list_io(self):
+        io = V1IO.from_dict({"name": "xs", "type": "int", "isList": True})
+        assert io.validate_value(["1", 2]) == [1, 2]
+        with pytest.raises(ValueError):
+            io.validate_value(3)
+
+    def test_validation_options(self):
+        io = V1IO.from_dict(
+            {"name": "opt", "type": "str", "validation": {"options": ["a", "b"]}}
+        )
+        assert io.validate_value("a") == "a"
+        with pytest.raises(ValueError, match="options"):
+            io.validate_value("c")
+
+    def test_validation_bounds(self):
+        io = V1IO.from_dict({"name": "n", "type": "int", "validation": {"ge": 1, "le": 8}})
+        assert io.validate_value(8) == 8
+        with pytest.raises(ValueError):
+            io.validate_value(9)
+
+    def test_arg_format(self):
+        io = V1IO.from_dict({"name": "lr", "type": "float", "argFormat": "--learning-rate={{ lr }}"})
+        assert io.as_arg(0.1) == "--learning-rate=0.1"
+        flag = V1IO.from_dict({"name": "debug", "type": "bool", "isFlag": True})
+        assert flag.as_arg(True) == "--debug"
+        assert flag.as_arg(False) is None
+
+    def test_params_against_io(self):
+        inputs = [V1IO(name="lr", type="float"), V1IO.from_dict({"name": "n", "type": "int", "isOptional": True, "value": 2})]
+        resolved = validate_params_against_io(inputs, None, {"lr": V1Param(value="0.5")})
+        assert resolved == {"lr": 0.5, "n": 2}
+        with pytest.raises(ValueError, match="no such input"):
+            validate_params_against_io(inputs, None, {"bogus": V1Param(value=1)})
+
+
+class TestComponentOperation:
+    def test_component_yaml_roundtrip(self):
+        yaml_text = """
+version: 1.1
+kind: component
+name: trainer
+inputs:
+- {name: lr, type: float, value: 0.001}
+run:
+  kind: job
+  container:
+    image: python:3.12
+    command: [python, train.py]
+"""
+        c = V1Component.from_yaml(yaml_text)
+        assert c.name == "trainer"
+        assert isinstance(c.run, V1Job)
+        d = c.to_dict()
+        c2 = V1Component.from_dict(d)
+        assert c2.to_dict() == d
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(Exception):
+            V1Component.from_dict({"kind": "component", "bogusField": 1})
+
+    def test_operation_single_ref(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            V1Operation.from_dict(
+                {"kind": "operation", "hubRef": "a", "pathRef": "b"}
+            )
+
+    def test_compile_inlines_component(self):
+        op = V1Operation.from_dict(
+            {
+                "kind": "operation",
+                "name": "exp1",
+                "params": {"lr": {"value": 0.01}},
+                "component": {
+                    "name": "trainer",
+                    "inputs": [{"name": "lr", "type": "float"}],
+                    "run": {"kind": "job", "container": {"image": "x"}},
+                },
+            }
+        )
+        comp = V1CompiledOperation.from_operation(op)
+        assert comp.name == "exp1"
+        assert comp.inputs[0].name == "lr"
+        assert comp.get_run_kind() == "job"
+
+    def test_run_patch(self):
+        op = V1Operation.from_dict(
+            {
+                "kind": "operation",
+                "runPatch": {"container": {"image": "override:latest"}},
+                "component": {
+                    "run": {"kind": "job", "container": {"image": "orig", "command": ["c"]}}
+                },
+            }
+        )
+        comp = V1CompiledOperation.from_operation(op)
+        assert comp.run.container.image == "override:latest"
+        assert comp.run.container.command == ["c"]
+
+
+class TestRunKinds:
+    def test_pytorchjob(self):
+        j = V1PytorchJob.from_dict(
+            {
+                "kind": "pytorchjob",
+                "master": {"replicas": 1, "container": {"image": "t"}},
+                "worker": {"replicas": 3, "container": {"image": "t"}},
+            }
+        )
+        assert j.worker.replicas == 3
+
+    def test_tpujob_slice(self):
+        j = V1TPUJob.from_dict({"kind": "tpujob", "sliceAlias": "v5e-64"})
+        s = j.get_slice()
+        assert s.topology == "8x8"
+        assert s.num_chips == 64
+        assert s.num_hosts == 16
+        assert s.node_selectors()["cloud.google.com/gke-tpu-topology"] == "8x8"
+
+    def test_tpujob_parallelism(self):
+        j = V1TPUJob.from_dict(
+            {
+                "kind": "tpujob",
+                "accelerator": "v5e",
+                "topology": "8x8",
+                "parallelism": {"data": 4, "fsdp": 4, "model": 4},
+            }
+        )
+        assert j.parallelism.total == 64
+        assert j.get_slice().num_chips == 64
+
+
+class TestMatrix:
+    def test_grid_rejects_random_dist(self):
+        with pytest.raises(ValueError, match="non-enumerable"):
+            V1GridSearch.from_dict(
+                {"kind": "grid", "params": {"lr": {"kind": "uniform", "value": [0, 1]}}}
+            )
+
+    def test_hyperband_parse(self):
+        hb = V1Hyperband.from_dict(
+            {
+                "kind": "hyperband",
+                "maxIterations": 81,
+                "eta": 3,
+                "resource": {"name": "epochs", "type": "int"},
+                "metric": {"name": "loss", "optimization": "minimize"},
+                "params": {"lr": {"kind": "loguniform", "value": [-6, -1]}},
+            }
+        )
+        assert hb.max_iterations == 81
+        assert not hb.metric.maximize
+
+
+class TestStatuses:
+    def test_lifecycle_path(self):
+        path = [
+            V1Statuses.CREATED,
+            V1Statuses.COMPILED,
+            V1Statuses.QUEUED,
+            V1Statuses.SCHEDULED,
+            V1Statuses.STARTING,
+            V1Statuses.RUNNING,
+            V1Statuses.SUCCEEDED,
+        ]
+        for a, b in zip(path, path[1:]):
+            assert can_transition(a, b), f"{a}->{b}"
+        assert is_done(V1Statuses.SUCCEEDED)
+        assert not can_transition(V1Statuses.SUCCEEDED, V1Statuses.RUNNING)
+
+    def test_stop_always_allowed(self):
+        assert can_transition(V1Statuses.QUEUED, V1Statuses.STOPPED)
+        assert can_transition(V1Statuses.RUNNING, V1Statuses.STOPPING)
+
+
+class TestTPUTopology:
+    def test_alias(self):
+        s = SliceTopology.from_alias("v5e-256")
+        assert s.topology == "16x16"
+        assert s.num_hosts == 64
+
+    def test_single_host(self):
+        s = SliceTopology(accelerator="v5e", topology="2x4")
+        assert s.num_hosts == 1
+        assert s.chips_per_host == 8
+
+    def test_subdivide_and_pack(self):
+        parent = SliceTopology.from_alias("v5e-256")
+        sub = SliceTopology(accelerator="v5e", topology="4x4")
+        assert parent.subdivide(sub) == 16
+        placements = pack_subslices(parent, sub, 16)
+        assert len(placements) == 16
+        assert placements[0].origin == (0, 0)
+        assert placements[-1].origin == (12, 12)
+        origins = {p.origin for p in placements}
+        assert len(origins) == 16  # no overlap
+
+    def test_subdivide_rejects_nonfit(self):
+        parent = SliceTopology(accelerator="v5e", topology="8x8")
+        sub = SliceTopology(accelerator="v5e", topology="3x3")
+        assert parent.subdivide(sub) == 0
+
+
+class TestReviewRegressions:
+    """Regression tests for the pre-commit review findings."""
+
+    def test_isnull_patch_is_shallow(self):
+        from polyaxon_tpu.schemas.lifecycle import V1Environment
+
+        e = V1Environment(labels={"x": "1"}).patch(
+            V1Environment(labels={"x": "2", "y": "3"}, node_name="n"), "isnull"
+        )
+        assert e.labels == {"x": "1"}
+        assert e.node_name == "n"
+
+    def test_dag_keeps_unnamed_ops(self):
+        from polyaxon_tpu.schemas import V1Dag
+
+        d = V1Dag.from_dict(
+            {
+                "kind": "dag",
+                "operations": [
+                    {"name": "a", "component": {"run": {"kind": "job"}}},
+                    {"component": {"run": {"kind": "job"}}},
+                ],
+            }
+        )
+        assert len(d.topological_order()) == 2
+
+    def test_dag_unknown_dependency_raises(self):
+        from polyaxon_tpu.schemas import V1Dag
+
+        d = V1Dag.from_dict(
+            {
+                "kind": "dag",
+                "operations": [
+                    {"name": "train", "dependencies": ["prepro"], "component": {"run": {"kind": "job"}}},
+                    {"name": "prep", "component": {"run": {"kind": "job"}}},
+                ],
+            }
+        )
+        with pytest.raises(ValueError, match="unknown operations"):
+            d.topological_order()
+
+    def test_compile_preserves_approval_and_cost(self):
+        op = V1Operation.from_dict(
+            {
+                "kind": "operation",
+                "isApproved": False,
+                "cost": 2.5,
+                "component": {"run": {"kind": "job", "container": {"image": "x"}}},
+            }
+        )
+        c = V1CompiledOperation.from_operation(op)
+        assert c.is_approved is False
+        assert c.cost == 2.5
+
+    def test_operation_requires_a_ref(self):
+        with pytest.raises(ValueError, match="must reference"):
+            V1Operation.from_dict({"kind": "operation", "name": "x"})
+        # presets are exempt
+        V1Operation.from_dict({"kind": "operation", "isPreset": True, "queue": "q"})
