@@ -1,0 +1,72 @@
+"""Headline benchmark: Llama training throughput on the available TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+No reference numbers exist (BASELINE.md: reference mount empty, upstream
+publishes none), so ``vs_baseline`` is measured MFU / 0.45 — the north-star
+MFU target from BASELINE.json. >1.0 beats the target.
+
+Model size auto-scales to the chip count so the bench is meaningful from one
+v5e chip (this harness) up to a v5e-64 slice (the north-star config).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from dataclasses import replace
+
+
+def main() -> None:
+    import jax
+
+    from polyaxon_tpu.models import llama
+    from polyaxon_tpu.parallel import build_mesh
+    from polyaxon_tpu.train import (
+        DataConfig, OptimizerConfig, Trainer, TrainerConfig, make_batches,
+    )
+
+    n = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+
+    if on_tpu and n >= 32:
+        mcfg, batch, seq, axes = llama.LLAMA2_7B, 64, 2048, {"fsdp": n}
+        steps = 20
+    elif on_tpu:
+        # single chip (or few): ~125M model, pure DP
+        mcfg = replace(llama.LLAMA_125M, remat="dots", max_seq=2048)
+        batch, seq, axes, steps = 8 * n, 2048, {"data": n}, 20
+    else:
+        # CPU smoke: tiny
+        mcfg = replace(llama.LLAMA_TINY, attn_impl="dense")
+        batch, seq, axes, steps = 8, 64, {"data": min(n, 8)}, 5
+
+    cfg = TrainerConfig(
+        model=mcfg,
+        optimizer=OptimizerConfig(learning_rate=3e-4, warmup_steps=5, total_steps=steps),
+        batch_size=batch,
+        seq_len=seq,
+        parallelism=axes,
+        accelerator="v5e",
+    )
+    trainer = Trainer(cfg)
+    data = make_batches(
+        DataConfig(kind="synthetic-lm", batch_size=batch, seq_len=seq,
+                   vocab_size=mcfg.vocab_size), trainer.mesh,
+    )
+    state, metrics = trainer.fit(data, num_steps=steps)
+
+    mfu = metrics["mfu"]
+    out = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(metrics["tokens_per_sec_per_chip"], 2),
+        "unit": f"tokens/s/chip (model={mcfg.num_params()/1e6:.0f}M, seq={seq}, "
+                f"chips={trainer.mesh.size}, mfu={mfu:.3f})",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

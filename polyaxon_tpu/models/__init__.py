@@ -1,0 +1,21 @@
+"""Model zoo: the training workloads the BASELINE configs name (SURVEY.md §6)
+— Llama-2 (flagship), GPT-2, BERT, ViT, ResNet — as pure-pytree JAX models
+over the shared transformer core."""
+
+from . import bert, gpt2, llama, resnet, transformer, vit
+from .transformer import TransformerConfig, cross_entropy_loss
+
+# name -> (module, config) for CLI/runtime lookup (`runtime: {model: ...}`)
+REGISTRY: dict = {}
+for _mod in (llama, gpt2, bert):
+    for _name, _cfg in _mod.CONFIGS.items():
+        REGISTRY[_name] = ("lm", _cfg)
+for _name, _cfg in vit.CONFIGS.items():
+    REGISTRY[_name] = ("vit", _cfg)
+for _name, _cfg in resnet.CONFIGS.items():
+    REGISTRY[_name] = ("resnet", _cfg)
+
+__all__ = [
+    "bert", "gpt2", "llama", "resnet", "transformer", "vit",
+    "TransformerConfig", "cross_entropy_loss", "REGISTRY",
+]

@@ -1,0 +1,49 @@
+"""BERT-base MLM (BASELINE config 3: multi-worker BERT-base pretraining).
+
+Bidirectional encoder on the shared core (causal=False); masked-LM loss
+masks out non-[MASK] positions via the loss mask."""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import TransformerConfig, cross_entropy_loss
+
+BERT_BASE = TransformerConfig(
+    vocab_size=30522, hidden=768, num_layers=12, num_heads=12, mlp_dim=3072,
+    max_seq=512, norm="ln", act="gelu", pos="learned", causal=False,
+    use_bias=True, tie_embeddings=True, eps=1e-12, dtype=jnp.bfloat16,
+)
+
+BERT_LARGE = replace(BERT_BASE, hidden=1024, num_layers=24, num_heads=16, mlp_dim=4096)
+
+BERT_TINY = replace(
+    BERT_BASE, vocab_size=256, hidden=64, num_layers=2, num_heads=4,
+    mlp_dim=128, max_seq=128, dtype=jnp.float32, attn_impl="dense",
+)
+
+CONFIGS = {"bert-base": BERT_BASE, "bert-large": BERT_LARGE, "bert-tiny": BERT_TINY}
+
+MASK_TOKEN_ID = 103  # [MASK] in the BERT WordPiece vocab
+
+
+def mlm_mask_tokens(
+    key: jax.Array, tokens: jax.Array, vocab_size: int, mask_rate: float = 0.15,
+    mask_token_id: int = MASK_TOKEN_ID,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """BERT 80/10/10 masking. Returns (inputs, labels, loss_mask)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    selected = jax.random.uniform(k1, tokens.shape) < mask_rate
+    roll = jax.random.uniform(k2, tokens.shape)
+    random_tokens = jax.random.randint(k3, tokens.shape, 0, vocab_size)
+    inputs = jnp.where(selected & (roll < 0.8), mask_token_id, tokens)
+    inputs = jnp.where(selected & (roll >= 0.8) & (roll < 0.9), random_tokens, inputs)
+    return inputs, tokens, selected
+
+
+def mlm_loss(logits: jax.Array, labels: jax.Array, loss_mask: jax.Array) -> jax.Array:
+    return cross_entropy_loss(logits, labels, loss_mask)

@@ -1,0 +1,346 @@
+"""Shared transformer core for the model zoo (Llama/GPT-2/BERT/ViT).
+
+The reference contains no model code (SURVEY.md §1: "What Polyaxon does not
+contain") — this runtime is the capability the north star adds. Design is
+TPU-first, not a torch translation:
+
+- **pure pytrees**: params are nested dicts of arrays; every leaf carries
+  logical axis names so `parallel.ShardingRules` decides placement without
+  touching model code.
+- **scan over stacked layers**: one compiled layer body regardless of depth
+  (compile time + XLA fusion), with `jax.checkpoint` remat inside the scan
+  body to trade FLOPs for HBM.
+- **sharded attention via shard_map**: the pallas kernel runs on local
+  shards (batch over data/fsdp, heads over model, sequence over context);
+  ring attention engages automatically when the context axis is >1.
+- **bf16 activations, f32 params/optimizer** by default; logits and
+  softmax in f32.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import (
+    apply_rope,
+    attention,
+    dense_attention,
+    gelu,
+    layer_norm,
+    repeat_kv,
+    ring_attention,
+    rms_norm,
+    rope_frequencies,
+    swiglu,
+    ulysses_attention,
+)
+from ..parallel.mesh import ShardingRules
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int
+    hidden: int
+    num_layers: int
+    num_heads: int
+    mlp_dim: int
+    num_kv_heads: Optional[int] = None          # GQA; defaults to num_heads
+    head_dim: Optional[int] = None              # defaults to hidden // num_heads
+    max_seq: int = 2048
+    norm: str = "rms"                           # "rms" | "ln"
+    act: str = "swiglu"                         # "swiglu" | "gelu"
+    pos: str = "rope"                           # "rope" | "learned" | "none"
+    causal: bool = True
+    use_bias: bool = False                      # linear/ln biases (GPT-2/BERT)
+    tie_embeddings: bool = False
+    rope_theta: float = 10000.0
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16                   # activation dtype
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "auto"                     # "auto" | "dense" | "flash"
+    seq_parallel: str = "ring"                  # "ring" | "ulysses" (context axis >1)
+    remat: str = "none"                         # "none" | "full" | "dots"
+    attn_block_q: int = 512
+    attn_block_k: int = 512
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def hd(self) -> int:
+        return self.head_dim or self.hidden // self.num_heads
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Approximate training FLOPs/token (fwd+bwd = 6N + attention term);
+        feeds the MFU meter (BASELINE.md metric)."""
+        n_params = self.num_params()
+        attn = 12 * self.num_layers * self.hidden * seq_len  # qk+av fwd+bwd
+        return 6 * n_params + attn
+
+    def num_params(self) -> int:
+        h, l = self.hidden, self.num_layers
+        attn = h * self.num_heads * self.hd + 2 * h * self.kv_heads * self.hd \
+            + self.num_heads * self.hd * h
+        mlp = (3 if self.act == "swiglu" else 2) * h * self.mlp_dim
+        norms = (2 * l + 1) * h
+        if self.norm == "ln" or self.use_bias:
+            norms *= 2  # scale + bias
+        biases = 0
+        if self.use_bias:
+            biases = l * (
+                self.num_heads * self.hd + 2 * self.kv_heads * self.hd + h  # attn
+                + self.mlp_dim + h  # mlp
+            )
+        embed = self.vocab_size * h * (1 if self.tie_embeddings else 2)
+        pos = self.max_seq * h if self.pos == "learned" else 0
+        return l * (attn + mlp) + norms + biases + embed + pos
+
+
+# ---------------------------------------------------------------------------
+# Parameter trees: shapes + logical axes live side by side
+# ---------------------------------------------------------------------------
+
+
+def _norm_params(cfg: TransformerConfig, layers: Optional[int] = None):
+    lead = (layers,) if layers else ()
+    lead_ax = ("layers",) if layers else ()
+    p = {"scale": (lead + (cfg.hidden,), lead_ax + ("embed_act",))}
+    if cfg.norm == "ln" or cfg.use_bias:
+        p["bias"] = (lead + (cfg.hidden,), lead_ax + ("embed_act",))
+    return p
+
+
+def abstract_params(cfg: TransformerConfig) -> dict:
+    """Returns a pytree whose leaves are (shape, logical_axes) tuples."""
+    h, nh, kvh, hd, mlp, L = cfg.hidden, cfg.num_heads, cfg.kv_heads, cfg.hd, cfg.mlp_dim, cfg.num_layers
+    layer = {
+        "attn_norm": _norm_params(cfg, L),
+        "mlp_norm": _norm_params(cfg, L),
+        "attn": {
+            "wq": ((L, h, nh, hd), ("layers", "embed", "heads", "head_dim")),
+            "wk": ((L, h, kvh, hd), ("layers", "embed", "kv_heads", "head_dim")),
+            "wv": ((L, h, kvh, hd), ("layers", "embed", "kv_heads", "head_dim")),
+            "wo": ((L, nh, hd, h), ("layers", "heads", "head_dim", "embed")),
+        },
+        "mlp": {
+            "wi": ((L, h, mlp), ("layers", "embed", "mlp")),
+            "wo": ((L, mlp, h), ("layers", "mlp", "embed")),
+        },
+    }
+    if cfg.act == "swiglu":
+        layer["mlp"]["wg"] = ((L, h, mlp), ("layers", "embed", "mlp"))
+    if cfg.use_bias:
+        layer["attn"]["bq"] = ((L, nh, hd), ("layers", "heads", "head_dim"))
+        layer["attn"]["bk"] = ((L, kvh, hd), ("layers", "kv_heads", "head_dim"))
+        layer["attn"]["bv"] = ((L, kvh, hd), ("layers", "kv_heads", "head_dim"))
+        layer["attn"]["bo"] = ((L, h), ("layers", "embed_act"))
+        layer["mlp"]["bi"] = ((L, mlp), ("layers", "mlp"))
+        layer["mlp"]["bo"] = ((L, h), ("layers", "embed_act"))
+    params = {
+        "embed": {"tokens": ((cfg.vocab_size, h), ("vocab", "embed"))},
+        "layers": layer,
+        "final_norm": _norm_params(cfg),
+    }
+    if cfg.pos == "learned":
+        params["embed"]["pos"] = ((cfg.max_seq, h), (None, "embed"))
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"w": ((h, cfg.vocab_size), ("embed", "vocab"))}
+    return params
+
+
+def _is_leaf(x):
+    return isinstance(x, tuple) and len(x) == 2 and isinstance(x[0], tuple)
+
+
+def param_specs(cfg: TransformerConfig, rules: Optional[ShardingRules] = None):
+    """PartitionSpec pytree matching init()'s params tree."""
+    rules = rules or ShardingRules()
+    return jax.tree.map(
+        lambda ab: rules.spec(ab[1]), abstract_params(cfg), is_leaf=_is_leaf
+    )
+
+
+def init(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Initialize params (f32 by default). Truncated-normal fan-in scaling;
+    output projections scaled by 1/sqrt(2*L) (GPT-2 residual init)."""
+    abstract = abstract_params(cfg)
+    leaves, treedef = jax.tree.flatten(abstract, is_leaf=_is_leaf)
+    keys = jax.random.split(key, len(leaves))
+    paths = jax.tree_util.tree_flatten_with_path(abstract, is_leaf=_is_leaf)[0]
+
+    def _init_leaf(k, path, ab):
+        shape, axes = ab
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("scale",):
+            return jnp.ones(shape, cfg.param_dtype)
+        if name.startswith("b") or name == "bias":
+            return jnp.zeros(shape, cfg.param_dtype)
+        w = jax.random.truncated_normal(k, -2, 2, shape, jnp.float32) * 0.02
+        if name == "wo":  # residual-path projections
+            w = w / (2 * cfg.num_layers) ** 0.5
+        return w.astype(cfg.param_dtype)
+
+    out = [_init_leaf(k, p, ab) for k, (p, ab) in zip(keys, paths)]
+    return jax.tree.unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _norm(x, p, cfg):
+    if cfg.norm == "rms":
+        return rms_norm(x, p["scale"], cfg.eps)
+    return layer_norm(x, p["scale"], p.get("bias", jnp.zeros_like(p["scale"])), cfg.eps)
+
+
+def _sharded_attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh], interpret=None):
+    """Dispatch attention: local kernel, or shard_map'd over the mesh with
+    ring/Ulysses on the context axis."""
+    if mesh is None:
+        return attention(
+            q, k, v, causal=cfg.causal, impl=cfg.attn_impl,
+            block_q=cfg.attn_block_q, block_k=cfg.attn_block_k, interpret=interpret,
+        )
+    cp = mesh.shape["context"]
+    k = repeat_kv(k, q.shape[1])
+    v = repeat_kv(v, q.shape[1])
+    qkv_spec = P(("data", "fsdp"), "model", "context", None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, check_vma=False,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec,
+    )
+    def _attn(q, k, v):
+        if cp > 1 and cfg.seq_parallel == "ring":
+            return ring_attention(
+                q, k, v, axis_name="context", axis_size=cp, causal=cfg.causal,
+                block_q=min(cfg.attn_block_q, q.shape[2]),
+                block_k=min(cfg.attn_block_k, k.shape[2]),
+                interpret=interpret,
+            )
+        if cp > 1:
+            return ulysses_attention(
+                q, k, v, axis_name="context", causal=cfg.causal,
+                impl=cfg.attn_impl, interpret=interpret,
+            )
+        return attention(
+            q, k, v, causal=cfg.causal, impl=cfg.attn_impl,
+            block_q=min(cfg.attn_block_q, q.shape[2]),
+            block_k=min(cfg.attn_block_k, k.shape[2]),
+            interpret=interpret,
+        )
+
+    return _attn(q, k, v)
+
+
+def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret):
+    b, s, h = x.shape
+    nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
+    ap, mp = lp["attn"], lp["mlp"]
+    dt = cfg.dtype
+
+    y = _norm(x, lp["attn_norm"], cfg)
+    q = jnp.einsum("bsh,hnd->bnsd", y, ap["wq"].astype(dt))
+    k = jnp.einsum("bsh,hnd->bnsd", y, ap["wk"].astype(dt))
+    v = jnp.einsum("bsh,hnd->bnsd", y, ap["wv"].astype(dt))
+    if cfg.use_bias:
+        q = q + ap["bq"].astype(dt)[None, :, None, :]
+        k = k + ap["bk"].astype(dt)[None, :, None, :]
+        v = v + ap["bv"].astype(dt)[None, :, None, :]
+    if cfg.pos == "rope":
+        cos, sin = rope_tables
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+    o = _sharded_attention(q, k, v, cfg, mesh, interpret)
+    o = jnp.einsum("bnsd,ndh->bsh", o, ap["wo"].astype(dt))
+    if cfg.use_bias:
+        o = o + ap["bo"].astype(dt)
+    x = x + o
+
+    y = _norm(x, lp["mlp_norm"], cfg)
+    if cfg.act == "swiglu":
+        inner = swiglu(
+            jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt)),
+            jnp.einsum("bsh,hm->bsm", y, mp["wg"].astype(dt)),
+        )
+    else:
+        inner = jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt))
+        if cfg.use_bias:
+            inner = inner + mp["bi"].astype(dt)
+        inner = gelu(inner)
+    out = jnp.einsum("bsm,mh->bsh", inner, mp["wo"].astype(dt))
+    if cfg.use_bias:
+        out = out + mp["bo"].astype(dt)
+    return x + out
+
+
+def apply(
+    params: dict,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+    interpret: Optional[bool] = None,
+    inputs_embeds: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Forward pass: tokens [batch, seq] -> logits [batch, seq, vocab] (f32).
+
+    ``inputs_embeds`` bypasses token embedding (ViT patches, BERT pipelines).
+    """
+    dt = cfg.dtype
+    if inputs_embeds is None:
+        x = params["embed"]["tokens"].astype(dt)[tokens]
+    else:
+        x = inputs_embeds.astype(dt)
+    s = x.shape[1]
+    if cfg.pos == "learned":
+        x = x + params["embed"]["pos"].astype(dt)[None, :s]
+    rope_tables = None
+    if cfg.pos == "rope":
+        if s > cfg.max_seq:
+            raise ValueError(
+                f"sequence length {s} exceeds max_seq {cfg.max_seq}: RoPE "
+                f"positions would silently clamp"
+            )
+        cos, sin = rope_frequencies(cfg.hd, cfg.max_seq, cfg.rope_theta)
+        rope_tables = (cos[:s], sin[:s])
+
+    body = lambda x, lp: (_layer_body(x, lp, cfg, rope_tables, mesh, interpret), None)
+    if cfg.remat == "full":
+        body = jax.checkpoint(body, prevent_cse=False)
+    elif cfg.remat == "dots":
+        body = jax.checkpoint(
+            body, prevent_cse=False,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"].astype(dt))
+    else:
+        logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["w"].astype(dt))
+    return logits.astype(jnp.float32)
+
+
+def cross_entropy_loss(
+    logits: jax.Array, labels: jax.Array, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """Mean token cross entropy in f32; mask=0 positions excluded."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if mask is None:
+        return nll.mean()
+    mask = mask.astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,140 @@
+"""ViT-B/16 (BASELINE config 5: 16 parallel ViT-B/16 Hyperband trials).
+
+Patchify is a reshape + matmul rather than a conv — for non-overlapping
+patches they're identical, and the matmul form feeds the MXU directly with
+no im2col. Encoder rides the shared transformer core (causal=False) via
+``inputs_embeds``; adds a CLS token and a classification head.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import transformer
+from .transformer import TransformerConfig
+from ..parallel.mesh import ShardingRules
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    channels: int = 3
+    encoder: TransformerConfig = None  # type: ignore[assignment]
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.channels * self.patch_size ** 2
+
+    def num_params(self) -> int:
+        h = self.encoder.hidden
+        # drop the encoder's token-embed and learned-pos terms (init() deletes
+        # tokens and replaces pos with the patch-grid table)
+        enc = self.encoder.num_params() - self.encoder.vocab_size * h \
+            - self.encoder.max_seq * h
+        pos = (self.num_patches + 1) * h
+        patch = self.patch_dim * h + h
+        cls = h
+        head = h * self.num_classes + self.num_classes
+        return enc + pos + patch + cls + head
+
+
+def _encoder(hidden, layers, heads, mlp, seq) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=1,  # unused: inputs_embeds path
+        hidden=hidden, num_layers=layers, num_heads=heads, mlp_dim=mlp,
+        max_seq=seq, norm="ln", act="gelu", pos="learned", causal=False,
+        use_bias=True, tie_embeddings=True, eps=1e-6, dtype=jnp.bfloat16,
+    )
+
+
+VIT_B16 = ViTConfig(encoder=_encoder(768, 12, 12, 3072, 197))
+VIT_L16 = ViTConfig(encoder=_encoder(1024, 24, 16, 4096, 197))
+VIT_TINY = ViTConfig(
+    image_size=32, patch_size=8, num_classes=10,
+    encoder=replace(_encoder(64, 2, 4, 128, 17), dtype=jnp.float32, attn_impl="dense"),
+)
+
+CONFIGS = {"vit-b16": VIT_B16, "vit-l16": VIT_L16, "vit-tiny": VIT_TINY}
+
+
+def init(key: jax.Array, cfg: ViTConfig) -> dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    enc = transformer.init(k1, cfg.encoder)
+    h = cfg.encoder.hidden
+    del enc["embed"]["tokens"]
+    enc["embed"]["pos"] = (
+        jax.random.truncated_normal(k2, -2, 2, (cfg.num_patches + 1, h), jnp.float32) * 0.02
+    )
+    return {
+        "encoder": enc,
+        "patch": {"w": jax.random.truncated_normal(k3, -2, 2, (cfg.patch_dim, h), jnp.float32) * 0.02,
+                  "b": jnp.zeros((h,), jnp.float32)},
+        "cls": jnp.zeros((1, 1, h), jnp.float32),
+        "head": {"w": jax.random.truncated_normal(k4, -2, 2, (h, cfg.num_classes), jnp.float32) * 0.02,
+                 "b": jnp.zeros((cfg.num_classes,), jnp.float32)},
+    }
+
+
+def param_specs(cfg: ViTConfig, rules: Optional[ShardingRules] = None):
+    rules = rules or ShardingRules()
+    enc = transformer.param_specs(cfg.encoder, rules)
+    del enc["embed"]["tokens"]
+    enc["embed"]["pos"] = rules.spec((None, "embed"))
+    return {
+        "encoder": enc,
+        "patch": {"w": rules.spec((None, "embed")), "b": rules.spec((None,))},
+        "cls": rules.spec((None, None, None)),
+        "head": {"w": rules.spec(("embed", "classes")), "b": rules.spec(("classes",))},
+    }
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, C] -> [B, (H/p)*(W/p), p*p*C]"""
+    b, hh, ww, c = images.shape
+    x = images.reshape(b, hh // patch, patch, ww // patch, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (hh // patch) * (ww // patch), patch * patch * c)
+
+
+def apply(params: dict, images: jax.Array, cfg: ViTConfig, *, mesh=None, interpret=None) -> jax.Array:
+    """images [B, H, W, C] -> class logits [B, num_classes] (f32)."""
+    dt = cfg.encoder.dtype
+    x = patchify(images.astype(dt), cfg.patch_size)
+    x = x @ params["patch"]["w"].astype(dt) + params["patch"]["b"].astype(dt)
+    cls = jnp.broadcast_to(params["cls"].astype(dt), (x.shape[0], 1, x.shape[-1]))
+    x = jnp.concatenate([cls, x], axis=1)
+    feats = _encode(params["encoder"], x, cfg, mesh, interpret)
+    cls_out = feats[:, 0]
+    return (cls_out @ params["head"]["w"].astype(dt) + params["head"]["b"].astype(dt)).astype(jnp.float32)
+
+
+def _encode(enc_params, x, cfg: ViTConfig, mesh, interpret):
+    """Run the transformer trunk on embeddings, skipping the LM head."""
+    ecfg = cfg.encoder
+    s = x.shape[1]
+    x = x + enc_params["embed"]["pos"].astype(ecfg.dtype)[None, :s]
+    rope_tables = None
+    body = lambda x, lp: (
+        transformer._layer_body(x, lp, ecfg, rope_tables, mesh, interpret), None,
+    )
+    if ecfg.remat == "full":
+        body = jax.checkpoint(body, prevent_cse=False)
+    x, _ = jax.lax.scan(body, x, enc_params["layers"])
+    return transformer._norm(x, enc_params["final_norm"], ecfg)
+
+
+def classification_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return (logz - gold).mean()

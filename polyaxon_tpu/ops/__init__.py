@@ -1,0 +1,24 @@
+"""TPU compute ops: pallas flash attention, ring/Ulysses sequence
+parallelism, and fused building blocks (SURVEY.md §2 "absent components" —
+the reference orchestrates but never owns these)."""
+
+from .attention import attention, dense_attention, repeat_kv
+from .flash_attention import flash_attention_bhsd
+from .layers import apply_rope, gelu, layer_norm, rms_norm, rope_frequencies, swiglu
+from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
+
+__all__ = [
+    "attention",
+    "dense_attention",
+    "repeat_kv",
+    "flash_attention_bhsd",
+    "ring_attention",
+    "ulysses_attention",
+    "apply_rope",
+    "gelu",
+    "layer_norm",
+    "rms_norm",
+    "rope_frequencies",
+    "swiglu",
+]

@@ -1,0 +1,86 @@
+"""Public attention API: dense reference + flash dispatch, GQA handling.
+
+Shapes are ``[batch, heads, seq, head_dim]`` throughout. The dense path is
+the numerics oracle for kernel tests (SURVEY.md §4: numerics vs dense
+reference) and the small-shape fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import flash_attention_bhsd
+
+
+def repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
+    """Expand grouped KV heads to match query heads (GQA/MQA)."""
+    num_kv = k.shape[1]
+    if num_kv == num_q_heads:
+        return k
+    assert num_q_heads % num_kv == 0, (num_q_heads, num_kv)
+    return jnp.repeat(k, num_q_heads // num_kv, axis=1)
+
+
+def dense_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    q_offset=0,
+    k_offset=0,
+) -> jax.Array:
+    """Plain XLA attention — the numerics reference. Supports the same
+    global-position causal mask as the flash kernel."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    k = repeat_kv(k, q.shape[1])
+    v = repeat_kv(v, q.shape[1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores * sm_scale
+    if causal:
+        q_ids = q_offset + jnp.arange(q.shape[2])
+        k_ids = k_offset + jnp.arange(k.shape[2])
+        mask = q_ids[:, None] >= k_ids[None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked rows
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-device attention entry point.
+
+    ``impl``: 'flash' (pallas kernel), 'dense' (XLA), or 'auto' — flash on
+    TPU when block-divisible, dense otherwise.
+    """
+    b, h, s, d = q.shape
+    if impl == "auto":
+        divisible = s % min(block_q, s) == 0 and k.shape[2] % min(block_k, k.shape[2]) == 0
+        impl = "flash" if divisible and s >= 128 else "dense"
+    if impl == "dense":
+        return dense_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    kr = repeat_kv(k, h)
+    vr = repeat_kv(v, h)
+    o = flash_attention_bhsd(
+        q.reshape(b * h, s, d),
+        kr.reshape(b * h, kr.shape[2], d),
+        vr.reshape(b * h, vr.shape[2], d),
+        causal=causal,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    return o.reshape(b, h, s, d)

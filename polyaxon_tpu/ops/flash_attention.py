@@ -1,0 +1,407 @@
+"""Pallas TPU flash attention (FlashAttention-2 style), forward + backward.
+
+The reference framework contains no attention code at all (SURVEY.md §5
+"Long-context": upstream Polyaxon never touches attention) — this kernel is
+part of the training runtime the TPU build owns outright (north star).
+
+Design (TPU grid-accumulation pattern, see /opt/skills/guides/pallas_guide.md):
+- grid = (batch*heads, q_blocks, kv_blocks); the last grid dim executes
+  sequentially on a core, so VMEM scratch (acc/m/l) carries the online
+  softmax state across kv steps and the output is written on the last step.
+- position offsets (``q_offset``/``k_offset``, SMEM scalars) shift the causal
+  mask so the same kernel serves ring attention, where each step attends to a
+  KV chunk from a different global position (ops/ring_attention.py).
+- fully-masked kv blocks are skipped with ``pl.when`` (saves MXU work; the
+  DMA still lands — acceptable round-1 cost).
+- compute is f32 regardless of input dtype; outputs cast back. LSE is saved
+  for the backward pass.
+
+Backward = two kernels: dq accumulates over kv blocks; dkv accumulates over
+q blocks. ``delta = rowsum(do * o)`` is precomputed in XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -1e30
+
+
+def _causal_mask(s, q_ids, k_ids):
+    return jnp.where(q_ids[:, None] >= k_ids[None, :], s, DEFAULT_MASK_VALUE)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    qo_ref, ko_ref,  # SMEM scalars: [1] int32 global position offsets
+    q_ref, k_ref, v_ref,  # VMEM blocks
+    o_ref, lse_ref,  # outputs
+    acc_ref, m_ref, l_ref,  # VMEM scratch, persists across kv grid steps
+    *, sm_scale: float, causal: bool, block_q: int, block_k: int, num_k: int,
+):
+    j = pl.program_id(1)
+    s = pl.program_id(2)
+
+    @pl.when(s == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+    q_ids = q_off + j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q,), 0)
+    k_ids = k_off + s * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k,), 0)
+
+    # Skip blocks entirely above the causal diagonal (scalar predicate only:
+    # vector-element extraction has no TPU lowering).
+    run = jnp.logical_or(
+        not causal, q_off + (j + 1) * block_q - 1 >= k_off + s * block_k
+    )
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            scores = _causal_mask(scores, q_ids, k_ids)
+        m_prev = m_ref[:, :1]  # [bq, 1], lanes-replicated scratch
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard -inf - -inf (fully masked so far AND fully masked now)
+        safe_m = jnp.where(m_new == -jnp.inf, 0.0, m_new)
+        alpha = jnp.where(m_prev == -jnp.inf, 0.0, jnp.exp(m_prev - safe_m))
+        p = jnp.exp(scores - safe_m)
+        if causal:
+            p = jnp.where(q_ids[:, None] >= k_ids[None, :], p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(s == num_k - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        m = m_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(l_safe))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _flash_fwd(
+    q, k, v, q_offset, k_offset,
+    *, sm_scale, causal, block_q, block_k, interpret,
+):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+    num_q, num_k = sq // block_q, sk // block_k
+    grid = (bh, num_q, num_k)
+
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    ko = jnp.asarray(k_offset, jnp.int32).reshape(1)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=num_k,
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),  # lse, lanes-replicated
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, j, s: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, s: (i, s, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, s: (i, s, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, s: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda i, j, s: (i, j, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(qo, ko, q, k, v)
+    return o, lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    qo_ref, ko_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    acc_ref,
+    *, sm_scale, causal, block_q, block_k, num_k,
+):
+    j = pl.program_id(1)
+    s = pl.program_id(2)
+
+    @pl.when(s == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_ids = qo_ref[0] + j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q,), 0)
+    k_ids = ko_ref[0] + s * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k,), 0)
+    run = jnp.logical_or(
+        not causal, qo_ref[0] + (j + 1) * block_q - 1 >= ko_ref[0] + s * block_k
+    )
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        mask = q_ids[:, None] >= k_ids[None, :]
+        safe_lse = jnp.where(lse == -jnp.inf, 0.0, lse)
+        p = jnp.exp(scores - safe_lse)
+        p = jnp.where(lse == -jnp.inf, 0.0, p)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(s == num_k - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    qo_ref, ko_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, sm_scale, causal, block_q, block_k, num_q,
+):
+    s = pl.program_id(1)  # kv block
+    j = pl.program_id(2)  # q block (sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_ids = qo_ref[0] + j * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q,), 0)
+    k_ids = ko_ref[0] + s * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k,), 0)
+    run = jnp.logical_or(
+        not causal, qo_ref[0] + (j + 1) * block_q - 1 >= ko_ref[0] + s * block_k
+    )
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        mask = q_ids[:, None] >= k_ids[None, :]
+        safe_lse = jnp.where(lse == -jnp.inf, 0.0, lse)
+        p = jnp.exp(scores - safe_lse)
+        p = jnp.where(lse == -jnp.inf, 0.0, p)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        # dv += p^T @ do
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        # dk += ds^T @ q
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def bwd_row_stats(o, lse, do):
+    """Loop-invariant backward inputs: delta = rowsum(do*o) and the
+    lanes-replicated [bh, sq, 128] forms of lse/delta. Ring attention hoists
+    this out of its per-step loop (same o/do/lse every step)."""
+    bh, sq = lse.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse_r = jnp.broadcast_to(lse[..., None], (bh, sq, 128))
+    delta_r = jnp.broadcast_to(delta[..., None], (bh, sq, 128))
+    return lse_r, delta_r
+
+
+def _flash_bwd(
+    q, k, v, o, lse, do, q_offset, k_offset,
+    *, sm_scale, causal, block_q, block_k, interpret,
+    row_stats=None,
+):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    num_q, num_k = sq // block_q, sk // block_k
+
+    lse_r, delta_r = row_stats if row_stats is not None else bwd_row_stats(o, lse, do)
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    ko = jnp.asarray(k_offset, jnp.int32).reshape(1)
+
+    scalar_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, s: (i, j, 0))
+    kv_spec_dq = pl.BlockSpec((1, block_k, d), lambda i, j, s: (i, s, 0))
+    row_spec = pl.BlockSpec((1, block_q, 128), lambda i, j, s: (i, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k=num_k,
+        ),
+        grid=(bh, num_q, num_k),
+        in_specs=scalar_specs + [q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(qo, ko, q, k, v, do, lse_r, delta_r)
+
+    # dkv: grid (bh, kv_blocks, q_blocks) — q is the sequential dim
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda i, s, j: (i, j, 0))
+    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda i, s, j: (i, s, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, 128), lambda i, s, j: (i, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q=num_q,
+        ),
+        grid=(bh, num_k, num_q),
+        in_specs=scalar_specs + [q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qo, ko, q, k, v, do, lse_r, delta_r)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper (static config via nondiff argnums-free closure cache)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _make_flash(sm_scale, causal, block_q, block_k, interpret):
+    @jax.custom_vjp
+    def flash(q, k, v, q_offset, k_offset):
+        o, _ = _flash_fwd(
+            q, k, v, q_offset, k_offset,
+            sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        return o
+
+    def fwd(q, k, v, q_offset, k_offset):
+        o, lse = _flash_fwd(
+            q, k, v, q_offset, k_offset,
+            sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        return o, (q, k, v, o, lse, q_offset, k_offset)
+
+    def bwd(res, do):
+        q, k, v, o, lse, q_offset, k_offset = res
+        dq, dk, dv = _flash_bwd(
+            q, k, v, o, lse, do, q_offset, k_offset,
+            sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        return dq, dk, dv, None, None
+
+    flash.defvjp(fwd, bwd)
+    return flash
+
+
+def flash_attention_bhsd(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    q_offset=0,
+    k_offset=0,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+    return_lse: bool = False,
+):
+    """Flash attention over ``[batch*heads, seq, head_dim]`` tensors.
+
+    ``q_offset``/``k_offset`` are *global* sequence positions of element 0 of
+    the q/k chunks — the causal mask compares global positions, which is what
+    ring attention needs. May be traced scalars.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if return_lse:
+        return _flash_fwd(
+            q, k, v, q_offset, k_offset,
+            sm_scale=float(sm_scale), causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    fn = _make_flash(float(sm_scale), causal, block_q, block_k, interpret)
+    return fn(q, k, v, q_offset, k_offset)

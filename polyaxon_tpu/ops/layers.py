@@ -1,0 +1,64 @@
+"""Elementwise/normalization building blocks shared by the model zoo.
+
+Plain jnp implementations — XLA fuses these into surrounding matmuls on TPU
+(HBM-bandwidth guidance in the task brief); pallas variants only where XLA
+can't fuse (attention — see flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """RMSNorm in f32 regardless of activation dtype (stability on bf16)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(x: jax.Array, weight: jax.Array, bias: jax.Array, eps: float = 1e-5) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0) -> tuple[jax.Array, jax.Array]:
+    """Precompute cos/sin tables [max_seq, head_dim//2] (f32)."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array | None = None
+) -> jax.Array:
+    """Rotary position embedding. x: [..., seq, head_dim]; positions: [seq]
+    global indices (context-parallel shards pass their own offsets)."""
+    seq = x.shape[-2]
+    if positions is None:
+        positions = jnp.arange(seq)
+    c = cos[positions][..., None, :, :] if x.ndim == 4 else cos[positions]
+    s = sin[positions][..., None, :, :] if x.ndim == 4 else sin[positions]
+    # x layout: interleave-free halves (GPT-NeoX style)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    # broadcast tables over leading dims
+    while c.ndim < x1.ndim:
+        c, s = c[None], s[None]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, gate: jax.Array) -> jax.Array:
+    return jax.nn.silu(gate) * x
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    return jax.nn.gelu(x, approximate=True)

@@ -1,0 +1,144 @@
+"""Ring attention: exact attention over context-parallel sequence shards.
+
+The long-context capability the reference never had (SURVEY.md §5
+"Long-context / sequence parallelism": absent upstream; mandated by the
+north star). Sequence is sharded over the ``context`` mesh axis; KV chunks
+travel the ICI ring via ``ppermute`` while each device computes blockwise
+attention against the visiting chunk, merging partial results with the
+online-softmax log-sum-exp rule. Communication overlaps compute because the
+ppermute of step i+1 has no data dependency on step i's FLOPs — XLA's
+latency-hiding scheduler pipelines them.
+
+Gradients: a custom VJP runs a second ring pass. Flash backward only needs
+the *global* row LSE and delta = rowsum(do·o), so each step reuses the
+single-chip pallas backward kernels with position offsets — dk/dv partial
+sums ride the ring with their chunk and arrive home after cp steps.
+
+Call INSIDE shard_map with per-device shards ``[batch, heads, seq_local,
+head_dim]``; positions are global (shard i owns rows [i*S, (i+1)*S)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .flash_attention import _flash_bwd, _flash_fwd, bwd_row_stats
+
+
+def _merge(o, lse, o_i, lse_i):
+    """Merge normalized partial attention (o_i, lse_i) into running (o, lse)."""
+    lse_new = jnp.logaddexp(lse, lse_i)
+    safe = jnp.where(lse_new == -jnp.inf, 0.0, lse_new)
+    w_prev = jnp.where(lse == -jnp.inf, 0.0, jnp.exp(lse - safe))[..., None]
+    w_i = jnp.where(lse_i == -jnp.inf, 0.0, jnp.exp(lse_i - safe))[..., None]
+    return o * w_prev + o_i.astype(jnp.float32) * w_i, lse_new
+
+
+def _ring_fwd_loop(q, k, v, axis_name, cp, causal, sm_scale, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(i, carry):
+        o, lse, k_cur, v_cur = carry
+        src = (my - i) % cp
+        o_i, lse_i = _flash_fwd(
+            q, k_cur, v_cur, my * s, src * s,
+            sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        o, lse = _merge(o, lse, o_i, lse_i)
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        return o, lse, k_cur, v_cur
+
+    o0 = jnp.zeros((bh, s, d), jnp.float32)
+    lse0 = jnp.full((bh, s), -jnp.inf, jnp.float32)
+    o, lse, _, _ = lax.fori_loop(0, cp, step, (o0, lse0, k, v))
+    return o.astype(q.dtype), lse
+
+
+@functools.lru_cache(maxsize=64)
+def _make_ring(axis_name, cp, causal, sm_scale, block_q, block_k, interpret):
+    @jax.custom_vjp
+    def ring(q, k, v):
+        o, _ = _ring_fwd_loop(
+            q, k, v, axis_name, cp, causal, sm_scale, block_q, block_k, interpret
+        )
+        return o
+
+    def fwd(q, k, v):
+        o, lse = _ring_fwd_loop(
+            q, k, v, axis_name, cp, causal, sm_scale, block_q, block_k, interpret
+        )
+        return o, (q, k, v, o, lse)
+
+    def bwd(res, do):
+        q, k, v, o, lse, = res
+        bh, s, d = q.shape
+        my = lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+        row_stats = bwd_row_stats(o, lse, do)  # loop-invariant
+
+        def step(i, carry):
+            dq, k_cur, v_cur, dk, dv = carry
+            src = (my - i) % cp
+            dq_i, dk_i, dv_i = _flash_bwd(
+                q, k_cur, v_cur, o, lse, do, my * s, src * s,
+                sm_scale=sm_scale, causal=causal,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+                row_stats=row_stats,
+            )
+            dq = dq + dq_i.astype(jnp.float32)
+            dk = dk + dk_i.astype(jnp.float32)
+            dv = dv + dv_i.astype(jnp.float32)
+            # chunk gradients travel with their chunk around the ring
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+            dk = lax.ppermute(dk, axis_name, perm)
+            dv = lax.ppermute(dv, axis_name, perm)
+            return dq, k_cur, v_cur, dk, dv
+
+        z = jnp.zeros((bh, s, d), jnp.float32)
+        dq, _, _, dk, dv = lax.fori_loop(0, cp, step, (z, k, v, z, z))
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    ring.defvjp(fwd, bwd)
+    return ring
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    axis_name: str = "context",
+    axis_size: Optional[int] = None,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Exact causal attention over a sequence sharded on ``axis_name``.
+
+    q/k/v: per-device shards [batch, heads, seq_local, head_dim] (GQA must be
+    expanded by the caller). Returns the local output shard.
+    """
+    b, h, s, d = q.shape
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if axis_size is None:
+        axis_size = lax.psum(1, axis_name)
+        axis_size = int(axis_size)  # static under shard_map tracing
+    fn = _make_ring(
+        axis_name, int(axis_size), causal, float(sm_scale),
+        block_q, block_k, bool(interpret),
+    )
+    o = fn(q.reshape(b * h, s, d), k.reshape(b * h, s, d), v.reshape(b * h, s, d))
+    return o.reshape(b, h, s, d)

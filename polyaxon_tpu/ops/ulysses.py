@@ -1,0 +1,50 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all head/seq
+reshard — the head-parallel alternative to ring attention (SURVEY.md §5).
+
+Inside shard_map over the ``context`` axis, each device holds a sequence
+shard of every head. Two ``all_to_all``s convert that to "all of the
+sequence for heads/cp heads", run ordinary (flash) attention with the full
+causal mask, and convert back. Differentiable end-to-end — all_to_all has a
+well-defined transpose, so no custom VJP is needed.
+
+Prefer Ulysses when heads % cp == 0 and the sequence fits one device's HBM
+after the reshard; prefer ring attention when sequence length itself is the
+constraint (KV never materializes fully on one chip there).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from .attention import attention
+
+
+def ulysses_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    axis_name: str = "context",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    impl: str = "auto",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """q/k/v: per-device shards [batch, heads, seq_local, head_dim]."""
+    cp = int(lax.psum(1, axis_name))
+    h = q.shape[1]
+    if h % cp != 0:
+        raise ValueError(f"Ulysses needs heads ({h}) divisible by axis size ({cp})")
+
+    def to_heads(x):  # [B, H, S/cp, D] -> [B, H/cp, S, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def to_seq(x):  # [B, H/cp, S, D] -> [B, H, S/cp, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    o = attention(
+        to_heads(q), to_heads(k), to_heads(v),
+        causal=causal, sm_scale=sm_scale, impl=impl, interpret=interpret,
+    )
+    return to_seq(o)

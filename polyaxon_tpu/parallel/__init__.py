@@ -1,0 +1,46 @@
+"""Mesh/sharding layer: SPMD parallelism over a jax.sharding.Mesh.
+
+TPU-native replacement for the reference's NCCL/Kubeflow distribution story
+(SURVEY.md §2): DP/FSDP/TP/PP/SP/EP are mesh axes, collectives are XLA ops
+riding ICI, and multi-host bootstrap is jax.distributed env injection.
+"""
+
+from .mesh import (
+    MESH_AXES,
+    DEFAULT_RULES,
+    ShardingRules,
+    build_mesh,
+    logical_sharding,
+    mesh_axis_size,
+    normalize_axis_sizes,
+    shard_pytree,
+    with_logical_constraint,
+)
+from .distributed import (
+    ENV_COORDINATOR,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ProcessInfo,
+    initialize,
+    process_info_from_env,
+    rendezvous_env,
+)
+
+__all__ = [
+    "MESH_AXES",
+    "DEFAULT_RULES",
+    "ShardingRules",
+    "build_mesh",
+    "logical_sharding",
+    "mesh_axis_size",
+    "normalize_axis_sizes",
+    "shard_pytree",
+    "with_logical_constraint",
+    "ENV_COORDINATOR",
+    "ENV_NUM_PROCESSES",
+    "ENV_PROCESS_ID",
+    "ProcessInfo",
+    "initialize",
+    "process_info_from_env",
+    "rendezvous_env",
+]

@@ -1,0 +1,94 @@
+"""Multi-host rendezvous: the TPU replacement for NCCL/MPI bootstrap.
+
+The reference's distributed bootstrap is env-var injection consumed by NCCL
+(``MASTER_ADDR``/``MASTER_PORT``/``WORLD_SIZE``/``RANK``) or an MPI hostfile
+(SURVEY.md §5 "Distributed communication backend"). Here the operator injects
+the JAX coordinator triple instead, and this module consumes it:
+
+- ``PLX_COORDINATOR_ADDRESS``  — host:port of process 0
+- ``PLX_NUM_PROCESSES``        — one process per TPU-VM host
+- ``PLX_PROCESS_ID``           — this host's index
+
+``initialize()`` is idempotent and a no-op for single-process runs, so the
+same training script works on a laptop CPU, one TPU VM, or a v5e-256 slice —
+the TPU analogue of the reference running the same script under
+``python``, ``torchrun``, or ``mpirun``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+
+# Canonical env names injected by the operator/compiler (compiler/converter.py).
+ENV_COORDINATOR = "PLX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "PLX_NUM_PROCESSES"
+ENV_PROCESS_ID = "PLX_PROCESS_ID"
+# Also honor raw jax.distributed names so hand-rolled pods work.
+_FALLBACKS = {
+    ENV_COORDINATOR: "JAX_COORDINATOR_ADDRESS",
+    ENV_NUM_PROCESSES: "JAX_NUM_PROCESSES",
+    ENV_PROCESS_ID: "JAX_PROCESS_ID",
+}
+
+_initialized = False
+
+
+@dataclass(frozen=True)
+class ProcessInfo:
+    process_id: int
+    num_processes: int
+    coordinator_address: Optional[str]
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def _env(name: str) -> Optional[str]:
+    return os.environ.get(name) or os.environ.get(_FALLBACKS.get(name, ""), None) or None
+
+
+def process_info_from_env() -> ProcessInfo:
+    num = int(_env(ENV_NUM_PROCESSES) or 1)
+    pid = int(_env(ENV_PROCESS_ID) or 0)
+    return ProcessInfo(process_id=pid, num_processes=num, coordinator_address=_env(ENV_COORDINATOR))
+
+
+def initialize(info: Optional[ProcessInfo] = None) -> ProcessInfo:
+    """Join the job's rendezvous if the env says we're multi-process.
+
+    Safe to call multiple times; only the first call talks to jax.distributed.
+    """
+    global _initialized
+    info = info or process_info_from_env()
+    if _initialized or not info.is_distributed:
+        return info
+    if not info.coordinator_address:
+        raise RuntimeError(
+            f"{ENV_NUM_PROCESSES}={info.num_processes} but no {ENV_COORDINATOR} set"
+        )
+    jax.distributed.initialize(
+        coordinator_address=info.coordinator_address,
+        num_processes=info.num_processes,
+        process_id=info.process_id,
+    )
+    _initialized = True
+    return info
+
+
+def rendezvous_env(coordinator_host: str, port: int, num_processes: int, process_id: int) -> dict[str, str]:
+    """The env block the compiler/operator injects into each host's pod
+    (the ICI-era replacement for the reference's NCCL env block)."""
+    return {
+        ENV_COORDINATOR: f"{coordinator_host}:{port}",
+        ENV_NUM_PROCESSES: str(num_processes),
+        ENV_PROCESS_ID: str(process_id),
+    }

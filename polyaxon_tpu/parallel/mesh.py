@@ -1,0 +1,181 @@
+"""Device-mesh construction and logical-axis sharding rules.
+
+This module is the TPU-native replacement for the reference's
+replicas+NCCL description of distribution (SURVEY.md §2 "absent components"
+table): instead of injecting ``MASTER_ADDR``/``WORLD_SIZE`` and delegating
+collectives to NCCL inside user containers, every distributed workload is a
+single SPMD program over a ``jax.sharding.Mesh`` whose axes are declared in
+the job spec (``V1Parallelism``) and whose collectives XLA lowers onto ICI.
+
+Axis order is chosen for ICI locality (scaling-book recipe): outermost axes
+(``data``/``fsdp``) carry the least-frequent, largest-granularity traffic and
+may span DCN in multislice; innermost (``model``) carries per-layer
+collectives and must sit on adjacent chips.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Any, Mapping, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical mesh axis order, outermost first.
+MESH_AXES: tuple[str, ...] = ("data", "fsdp", "stage", "expert", "context", "model")
+
+
+def normalize_axis_sizes(parallelism: Union[Mapping[str, int], Any, None]) -> dict[str, int]:
+    """Accept a V1Parallelism, a dict, or None and return {axis: size} in
+    canonical order with every axis present (size 1 when unspecified)."""
+    if parallelism is None:
+        sizes: Mapping[str, int] = {}
+    elif hasattr(parallelism, "axis_sizes"):
+        sizes = parallelism.axis_sizes()
+    else:
+        sizes = dict(parallelism)
+    unknown = set(sizes) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(f"Unknown mesh axes {sorted(unknown)}; valid: {MESH_AXES}")
+    return {ax: int(sizes.get(ax, 1)) for ax in MESH_AXES}
+
+
+def build_mesh(
+    parallelism: Union[Mapping[str, int], Any, None] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    allow_split_physical_axes: bool = True,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` from a parallelism spec.
+
+    Unspecified capacity is absorbed into the ``data`` axis: with 8 devices
+    and ``{"model": 2}`` you get a ``data=4, model=2`` mesh. This mirrors how
+    the reference scaled by adding replicas — DP is the default axis.
+    """
+    sizes = normalize_axis_sizes(parallelism)
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    declared = math.prod(sizes.values())
+    if declared > n:
+        raise ValueError(f"Mesh needs {declared} devices but only {n} available")
+    if n % declared != 0:
+        raise ValueError(f"{n} devices not divisible by declared mesh size {declared}")
+    if n // declared > 1:
+        if sizes["data"] != 1 and declared != n:
+            raise ValueError(
+                f"Mesh axes {sizes} (={declared}) do not cover {n} devices"
+            )
+        if sizes["data"] == 1:
+            sizes["data"] = n // declared
+    shape = tuple(sizes[ax] for ax in MESH_AXES)
+    try:
+        # mesh_utils lays devices out so inner axes land on adjacent chips
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=devices, allow_split_physical_axes=allow_split_physical_axes
+        )
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+# ---------------------------------------------------------------------------
+# Logical axis rules
+# ---------------------------------------------------------------------------
+
+# Default logical-name -> mesh-axis rules. Model code annotates arrays with
+# *logical* names ("batch", "embed", "mlp", ...) and the rules decide which
+# mesh axes shard them — swapping a parallelism layout never touches model
+# code, only these rules (the TPU analogue of the reference swapping
+# DDP <-> Horovod launchers without touching the model).
+DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
+    ("batch", ("data", "fsdp")),
+    ("layers", None),           # scan-stacked layer dim is never sharded
+    ("seq", "context"),
+    ("embed", "fsdp"),          # params: fsdp-shard the embed dim (zero-3 style)
+    ("embed_act", None),        # activations keep embed replicated...
+    ("embed_tp", "model"),      # ...except where TP shards them
+    ("heads", "model"),
+    ("kv_heads", "model"),
+    ("head_dim", None),
+    ("mlp", "model"),
+    ("vocab", "model"),
+    ("expert", "expert"),
+    ("stage", "stage"),
+    ("conv_kernel", None),
+    ("channels", None),
+    ("classes", None),
+)
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """Mapping from logical axis names to mesh axis names (or None)."""
+
+    rules: tuple[tuple[str, Any], ...] = DEFAULT_RULES
+
+    def mesh_axes(self, logical: Optional[str]) -> Any:
+        if logical is None:
+            return None
+        for name, axes in self.rules:
+            if name == logical:
+                return axes
+        raise KeyError(f"No sharding rule for logical axis {logical!r}")
+
+    def spec(self, logical_axes: Sequence[Optional[str]]) -> PartitionSpec:
+        return PartitionSpec(*(self.mesh_axes(ax) for ax in logical_axes))
+
+    def sharding(self, mesh: Mesh, logical_axes: Sequence[Optional[str]]) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_axes))
+
+    def override(self, **kwargs: Any) -> "ShardingRules":
+        """Return new rules with some logical names remapped, e.g.
+        ``rules.override(embed=None)`` to disable FSDP param sharding."""
+        out = [(n, kwargs[n]) if n in kwargs else (n, a) for n, a in self.rules]
+        for k in kwargs:
+            if k not in dict(self.rules):
+                out.append((k, kwargs[k]))
+        return ShardingRules(rules=tuple(out))
+
+
+def logical_sharding(
+    mesh: Mesh, *logical_axes: Optional[str], rules: Optional[ShardingRules] = None
+) -> NamedSharding:
+    return (rules or ShardingRules()).sharding(mesh, logical_axes)
+
+
+def with_logical_constraint(
+    x: Any, *logical_axes: Optional[str], mesh: Optional[Mesh] = None, rules: Optional[ShardingRules] = None
+) -> Any:
+    """``jax.lax.with_sharding_constraint`` by logical names.
+
+    With ``mesh`` the constraint is a NamedSharding; without, the bare
+    PartitionSpec is passed through, which is valid under an active
+    ``jax.sharding.use_mesh`` context and raises outside one (never a
+    silent no-op)."""
+    rules = rules or ShardingRules()
+    spec = rules.spec(logical_axes)
+    if mesh is None:
+        return jax.lax.with_sharding_constraint(x, spec)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def shard_pytree(tree: Any, mesh: Mesh, spec_tree: Any) -> Any:
+    """Device-put a pytree of arrays with a matching pytree of PartitionSpecs."""
+    def _put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(_put, tree, spec_tree)
+
+
+def pspec_tree_like(tree: Any, fn) -> Any:
+    """Build a PartitionSpec pytree by calling ``fn(path, leaf)`` per leaf."""
+    return jax.tree_util.tree_map_with_path(fn, tree)
+
+
+def mesh_axis_size(mesh: Mesh, *axes: str) -> int:
+    return math.prod(mesh.shape[a] for a in axes)

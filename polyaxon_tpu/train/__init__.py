@@ -1,0 +1,15 @@
+"""Training runtime: jitted SPMD train step, optimizers, checkpoint/resume,
+throughput/MFU metering, input pipelines (SURVEY.md §7 stage 4 — the part
+of the stack the reference delegated to user containers)."""
+
+from .checkpoint import CheckpointConfig, Checkpointer
+from .data import DataConfig, make_batches
+from .metrics import ThroughputMeter, peak_tflops
+from .optimizers import OptimizerConfig, make_optimizer, make_schedule
+from .trainer import Trainer, TrainerConfig, TrainState
+
+__all__ = [
+    "CheckpointConfig", "Checkpointer", "DataConfig", "make_batches",
+    "ThroughputMeter", "peak_tflops", "OptimizerConfig", "make_optimizer",
+    "make_schedule", "Trainer", "TrainerConfig", "TrainState",
+]

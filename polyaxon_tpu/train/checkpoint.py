@@ -1,0 +1,75 @@
+"""Orbax-backed checkpoint/resume.
+
+Upstream checkpointing is convention only (user writes to the artifacts dir,
+sidecar syncs, resume = clone-with-restart; SURVEY.md §5). Here the runtime
+owns it: async Orbax saves off the critical path, `save_interval_steps` from
+the run spec, and auto-resume picks up the latest step after a slice
+restart (failure model: all-or-nothing per ICI slice).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    directory: str
+    save_interval_steps: int = 1000
+    max_to_keep: int = 3
+    async_save: bool = True
+
+
+class Checkpointer:
+    """Thin wrapper over orbax CheckpointManager for train-state pytrees."""
+
+    def __init__(self, cfg: CheckpointConfig):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.cfg = cfg
+        os.makedirs(cfg.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            save_interval_steps=cfg.save_interval_steps,
+            max_to_keep=cfg.max_to_keep,
+            enable_async_checkpointing=cfg.async_save,
+        )
+        self.manager = ocp.CheckpointManager(
+            os.path.abspath(cfg.directory), options=options
+        )
+
+    def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Save if the interval policy says so. Async: returns immediately."""
+        return self.manager.save(
+            step, args=self._ocp.args.StandardSave(state), force=force
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> tuple[Any, int]:
+        """Restore latest (or given) step. ``state_like`` provides structure +
+        shardings: pass the freshly-initialized (possibly sharded) state."""
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"No checkpoint under {self.cfg.directory}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "shape") else x,
+            state_like,
+        )
+        restored = self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(abstract)
+        )
+        return restored, step
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()

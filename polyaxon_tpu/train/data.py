@@ -1,0 +1,93 @@
+"""Input pipelines: synthetic workloads for bench/tests + tokenized-corpus
+loader. Host-side numpy feeding sharded device_put (per-host data loading on
+multi-host slices: each process owns its batch shard, jax.make_array_*
+assembles the global array)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    kind: str = "synthetic-lm"      # synthetic-lm | synthetic-image | tokens-file
+    batch_size: int = 8
+    seq_len: int = 128
+    vocab_size: int = 32000
+    image_size: int = 224
+    num_classes: int = 1000
+    path: Optional[str] = None      # tokens-file: .npy/.bin uint16/uint32 array
+    seed: int = 0
+
+
+def _batch_sharding(mesh: Optional[Mesh], extra_dims: int, seq_axis: bool = False):
+    if mesh is None:
+        return None
+    spec = [("data", "fsdp")] + ([None] * extra_dims)
+    if seq_axis:
+        spec[1] = "context"
+    return NamedSharding(mesh, P(*spec))
+
+
+def _put(arr: np.ndarray, sharding) -> jax.Array:
+    if sharding is None:
+        return jax.numpy.asarray(arr)
+    return jax.device_put(arr, sharding)
+
+
+def synthetic_lm_batches(cfg: DataConfig, mesh: Optional[Mesh] = None) -> Iterator[dict]:
+    """Endless {inputs, labels} int32 batches (next-token objective)."""
+    rng = np.random.default_rng(cfg.seed)
+    sharding = _batch_sharding(mesh, 1, seq_axis=True)
+    while True:
+        tok = rng.integers(0, cfg.vocab_size, (cfg.batch_size, cfg.seq_len + 1), dtype=np.int32)
+        yield {
+            "inputs": _put(tok[:, :-1], sharding),
+            "labels": _put(tok[:, 1:], sharding),
+        }
+
+
+def synthetic_image_batches(cfg: DataConfig, mesh: Optional[Mesh] = None) -> Iterator[dict]:
+    rng = np.random.default_rng(cfg.seed)
+    im_sharding = _batch_sharding(mesh, 3)
+    lb_sharding = _batch_sharding(mesh, 0)
+    while True:
+        images = rng.standard_normal(
+            (cfg.batch_size, cfg.image_size, cfg.image_size, 3), dtype=np.float32
+        )
+        labels = rng.integers(0, cfg.num_classes, (cfg.batch_size,), dtype=np.int32)
+        yield {"images": _put(images, im_sharding), "labels": _put(labels, lb_sharding)}
+
+
+def token_file_batches(cfg: DataConfig, mesh: Optional[Mesh] = None) -> Iterator[dict]:
+    """Stream fixed-length windows from a flat token array on disk
+    (np.memmap; the standard packed-corpus format)."""
+    assert cfg.path, "tokens-file data needs `path`"
+    tokens = np.load(cfg.path, mmap_mode="r") if cfg.path.endswith(".npy") else \
+        np.memmap(cfg.path, dtype=np.uint16, mode="r")
+    n = len(tokens) - cfg.seq_len - 1
+    rng = np.random.default_rng(cfg.seed)
+    sharding = _batch_sharding(mesh, 1, seq_axis=True)
+    while True:
+        starts = rng.integers(0, n, cfg.batch_size)
+        window = np.stack([np.asarray(tokens[s : s + cfg.seq_len + 1]) for s in starts])
+        window = window.astype(np.int32)
+        yield {
+            "inputs": _put(window[:, :-1], sharding),
+            "labels": _put(window[:, 1:], sharding),
+        }
+
+
+def make_batches(cfg: DataConfig, mesh: Optional[Mesh] = None) -> Iterator[dict]:
+    if cfg.kind == "synthetic-lm":
+        return synthetic_lm_batches(cfg, mesh)
+    if cfg.kind == "synthetic-image":
+        return synthetic_image_batches(cfg, mesh)
+    if cfg.kind == "tokens-file":
+        return token_file_batches(cfg, mesh)
+    raise ValueError(f"Unknown data kind {cfg.kind!r}")

@@ -1,0 +1,70 @@
+"""Throughput/MFU meter — the quantitative anchor of BASELINE.md
+(tokens/sec/chip, MFU vs the ≥45% north-star target)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..schemas.tpu import ACCELERATOR_SPECS
+
+
+def peak_tflops(accelerator: str = "v5e") -> float:
+    return ACCELERATOR_SPECS[accelerator]["bf16_tflops"]
+
+
+@dataclass
+class ThroughputMeter:
+    """Tracks step wall time -> tokens/sec/chip and model FLOPs utilization.
+
+    ``flops_per_token`` comes from the model config
+    (TransformerConfig.flops_per_token); MFU = achieved FLOPs / peak FLOPs.
+    """
+
+    tokens_per_step: int
+    flops_per_token: float
+    num_chips: int = 1
+    accelerator: str = "v5e"
+    _t0: Optional[float] = field(default=None, repr=False)
+    steps: int = 0
+    elapsed: float = 0.0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def step(self) -> None:
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self.elapsed += now - self._t0
+            self.steps += 1
+        self._t0 = now
+
+    @property
+    def tokens_per_sec(self) -> float:
+        if self.elapsed == 0:
+            return 0.0
+        return self.tokens_per_step * self.steps / self.elapsed
+
+    @property
+    def tokens_per_sec_per_chip(self) -> float:
+        return self.tokens_per_sec / self.num_chips
+
+    @property
+    def achieved_tflops_per_chip(self) -> float:
+        return self.tokens_per_sec_per_chip * self.flops_per_token / 1e12
+
+    @property
+    def mfu(self) -> float:
+        peak = peak_tflops(self.accelerator)
+        return self.achieved_tflops_per_chip / peak if peak else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "steps": self.steps,
+            "step_time_ms": (self.elapsed / self.steps * 1e3) if self.steps else 0.0,
+            "tokens_per_sec": self.tokens_per_sec,
+            "tokens_per_sec_per_chip": self.tokens_per_sec_per_chip,
+            "achieved_tflops_per_chip": self.achieved_tflops_per_chip,
+            "mfu": self.mfu,
+        }

@@ -1,0 +1,64 @@
+"""Optimizers + LR schedules (optax) for the training runtime.
+
+The reference has no optimizer code (it orchestrates user containers,
+SURVEY.md §1) — these are part of the runtime we own. Optimizer state
+inherits the params' sharding (same pytree structure), so FSDP shards
+moments for free.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import optax
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "adamw"               # adamw | sgd | lion | adafactor
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 10000
+    schedule: str = "cosine"          # cosine | linear | constant
+    min_lr_ratio: float = 0.1
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    momentum: float = 0.9             # sgd
+
+
+def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
+    peak = cfg.learning_rate
+    end = peak * cfg.min_lr_ratio
+    decay_steps = max(cfg.total_steps - cfg.warmup_steps, 1)
+    if cfg.schedule == "cosine":
+        decay = optax.cosine_decay_schedule(peak, decay_steps, alpha=cfg.min_lr_ratio)
+    elif cfg.schedule == "linear":
+        decay = optax.linear_schedule(peak, end, decay_steps)
+    elif cfg.schedule == "constant":
+        decay = optax.constant_schedule(peak)
+    else:
+        raise ValueError(f"Unknown schedule {cfg.schedule!r}")
+    if cfg.warmup_steps <= 0:
+        return decay
+    warmup = optax.linear_schedule(0.0, peak, cfg.warmup_steps)
+    return optax.join_schedules([warmup, decay], [cfg.warmup_steps])
+
+
+def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    sched = make_schedule(cfg)
+    if cfg.name == "adamw":
+        tx = optax.adamw(sched, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay)
+    elif cfg.name == "sgd":
+        tx = optax.sgd(sched, momentum=cfg.momentum)
+    elif cfg.name == "lion":
+        tx = optax.lion(sched, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay)
+    elif cfg.name == "adafactor":
+        tx = optax.adafactor(sched)
+    else:
+        raise ValueError(f"Unknown optimizer {cfg.name!r}")
+    if cfg.grad_clip and cfg.grad_clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+    return tx

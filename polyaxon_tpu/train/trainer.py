@@ -1,0 +1,198 @@
+"""The training loop the reference never owned (SURVEY.md §7 stage 4).
+
+One jitted SPMD step over the job's mesh: shardings come from logical rules,
+params initialize directly into their shards (jit + out_shardings — a 7B
+model never materializes unsharded), optimizer state inherits param
+shardings, inputs are donated, and the loop reports traceml-style metrics.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import transformer
+from ..models.transformer import TransformerConfig, cross_entropy_loss
+from ..parallel.mesh import ShardingRules, build_mesh
+from .checkpoint import CheckpointConfig, Checkpointer
+from .metrics import ThroughputMeter
+from .optimizers import OptimizerConfig, make_optimizer
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params: Any, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+
+
+@dataclass(frozen=True)
+class TrainerConfig:
+    model: TransformerConfig
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    batch_size: int = 8
+    seq_len: int = 128
+    parallelism: Optional[dict] = None
+    checkpoint: Optional[CheckpointConfig] = None
+    log_interval: int = 10
+    accelerator: str = "v5e"
+
+
+class Trainer:
+    """LM trainer (the flagship path; ViT/ResNet have task adapters in
+    runtime/builtin.py)."""
+
+    def __init__(
+        self,
+        cfg: TrainerConfig,
+        mesh: Optional[Mesh] = None,
+        rules: Optional[ShardingRules] = None,
+        track: Optional[Callable[[int, dict], None]] = None,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else build_mesh(cfg.parallelism)
+        self.rules = rules or ShardingRules()
+        self.tx = make_optimizer(cfg.optimizer)
+        self.track = track
+        self.checkpointer = Checkpointer(cfg.checkpoint) if cfg.checkpoint else None
+
+        mcfg = cfg.model
+        pspecs = transformer.param_specs(mcfg, self.rules)
+        self.param_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), pspecs
+        )
+        self.batch_sharding = NamedSharding(self.mesh, P(("data", "fsdp"), "context"))
+        self._compiled_step = None
+
+    # -- init / restore ----------------------------------------------------
+
+    def init_state(self, seed: int = 0) -> TrainState:
+        mcfg = self.cfg.model
+
+        def _init(key):
+            params = transformer.init(key, mcfg)
+            return TrainState.create(params, self.tx)
+
+        key = jax.random.PRNGKey(seed)
+        abstract = jax.eval_shape(_init, key)
+        shardings = self._state_shardings(abstract)
+        init_fn = jax.jit(_init, out_shardings=shardings)
+        return init_fn(key)
+
+    def _state_shardings(self, abstract_state):
+        """Params get logical shardings; everything else (opt moments) mirrors
+        the matching param leaf when shapes line up, else replicated."""
+        param_leaves = jax.tree.leaves(
+            self.param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
+        )
+        param_shapes = jax.tree.leaves(jax.eval_shape(
+            lambda: transformer.init(jax.random.PRNGKey(0), self.cfg.model)
+        ))
+        shape_to_sharding = {}
+        for sh, sd in zip(param_shapes, param_leaves):
+            shape_to_sharding.setdefault((sh.shape, sh.dtype), sd)
+
+        def pick(x):
+            if not hasattr(x, "shape"):
+                return NamedSharding(self.mesh, P())
+            return shape_to_sharding.get(
+                (x.shape, x.dtype), NamedSharding(self.mesh, P())
+            )
+
+        struct = jax.tree.structure(abstract_state)
+        return jax.tree.unflatten(
+            struct, [pick(x) for x in jax.tree.leaves(abstract_state)]
+        )
+
+    def restore_or_init(self, seed: int = 0) -> tuple[TrainState, int]:
+        state = self.init_state(seed)
+        if self.checkpointer and self.checkpointer.latest_step() is not None:
+            state, step = self.checkpointer.restore(state)
+            return state, step
+        return state, 0
+
+    # -- the step ----------------------------------------------------------
+
+    def _loss_fn(self, params, batch):
+        logits = transformer.apply(
+            params, batch["inputs"], self.cfg.model, mesh=self.mesh,
+            interpret=jax.default_backend() != "tpu",
+        )
+        return cross_entropy_loss(logits, batch["labels"], batch.get("mask"))
+
+    def make_step(self):
+        if self._compiled_step is not None:
+            return self._compiled_step
+
+        def step_fn(state: TrainState, batch) -> tuple[TrainState, dict]:
+            loss, grads = jax.value_and_grad(self._loss_fn)(state.params, batch)
+            updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            metrics = {
+                "loss": loss,
+                "grad_norm": optax.global_norm(grads),
+            }
+            return TrainState(params, opt_state, state.step + 1), metrics
+
+        self._compiled_step = jax.jit(step_fn, donate_argnums=(0,))
+        return self._compiled_step
+
+    # -- the loop ----------------------------------------------------------
+
+    def fit(
+        self,
+        batches: Iterator[dict],
+        num_steps: int,
+        state: Optional[TrainState] = None,
+        meter: Optional[ThroughputMeter] = None,
+    ) -> tuple[TrainState, dict]:
+        if state is None:
+            state, start = self.restore_or_init()
+        else:
+            start = int(state.step)
+        step_fn = self.make_step()
+        if meter is None:
+            meter = ThroughputMeter(
+                tokens_per_step=self.cfg.batch_size * self.cfg.seq_len,
+                flops_per_token=self.cfg.model.flops_per_token(self.cfg.seq_len),
+                num_chips=self.mesh.size,
+                accelerator=self.cfg.accelerator,
+            )
+        metrics: dict = {}
+        for i in range(start, num_steps):
+            batch = next(batches)
+            state, metrics = step_fn(state, batch)
+            if i == start:
+                # Sync via scalar fetch, not block_until_ready: on tunneled
+                # platforms (axon) block_until_ready returns before execution
+                # finishes; a device->host copy always waits.
+                float(metrics["loss"])  # excludes compile from timing
+                meter.start()
+            else:
+                if i == num_steps - 1:
+                    float(metrics["loss"])  # close the last timed interval
+                meter.step()
+            if self.track and (i % self.cfg.log_interval == 0 or i == num_steps - 1):
+                logged = {k: float(v) for k, v in metrics.items()}
+                logged.update(meter.summary())
+                self.track(i, logged)
+            if self.checkpointer:
+                self.checkpointer.maybe_save(i + 1, state)
+        if self.checkpointer:
+            if self.checkpointer.latest_step() != num_steps:
+                self.checkpointer.maybe_save(num_steps, state, force=True)
+            self.checkpointer.wait()
+        final = {k: float(v) for k, v in metrics.items()}
+        final.update(meter.summary())
+        return state, final

@@ -1,12 +1,17 @@
 """Test harness: force an 8-device CPU platform so mesh/sharding tests run
-without TPU hardware (SURVEY.md §4 distributed-testing note)."""
+without TPU hardware (SURVEY.md §4 distributed-testing note).
+
+The session interpreter pre-imports jax via sitecustomize (axon TPU plugin),
+so env vars are too late here — use jax.config.update, which works any time
+before first backend initialization.
+"""
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,187 @@
+"""Kernel numerics vs the dense reference (SURVEY.md §4: the distributed
+numerics tests upstream never had). Runs the pallas kernels in interpret
+mode on the 8-device CPU platform."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from polyaxon_tpu.ops import (
+    attention,
+    dense_attention,
+    flash_attention_bhsd,
+    ring_attention,
+    ulysses_attention,
+)
+from polyaxon_tpu.parallel import build_mesh
+
+
+def _rand_qkv(key, b=2, h=2, s=256, d=64, kv_heads=None, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    kvh = kv_heads or h
+    q = jax.random.normal(kq, (b, h, s, d), dtype)
+    k = jax.random.normal(kk, (b, kvh, s, d), dtype)
+    v = jax.random.normal(kv, (b, kvh, s, d), dtype)
+    return q, k, v
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(0))
+        out = attention(q, k, v, causal=causal, impl="flash", block_q=128, block_k=128)
+        ref = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_uneven_blocks(self):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(1), s=384)
+        out = attention(q, k, v, causal=True, impl="flash", block_q=128, block_k=128)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_gqa(self):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(2), h=4, kv_heads=2)
+        out = attention(q, k, v, causal=True, impl="flash", block_q=128, block_k=128)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_offsets_shift_mask(self):
+        # rows at global positions [256, 512) vs keys at [0, 256): fully visible
+        q, k, v = _rand_qkv(jax.random.PRNGKey(3))
+        b, h, s, d = q.shape
+        out = flash_attention_bhsd(
+            q.reshape(b * h, s, d), k.reshape(b * h, s, d), v.reshape(b * h, s, d),
+            causal=True, q_offset=s, k_offset=0, block_q=128, block_k=128,
+        ).reshape(b, h, s, d)
+        ref = dense_attention(q, k, v, causal=False)  # no masking applies
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_fully_masked_is_zero(self):
+        # keys strictly in the future: output must be exactly 0
+        q, k, v = _rand_qkv(jax.random.PRNGKey(4), s=128)
+        b, h, s, d = q.shape
+        o, lse = flash_attention_bhsd(
+            q.reshape(b * h, s, d), k.reshape(b * h, s, d), v.reshape(b * h, s, d),
+            causal=True, q_offset=0, k_offset=s, block_q=128, block_k=128,
+            return_lse=True,
+        )
+        assert np.all(np.asarray(o) == 0)
+        assert np.all(np.isinf(np.asarray(lse)))
+
+
+class TestFlashBackward:
+    def test_grads_match_dense(self):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(5), s=256)
+
+        def loss_flash(q, k, v):
+            return attention(q, k, v, causal=True, impl="flash", block_q=128, block_k=128).sum()
+
+        def loss_dense(q, k, v):
+            return dense_attention(q, k, v, causal=True).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-4)
+
+    def test_noncausal_grads(self):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(6), s=128, b=1, h=1)
+        gf = jax.grad(
+            lambda q, k, v: (attention(q, k, v, causal=False, impl="flash",
+                                       block_q=64, block_k=64) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: (dense_attention(q, k, v, causal=False) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b_ in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-4)
+
+
+def _shard_seq(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P(None, None, "context", None)))
+
+
+class TestRingAttention:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return build_mesh({"context": 8})
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, mesh, causal):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(7), b=1, h=2, s=512, d=32)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, check_vma=False,
+            in_specs=(P(None, None, "context", None),) * 3,
+            out_specs=P(None, None, "context", None),
+        )
+        def ring(q, k, v):
+            return ring_attention(q, k, v, axis_name="context", axis_size=8,
+                                  causal=causal, block_q=64, block_k=64, interpret=True)
+
+        out = ring(_shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v))
+        ref = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_grads_match_dense(self, mesh):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(8), b=1, h=1, s=256, d=32)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, check_vma=False,
+            in_specs=(P(None, None, "context", None),) * 3,
+            out_specs=P(None, None, "context", None),
+        )
+        def ring(q, k, v):
+            return ring_attention(q, k, v, axis_name="context", axis_size=8,
+                                  causal=True, block_q=32, block_k=32, interpret=True)
+
+        def loss_ring(q, k, v):
+            return (ring(q, k, v) ** 2).sum()
+
+        def loss_dense(q, k, v):
+            return (dense_attention(q, k, v, causal=True) ** 2).sum()
+
+        gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gr, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-4)
+
+
+class TestUlysses:
+    def test_matches_dense(self):
+        mesh = build_mesh({"context": 8})
+        q, k, v = _rand_qkv(jax.random.PRNGKey(9), b=1, h=8, s=512, d=32)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, check_vma=False,
+            in_specs=(P(None, None, "context", None),) * 3,
+            out_specs=P(None, None, "context", None),
+        )
+        def uly(q, k, v):
+            return ulysses_attention(q, k, v, axis_name="context", causal=True, impl="dense")
+
+        out = uly(_shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v))
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_head_divisibility_enforced(self):
+        mesh = build_mesh({"context": 8})
+        q, k, v = _rand_qkv(jax.random.PRNGKey(10), b=1, h=4, s=64, d=8)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, check_vma=False,
+            in_specs=(P(None, None, "context", None),) * 3,
+            out_specs=P(None, None, "context", None),
+        )
+        def uly(q, k, v):
+            return ulysses_attention(q, k, v, axis_name="context", causal=True)
+
+        with pytest.raises(ValueError, match="divisible"):
+            uly(_shard_seq(mesh, q), _shard_seq(mesh, k), _shard_seq(mesh, v))

@@ -1,0 +1,134 @@
+"""Mesh/sharding tests on the 8-device CPU platform (conftest forces
+jax.config jax_platforms=cpu + jax_num_cpu_devices=8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from polyaxon_tpu.parallel import (
+    MESH_AXES,
+    ShardingRules,
+    build_mesh,
+    logical_sharding,
+    normalize_axis_sizes,
+    rendezvous_env,
+    shard_pytree,
+    with_logical_constraint,
+)
+from polyaxon_tpu.parallel.distributed import ProcessInfo, initialize
+from polyaxon_tpu.schemas.run import V1Parallelism
+
+
+class TestBuildMesh:
+    def test_default_is_all_data(self):
+        mesh = build_mesh()
+        assert mesh.axis_names == MESH_AXES
+        assert mesh.shape["data"] == 8
+        assert mesh.size == 8
+
+    def test_explicit_axes(self):
+        mesh = build_mesh({"data": 2, "model": 4})
+        assert mesh.shape["data"] == 2
+        assert mesh.shape["model"] == 4
+
+    def test_residual_devices_absorbed_into_data(self):
+        mesh = build_mesh({"model": 2})
+        assert mesh.shape["data"] == 4
+        assert mesh.shape["model"] == 2
+
+    def test_from_v1_parallelism(self):
+        p = V1Parallelism(data=2, model=2, context=2)
+        mesh = build_mesh(p)
+        assert mesh.shape["context"] == 2
+        assert mesh.size == 8
+
+    def test_too_many_devices_raises(self):
+        with pytest.raises(ValueError, match="devices"):
+            build_mesh({"data": 16})
+
+    def test_unknown_axis_raises(self):
+        with pytest.raises(ValueError, match="Unknown mesh axes"):
+            normalize_axis_sizes({"pipeline": 2})
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            build_mesh({"model": 3})
+
+
+class TestShardingRules:
+    def test_default_rules_batch(self):
+        rules = ShardingRules()
+        assert rules.mesh_axes("batch") == ("data", "fsdp")
+        assert rules.mesh_axes("mlp") == "model"
+        assert rules.mesh_axes(None) is None
+
+    def test_spec(self):
+        rules = ShardingRules()
+        spec = rules.spec(["batch", "seq", None])
+        assert spec == PartitionSpec(("data", "fsdp"), "context", None)
+
+    def test_override(self):
+        rules = ShardingRules().override(embed=None, custom="model")
+        assert rules.mesh_axes("embed") is None
+        assert rules.mesh_axes("custom") == "model"
+        # originals untouched
+        assert ShardingRules().mesh_axes("embed") == "fsdp"
+
+    def test_unknown_logical_raises(self):
+        with pytest.raises(KeyError):
+            ShardingRules().mesh_axes("nope")
+
+
+class TestSharding:
+    def test_logical_sharding_places_array(self):
+        mesh = build_mesh({"data": 4, "model": 2})
+        x = jnp.zeros((8, 16))
+        s = logical_sharding(mesh, "batch", "mlp")
+        y = jax.device_put(x, s)
+        assert y.sharding.is_equivalent_to(
+            NamedSharding(mesh, PartitionSpec(("data", "fsdp"), "model")), 2
+        )
+        # batch dim split over 4 data shards
+        assert y.addressable_shards[0].data.shape == (2, 8)
+
+    def test_shard_pytree(self):
+        mesh = build_mesh({"data": 8})
+        tree = {"w": jnp.zeros((16, 4)), "b": jnp.zeros((4,))}
+        specs = {"w": PartitionSpec("data", None), "b": PartitionSpec(None)}
+        out = shard_pytree(tree, mesh, specs)
+        assert out["w"].addressable_shards[0].data.shape == (2, 4)
+
+    def test_constraint_inside_jit(self):
+        mesh = build_mesh({"data": 8})
+
+        @jax.jit
+        def f(x):
+            return with_logical_constraint(x * 2, "batch", None, mesh=mesh)
+
+        x = jnp.ones((8, 3))
+        y = f(x)
+        np.testing.assert_allclose(np.asarray(y), 2.0)
+
+
+class TestDistributedEnv:
+    def test_rendezvous_env_roundtrip(self, monkeypatch):
+        env = rendezvous_env("10.0.0.2", 8476, 16, 3)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        from polyaxon_tpu.parallel.distributed import process_info_from_env
+
+        info = process_info_from_env()
+        assert info.num_processes == 16
+        assert info.process_id == 3
+        assert info.coordinator_address == "10.0.0.2:8476"
+        assert info.is_distributed and not info.is_coordinator
+
+    def test_initialize_noop_single_process(self):
+        info = initialize(ProcessInfo(0, 1, None))
+        assert not info.is_distributed
+
+    def test_initialize_requires_coordinator(self):
+        with pytest.raises(RuntimeError, match="PLX_COORDINATOR"):
+            initialize(ProcessInfo(1, 4, None))

@@ -1,0 +1,129 @@
+"""Trainer tests: sharded end-to-end training step, loss goes down,
+checkpoint save/resume round-trip (SURVEY.md §5 checkpoint/resume)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import llama
+from polyaxon_tpu.train import (
+    CheckpointConfig,
+    DataConfig,
+    OptimizerConfig,
+    Trainer,
+    TrainerConfig,
+    ThroughputMeter,
+    make_batches,
+    make_schedule,
+)
+
+
+def _trainer(tmp_path=None, parallelism=None, **opt):
+    cfg = TrainerConfig(
+        model=llama.LLAMA_TINY,
+        optimizer=OptimizerConfig(
+            learning_rate=1e-2, warmup_steps=2, total_steps=20, **opt
+        ),
+        batch_size=8,
+        seq_len=32,
+        parallelism=parallelism or {"data": 8},
+        checkpoint=CheckpointConfig(
+            directory=str(tmp_path), save_interval_steps=5, async_save=False
+        ) if tmp_path else None,
+        log_interval=2,
+    )
+    return cfg
+
+
+class TestSchedules:
+    def test_warmup_then_cosine(self):
+        s = make_schedule(OptimizerConfig(learning_rate=1.0, warmup_steps=10, total_steps=110))
+        assert float(s(0)) == 0.0
+        assert abs(float(s(10)) - 1.0) < 1e-6
+        assert float(s(110)) < float(s(50))
+
+    def test_constant(self):
+        s = make_schedule(OptimizerConfig(learning_rate=0.5, warmup_steps=0,
+                                          total_steps=10, schedule="constant"))
+        assert float(s(7)) == 0.5
+
+
+class TestTrainer:
+    def test_loss_decreases(self):
+        import itertools
+
+        cfg = _trainer()
+        tr = Trainer(cfg)
+        batch = next(make_batches(
+            DataConfig(kind="synthetic-lm", batch_size=8, seq_len=32,
+                       vocab_size=cfg.model.vocab_size), tr.mesh,
+        ))
+        data = itertools.repeat(batch)  # memorize one batch: loss must fall
+        logs = []
+        tr.track = lambda step, m: logs.append(m)
+        state, final = tr.fit(data, num_steps=12)
+        assert int(state.step) == 12
+        assert final["loss"] < logs[0]["loss"] - 0.3
+        assert final["tokens_per_sec"] > 0
+
+    def test_sharded_params_materialize_sharded(self):
+        cfg = _trainer(parallelism={"fsdp": 4, "model": 2})
+        tr = Trainer(cfg)
+        state = tr.init_state()
+        # mlp wi: (L, hidden, mlp) sharded fsdp on hidden, model on mlp
+        wi = state.params["layers"]["mlp"]["wi"]
+        shard = wi.addressable_shards[0].data
+        assert shard.shape[1] == wi.shape[1] // 4
+        assert shard.shape[2] == wi.shape[2] // 2
+
+    def test_tensor_parallel_training(self):
+        cfg = _trainer(parallelism={"data": 2, "model": 2, "context": 2})
+        tr = Trainer(cfg)
+        data = make_batches(
+            DataConfig(kind="synthetic-lm", batch_size=8, seq_len=32,
+                       vocab_size=cfg.model.vocab_size), tr.mesh,
+        )
+        state, final = tr.fit(data, num_steps=3)
+        assert np.isfinite(final["loss"])
+
+    def test_checkpoint_resume(self, tmp_path):
+        cfg = _trainer(tmp_path=tmp_path / "ckpt")
+        tr = Trainer(cfg)
+        data = make_batches(
+            DataConfig(kind="synthetic-lm", batch_size=8, seq_len=32,
+                       vocab_size=cfg.model.vocab_size), tr.mesh,
+        )
+        state, _ = tr.fit(data, num_steps=10)
+        w_trained = np.asarray(state.params["embed"]["tokens"])
+
+        tr2 = Trainer(_trainer(tmp_path=tmp_path / "ckpt"))
+        state2, step = tr2.restore_or_init()
+        assert step == 10
+        np.testing.assert_allclose(
+            np.asarray(state2.params["embed"]["tokens"]), w_trained, atol=1e-7
+        )
+
+    def test_resume_continues_from_step(self, tmp_path):
+        cfg = _trainer(tmp_path=tmp_path / "ckpt")
+        tr = Trainer(cfg)
+        data = make_batches(
+            DataConfig(kind="synthetic-lm", batch_size=8, seq_len=32,
+                       vocab_size=cfg.model.vocab_size), tr.mesh,
+        )
+        tr.fit(data, num_steps=5)
+        tr2 = Trainer(_trainer(tmp_path=tmp_path / "ckpt"))
+        state2, final = tr2.fit(data, num_steps=8)  # resumes at 5, runs 3 more
+        assert int(state2.step) == 8
+
+
+class TestMeter:
+    def test_mfu_math(self):
+        m = ThroughputMeter(tokens_per_step=1000, flops_per_token=1e9,
+                            num_chips=2, accelerator="v5e")
+        m.elapsed, m.steps = 1.0, 10
+        assert m.tokens_per_sec == 10000
+        assert m.tokens_per_sec_per_chip == 5000
+        # 5000 * 1e9 / 1e12 = 5 TFLOP/s vs 197 peak
+        assert abs(m.mfu - 5.0 / 197.0) < 1e-6
