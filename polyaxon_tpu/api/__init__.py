@@ -1,0 +1,5 @@
+"""REST + streams API service over SQLite (upstream haupt equivalent)."""
+
+from .app import ApiApp, run_artifacts_dir
+from .server import ApiServer
+from .store import Store

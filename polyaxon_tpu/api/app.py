@@ -1,0 +1,286 @@
+"""REST + streams API (aiohttp) — upstream's Django API + ASGI streams
+service collapsed into one async app (SURVEY.md §2 "API service"/"Streams
+service" rows; §3(e) read path).
+
+Endpoints (all JSON unless noted):
+    GET  /healthz
+    GET|POST /api/v1/projects
+    GET  /api/v1/projects/{project}
+    POST /api/v1/{project}/runs                     create (operation spec body)
+    GET  /api/v1/{project}/runs                     list (?status=&limit=&offset=)
+    GET|DELETE /api/v1/{project}/runs/{uuid}
+    POST /api/v1/{project}/runs/{uuid}/statuses     {status, reason?, message?}
+    GET  /api/v1/{project}/runs/{uuid}/statuses
+    POST /api/v1/{project}/runs/{uuid}/outputs      merged into run.outputs
+    POST /api/v1/{project}/runs/{uuid}/stop
+    POST /api/v1/{project}/runs/{uuid}/restart      (cloning, SURVEY.md §5)
+    GET  /api/v1/{project}/runs/{uuid}/metrics      ?names=a,b -> events
+    GET  /api/v1/{project}/runs/{uuid}/events/{kind}
+    GET  /api/v1/{project}/runs/{uuid}/logs         ?offset=N (tail; text/plain)
+    GET  /api/v1/{project}/runs/{uuid}/artifacts/tree ?path=
+    GET  /api/v1/{project}/runs/{uuid}/artifacts/file ?path= (download)
+    POST|GET /api/v1/{project}/runs/{uuid}/lineage
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from ..schemas.statuses import V1Statuses
+from ..tracking.writer import list_event_names, read_events
+from .store import Store
+
+
+def run_artifacts_dir(artifacts_root: str, project: str, uuid: str) -> str:
+    return os.path.join(artifacts_root, project, uuid)
+
+
+def _json(data, status=200):
+    return web.json_response(data, status=status)
+
+
+def _not_found(msg="not found"):
+    return _json({"error": msg}, status=404)
+
+
+class ApiApp:
+    def __init__(self, store: Store, artifacts_root: str):
+        self.store = store
+        self.artifacts_root = os.path.abspath(artifacts_root)
+        os.makedirs(self.artifacts_root, exist_ok=True)
+        self.app = web.Application()
+        self._routes()
+        # the scheduler (if attached in-process) watches this queue
+        self.new_run_event = asyncio.Event()
+
+    def run_dir(self, project: str, uuid: str) -> str:
+        return run_artifacts_dir(self.artifacts_root, project, uuid)
+
+    def _routes(self) -> None:
+        r = self.app.router
+        r.add_get("/healthz", self.healthz)
+        r.add_get("/api/v1/projects", self.list_projects)
+        r.add_post("/api/v1/projects", self.create_project)
+        r.add_get("/api/v1/projects/{project}", self.get_project)
+        r.add_post("/api/v1/{project}/runs", self.create_run)
+        r.add_get("/api/v1/{project}/runs", self.list_runs)
+        r.add_get("/api/v1/{project}/runs/{uuid}", self.get_run)
+        r.add_delete("/api/v1/{project}/runs/{uuid}", self.delete_run)
+        r.add_post("/api/v1/{project}/runs/{uuid}/statuses", self.post_status)
+        r.add_get("/api/v1/{project}/runs/{uuid}/statuses", self.get_statuses)
+        r.add_post("/api/v1/{project}/runs/{uuid}/outputs", self.post_outputs)
+        r.add_post("/api/v1/{project}/runs/{uuid}/stop", self.stop_run)
+        r.add_post("/api/v1/{project}/runs/{uuid}/restart", self.restart_run)
+        r.add_get("/api/v1/{project}/runs/{uuid}/metrics", self.get_metrics)
+        r.add_get("/api/v1/{project}/runs/{uuid}/events/{kind}", self.get_events)
+        r.add_get("/api/v1/{project}/runs/{uuid}/logs", self.get_logs)
+        r.add_get("/api/v1/{project}/runs/{uuid}/artifacts/tree", self.artifacts_tree)
+        r.add_get("/api/v1/{project}/runs/{uuid}/artifacts/file", self.artifacts_file)
+        r.add_post("/api/v1/{project}/runs/{uuid}/lineage", self.post_lineage)
+        r.add_get("/api/v1/{project}/runs/{uuid}/lineage", self.get_lineage)
+
+    # -- handlers ----------------------------------------------------------
+
+    async def healthz(self, request):
+        return _json({"status": "ok"})
+
+    async def list_projects(self, request):
+        return _json(self.store.list_projects())
+
+    async def create_project(self, request):
+        body = await request.json()
+        return _json(self.store.create_project(body["name"], body.get("description")), 201)
+
+    async def get_project(self, request):
+        p = self.store.get_project(request.match_info["project"])
+        return _json(p) if p else _not_found()
+
+    async def create_run(self, request):
+        project = request.match_info["project"]
+        body = await request.json()
+        run = self.store.create_run(
+            project,
+            spec=body.get("spec"),
+            name=body.get("name"),
+            kind=body.get("kind"),
+            inputs=body.get("inputs"),
+            meta=body.get("meta"),
+            tags=body.get("tags"),
+            pipeline_uuid=body.get("pipeline_uuid"),
+        )
+        self.new_run_event.set()
+        return _json(run, 201)
+
+    async def list_runs(self, request):
+        q = request.rel_url.query
+        return _json(self.store.list_runs(
+            project=request.match_info["project"],
+            status=q.get("status"),
+            pipeline_uuid=q.get("pipeline_uuid"),
+            limit=int(q.get("limit", 100)),
+            offset=int(q.get("offset", 0)),
+        ))
+
+    def _run(self, request) -> Optional[dict]:
+        return self.store.get_run(request.match_info["uuid"])
+
+    async def get_run(self, request):
+        run = self._run(request)
+        return _json(run) if run else _not_found()
+
+    async def delete_run(self, request):
+        ok = self.store.delete_run(request.match_info["uuid"])
+        return _json({"deleted": ok}, 200 if ok else 404)
+
+    async def post_status(self, request):
+        body = await request.json()
+        run, changed = self.store.transition(
+            request.match_info["uuid"], body["status"],
+            reason=body.get("reason"), message=body.get("message"),
+            force=bool(body.get("force")),
+        )
+        if run is None:
+            return _not_found()
+        return _json({"run": run, "changed": changed})
+
+    async def get_statuses(self, request):
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        return _json({"status": run["status"],
+                      "conditions": self.store.get_statuses(run["uuid"])})
+
+    async def post_outputs(self, request):
+        body = await request.json()
+        run = self.store.merge_outputs(request.match_info["uuid"], body)
+        return _json(run) if run else _not_found()
+
+    async def stop_run(self, request):
+        run, changed = self.store.transition(
+            request.match_info["uuid"], V1Statuses.STOPPING.value
+        )
+        if run is None:
+            return _not_found()
+        return _json({"run": run, "changed": changed})
+
+    async def restart_run(self, request):
+        """Clone-with-restart (upstream V1CloningKind.RESTART): new run, same
+        spec, original's artifacts path wired in via meta for resume."""
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        body = {}
+        try:
+            body = await request.json()
+        except Exception:
+            pass
+        meta = dict(run.get("meta") or {})
+        meta["resume_from"] = self.run_dir(run["project"], run["uuid"])
+        clone = self.store.create_run(
+            run["project"],
+            spec=body.get("spec") or run["spec"],
+            name=run["name"],
+            kind=run["kind"],
+            inputs=run["inputs"],
+            meta=meta,
+            tags=run["tags"],
+            original_uuid=run["uuid"],
+            cloning_kind="restart",
+        )
+        self.new_run_event.set()
+        return _json(clone, 201)
+
+    async def get_metrics(self, request):
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        rd = self.run_dir(run["project"], run["uuid"])
+        names = request.rel_url.query.get("names")
+        names = names.split(",") if names else list_event_names(rd, "metric")
+        out = {
+            n: [e.to_dict() for e in read_events(rd, "metric", n)] for n in names
+        }
+        return _json(out)
+
+    async def get_events(self, request):
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        kind = request.match_info["kind"]
+        rd = self.run_dir(run["project"], run["uuid"])
+        names = request.rel_url.query.get("names")
+        names = names.split(",") if names else list_event_names(rd, kind)
+        return _json({n: [e.to_dict() for e in read_events(rd, kind, n)] for n in names})
+
+    async def get_logs(self, request):
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        rd = self.run_dir(run["project"], run["uuid"])
+        logs_dir = os.path.join(rd, "logs")
+        offset = int(request.rel_url.query.get("offset", 0))
+        chunks = []
+        if os.path.isdir(logs_dir):
+            for f in sorted(os.listdir(logs_dir)):
+                with open(os.path.join(logs_dir, f), encoding="utf-8") as fh:
+                    chunks.append(fh.read())
+        text = "".join(chunks)
+        return web.Response(
+            text=text[offset:],
+            headers={"X-Log-Offset": str(len(text))},
+            content_type="text/plain",
+        )
+
+    def _safe_path(self, rd: str, rel: str) -> Optional[str]:
+        p = os.path.abspath(os.path.join(rd, rel))
+        if not (p + os.sep).startswith(os.path.abspath(rd) + os.sep) and p != os.path.abspath(rd):
+            return None
+        return p
+
+    async def artifacts_tree(self, request):
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        rd = self.run_dir(run["project"], run["uuid"])
+        rel = request.rel_url.query.get("path", "")
+        p = self._safe_path(rd, rel)
+        if p is None or not os.path.isdir(p):
+            return _not_found("no such dir")
+        files, dirs = [], []
+        for entry in sorted(os.listdir(p)):
+            full = os.path.join(p, entry)
+            if os.path.isdir(full):
+                dirs.append(entry)
+            else:
+                files.append({"name": entry, "size": os.path.getsize(full)})
+        return _json({"path": rel, "dirs": dirs, "files": files})
+
+    async def artifacts_file(self, request):
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        rd = self.run_dir(run["project"], run["uuid"])
+        rel = request.rel_url.query.get("path", "")
+        p = self._safe_path(rd, rel)
+        if p is None or not os.path.isfile(p):
+            return _not_found("no such file")
+        return web.FileResponse(p)
+
+    async def post_lineage(self, request):
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        body = await request.json()
+        self.store.add_lineage(run["uuid"], body)
+        return _json({"ok": True}, 201)
+
+    async def get_lineage(self, request):
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        return _json(self.store.get_lineage(run["uuid"]))

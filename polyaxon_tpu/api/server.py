@@ -1,0 +1,104 @@
+"""API server runner: standalone (``python -m polyaxon_tpu.api``) or
+embedded in-process for the local runtime and tests."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+from aiohttp import web
+
+from .app import ApiApp
+from .store import Store
+
+
+class ApiServer:
+    """Runs the aiohttp app on a background thread with its own event loop.
+
+    ``start()`` returns once the socket is bound; ``port=0`` picks a free
+    port (tests). The in-process scheduler can share ``self.store``.
+    """
+
+    def __init__(
+        self,
+        db_path: str = ":memory:",
+        artifacts_root: str = ".plx/artifacts",
+        host: str = "127.0.0.1",
+        port: int = 8000,
+    ):
+        self.store = Store(db_path)
+        self.api = ApiApp(self.store, artifacts_root)
+        self.host = host
+        self.port = port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._runner: Optional[web.AppRunner] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=15):
+            raise RuntimeError("API server failed to start")
+        return self
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _start():
+            self._runner = web.AppRunner(self.api.app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, self.host, self.port)
+            await site.start()
+            # resolve the actual port when 0 was requested
+            server = site._server
+            if server and server.sockets:
+                self.port = server.sockets[0].getsockname()[1]
+            self._started.set()
+
+        self._loop.run_until_complete(_start())
+        self._loop.run_forever()
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+
+        async def _cleanup():
+            if self._runner:
+                await self._runner.cleanup()
+
+        fut = asyncio.run_coroutine_threadsafe(_cleanup(), self._loop)
+        try:
+            fut.result(timeout=10)
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            if self._thread:
+                self._thread.join(timeout=10)
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser("polyaxon_tpu API server")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--db", default=".plx/db.sqlite")
+    p.add_argument("--artifacts-root", default=".plx/artifacts")
+    args = p.parse_args()
+    server = ApiServer(args.db, args.artifacts_root, args.host, args.port)
+    server.start()
+    print(f"polyaxon_tpu API listening on {server.url}")
+    try:
+        server._thread.join()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

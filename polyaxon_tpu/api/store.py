@@ -1,0 +1,312 @@
+"""SQLite-backed persistence for projects/runs/statuses (the API service
+DB — upstream used Django+Postgres, SURVEY.md §2 "API service"; SQLite is
+the local/agent deployment default and is WAL-mode safe across the API and
+scheduler threads)."""
+
+from __future__ import annotations
+
+import datetime
+import json
+import sqlite3
+import threading
+import uuid as uuid_mod
+from typing import Any, Optional
+
+from ..schemas.statuses import DONE_STATUSES, V1StatusCondition, V1Statuses, can_transition, is_done
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS projects (
+    name TEXT PRIMARY KEY,
+    description TEXT,
+    created_at TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS runs (
+    uuid TEXT PRIMARY KEY,
+    project TEXT NOT NULL,
+    name TEXT,
+    kind TEXT,
+    status TEXT NOT NULL,
+    spec TEXT,
+    compiled TEXT,
+    inputs TEXT,
+    outputs TEXT,
+    meta TEXT,
+    tags TEXT,
+    original_uuid TEXT,
+    cloning_kind TEXT,
+    pipeline_uuid TEXT,
+    created_at TEXT NOT NULL,
+    updated_at TEXT NOT NULL,
+    started_at TEXT,
+    finished_at TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_runs_project ON runs (project, created_at);
+CREATE INDEX IF NOT EXISTS idx_runs_status ON runs (status);
+CREATE INDEX IF NOT EXISTS idx_runs_pipeline ON runs (pipeline_uuid);
+CREATE TABLE IF NOT EXISTS status_conditions (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_uuid TEXT NOT NULL,
+    condition TEXT NOT NULL,
+    created_at TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_conditions_run ON status_conditions (run_uuid);
+CREATE TABLE IF NOT EXISTS lineage (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    run_uuid TEXT NOT NULL,
+    name TEXT,
+    artifact TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_lineage_run ON lineage (run_uuid);
+"""
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+class Store:
+    """Thread-safe SQLite store. One connection per thread (sqlite3
+    check_same_thread), WAL so readers never block the writer."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._local = threading.local()
+        # serializes status transitions (read-check-insert-update must be
+        # atomic across the agent/executor/API threads)
+        self._transition_lock = threading.Lock()
+        self._memory_conn: Optional[sqlite3.Connection] = None
+        if path == ":memory:":
+            # a single shared connection (serialized by a lock)
+            self._memory_conn = sqlite3.connect(":memory:", check_same_thread=False)
+            self._memory_lock = threading.Lock()
+        with self._conn_ctx() as conn:
+            conn.executescript(_SCHEMA)
+
+    # -- connection plumbing ----------------------------------------------
+
+    def _conn_ctx(self):
+        store = self
+
+        class _Ctx:
+            def __enter__(self):
+                if store._memory_conn is not None:
+                    store._memory_lock.acquire()
+                    return store._memory_conn
+                conn = getattr(store._local, "conn", None)
+                if conn is None:
+                    conn = sqlite3.connect(store.path, timeout=30)
+                    conn.execute("PRAGMA journal_mode=WAL")
+                    conn.execute("PRAGMA synchronous=NORMAL")
+                    store._local.conn = conn
+                return conn
+
+            def __exit__(self, et, ev, tb):
+                if store._memory_conn is not None:
+                    if et is None:
+                        store._memory_conn.commit()
+                    store._memory_lock.release()
+                else:
+                    if et is None:
+                        store._local.conn.commit()
+
+        return _Ctx()
+
+    # -- projects ----------------------------------------------------------
+
+    def create_project(self, name: str, description: Optional[str] = None) -> dict:
+        with self._conn_ctx() as conn:
+            conn.execute(
+                "INSERT OR IGNORE INTO projects (name, description, created_at) VALUES (?,?,?)",
+                (name, description, _now()),
+            )
+        return self.get_project(name)
+
+    def get_project(self, name: str) -> Optional[dict]:
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                "SELECT name, description, created_at FROM projects WHERE name=?", (name,)
+            ).fetchone()
+        if not row:
+            return None
+        return {"name": row[0], "description": row[1], "created_at": row[2]}
+
+    def list_projects(self) -> list[dict]:
+        with self._conn_ctx() as conn:
+            rows = conn.execute(
+                "SELECT name, description, created_at FROM projects ORDER BY name"
+            ).fetchall()
+        return [{"name": r[0], "description": r[1], "created_at": r[2]} for r in rows]
+
+    # -- runs --------------------------------------------------------------
+
+    _RUN_COLS = (
+        "uuid", "project", "name", "kind", "status", "spec", "compiled",
+        "inputs", "outputs", "meta", "tags", "original_uuid", "cloning_kind",
+        "pipeline_uuid", "created_at", "updated_at", "started_at", "finished_at",
+    )
+    _JSON_COLS = {"spec", "compiled", "inputs", "outputs", "meta", "tags"}
+
+    def _row_to_run(self, row) -> dict:
+        d = dict(zip(self._RUN_COLS, row))
+        for c in self._JSON_COLS:
+            d[c] = json.loads(d[c]) if d[c] else None
+        return d
+
+    def create_run(
+        self,
+        project: str,
+        spec: Optional[dict] = None,
+        name: Optional[str] = None,
+        kind: Optional[str] = None,
+        inputs: Optional[dict] = None,
+        meta: Optional[dict] = None,
+        tags: Optional[list] = None,
+        uuid: Optional[str] = None,
+        original_uuid: Optional[str] = None,
+        cloning_kind: Optional[str] = None,
+        pipeline_uuid: Optional[str] = None,
+    ) -> dict:
+        self.create_project(project)
+        run_uuid = uuid or uuid_mod.uuid4().hex
+        now = _now()
+        with self._conn_ctx() as conn:
+            conn.execute(
+                "INSERT INTO runs (uuid, project, name, kind, status, spec, inputs, meta, tags,"
+                " original_uuid, cloning_kind, pipeline_uuid, created_at, updated_at)"
+                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    run_uuid, project, name, kind, V1Statuses.CREATED.value,
+                    json.dumps(spec) if spec else None,
+                    json.dumps(inputs) if inputs else None,
+                    json.dumps(meta) if meta else None,
+                    json.dumps(tags) if tags else None,
+                    original_uuid, cloning_kind, pipeline_uuid, now, now,
+                ),
+            )
+            conn.execute(
+                "INSERT INTO status_conditions (run_uuid, condition, created_at) VALUES (?,?,?)",
+                (run_uuid,
+                 json.dumps(V1StatusCondition.get_condition(V1Statuses.CREATED).to_dict()),
+                 now),
+            )
+        return self.get_run(run_uuid)
+
+    def get_run(self, uuid: str) -> Optional[dict]:
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                f"SELECT {','.join(self._RUN_COLS)} FROM runs WHERE uuid=?", (uuid,)
+            ).fetchone()
+        return self._row_to_run(row) if row else None
+
+    def list_runs(
+        self,
+        project: Optional[str] = None,
+        status: Optional[str] = None,
+        pipeline_uuid: Optional[str] = None,
+        limit: int = 100,
+        offset: int = 0,
+    ) -> list[dict]:
+        q = f"SELECT {','.join(self._RUN_COLS)} FROM runs WHERE 1=1"
+        args: list = []
+        if project:
+            q += " AND project=?"
+            args.append(project)
+        if status:
+            q += " AND status=?"
+            args.append(status)
+        if pipeline_uuid:
+            q += " AND pipeline_uuid=?"
+            args.append(pipeline_uuid)
+        q += " ORDER BY created_at DESC LIMIT ? OFFSET ?"
+        args += [limit, offset]
+        with self._conn_ctx() as conn:
+            rows = conn.execute(q, args).fetchall()
+        return [self._row_to_run(r) for r in rows]
+
+    def update_run(self, uuid: str, **fields: Any) -> Optional[dict]:
+        sets, args = [], []
+        for k, v in fields.items():
+            if k not in self._RUN_COLS or k == "uuid":
+                raise ValueError(f"bad run field {k!r}")
+            if k in self._JSON_COLS and v is not None and not isinstance(v, str):
+                v = json.dumps(v)
+            sets.append(f"{k}=?")
+            args.append(v)
+        sets.append("updated_at=?")
+        args.append(_now())
+        args.append(uuid)
+        with self._conn_ctx() as conn:
+            conn.execute(f"UPDATE runs SET {','.join(sets)} WHERE uuid=?", args)
+        return self.get_run(uuid)
+
+    def merge_outputs(self, uuid: str, outputs: dict) -> Optional[dict]:
+        run = self.get_run(uuid)
+        if run is None:
+            return None
+        merged = dict(run.get("outputs") or {})
+        merged.update(outputs)
+        return self.update_run(uuid, outputs=merged)
+
+    def delete_run(self, uuid: str) -> bool:
+        with self._conn_ctx() as conn:
+            cur = conn.execute("DELETE FROM runs WHERE uuid=?", (uuid,))
+            conn.execute("DELETE FROM status_conditions WHERE run_uuid=?", (uuid,))
+            conn.execute("DELETE FROM lineage WHERE run_uuid=?", (uuid,))
+        return cur.rowcount > 0
+
+    # -- statuses ----------------------------------------------------------
+
+    def transition(
+        self, uuid: str, status: str, reason: Optional[str] = None,
+        message: Optional[str] = None, force: bool = False,
+    ) -> tuple[Optional[dict], bool]:
+        """Apply a status transition if legal. Returns (run, changed).
+        Atomic: the check + condition insert + status update hold one lock so
+        concurrent writers (agent vs executor threads) cannot interleave —
+        e.g. a late 'failed' from a killed process must not overwrite
+        'stopped'."""
+        with self._transition_lock:
+            run = self.get_run(uuid)
+            if run is None:
+                return None, False
+            src = V1Statuses(run["status"])
+            dst = V1Statuses(status)
+            if (not force or src in DONE_STATUSES) and not can_transition(src, dst):
+                return run, False
+            cond = V1StatusCondition.get_condition(dst, reason=reason, message=message)
+            now = _now()
+            fields: dict[str, Any] = {"status": dst.value}
+            if dst == V1Statuses.RUNNING and not run.get("started_at"):
+                fields["started_at"] = now
+            if is_done(dst):
+                fields["finished_at"] = now
+            with self._conn_ctx() as conn:
+                conn.execute(
+                    "INSERT INTO status_conditions (run_uuid, condition, created_at) VALUES (?,?,?)",
+                    (uuid, json.dumps(cond.to_dict()), now),
+                )
+            return self.update_run(uuid, **fields), True
+
+    def get_statuses(self, uuid: str) -> list[dict]:
+        with self._conn_ctx() as conn:
+            rows = conn.execute(
+                "SELECT condition FROM status_conditions WHERE run_uuid=? ORDER BY id",
+                (uuid,),
+            ).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    # -- lineage -----------------------------------------------------------
+
+    def add_lineage(self, uuid: str, artifact: dict) -> None:
+        with self._conn_ctx() as conn:
+            conn.execute(
+                "INSERT INTO lineage (run_uuid, name, artifact) VALUES (?,?,?)",
+                (uuid, artifact.get("name"), json.dumps(artifact)),
+            )
+
+    def get_lineage(self, uuid: str) -> list[dict]:
+        with self._conn_ctx() as conn:
+            rows = conn.execute(
+                "SELECT artifact FROM lineage WHERE run_uuid=? ORDER BY id", (uuid,)
+            ).fetchall()
+        return [json.loads(r[0]) for r in rows]

@@ -1,0 +1,3 @@
+"""CLI command tree (upstream `polyaxon` CLI — SURVEY.md §2 "CLI" row)."""
+
+from .main import cli, main

@@ -1,0 +1,404 @@
+"""CLI — the `polyaxon` command tree (upstream `cli/` — SURVEY.md §2 "CLI"
+row; §3(a)/(e) call stacks).
+
+Two execution modes:
+- **local** (default when no host configured): an embedded store + agent in
+  ``./.plx`` runs the operation on this machine — the SURVEY.md §7 stage-2
+  "minimum e2e slice".
+- **remote**: with ``--host`` (or `config set --host`), operations POST to a
+  deployed API; `polyaxon server` runs that API + agent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+import click
+
+CONFIG_DIR = os.path.expanduser("~/.polyaxon_tpu")
+CONFIG_FILE = os.path.join(CONFIG_DIR, "config.json")
+
+
+def load_config() -> dict:
+    if os.path.exists(CONFIG_FILE):
+        with open(CONFIG_FILE, encoding="utf-8") as f:
+            return json.load(f)
+    return {}
+
+
+def save_config(cfg: dict) -> None:
+    os.makedirs(CONFIG_DIR, exist_ok=True)
+    with open(CONFIG_FILE, "w", encoding="utf-8") as f:
+        json.dump(cfg, f, indent=2)
+
+
+def get_host(explicit: Optional[str]) -> Optional[str]:
+    return explicit or os.environ.get("PLX_API_HOST") or load_config().get("host")
+
+
+def _local_stack(data_dir: str = ".plx"):
+    """Embedded store + agent for hostless local runs."""
+    from ..api.store import Store
+    from ..scheduler.agent import LocalAgent
+
+    os.makedirs(data_dir, exist_ok=True)
+    store = Store(os.path.join(data_dir, "db.sqlite"))
+    agent = LocalAgent(store, artifacts_root=os.path.join(data_dir, "artifacts"))
+    return store, agent
+
+
+@click.group()
+@click.version_option("0.1.0", prog_name="polyaxon_tpu")
+def cli():
+    """polyaxon_tpu: TPU-native ML orchestration + training."""
+
+
+# -- run --------------------------------------------------------------------
+
+
+@cli.command()
+@click.option("-f", "--file", "files", multiple=True, required=True,
+              type=click.Path(exists=True), help="polyaxonfile(s), merged in order")
+@click.option("-P", "--param", "params", multiple=True, help="name=value param binding")
+@click.option("--set", "set_overrides", multiple=True, help="dotted.path=value override")
+@click.option("--preset", "presets", multiple=True, type=click.Path(exists=True))
+@click.option("--project", "-p", default=None)
+@click.option("--name", default=None)
+@click.option("--host", default=None)
+@click.option("--local", is_flag=True, help="run on this machine (embedded agent)")
+@click.option("--watch/--no-watch", default=True, help="wait and stream status")
+@click.option("--data-dir", default=".plx", help="local mode state dir")
+def run(files, params, set_overrides, presets, project, name, host, local, watch, data_dir):
+    """Run a polyaxonfile (upstream `polyaxon run -f ...`)."""
+    import yaml
+
+    from ..polyaxonfile import check_polyaxonfile
+
+    parsed_params = {}
+    for p in params:
+        if "=" not in p:
+            raise click.BadParameter(f"-P expects name=value, got {p!r}")
+        k, _, v = p.partition("=")
+        parsed_params[k] = yaml.safe_load(v)
+
+    op = check_polyaxonfile(
+        list(files), params=parsed_params, presets=list(presets) or None,
+        set_overrides=list(set_overrides) or None,
+    )
+    if name:
+        op.name = name
+    project = project or load_config().get("project", "default")
+    host = get_host(host)
+
+    if host and not local:
+        from ..client import RunClient
+
+        rc = RunClient(host, project=project)
+        run_data = rc.create(operation=op)
+        click.echo(f"Run {run_data['uuid']} created ({run_data['status']})")
+        if watch:
+            final = rc.wait(timeout=24 * 3600)
+            click.echo(f"Run {final['uuid']} finished: {final['status']}")
+            if final.get("outputs"):
+                click.echo(json.dumps(final["outputs"], indent=2))
+            sys.exit(0 if final["status"] == "succeeded" else 1)
+        return
+
+    # local embedded mode
+    store, agent = _local_stack(data_dir)
+    agent.start()
+    run_row = store.create_run(project, spec=op.to_dict(), name=op.name or name)
+    click.echo(f"Run {run_row['uuid']} created (local)")
+    if not watch:
+        click.echo("agent running in this process only with --watch; "
+                   "use `polyaxon server` for a persistent agent")
+        return
+    from ..schemas.statuses import is_done
+
+    last_status = None
+    try:
+        while True:
+            row = store.get_run(run_row["uuid"])
+            if row["status"] != last_status:
+                click.echo(f"  status: {row['status']}")
+                last_status = row["status"]
+            if is_done(row["status"]):
+                break
+            time.sleep(0.3)
+    finally:
+        agent.stop()
+    if row.get("outputs"):
+        click.echo(json.dumps(row["outputs"], indent=2))
+    art_dir = os.path.join(data_dir, "artifacts", project, row["uuid"])
+    click.echo(f"artifacts: {art_dir}")
+    sys.exit(0 if row["status"] == "succeeded" else 1)
+
+
+# -- check ------------------------------------------------------------------
+
+
+@cli.command()
+@click.option("-f", "--file", "files", multiple=True, required=True, type=click.Path(exists=True))
+@click.option("-P", "--param", "params", multiple=True)
+@click.option("--set", "set_overrides", multiple=True)
+def check(files, params, set_overrides):
+    """Validate a polyaxonfile and print the compiled operation."""
+    import yaml
+
+    from ..compiler import compile_operation
+    from ..polyaxonfile import check_polyaxonfile
+
+    parsed = {}
+    for p in params:
+        k, _, v = p.partition("=")
+        parsed[k] = yaml.safe_load(v)
+    op = check_polyaxonfile(list(files), params=parsed,
+                            set_overrides=list(set_overrides) or None)
+    compiled = compile_operation(op) if op.has_component() else None
+    click.echo(yaml.safe_dump(compiled.to_dict() if compiled else op.to_dict(),
+                              sort_keys=False))
+
+
+# -- ops --------------------------------------------------------------------
+
+
+def _ops_client(host, project):
+    host = get_host(host)
+    project = project or load_config().get("project", "default")
+    if host:
+        from ..client import RunClient
+
+        return RunClient(host, project=project), None
+    from ..api.app import run_artifacts_dir
+    from ..api.store import Store
+
+    store = Store(os.path.join(".plx", "db.sqlite"))
+    return None, (store, project)
+
+
+@cli.group()
+def ops():
+    """Inspect and manage runs."""
+
+
+@ops.command("ls")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+@click.option("--status", default=None)
+@click.option("--limit", default=20)
+def ops_ls(project, host, status, limit):
+    rc, local = _ops_client(host, project)
+    runs = rc.list(status=status, limit=limit) if rc else \
+        local[0].list_runs(project=local[1], status=status, limit=limit)
+    for r in runs:
+        click.echo(f"{r['uuid']}  {r['status']:<12} {r.get('kind') or '-':<10} {r.get('name') or ''}")
+
+
+@ops.command("get")
+@click.argument("uuid")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+def ops_get(uuid, project, host):
+    rc, local = _ops_client(host, project)
+    row = rc.refresh(uuid) if rc else local[0].get_run(uuid)
+    if not row:
+        raise click.ClickException("run not found")
+    click.echo(json.dumps(row, indent=2))
+
+
+@ops.command("logs")
+@click.argument("uuid")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+@click.option("--follow", is_flag=True)
+def ops_logs(uuid, project, host, follow):
+    rc, local = _ops_client(host, project)
+    if rc:
+        offset = 0
+        while True:
+            text, offset2 = rc.get_logs(offset=offset, uuid=uuid)
+            if text:
+                click.echo(text, nl=False)
+            offset = offset2
+            run = rc.refresh(uuid)
+            from ..schemas.statuses import is_done
+
+            if not follow or is_done(run["status"]):
+                break
+            time.sleep(1)
+    else:
+        store, project = local
+        run = store.get_run(uuid)
+        if not run:
+            raise click.ClickException("run not found")
+        logs_dir = os.path.join(".plx", "artifacts", run["project"], uuid, "logs")
+        if os.path.isdir(logs_dir):
+            for f in sorted(os.listdir(logs_dir)):
+                click.echo(open(os.path.join(logs_dir, f), encoding="utf-8").read(), nl=False)
+
+
+@ops.command("metrics")
+@click.argument("uuid")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+@click.option("--names", default=None)
+def ops_metrics(uuid, project, host, names):
+    rc, local = _ops_client(host, project)
+    names_l = names.split(",") if names else None
+    if rc:
+        data = rc.get_metrics(names_l, uuid=uuid)
+    else:
+        from ..tracking import list_event_names, read_events
+
+        store, project = local
+        run = store.get_run(uuid)
+        if not run:
+            raise click.ClickException("run not found")
+        rd = os.path.join(".plx", "artifacts", run["project"], uuid)
+        names_l = names_l or list_event_names(rd, "metric")
+        data = {n: [e.to_dict() for e in read_events(rd, "metric", n)] for n in names_l}
+    click.echo(json.dumps(data, indent=2))
+
+
+@ops.command("stop")
+@click.argument("uuid")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+def ops_stop(uuid, project, host):
+    rc, local = _ops_client(host, project)
+    if rc:
+        rc.stop(uuid)
+    else:
+        local[0].transition(uuid, "stopping")
+    click.echo("stopping")
+
+
+@ops.command("restart")
+@click.argument("uuid")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+def ops_restart(uuid, project, host):
+    rc, local = _ops_client(host, project)
+    if rc:
+        clone = rc.restart(uuid)
+    else:
+        raise click.ClickException("restart requires a server (use `polyaxon server`)")
+    click.echo(f"restarted as {clone['uuid']}")
+
+
+@ops.command("delete")
+@click.argument("uuid")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+def ops_delete(uuid, project, host):
+    rc, local = _ops_client(host, project)
+    if rc:
+        rc.delete(uuid)
+    else:
+        local[0].delete_run(uuid)
+    click.echo("deleted")
+
+
+# -- project ----------------------------------------------------------------
+
+
+@cli.group()
+def project():
+    """Manage projects."""
+
+
+@project.command("create")
+@click.argument("name")
+@click.option("--description", default=None)
+@click.option("--host", default=None)
+def project_create(name, description, host):
+    h = get_host(host)
+    if h:
+        from ..client import ProjectClient
+
+        ProjectClient(h).create(name, description)
+    else:
+        from ..api.store import Store
+
+        Store(os.path.join(".plx", "db.sqlite")).create_project(name, description)
+    click.echo(f"project {name} created")
+
+
+@project.command("ls")
+@click.option("--host", default=None)
+def project_ls(host):
+    h = get_host(host)
+    if h:
+        from ..client import ProjectClient
+
+        rows = ProjectClient(h).list()
+    else:
+        from ..api.store import Store
+
+        rows = Store(os.path.join(".plx", "db.sqlite")).list_projects()
+    for r in rows:
+        click.echo(r["name"])
+
+
+# -- config / server --------------------------------------------------------
+
+
+@cli.command("config")
+@click.option("--host", default=None)
+@click.option("--project", default=None)
+@click.option("--show", is_flag=True)
+def config_cmd(host, project, show):
+    cfg = load_config()
+    if show or (host is None and project is None):
+        click.echo(json.dumps(cfg, indent=2))
+        return
+    if host is not None:
+        cfg["host"] = host
+    if project is not None:
+        cfg["project"] = project
+    save_config(cfg)
+    click.echo("config saved")
+
+
+@cli.command()
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=8000)
+@click.option("--data-dir", default=".plx")
+@click.option("--max-parallel", default=4)
+def server(host, port, data_dir, max_parallel):
+    """Start the API server + scheduling agent (one process)."""
+    from ..api.server import ApiServer
+    from ..scheduler.agent import LocalAgent
+
+    os.makedirs(data_dir, exist_ok=True)
+    srv = ApiServer(
+        db_path=os.path.join(data_dir, "db.sqlite"),
+        artifacts_root=os.path.join(data_dir, "artifacts"),
+        host=host, port=port,
+    )
+    srv.start()
+    agent = LocalAgent(
+        srv.store, artifacts_root=os.path.join(data_dir, "artifacts"),
+        api_host=srv.url, max_parallel=max_parallel,
+    )
+    agent.start()
+    click.echo(f"polyaxon_tpu server on {srv.url} (agent: {max_parallel} parallel)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        agent.stop()
+        srv.stop()
+
+
+def main():
+    cli()
+
+
+if __name__ == "__main__":
+    main()

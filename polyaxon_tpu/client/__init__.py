@@ -1,0 +1,3 @@
+"""API clients (upstream RunClient/ProjectClient equivalents)."""
+
+from .client import ApiError, BaseClient, ProjectClient, RunClient

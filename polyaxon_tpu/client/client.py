@@ -1,0 +1,168 @@
+"""HTTP clients for the API (upstream ``RunClient``/``ProjectClient``,
+SURVEY.md §2 "Client" row — hand-written against our REST surface instead
+of OpenAPI-generated)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import requests
+
+from ..schemas.operation import V1Operation
+from ..schemas.statuses import V1Statuses, is_done
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"API error {status}: {message}")
+        self.status = status
+
+
+class BaseClient:
+    def __init__(self, host: str = "http://127.0.0.1:8000", timeout: float = 30.0):
+        self.host = host.rstrip("/")
+        self.timeout = timeout
+        self._session = requests.Session()
+
+    def _req(self, method: str, path: str, **kwargs: Any):
+        url = f"{self.host}{path}"
+        resp = self._session.request(method, url, timeout=self.timeout, **kwargs)
+        if resp.status_code >= 400:
+            raise ApiError(resp.status_code, resp.text[:500])
+        return resp
+
+    def _json(self, method: str, path: str, **kwargs: Any):
+        return self._req(method, path, **kwargs).json()
+
+
+class ProjectClient(BaseClient):
+    def create(self, name: str, description: Optional[str] = None) -> dict:
+        return self._json("POST", "/api/v1/projects",
+                          json={"name": name, "description": description})
+
+    def get(self, name: str) -> dict:
+        return self._json("GET", f"/api/v1/projects/{name}")
+
+    def list(self) -> list[dict]:
+        return self._json("GET", "/api/v1/projects")
+
+
+class RunClient(BaseClient):
+    """Operations on runs; binds (project, run_uuid) like upstream."""
+
+    def __init__(
+        self,
+        host: str = "http://127.0.0.1:8000",
+        project: str = "default",
+        run_uuid: Optional[str] = None,
+        timeout: float = 30.0,
+    ):
+        super().__init__(host, timeout)
+        self.project = project
+        self.run_uuid = run_uuid
+
+    def _rpath(self, suffix: str = "", uuid: Optional[str] = None) -> str:
+        uuid = uuid or self.run_uuid
+        assert uuid, "run_uuid not set"
+        return f"/api/v1/{self.project}/runs/{uuid}{suffix}"
+
+    # -- create / read -----------------------------------------------------
+
+    def create(
+        self,
+        operation: Optional[V1Operation] = None,
+        spec: Optional[dict] = None,
+        name: Optional[str] = None,
+        kind: Optional[str] = None,
+        inputs: Optional[dict] = None,
+        meta: Optional[dict] = None,
+        tags: Optional[list] = None,
+        pipeline_uuid: Optional[str] = None,
+    ) -> dict:
+        if operation is not None:
+            spec = operation.to_dict()
+            name = name or operation.name
+        run = self._json("POST", f"/api/v1/{self.project}/runs", json={
+            "spec": spec, "name": name, "kind": kind, "inputs": inputs,
+            "meta": meta, "tags": tags, "pipeline_uuid": pipeline_uuid,
+        })
+        self.run_uuid = run["uuid"]
+        return run
+
+    def refresh(self, uuid: Optional[str] = None) -> dict:
+        return self._json("GET", self._rpath(uuid=uuid))
+
+    def list(self, status: Optional[str] = None, pipeline_uuid: Optional[str] = None,
+             limit: int = 100, offset: int = 0) -> list[dict]:
+        params = {"limit": limit, "offset": offset}
+        if status:
+            params["status"] = status
+        if pipeline_uuid:
+            params["pipeline_uuid"] = pipeline_uuid
+        return self._json("GET", f"/api/v1/{self.project}/runs", params=params)
+
+    def delete(self, uuid: Optional[str] = None) -> dict:
+        return self._json("DELETE", self._rpath(uuid=uuid))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def log_status(self, status: str, reason: Optional[str] = None,
+                   message: Optional[str] = None, force: bool = False) -> dict:
+        return self._json("POST", self._rpath("/statuses"), json={
+            "status": status, "reason": reason, "message": message, "force": force,
+        })
+
+    def get_statuses(self, uuid: Optional[str] = None) -> dict:
+        return self._json("GET", self._rpath("/statuses", uuid=uuid))
+
+    def stop(self, uuid: Optional[str] = None) -> dict:
+        return self._json("POST", self._rpath("/stop", uuid=uuid))
+
+    def restart(self, uuid: Optional[str] = None, spec: Optional[dict] = None) -> dict:
+        return self._json("POST", self._rpath("/restart", uuid=uuid),
+                          json={"spec": spec} if spec else {})
+
+    def wait(self, uuid: Optional[str] = None, timeout: float = 300.0,
+             poll: float = 0.25) -> dict:
+        """Block until the run reaches a terminal status."""
+        deadline = time.monotonic() + timeout
+        while True:
+            run = self.refresh(uuid)
+            if is_done(run["status"]):
+                return run
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"run {run['uuid']} still {run['status']}")
+            time.sleep(poll)
+
+    # -- data --------------------------------------------------------------
+
+    def log_outputs(self, uuid: Optional[str] = None, **outputs: Any) -> dict:
+        return self._json("POST", self._rpath("/outputs", uuid=uuid), json=outputs)
+
+    def get_metrics(self, names: Optional[list[str]] = None,
+                    uuid: Optional[str] = None) -> dict:
+        params = {"names": ",".join(names)} if names else {}
+        return self._json("GET", self._rpath("/metrics", uuid=uuid), params=params)
+
+    def get_logs(self, offset: int = 0, uuid: Optional[str] = None) -> tuple[str, int]:
+        resp = self._req("GET", self._rpath("/logs", uuid=uuid), params={"offset": offset})
+        return resp.text, int(resp.headers.get("X-Log-Offset", 0))
+
+    def artifacts_tree(self, path: str = "", uuid: Optional[str] = None) -> dict:
+        return self._json("GET", self._rpath("/artifacts/tree", uuid=uuid),
+                          params={"path": path})
+
+    def download_artifact(self, path: str, dest: str, uuid: Optional[str] = None) -> str:
+        resp = self._req("GET", self._rpath("/artifacts/file", uuid=uuid),
+                         params={"path": path})
+        with open(dest, "wb") as f:
+            f.write(resp.content)
+        return dest
+
+    def log_artifact_lineage(self, artifact: Any, uuid: Optional[str] = None) -> dict:
+        body = artifact.to_dict() if hasattr(artifact, "to_dict") else dict(artifact)
+        return self._json("POST", self._rpath("/lineage", uuid=uuid), json=body)
+
+    def get_lineage(self, uuid: Optional[str] = None) -> list[dict]:
+        return self._json("GET", self._rpath("/lineage", uuid=uuid))

@@ -1,0 +1,6 @@
+"""Compiler: V1Operation -> V1CompiledOperation -> executable payloads
+(upstream haupt compiler/polypod — SURVEY.md §2 "Compiler" row)."""
+
+from .contexts import build_context, context_env, render_template, render_value, resolve_params
+from .converter import LocalPayload, to_k8s_resources, to_local_payload
+from .resolver import ResolvedRun, compile_operation, resolve

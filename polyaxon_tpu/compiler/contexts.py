@@ -1,0 +1,100 @@
+"""Context resolution + templating (upstream compiler ``resolve()``:
+contexts/params/connections — SURVEY.md §2 "Compiler" row).
+
+A resolved run exposes a context tree to jinja templates in container
+cmd/args/env:
+
+    {{ params.lr }}            bound param values
+    {{ globals.run_artifacts_path }}, {{ globals.run_outputs_path }},
+    {{ globals.uuid }}, {{ globals.project_name }}, {{ globals.name }}
+    {{ connections.<name>.path }}  (mounted connection info)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import jinja2
+
+from ..schemas.io import V1IO, V1Param, validate_params_against_io
+from ..schemas.operation import V1CompiledOperation
+
+_env = jinja2.Environment(undefined=jinja2.StrictUndefined)
+
+
+def render_template(text: str, context: dict[str, Any]) -> str:
+    if "{{" not in text and "{%" not in text:
+        return text
+    return _env.from_string(text).render(**context)
+
+
+def render_value(value: Any, context: dict[str, Any]) -> Any:
+    if isinstance(value, str):
+        return render_template(value, context)
+    if isinstance(value, list):
+        return [render_value(v, context) for v in value]
+    if isinstance(value, dict):
+        return {k: render_value(v, context) for k, v in value.items()}
+    return value
+
+
+def resolve_params(compiled: V1CompiledOperation) -> dict[str, Any]:
+    """Validate params against IO, apply input defaults, return plain values."""
+    params = compiled.params or {}
+    validate_params_against_io(compiled.inputs, compiled.outputs, params)
+    values: dict[str, Any] = {}
+    for io in compiled.inputs or []:
+        if io.name in params:
+            values[io.name] = params[io.name].value
+        elif io.value is not None:
+            values[io.name] = io.value
+        elif not io.is_optional:
+            raise ValueError(f"Missing required input '{io.name}'")
+    # params not declared as inputs still flow through
+    for name, p in params.items():
+        values.setdefault(name, p.value)
+    return values
+
+
+def build_context(
+    compiled: V1CompiledOperation,
+    run_uuid: str,
+    project: str,
+    artifacts_path: str,
+    api_host: Optional[str] = None,
+    extra: Optional[dict[str, Any]] = None,
+) -> dict[str, Any]:
+    params = resolve_params(compiled)
+    ctx: dict[str, Any] = {
+        "globals": {
+            "uuid": run_uuid,
+            "name": compiled.name,
+            "project_name": project,
+            "run_artifacts_path": artifacts_path,
+            "run_outputs_path": f"{artifacts_path}/outputs",
+            "api_host": api_host or "",
+        },
+        "params": params,
+        # flat access too: {{ lr }} — upstream allows both
+        **params,
+    }
+    if extra:
+        ctx.update(extra)
+    return ctx
+
+
+def context_env(ctx: dict[str, Any]) -> dict[str, str]:
+    """The PLX_* env block every run container gets (tracking attaches via
+    these — tracking/run.py env contract)."""
+    g = ctx["globals"]
+    env = {
+        "PLX_RUN_UUID": g["uuid"],
+        "PLX_PROJECT": g["project_name"],
+        "PLX_ARTIFACTS_PATH": g["run_artifacts_path"],
+    }
+    if g.get("api_host"):
+        env["PLX_API_HOST"] = g["api_host"]
+    if ctx.get("params"):
+        env["PLX_PARAMS"] = json.dumps(ctx["params"])
+    return env

@@ -1,0 +1,271 @@
+"""Converters: resolved operation -> executable payloads.
+
+Two backends (upstream rendered K8s podspecs only — SURVEY.md §2
+"Compiler" row; we render both):
+
+- ``LocalPayload``: argv/env/workdir for the subprocess executor
+  (runtime/local.py) — the in-proc "fake cluster" test path SURVEY.md §4
+  prescribes.
+- K8s manifests (``to_k8s_resources``): one pod per TPU-VM host with
+  ``google.com/tpu`` resources, ``gke-tpu-*`` nodeSelectors and
+  jax.distributed rendezvous env — the TPU replacement for NCCL env
+  injection (north star; SURVEY.md §2 absent-components table).
+"""
+
+from __future__ import annotations
+
+import shlex
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..parallel.distributed import rendezvous_env
+from ..schemas.k8s import V1Container
+from ..schemas.operation import V1CompiledOperation
+from ..schemas.run import V1RunKind, V1TPUJob
+from ..schemas.tpu import SliceTopology
+from .contexts import context_env, render_value
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass
+class LocalPayload:
+    """What the local subprocess executor needs to run one container."""
+
+    run_uuid: str
+    project: str
+    argv: list[str]
+    env: dict[str, str]
+    workdir: Optional[str] = None
+    artifacts_path: str = ""
+    init: list[dict] = field(default_factory=list)
+    builtin: Optional[dict] = None  # `runtime:` shortcut -> in-proc Trainer
+    max_retries: int = 0
+    timeout: Optional[float] = None
+
+
+def _container_argv(container: Optional[V1Container], ctx: dict) -> list[str]:
+    if container is None:
+        return []
+    cmd = container.command or []
+    if isinstance(cmd, str):
+        cmd = shlex.split(cmd)
+    args = container.args or []
+    if isinstance(args, str):
+        args = shlex.split(args)
+    argv = [str(render_value(c, ctx)) for c in cmd] + [str(render_value(a, ctx)) for a in args]
+    return argv
+
+
+def _container_env(container: Optional[V1Container], ctx: dict) -> dict[str, str]:
+    env: dict[str, str] = {}
+    if container and container.env:
+        for e in container.env:
+            if e.value is not None:
+                env[e.name] = str(render_value(e.value, ctx))
+    return env
+
+
+def get_main_container(compiled: V1CompiledOperation) -> Optional[V1Container]:
+    run = compiled.run
+    return getattr(run, "container", None)
+
+
+def to_local_payload(
+    compiled: V1CompiledOperation,
+    ctx: dict,
+    run_uuid: str,
+    project: str,
+) -> LocalPayload:
+    run = compiled.run
+    container = get_main_container(compiled)
+    argv = _container_argv(container, ctx)
+    env = {**context_env(ctx), **_container_env(container, ctx)}
+    init_steps = []
+    for i in getattr(run, "init", None) or []:
+        init_steps.append(render_value(i.to_dict(), ctx))
+    builtin = None
+    if isinstance(run, V1TPUJob) and run.runtime:
+        builtin = dict(render_value(run.runtime, ctx))
+        if run.parallelism:
+            builtin.setdefault("parallelism", run.parallelism.to_dict())
+    term = compiled.termination
+    return LocalPayload(
+        run_uuid=run_uuid,
+        project=project,
+        argv=argv,
+        env=env,
+        workdir=container.working_dir if container else None,
+        artifacts_path=ctx["globals"]["run_artifacts_path"],
+        init=init_steps,
+        builtin=builtin,
+        max_retries=(term.max_retries if term and term.max_retries else 0),
+        timeout=(term.timeout if term and term.timeout else None),
+    )
+
+
+# ---------------------------------------------------------------------------
+# K8s rendering (manifest dicts; asserted on by converter tests, applied by
+# the operator)
+# ---------------------------------------------------------------------------
+
+
+def _container_manifest(container: Optional[V1Container], ctx: dict, env: dict[str, str]) -> dict:
+    c = container or V1Container(name="main", image="python:3.12")
+    return {
+        "name": c.name or "main",
+        "image": render_value(c.image, ctx) if c.image else None,
+        "command": _container_argv_cmd(c, ctx),
+        "args": _container_argv_args(c, ctx),
+        "env": [{"name": k, "value": v} for k, v in {**env, **_container_env(c, ctx)}.items()],
+        "resources": c.resources.to_dict() if c.resources else None,
+        "workingDir": c.working_dir,
+    }
+
+
+def _container_argv_cmd(c: V1Container, ctx: dict) -> Optional[list[str]]:
+    cmd = c.command
+    if cmd is None:
+        return None
+    if isinstance(cmd, str):
+        cmd = shlex.split(cmd)
+    return [str(render_value(x, ctx)) for x in cmd]
+
+
+def _container_argv_args(c: V1Container, ctx: dict) -> Optional[list[str]]:
+    args = c.args
+    if args is None:
+        return None
+    if isinstance(args, str):
+        args = shlex.split(args)
+    return [str(render_value(x, ctx)) for x in args]
+
+
+def to_k8s_resources(
+    compiled: V1CompiledOperation,
+    ctx: dict,
+    run_uuid: str,
+    project: str,
+) -> list[dict]:
+    """Render the pod manifests for this run.
+
+    tpujob/jaxjob -> one pod per TPU host of the slice with rendezvous env;
+    job/service -> a single pod; Kubeflow-style kinds -> one pod per replica
+    with the same rendezvous env (their collectives ride ICI when placed on
+    TPU, so replicas are just processes of one SPMD program).
+    """
+    kind = compiled.get_run_kind()
+    run = compiled.run
+    base_env = context_env(ctx)
+    labels = {
+        "app.polyaxon.com/run": run_uuid,
+        "app.polyaxon.com/project": project,
+        "app.polyaxon.com/kind": kind or "job",
+    }
+
+    def pod(name: str, container: dict, extra: Optional[dict] = None) -> dict:
+        spec: dict[str, Any] = {"restartPolicy": "Never", "containers": [container]}
+        if extra:
+            spec.update(extra)
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": name, "labels": dict(labels)},
+            "spec": spec,
+        }
+
+    if isinstance(run, V1TPUJob):
+        topo: SliceTopology = run.get_slice()
+        hosts = topo.num_hosts
+        svc = f"plx-{run_uuid[:12]}-hosts"
+        pods = []
+        for host_idx in range(hosts):
+            env = dict(base_env)
+            env.update(rendezvous_env(
+                coordinator_host=f"plx-{run_uuid[:12]}-0.{svc}",
+                port=DEFAULT_COORDINATOR_PORT,
+                num_processes=hosts,
+                process_id=host_idx,
+            ))
+            env["PLX_SLICE_TOPOLOGY"] = topo.topology
+            env["PLX_SLICE_ACCELERATOR"] = topo.accelerator
+            if run.parallelism:
+                import json as _json
+
+                env["PLX_PARALLELISM"] = _json.dumps(run.parallelism.to_dict())
+            cm = _container_manifest(run.container, ctx, env)
+            cm["resources"] = {"limits": {k: str(v) for k, v in topo.tpu_resources().items()}}
+            pods.append(pod(
+                f"plx-{run_uuid[:12]}-{host_idx}",
+                cm,
+                extra={
+                    "nodeSelector": topo.node_selectors(),
+                    "subdomain": svc,
+                    "hostname": f"plx-{run_uuid[:12]}-{host_idx}",
+                },
+            ))
+        headless = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": svc, "labels": dict(labels)},
+            "spec": {"clusterIP": "None", "selector": {"app.polyaxon.com/run": run_uuid},
+                     "ports": [{"port": DEFAULT_COORDINATOR_PORT}]},
+        }
+        return [headless] + pods
+
+    if kind in V1RunKind.DISTRIBUTED:
+        # Kubeflow-style replica kinds: flatten replica groups into pods.
+        pods = []
+        idx = 0
+        groups = [
+            (role, getattr(run, role))
+            for role in ("chief", "master", "launcher", "ps", "worker", "evaluator")
+            if getattr(run, role, None) is not None
+        ]
+        total = sum((g.replicas or 1) for _, g in groups)
+        svc = f"plx-{run_uuid[:12]}-hosts"
+        # process 0 is the first replica of the first group; its stable DNS
+        # name (hostname.subdomain) is the rendezvous coordinator
+        coord_pod = f"plx-{run_uuid[:12]}-{groups[0][0]}-0" if groups else ""
+        for role, group in groups:
+            for r in range(group.replicas or 1):
+                env = dict(base_env)
+                env.update(rendezvous_env(
+                    coordinator_host=f"{coord_pod}.{svc}",
+                    port=DEFAULT_COORDINATOR_PORT,
+                    num_processes=total,
+                    process_id=idx,
+                ))
+                env["PLX_REPLICA_ROLE"] = role
+                env["PLX_REPLICA_INDEX"] = str(r)
+                cm = _container_manifest(group.container, ctx, env)
+                name = f"plx-{run_uuid[:12]}-{role}-{r}"
+                pods.append(pod(name, cm,
+                                extra={"subdomain": svc, "hostname": name}))
+                idx += 1
+        headless = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": svc, "labels": dict(labels)},
+            "spec": {"clusterIP": "None",
+                     "selector": {"app.polyaxon.com/run": run_uuid},
+                     "ports": [{"port": DEFAULT_COORDINATOR_PORT}]},
+        }
+        return [headless] + pods
+
+    if kind == V1RunKind.SERVICE:
+        cm = _container_manifest(run.container, ctx, base_env)
+        p = pod(f"plx-{run_uuid[:12]}", cm)
+        svc = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": f"plx-{run_uuid[:12]}", "labels": dict(labels)},
+            "spec": {
+                "selector": {"app.polyaxon.com/run": run_uuid},
+                "ports": [{"port": p_} for p_ in (run.ports or [80])],
+            },
+        }
+        return [p, svc]
+
+    cm = _container_manifest(getattr(run, "container", None), ctx, base_env)
+    return [pod(f"plx-{run_uuid[:12]}", cm)]

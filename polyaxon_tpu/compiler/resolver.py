@@ -1,0 +1,55 @@
+"""Top-level compile pipeline: V1Operation -> V1CompiledOperation ->
+payload (upstream ``resolve()`` — SURVEY.md §3a steps 3-4)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..schemas.component import V1Component
+from ..schemas.operation import V1CompiledOperation, V1Operation
+from .contexts import build_context
+from .converter import LocalPayload, to_k8s_resources, to_local_payload
+
+
+@dataclass
+class ResolvedRun:
+    run_uuid: str
+    project: str
+    compiled: V1CompiledOperation
+    context: dict[str, Any]
+    payload: LocalPayload
+
+    def k8s_resources(self) -> list[dict]:
+        return to_k8s_resources(self.compiled, self.context, self.run_uuid, self.project)
+
+
+def compile_operation(
+    op: V1Operation, component: Optional[V1Component] = None
+) -> V1CompiledOperation:
+    return V1CompiledOperation.from_operation(op, component)
+
+
+def resolve(
+    op_or_compiled: V1Operation | V1CompiledOperation | dict,
+    run_uuid: str,
+    project: str,
+    artifacts_path: str,
+    api_host: Optional[str] = None,
+) -> ResolvedRun:
+    if isinstance(op_or_compiled, dict):
+        kind = op_or_compiled.get("kind")
+        if kind == "compiled_operation":
+            compiled = V1CompiledOperation.from_dict(op_or_compiled)
+        else:
+            compiled = compile_operation(V1Operation.from_dict(op_or_compiled))
+    elif isinstance(op_or_compiled, V1Operation):
+        compiled = compile_operation(op_or_compiled)
+    else:
+        compiled = op_or_compiled
+    ctx = build_context(compiled, run_uuid, project, artifacts_path, api_host)
+    payload = to_local_payload(compiled, ctx, run_uuid, project)
+    return ResolvedRun(
+        run_uuid=run_uuid, project=project, compiled=compiled,
+        context=ctx, payload=payload,
+    )

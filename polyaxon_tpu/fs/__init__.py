@@ -1,0 +1,7 @@
+"""Artifact-store IO over fsspec (upstream ``polyaxon._fs`` — SURVEY.md §2
+"FS / connections" row): gs://, s3://, or plain local paths, resolved from
+``V1Connection`` specs."""
+
+from .fs import download, get_fs, get_fs_from_connection, sync_dir, upload
+
+__all__ = ["download", "get_fs", "get_fs_from_connection", "sync_dir", "upload"]

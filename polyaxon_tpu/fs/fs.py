@@ -1,0 +1,90 @@
+"""fsspec-backed store operations used by init/sidecar/checkpoint paths."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import fsspec
+
+from ..schemas.connections import V1Connection
+
+
+def get_fs(url_or_path: str) -> tuple[Any, str]:
+    """Returns (filesystem, path-without-protocol)."""
+    if "://" in url_or_path:
+        protocol, _, rest = url_or_path.partition("://")
+        return fsspec.filesystem(protocol), rest
+    return fsspec.filesystem("file"), url_or_path
+
+
+def get_fs_from_connection(conn: V1Connection) -> tuple[Any, str]:
+    """Resolve a declared connection to (filesystem, root path)."""
+    root = conn.store_path()
+    if conn.kind in ("gcs", "s3", "wasb"):
+        proto = {"gcs": "gs", "s3": "s3", "wasb": "abfs"}[conn.kind]
+        return fsspec.filesystem(proto), root
+    if conn.kind in ("volume_claim", "host_path"):
+        return fsspec.filesystem("file"), root or "/"
+    raise ValueError(f"No fs mapping for connection kind {conn.kind!r}")
+
+
+def download(src: str, dest: str) -> str:
+    fs, path = get_fs(src)
+    if fs.isdir(path):
+        fs.get(path, dest, recursive=True)
+    else:
+        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+        fs.get(path, dest)
+    return dest
+
+
+def upload(src: str, dest: str) -> str:
+    fs, path = get_fs(dest)
+    if os.path.isdir(src):
+        fs.put(src, path, recursive=True)
+    else:
+        fs.put(src, path)
+    return dest
+
+
+def _remote_mtime(rinfo: dict) -> Optional[float]:
+    for key in ("mtime", "LastModified", "last_modified", "updated"):
+        v = rinfo.get(key)
+        if v is None:
+            continue
+        if hasattr(v, "timestamp"):
+            return v.timestamp()
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            continue
+    return None
+
+
+def sync_dir(local_dir: str, remote_dir: str, exclude: Optional[set[str]] = None) -> int:
+    """One-way sync local->remote of files newer than the remote copy (the
+    sidecar loop's primitive — SURVEY.md §2 "Sidecar"). A file is skipped
+    only when sizes match AND the remote copy is at least as new (same-size
+    in-place rewrites must still sync). Returns files copied."""
+    fs, rroot = get_fs(remote_dir)
+    copied = 0
+    for root, _, files in os.walk(local_dir):
+        for f in files:
+            if exclude and f in exclude:
+                continue
+            lpath = os.path.join(root, f)
+            rel = os.path.relpath(lpath, local_dir)
+            rpath = os.path.join(rroot, rel)
+            try:
+                rinfo = fs.info(rpath)
+                if rinfo.get("size") == os.path.getsize(lpath):
+                    rm = _remote_mtime(rinfo)
+                    if rm is not None and rm >= os.path.getmtime(lpath):
+                        continue
+            except FileNotFoundError:
+                pass
+            fs.makedirs(os.path.dirname(rpath), exist_ok=True)
+            fs.put(lpath, rpath)
+            copied += 1
+    return copied

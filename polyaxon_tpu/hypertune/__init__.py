@@ -1,0 +1,17 @@
+"""Hyperparameter tuning engine (upstream hypertune — SURVEY.md §2):
+grid/random/mapping/Hyperband/Bayes/TPE managers + the tuner pipeline loop."""
+
+from .managers import (
+    BaseManager,
+    BayesManager,
+    GridSearchManager,
+    HyperbandManager,
+    HyperoptManager,
+    IterativeManager,
+    MappingManager,
+    Observation,
+    RandomSearchManager,
+    Suggestion,
+    make_manager,
+)
+from .tuner import Tuner

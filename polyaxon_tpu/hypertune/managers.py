@@ -1,0 +1,320 @@
+"""Suggestion managers — one per matrix kind (upstream hypertune
+``BaseManager``/``HyperbandManager``/``BayesManager``; SURVEY.md §2
+"Hypertune engine", §3(c) call stack).
+
+Protocol: the tuner repeatedly calls ``suggest(observations)`` for the next
+batch of trials and stops when ``done(observations)``. An Observation is a
+finished (or pruned) trial: params + objective metric (None if failed).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..schemas.matrix import (
+    V1Bayes,
+    V1GridSearch,
+    V1Hyperband,
+    V1Hyperopt,
+    V1Iterative,
+    V1Mapping,
+    V1RandomSearch,
+)
+from . import space
+
+
+@dataclass
+class Observation:
+    params: dict[str, Any]
+    metric: Optional[float]  # objective value; None = failed/no metric
+    trial_meta: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Suggestion:
+    params: dict[str, Any]
+    meta: dict[str, Any] = field(default_factory=dict)
+
+
+class BaseManager:
+    def __init__(self, config: Any):
+        self.config = config
+
+    @property
+    def concurrency(self) -> int:
+        return getattr(self.config, "concurrency", None) or 4
+
+    def done(self, observations: list[Observation]) -> bool:
+        raise NotImplementedError
+
+    def suggest(self, observations: list[Observation]) -> list[Suggestion]:
+        raise NotImplementedError
+
+    def _maximize(self) -> bool:
+        metric = getattr(self.config, "metric", None)
+        return metric.maximize if metric else True
+
+    def best(self, observations: list[Observation]) -> Optional[Observation]:
+        scored = [o for o in observations if o.metric is not None]
+        if not scored:
+            return None
+        return (max if self._maximize() else min)(scored, key=lambda o: o.metric)
+
+
+class MappingManager(BaseManager):
+    config: V1Mapping
+
+    def done(self, obs: list[Observation]) -> bool:
+        return len(obs) >= len(self.config.values)
+
+    def suggest(self, obs: list[Observation]) -> list[Suggestion]:
+        remaining = self.config.values[len(obs):]
+        return [Suggestion(params=dict(v)) for v in remaining]
+
+
+class GridSearchManager(BaseManager):
+    config: V1GridSearch
+
+    def __init__(self, config: V1GridSearch):
+        super().__init__(config)
+        self._grid = space.grid_combinations(config.params, limit=config.num_runs)
+
+    def done(self, obs: list[Observation]) -> bool:
+        return len(obs) >= len(self._grid)
+
+    def suggest(self, obs: list[Observation]) -> list[Suggestion]:
+        return [Suggestion(params=p) for p in self._grid[len(obs):]]
+
+
+class RandomSearchManager(BaseManager):
+    config: V1RandomSearch
+
+    def __init__(self, config: V1RandomSearch):
+        super().__init__(config)
+        self._rng = np.random.default_rng(config.seed)
+
+    def done(self, obs: list[Observation]) -> bool:
+        return len(obs) >= self.config.num_runs
+
+    def suggest(self, obs: list[Observation]) -> list[Suggestion]:
+        n = self.config.num_runs - len(obs)
+        return [Suggestion(params=p)
+                for p in space.sample_suggestions(self.config.params, n, self._rng)]
+
+
+class IterativeManager(RandomSearchManager):
+    """Random proposals until max_iterations; user logic can re-seed between
+    rounds via the tuner container (upstream V1Iterative)."""
+
+    config: V1Iterative
+
+    def __init__(self, config: V1Iterative):
+        BaseManager.__init__(self, config)
+        self._rng = np.random.default_rng(config.seed)
+
+    def done(self, obs: list[Observation]) -> bool:
+        return len(obs) >= self.config.max_iterations
+
+    def suggest(self, obs: list[Observation]) -> list[Suggestion]:
+        n = self.config.max_iterations - len(obs)
+        return [Suggestion(params=p)
+                for p in space.sample_suggestions(self.config.params, n, self._rng)]
+
+
+class HyperbandManager(BaseManager):
+    """Hyperband (Li et al., JMLR 2018). R = max_iterations, eta;
+    s_max = floor(log_eta R); bracket s runs rungs i=0..s with
+    n_i = ceil(B/R * eta^s/(s+1)) * eta^-i, r_i = R * eta^(i-s).
+
+    The manager is stateful across rungs: ``suggest`` returns the next rung's
+    trials (params + the resource budget in meta/params), using the parent
+    rung's results to promote the top 1/eta."""
+
+    config: V1Hyperband
+
+    def __init__(self, config: V1Hyperband):
+        super().__init__(config)
+        self._rng = np.random.default_rng(config.seed)
+        self.R = config.max_iterations
+        self.eta = config.eta
+        self.s_max = int(math.floor(math.log(self.R) / math.log(self.eta)))
+        self.B = (self.s_max + 1) * self.R
+        # schedule of (bracket, rung) in execution order
+        self._schedule = [(s, i) for s in range(self.s_max, -1, -1) for i in range(s + 1)]
+        self._cursor = 0
+        self._pending_promotions: list[dict[str, Any]] = []
+
+    def bracket_sizes(self, s: int) -> list[tuple[int, float]]:
+        """[(n_i, r_i)] for bracket s."""
+        n = int(math.ceil(self.B / self.R * (self.eta ** s) / (s + 1)))
+        r = self.R * (self.eta ** (-s))
+        out = []
+        for i in range(s + 1):
+            n_i = int(math.floor(n * self.eta ** (-i)))
+            r_i = r * (self.eta ** i)
+            out.append((max(n_i, 1), r_i))
+        return out
+
+    def done(self, obs: list[Observation]) -> bool:
+        return self._cursor >= len(self._schedule)
+
+    def suggest(self, obs: list[Observation]) -> list[Suggestion]:
+        if self.done(obs):
+            return []
+        s, i = self._schedule[self._cursor]
+        self._cursor += 1
+        n_i, r_i = self.bracket_sizes(s)[i]
+        resource = self.config.resource
+        budget = resource.cast(r_i)
+        if i == 0:
+            params = space.sample_suggestions(self.config.params, n_i, self._rng)
+        else:
+            # promote top n_i from the previous rung of this bracket
+            prev = [o for o in obs if o.trial_meta.get("bracket") == s
+                    and o.trial_meta.get("rung") == i - 1 and o.metric is not None]
+            prev.sort(key=lambda o: o.metric, reverse=self._maximize())
+            params = [dict(o.params) for o in prev[:n_i]]
+            if not params:  # whole rung failed: skip remaining rungs of bracket
+                while self._cursor < len(self._schedule) and self._schedule[self._cursor][0] == s:
+                    self._cursor += 1
+                return self.suggest(obs)
+        out = []
+        for p in params:
+            p = dict(p)
+            p.pop(resource.name, None)
+            p[resource.name] = budget
+            out.append(Suggestion(params=p, meta={"bracket": s, "rung": i}))
+        return out
+
+
+class BayesManager(BaseManager):
+    """GP surrogate + expected-improvement acquisition (upstream BayesManager
+    used sklearn GPs; same here — sklearn ships in the image)."""
+
+    config: V1Bayes
+
+    def __init__(self, config: V1Bayes):
+        super().__init__(config)
+        self._rng = np.random.default_rng(config.seed)
+        uf = config.utility_function or {}
+        self.kappa = float(uf.get("kappa", 2.576))
+        self.eps = float(uf.get("eps", 0.0))
+        self.acq = str(uf.get("acquisitionFunction", uf.get("acquisition_function", "ei")))
+        self.num_candidates = int(uf.get("numSamples", uf.get("num_samples", 256)))
+
+    @property
+    def total(self) -> int:
+        return self.config.num_initial_runs + self.config.max_iterations
+
+    def done(self, obs: list[Observation]) -> bool:
+        return len(obs) >= self.total
+
+    def suggest(self, obs: list[Observation]) -> list[Suggestion]:
+        n_init = self.config.num_initial_runs
+        if len(obs) < n_init:
+            return [Suggestion(params=p) for p in
+                    space.sample_suggestions(self.config.params, n_init - len(obs), self._rng)]
+        scored = [o for o in obs if o.metric is not None]
+        if len(scored) < 2:
+            return [Suggestion(params=p) for p in
+                    space.sample_suggestions(self.config.params, 1, self._rng)]
+        X = np.stack([space.encode(self.config.params, o.params) for o in scored])
+        y = np.asarray([o.metric for o in scored], dtype=float)
+        if not self._maximize():
+            y = -y
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import RBF, ConstantKernel, WhiteKernel
+
+        scale = np.maximum(X.std(axis=0), 1e-6)
+        kernel = ConstantKernel(1.0) * RBF(length_scale=np.ones(X.shape[1])) \
+            + WhiteKernel(noise_level=1e-5)
+        gp = GaussianProcessRegressor(kernel=kernel, normalize_y=True, alpha=1e-8)
+        gp.fit(X / scale, y)
+
+        bnds = space.bounds(self.config.params)
+        cands = np.stack([
+            np.asarray([self._rng.uniform(lo, hi) for lo, hi in bnds])
+            for _ in range(self.num_candidates)
+        ])
+        mu, sigma = gp.predict(cands / scale, return_std=True)
+        best = y.max()
+        if self.acq == "ucb":
+            score = mu + self.kappa * sigma
+        else:  # expected improvement
+            from scipy.stats import norm
+
+            imp = mu - best - self.eps
+            z = np.where(sigma > 0, imp / np.maximum(sigma, 1e-12), 0.0)
+            score = np.where(sigma > 0, imp * norm.cdf(z) + sigma * norm.pdf(z), 0.0)
+        vec = cands[int(np.argmax(score))]
+        return [Suggestion(params=space.decode(self.config.params, vec))]
+
+
+class HyperoptManager(BaseManager):
+    """TPE-style density-ratio sampler (upstream delegated to the hyperopt
+    package, which is not in this image — this is a self-contained TPE:
+    split observations at the gamma-quantile, model good/bad with KDEs over
+    the encoded space, pick the candidate maximizing good/bad ratio)."""
+
+    config: V1Hyperopt
+
+    def __init__(self, config: V1Hyperopt):
+        super().__init__(config)
+        self._rng = np.random.default_rng(config.seed)
+        self.gamma = 0.25
+        self.num_candidates = 64
+
+    def done(self, obs: list[Observation]) -> bool:
+        return len(obs) >= self.config.num_runs
+
+    def suggest(self, obs: list[Observation]) -> list[Suggestion]:
+        scored = [o for o in obs if o.metric is not None]
+        n_random = max(4, self.config.num_runs // 5)
+        if self.config.algorithm == "rand" or len(scored) < n_random:
+            n = min(self.config.num_runs - len(obs),
+                    max(1, n_random - len(scored)))
+            return [Suggestion(params=p) for p in
+                    space.sample_suggestions(self.config.params, n, self._rng)]
+        X = np.stack([space.encode(self.config.params, o.params) for o in scored])
+        y = np.asarray([o.metric for o in scored], dtype=float)
+        if not self._maximize():
+            y = -y
+        cut = np.quantile(y, 1 - self.gamma)
+        good, bad = X[y >= cut], X[y < cut]
+        if len(good) == 0 or len(bad) == 0:
+            return [Suggestion(params=p) for p in
+                    space.sample_suggestions(self.config.params, 1, self._rng)]
+        bw = np.maximum(X.std(axis=0), 1e-3)
+
+        def kde(pts, x):
+            d = (x[None, :] - pts) / bw
+            return np.exp(-0.5 * (d ** 2).sum(-1)).mean() + 1e-12
+
+        # candidates drawn around good points
+        cands = []
+        for _ in range(self.num_candidates):
+            c = good[self._rng.integers(0, len(good))] + self._rng.normal(0, bw)
+            cands.append(c)
+        ratios = [kde(good, c) / kde(bad, c) for c in cands]
+        vec = cands[int(np.argmax(ratios))]
+        return [Suggestion(params=space.decode(self.config.params, vec))]
+
+
+def make_manager(config: Any) -> BaseManager:
+    kinds = {
+        "mapping": MappingManager,
+        "grid": GridSearchManager,
+        "random": RandomSearchManager,
+        "hyperband": HyperbandManager,
+        "bayes": BayesManager,
+        "hyperopt": HyperoptManager,
+        "iterative": IterativeManager,
+    }
+    kind = getattr(config, "kind", None)
+    if kind not in kinds:
+        raise ValueError(f"No manager for matrix kind {kind!r}")
+    return kinds[kind](config)

@@ -1,0 +1,171 @@
+"""Search-space operations over the hp distributions (schemas.matrix):
+sampling, grid enumeration, and numeric encoding for model-based search
+(upstream hypertune's space handling — SURVEY.md §2 "Hypertune engine")."""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Optional
+
+import numpy as np
+
+from ..schemas.matrix import GRID_KINDS
+
+
+def sample_param(hp: Any, rng: np.random.Generator) -> Any:
+    k = hp.kind
+    if k == "choice":
+        return hp.value[rng.integers(0, len(hp.value))]
+    if k == "pchoice":
+        probs = [float(p) for _, p in hp.value]
+        idx = rng.choice(len(hp.value), p=probs)
+        return hp.value[idx][0]
+    if k == "range":
+        start, stop, step = hp.as_tuple()
+        n = max(1, int(math.ceil((stop - start) / step)))
+        return start + step * float(rng.integers(0, n))
+    if k in ("linspace", "logspace", "geomspace"):
+        vals = grid_values(hp)
+        return vals[rng.integers(0, len(vals))]
+    if k == "uniform":
+        lo, hi = hp.as_pair("low", "high")
+        return float(rng.uniform(lo, hi))
+    if k == "quniform":
+        lo, hi = hp.as_pair("low", "high")
+        return float(round(rng.uniform(lo, hi)))
+    if k == "loguniform":
+        lo, hi = hp.as_pair("low", "high")
+        return float(np.exp(rng.uniform(np.log(lo), np.log(hi))))
+    if k == "qloguniform":
+        lo, hi = hp.as_pair("low", "high")
+        return float(round(np.exp(rng.uniform(np.log(lo), np.log(hi)))))
+    if k == "normal":
+        mu, sigma = hp.as_pair("loc", "scale")
+        return float(rng.normal(mu, sigma))
+    if k == "qnormal":
+        mu, sigma = hp.as_pair("loc", "scale")
+        return float(round(rng.normal(mu, sigma)))
+    if k == "lognormal":
+        mu, sigma = hp.as_pair("loc", "scale")
+        return float(rng.lognormal(mu, sigma))
+    if k == "qlognormal":
+        mu, sigma = hp.as_pair("loc", "scale")
+        return float(round(rng.lognormal(mu, sigma)))
+    raise ValueError(f"Cannot sample distribution kind {k!r}")
+
+
+def grid_values(hp: Any) -> list[Any]:
+    k = hp.kind
+    if k == "choice":
+        return list(hp.value)
+    if k == "range":
+        start, stop, step = hp.as_tuple()
+        out, v = [], start
+        while v < stop:
+            out.append(v)
+            v += step
+        return out
+    if k == "linspace":
+        start, stop, num = hp.as_tuple()
+        return [float(x) for x in np.linspace(start, stop, num)]
+    if k == "logspace":
+        start, stop, num = hp.as_tuple()
+        return [float(x) for x in np.logspace(start, stop, num)]
+    if k == "geomspace":
+        start, stop, num = hp.as_tuple()
+        return [float(x) for x in np.geomspace(start, stop, num)]
+    raise ValueError(f"Distribution kind {k!r} is not grid-enumerable")
+
+
+def grid_combinations(params: dict[str, Any], limit: Optional[int] = None) -> list[dict[str, Any]]:
+    names = list(params)
+    value_lists = [grid_values(params[n]) for n in names]
+    out = []
+    for combo in itertools.product(*value_lists):
+        out.append(dict(zip(names, combo)))
+        if limit and len(out) >= limit:
+            break
+    return out
+
+
+def sample_suggestions(
+    params: dict[str, Any], n: int, rng: np.random.Generator
+) -> list[dict[str, Any]]:
+    return [{name: sample_param(hp, rng) for name, hp in params.items()} for _ in range(n)]
+
+
+# -- numeric encoding for model-based search (bayes/TPE) --------------------
+
+
+def _is_log(kind: str) -> bool:
+    return kind in ("loguniform", "qloguniform", "lognormal", "qlognormal", "logspace", "geomspace")
+
+
+def encode(params: dict[str, Any], values: dict[str, Any]) -> np.ndarray:
+    """Map a param dict to a numeric vector (log-transform log-scaled dims,
+    index-encode choices)."""
+    out = []
+    for name, hp in params.items():
+        v = values[name]
+        if hp.kind in ("choice", "pchoice"):
+            pool = hp.value if hp.kind == "choice" else [x[0] for x in hp.value]
+            out.append(float(pool.index(v)))
+        elif _is_log(hp.kind):
+            out.append(float(np.log(max(float(v), 1e-300))))
+        else:
+            out.append(float(v))
+    return np.asarray(out)
+
+
+def bounds(params: dict[str, Any]) -> list[tuple[float, float]]:
+    """Encoded-space bounds per dimension (for acquisition sampling)."""
+    out = []
+    for hp in params.values():
+        k = hp.kind
+        if k in ("choice", "pchoice"):
+            n = len(hp.value)
+            out.append((0.0, float(n - 1)))
+        elif k in ("uniform", "quniform"):
+            lo, hi = hp.as_pair("low", "high")
+            out.append((lo, hi))
+        elif k in ("loguniform", "qloguniform"):
+            lo, hi = hp.as_pair("low", "high")
+            out.append((float(np.log(lo)), float(np.log(hi))))
+        elif k in ("normal", "qnormal"):
+            mu, sigma = hp.as_pair("loc", "scale")
+            out.append((mu - 3 * sigma, mu + 3 * sigma))
+        elif k in ("lognormal", "qlognormal"):
+            mu, sigma = hp.as_pair("loc", "scale")
+            out.append((mu - 3 * sigma, mu + 3 * sigma))
+        elif k in GRID_KINDS:
+            vals = [float(x) for x in grid_values(hp)]
+            if _is_log(k):
+                vals = [float(np.log(max(v, 1e-300))) for v in vals]
+            out.append((min(vals), max(vals)))
+        else:
+            out.append((0.0, 1.0))
+    return out
+
+
+def decode(params: dict[str, Any], vec: np.ndarray) -> dict[str, Any]:
+    """Inverse of ``encode`` (rounds q-kinds and choice indices)."""
+    out = {}
+    for (name, hp), x in zip(params.items(), vec):
+        k = hp.kind
+        if k in ("choice", "pchoice"):
+            pool = hp.value if k == "choice" else [v[0] for v in hp.value]
+            idx = int(round(float(np.clip(x, 0, len(pool) - 1))))
+            out[name] = pool[idx]
+        elif _is_log(k):
+            v = float(np.exp(x))
+            out[name] = float(round(v)) if k.startswith("q") else v
+        elif k.startswith("q"):
+            out[name] = float(round(float(x)))
+        elif k in GRID_KINDS:
+            vals = grid_values(hp)
+            arr = np.asarray([float(v) for v in vals])
+            out[name] = vals[int(np.argmin(np.abs(arr - float(x))))]
+        else:
+            out[name] = float(x)
+    return out

@@ -1,0 +1,179 @@
+"""The tuner loop: drives a matrix pipeline against the store (upstream's
+tuner job — SURVEY.md §3(c): compute suggestions -> create child ops ->
+join child metrics -> iterate; early-stop losers).
+
+Child runs are ordinary operations (same spec minus ``matrix``, params
+bound), created through the store so the agent schedules them like anything
+else — including onto ICI sub-slices when the spec is a tpujob (the
+scheduler's packing decides placement; BASELINE config 5)."""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Optional
+
+from ..api.store import Store
+from ..schemas.matrix import V1FailureEarlyStopping, V1MetricEarlyStopping
+from ..schemas.operation import V1Operation
+from ..schemas.statuses import V1Statuses, is_done
+from .managers import Observation, Suggestion, make_manager
+
+
+class Tuner:
+    def __init__(self, store: Store, pipeline_run: dict, poll_interval: float = 0.2):
+        self.store = store
+        self.pipeline = pipeline_run
+        self.poll_interval = poll_interval
+        spec = pipeline_run["spec"]
+        op = V1Operation.from_dict(spec)
+        if op.matrix is None:
+            raise ValueError("pipeline run has no matrix section")
+        self.matrix = op.matrix
+        self.manager = make_manager(self.matrix)
+        self.metric = getattr(self.matrix, "metric", None)
+        self.metric_name = self.metric.name if self.metric else "loss"
+        self._child_spec = self._make_child_spec(spec)
+
+    def _make_child_spec(self, spec: dict) -> dict:
+        child = copy.deepcopy(spec)
+        child.pop("matrix", None)
+        child.pop("schedule", None)
+        return child
+
+    # -- trial plumbing ----------------------------------------------------
+
+    def _create_trial(self, sugg: Suggestion, index: int) -> dict:
+        spec = copy.deepcopy(self._child_spec)
+        params = dict(spec.get("params") or {})
+        for name, value in sugg.params.items():
+            params[name] = {"value": value}
+        spec["params"] = params
+        name = f"{self.pipeline.get('name') or 'sweep'}-t{index}"
+        spec["name"] = name
+        return self.store.create_run(
+            self.pipeline["project"],
+            spec=spec,
+            name=name,
+            kind="trial",
+            inputs=sugg.params,
+            meta={"trial_index": index, **(sugg.meta or {})},
+            pipeline_uuid=self.pipeline["uuid"],
+        )
+
+    def _trial_metric(self, run: dict) -> Optional[float]:
+        outputs = run.get("outputs") or {}
+        v = outputs.get(self.metric_name)
+        if v is None and self.metric is None:
+            # grid/random/mapping declare no objective; if a trial reports
+            # exactly one numeric output, rank by it
+            numeric = [x for x in outputs.values()
+                       if isinstance(x, (int, float)) and not isinstance(x, bool)]
+            if len(numeric) == 1:
+                v = numeric[0]
+        try:
+            return float(v) if v is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    def _wait_trials(self, uuids: list[str], early: list) -> dict[str, Optional[dict]]:
+        """Poll until all trials finish; apply metric early stopping by
+        stopping still-running trials once the target is met. Returns
+        {uuid: run-or-None} — None marks a trial deleted mid-flight, so the
+        caller keeps suggestion/result pairing intact."""
+        pending = set(uuids)
+        done_runs: dict[str, Optional[dict]] = {}
+        target_reached = False
+        while pending:
+            for u in list(pending):
+                run = self.store.get_run(u)
+                if run is None:
+                    pending.discard(u)
+                    done_runs[u] = None
+                    continue
+                if is_done(run["status"]):
+                    pending.discard(u)
+                    done_runs[u] = run
+                    if not target_reached and self._metric_target_met(run, early):
+                        target_reached = True
+                        for other in pending:
+                            self.store.transition(other, V1Statuses.STOPPING.value)
+            if pending:
+                # pipeline stopped? propagate to children
+                pl = self.store.get_run(self.pipeline["uuid"])
+                if pl and pl["status"] in (V1Statuses.STOPPING.value, V1Statuses.STOPPED.value):
+                    for u in pending:
+                        self.store.transition(u, V1Statuses.STOPPING.value)
+                    raise InterruptedError("pipeline stopped")
+                time.sleep(self.poll_interval)
+        return done_runs
+
+    def _metric_target_met(self, run: dict, early: list) -> bool:
+        m = self._trial_metric(run)
+        if m is None:
+            return False
+        for es in early or []:
+            if isinstance(es, V1MetricEarlyStopping) and es.metric == self.metric_name:
+                if es.optimization == "maximize" and m >= es.value:
+                    return True
+                if es.optimization == "minimize" and m <= es.value:
+                    return True
+        return False
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self) -> dict[str, Any]:
+        observations: list[Observation] = []
+        early = getattr(self.matrix, "early_stopping", None) or []
+        concurrency = self.manager.concurrency
+        trial_index = 0
+        failures = 0
+        while not self.manager.done(observations):
+            batch = self.manager.suggest(observations)
+            if not batch:
+                break
+            for start in range(0, len(batch), concurrency):
+                window = batch[start : start + concurrency]
+                trials = []
+                for sugg in window:
+                    trials.append(self._create_trial(sugg, trial_index))
+                    trial_index += 1
+                finished = self._wait_trials([t["uuid"] for t in trials], early)
+                # explicit uuid pairing: a deleted trial (None) stays aligned
+                # with its suggestion and counts as a failure
+                for sugg, trial in zip(window, trials):
+                    run = finished.get(trial["uuid"])
+                    metric = self._trial_metric(run) if run else None
+                    if run is None or run["status"] != V1Statuses.SUCCEEDED.value:
+                        metric = None
+                        failures += 1
+                    observations.append(Observation(
+                        params=sugg.params, metric=metric,
+                        trial_meta={**(sugg.meta or {}), "uuid": trial["uuid"]},
+                    ))
+                if self._failure_stop(early, failures, len(observations)):
+                    raise RuntimeError(
+                        f"failure early stopping: {failures}/{len(observations)} trials failed"
+                    )
+                if any(self._metric_target_met(r, early)
+                       for r in finished.values() if r is not None):
+                    return self._summary(observations, stopped_early=True)
+        return self._summary(observations)
+
+    def _failure_stop(self, early: list, failures: int, total: int) -> bool:
+        for es in early or []:
+            if isinstance(es, V1FailureEarlyStopping) and total > 0:
+                if failures / total * 100.0 >= es.percent:
+                    return True
+        return False
+
+    def _summary(self, observations: list[Observation], stopped_early: bool = False) -> dict:
+        best = self.manager.best(observations)
+        return {
+            "num_trials": len(observations),
+            "stopped_early": stopped_early,
+            "best_params": best.params if best else None,
+            "best_metric": best.metric if best else None,
+            "best_uuid": best.trial_meta.get("uuid") if best else None,
+            "metric": self.metric_name,
+        }

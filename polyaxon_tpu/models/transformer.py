@@ -283,6 +283,21 @@ def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret):
     return x + out
 
 
+def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interpret):
+    """Scan the stacked layers over x with the configured remat policy
+    (shared by apply() and encoder-only models like ViT)."""
+    body = lambda x, lp: (_layer_body(x, lp, cfg, rope_tables, mesh, interpret), None)
+    if cfg.remat == "full":
+        body = jax.checkpoint(body, prevent_cse=False)
+    elif cfg.remat == "dots":
+        body = jax.checkpoint(
+            body, prevent_cse=False,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    x, _ = jax.lax.scan(body, x, layer_params)
+    return x
+
+
 def apply(
     params: dict,
     tokens: jax.Array,
@@ -314,16 +329,7 @@ def apply(
         cos, sin = rope_frequencies(cfg.hd, cfg.max_seq, cfg.rope_theta)
         rope_tables = (cos[:s], sin[:s])
 
-    body = lambda x, lp: (_layer_body(x, lp, cfg, rope_tables, mesh, interpret), None)
-    if cfg.remat == "full":
-        body = jax.checkpoint(body, prevent_cse=False)
-    elif cfg.remat == "dots":
-        body = jax.checkpoint(
-            body, prevent_cse=False,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-        )
-    x, _ = jax.lax.scan(body, x, params["layers"])
-
+    x = run_trunk(x, params["layers"], cfg, rope_tables, mesh, interpret)
     x = _norm(x, params["final_norm"], cfg)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"].astype(dt))

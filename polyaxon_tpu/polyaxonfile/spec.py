@@ -137,7 +137,20 @@ def check_polyaxonfile(
         if op.params or op.component.inputs:
             from ..schemas.io import validate_params_against_io
 
-            validate_params_against_io(op.component.inputs, op.component.outputs, op.params)
+            matrix_params: set[str] = set()
+            if op.matrix is not None:
+                if hasattr(op.matrix, "params") and op.matrix.params:
+                    matrix_params = set(op.matrix.params)
+                elif hasattr(op.matrix, "values") and op.matrix.values:
+                    matrix_params = set().union(*(set(v) for v in op.matrix.values))
+                # Hyperband also binds the rationed resource as a param
+                resource = getattr(op.matrix, "resource", None)
+                if resource is not None:
+                    matrix_params.add(resource.name)
+            validate_params_against_io(
+                op.component.inputs, op.component.outputs, op.params,
+                matrix_params=matrix_params,
+            )
     return op
 
 

@@ -1,0 +1,5 @@
+"""Run-pod runtime equivalents: init steps, local subprocess executor,
+sidecar sync, built-in trainer entry (SURVEY.md §2 init/sidecar rows)."""
+
+from .init import InitError, run_init_step
+from .local import LocalExecution, LocalExecutor

@@ -1,0 +1,123 @@
+"""Built-in training entry (`runtime:` section of tpujob/jaxjob specs).
+
+Runs the framework's own Trainer for a named model from the zoo — the
+workload path of the north star (`polyaxon run -f llama7b.yaml` trains with
+our runtime, no user container needed). Reads its spec from
+``PLX_BUILTIN_SPEC`` (JSON) and attaches tracking via the standard PLX_* env.
+
+Spec keys:
+    model: registry name (e.g. "llama2-7b", "llama-tiny", "vit-b16", ...)
+    steps, batch_size, seq_len, learning_rate, warmup_steps, schedule,
+    optimizer, remat, parallelism {data,fsdp,model,context,expert,stage},
+    data {kind, path, ...}, checkpoint {save_interval_steps, max_to_keep},
+    platform ("cpu" forces CPU — tests), num_cpu_devices
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import replace
+from typing import Any
+
+
+def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
+    platform = spec.get("platform")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+        if spec.get("num_cpu_devices"):
+            jax.config.update("jax_num_cpu_devices", int(spec["num_cpu_devices"]))
+
+    from .. import tracking
+    from ..models import REGISTRY
+    from ..parallel import initialize as dist_init
+    from ..train import (
+        CheckpointConfig, DataConfig, OptimizerConfig, Trainer, TrainerConfig,
+        make_batches,
+    )
+
+    dist_init()  # joins jax.distributed when PLX_COORDINATOR_* present
+
+    name = spec.get("model", "llama-tiny")
+    if name not in REGISTRY:
+        raise SystemExit(f"Unknown model {name!r}; available: {sorted(REGISTRY)}")
+    family, mcfg = REGISTRY[name]
+    if family != "lm":
+        raise SystemExit(f"builtin runtime currently trains LM models; {name} is {family}")
+
+    overrides = {}
+    if spec.get("remat"):
+        overrides["remat"] = spec["remat"]
+    seq_len = int(spec.get("seq_len", min(2048, mcfg.max_seq)))
+    if seq_len > mcfg.max_seq:
+        overrides["max_seq"] = seq_len
+    if overrides:
+        mcfg = replace(mcfg, **overrides)
+
+    steps = int(spec.get("steps", 100))
+    batch_size = int(spec.get("batch_size", 8))
+    run = tracking.get_run()
+
+    ckpt_spec = spec.get("checkpoint") or {}
+    ckpt = CheckpointConfig(
+        directory=os.path.join(run.run_dir, "outputs", "checkpoints"),
+        save_interval_steps=int(ckpt_spec.get("save_interval_steps", max(steps // 4, 1))),
+        max_to_keep=int(ckpt_spec.get("max_to_keep", 3)),
+        async_save=bool(ckpt_spec.get("async_save", True)),
+    ) if spec.get("checkpoint", True) is not False else None
+
+    tcfg = TrainerConfig(
+        model=mcfg,
+        optimizer=OptimizerConfig(
+            name=spec.get("optimizer", "adamw"),
+            learning_rate=float(spec.get("learning_rate", 3e-4)),
+            warmup_steps=int(spec.get("warmup_steps", min(100, steps // 10 + 1))),
+            total_steps=steps,
+            schedule=spec.get("schedule", "cosine"),
+        ),
+        batch_size=batch_size,
+        seq_len=seq_len,
+        parallelism=spec.get("parallelism"),
+        checkpoint=ckpt,
+        log_interval=int(spec.get("log_interval", 10)),
+    )
+    trainer = Trainer(
+        tcfg,
+        track=lambda step, m: run.log_metrics(step=step, **{
+            k: v for k, v in m.items() if isinstance(v, (int, float))
+        }),
+    )
+
+    data_spec = dict(spec.get("data") or {})
+    data_cfg = DataConfig(
+        kind=data_spec.get("kind", "synthetic-lm"),
+        batch_size=batch_size,
+        seq_len=seq_len,
+        vocab_size=mcfg.vocab_size,
+        path=data_spec.get("path"),
+        seed=int(data_spec.get("seed", 0)),
+    )
+    batches = make_batches(data_cfg, trainer.mesh)
+
+    state, metrics = trainer.fit(batches, num_steps=steps)
+    summary = {k: v for k, v in metrics.items() if isinstance(v, (int, float))}
+    run.log_outputs(**summary)
+    if ckpt:
+        run.log_artifact("checkpoints", "outputs/checkpoints", kind="checkpoint")
+    run.end()
+    print(json.dumps({"final": summary}))
+    return summary
+
+
+def main() -> None:
+    raw = os.environ.get("PLX_BUILTIN_SPEC")
+    if not raw:
+        raise SystemExit("PLX_BUILTIN_SPEC not set")
+    run_builtin(json.loads(raw))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Init steps — the init-container equivalents (SURVEY.md §2 "Init
+container": fetch code/artifacts/files into the run's context before the
+main process starts). Locally these run in-process before the subprocess."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Any
+
+
+class InitError(RuntimeError):
+    pass
+
+
+def run_init_step(step: dict[str, Any], run_dir: str) -> None:
+    if step.get("git"):
+        _init_git(step["git"], run_dir)
+    elif step.get("file"):
+        _init_file(step["file"], run_dir)
+    elif step.get("dockerfile"):
+        _init_file({"name": "Dockerfile", **step["dockerfile"]}, run_dir)
+    elif step.get("paths") or step.get("artifacts"):
+        _init_paths(step, run_dir)
+    elif step.get("connection") or step.get("path"):
+        _init_connection_path(step, run_dir)
+    else:
+        raise InitError(f"Unsupported init step: {sorted(k for k, v in step.items() if v)}")
+
+
+def _init_git(spec: dict, run_dir: str) -> None:
+    url = spec.get("url")
+    if not url:
+        raise InitError("git init step needs 'url'")
+    dest = os.path.join(run_dir, "code")
+    args = ["git", "clone", "--depth", "1"]
+    if spec.get("revision"):
+        args += ["--branch", spec["revision"]]
+    args += list(spec.get("flags") or []) + [url, dest]
+    proc = subprocess.run(args, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise InitError(f"git clone failed: {proc.stderr[-500:]}")
+
+
+def _init_file(spec: dict, run_dir: str) -> None:
+    content = spec.get("content", "")
+    name = spec.get("filename") or spec.get("name") or "file"
+    dest_dir = os.path.join(run_dir, "code")
+    os.makedirs(dest_dir, exist_ok=True)
+    with open(os.path.join(dest_dir, name), "w", encoding="utf-8") as f:
+        f.write(content)
+    if spec.get("chmod"):
+        os.chmod(os.path.join(dest_dir, name), int(str(spec["chmod"]), 8))
+
+
+def _init_paths(step: dict, run_dir: str) -> None:
+    """Copy local paths (or artifact-store paths once fs connections are
+    configured) into the context."""
+    paths = step.get("paths") or (step.get("artifacts") or {}).get("files") or []
+    dest_dir = os.path.join(run_dir, "artifacts_in")
+    os.makedirs(dest_dir, exist_ok=True)
+    for p in paths:
+        src, dst = (p if isinstance(p, (list, tuple)) else (p, os.path.basename(str(p))))
+        dst_full = os.path.join(dest_dir, dst)
+        if os.path.isdir(src):
+            shutil.copytree(src, dst_full, dirs_exist_ok=True)
+        elif os.path.isfile(src):
+            os.makedirs(os.path.dirname(dst_full) or dest_dir, exist_ok=True)
+            shutil.copy2(src, dst_full)
+        else:
+            raise InitError(f"init path not found: {src}")
+
+
+def _init_connection_path(step: dict, run_dir: str) -> None:
+    """Fetch from an fsspec-backed connection path (gs://, s3://, local)."""
+    from ..fs import download
+
+    path = step.get("path")
+    if not path:
+        raise InitError("connection init step needs 'path'")
+    dest = os.path.join(run_dir, "artifacts_in", os.path.basename(path.rstrip("/")))
+    download(path, dest)

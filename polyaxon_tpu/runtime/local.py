@@ -1,0 +1,204 @@
+"""Local executor: runs a resolved operation as a subprocess with the run
+context layout — the "fake cluster" execution backend (SURVEY.md §4
+"Integration/e2e": in-proc scheduler + subprocess pods). Also the `--local`
+CLI path (SURVEY.md §7 stage 2 minimum e2e slice).
+
+Responsibilities mirrored from the pod runtime (SURVEY.md §3a step 6):
+  init steps -> main process (stdout/err captured to logs/) -> final status;
+  a sidecar thread syncs outputs to a remote store when one is configured.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..compiler.converter import LocalPayload
+from ..schemas.statuses import V1Statuses
+from ..tracking.writer import LogWriter
+from .init import InitError, run_init_step
+
+
+def _pythonpath_env() -> dict[str, str]:
+    """Make the framework importable in child processes even when it is run
+    from a source tree rather than installed (local/e2e mode)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    existing = os.environ.get("PYTHONPATH", "")
+    if pkg_root in existing.split(os.pathsep):
+        return {}
+    return {"PYTHONPATH": f"{pkg_root}{os.pathsep}{existing}" if existing else pkg_root}
+
+
+class LocalExecution:
+    """Handle on a launched local run."""
+
+    def __init__(self, payload: LocalPayload, proc: Optional[subprocess.Popen], thread: Optional[threading.Thread]):
+        self.payload = payload
+        self.proc = proc
+        self.thread = thread
+        self.returncode: Optional[int] = None
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        if self.thread is not None:
+            self.thread.join(timeout)
+            if self.thread.is_alive():
+                raise TimeoutError("run still active")
+        return self.returncode if self.returncode is not None else -1
+
+    def stop(self) -> None:
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+class LocalExecutor:
+    """Executes LocalPayloads; reports status via a callback (the store's
+    ``transition`` or a RunClient's ``log_status``)."""
+
+    def __init__(
+        self,
+        on_status: Optional[Callable[[str, str, Optional[str]], None]] = None,
+        remote_store: Optional[str] = None,
+        sync_interval: float = 5.0,
+    ):
+        # on_status(run_uuid, status, message)
+        self.on_status = on_status or (lambda *a: None)
+        self.remote_store = remote_store
+        self.sync_interval = sync_interval
+
+    # -- submit ------------------------------------------------------------
+
+    def submit(self, payload: LocalPayload, block: bool = False) -> LocalExecution:
+        execution = LocalExecution(payload, None, None)
+        thread = threading.Thread(target=self._run, args=(payload, execution), daemon=True)
+        execution.thread = thread
+        thread.start()
+        if block:
+            execution.wait(payload.timeout)
+        return execution
+
+    # -- the pod lifecycle -------------------------------------------------
+
+    def _run(self, payload: LocalPayload, execution: LocalExecution) -> None:
+        uuid = payload.run_uuid
+        run_dir = payload.artifacts_path
+        os.makedirs(run_dir, exist_ok=True)
+        log = LogWriter(run_dir)
+        attempts = payload.max_retries + 1
+        try:
+            self.on_status(uuid, V1Statuses.STARTING.value, None)
+            for step in payload.init:
+                run_init_step(step, run_dir)
+        except InitError as e:
+            log.write(f"[init] failed: {e}")
+            log.close()
+            self.on_status(uuid, V1Statuses.FAILED.value, f"init failed: {e}")
+            execution.returncode = 1
+            return
+
+        status, rc, msg = V1Statuses.FAILED.value, 1, None
+        for attempt in range(attempts):
+            if attempt:
+                self.on_status(uuid, V1Statuses.RETRYING.value, f"attempt {attempt + 1}")
+                self.on_status(uuid, V1Statuses.QUEUED.value, None)
+                self.on_status(uuid, V1Statuses.SCHEDULED.value, None)
+                self.on_status(uuid, V1Statuses.STARTING.value, None)
+            self.on_status(uuid, V1Statuses.RUNNING.value, None)
+            stop_sync = threading.Event()
+            sync_thread = self._start_sidecar(payload, stop_sync)
+            try:
+                rc = self._run_main(payload, execution, log)
+            finally:
+                stop_sync.set()
+                if sync_thread:
+                    sync_thread.join(timeout=30)
+            if rc == 0:
+                status, msg = V1Statuses.SUCCEEDED.value, None
+                break
+            status, msg = V1Statuses.FAILED.value, f"exit code {rc}"
+        log.close()
+        execution.returncode = rc
+        self.on_status(uuid, status, msg)
+
+    def _run_main(self, payload: LocalPayload, execution: LocalExecution, log: LogWriter) -> int:
+        if payload.builtin is not None:
+            return self._run_builtin(payload, log)
+        if not payload.argv:
+            log.write("[main] no container command; nothing to run")
+            return 0
+        env = {**os.environ, **payload.env, **_pythonpath_env()}
+        workdir = payload.workdir or os.path.join(payload.artifacts_path, "code")
+        if not os.path.isdir(workdir):
+            workdir = payload.artifacts_path
+        proc = subprocess.Popen(
+            payload.argv,
+            env=env,
+            cwd=workdir,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        execution.proc = proc
+        # watchdog, not an in-loop check: a hung process that prints nothing
+        # must still be killed at the deadline
+        watchdog: Optional[threading.Timer] = None
+        if payload.timeout:
+            def _kill():
+                if proc.poll() is None:
+                    log.write("[main] timeout exceeded; terminated")
+                    proc.terminate()
+
+            watchdog = threading.Timer(payload.timeout, _kill)
+            watchdog.daemon = True
+            watchdog.start()
+        try:
+            for line in proc.stdout:  # type: ignore[union-attr]
+                log.write(line)
+            return proc.wait()
+        finally:
+            if watchdog:
+                watchdog.cancel()
+
+    def _run_builtin(self, payload: LocalPayload, log: LogWriter) -> int:
+        """`runtime:` shortcut — run the built-in trainer in a subprocess so
+        crashes/OOMs behave like user containers."""
+        import json
+
+        spec = dict(payload.builtin or {})
+        env = {**os.environ, **payload.env, "PLX_BUILTIN_SPEC": json.dumps(spec)}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "polyaxon_tpu.runtime.builtin"],
+            env=env,
+            cwd=payload.artifacts_path,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for line in proc.stdout:  # type: ignore[union-attr]
+            log.write(line)
+        return proc.wait()
+
+    # -- sidecar -----------------------------------------------------------
+
+    def _start_sidecar(self, payload: LocalPayload, stop: threading.Event) -> Optional[threading.Thread]:
+        if not self.remote_store:
+            return None
+        from ..fs import sync_dir
+
+        remote = os.path.join(self.remote_store, payload.project, payload.run_uuid)
+
+        def loop():
+            while not stop.wait(self.sync_interval):
+                sync_dir(payload.artifacts_path, remote)
+            sync_dir(payload.artifacts_path, remote)  # final sync
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t

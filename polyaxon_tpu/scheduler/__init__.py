@@ -1,0 +1,4 @@
+"""Queue/agent scheduling (upstream agent — SURVEY.md §2 "Agent" row) +
+topology-aware sub-slice packing (schemas.tpu.pack_subslices)."""
+
+from .agent import LocalAgent

@@ -1,0 +1,218 @@
+"""Agent: watches the store queue and drives runs to completion (upstream
+``BaseAgent.start()`` poll loop + executor — SURVEY.md §2 "Agent" row,
+§3a steps 3-5 collapsed for the local/in-proc deployment).
+
+Pipeline per run: created -> compiled (resolver) -> queued -> scheduled
+(capacity) -> local execution (runtime/local.py) -> terminal status.
+Runs with a ``matrix`` section become pipelines: the agent spawns a tuner
+(hypertune/tuner.py) that creates child runs through the same queue."""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Optional
+
+from ..api.app import run_artifacts_dir
+from ..api.store import Store
+from ..compiler.resolver import resolve
+from ..runtime.local import LocalExecution, LocalExecutor
+from ..schemas.statuses import V1Statuses, is_done
+
+
+class LocalAgent:
+    def __init__(
+        self,
+        store: Store,
+        artifacts_root: str,
+        api_host: Optional[str] = None,
+        max_parallel: int = 4,
+        poll_interval: float = 0.2,
+    ):
+        self.store = store
+        self.artifacts_root = os.path.abspath(artifacts_root)
+        self.api_host = api_host
+        self.max_parallel = max_parallel
+        self.poll_interval = poll_interval
+        self.executor = LocalExecutor(on_status=self._on_status)
+        self._active: dict[str, LocalExecution] = {}
+        self._tuners: dict[str, threading.Thread] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "LocalAgent":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        with self._lock:
+            for ex in self._active.values():
+                ex.stop()
+
+    def _on_status(self, run_uuid: str, status: str, message: Optional[str]) -> None:
+        self.store.transition(run_uuid, status, message=message)
+        if is_done(status):
+            self._collect_outputs(run_uuid)
+            with self._lock:
+                self._active.pop(run_uuid, None)
+
+    def _collect_outputs(self, run_uuid: str) -> None:
+        """Merge the run's offline outputs.json (tracking writes it at end())
+        into the store, so outputs flow even without an API client."""
+        import json
+
+        run = self.store.get_run(run_uuid)
+        if not run:
+            return
+        path = os.path.join(
+            run_artifacts_dir(self.artifacts_root, run["project"], run_uuid),
+            "outputs.json",
+        )
+        if os.path.exists(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    self.store.merge_outputs(run_uuid, json.load(f))
+            except (OSError, ValueError):
+                pass
+
+    # -- the poll loop -----------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.tick()
+            except Exception:
+                traceback.print_exc()
+
+    def tick(self) -> None:
+        """One reconcile pass (public for deterministic tests)."""
+        for run in self.store.list_runs(status=V1Statuses.CREATED.value):
+            self._compile(run)
+        for run in self.store.list_runs(status=V1Statuses.COMPILED.value):
+            self.store.transition(run["uuid"], V1Statuses.QUEUED.value)
+        for run in self.store.list_runs(status=V1Statuses.QUEUED.value):
+            self._maybe_schedule(run)
+        for run in self.store.list_runs(status=V1Statuses.STOPPING.value):
+            self._do_stop(run)
+
+    # -- stages ------------------------------------------------------------
+
+    def _compile(self, run: dict) -> None:
+        uuid = run["uuid"]
+        try:
+            spec = run.get("spec")
+            if not spec:
+                raise ValueError("run has no spec")
+            if spec.get("matrix"):
+                # matrix pipeline: the run itself becomes the pipeline record
+                self.store.transition(uuid, V1Statuses.COMPILED.value)
+                return
+            resolved = resolve(
+                spec,
+                run_uuid=uuid,
+                project=run["project"],
+                artifacts_path=run_artifacts_dir(self.artifacts_root, run["project"], uuid),
+                api_host=self.api_host,
+            )
+            self.store.update_run(
+                uuid,
+                compiled=resolved.compiled.to_dict(),
+                kind=resolved.compiled.get_run_kind(),
+            )
+            self.store.transition(uuid, V1Statuses.COMPILED.value)
+        except Exception as e:
+            self.store.transition(
+                uuid, V1Statuses.FAILED.value, reason="CompilationError", message=str(e)[:500],
+            )
+
+    def _maybe_schedule(self, run: dict) -> None:
+        uuid = run["uuid"]
+        spec = run.get("spec") or {}
+        if spec.get("matrix"):
+            self._start_tuner(run)
+            return
+        with self._lock:
+            if len(self._active) >= self.max_parallel:
+                return
+            if uuid in self._active:
+                return
+        try:
+            resolved = resolve(
+                run["compiled"] or spec,
+                run_uuid=uuid,
+                project=run["project"],
+                artifacts_path=run_artifacts_dir(self.artifacts_root, run["project"], uuid),
+                api_host=self.api_host,
+            )
+            self.store.transition(uuid, V1Statuses.SCHEDULED.value)
+            execution = self.executor.submit(resolved.payload)
+            with self._lock:
+                self._active[uuid] = execution
+        except Exception as e:
+            self.store.transition(
+                uuid, V1Statuses.FAILED.value, reason="SchedulingError", message=str(e)[:500],
+            )
+
+    def _do_stop(self, run: dict) -> None:
+        uuid = run["uuid"]
+        with self._lock:
+            ex = self._active.pop(uuid, None)
+        # mark stopped BEFORE killing: the dying process's late 'failed'
+        # report must land on a done status and be rejected (atomic
+        # transition in the store)
+        self.store.transition(uuid, V1Statuses.STOPPED.value, force=True)
+        if ex:
+            ex.stop()
+
+    # -- matrix pipelines --------------------------------------------------
+
+    def _start_tuner(self, run: dict) -> None:
+        uuid = run["uuid"]
+        if uuid in self._tuners:
+            return
+        from ..hypertune.tuner import Tuner
+
+        self.store.transition(uuid, V1Statuses.SCHEDULED.value)
+        self.store.transition(uuid, V1Statuses.RUNNING.value)
+
+        def _run_tuner():
+            try:
+                tuner = Tuner(self.store, run)
+                best = tuner.run()
+                self.store.merge_outputs(uuid, {"best": best})
+                self.store.transition(uuid, V1Statuses.SUCCEEDED.value)
+            except Exception as e:
+                traceback.print_exc()
+                self.store.transition(
+                    uuid, V1Statuses.FAILED.value, reason="TunerError", message=str(e)[:500],
+                )
+            finally:
+                self._tuners.pop(uuid, None)
+
+        t = threading.Thread(target=_run_tuner, daemon=True)
+        self._tuners[uuid] = t
+        t.start()
+
+    def wait_all(self, timeout: float = 300.0) -> None:
+        """Block until no runs are active/queued (tests)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            busy = self.store.list_runs(status=V1Statuses.QUEUED.value) or \
+                self.store.list_runs(status=V1Statuses.CREATED.value) or \
+                self.store.list_runs(status=V1Statuses.RUNNING.value) or \
+                self.store.list_runs(status=V1Statuses.SCHEDULED.value) or \
+                self.store.list_runs(status=V1Statuses.STARTING.value)
+            if not busy and not self._active and not self._tuners:
+                return
+            time.sleep(0.1)
+        raise TimeoutError("agent still busy")

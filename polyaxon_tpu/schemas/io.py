@@ -247,13 +247,17 @@ def validate_params_against_io(
     inputs: Optional[list[V1IO]],
     outputs: Optional[list[V1IO]],
     params: Optional[dict[str, V1Param]],
+    matrix_params: Optional[set[str]] = None,
 ) -> dict[str, Any]:
     """Check an operation's params fully satisfy a component's IO contract.
 
+    ``matrix_params`` are inputs a matrix section will bind per-trial — they
+    count as provided at validation time (the tuner fills them in).
     Returns the resolved {name: value} map. Mirrors upstream
     ``ops/params validation`` in ``polyaxon._flow.params``.
     """
     params = params or {}
+    matrix_params = matrix_params or set()
     declared = {io.name: io for io in (inputs or [])}
     declared_out = {io.name: io for io in (outputs or [])}
     resolved: dict[str, Any] = {}
@@ -266,6 +270,8 @@ def validate_params_against_io(
             )
     for name, io in declared.items():
         param = params.get(name)
+        if param is None and name in matrix_params:
+            continue
         if param is not None and param.ref is not None:
             resolved[name] = f"{{{{ {param.ref}.{param.value} }}}}"
             continue

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from typing import Annotated, Any, Literal, Optional, Union
 
-from pydantic import Field, field_validator
+from pydantic import Field, field_validator, model_validator
 
 from .base import BaseSchema
 from .io import V1Param
@@ -265,6 +265,14 @@ class V1TPUJob(_BaseRun):
         if v not in ACCELERATOR_SPECS:
             raise ValueError(f"Unknown accelerator '{v}'. Valid: {sorted(ACCELERATOR_SPECS)}")
         return v
+
+    @model_validator(mode="after")
+    def _check_slice(self) -> "V1TPUJob":
+        # eager validation: a bad topology string must fail at parse time,
+        # not when the scheduler first calls get_slice()
+        if self.topology or self.slice_alias:
+            self.get_slice()
+        return self
 
     def get_slice(self) -> SliceTopology:
         if self.slice_alias:

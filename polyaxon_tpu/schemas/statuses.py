@@ -78,7 +78,9 @@ def can_transition(src: V1Statuses, dst: V1Statuses) -> bool:
         return False
     if src in DONE_STATUSES:
         return False
-    if dst in (V1Statuses.STOPPING, V1Statuses.STOPPED, V1Statuses.UNKNOWN):
+    # terminal interventions are legal from any non-done state: stop requests,
+    # lost-contact, and failures (e.g. compile errors fail a `created` run)
+    if dst in (V1Statuses.STOPPING, V1Statuses.STOPPED, V1Statuses.UNKNOWN, V1Statuses.FAILED):
         return True
     return dst in _TRANSITIONS.get(src, set())
 

@@ -1,0 +1,15 @@
+"""Tracking/lineage (traceml equivalent — SURVEY.md §2 "Traceml" row)."""
+
+from .events import (
+    V1ArtifactKind,
+    V1Event,
+    V1EventArtifact,
+    V1EventHistogram,
+    V1EventImage,
+    V1EventKind,
+    V1EventSpan,
+    V1RunArtifact,
+)
+from .resources import ResourceLogger
+from .run import Run, end, get_run, init, log_artifact, log_metrics, log_outputs
+from .writer import EventFileWriter, LogWriter, list_event_names, read_events

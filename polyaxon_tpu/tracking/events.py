@@ -1,0 +1,126 @@
+"""Event schema — the traceml ``V1Event`` equivalent (SURVEY.md §2
+"Traceml" row, §5 "Metrics/logging": jsonl per metric name, one event per
+line, so dashboards/CLIs can tail incrementally)."""
+
+from __future__ import annotations
+
+import datetime
+import json
+from typing import Any, Optional, Union
+
+from pydantic import Field
+
+from ..schemas.base import BaseSchema
+
+
+class V1EventKind:
+    METRIC = "metric"
+    IMAGE = "image"
+    HISTOGRAM = "histogram"
+    AUDIO = "audio"
+    VIDEO = "video"
+    TEXT = "text"
+    HTML = "html"
+    CHART = "chart"
+    CURVE = "curve"
+    ARTIFACT = "artifact"
+    MODEL = "model"
+    DATAFRAME = "dataframe"
+    SPAN = "span"
+
+    ALL = {METRIC, IMAGE, HISTOGRAM, AUDIO, VIDEO, TEXT, HTML, CHART, CURVE,
+           ARTIFACT, MODEL, DATAFRAME, SPAN}
+
+
+class V1EventImage(BaseSchema):
+    path: Optional[str] = None
+    width: Optional[int] = None
+    height: Optional[int] = None
+
+
+class V1EventHistogram(BaseSchema):
+    values: list[float] = Field(default_factory=list)
+    counts: list[float] = Field(default_factory=list)
+
+
+class V1EventArtifact(BaseSchema):
+    kind: Optional[str] = None
+    path: Optional[str] = None
+
+
+class V1EventSpan(BaseSchema):
+    """Tracing span (SURVEY.md §5 tracing: jax.profiler sections logged as
+    spans so the UI can render a timeline)."""
+
+    name: Optional[str] = None
+    start: Optional[float] = None
+    end: Optional[float] = None
+    meta: Optional[dict[str, Any]] = None
+
+
+class V1Event(BaseSchema):
+    timestamp: Optional[str] = None
+    step: Optional[int] = None
+    metric: Optional[float] = None
+    image: Optional[V1EventImage] = None
+    histogram: Optional[V1EventHistogram] = None
+    text: Optional[str] = None
+    html: Optional[str] = None
+    artifact: Optional[V1EventArtifact] = None
+    span: Optional[V1EventSpan] = None
+
+    @classmethod
+    def make(cls, step: Optional[int] = None, **kwargs: Any) -> "V1Event":
+        return cls(
+            timestamp=datetime.datetime.now(datetime.timezone.utc).isoformat(),
+            step=step,
+            **kwargs,
+        )
+
+    @property
+    def kind(self) -> str:
+        for k in ("metric", "image", "histogram", "text", "html", "artifact", "span"):
+            if getattr(self, k) is not None:
+                return k
+        return V1EventKind.METRIC
+
+    def to_jsonl(self) -> str:
+        return json.dumps(self.to_dict(), separators=(",", ":"))
+
+    @classmethod
+    def from_jsonl(cls, line: str) -> "V1Event":
+        return cls.from_dict(json.loads(line))
+
+
+class V1ArtifactKind:
+    """Lineage artifact kinds (upstream ``V1ArtifactKind``)."""
+
+    MODEL = "model"
+    AUDIO = "audio"
+    VIDEO = "video"
+    DATASET = "dataset"
+    DATAFRAME = "dataframe"
+    IMAGE = "image"
+    TENSORBOARD = "tensorboard"
+    CODEREF = "coderef"
+    FILE = "file"
+    DIR = "dir"
+    DOCKERFILE = "dockerfile"
+    METRIC = "metric"
+    ENV = "env"
+    CHECKPOINT = "checkpoint"
+    PROFILE = "profile"  # jax.profiler trace dirs
+
+    ALL = {MODEL, AUDIO, VIDEO, DATASET, DATAFRAME, IMAGE, TENSORBOARD,
+           CODEREF, FILE, DIR, DOCKERFILE, METRIC, ENV, CHECKPOINT, PROFILE}
+
+
+class V1RunArtifact(BaseSchema):
+    """Lineage record linking a run to an artifact."""
+
+    name: Optional[str] = None
+    kind: Optional[str] = None
+    path: Optional[str] = None
+    state: Optional[str] = None
+    summary: Optional[dict[str, Any]] = None
+    is_input: Optional[bool] = None

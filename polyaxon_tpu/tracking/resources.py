@@ -1,0 +1,67 @@
+"""Host/TPU resource logger (upstream traceml ``ResourceLogger`` used
+psutil/pynvml; the TPU equivalent reads jax.local_devices memory stats)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .run import Run
+
+
+def sample_host() -> dict:
+    import psutil
+
+    vm = psutil.virtual_memory()
+    return {
+        "host_cpu_percent": psutil.cpu_percent(interval=None),
+        "host_mem_percent": vm.percent,
+        "host_mem_used_gib": vm.used / 2**30,
+    }
+
+
+def sample_tpu() -> dict:
+    """Per-device HBM stats via jax memory_stats (no-op off-accelerator)."""
+    out: dict = {}
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            stats = d.memory_stats() or {}
+            if "bytes_in_use" in stats:
+                out[f"tpu{d.id}_hbm_gib"] = stats["bytes_in_use"] / 2**30
+            if "peak_bytes_in_use" in stats:
+                out[f"tpu{d.id}_hbm_peak_gib"] = stats["peak_bytes_in_use"] / 2**30
+    except Exception:
+        pass
+    return out
+
+
+class ResourceLogger:
+    """Background thread logging host + TPU resource metrics every
+    ``interval`` seconds to the run's event files."""
+
+    def __init__(self, run: Run, interval: float = 10.0, tpu: bool = True):
+        self.run = run
+        self.interval = interval
+        self.tpu = tpu
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ResourceLogger":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            metrics = sample_host()
+            if self.tpu:
+                metrics.update(sample_tpu())
+            self.run.log_metrics(**metrics)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

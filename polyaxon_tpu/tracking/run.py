@@ -1,0 +1,168 @@
+"""User-facing tracking API (upstream ``from polyaxon import tracking``):
+
+    from polyaxon_tpu import tracking
+    tracking.init()                       # attaches via PLX_* env in-cluster
+    tracking.log_metrics(step=i, loss=0.3, mfu=0.46)
+    tracking.log_artifact("model", path, kind="checkpoint")
+
+Events land in the run's artifacts dir (writer.py layout); when an API host
+is configured, statuses/outputs also post there. Works fully offline — the
+same code runs on a laptop or a TPU host pod (SURVEY.md §3(d))."""
+
+from __future__ import annotations
+
+import os
+import uuid as uuid_mod
+from typing import Any, Optional
+
+from .events import V1Event, V1EventArtifact, V1EventHistogram, V1EventSpan, V1RunArtifact
+from .writer import EventFileWriter, LogWriter
+
+# Env contract injected by the compiler/operator (compiler/converter.py).
+ENV_RUN_UUID = "PLX_RUN_UUID"
+ENV_PROJECT = "PLX_PROJECT"
+ENV_ARTIFACTS_PATH = "PLX_ARTIFACTS_PATH"
+ENV_API_HOST = "PLX_API_HOST"
+
+
+class Run:
+    """A tracked run: event/log writers + optional API client binding."""
+
+    def __init__(
+        self,
+        run_uuid: Optional[str] = None,
+        project: Optional[str] = None,
+        artifacts_path: Optional[str] = None,
+        api_host: Optional[str] = None,
+        client: Any = None,
+    ):
+        self.run_uuid = run_uuid or os.environ.get(ENV_RUN_UUID) or uuid_mod.uuid4().hex
+        self.project = project or os.environ.get(ENV_PROJECT, "default")
+        base = artifacts_path or os.environ.get(ENV_ARTIFACTS_PATH)
+        if base is None:
+            base = os.path.join(os.getcwd(), ".plx", "runs", self.run_uuid)
+        self.run_dir = base
+        os.makedirs(self.run_dir, exist_ok=True)
+        self._writer = EventFileWriter(self.run_dir)
+        self._logger = LogWriter(self.run_dir)
+        self._outputs: dict[str, Any] = {}
+        self._lineage: list[V1RunArtifact] = []
+        api_host = api_host or os.environ.get(ENV_API_HOST)
+        if client is None and api_host:
+            from ..client import RunClient
+
+            client = RunClient(host=api_host, project=self.project, run_uuid=self.run_uuid)
+        self.client = client
+
+    # -- logging -----------------------------------------------------------
+
+    def log_metrics(self, step: Optional[int] = None, **metrics: float) -> None:
+        for name, value in metrics.items():
+            self._writer.add("metric", name, V1Event.make(step=step, metric=float(value)))
+
+    def log_metric(self, name: str, value: float, step: Optional[int] = None) -> None:
+        self.log_metrics(step=step, **{name: value})
+
+    def log_text(self, name: str, text: str, step: Optional[int] = None) -> None:
+        self._writer.add("text", name, V1Event.make(step=step, text=text))
+
+    def log_histogram(
+        self, name: str, values: list[float], counts: list[float], step: Optional[int] = None
+    ) -> None:
+        self._writer.add(
+            "histogram", name,
+            V1Event.make(step=step, histogram=V1EventHistogram(values=values, counts=counts)),
+        )
+
+    def log_span(self, name: str, start: float, end: float, **meta: Any) -> None:
+        self._writer.add(
+            "span", name,
+            V1Event.make(span=V1EventSpan(name=name, start=start, end=end, meta=meta or None)),
+        )
+
+    def log_line(self, line: str) -> None:
+        self._logger.write(line)
+
+    # -- outputs / lineage -------------------------------------------------
+
+    def log_outputs(self, **outputs: Any) -> None:
+        self._outputs.update(outputs)
+        if self.client:
+            self.client.log_outputs(**outputs)
+
+    def log_artifact(
+        self, name: str, path: str, kind: str = "file", is_input: bool = False,
+        summary: Optional[dict] = None,
+    ) -> None:
+        art = V1RunArtifact(name=name, kind=kind, path=path, is_input=is_input, summary=summary)
+        self._lineage.append(art)
+        self._writer.add(
+            "artifact", name,
+            V1Event.make(artifact=V1EventArtifact(kind=kind, path=path)),
+        )
+        if self.client:
+            self.client.log_artifact_lineage(art)
+
+    @property
+    def outputs_dir(self) -> str:
+        d = os.path.join(self.run_dir, "outputs")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def log_status(self, status: str, reason: Optional[str] = None, message: Optional[str] = None) -> None:
+        if self.client:
+            self.client.log_status(status, reason=reason, message=message)
+
+    def end(self, status: Optional[str] = None) -> None:
+        self._writer.flush()
+        if self._outputs:
+            # durable copy for the offline path: the agent merges this into
+            # the store when the run finishes (scheduler/agent.py)
+            import json
+
+            with open(os.path.join(self.run_dir, "outputs.json"), "w", encoding="utf-8") as f:
+                json.dump(self._outputs, f)
+            if self.client:
+                self.client.log_outputs(**self._outputs)
+        if status:
+            self.log_status(status)
+        self._writer.close()
+        self._logger.close()
+
+
+# -- module-level convenience (upstream `tracking.init()` pattern) ----------
+
+_active: Optional[Run] = None
+
+
+def init(**kwargs: Any) -> Run:
+    global _active
+    _active = Run(**kwargs)
+    return _active
+
+
+def get_run() -> Run:
+    if _active is None:
+        return init()
+    return _active
+
+
+def log_metrics(step: Optional[int] = None, **metrics: float) -> None:
+    get_run().log_metrics(step=step, **metrics)
+
+
+def log_outputs(**outputs: Any) -> None:
+    get_run().log_outputs(**outputs)
+
+
+def log_artifact(name: str, path: str, kind: str = "file", **kw: Any) -> None:
+    get_run().log_artifact(name, path, kind=kind, **kw)
+
+
+def end(status: Optional[str] = None) -> None:
+    global _active
+    if _active is not None:
+        _active.end(status)
+        _active = None

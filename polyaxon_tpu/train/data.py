@@ -68,8 +68,13 @@ def token_file_batches(cfg: DataConfig, mesh: Optional[Mesh] = None) -> Iterator
     """Stream fixed-length windows from a flat token array on disk
     (np.memmap; the standard packed-corpus format)."""
     assert cfg.path, "tokens-file data needs `path`"
-    tokens = np.load(cfg.path, mmap_mode="r") if cfg.path.endswith(".npy") else \
-        np.memmap(cfg.path, dtype=np.uint16, mode="r")
+    if cfg.path.endswith(".npy"):
+        tokens = np.load(cfg.path, mmap_mode="r")
+    else:
+        # raw .bin carries no dtype header: pick the narrowest type that can
+        # hold the vocab (uint16 breaks >65535-token vocabs)
+        dtype = np.uint16 if cfg.vocab_size <= np.iinfo(np.uint16).max + 1 else np.uint32
+        tokens = np.memmap(cfg.path, dtype=dtype, mode="r")
     n = len(tokens) - cfg.seq_len - 1
     rng = np.random.default_rng(cfg.seed)
     sharding = _batch_sharding(mesh, 1, seq_axis=True)

@@ -1,0 +1,147 @@
+"""API + client + tracking integration tests (no JAX): in-proc aiohttp
+server, status lifecycle, metrics/logs/artifacts read paths — the converter
+and e2e test strategy SURVEY.md §4 describes."""
+
+import os
+import time
+
+import pytest
+
+from polyaxon_tpu.api import ApiServer
+from polyaxon_tpu.client import ApiError, ProjectClient, RunClient
+from polyaxon_tpu.schemas.statuses import V1Statuses
+from polyaxon_tpu.tracking import Run, read_events
+
+
+@pytest.fixture()
+def server(tmp_path):
+    s = ApiServer(db_path=":memory:", artifacts_root=str(tmp_path / "artifacts"), port=0)
+    s.start()
+    yield s
+    s.stop()
+
+
+class TestProjects:
+    def test_crud(self, server):
+        pc = ProjectClient(server.url)
+        pc.create("alpha", "first")
+        assert pc.get("alpha")["description"] == "first"
+        assert [p["name"] for p in pc.list()] == ["alpha"]
+
+
+class TestRunLifecycle:
+    def test_create_and_transitions(self, server):
+        rc = RunClient(server.url, project="p1")
+        run = rc.create(spec={"kind": "operation"}, name="train", kind="job")
+        assert run["status"] == "created"
+
+        for st in ("compiled", "queued", "scheduled", "starting", "running", "succeeded"):
+            out = rc.log_status(st)
+            assert out["changed"], st
+        final = rc.refresh()
+        assert final["status"] == "succeeded"
+        assert final["finished_at"]
+
+        conds = rc.get_statuses()["conditions"]
+        assert [c["type"] for c in conds][:3] == ["created", "compiled", "queued"]
+
+    def test_illegal_transition_rejected(self, server):
+        rc = RunClient(server.url, project="p1")
+        rc.create(spec={}, name="x")
+        out = rc.log_status("succeeded")  # created -> succeeded is not legal
+        assert not out["changed"]
+        assert rc.refresh()["status"] == "created"
+
+    def test_stop_always_allowed(self, server):
+        rc = RunClient(server.url, project="p1")
+        rc.create(spec={})
+        rc.stop()
+        assert rc.refresh()["status"] == "stopping"
+
+    def test_outputs_merge(self, server):
+        rc = RunClient(server.url, project="p1")
+        rc.create(spec={})
+        rc.log_outputs(accuracy=0.9)
+        rc.log_outputs(loss=0.1)
+        out = rc.refresh()["outputs"]
+        assert out == {"accuracy": 0.9, "loss": 0.1}
+
+    def test_restart_clone_carries_resume_meta(self, server):
+        rc = RunClient(server.url, project="p1")
+        orig = rc.create(spec={"a": 1})
+        clone = rc.restart()
+        assert clone["original_uuid"] == orig["uuid"]
+        assert clone["cloning_kind"] == "restart"
+        assert orig["uuid"] in clone["meta"]["resume_from"]
+        assert clone["spec"] == {"a": 1}
+
+    def test_missing_run_404(self, server):
+        rc = RunClient(server.url, project="p1", run_uuid="nope")
+        with pytest.raises(ApiError) as e:
+            rc.refresh()
+        assert e.value.status == 404
+
+    def test_wait_reaches_terminal(self, server):
+        rc = RunClient(server.url, project="p1")
+        rc.create(spec={})
+        rc.log_status("compiled"); rc.log_status("queued")
+        rc.log_status("scheduled"); rc.log_status("running")
+        rc.log_status("failed", reason="OOM")
+        run = rc.wait(timeout=5)
+        assert run["status"] == "failed"
+
+
+class TestTrackingIntegration:
+    def test_events_written_and_served(self, server):
+        rc = RunClient(server.url, project="p1")
+        run = rc.create(spec={})
+        run_dir = server.api.run_dir("p1", run["uuid"])
+
+        tr = Run(run_uuid=run["uuid"], project="p1", artifacts_path=run_dir)
+        for i in range(5):
+            tr.log_metrics(step=i, loss=1.0 / (i + 1), mfu=0.4)
+        tr.log_line("hello from training")
+        with open(os.path.join(tr.outputs_dir, "model.bin"), "wb") as f:
+            f.write(b"\x00" * 16)
+        tr.end()
+
+        metrics = rc.get_metrics(["loss"])
+        assert len(metrics["loss"]) == 5
+        assert metrics["loss"][0]["metric"] == 1.0
+
+        logs, offset = rc.get_logs()
+        assert "hello from training" in logs and offset > 0
+
+        tree = rc.artifacts_tree()
+        assert "outputs" in tree["dirs"] and "events" in tree["dirs"]
+        sub = rc.artifacts_tree("outputs")
+        assert sub["files"][0]["name"] == "model.bin"
+
+    def test_lineage_roundtrip(self, server):
+        rc = RunClient(server.url, project="p1")
+        run = rc.create(spec={})
+        run_dir = server.api.run_dir("p1", run["uuid"])
+        tr = Run(run_uuid=run["uuid"], project="p1", artifacts_path=run_dir,
+                 client=rc)
+        tr.log_artifact("ckpt", "outputs/ckpt-10", kind="checkpoint")
+        tr.end()
+        lin = rc.get_lineage()
+        assert lin[0]["name"] == "ckpt" and lin[0]["kind"] == "checkpoint"
+
+    def test_path_traversal_blocked(self, server):
+        rc = RunClient(server.url, project="p1")
+        rc.create(spec={})
+        with pytest.raises(ApiError) as e:
+            rc.artifacts_tree("../..")
+        assert e.value.status == 404
+
+
+class TestOfflineTracking:
+    def test_offline_run_writes_local(self, tmp_path):
+        tr = Run(artifacts_path=str(tmp_path / "run1"))
+        tr.log_metrics(step=1, loss=0.5)
+        tr.log_text("note", "offline works")
+        tr.end()
+        events = read_events(str(tmp_path / "run1"), "metric", "loss")
+        assert events[0].metric == 0.5
+        assert read_events(str(tmp_path / "run1"), "text", "note")[0].text == "offline works"

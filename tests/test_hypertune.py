@@ -1,0 +1,260 @@
+"""Hypertune tests: manager math (grid combos, Hyperband brackets, Bayes
+convergence on a known optimum) + the full tuner pipeline through the agent
+(SURVEY.md §3(c) call stack)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.hypertune import (
+    BayesManager,
+    GridSearchManager,
+    HyperbandManager,
+    HyperoptManager,
+    MappingManager,
+    Observation,
+    RandomSearchManager,
+    make_manager,
+)
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.scheduler.agent import LocalAgent
+from polyaxon_tpu.schemas.matrix import (
+    V1Bayes,
+    V1GridSearch,
+    V1Hyperband,
+    V1Hyperopt,
+    V1Mapping,
+    V1RandomSearch,
+)
+
+
+def _hp(d):
+    from polyaxon_tpu.schemas.matrix import V1GridSearch
+
+    return d
+
+
+class TestGrid:
+    def test_combinations(self):
+        cfg = V1GridSearch.from_dict({
+            "kind": "grid",
+            "params": {
+                "lr": {"kind": "choice", "value": [0.1, 0.01]},
+                "bs": {"kind": "range", "value": [16, 65, 16]},
+            },
+        })
+        m = GridSearchManager(cfg)
+        suggs = m.suggest([])
+        assert len(suggs) == 2 * 4  # lr x bs(16,32,48,64)
+        assert {s.params["lr"] for s in suggs} == {0.1, 0.01}
+        assert m.done([Observation(params=s.params, metric=0.0) for s in suggs])
+
+    def test_non_enumerable_rejected(self):
+        with pytest.raises(Exception, match="non-enumerable"):
+            V1GridSearch.from_dict({
+                "kind": "grid",
+                "params": {"lr": {"kind": "uniform", "value": [0, 1]}},
+            })
+
+
+class TestRandom:
+    def test_count_and_bounds(self):
+        cfg = V1RandomSearch.from_dict({
+            "kind": "random", "numRuns": 10, "seed": 1,
+            "params": {
+                "lr": {"kind": "loguniform", "value": [1e-5, 1e-1]},
+                "opt": {"kind": "choice", "value": ["adam", "sgd"]},
+            },
+        })
+        m = RandomSearchManager(cfg)
+        suggs = m.suggest([])
+        assert len(suggs) == 10
+        for s in suggs:
+            assert 1e-5 <= s.params["lr"] <= 1e-1
+            assert s.params["opt"] in ("adam", "sgd")
+
+
+class TestHyperband:
+    def test_bracket_math_r81_eta3(self):
+        # Classic Li et al. example: R=81, eta=3 -> s_max=4, 5 brackets
+        cfg = V1Hyperband.from_dict({
+            "kind": "hyperband", "maxIterations": 81, "eta": 3,
+            "resource": {"name": "epochs", "type": "int"},
+            "metric": {"name": "acc", "optimization": "maximize"},
+            "params": {"lr": {"kind": "uniform", "value": [0, 1]}},
+        })
+        m = HyperbandManager(cfg)
+        assert m.s_max == 4
+        sizes = m.bracket_sizes(4)
+        assert sizes[0] == (81, 1)   # n=81 configs at r=1
+        assert sizes[-1][1] == 81    # last rung gets full budget
+        assert m.bracket_sizes(0)[0] == (5, 81)
+
+    def test_promotion_flow(self):
+        cfg = V1Hyperband.from_dict({
+            "kind": "hyperband", "maxIterations": 9, "eta": 3,
+            "resource": {"name": "steps", "type": "int"},
+            "metric": {"name": "acc", "optimization": "maximize"},
+            "params": {"lr": {"kind": "uniform", "value": [0, 1]}},
+            "seed": 0,
+        })
+        m = HyperbandManager(cfg)
+        obs = []
+        # bracket s=2 rung 0
+        rung0 = m.suggest(obs)
+        assert all(s.params["steps"] == 1 for s in rung0)
+        assert all(s.meta == {"bracket": 2, "rung": 0} for s in rung0)
+        for i, s in enumerate(rung0):
+            obs.append(Observation(params=s.params, metric=float(i), trial_meta=s.meta))
+        # rung 1 should promote top third with 3x budget
+        rung1 = m.suggest(obs)
+        assert len(rung1) == len(rung0) // 3
+        assert all(s.params["steps"] == 3 for s in rung1)
+        best_lr = max(obs, key=lambda o: o.metric).params["lr"]
+        assert any(abs(s.params["lr"] - best_lr) < 1e-12 for s in rung1)
+
+    def test_total_schedule_terminates(self):
+        cfg = V1Hyperband.from_dict({
+            "kind": "hyperband", "maxIterations": 9, "eta": 3,
+            "resource": {"name": "steps"},
+            "metric": {"name": "acc"},
+            "params": {"lr": {"kind": "uniform", "value": [0, 1]}},
+        })
+        m = HyperbandManager(cfg)
+        obs = []
+        rounds = 0
+        while not m.done(obs) and rounds < 50:
+            batch = m.suggest(obs)
+            rounds += 1
+            for s in batch:
+                obs.append(Observation(params=s.params, metric=np.random.rand(),
+                                       trial_meta=s.meta))
+        assert m.done(obs)
+
+
+class TestBayes:
+    def test_converges_near_optimum(self):
+        # maximize -(x-0.3)^2: optimum at 0.3
+        cfg = V1Bayes.from_dict({
+            "kind": "bayes", "numInitialRuns": 5, "maxIterations": 15,
+            "metric": {"name": "obj", "optimization": "maximize"},
+            "params": {"x": {"kind": "uniform", "value": [0, 1]}},
+            "seed": 42,
+        })
+        m = BayesManager(cfg)
+        obs = []
+        while not m.done(obs):
+            for s in m.suggest(obs):
+                x = s.params["x"]
+                obs.append(Observation(params=s.params, metric=-(x - 0.3) ** 2))
+        best = m.best(obs)
+        assert abs(best.params["x"] - 0.3) < 0.1, best.params
+
+    def test_minimize(self):
+        cfg = V1Bayes.from_dict({
+            "kind": "bayes", "numInitialRuns": 4, "maxIterations": 8,
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "params": {"x": {"kind": "uniform", "value": [-1, 1]}},
+            "seed": 7,
+        })
+        m = BayesManager(cfg)
+        obs = []
+        while not m.done(obs):
+            for s in m.suggest(obs):
+                obs.append(Observation(params=s.params, metric=s.params["x"] ** 2))
+        assert abs(m.best(obs).params["x"]) < 0.3
+
+
+class TestTPE:
+    def test_improves_over_random(self):
+        cfg = V1Hyperopt.from_dict({
+            "kind": "hyperopt", "algorithm": "tpe", "numRuns": 30,
+            "metric": {"name": "obj", "optimization": "maximize"},
+            "params": {"x": {"kind": "uniform", "value": [0, 1]}},
+            "seed": 3,
+        })
+        m = HyperoptManager(cfg)
+        obs = []
+        while not m.done(obs):
+            for s in m.suggest(obs):
+                if m.done(obs):
+                    break
+                x = s.params["x"]
+                obs.append(Observation(params=s.params, metric=-(x - 0.7) ** 2))
+        assert abs(m.best(obs).params["x"] - 0.7) < 0.15
+
+
+class TestMakeManager:
+    def test_dispatch(self):
+        cfg = V1Mapping.from_dict({"kind": "mapping", "values": [{"a": 1}]})
+        assert isinstance(make_manager(cfg), MappingManager)
+
+
+TRIAL_SCRIPT = """
+import json, os
+params = json.loads(os.environ["PLX_PARAMS"])
+x = float(params["x"])
+out = {"score": -(x - 0.5) ** 2}
+with open(os.path.join(os.environ["PLX_ARTIFACTS_PATH"], "outputs.json"), "w") as f:
+    json.dump(out, f)
+print("trial", params, out)
+"""
+
+
+def _sweep_spec(matrix: dict) -> dict:
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "sweep",
+        "matrix": matrix,
+        "component": {
+            "kind": "component",
+            "inputs": [{"name": "x", "type": "float"}],
+            "run": {
+                "kind": "job",
+                "init": [{"file": {"filename": "trial.py", "content": TRIAL_SCRIPT}}],
+                "container": {"command": [sys.executable, "trial.py"]},
+            },
+        },
+    }).to_dict()
+
+
+class TestTunerE2E:
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path / "a"), max_parallel=4)
+        agent.start()
+        yield store, agent
+        agent.stop()
+
+    def test_grid_sweep_end_to_end(self, stack):
+        store, agent = stack
+        spec = _sweep_spec({
+            "kind": "grid",
+            "concurrency": 4,
+            "params": {"x": {"kind": "linspace", "value": [0, 1, 5]}},
+        })
+        pipeline = store.create_run("p1", spec=spec, name="sweep")
+        agent.wait_all(timeout=180)
+        final = store.get_run(pipeline["uuid"])
+        assert final["status"] == "succeeded", store.get_statuses(pipeline["uuid"])
+        best = final["outputs"]["best"]
+        assert best["num_trials"] == 5
+        assert abs(best["best_params"]["x"] - 0.5) < 1e-9
+        trials = store.list_runs(pipeline_uuid=pipeline["uuid"])
+        assert len(trials) == 5
+        assert all(t["status"] == "succeeded" for t in trials)
+
+    def test_mapping_sweep(self, stack):
+        store, agent = stack
+        spec = _sweep_spec({
+            "kind": "mapping",
+            "values": [{"x": 0.1}, {"x": 0.5}, {"x": 0.9}],
+        })
+        pipeline = store.create_run("p1", spec=spec, name="map-sweep")
+        agent.wait_all(timeout=120)
+        final = store.get_run(pipeline["uuid"])
+        assert final["status"] == "succeeded"
+        assert final["outputs"]["best"]["best_params"]["x"] == 0.5

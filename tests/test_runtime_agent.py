@@ -1,0 +1,144 @@
+"""Local executor + agent tests: the in-proc "fake cluster" e2e path
+(SURVEY.md §4 "Integration/e2e")."""
+
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.compiler.converter import LocalPayload
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.runtime.local import LocalExecutor
+from polyaxon_tpu.scheduler.agent import LocalAgent
+from polyaxon_tpu.schemas.statuses import V1Statuses
+
+
+def _payload(tmp_path, argv, **kw):
+    return LocalPayload(
+        run_uuid="u1", project="p", argv=argv, env={},
+        artifacts_path=str(tmp_path / "run"), **kw,
+    )
+
+
+class TestLocalExecutor:
+    def test_success_and_logs(self, tmp_path):
+        statuses = []
+        ex = LocalExecutor(on_status=lambda u, s, m: statuses.append(s))
+        e = ex.submit(_payload(tmp_path, [sys.executable, "-c", "print('hello world')"]),
+                      block=True)
+        assert e.returncode == 0
+        assert statuses[-1] == "succeeded"
+        logs = (tmp_path / "run" / "logs" / "run.plx.log").read_text()
+        assert "hello world" in logs
+
+    def test_failure_reports_exit_code(self, tmp_path):
+        statuses = []
+        ex = LocalExecutor(on_status=lambda u, s, m: statuses.append((s, m)))
+        e = ex.submit(_payload(tmp_path, [sys.executable, "-c", "raise SystemExit(3)"]),
+                      block=True)
+        assert e.returncode == 3
+        assert statuses[-1] == ("failed", "exit code 3")
+
+    def test_retries(self, tmp_path):
+        # fails until a marker file exists, created on first attempt
+        marker = tmp_path / "marker"
+        code = textwrap.dedent(f"""
+            import os, sys
+            if os.path.exists({str(marker)!r}):
+                sys.exit(0)
+            open({str(marker)!r}, "w").close()
+            sys.exit(1)
+        """)
+        statuses = []
+        ex = LocalExecutor(on_status=lambda u, s, m: statuses.append(s))
+        e = ex.submit(_payload(tmp_path, [sys.executable, "-c", code], max_retries=2),
+                      block=True)
+        assert e.returncode == 0
+        assert "retrying" in statuses
+        assert statuses[-1] == "succeeded"
+
+    def test_init_file_step(self, tmp_path):
+        # workdir defaults to the code dir when init populates one
+        p = _payload(
+            tmp_path, [sys.executable, "hello.py"],
+            init=[{"file": {"filename": "hello.py", "content": "print('from init')"}}],
+        )
+        ex = LocalExecutor()
+        e = ex.submit(p, block=True)
+        assert e.returncode == 0
+
+    def test_bad_init_fails_run(self, tmp_path):
+        statuses = []
+        ex = LocalExecutor(on_status=lambda u, s, m: statuses.append((s, m)))
+        p = _payload(tmp_path, ["true"], init=[{"paths": ["/nonexistent/x"]}])
+        ex.submit(p, block=True)
+        assert statuses[-1][0] == "failed"
+        assert "init failed" in statuses[-1][1]
+
+
+IRIS = os.path.join(os.path.dirname(__file__), "..", "examples", "iris.yaml")
+
+
+class TestAgentE2E:
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path / "artifacts"))
+        agent.start()
+        yield store, agent
+        agent.stop()
+
+    def test_full_lifecycle(self, stack, tmp_path):
+        store, agent = stack
+        spec = check_polyaxonfile(
+            {"kind": "component",
+             "run": {"kind": "job",
+                     "container": {"command": [sys.executable, "-c", "print('ok')"]}}}
+        ).to_dict()
+        run = store.create_run("p1", spec=spec, name="t")
+        agent.wait_all(timeout=60)
+        final = store.get_run(run["uuid"])
+        assert final["status"] == "succeeded"
+        types = [c["type"] for c in store.get_statuses(run["uuid"])]
+        for expected in ("created", "compiled", "queued", "scheduled", "running", "succeeded"):
+            assert expected in types, types
+
+    def test_iris_example_with_outputs(self, stack):
+        store, agent = stack
+        op = check_polyaxonfile(IRIS)
+        run = store.create_run("p1", spec=op.to_dict(), name="iris")
+        agent.wait_all(timeout=120)
+        final = store.get_run(run["uuid"])
+        assert final["status"] == "succeeded", store.get_statuses(run["uuid"])
+        assert final["outputs"]["accuracy"] > 0.9
+
+    def test_compile_error_fails_fast(self, stack):
+        store, agent = stack
+        run = store.create_run("p1", spec={"kind": "operation"}, name="broken")
+        agent.wait_all(timeout=30)
+        final = store.get_run(run["uuid"])
+        assert final["status"] == "failed"
+        conds = store.get_statuses(run["uuid"])
+        assert any(c.get("reason") == "CompilationError" for c in conds)
+
+    def test_stop_running_run(self, stack):
+        store, agent = stack
+        spec = check_polyaxonfile(
+            {"kind": "component",
+             "run": {"kind": "job",
+                     "container": {"command": [sys.executable, "-c",
+                                               "import time; time.sleep(60)"]}}}
+        ).to_dict()
+        run = store.create_run("p1", spec=spec)
+        deadline = time.monotonic() + 30
+        while store.get_run(run["uuid"])["status"] != "running":
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        store.transition(run["uuid"], V1Statuses.STOPPING.value)
+        deadline = time.monotonic() + 30
+        while store.get_run(run["uuid"])["status"] != "stopped":
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
