@@ -237,8 +237,11 @@ class ApiApp:
         )
 
     def _safe_path(self, rd: str, rel: str) -> Optional[str]:
-        p = os.path.abspath(os.path.join(rd, rel))
-        if not (p + os.sep).startswith(os.path.abspath(rd) + os.sep) and p != os.path.abspath(rd):
+        # realpath on both sides so a symlink planted inside the run dir
+        # cannot escape the artifacts root
+        root = os.path.realpath(rd)
+        p = os.path.realpath(os.path.join(rd, rel))
+        if not (p + os.sep).startswith(root + os.sep) and p != root:
             return None
         return p
 

@@ -240,12 +240,15 @@ class Store:
         return self.get_run(uuid)
 
     def merge_outputs(self, uuid: str, outputs: dict) -> Optional[dict]:
-        run = self.get_run(uuid)
-        if run is None:
-            return None
-        merged = dict(run.get("outputs") or {})
-        merged.update(outputs)
-        return self.update_run(uuid, outputs=merged)
+        # serialize the read-modify-write: concurrent writers (API
+        # post_outputs, agent _collect_outputs, tuner merge) must not drop keys
+        with self._transition_lock:
+            run = self.get_run(uuid)
+            if run is None:
+                return None
+            merged = dict(run.get("outputs") or {})
+            merged.update(outputs)
+            return self.update_run(uuid, outputs=merged)
 
     def delete_run(self, uuid: str) -> bool:
         with self._conn_ctx() as conn:

@@ -23,14 +23,15 @@ from ..tracking.writer import LogWriter
 from .init import InitError, run_init_step
 
 
-def _pythonpath_env() -> dict[str, str]:
-    """Make the framework importable in child processes even when it is run
-    from a source tree rather than installed (local/e2e mode)."""
+def _with_pythonpath(env: dict) -> dict:
+    """Prepend the framework source root to the (already merged) child env's
+    PYTHONPATH so the package is importable without being installed, while
+    preserving any PYTHONPATH the operation's env spec set."""
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    existing = os.environ.get("PYTHONPATH", "")
-    if pkg_root in existing.split(os.pathsep):
-        return {}
-    return {"PYTHONPATH": f"{pkg_root}{os.pathsep}{existing}" if existing else pkg_root}
+    existing = env.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        env["PYTHONPATH"] = f"{pkg_root}{os.pathsep}{existing}" if existing else pkg_root
+    return env
 
 
 class LocalExecution:
@@ -129,22 +130,46 @@ class LocalExecutor:
 
     def _run_main(self, payload: LocalPayload, execution: LocalExecution, log: LogWriter) -> int:
         if payload.builtin is not None:
-            return self._run_builtin(payload, log)
+            return self._run_builtin(payload, execution, log)
         if not payload.argv:
             log.write("[main] no container command; nothing to run")
             return 0
-        env = {**os.environ, **payload.env, **_pythonpath_env()}
+        env = _with_pythonpath({**os.environ, **payload.env})
         workdir = payload.workdir or os.path.join(payload.artifacts_path, "code")
         if not os.path.isdir(workdir):
             workdir = payload.artifacts_path
+        return self._spawn_and_pump(payload, execution, log, payload.argv, env, workdir)
+
+    def _run_builtin(self, payload: LocalPayload, execution: LocalExecution, log: LogWriter) -> int:
+        """`runtime:` shortcut — run the built-in trainer in a subprocess so
+        crashes/OOMs behave like user containers."""
+        import json
+
+        spec = dict(payload.builtin or {})
+        env = _with_pythonpath({**os.environ, **payload.env})
+        env["PLX_BUILTIN_SPEC"] = json.dumps(spec)
+        argv = [sys.executable, "-m", "polyaxon_tpu.runtime.builtin"]
+        return self._spawn_and_pump(payload, execution, log, argv, env, payload.artifacts_path)
+
+    def _spawn_and_pump(
+        self,
+        payload: LocalPayload,
+        execution: LocalExecution,
+        log: LogWriter,
+        argv: list,
+        env: dict,
+        workdir: str,
+    ) -> int:
         proc = subprocess.Popen(
-            payload.argv,
+            argv,
             env=env,
             cwd=workdir,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
         )
+        # expose the live proc so stop() (agent _do_stop, tuner early stop)
+        # can actually kill the run instead of only flipping its status
         execution.proc = proc
         # watchdog, not an in-loop check: a hung process that prints nothing
         # must still be killed at the deadline
@@ -165,25 +190,6 @@ class LocalExecutor:
         finally:
             if watchdog:
                 watchdog.cancel()
-
-    def _run_builtin(self, payload: LocalPayload, log: LogWriter) -> int:
-        """`runtime:` shortcut — run the built-in trainer in a subprocess so
-        crashes/OOMs behave like user containers."""
-        import json
-
-        spec = dict(payload.builtin or {})
-        env = {**os.environ, **payload.env, "PLX_BUILTIN_SPEC": json.dumps(spec)}
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "polyaxon_tpu.runtime.builtin"],
-            env=env,
-            cwd=payload.artifacts_path,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for line in proc.stdout:  # type: ignore[union-attr]
-            log.write(line)
-        return proc.wait()
 
     # -- sidecar -----------------------------------------------------------
 

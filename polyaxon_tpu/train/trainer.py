@@ -91,28 +91,40 @@ class Trainer:
         return init_fn(key)
 
     def _state_shardings(self, abstract_state):
-        """Params get logical shardings; everything else (opt moments) mirrors
-        the matching param leaf when shapes line up, else replicated."""
-        param_leaves = jax.tree.leaves(
-            self.param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
+        """Params get logical shardings; optimizer-state subtrees that are
+        structurally param trees (adam mu/nu, etc.) inherit the param
+        sharding tree wholesale; scalar bookkeeping (count) is replicated.
+
+        Structural — not shape-keyed — so two distinct params sharing
+        shape+dtype but different PartitionSpecs still get the right moment
+        shardings (ADVICE r1)."""
+        replicated = NamedSharding(self.mesh, P())
+        abstract_params = abstract_state.params
+        params_struct = jax.tree.structure(abstract_params)
+
+        def _is_param_subtree(x):
+            return jax.tree.structure(x) == params_struct
+
+        def _shard(node):
+            if _is_param_subtree(node):
+                # per-leaf shape guard: factored moments (adafactor v_row/
+                # v_col) share the params tree structure but reduced-rank
+                # leaves — those must be replicated, not given rank-N specs
+                return jax.tree.map(
+                    lambda leaf, ap, sh: sh
+                    if getattr(leaf, "shape", None) == ap.shape
+                    else replicated,
+                    node, abstract_params, self.param_shardings,
+                )
+            return jax.tree.map(lambda _: replicated, node)
+
+        opt_shardings = jax.tree.map(
+            _shard, abstract_state.opt_state, is_leaf=_is_param_subtree
         )
-        param_shapes = jax.tree.leaves(jax.eval_shape(
-            lambda: transformer.init(jax.random.PRNGKey(0), self.cfg.model)
-        ))
-        shape_to_sharding = {}
-        for sh, sd in zip(param_shapes, param_leaves):
-            shape_to_sharding.setdefault((sh.shape, sh.dtype), sd)
-
-        def pick(x):
-            if not hasattr(x, "shape"):
-                return NamedSharding(self.mesh, P())
-            return shape_to_sharding.get(
-                (x.shape, x.dtype), NamedSharding(self.mesh, P())
-            )
-
-        struct = jax.tree.structure(abstract_state)
-        return jax.tree.unflatten(
-            struct, [pick(x) for x in jax.tree.leaves(abstract_state)]
+        return TrainState(
+            params=self.param_shardings,
+            opt_state=opt_shardings,
+            step=replicated,
         )
 
     def restore_or_init(self, seed: int = 0) -> tuple[TrainState, int]:

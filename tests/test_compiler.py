@@ -142,8 +142,11 @@ class TestTPUJobManifests:
 class TestKubeflowStyleManifests:
     def test_pytorchjob_replicas_flattened(self):
         r = _resolved(PT_YAML)
-        pods = r.k8s_resources()
-        assert len(pods) == 4  # 1 master + 3 workers
+        resources = r.k8s_resources()
+        assert len(resources) == 5  # headless Service + 1 master + 3 workers
+        svc, pods = resources[0], resources[1:]
+        assert svc["kind"] == "Service"
+        assert svc["spec"]["clusterIP"] == "None"
         env = [{e["name"]: e["value"] for e in p["spec"]["containers"][0]["env"]}
                for p in pods]
         assert env[0]["PLX_REPLICA_ROLE"] == "master"

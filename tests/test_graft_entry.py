@@ -1,0 +1,53 @@
+"""Guard the driver-graded entry points (VERDICT r1 item 1/3).
+
+The round-1 snapshot shipped a dryrun_multichip that failed under the
+driver because the graded process sees only the 1 real TPU. These tests
+exercise both the in-process path (conftest already forces 8 CPU devices)
+and the subprocess re-exec path the driver will hit.
+"""
+
+import subprocess
+import sys
+
+import jax
+import pytest
+
+import __graft_entry__ as graft
+
+
+def test_entry_is_jittable():
+    fn, args = graft.entry()
+    lowered = jax.jit(fn).lower(*args)
+    assert lowered is not None
+    out_shape = jax.eval_shape(fn, *args)
+    params, tokens = args
+    assert out_shape.shape[:2] == tokens.shape  # [B, S, vocab]
+
+
+def test_dryrun_multichip_in_process():
+    # conftest gives this process 8 CPU devices -> in-process path.
+    assert len(jax.devices()) >= 8
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_subprocess_reexec():
+    """Simulate the driver: a process whose jax platform is NOT pre-forced
+    to n devices. dryrun_multichip must re-exec and still succeed."""
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.config.update('jax_num_cpu_devices', 1)\n"  # driver sees 1 chip
+        "import sys\n"
+        f"sys.path.insert(0, {graft._REPO_DIR!r})\n"
+        "import __graft_entry__\n"
+        "__graft_entry__.dryrun_multichip(8)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=graft._REPO_DIR,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "dryrun_multichip OK" in proc.stdout
