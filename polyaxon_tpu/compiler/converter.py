@@ -71,6 +71,17 @@ def get_main_container(compiled: V1CompiledOperation) -> Optional[V1Container]:
     return getattr(run, "container", None)
 
 
+def _render_builtin(run: Any, ctx: dict) -> Optional[dict]:
+    """Render the `runtime:` builtin-trainer spec (shared by the local and
+    K8s paths so they can never diverge)."""
+    if not (isinstance(run, V1TPUJob) and run.runtime):
+        return None
+    builtin = dict(render_value(run.runtime, ctx))
+    if run.parallelism:
+        builtin.setdefault("parallelism", run.parallelism.to_dict())
+    return builtin
+
+
 def to_local_payload(
     compiled: V1CompiledOperation,
     ctx: dict,
@@ -84,11 +95,7 @@ def to_local_payload(
     init_steps = []
     for i in getattr(run, "init", None) or []:
         init_steps.append(render_value(i.to_dict(), ctx))
-    builtin = None
-    if isinstance(run, V1TPUJob) and run.runtime:
-        builtin = dict(render_value(run.runtime, ctx))
-        if run.parallelism:
-            builtin.setdefault("parallelism", run.parallelism.to_dict())
+    builtin = _render_builtin(run, ctx)
     term = compiled.termination
     return LocalPayload(
         run_uuid=run_uuid,
@@ -175,9 +182,12 @@ def to_k8s_resources(
         }
 
     if isinstance(run, V1TPUJob):
+        import json as _json
+
         topo: SliceTopology = run.get_slice()
         hosts = topo.num_hosts
         svc = f"plx-{run_uuid[:12]}-hosts"
+        builtin = _render_builtin(run, ctx)
         pods = []
         for host_idx in range(hosts):
             env = dict(base_env)
@@ -190,10 +200,16 @@ def to_k8s_resources(
             env["PLX_SLICE_TOPOLOGY"] = topo.topology
             env["PLX_SLICE_ACCELERATOR"] = topo.accelerator
             if run.parallelism:
-                import json as _json
-
                 env["PLX_PARALLELISM"] = _json.dumps(run.parallelism.to_dict())
+            if builtin is not None:
+                env["PLX_BUILTIN_SPEC"] = _json.dumps(builtin)
             cm = _container_manifest(run.container, ctx, env)
+            if builtin is not None and not cm.get("command"):
+                # `runtime:` shortcut: the pod runs our builtin trainer
+                cm["command"] = ["python", "-m", "polyaxon_tpu.runtime.builtin"]
+                cm.setdefault("workingDir", None)
+                if not cm["workingDir"]:
+                    cm["workingDir"] = ctx["globals"]["run_artifacts_path"]
             cm["resources"] = {"limits": {k: str(v) for k, v in topo.tpu_resources().items()}}
             pods.append(pod(
                 f"plx-{run_uuid[:12]}-{host_idx}",

@@ -1,0 +1,36 @@
+"""L3 operator: Operation reconciler over a Cluster backend.
+
+The TPU-native equivalent of upstream's Go operator (SURVEY.md §2
+"Operator"): the decision kernel is native C++ (native/reconcile_core.cc,
+loaded via ctypes), the effectful shell is Python, and the cluster is
+pluggable — FakeCluster (subprocess pods) for local/e2e, a real K8s client
+later.
+"""
+
+from .cluster import Cluster, FakeCluster, PodPhase, PodStatus
+from .native import (
+    Action,
+    Decision,
+    Observed,
+    Reason,
+    reconcile,
+    reconcile_native,
+    reconcile_python,
+)
+from .reconciler import OperationCR, OperationReconciler
+
+__all__ = [
+    "Action",
+    "Cluster",
+    "Decision",
+    "FakeCluster",
+    "Observed",
+    "OperationCR",
+    "OperationReconciler",
+    "PodPhase",
+    "PodStatus",
+    "Reason",
+    "reconcile",
+    "reconcile_native",
+    "reconcile_python",
+]

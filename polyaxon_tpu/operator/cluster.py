@@ -1,0 +1,224 @@
+"""Cluster backends for the operator.
+
+``Cluster`` is the minimal surface the reconciler needs (apply/delete/
+observe/logs) — the shape of the K8s REST verbs upstream's Go operator used
+through controller-runtime (SURVEY.md §2 "Operator" row), kept abstract so a
+real K8s backend can slot in without touching the reconciler.
+
+``FakeCluster`` is the in-proc test cluster SURVEY.md §4 prescribes ("fake
+'cluster' = in-proc scheduler + subprocess pods"): every applied Pod manifest
+becomes a real subprocess with the manifest's env, headless-Service DNS names
+rewritten to loopback so multi-"host" rendezvous genuinely works on one
+machine.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import threading
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+
+class PodPhase(str, Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class PodStatus:
+    name: str
+    phase: PodPhase
+    exit_code: Optional[int] = None
+    message: Optional[str] = None
+
+
+class Cluster(ABC):
+    """What the reconciler needs from a cluster."""
+
+    @abstractmethod
+    def apply(self, manifest: dict) -> None: ...
+
+    @abstractmethod
+    def delete(self, kind: str, name: str) -> None: ...
+
+    @abstractmethod
+    def pod_statuses(self, label_selector: dict[str, str]) -> list[PodStatus]: ...
+
+    @abstractmethod
+    def pod_logs(self, name: str) -> str: ...
+
+
+def _match_labels(manifest: dict, selector: dict[str, str]) -> bool:
+    labels = (manifest.get("metadata") or {}).get("labels") or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+@dataclass
+class _FakePod:
+    manifest: dict
+    proc: Optional[subprocess.Popen] = None
+    log_path: str = ""
+    started_at: float = field(default_factory=time.monotonic)
+    forced_phase: Optional[PodPhase] = None  # tests / no-op pods
+
+    @property
+    def name(self) -> str:
+        return self.manifest["metadata"]["name"]
+
+    def phase(self) -> PodStatus:
+        if self.forced_phase is not None:
+            return PodStatus(self.name, self.forced_phase)
+        if self.proc is None:
+            return PodStatus(self.name, PodPhase.PENDING)
+        rc = self.proc.poll()
+        if rc is None:
+            return PodStatus(self.name, PodPhase.RUNNING)
+        if rc == 0:
+            return PodStatus(self.name, PodPhase.SUCCEEDED, exit_code=0)
+        return PodStatus(self.name, PodPhase.FAILED, exit_code=rc,
+                         message=f"exit code {rc}")
+
+
+class FakeCluster(Cluster):
+    """Runs Pod manifests as local subprocesses; records Services.
+
+    DNS: pods in a real cluster reach each other via
+    ``<hostname>.<subdomain>`` headless-service names. Locally every "host"
+    is a process on loopback, so any env value referencing a registered
+    Service domain is rewritten to ``127.0.0.1`` — jax.distributed rendezvous
+    then works unmodified across the fake hosts.
+    """
+
+    def __init__(self, workdir: str):
+        self.workdir = os.path.abspath(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.pods: dict[str, _FakePod] = {}
+        self.services: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        # observability for tests: every env block a pod was launched with
+        self.launched_env: dict[str, dict[str, str]] = {}
+
+    # -- verbs -------------------------------------------------------------
+
+    def apply(self, manifest: dict) -> None:
+        kind = manifest.get("kind")
+        if kind == "Service":
+            with self._lock:
+                self.services[manifest["metadata"]["name"]] = manifest
+            return
+        if kind != "Pod":
+            raise ValueError(f"FakeCluster cannot apply kind {kind!r}")
+        name = manifest["metadata"]["name"]
+        with self._lock:
+            if name in self.pods:
+                raise ValueError(f"pod {name!r} already exists")
+            pod = _FakePod(manifest=manifest)
+            self.pods[name] = pod
+        self._launch(pod)
+
+    def delete(self, kind: str, name: str) -> None:
+        if kind == "Service":
+            with self._lock:
+                self.services.pop(name, None)
+            return
+        with self._lock:
+            pod = self.pods.pop(name, None)
+        if pod and pod.proc and pod.proc.poll() is None:
+            pod.proc.terminate()
+            try:
+                pod.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pod.proc.kill()
+
+    def delete_selected(self, label_selector: dict[str, str]) -> None:
+        with self._lock:
+            pods = [p.name for p in self.pods.values()
+                    if _match_labels(p.manifest, label_selector)]
+            svcs = [name for name, m in self.services.items()
+                    if _match_labels(m, label_selector)]
+        for n in pods:
+            self.delete("Pod", n)
+        for n in svcs:
+            self.delete("Service", n)
+
+    def pod_statuses(self, label_selector: dict[str, str]) -> list[PodStatus]:
+        with self._lock:
+            pods = [p for p in self.pods.values()
+                    if _match_labels(p.manifest, label_selector)]
+        return [p.phase() for p in pods]
+
+    def pod_logs(self, name: str) -> str:
+        with self._lock:
+            pod = self.pods.get(name)
+        if pod is None or not pod.log_path or not os.path.exists(pod.log_path):
+            return ""
+        with open(pod.log_path, encoding="utf-8", errors="replace") as f:
+            return f.read()
+
+    def shutdown(self) -> None:
+        """Kill every pod process (test teardown / agent stop)."""
+        with self._lock:
+            names = list(self.pods)
+        for n in names:
+            self.delete("Pod", n)
+
+    # -- pod launch --------------------------------------------------------
+
+    def _rewrite_dns(self, value: str) -> str:
+        """Rewrite <pod>.<registered-service> host references to loopback."""
+        for svc in self.services:
+            value = re.sub(rf"[A-Za-z0-9.-]+\.{re.escape(svc)}", "127.0.0.1", value)
+        return value
+
+    def _launch(self, pod: _FakePod) -> None:
+        import sys
+
+        from ..runtime.local import _with_pythonpath
+
+        spec = pod.manifest.get("spec") or {}
+        containers = spec.get("containers") or []
+        c = containers[0] if containers else {}
+        argv = list(c.get("command") or []) + list(c.get("args") or [])
+        env = dict(os.environ)
+        for e in c.get("env") or []:
+            if e.get("value") is not None:
+                env[e["name"]] = self._rewrite_dns(str(e["value"]))
+        # source tree importable inside "pods" (no image build locally)
+        env = _with_pythonpath(env)
+        self.launched_env[pod.name] = {
+            e["name"]: env[e["name"]] for e in (c.get("env") or []) if e.get("value") is not None
+        }
+        if not argv:
+            # no command: a real kubelet would run the image entrypoint; the
+            # fake cluster has no images, so an argv-less pod just "succeeds"
+            pod.forced_phase = PodPhase.SUCCEEDED
+            return
+        if argv[0] in ("python", "python3"):
+            # the fake kubelet's image-entrypoint resolution: manifests say
+            # "python" (correct inside a container image); locally that must
+            # be this interpreter
+            argv[0] = sys.executable
+        cwd = c.get("workingDir") or self.workdir
+        os.makedirs(cwd, exist_ok=True)
+        pod.log_path = os.path.join(self.workdir, f"{pod.name}.log")
+        log_file = open(pod.log_path, "w", encoding="utf-8")
+        try:
+            pod.proc = subprocess.Popen(
+                argv, env=env, cwd=cwd,
+                stdout=log_file, stderr=subprocess.STDOUT,
+            )
+            # the child owns its copy of the fd now; closing ours avoids
+            # leaking one handle per pod on long-lived agents
+            log_file.close()
+        except OSError as e:
+            pod.forced_phase = PodPhase.FAILED
+            log_file.write(f"[fake-cluster] launch failed: {e}\n")
+            log_file.close()

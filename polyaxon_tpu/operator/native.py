@@ -1,0 +1,168 @@
+"""ctypes bridge to the C++ reconcile kernel, with a pure-Python mirror.
+
+The .so is built on demand with g++ (no pybind11 in the image — C ABI +
+ctypes per the environment constraints) and cached next to the source. The
+Python mirror exists for toolchain-less environments and as the parity
+oracle in tests: both implementations MUST make identical decisions.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+from enum import IntEnum
+
+
+class Action(IntEnum):
+    WAIT = 0
+    SET_RUNNING = 1
+    RESTART = 2
+    FAIL = 3
+    SUCCEED = 4
+    GC = 5
+
+
+class Reason(IntEnum):
+    NONE = 0
+    DEADLINE = 1
+    POD_FAILED = 2
+    COMPLETED = 3
+    TTL = 4
+    BACKOFF = 5
+
+
+@dataclass
+class Observed:
+    pods_total: int
+    pending: int = 0
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    retries_done: int = 0
+    backoff_limit: int = 0
+    is_finished: bool = False
+    was_running: bool = False
+    elapsed_s: float = 0.0
+    finished_for_s: float = 0.0
+    active_deadline_s: float = 0.0  # <=0: none
+    ttl_s: float = -1.0             # <0: none
+
+
+@dataclass
+class Decision:
+    action: Action
+    reason: Reason
+
+
+class _CObserved(ctypes.Structure):
+    _fields_ = [
+        ("pods_total", ctypes.c_int32),
+        ("pending", ctypes.c_int32),
+        ("running", ctypes.c_int32),
+        ("succeeded", ctypes.c_int32),
+        ("failed", ctypes.c_int32),
+        ("retries_done", ctypes.c_int32),
+        ("backoff_limit", ctypes.c_int32),
+        ("is_finished", ctypes.c_int32),
+        ("was_running", ctypes.c_int32),
+        ("elapsed_s", ctypes.c_double),
+        ("finished_for_s", ctypes.c_double),
+        ("active_deadline_s", ctypes.c_double),
+        ("ttl_s", ctypes.c_double),
+    ]
+
+
+class _CDecision(ctypes.Structure):
+    _fields_ = [("action", ctypes.c_int32), ("reason", ctypes.c_int32)]
+
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native", "reconcile_core.cc")
+_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native", "_build", "libplxreconcile.so")
+_build_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+def _build_so() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load_native():
+    """Load (building if needed) the C++ kernel; None when unavailable."""
+    global _lib, _lib_tried
+    with _build_lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build_so():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.plx_reconcile.argtypes = [ctypes.POINTER(_CObserved), ctypes.POINTER(_CDecision)]
+            lib.plx_reconcile.restype = ctypes.c_int32
+            lib.plx_abi_version.restype = ctypes.c_int32
+            if lib.plx_abi_version() != 1:
+                return None
+            _lib = lib
+        except OSError:
+            return None
+        return _lib
+
+
+def reconcile_native(obs: Observed) -> Decision:
+    lib = load_native()
+    if lib is None:
+        raise RuntimeError("native reconcile kernel unavailable")
+    c_obs = _CObserved(
+        pods_total=obs.pods_total, pending=obs.pending, running=obs.running,
+        succeeded=obs.succeeded, failed=obs.failed,
+        retries_done=obs.retries_done, backoff_limit=obs.backoff_limit,
+        is_finished=int(obs.is_finished), was_running=int(obs.was_running),
+        elapsed_s=obs.elapsed_s, finished_for_s=obs.finished_for_s,
+        active_deadline_s=obs.active_deadline_s, ttl_s=obs.ttl_s,
+    )
+    out = _CDecision()
+    rc = lib.plx_reconcile(ctypes.byref(c_obs), ctypes.byref(out))
+    if rc != 0:
+        raise ValueError(f"plx_reconcile rejected input (rc={rc}): {obs}")
+    return Decision(Action(out.action), Reason(out.reason))
+
+
+def reconcile_python(obs: Observed) -> Decision:
+    """Pure-Python mirror of reconcile_core.cc (same priority order)."""
+    if min(obs.pods_total, obs.pending, obs.running, obs.succeeded, obs.failed) < 0:
+        raise ValueError(f"negative pod counts: {obs}")
+    if obs.is_finished:
+        if obs.ttl_s >= 0.0 and obs.finished_for_s >= obs.ttl_s:
+            return Decision(Action.GC, Reason.TTL)
+        return Decision(Action.WAIT, Reason.NONE)
+    if obs.active_deadline_s > 0.0 and obs.elapsed_s > obs.active_deadline_s:
+        return Decision(Action.FAIL, Reason.DEADLINE)
+    if obs.failed > 0:
+        if obs.retries_done < obs.backoff_limit:
+            return Decision(Action.RESTART, Reason.BACKOFF)
+        return Decision(Action.FAIL, Reason.POD_FAILED)
+    if obs.pods_total > 0 and obs.succeeded == obs.pods_total:
+        return Decision(Action.SUCCEED, Reason.COMPLETED)
+    if obs.running > 0 and not obs.was_running:
+        return Decision(Action.SET_RUNNING, Reason.NONE)
+    return Decision(Action.WAIT, Reason.NONE)
+
+
+def reconcile(obs: Observed) -> Decision:
+    """Native kernel when buildable, Python mirror otherwise."""
+    if load_native() is not None:
+        return reconcile_native(obs)
+    return reconcile_python(obs)

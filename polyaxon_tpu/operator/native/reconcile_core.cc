@@ -1,0 +1,111 @@
+// Reconcile decision kernel — the native core of the L3 operator.
+//
+// Upstream's operator is its one native-compiled component (a Go
+// controller-runtime reconciler on the Operation CRD — SURVEY.md §2
+// "Operator" row). Per SURVEY.md §7 hard part (d), the TPU-native port keeps
+// the reconciler minimal and native while rendering/IO stay in Python: this
+// translation unit is a PURE function from observed cluster state to a
+// decision, so it is trivially testable and shares none of Python's GIL or
+// allocation behavior on the hot reconcile path.
+//
+// Slice semantics (SURVEY.md §5 "failure detection"): TPU jobs restart
+// all-or-nothing — one failed host pod invalidates the whole ICI slice, so
+// the only retry action is "delete every pod and re-apply".
+
+#include <cstdint>
+
+extern "C" {
+
+enum plx_action : int32_t {
+  PLX_WAIT = 0,         // nothing to do this pass
+  PLX_SET_RUNNING = 1,  // first pod entered Running -> operation is running
+  PLX_RESTART = 2,      // slice-level retry: delete ALL pods, re-apply
+  PLX_FAIL = 3,         // terminal failure: delete pods, patch status failed
+  PLX_SUCCEED = 4,      // every pod succeeded: patch status succeeded
+  PLX_GC = 5,           // TTL elapsed after finish: delete all resources
+};
+
+enum plx_reason : int32_t {
+  PLX_R_NONE = 0,
+  PLX_R_DEADLINE = 1,   // activeDeadlineSeconds exceeded
+  PLX_R_POD_FAILED = 2, // >=1 pod failed, no retries left
+  PLX_R_COMPLETED = 3,
+  PLX_R_TTL = 4,
+  PLX_R_BACKOFF = 5,    // restarting within backoff budget
+};
+
+struct plx_observed {
+  int32_t pods_total;
+  int32_t pending;
+  int32_t running;
+  int32_t succeeded;
+  int32_t failed;
+  int32_t retries_done;
+  int32_t backoff_limit;
+  int32_t is_finished;      // operation already reached a terminal status
+  int32_t was_running;      // SET_RUNNING already emitted for this attempt
+  double elapsed_s;         // since current attempt's apply
+  double finished_for_s;    // since terminal status (0 when not finished)
+  double active_deadline_s; // <=0 => no deadline
+  double ttl_s;             // <0 => no TTL; 0 => immediate GC on finish
+};
+
+struct plx_decision {
+  int32_t action;
+  int32_t reason;
+};
+
+// Returns 0 on success, -1 on invalid input. Priority order matters and is
+// part of the contract (mirrored by the Python fallback + parity test):
+// GC > deadline > pod-failure > success > running > wait.
+int32_t plx_reconcile(const plx_observed* obs, plx_decision* out) {
+  if (obs == nullptr || out == nullptr) return -1;
+  if (obs->pods_total < 0 || obs->pending < 0 || obs->running < 0 ||
+      obs->succeeded < 0 || obs->failed < 0)
+    return -1;
+  out->action = PLX_WAIT;
+  out->reason = PLX_R_NONE;
+
+  if (obs->is_finished) {
+    if (obs->ttl_s >= 0.0 && obs->finished_for_s >= obs->ttl_s) {
+      out->action = PLX_GC;
+      out->reason = PLX_R_TTL;
+    }
+    return 0;
+  }
+
+  if (obs->active_deadline_s > 0.0 && obs->elapsed_s > obs->active_deadline_s) {
+    out->action = PLX_FAIL;
+    out->reason = PLX_R_DEADLINE;
+    return 0;
+  }
+
+  if (obs->failed > 0) {
+    // all-or-nothing: even with partial success, the slice restarts whole
+    if (obs->retries_done < obs->backoff_limit) {
+      out->action = PLX_RESTART;
+      out->reason = PLX_R_BACKOFF;
+    } else {
+      out->action = PLX_FAIL;
+      out->reason = PLX_R_POD_FAILED;
+    }
+    return 0;
+  }
+
+  if (obs->pods_total > 0 && obs->succeeded == obs->pods_total) {
+    out->action = PLX_SUCCEED;
+    out->reason = PLX_R_COMPLETED;
+    return 0;
+  }
+
+  if (obs->running > 0 && !obs->was_running) {
+    out->action = PLX_SET_RUNNING;
+    return 0;
+  }
+
+  return 0;
+}
+
+int32_t plx_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,208 @@
+"""Operation reconciler — the L3 operator loop.
+
+Upstream: a Go controller-runtime reconciler on the ``Operation`` CRD that
+creates pods/Jobs, watches child status, patches the CR, and enforces
+TTL/termination (SURVEY.md §2 "Operator" row, §3a steps 4-6). Here the same
+loop runs over a ``Cluster`` backend: manifests in (rendered by the compiler
+— rendering stays in Python per SURVEY.md §7 hard part (d)), status
+callbacks out. Decisions are made by the native C++ kernel
+(native/reconcile_core.cc) from observed pod phases only, so the loop itself
+is trivially idempotent — the controller pattern's level-triggered contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..schemas.statuses import V1Statuses
+from .cluster import Cluster, PodPhase
+from .native import Action, Decision, Observed, Reason, reconcile
+
+
+@dataclass
+class OperationCR:
+    """The 'custom resource': everything the operator needs about one run."""
+
+    run_uuid: str
+    resources: list[dict]
+    backoff_limit: int = 0
+    active_deadline_s: float = 0.0  # <=0: none
+    ttl_s: float = -1.0             # <0: keep resources after finish
+
+    @property
+    def label_selector(self) -> dict[str, str]:
+        return {"app.polyaxon.com/run": self.run_uuid}
+
+
+@dataclass
+class _OpState:
+    op: OperationCR
+    applied_at: float = field(default_factory=time.monotonic)
+    retries_done: int = 0
+    was_running: bool = False
+    finished_at: Optional[float] = None
+    final_status: Optional[str] = None
+    gc_done: bool = False
+
+
+# status callback: (run_uuid, status, message)
+StatusFn = Callable[[str, str, Optional[str]], None]
+
+_REASON_MSG = {
+    Reason.DEADLINE: "activeDeadlineSeconds exceeded",
+    Reason.POD_FAILED: "pod failed; no retries left",
+    Reason.COMPLETED: None,
+    Reason.TTL: "ttl expired",
+}
+
+
+class OperationReconciler:
+    def __init__(self, cluster: Cluster, on_status: Optional[StatusFn] = None):
+        self.cluster = cluster
+        self.on_status = on_status or (lambda *a: None)
+        self._ops: dict[str, _OpState] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- CR lifecycle ------------------------------------------------------
+
+    def apply(self, op: OperationCR) -> None:
+        """Create the operation's resources and start tracking it."""
+        with self._lock:
+            if op.run_uuid in self._ops:
+                raise ValueError(f"operation {op.run_uuid} already applied")
+            state = _OpState(op=op)
+            self._ops[op.run_uuid] = state
+        for manifest in op.resources:
+            self.cluster.apply(manifest)
+        state.applied_at = time.monotonic()
+
+    def delete(self, run_uuid: str) -> None:
+        """Stop tracking and tear down resources (stop / user delete)."""
+        with self._lock:
+            state = self._ops.pop(run_uuid, None)
+        if state:
+            self.cluster.delete_selected(state.op.label_selector)
+
+    def is_tracked(self, run_uuid: str) -> bool:
+        with self._lock:
+            return run_uuid in self._ops
+
+    def active_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._ops.values() if s.final_status is None)
+
+    def final_status(self, run_uuid: str) -> Optional[str]:
+        with self._lock:
+            state = self._ops.get(run_uuid)
+        return state.final_status if state else None
+
+    # -- the reconcile loop ------------------------------------------------
+
+    def reconcile_once(self) -> None:
+        with self._lock:
+            states = list(self._ops.values())
+        for state in states:
+            try:
+                self._reconcile_op(state)
+            except Exception:
+                traceback.print_exc()
+
+    def _observe(self, state: _OpState) -> Observed:
+        statuses = self.cluster.pod_statuses(state.op.label_selector)
+        counts = {phase: 0 for phase in PodPhase}
+        for s in statuses:
+            counts[s.phase] += 1
+        now = time.monotonic()
+        return Observed(
+            pods_total=len(statuses),
+            pending=counts[PodPhase.PENDING],
+            running=counts[PodPhase.RUNNING],
+            succeeded=counts[PodPhase.SUCCEEDED],
+            failed=counts[PodPhase.FAILED],
+            retries_done=state.retries_done,
+            backoff_limit=state.op.backoff_limit,
+            is_finished=state.final_status is not None,
+            was_running=state.was_running,
+            elapsed_s=now - state.applied_at,
+            finished_for_s=(now - state.finished_at) if state.finished_at else 0.0,
+            active_deadline_s=state.op.active_deadline_s,
+            ttl_s=state.op.ttl_s,
+        )
+
+    def _reconcile_op(self, state: _OpState) -> None:
+        if state.gc_done:
+            return
+        decision: Decision = reconcile(self._observe(state))
+        op = state.op
+        if decision.action == Action.WAIT:
+            return
+        if decision.action == Action.SET_RUNNING:
+            state.was_running = True
+            self.on_status(op.run_uuid, V1Statuses.RUNNING.value, None)
+            return
+        if decision.action == Action.RESTART:
+            # slice-level all-or-nothing: tear down every pod, re-apply all.
+            # Pods that fail faster than one observe interval were still
+            # running — emit RUNNING first so the status machine accepts the
+            # RETRYING edge (running->retrying; scheduled->retrying is not
+            # a legal transition).
+            if not state.was_running:
+                self.on_status(op.run_uuid, V1Statuses.RUNNING.value, None)
+            state.retries_done += 1
+            self.on_status(
+                op.run_uuid, V1Statuses.RETRYING.value,
+                f"attempt {state.retries_done + 1}/{op.backoff_limit + 1}",
+            )
+            self.on_status(op.run_uuid, V1Statuses.QUEUED.value, None)
+            self.on_status(op.run_uuid, V1Statuses.SCHEDULED.value, None)
+            self.cluster.delete_selected(op.label_selector)
+            for manifest in op.resources:
+                self.cluster.apply(manifest)
+            state.applied_at = time.monotonic()
+            state.was_running = False
+            return
+        if decision.action in (Action.FAIL, Action.SUCCEED):
+            status = (V1Statuses.SUCCEEDED if decision.action == Action.SUCCEED
+                      else V1Statuses.FAILED)
+            if decision.action == Action.SUCCEED and not state.was_running:
+                # pods ran to completion between observe passes; the status
+                # machine has no scheduled->succeeded edge, so record the
+                # (true) running phase first
+                self.on_status(op.run_uuid, V1Statuses.RUNNING.value, None)
+            state.final_status = status.value
+            state.finished_at = time.monotonic()
+            # report BEFORE any teardown so on_status consumers (agent log
+            # scraping) still see the pods; then failure tears them down,
+            # success leaves them until TTL (or forever when ttl < 0)
+            self.on_status(op.run_uuid, status.value, _REASON_MSG.get(decision.reason))
+            if decision.action == Action.FAIL or op.ttl_s == 0:
+                self.cluster.delete_selected(op.label_selector)
+                if op.ttl_s == 0:
+                    state.gc_done = True
+            return
+        if decision.action == Action.GC:
+            self.cluster.delete_selected(op.label_selector)
+            state.gc_done = True
+            return
+
+    # -- background watch --------------------------------------------------
+
+    def start(self, interval: float = 0.2) -> "OperationReconciler":
+        def _loop():
+            while not self._stop.wait(interval):
+                self.reconcile_once()
+
+        self._thread = threading.Thread(target=_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)

@@ -22,6 +22,14 @@ from ..schemas.statuses import V1Statuses, is_done
 
 
 class LocalAgent:
+    """Poll/compile/schedule loop with two execution backends:
+
+    - ``local``  — LocalExecutor subprocesses (upstream's docker-less path)
+    - ``cluster``— render K8s manifests and hand them to the L3 operator
+      (OperationReconciler over a Cluster; FakeCluster by default), the
+      upstream agent→operator→pods path (SURVEY.md §3a steps 4-6)
+    """
+
     def __init__(
         self,
         store: Store,
@@ -29,13 +37,26 @@ class LocalAgent:
         api_host: Optional[str] = None,
         max_parallel: int = 4,
         poll_interval: float = 0.2,
+        backend: str = "local",
+        cluster=None,
     ):
         self.store = store
         self.artifacts_root = os.path.abspath(artifacts_root)
         self.api_host = api_host
         self.max_parallel = max_parallel
         self.poll_interval = poll_interval
+        self.backend = backend
         self.executor = LocalExecutor(on_status=self._on_status)
+        self.reconciler = None
+        if backend == "cluster":
+            from ..operator import FakeCluster, OperationReconciler
+
+            if cluster is None:
+                cluster = FakeCluster(os.path.join(self.artifacts_root, ".cluster"))
+            self.cluster = cluster
+            self.reconciler = OperationReconciler(cluster, on_status=self._on_status)
+        elif backend != "local":
+            raise ValueError(f"unknown agent backend {backend!r}")
         self._active: dict[str, LocalExecution] = {}
         self._tuners: dict[str, threading.Thread] = {}
         self._stop = threading.Event()
@@ -56,6 +77,8 @@ class LocalAgent:
         with self._lock:
             for ex in self._active.values():
                 ex.stop()
+        if self.reconciler is not None and hasattr(self.cluster, "shutdown"):
+            self.cluster.shutdown()
 
     def _on_status(self, run_uuid: str, status: str, message: Optional[str]) -> None:
         self.store.transition(run_uuid, status, message=message)
@@ -63,6 +86,26 @@ class LocalAgent:
             self._collect_outputs(run_uuid)
             with self._lock:
                 self._active.pop(run_uuid, None)
+            if self.reconciler is not None and self.reconciler.is_tracked(run_uuid):
+                self._scrape_pod_logs(run_uuid)
+
+    def _scrape_pod_logs(self, run_uuid: str) -> None:
+        """Copy pod logs into the run's logs/ dir so `ops logs` shows them
+        (the sidecar's job in a real cluster)."""
+        run = self.store.get_run(run_uuid)
+        if not run:
+            return
+        logs_dir = os.path.join(
+            run_artifacts_dir(self.artifacts_root, run["project"], run_uuid), "logs",
+        )
+        os.makedirs(logs_dir, exist_ok=True)
+        selector = {"app.polyaxon.com/run": run_uuid}
+        for pod in self.cluster.pod_statuses(selector):
+            text = self.cluster.pod_logs(pod.name)
+            if text:
+                with open(os.path.join(logs_dir, f"{pod.name}.txt"), "w",
+                          encoding="utf-8") as f:
+                    f.write(text)
 
     def _collect_outputs(self, run_uuid: str) -> None:
         """Merge the run's offline outputs.json (tracking writes it at end())
@@ -102,6 +145,8 @@ class LocalAgent:
             self._maybe_schedule(run)
         for run in self.store.list_runs(status=V1Statuses.STOPPING.value):
             self._do_stop(run)
+        if self.reconciler is not None:
+            self.reconciler.reconcile_once()
 
     # -- stages ------------------------------------------------------------
 
@@ -139,11 +184,16 @@ class LocalAgent:
         if spec.get("matrix"):
             self._start_tuner(run)
             return
+        active = len(self._active)
+        if self.reconciler is not None:
+            active += self.reconciler.active_count()
         with self._lock:
-            if len(self._active) >= self.max_parallel:
+            if active >= self.max_parallel:
                 return
             if uuid in self._active:
                 return
+        if self.reconciler is not None and self.reconciler.is_tracked(uuid):
+            return
         try:
             resolved = resolve(
                 run["compiled"] or spec,
@@ -153,13 +203,28 @@ class LocalAgent:
                 api_host=self.api_host,
             )
             self.store.transition(uuid, V1Statuses.SCHEDULED.value)
-            execution = self.executor.submit(resolved.payload)
-            with self._lock:
-                self._active[uuid] = execution
+            if self.reconciler is not None:
+                self._submit_to_cluster(uuid, resolved)
+            else:
+                execution = self.executor.submit(resolved.payload)
+                with self._lock:
+                    self._active[uuid] = execution
         except Exception as e:
             self.store.transition(
                 uuid, V1Statuses.FAILED.value, reason="SchedulingError", message=str(e)[:500],
             )
+
+    def _submit_to_cluster(self, uuid: str, resolved) -> None:
+        from ..operator import OperationCR
+
+        term = resolved.compiled.termination
+        self.reconciler.apply(OperationCR(
+            run_uuid=uuid,
+            resources=resolved.k8s_resources(),
+            backoff_limit=(term.max_retries if term and term.max_retries else 0),
+            active_deadline_s=(term.timeout if term and term.timeout else 0.0),
+            ttl_s=(term.ttl if term and term.ttl is not None else -1.0),
+        ))
 
     def _do_stop(self, run: dict) -> None:
         uuid = run["uuid"]
@@ -171,6 +236,8 @@ class LocalAgent:
         self.store.transition(uuid, V1Statuses.STOPPED.value, force=True)
         if ex:
             ex.stop()
+        if self.reconciler is not None and self.reconciler.is_tracked(uuid):
+            self.reconciler.delete(uuid)
 
     # -- matrix pipelines --------------------------------------------------
 
@@ -212,7 +279,8 @@ class LocalAgent:
                 self.store.list_runs(status=V1Statuses.RUNNING.value) or \
                 self.store.list_runs(status=V1Statuses.SCHEDULED.value) or \
                 self.store.list_runs(status=V1Statuses.STARTING.value)
-            if not busy and not self._active and not self._tuners:
+            cluster_busy = self.reconciler is not None and self.reconciler.active_count() > 0
+            if not busy and not self._active and not self._tuners and not cluster_busy:
                 return
             time.sleep(0.1)
         raise TimeoutError("agent still busy")

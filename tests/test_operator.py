@@ -1,0 +1,360 @@
+"""L3 operator tests: native kernel parity, reconciler state machine on a
+FakeCluster, and the agent→manifests→reconciler e2e the VERDICT required
+(2-host tpujob through the full status lifecycle with rendezvous env
+visible to both pods — SURVEY.md §2 "Operator", §3a steps 4-6)."""
+
+import itertools
+import os
+import sys
+import time
+
+import pytest
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.operator import (
+    Action,
+    FakeCluster,
+    Observed,
+    OperationCR,
+    OperationReconciler,
+    PodPhase,
+    Reason,
+    reconcile_native,
+    reconcile_python,
+)
+from polyaxon_tpu.operator.native import load_native
+from polyaxon_tpu.scheduler.agent import LocalAgent
+from polyaxon_tpu.schemas.statuses import V1Statuses
+
+
+# ---------------------------------------------------------------------------
+# native kernel
+# ---------------------------------------------------------------------------
+
+
+def test_native_kernel_builds():
+    assert load_native() is not None, "C++ reconcile kernel failed to build"
+
+
+def test_native_python_parity_grid():
+    """The C++ kernel and the Python mirror must agree everywhere: sweep a
+    grid over pod phase mixes and policy knobs."""
+    cases = 0
+    for total in (0, 1, 2, 4):
+        splits = [
+            (p, r, s, f)
+            for p, r, s, f in itertools.product(range(total + 1), repeat=4)
+            if p + r + s + f == total
+        ]
+        for (p, r, s, f), retries, backoff, fin, was_run in itertools.product(
+            splits, (0, 1), (0, 2), (False, True), (False, True)
+        ):
+            for elapsed, deadline, fin_for, ttl in (
+                (1.0, 0.0, 0.0, -1.0),
+                (100.0, 50.0, 0.0, -1.0),
+                (1.0, 0.0, 10.0, 5.0),
+                (1.0, 0.0, 1.0, 5.0),
+                (1.0, 0.0, 0.0, 0.0),
+            ):
+                obs = Observed(
+                    pods_total=total, pending=p, running=r, succeeded=s,
+                    failed=f, retries_done=retries, backoff_limit=backoff,
+                    is_finished=fin, was_running=was_run, elapsed_s=elapsed,
+                    finished_for_s=fin_for, active_deadline_s=deadline,
+                    ttl_s=ttl,
+                )
+                assert reconcile_native(obs) == reconcile_python(obs), obs
+                cases += 1
+    assert cases > 2000
+
+
+def test_kernel_slice_semantics():
+    # partial success + one failure -> whole-slice restart, not partial
+    obs = Observed(pods_total=4, succeeded=3, failed=1, backoff_limit=2)
+    d = reconcile_python(obs)
+    assert d.action == Action.RESTART and d.reason == Reason.BACKOFF
+    # no budget left -> fail
+    obs2 = Observed(pods_total=4, succeeded=3, failed=1, retries_done=2, backoff_limit=2)
+    assert reconcile_python(obs2).action == Action.FAIL
+
+
+# ---------------------------------------------------------------------------
+# FakeCluster
+# ---------------------------------------------------------------------------
+
+
+def _pod(name, argv, env=None, labels=None, workdir=None):
+    c = {"name": "main", "image": "python:3.12"}
+    if argv:
+        c["command"] = argv
+    if env:
+        c["env"] = [{"name": k, "value": v} for k, v in env.items()]
+    if workdir:
+        c["workingDir"] = workdir
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "labels": labels or {"app.polyaxon.com/run": "r1"}},
+        "spec": {"containers": [c]},
+    }
+
+
+def test_fake_cluster_runs_pod(tmp_path):
+    cluster = FakeCluster(str(tmp_path))
+    cluster.apply(_pod("p1", [sys.executable, "-c", "print('hello pod')"]))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = cluster.pod_statuses({"app.polyaxon.com/run": "r1"})
+        if st[0].phase == PodPhase.SUCCEEDED:
+            break
+        time.sleep(0.05)
+    assert st[0].phase == PodPhase.SUCCEEDED
+    assert "hello pod" in cluster.pod_logs("p1")
+
+
+def test_fake_cluster_dns_rewrite(tmp_path):
+    cluster = FakeCluster(str(tmp_path))
+    cluster.apply({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "plx-abc-hosts", "labels": {"app.polyaxon.com/run": "r1"}},
+        "spec": {"clusterIP": "None"},
+    })
+    cluster.apply(_pod(
+        "p1",
+        [sys.executable, "-c", "import os; print(os.environ['PLX_COORDINATOR_ADDRESS'])"],
+        env={"PLX_COORDINATOR_ADDRESS": "plx-abc-0.plx-abc-hosts:8476"},
+    ))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if cluster.pod_statuses({"app.polyaxon.com/run": "r1"})[0].phase == PodPhase.SUCCEEDED:
+            break
+        time.sleep(0.05)
+    assert cluster.pod_logs("p1").strip() == "127.0.0.1:8476"
+
+
+# ---------------------------------------------------------------------------
+# reconciler state machine
+# ---------------------------------------------------------------------------
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, uuid, status, message):
+        self.events.append((uuid, status, message))
+
+    def statuses(self, uuid):
+        return [s for u, s, _ in self.events if u == uuid]
+
+
+def _wait(pred, timeout=30.0, tick=None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if tick:
+            tick()
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_reconciler_success_flow(tmp_path):
+    cluster = FakeCluster(str(tmp_path))
+    rec = _Recorder()
+    r = OperationReconciler(cluster, on_status=rec)
+    r.apply(OperationCR(run_uuid="u1", resources=[
+        _pod("p1", [sys.executable, "-c", "import time; time.sleep(0.3)"],
+             labels={"app.polyaxon.com/run": "u1"}),
+        _pod("p2", [sys.executable, "-c", "import time; time.sleep(0.3)"],
+             labels={"app.polyaxon.com/run": "u1"}),
+    ]))
+    assert _wait(lambda: r.final_status("u1") == "succeeded", tick=r.reconcile_once)
+    assert "running" in rec.statuses("u1")
+
+
+def test_reconciler_all_or_nothing_retry(tmp_path):
+    cluster = FakeCluster(str(tmp_path))
+    rec = _Recorder()
+    r = OperationReconciler(cluster, on_status=rec)
+    # p-ok succeeds instantly; p-bad fails -> slice restarts BOTH, then fails
+    resources = [
+        _pod("p-ok", [sys.executable, "-c", "pass"],
+             labels={"app.polyaxon.com/run": "u2"}),
+        _pod("p-bad", [sys.executable, "-c", "raise SystemExit(3)"],
+             labels={"app.polyaxon.com/run": "u2"}),
+    ]
+    r.apply(OperationCR(run_uuid="u2", resources=resources, backoff_limit=1))
+    assert _wait(lambda: r.final_status("u2") == "failed", tick=r.reconcile_once)
+    sts = rec.statuses("u2")
+    assert "retrying" in sts
+    assert sts[-1] == "failed"
+    # after failure pods are torn down
+    assert cluster.pod_statuses({"app.polyaxon.com/run": "u2"}) == []
+
+
+def test_reconciler_deadline(tmp_path):
+    cluster = FakeCluster(str(tmp_path))
+    rec = _Recorder()
+    r = OperationReconciler(cluster, on_status=rec)
+    r.apply(OperationCR(
+        run_uuid="u3",
+        resources=[_pod("p-slow", [sys.executable, "-c", "import time; time.sleep(60)"],
+                        labels={"app.polyaxon.com/run": "u3"})],
+        active_deadline_s=0.5,
+    ))
+    assert _wait(lambda: r.final_status("u3") == "failed", tick=r.reconcile_once)
+    assert cluster.pod_statuses({"app.polyaxon.com/run": "u3"}) == []
+
+
+def test_reconciler_ttl_gc(tmp_path):
+    cluster = FakeCluster(str(tmp_path))
+    r = OperationReconciler(cluster)
+    r.apply(OperationCR(
+        run_uuid="u4",
+        resources=[_pod("p1", [sys.executable, "-c", "pass"],
+                        labels={"app.polyaxon.com/run": "u4"})],
+        ttl_s=0.3,
+    ))
+    assert _wait(lambda: r.final_status("u4") == "succeeded", tick=r.reconcile_once)
+    # pods kept right after success...
+    assert cluster.pod_statuses({"app.polyaxon.com/run": "u4"}) != []
+    # ...gone after TTL
+    assert _wait(
+        lambda: cluster.pod_statuses({"app.polyaxon.com/run": "u4"}) == [],
+        tick=r.reconcile_once,
+    )
+
+
+# ---------------------------------------------------------------------------
+# e2e: agent + manifests + reconciler (the VERDICT item-3 'done' bar)
+# ---------------------------------------------------------------------------
+
+TPU_2HOST_YAML = """
+kind: component
+name: multi-host-env
+run:
+  kind: tpujob
+  accelerator: v5e
+  topology: 4x4
+  container:
+    image: python:3.12
+    command: ["{python}", "-c", "import os, json; print(json.dumps({{k: v for k, v in os.environ.items() if k.startswith('PLX_')}}))"]
+"""
+
+
+def test_e2e_tpujob_through_reconciler(tmp_path):
+    """2-host tpujob: created→compiled→queued→scheduled→running→succeeded
+    entirely via manifests + reconciler; rendezvous env visible in both pods."""
+    import json
+
+    import yaml
+
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    store = Store(":memory:")
+    agent = LocalAgent(store, str(tmp_path), backend="cluster", poll_interval=0.05)
+    spec = check_polyaxonfile(
+        yaml.safe_load(TPU_2HOST_YAML.format(python=sys.executable))
+    ).to_dict()
+    run = store.create_run(project="default", name="multi-host", spec=spec)
+    uuid = run["uuid"]
+    assert _wait(
+        lambda: (store.get_run(uuid) or {}).get("status") in ("succeeded", "failed"),
+        tick=agent.tick, timeout=60,
+    )
+    assert store.get_run(uuid)["status"] == "succeeded"
+    # full lifecycle order
+    seen = [json.loads(json.dumps(c))["type"] if isinstance(c, dict) else c
+            for c in [d["type"] for d in store.get_statuses(uuid)]]
+    for expected in ("created", "compiled", "queued", "scheduled", "running", "succeeded"):
+        assert expected in seen, f"{expected} missing from {seen}"
+    assert seen.index("scheduled") < seen.index("running") < seen.index("succeeded")
+    # every host pod ran as a real process and printed its rendezvous env
+    cluster = agent.cluster
+    envs = []
+    for host in range(4):
+        log = cluster.pod_logs(f"plx-{uuid[:12]}-{host}")
+        envs.append(json.loads(log.strip().splitlines()[-1]))
+    assert [e["PLX_PROCESS_ID"] for e in envs] == ["0", "1", "2", "3"]
+    assert all(e["PLX_NUM_PROCESSES"] == "4" for e in envs)
+    assert len({e["PLX_COORDINATOR_ADDRESS"] for e in envs}) == 1
+    assert envs[0]["PLX_COORDINATOR_ADDRESS"].startswith("127.0.0.1:")
+    assert envs[0]["PLX_SLICE_TOPOLOGY"] == "4x4"
+    agent.stop()
+
+
+def test_e2e_instant_pod_reaches_succeeded(tmp_path):
+    """A pod finishing before the first observe pass (argv-less pods force
+    phase Succeeded instantly) must still land the run in `succeeded` —
+    the status machine has no scheduled→succeeded edge, so the reconciler
+    emits the intermediate running phase."""
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    store = Store(":memory:")
+    agent = LocalAgent(store, str(tmp_path), backend="cluster", poll_interval=0.05)
+    spec = check_polyaxonfile({
+        "kind": "component",
+        "run": {"kind": "job", "container": {"image": "python:3.12"}},
+    }).to_dict()
+    uuid = store.create_run(project="default", name="instant", spec=spec)["uuid"]
+    assert _wait(lambda: (store.get_run(uuid) or {}).get("status") == "succeeded",
+                 tick=agent.tick, timeout=30)
+    types = [c["type"] for c in store.get_statuses(uuid)]
+    assert "running" in types and types[-1] == "succeeded"
+    agent.stop()
+
+
+def test_e2e_failed_run_keeps_pod_logs(tmp_path):
+    """Pod logs must be scraped into the run's logs/ dir BEFORE the failed
+    pods are torn down."""
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    store = Store(":memory:")
+    agent = LocalAgent(store, str(tmp_path), backend="cluster", poll_interval=0.05)
+    spec = check_polyaxonfile({
+        "kind": "component",
+        "run": {"kind": "job", "container": {
+            "image": "python:3.12",
+            "command": [sys.executable, "-c",
+                        "print('diagnostic breadcrumb'); raise SystemExit(2)"],
+        }},
+    }).to_dict()
+    uuid = store.create_run(project="default", name="crasher", spec=spec)["uuid"]
+    assert _wait(lambda: (store.get_run(uuid) or {}).get("status") == "failed",
+                 tick=agent.tick, timeout=30)
+    logs_dir = os.path.join(str(tmp_path), "default", uuid, "logs")
+    texts = []
+    if os.path.isdir(logs_dir):
+        for f in os.listdir(logs_dir):
+            with open(os.path.join(logs_dir, f), encoding="utf-8") as fh:
+                texts.append(fh.read())
+    assert any("diagnostic breadcrumb" in t for t in texts), texts
+    agent.stop()
+
+
+def test_e2e_stop_through_reconciler(tmp_path):
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    store = Store(":memory:")
+    agent = LocalAgent(store, str(tmp_path), backend="cluster", poll_interval=0.05)
+    spec = check_polyaxonfile({
+        "kind": "component",
+        "name": "sleeper",
+        "run": {
+            "kind": "job",
+            "container": {
+                "image": "python:3.12",
+                "command": [sys.executable, "-c", "import time; time.sleep(120)"],
+            },
+        },
+    }).to_dict()
+    run = store.create_run(project="default", name="sleeper", spec=spec)
+    uuid = run["uuid"]
+    assert _wait(lambda: (store.get_run(uuid) or {}).get("status") == "running",
+                 tick=agent.tick, timeout=60)
+    store.transition(uuid, V1Statuses.STOPPING.value)
+    assert _wait(lambda: (store.get_run(uuid) or {}).get("status") == "stopped",
+                 tick=agent.tick, timeout=30)
+    # pod process actually killed
+    assert _wait(lambda: agent.cluster.pod_statuses({"app.polyaxon.com/run": uuid}) == [])
+    agent.stop()
