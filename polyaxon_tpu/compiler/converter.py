@@ -14,6 +14,7 @@ Two backends (upstream rendered K8s podspecs only — SURVEY.md §2
 
 from __future__ import annotations
 
+import json
 import shlex
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -71,14 +72,31 @@ def get_main_container(compiled: V1CompiledOperation) -> Optional[V1Container]:
     return getattr(run, "container", None)
 
 
+def _apply_builtin_to_pod(cm: dict, builtin: Optional[dict], ctx: dict) -> None:
+    """Make a rendered pod container run the builtin trainer: spec env +
+    default command/workingDir. One definition for every run kind."""
+    if builtin is None:
+        return
+    cm["env"] = (cm.get("env") or []) + [
+        {"name": "PLX_BUILTIN_SPEC", "value": json.dumps(builtin)}
+    ]
+    if not cm.get("command"):
+        cm["command"] = ["python", "-m", "polyaxon_tpu.runtime.builtin"]
+        if not cm.get("workingDir"):
+            cm["workingDir"] = ctx["globals"]["run_artifacts_path"]
+
+
 def _render_builtin(run: Any, ctx: dict) -> Optional[dict]:
     """Render the `runtime:` builtin-trainer spec (shared by the local and
-    K8s paths so they can never diverge)."""
-    if not (isinstance(run, V1TPUJob) and run.runtime):
+    K8s paths so they can never diverge). Available on tpujob/jaxjob and all
+    Kubeflow-style kinds."""
+    runtime = getattr(run, "runtime", None)
+    if not runtime:
         return None
-    builtin = dict(render_value(run.runtime, ctx))
-    if run.parallelism:
-        builtin.setdefault("parallelism", run.parallelism.to_dict())
+    builtin = dict(render_value(runtime, ctx))
+    parallelism = getattr(run, "parallelism", None)
+    if parallelism:
+        builtin.setdefault("parallelism", parallelism.to_dict())
     return builtin
 
 
@@ -182,8 +200,6 @@ def to_k8s_resources(
         }
 
     if isinstance(run, V1TPUJob):
-        import json as _json
-
         topo: SliceTopology = run.get_slice()
         hosts = topo.num_hosts
         svc = f"plx-{run_uuid[:12]}-hosts"
@@ -200,16 +216,9 @@ def to_k8s_resources(
             env["PLX_SLICE_TOPOLOGY"] = topo.topology
             env["PLX_SLICE_ACCELERATOR"] = topo.accelerator
             if run.parallelism:
-                env["PLX_PARALLELISM"] = _json.dumps(run.parallelism.to_dict())
-            if builtin is not None:
-                env["PLX_BUILTIN_SPEC"] = _json.dumps(builtin)
+                env["PLX_PARALLELISM"] = json.dumps(run.parallelism.to_dict())
             cm = _container_manifest(run.container, ctx, env)
-            if builtin is not None and not cm.get("command"):
-                # `runtime:` shortcut: the pod runs our builtin trainer
-                cm["command"] = ["python", "-m", "polyaxon_tpu.runtime.builtin"]
-                cm.setdefault("workingDir", None)
-                if not cm["workingDir"]:
-                    cm["workingDir"] = ctx["globals"]["run_artifacts_path"]
+            _apply_builtin_to_pod(cm, builtin, ctx)
             cm["resources"] = {"limits": {k: str(v) for k, v in topo.tpu_resources().items()}}
             pods.append(pod(
                 f"plx-{run_uuid[:12]}-{host_idx}",
@@ -239,6 +248,10 @@ def to_k8s_resources(
             if getattr(run, role, None) is not None
         ]
         total = sum((g.replicas or 1) for _, g in groups)
+        builtin = _render_builtin(run, ctx)
+        # no parallelism default: build_mesh absorbs all capacity into the
+        # data axis, which IS the DDP semantics — and unlike {"data": total}
+        # it stays correct when each replica owns several local devices
         svc = f"plx-{run_uuid[:12]}-hosts"
         # process 0 is the first replica of the first group; its stable DNS
         # name (hostname.subdomain) is the rendezvous coordinator
@@ -255,6 +268,7 @@ def to_k8s_resources(
                 env["PLX_REPLICA_ROLE"] = role
                 env["PLX_REPLICA_INDEX"] = str(r)
                 cm = _container_manifest(group.container, ctx, env)
+                _apply_builtin_to_pod(cm, builtin, ctx)
                 name = f"plx-{run_uuid[:12]}-{role}-{r}"
                 pods.append(pod(name, cm,
                                 extra={"subdomain": svc, "hostname": name}))

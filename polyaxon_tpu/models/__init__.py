@@ -5,11 +5,14 @@ over the shared transformer core."""
 from . import bert, gpt2, llama, resnet, transformer, vit
 from .transformer import TransformerConfig, cross_entropy_loss
 
-# name -> (module, config) for CLI/runtime lookup (`runtime: {model: ...}`)
+# name -> (family, config) for CLI/runtime lookup (`runtime: {model: ...}`);
+# family selects the Task in train/tasks.py
 REGISTRY: dict = {}
-for _mod in (llama, gpt2, bert):
+for _mod in (llama, gpt2):
     for _name, _cfg in _mod.CONFIGS.items():
         REGISTRY[_name] = ("lm", _cfg)
+for _name, _cfg in bert.CONFIGS.items():
+    REGISTRY[_name] = ("mlm", _cfg)
 for _name, _cfg in vit.CONFIGS.items():
     REGISTRY[_name] = ("vit", _cfg)
 for _name, _cfg in resnet.CONFIGS.items():

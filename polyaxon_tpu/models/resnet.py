@@ -129,6 +129,40 @@ def apply(
     return logits.astype(jnp.float32), new_stats
 
 
+def flops_per_image(cfg: ResNetConfig, image_size: int) -> float:
+    """Training FLOPs per image (2*MACs forward, x3 for fwd+bwd), walking
+    the same conv schedule as apply()."""
+    total = 0.0
+
+    def conv(kh, kw, cin, cout, hw, stride=1):
+        nonlocal total
+        out = hw // stride
+        total += 2.0 * kh * kw * cin * cout * out * out
+        return out
+
+    w = cfg.width
+    hw = image_size
+    hw = conv(3 if cfg.small_inputs else 7, 3 if cfg.small_inputs else 7, 3, w,
+              hw, stride=1 if cfg.small_inputs else 2)
+    if not cfg.small_inputs:
+        hw //= 2  # maxpool
+    cin = w
+    for si, n_blocks in enumerate(cfg.stage_sizes):
+        cmid = w * (2 ** si)
+        cout = cmid * _BOTTLENECK
+        for bi in range(n_blocks):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            conv(1, 1, cin, cmid, hw)
+            hw2 = conv(3, 3, cmid, cmid, hw, stride=stride)
+            conv(1, 1, cmid, cout, hw2)
+            if bi == 0:
+                conv(1, 1, cin, cout, hw, stride=stride)
+            hw = hw2
+            cin = cout
+    total += 2.0 * cin * cfg.num_classes
+    return 3.0 * total
+
+
 def classification_loss(logits, labels):
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]

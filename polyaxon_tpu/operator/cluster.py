@@ -102,6 +102,7 @@ class FakeCluster(Cluster):
         os.makedirs(self.workdir, exist_ok=True)
         self.pods: dict[str, _FakePod] = {}
         self.services: dict[str, dict] = {}
+        self.service_ports: dict[str, int] = {}
         self._lock = threading.Lock()
         # observability for tests: every env block a pod was launched with
         self.launched_env: dict[str, dict[str, str]] = {}
@@ -111,8 +112,19 @@ class FakeCluster(Cluster):
     def apply(self, manifest: dict) -> None:
         kind = manifest.get("kind")
         if kind == "Service":
+            name = manifest["metadata"]["name"]
             with self._lock:
-                self.services[manifest["metadata"]["name"]] = manifest
+                if name not in self.service_ports:
+                    # distinct loopback port per service: concurrent
+                    # distributed runs must not share one coordinator port
+                    # (real clusters separate by pod IP; loopback can't)
+                    import socket
+
+                    s = socket.socket()
+                    s.bind(("127.0.0.1", 0))
+                    self.service_ports[name] = s.getsockname()[1]
+                    s.close()
+                self.services[name] = manifest
             return
         if kind != "Pod":
             raise ValueError(f"FakeCluster cannot apply kind {kind!r}")
@@ -173,8 +185,12 @@ class FakeCluster(Cluster):
     # -- pod launch --------------------------------------------------------
 
     def _rewrite_dns(self, value: str) -> str:
-        """Rewrite <pod>.<registered-service> host references to loopback."""
-        for svc in self.services:
+        """Rewrite <pod>.<registered-service>[:port] references to loopback,
+        remapping the port to the service's allocated local port."""
+        for svc, port in self.service_ports.items():
+            value = re.sub(
+                rf"[A-Za-z0-9.-]+\.{re.escape(svc)}:\d+", f"127.0.0.1:{port}", value,
+            )
             value = re.sub(rf"[A-Za-z0-9.-]+\.{re.escape(svc)}", "127.0.0.1", value)
         return value
 

@@ -41,29 +41,52 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
 
     dist_init()  # joins jax.distributed when PLX_COORDINATOR_* present
 
+    from ..train.tasks import task_for
+
     name = spec.get("model", "llama-tiny")
     if name not in REGISTRY:
         raise SystemExit(f"Unknown model {name!r}; available: {sorted(REGISTRY)}")
     family, mcfg = REGISTRY[name]
-    if family != "lm":
-        raise SystemExit(f"builtin runtime currently trains LM models; {name} is {family}")
 
-    overrides = {}
-    if spec.get("remat"):
-        overrides["remat"] = spec["remat"]
-    seq_len = int(spec.get("seq_len", min(2048, mcfg.max_seq)))
-    if seq_len > mcfg.max_seq:
-        overrides["max_seq"] = seq_len
-    if overrides:
-        mcfg = replace(mcfg, **overrides)
+    if family in ("lm", "mlm"):
+        overrides = {}
+        if spec.get("remat"):
+            overrides["remat"] = spec["remat"]
+        seq_len = int(spec.get("seq_len", min(2048, mcfg.max_seq)))
+        if seq_len > mcfg.max_seq:
+            overrides["max_seq"] = seq_len
+        if overrides:
+            mcfg = replace(mcfg, **overrides)
+        task = task_for(family, mcfg)
+        vocab_size = mcfg.vocab_size
+        image_size = num_classes = None
+    elif family == "vit":
+        seq_len = mcfg.num_patches + 1
+        task = task_for(family, mcfg)
+        vocab_size = None
+        image_size, num_classes = mcfg.image_size, mcfg.num_classes
+    elif family == "resnet":
+        image_size = int(spec.get("image_size", 32 if mcfg.small_inputs else 224))
+        seq_len = 1
+        task = task_for(family, mcfg, image_size=image_size)
+        vocab_size = None
+        num_classes = mcfg.num_classes
+    else:
+        raise SystemExit(f"no builtin task for model family {family!r}")
 
     steps = int(spec.get("steps", 100))
     batch_size = int(spec.get("batch_size", 8))
-    run = tracking.get_run()
+    import jax
+
+    # In multi-process runs every process executes the same SPMD program but
+    # only process 0 owns tracking/outputs (they share one artifacts dir).
+    is_primary = jax.process_index() == 0
+    run = tracking.get_run() if is_primary else None
+    artifacts_dir = run.run_dir if run else os.environ.get("PLX_ARTIFACTS_PATH", os.getcwd())
 
     ckpt_spec = spec.get("checkpoint") or {}
     ckpt = CheckpointConfig(
-        directory=os.path.join(run.run_dir, "outputs", "checkpoints"),
+        directory=os.path.join(artifacts_dir, "outputs", "checkpoints"),
         save_interval_steps=int(ckpt_spec.get("save_interval_steps", max(steps // 4, 1))),
         max_to_keep=int(ckpt_spec.get("max_to_keep", 3)),
         async_save=bool(ckpt_spec.get("async_save", True)),
@@ -84,30 +107,38 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
         checkpoint=ckpt,
         log_interval=int(spec.get("log_interval", 10)),
     )
-    trainer = Trainer(
-        tcfg,
-        track=lambda step, m: run.log_metrics(step=step, **{
+    track = None
+    if run is not None:
+        track = lambda step, m: run.log_metrics(step=step, **{  # noqa: E731
             k: v for k, v in m.items() if isinstance(v, (int, float))
-        }),
-    )
+        })
+    trainer = Trainer(tcfg, task=task, track=track)
 
     data_spec = dict(spec.get("data") or {})
+    data_kwargs: dict[str, Any] = {}
+    if vocab_size is not None:
+        data_kwargs["vocab_size"] = vocab_size
+    if image_size is not None:
+        data_kwargs["image_size"] = image_size
+    if num_classes is not None:
+        data_kwargs["num_classes"] = num_classes
     data_cfg = DataConfig(
-        kind=data_spec.get("kind", "synthetic-lm"),
+        kind=data_spec.get("kind", task.default_data_kind),
         batch_size=batch_size,
         seq_len=seq_len,
-        vocab_size=mcfg.vocab_size,
         path=data_spec.get("path"),
         seed=int(data_spec.get("seed", 0)),
+        **data_kwargs,
     )
     batches = make_batches(data_cfg, trainer.mesh)
 
     state, metrics = trainer.fit(batches, num_steps=steps)
     summary = {k: v for k, v in metrics.items() if isinstance(v, (int, float))}
-    run.log_outputs(**summary)
-    if ckpt:
-        run.log_artifact("checkpoints", "outputs/checkpoints", kind="checkpoint")
-    run.end()
+    if run is not None:
+        run.log_outputs(**summary)
+        if ckpt:
+            run.log_artifact("checkpoints", "outputs/checkpoints", kind="checkpoint")
+        run.end()
     print(json.dumps({"final": summary}))
     return summary
 

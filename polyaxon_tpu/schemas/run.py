@@ -121,6 +121,11 @@ class V1SchedulingPolicy(BaseSchema):
 class _KFJob(_BaseRun):
     clean_pod_policy: Optional[str] = None
     scheduling_policy: Optional[V1SchedulingPolicy] = None
+    # Training-runtime shortcut (same as V1TPUJob.runtime): replicas run the
+    # built-in trainer as one SPMD program instead of a user container —
+    # upstream's Kubeflow workloads (DDP/TF/Horovod) become mesh configs of
+    # the owned runtime (SURVEY.md §7 stage 4)
+    runtime: Optional[dict[str, Any]] = None
 
 
 class V1TFJob(_KFJob):

@@ -37,7 +37,11 @@ def _batch_sharding(mesh: Optional[Mesh], extra_dims: int, seq_axis: bool = Fals
 def _put(arr: np.ndarray, sharding) -> jax.Array:
     if sharding is None:
         return jax.numpy.asarray(arr)
-    return jax.device_put(arr, sharding)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    # multi-process: every process generates the same global batch (same
+    # seed), each contributes only its addressable shards
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
 
 
 def synthetic_lm_batches(cfg: DataConfig, mesh: Optional[Mesh] = None) -> Iterator[dict]:
@@ -49,6 +53,29 @@ def synthetic_lm_batches(cfg: DataConfig, mesh: Optional[Mesh] = None) -> Iterat
         yield {
             "inputs": _put(tok[:, :-1], sharding),
             "labels": _put(tok[:, 1:], sharding),
+        }
+
+
+def synthetic_mlm_batches(cfg: DataConfig, mesh: Optional[Mesh] = None) -> Iterator[dict]:
+    """BERT-style {inputs, labels, mask} batches: 15% of positions selected,
+    80/10/10 [MASK]/random/keep — done host-side in numpy so the jitted step
+    stays deterministic in its rng-free inputs."""
+    from ..models.bert import MASK_TOKEN_ID
+
+    rng = np.random.default_rng(cfg.seed)
+    sharding = _batch_sharding(mesh, 1, seq_axis=True)
+    mask_id = min(MASK_TOKEN_ID, cfg.vocab_size - 1)
+    while True:
+        tok = rng.integers(0, cfg.vocab_size, (cfg.batch_size, cfg.seq_len), dtype=np.int32)
+        selected = rng.random(tok.shape) < 0.15
+        roll = rng.random(tok.shape)
+        inputs = np.where(selected & (roll < 0.8), mask_id, tok)
+        rand = rng.integers(0, cfg.vocab_size, tok.shape, dtype=np.int32)
+        inputs = np.where(selected & (roll >= 0.8) & (roll < 0.9), rand, inputs)
+        yield {
+            "inputs": _put(inputs, sharding),
+            "labels": _put(tok, sharding),
+            "mask": _put(selected.astype(np.float32), sharding),
         }
 
 
@@ -91,6 +118,8 @@ def token_file_batches(cfg: DataConfig, mesh: Optional[Mesh] = None) -> Iterator
 def make_batches(cfg: DataConfig, mesh: Optional[Mesh] = None) -> Iterator[dict]:
     if cfg.kind == "synthetic-lm":
         return synthetic_lm_batches(cfg, mesh)
+    if cfg.kind == "synthetic-mlm":
+        return synthetic_mlm_batches(cfg, mesh)
     if cfg.kind == "synthetic-image":
         return synthetic_image_batches(cfg, mesh)
     if cfg.kind == "tokens-file":

@@ -1,0 +1,186 @@
+"""Training tasks: model family -> (init, loss, shardings, FLOPs accounting).
+
+The reference delegated every workload's numerics to user containers
+(PyTorch DDP ResNet, TF BERT, Horovod GPT-2 — BASELINE configs 2-4); here
+each family is a Task the one SPMD Trainer consumes, so DP/FSDP/TP/SP come
+from the mesh, not from per-framework launchers. A Task owns:
+
+- ``init(key)`` -> (params, extra)  — extra is mutable non-param state
+  (ResNet batch stats), threaded through the jitted step functionally
+- ``param_specs(rules)`` / ``extra_specs(rules)`` — logical shardings
+- ``loss(params, extra, batch, ...)`` -> (loss, metrics, new_extra)
+- ``tokens_per_step`` / ``flops_per_token`` — throughput units for the MFU
+  meter (samples for vision)
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models import resnet as resnet_mod
+from ..models import transformer
+from ..models import vit as vit_mod
+from ..models.transformer import TransformerConfig, cross_entropy_loss
+from ..parallel.mesh import ShardingRules
+
+
+class Task(ABC):
+    """One trainable workload family."""
+
+    #: DataConfig.kind to default to when the spec names none
+    default_data_kind: str = "synthetic-lm"
+
+    @abstractmethod
+    def init(self, key: jax.Array) -> tuple[Any, Any]:
+        """Returns (params, extra); extra is None when the model has no
+        non-param state."""
+
+    @abstractmethod
+    def param_specs(self, rules: ShardingRules) -> Any: ...
+
+    def extra_specs(self, rules: ShardingRules) -> Any:
+        return None  # replicated
+
+    @abstractmethod
+    def loss(
+        self, params: Any, extra: Any, batch: dict, *, mesh=None, interpret=None,
+    ) -> tuple[jax.Array, dict, Any]:
+        """Returns (scalar loss, metrics dict, new_extra)."""
+
+    @abstractmethod
+    def tokens_per_step(self, batch_size: int, seq_len: int) -> int: ...
+
+    @abstractmethod
+    def flops_per_token(self, seq_len: int) -> float: ...
+
+    def batch_spec(self) -> tuple:
+        """Logical axes of the primary batch array (for input sharding)."""
+        return ("batch", "seq")
+
+
+class LMTask(Task):
+    """Next-token (causal) or masked (bidirectional, when the batch carries a
+    loss mask) language modeling on the shared transformer core."""
+
+    default_data_kind = "synthetic-lm"
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+
+    def init(self, key):
+        return transformer.init(key, self.cfg), None
+
+    def param_specs(self, rules):
+        return transformer.param_specs(self.cfg, rules)
+
+    def loss(self, params, extra, batch, *, mesh=None, interpret=None):
+        logits = transformer.apply(
+            params, batch["inputs"], self.cfg, mesh=mesh, interpret=interpret,
+        )
+        loss = cross_entropy_loss(logits, batch["labels"], batch.get("mask"))
+        return loss, {"loss": loss}, None
+
+    def tokens_per_step(self, batch_size, seq_len):
+        return batch_size * seq_len
+
+    def flops_per_token(self, seq_len):
+        return self.cfg.flops_per_token(seq_len)
+
+
+class MLMTask(LMTask):
+    """BERT-style MLM: same core, bidirectional config, masked batches
+    (data kind synthetic-mlm / tokens-file-mlm supply inputs/labels/mask)."""
+
+    default_data_kind = "synthetic-mlm"
+
+
+class ViTTask(Task):
+    """Image classification with a ViT encoder (BASELINE config 5)."""
+
+    default_data_kind = "synthetic-image"
+
+    def __init__(self, cfg: vit_mod.ViTConfig):
+        self.cfg = cfg
+
+    def init(self, key):
+        return vit_mod.init(key, self.cfg), None
+
+    def param_specs(self, rules):
+        return vit_mod.param_specs(self.cfg, rules)
+
+    def loss(self, params, extra, batch, *, mesh=None, interpret=None):
+        logits = vit_mod.apply(
+            params, batch["images"], self.cfg, mesh=mesh, interpret=interpret,
+        )
+        loss = resnet_mod.classification_loss(logits, batch["labels"])
+        acc = (jnp.argmax(logits, axis=-1) == batch["labels"]).mean()
+        return loss, {"loss": loss, "accuracy": acc}, None
+
+    def tokens_per_step(self, batch_size, seq_len):
+        return batch_size  # samples
+
+    def flops_per_token(self, seq_len):
+        # per image: encoder flops at its sequence length (patches + CLS)
+        tokens = self.cfg.num_patches + 1
+        return self.cfg.encoder.flops_per_token(tokens) * tokens
+
+    def batch_spec(self):
+        return ("batch", None, None, None)
+
+
+class ResNetTask(Task):
+    """ResNet classification (BASELINE config 2); batch stats threaded as
+    ``extra`` — under jit the batch mean/var are global across the ``data``
+    axis (XLA inserts the psum), the SPMD analogue of SyncBatchNorm."""
+
+    default_data_kind = "synthetic-image"
+
+    def __init__(self, cfg: resnet_mod.ResNetConfig, image_size: Optional[int] = None):
+        self.cfg = cfg
+        self.image_size = image_size or (32 if cfg.small_inputs else 224)
+
+    def init(self, key):
+        return resnet_mod.init(key, self.cfg)
+
+    def param_specs(self, rules):
+        # conv kernels replicate (they are small vs activations); fsdp
+        # sharding of convs buys little and complicates layout
+        params, _ = jax.eval_shape(lambda k: resnet_mod.init(k, self.cfg),
+                                   jax.random.PRNGKey(0))
+        from jax.sharding import PartitionSpec as P
+
+        return jax.tree.map(lambda _: P(), params)
+
+    def loss(self, params, extra, batch, *, mesh=None, interpret=None):
+        logits, new_stats = resnet_mod.apply(
+            params, extra, batch["images"], self.cfg, train=True,
+        )
+        loss = resnet_mod.classification_loss(logits, batch["labels"])
+        acc = (jnp.argmax(logits, axis=-1) == batch["labels"]).mean()
+        return loss, {"loss": loss, "accuracy": acc}, new_stats
+
+    def tokens_per_step(self, batch_size, seq_len):
+        return batch_size
+
+    def flops_per_token(self, seq_len):
+        return resnet_mod.flops_per_image(self.cfg, self.image_size)
+
+    def batch_spec(self):
+        return ("batch", None, None, None)
+
+
+def task_for(family: str, model_cfg: Any, **kwargs: Any) -> Task:
+    """Model-zoo family name -> Task (REGISTRY's family tags)."""
+    if family == "lm":
+        return LMTask(model_cfg)
+    if family == "mlm":
+        return MLMTask(model_cfg)
+    if family == "vit":
+        return ViTTask(model_cfg)
+    if family == "resnet":
+        return ResNetTask(model_cfg, **kwargs)
+    raise ValueError(f"no task for model family {family!r}")

@@ -17,9 +17,9 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models import transformer
-from ..models.transformer import TransformerConfig, cross_entropy_loss
+from ..models.transformer import TransformerConfig
 from ..parallel.mesh import ShardingRules, build_mesh
+from .tasks import LMTask, Task
 from .checkpoint import CheckpointConfig, Checkpointer
 from .metrics import ThroughputMeter
 from .optimizers import OptimizerConfig, make_optimizer
@@ -31,15 +31,17 @@ class TrainState:
     params: Any
     opt_state: Any
     step: jax.Array
+    extra: Any = None  # non-param model state (e.g. ResNet batch stats)
 
     @classmethod
-    def create(cls, params: Any, tx: optax.GradientTransformation) -> "TrainState":
-        return cls(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+    def create(cls, params: Any, tx: optax.GradientTransformation, extra: Any = None) -> "TrainState":
+        return cls(params=params, opt_state=tx.init(params),
+                   step=jnp.zeros((), jnp.int32), extra=extra)
 
 
 @dataclass(frozen=True)
 class TrainerConfig:
-    model: TransformerConfig
+    model: Any  # TransformerConfig | ViTConfig | ResNetConfig (Task decides)
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     batch_size: int = 8
     seq_len: int = 128
@@ -50,8 +52,9 @@ class TrainerConfig:
 
 
 class Trainer:
-    """LM trainer (the flagship path; ViT/ResNet have task adapters in
-    runtime/builtin.py)."""
+    """One SPMD trainer for every workload family: the Task supplies init/
+    loss/shardings (LM is the flagship default; ViT/ResNet/BERT come from
+    train/tasks.py via the builtin runtime)."""
 
     def __init__(
         self,
@@ -59,16 +62,23 @@ class Trainer:
         mesh: Optional[Mesh] = None,
         rules: Optional[ShardingRules] = None,
         track: Optional[Callable[[int, dict], None]] = None,
+        task: Optional[Task] = None,
     ):
         self.cfg = cfg
+        if task is None:
+            if not isinstance(cfg.model, TransformerConfig):
+                raise ValueError(
+                    f"model config {type(cfg.model).__name__} needs an explicit Task"
+                )
+            task = LMTask(cfg.model)
+        self.task = task
         self.mesh = mesh if mesh is not None else build_mesh(cfg.parallelism)
         self.rules = rules or ShardingRules()
         self.tx = make_optimizer(cfg.optimizer)
         self.track = track
         self.checkpointer = Checkpointer(cfg.checkpoint) if cfg.checkpoint else None
 
-        mcfg = cfg.model
-        pspecs = transformer.param_specs(mcfg, self.rules)
+        pspecs = task.param_specs(self.rules)
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), pspecs
         )
@@ -78,11 +88,9 @@ class Trainer:
     # -- init / restore ----------------------------------------------------
 
     def init_state(self, seed: int = 0) -> TrainState:
-        mcfg = self.cfg.model
-
         def _init(key):
-            params = transformer.init(key, mcfg)
-            return TrainState.create(params, self.tx)
+            params, extra = self.task.init(key)
+            return TrainState.create(params, self.tx, extra=extra)
 
         key = jax.random.PRNGKey(seed)
         abstract = jax.eval_shape(_init, key)
@@ -121,10 +129,18 @@ class Trainer:
         opt_shardings = jax.tree.map(
             _shard, abstract_state.opt_state, is_leaf=_is_param_subtree
         )
+        extra_specs = self.task.extra_specs(self.rules)
+        if extra_specs is None:
+            extra_sh = jax.tree.map(lambda _: replicated, abstract_state.extra)
+        else:
+            extra_sh = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), extra_specs
+            )
         return TrainState(
             params=self.param_shardings,
             opt_state=opt_shardings,
             step=replicated,
+            extra=extra_sh,
         )
 
     def restore_or_init(self, seed: int = 0) -> tuple[TrainState, int]:
@@ -136,26 +152,25 @@ class Trainer:
 
     # -- the step ----------------------------------------------------------
 
-    def _loss_fn(self, params, batch):
-        logits = transformer.apply(
-            params, batch["inputs"], self.cfg.model, mesh=self.mesh,
+    def _loss_fn(self, params, extra, batch):
+        loss, metrics, new_extra = self.task.loss(
+            params, extra, batch, mesh=self.mesh,
             interpret=jax.default_backend() != "tpu",
         )
-        return cross_entropy_loss(logits, batch["labels"], batch.get("mask"))
+        return loss, (metrics, new_extra)
 
     def make_step(self):
         if self._compiled_step is not None:
             return self._compiled_step
 
         def step_fn(state: TrainState, batch) -> tuple[TrainState, dict]:
-            loss, grads = jax.value_and_grad(self._loss_fn)(state.params, batch)
+            (loss, (metrics, new_extra)), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(state.params, state.extra, batch)
             updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
-            metrics = {
-                "loss": loss,
-                "grad_norm": optax.global_norm(grads),
-            }
-            return TrainState(params, opt_state, state.step + 1), metrics
+            metrics = {**metrics, "grad_norm": optax.global_norm(grads)}
+            return TrainState(params, opt_state, state.step + 1, new_extra), metrics
 
         self._compiled_step = jax.jit(step_fn, donate_argnums=(0,))
         return self._compiled_step
@@ -176,8 +191,8 @@ class Trainer:
         step_fn = self.make_step()
         if meter is None:
             meter = ThroughputMeter(
-                tokens_per_step=self.cfg.batch_size * self.cfg.seq_len,
-                flops_per_token=self.cfg.model.flops_per_token(self.cfg.seq_len),
+                tokens_per_step=self.task.tokens_per_step(self.cfg.batch_size, self.cfg.seq_len),
+                flops_per_token=self.task.flops_per_token(self.cfg.seq_len),
                 num_chips=self.mesh.size,
                 accelerator=self.cfg.accelerator,
             )

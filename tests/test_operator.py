@@ -128,7 +128,10 @@ def test_fake_cluster_dns_rewrite(tmp_path):
         if cluster.pod_statuses({"app.polyaxon.com/run": "r1"})[0].phase == PodPhase.SUCCEEDED:
             break
         time.sleep(0.05)
-    assert cluster.pod_logs("p1").strip() == "127.0.0.1:8476"
+    # host rewritten to loopback, port remapped to the service's allocated
+    # local port (concurrent distributed runs must not share a port)
+    port = cluster.service_ports["plx-abc-hosts"]
+    assert cluster.pod_logs("p1").strip() == f"127.0.0.1:{port}"
 
 
 # ---------------------------------------------------------------------------

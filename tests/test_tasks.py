@@ -1,0 +1,132 @@
+"""Task-adapter tests: ViT/ResNet classification and BERT MLM all train
+through the one SPMD Trainer on a sharded mesh (VERDICT r1 item 6 — the
+vision/MLM paths the reference ran in per-framework user containers,
+BASELINE configs 2/3/5)."""
+
+import itertools
+
+import jax
+import pytest
+
+from polyaxon_tpu.models import REGISTRY, bert, resnet, vit
+from polyaxon_tpu.train import (
+    DataConfig,
+    OptimizerConfig,
+    Trainer,
+    TrainerConfig,
+    make_batches,
+)
+from polyaxon_tpu.train.tasks import (
+    LMTask,
+    MLMTask,
+    ResNetTask,
+    ViTTask,
+    task_for,
+)
+
+
+def _fit(task, model_cfg, data_cfg, steps=8, lr=1e-2, parallelism=None):
+    cfg = TrainerConfig(
+        model=model_cfg,
+        optimizer=OptimizerConfig(learning_rate=lr, warmup_steps=1, total_steps=steps),
+        batch_size=data_cfg.batch_size,
+        seq_len=data_cfg.seq_len,
+        parallelism=parallelism or {"data": 8},
+        log_interval=100,
+    )
+    tr = Trainer(cfg, task=task)
+    # single repeated batch: loss must drop if the step works end to end
+    batch = next(make_batches(data_cfg, tr.mesh))
+    state, m0 = tr.fit(itertools.repeat(batch), num_steps=1)
+    state, m = tr.fit(itertools.repeat(batch), num_steps=steps, state=state)
+    return m0, m
+
+
+class TestViTTask:
+    def test_trains_and_reports_accuracy(self):
+        cfg = vit.VIT_TINY
+        data = DataConfig(kind="synthetic-image", batch_size=8, seq_len=1,
+                          image_size=cfg.image_size, num_classes=cfg.num_classes)
+        m0, m = _fit(ViTTask(cfg), cfg, data, steps=10)
+        assert m["loss"] < m0["loss"]
+        assert 0.0 <= m["accuracy"] <= 1.0
+
+    def test_flops_accounting_positive(self):
+        t = ViTTask(vit.VIT_B16)
+        assert t.flops_per_token(1) > 1e9  # ~B/16 is ~52 GFLOPs/image in training
+        assert t.tokens_per_step(32, 197) == 32
+
+
+class TestResNetTask:
+    def test_trains_with_batchstats_threading(self):
+        cfg = resnet.RESNET18_CIFAR
+        data = DataConfig(kind="synthetic-image", batch_size=8, seq_len=1,
+                          image_size=32, num_classes=cfg.num_classes)
+        task = ResNetTask(cfg, image_size=32)
+        m0, m = _fit(task, cfg, data, steps=8)
+        assert m["loss"] < m0["loss"]
+
+    def test_batch_stats_update(self):
+        cfg = resnet.RESNET18_CIFAR
+        task = ResNetTask(cfg, image_size=32)
+        data = DataConfig(kind="synthetic-image", batch_size=8, seq_len=1,
+                          image_size=32, num_classes=cfg.num_classes)
+        tcfg = TrainerConfig(model=cfg, batch_size=8, seq_len=1,
+                             parallelism={"data": 8})
+        tr = Trainer(tcfg, task=task)
+        state = tr.init_state()
+        stats0 = jax.tree.map(lambda x: x.copy(), state.extra)
+        batch = next(make_batches(data, tr.mesh))
+        state, _ = tr.make_step()(state, batch)
+        # running means must move away from init after one training step
+        moved = jax.tree.map(
+            lambda a, b: bool(abs(a - b).sum() > 0), stats0, state.extra
+        )
+        assert any(jax.tree.leaves(moved))
+
+    def test_flops_walk_matches_known_magnitude(self):
+        # ResNet-50 @224: ~4.1 GMACs = ~8.2 GFLOPs forward -> ~24.5 training
+        f = resnet.flops_per_image(resnet.RESNET50, 224)
+        assert 20e9 < f < 30e9, f
+
+
+class TestMLMTask:
+    def test_bert_mlm_trains(self):
+        cfg = bert.BERT_TINY
+        data = DataConfig(kind="synthetic-mlm", batch_size=8, seq_len=32,
+                          vocab_size=cfg.vocab_size)
+        m0, m = _fit(MLMTask(cfg), cfg, data, steps=10)
+        assert m["loss"] < m0["loss"]
+
+    def test_mlm_batches_shape_and_mask(self):
+        data = DataConfig(kind="synthetic-mlm", batch_size=4, seq_len=64,
+                          vocab_size=256, seed=1)
+        b = next(make_batches(data))
+        assert b["inputs"].shape == (4, 64)
+        mask = jax.device_get(b["mask"])
+        assert 0.05 < mask.mean() < 0.3  # ~15% selected
+        # non-selected positions keep original tokens
+        import numpy as np
+
+        inp, lab = jax.device_get(b["inputs"]), jax.device_get(b["labels"])
+        assert (inp[mask == 0] == lab[mask == 0]).all()
+
+
+class TestRegistryDispatch:
+    def test_bert_is_mlm_family(self):
+        family, _ = REGISTRY["bert-base"]
+        assert family == "mlm"
+
+    def test_task_for_every_family(self):
+        seen = set()
+        for name, (family, cfg) in REGISTRY.items():
+            if family in seen:
+                continue
+            seen.add(family)
+            t = task_for(family, cfg)
+            assert t.flops_per_token(128) > 0
+        assert seen == {"lm", "mlm", "vit", "resnet"}
+
+    def test_unknown_family_raises(self):
+        with pytest.raises(ValueError):
+            task_for("diffusion", None)
