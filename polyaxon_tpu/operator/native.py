@@ -105,7 +105,15 @@ def load_native():
         if _lib is not None or _lib_tried:
             return _lib
         _lib_tried = True
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        try:
+            stale = (not os.path.exists(_SO)
+                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        except OSError:
+            # e.g. .so present but source missing (packaged install): use the
+            # existing binary as-is; any load failure below falls back to the
+            # Python mirror
+            stale = not os.path.exists(_SO)
+        if stale:
             if not _build_so():
                 return None
         try:

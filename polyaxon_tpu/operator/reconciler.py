@@ -47,6 +47,7 @@ class _OpState:
     finished_at: Optional[float] = None
     final_status: Optional[str] = None
     gc_done: bool = False
+    applying: bool = True  # manifests not yet fully applied; reconcile must WAIT
 
 
 # status callback: (run_uuid, status, message)
@@ -72,15 +73,44 @@ class OperationReconciler:
     # -- CR lifecycle ------------------------------------------------------
 
     def apply(self, op: OperationCR) -> None:
-        """Create the operation's resources and start tracking it."""
+        """Create the operation's resources and start tracking it.
+
+        The op is registered first (so a concurrent apply of the same uuid
+        errors) but held in ``applying`` state until every manifest is on the
+        cluster: a background reconcile pass between per-manifest applies
+        must not observe a partial pod set — e.g. every applied pod already
+        succeeded — and emit a premature SUCCEED."""
         with self._lock:
             if op.run_uuid in self._ops:
                 raise ValueError(f"operation {op.run_uuid} already applied")
             state = _OpState(op=op)
             self._ops[op.run_uuid] = state
-        for manifest in op.resources:
-            self.cluster.apply(manifest)
-        state.applied_at = time.monotonic()
+        try:
+            for manifest in op.resources:
+                self.cluster.apply(manifest)
+        except Exception:
+            # tear down BEFORE freeing the uuid so a concurrent re-apply
+            # can't register (and create pods) that this rollback would then
+            # delete; swallow teardown errors so the apply error propagates
+            try:
+                self.cluster.delete_selected(op.label_selector)
+            except Exception:
+                pass
+            with self._lock:
+                if self._ops.get(op.run_uuid) is state:
+                    del self._ops[op.run_uuid]
+            raise
+        with self._lock:
+            if self._ops.get(op.run_uuid) is not state:
+                # concurrent delete() mid-apply untracked us after removing
+                # the pods applied so far; remove the ones applied since
+                concurrent_delete = True
+            else:
+                state.applied_at = time.monotonic()
+                state.applying = False
+                concurrent_delete = False
+        if concurrent_delete:
+            self.cluster.delete_selected(op.label_selector)
 
     def delete(self, run_uuid: str) -> None:
         """Stop tracking and tear down resources (stop / user delete)."""
@@ -136,7 +166,7 @@ class OperationReconciler:
         )
 
     def _reconcile_op(self, state: _OpState) -> None:
-        if state.gc_done:
+        if state.gc_done or state.applying:
             return
         decision: Decision = reconcile(self._observe(state))
         op = state.op

@@ -184,10 +184,12 @@ class LocalAgent:
         if spec.get("matrix"):
             self._start_tuner(run)
             return
-        active = len(self._active)
-        if self.reconciler is not None:
-            active += self.reconciler.active_count()
         with self._lock:
+            active = len(self._active)
+            if self.reconciler is not None:
+                # reconciler.active_count() takes only its own lock; no
+                # lock-ordering cycle with self._lock
+                active += self.reconciler.active_count()
             if active >= self.max_parallel:
                 return
             if uuid in self._active:
