@@ -3,7 +3,7 @@
 over the shared transformer core."""
 
 from . import bert, gpt2, llama, resnet, transformer, vit
-from .transformer import TransformerConfig, cross_entropy_loss
+from .transformer import TransformerConfig, cross_entropy_loss, lm_loss_from_hidden
 
 # name -> (family, config) for CLI/runtime lookup (`runtime: {model: ...}`);
 # family selects the Task in train/tasks.py
@@ -20,5 +20,5 @@ for _name, _cfg in resnet.CONFIGS.items():
 
 __all__ = [
     "bert", "gpt2", "llama", "resnet", "transformer", "vit",
-    "TransformerConfig", "cross_entropy_loss", "REGISTRY",
+    "TransformerConfig", "cross_entropy_loss", "lm_loss_from_hidden", "REGISTRY",
 ]

@@ -35,10 +35,20 @@ LLAMA_125M = replace(
     num_kv_heads=12, mlp_dim=2048, max_seq=2048,
 )
 
+# ~1.1B with TinyLlama's architecture (hidden 2048, GQA 32/4, mlp 5632):
+# the single-chip bench model — big enough that matmul shapes reach MXU
+# efficiency (K=2048), small enough to fit one v5e-16GB with full AdamW
+# (bf16 first moments) + remat.
+LLAMA_1B = replace(
+    LLAMA2_7B, hidden=2048, num_layers=22, num_heads=32, num_kv_heads=4,
+    mlp_dim=5632, max_seq=2048,
+)
+
 CONFIGS = {
     "llama2-7b": LLAMA2_7B,
     "llama2-13b": LLAMA2_13B,
     "llama2-70b": LLAMA2_70B,
     "llama-tiny": LLAMA_TINY,
     "llama-125m": LLAMA_125M,
+    "llama-1b": LLAMA_1B,
 }

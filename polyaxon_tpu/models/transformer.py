@@ -68,6 +68,7 @@ class TransformerConfig:
     remat: str = "none"                         # "none" | "full" | "dots"
     attn_block_q: int = 512
     attn_block_k: int = 512
+    loss_chunk_tokens: int = 4096               # blockwise-CE chunk; 0 = unchunked
 
     @property
     def kv_heads(self) -> int:
@@ -298,7 +299,7 @@ def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interp
     return x
 
 
-def apply(
+def apply_hidden(
     params: dict,
     tokens: jax.Array,
     cfg: TransformerConfig,
@@ -307,7 +308,10 @@ def apply(
     interpret: Optional[bool] = None,
     inputs_embeds: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Forward pass: tokens [batch, seq] -> logits [batch, seq, vocab] (f32).
+    """Trunk forward: tokens [batch, seq] -> final-norm hidden states
+    [batch, seq, hidden] (activation dtype). The vocab projection is left to
+    the caller — the training loss fuses it blockwise (lm_loss_from_hidden)
+    so the full [B,S,V] f32 logits tensor never materializes.
 
     ``inputs_embeds`` bypasses token embedding (ViT patches, BERT pipelines).
     """
@@ -330,11 +334,38 @@ def apply(
         rope_tables = (cos[:s], sin[:s])
 
     x = run_trunk(x, params["layers"], cfg, rope_tables, mesh, interpret)
-    x = _norm(x, params["final_norm"], cfg)
+    return _norm(x, params["final_norm"], cfg)
+
+
+def head_weights(params: dict, cfg: TransformerConfig) -> tuple[jax.Array, bool]:
+    """LM-head weight and its orientation: (w, vocab_major). vocab_major
+    means w is [vocab, hidden] (tied embeddings) vs [hidden, vocab]."""
     if cfg.tie_embeddings:
-        logits = jnp.einsum("bsh,vh->bsv", x, params["embed"]["tokens"].astype(dt))
-    else:
-        logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["w"].astype(dt))
+        return params["embed"]["tokens"], True
+    return params["lm_head"]["w"], False
+
+
+def apply(
+    params: dict,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+    interpret: Optional[bool] = None,
+    inputs_embeds: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Full forward: tokens [batch, seq] -> logits [batch, seq, vocab] (f32).
+
+    Evaluation/inference path; training uses apply_hidden +
+    lm_loss_from_hidden to avoid materializing the logits.
+    """
+    x = apply_hidden(
+        params, tokens, cfg, mesh=mesh, interpret=interpret,
+        inputs_embeds=inputs_embeds,
+    )
+    w, vocab_major = head_weights(params, cfg)
+    eq = "bsh,vh->bsv" if vocab_major else "bsh,hv->bsv"
+    logits = jnp.einsum(eq, x, w.astype(cfg.dtype))
     return logits.astype(jnp.float32)
 
 
@@ -350,3 +381,66 @@ def cross_entropy_loss(
         return nll.mean()
     mask = mask.astype(jnp.float32)
     return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def _chunk_nll(x, w, labels, vocab_major):
+    """Per-token NLL for one chunk: project to vocab (bf16 matmul, MXU),
+    reduce in f32. The chunk's logits are the only vocab-sized live tensor."""
+    eq = "...h,vh->...v" if vocab_major else "...h,hv->...v"
+    logits = jnp.einsum(eq, x, w.astype(x.dtype)).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return logz - gold
+
+
+def lm_loss_from_hidden(
+    x: jax.Array,
+    w: jax.Array,
+    labels: jax.Array,
+    mask: Optional[jax.Array] = None,
+    *,
+    vocab_major: bool = False,
+    chunk_tokens: int = 4096,
+) -> jax.Array:
+    """Blockwise fused vocab-projection + cross entropy.
+
+    Scans sequence chunks of ``x`` [batch, seq, hidden] against the head
+    weight so at most ~chunk_tokens × vocab f32 logits are live at once
+    (vs batch × seq × vocab for the unfused path — 4 GB at batch 16,
+    seq 2048, vocab 32k). The chunk body is rematerialized in the backward
+    pass, so the same bound holds for gradients. Numerics match
+    cross_entropy_loss(apply(...)) exactly: identical matmul dtype and f32
+    reductions, summed over the same token set.
+    """
+    b, s, h = x.shape
+    mask_f = None if mask is None else mask.astype(jnp.float32)
+    nc = 1
+    if chunk_tokens and b * s > chunk_tokens:
+        # smallest chunk count that divides seq and fits the token budget
+        nc = next(
+            (c for c in range(1, s + 1) if s % c == 0 and (s // c) * b <= chunk_tokens),
+            s,
+        )
+    if nc == 1:
+        nll = _chunk_nll(x, w, labels, vocab_major)
+        if mask_f is None:
+            return nll.mean()
+        return (nll * mask_f).sum() / jnp.maximum(mask_f.sum(), 1.0)
+
+    cs = s // nc
+    xs = x.reshape(b, nc, cs, h).swapaxes(0, 1)
+    ls = labels.reshape(b, nc, cs).swapaxes(0, 1)
+    if mask_f is None:
+        ms = jnp.ones((nc, b, cs), jnp.float32)
+    else:
+        ms = mask_f.reshape(b, nc, cs).swapaxes(0, 1)
+
+    def body(carry, inp):
+        xc, lc, mc = inp
+        nll = _chunk_nll(xc, w, lc, vocab_major)
+        return (carry[0] + (nll * mc).sum(), carry[1] + mc.sum()), None
+
+    body = jax.checkpoint(body, prevent_cse=False)
+    zero = jnp.zeros((), jnp.float32)
+    (total, count), _ = jax.lax.scan(body, (zero, zero), (xs, ls, ms))
+    return total / jnp.maximum(count, 1.0)

@@ -27,6 +27,9 @@ class OptimizerConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     momentum: float = 0.9             # sgd
+    # bf16 first moments halve adam/lion state HBM with negligible quality
+    # impact — what lets a ~1B model + full optimizer fit one v5e chip
+    mu_dtype: Optional[str] = None    # e.g. "bfloat16"; None = param dtype
 
 
 def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
@@ -50,11 +53,13 @@ def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
 def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     sched = make_schedule(cfg)
     if cfg.name == "adamw":
-        tx = optax.adamw(sched, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay)
+        tx = optax.adamw(sched, b1=cfg.b1, b2=cfg.b2,
+                         weight_decay=cfg.weight_decay, mu_dtype=cfg.mu_dtype)
     elif cfg.name == "sgd":
         tx = optax.sgd(sched, momentum=cfg.momentum)
     elif cfg.name == "lion":
-        tx = optax.lion(sched, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay)
+        tx = optax.lion(sched, b1=cfg.b1, b2=cfg.b2,
+                        weight_decay=cfg.weight_decay, mu_dtype=cfg.mu_dtype)
     elif cfg.name == "adafactor":
         tx = optax.adafactor(sched)
     else:

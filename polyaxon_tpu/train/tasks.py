@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from ..models import resnet as resnet_mod
 from ..models import transformer
 from ..models import vit as vit_mod
-from ..models.transformer import TransformerConfig, cross_entropy_loss
+from ..models.transformer import TransformerConfig
 from ..parallel.mesh import ShardingRules
 
 
@@ -78,10 +78,14 @@ class LMTask(Task):
         return transformer.param_specs(self.cfg, rules)
 
     def loss(self, params, extra, batch, *, mesh=None, interpret=None):
-        logits = transformer.apply(
+        hidden = transformer.apply_hidden(
             params, batch["inputs"], self.cfg, mesh=mesh, interpret=interpret,
         )
-        loss = cross_entropy_loss(logits, batch["labels"], batch.get("mask"))
+        w, vocab_major = transformer.head_weights(params, self.cfg)
+        loss = transformer.lm_loss_from_hidden(
+            hidden, w, batch["labels"], batch.get("mask"),
+            vocab_major=vocab_major, chunk_tokens=self.cfg.loss_chunk_tokens,
+        )
         return loss, {"loss": loss}, None
 
     def tokens_per_step(self, batch_size, seq_len):

@@ -49,6 +49,54 @@ class TestTransformerCore:
         l2 = transformer.apply(params, t2, cfg)
         np.testing.assert_allclose(np.asarray(l1[:, :8]), np.asarray(l2[:, :8]), atol=1e-5)
 
+    @pytest.mark.parametrize("tie,masked", [(False, False), (False, True), (True, False)])
+    def test_fused_loss_matches_dense(self, tie, masked):
+        """lm_loss_from_hidden (blockwise, chunked) == CE over full logits,
+        in value and in gradients — the training path never materializes
+        [B,S,V] logits but must be numerically identical to the path that
+        does."""
+        from dataclasses import replace as _replace
+
+        cfg = _replace(llama.LLAMA_TINY, tie_embeddings=tie, loss_chunk_tokens=64)
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        tokens = _lm_batch(jax.random.PRNGKey(1), cfg, batch=4, seq=32)
+        labels = jnp.roll(tokens, -1, axis=1)
+        mask = None
+        if masked:
+            mask = (jax.random.uniform(jax.random.PRNGKey(2), labels.shape) < 0.7)
+
+        def dense(p):
+            return cross_entropy_loss(transformer.apply(p, tokens, cfg), labels, mask)
+
+        def fused(p):
+            hidden = transformer.apply_hidden(p, tokens, cfg)
+            w, vm = transformer.head_weights(p, cfg)
+            return transformer.lm_loss_from_hidden(
+                hidden, w, labels, mask, vocab_major=vm,
+                chunk_tokens=cfg.loss_chunk_tokens,
+            )
+
+        ld, gd = jax.value_and_grad(dense)(params)
+        lf, gf = jax.value_and_grad(fused)(params)
+        np.testing.assert_allclose(float(ld), float(lf), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4),
+            gd, gf,
+        )
+
+    def test_fused_loss_unchunked_small_batch(self):
+        # b*s <= chunk_tokens takes the single-chunk path
+        cfg = llama.LLAMA_TINY
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        tokens = _lm_batch(jax.random.PRNGKey(1), cfg, batch=1, seq=8)
+        labels = jnp.roll(tokens, -1, axis=1)
+        hidden = transformer.apply_hidden(params, tokens, cfg)
+        w, vm = transformer.head_weights(params, cfg)
+        lf = transformer.lm_loss_from_hidden(hidden, w, labels, vocab_major=vm)
+        ld = cross_entropy_loss(transformer.apply(params, tokens, cfg), labels)
+        np.testing.assert_allclose(float(ld), float(lf), rtol=1e-5)
+
     def test_loss_decreases_sgd(self):
         cfg = llama.LLAMA_TINY
         params = transformer.init(jax.random.PRNGKey(0), cfg)
