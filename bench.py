@@ -29,14 +29,25 @@ def main() -> None:
 
     n = len(jax.devices())
     on_tpu = jax.default_backend() == "tpu"
+    moments = {}
+    grad_dtype = None
 
     if on_tpu and n >= 32:
-        mcfg, batch, seq, axes = llama.LLAMA2_7B, 64, 2048, {"fsdp": n}
-        steps = 20
+        mcfg = replace(llama.LLAMA2_7B, remat="attn",
+                       attn_block_q=1024, attn_block_k=1024)
+        batch, seq, axes, steps = 64, 2048, {"fsdp": n}, 20
+        moments = {"mu_dtype": "bfloat16", "nu_dtype": "bfloat16"}
+        grad_dtype = "bfloat16"
     elif on_tpu:
-        # single chip (or few): ~125M model, pure DP
-        mcfg = replace(llama.LLAMA_125M, remat="dots", max_seq=2048)
-        batch, seq, axes, steps = 8 * n, 2048, {"data": n}, 20
+        # single chip: ~1.1B (TinyLlama shape) — big enough that matmul
+        # shapes hit MXU efficiency; fits 16 GiB via attn-only remat +
+        # bf16 moments/grads (measured r3: MFU 0.44 vs 0.365 for the old
+        # 125M/dots config)
+        mcfg = replace(llama.LLAMA_1B, remat="attn", max_seq=2048,
+                       attn_block_q=1024, attn_block_k=1024)
+        batch, seq, axes, steps = 4 * n, 2048, {"data": n}, 20
+        moments = {"mu_dtype": "bfloat16", "nu_dtype": "bfloat16"}
+        grad_dtype = "bfloat16"
     else:
         # CPU smoke: tiny
         mcfg = replace(llama.LLAMA_TINY, attn_impl="dense")
@@ -44,11 +55,13 @@ def main() -> None:
 
     cfg = TrainerConfig(
         model=mcfg,
-        optimizer=OptimizerConfig(learning_rate=3e-4, warmup_steps=5, total_steps=steps),
+        optimizer=OptimizerConfig(learning_rate=3e-4, warmup_steps=5,
+                                  total_steps=steps, **moments),
         batch_size=batch,
         seq_len=seq,
         parallelism=axes,
         accelerator="v5e",
+        grad_dtype=grad_dtype,
     )
     trainer = Trainer(cfg)
     data = make_batches(

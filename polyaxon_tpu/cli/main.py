@@ -41,14 +41,17 @@ def get_host(explicit: Optional[str]) -> Optional[str]:
     return explicit or os.environ.get("PLX_API_HOST") or load_config().get("host")
 
 
-def _local_stack(data_dir: str = ".plx"):
-    """Embedded store + agent for hostless local runs."""
+def _local_stack(data_dir: str = ".plx", backend: str = "auto"):
+    """Embedded store + agent for hostless local runs. ``auto`` routes
+    distributed kinds through the operator/reconciler (per-host pods with
+    rendezvous env) and plain jobs through the local executor."""
     from ..api.store import Store
     from ..scheduler.agent import LocalAgent
 
     os.makedirs(data_dir, exist_ok=True)
     store = Store(os.path.join(data_dir, "db.sqlite"))
-    agent = LocalAgent(store, artifacts_root=os.path.join(data_dir, "artifacts"))
+    agent = LocalAgent(store, artifacts_root=os.path.join(data_dir, "artifacts"),
+                       backend=backend)
     return store, agent
 
 
@@ -73,7 +76,11 @@ def cli():
 @click.option("--local", is_flag=True, help="run on this machine (embedded agent)")
 @click.option("--watch/--no-watch", default=True, help="wait and stream status")
 @click.option("--data-dir", default=".plx", help="local mode state dir")
-def run(files, params, set_overrides, presets, project, name, host, local, watch, data_dir):
+@click.option("--backend", default="auto", type=click.Choice(["auto", "local", "cluster"]),
+              help="execution backend: auto routes distributed kinds through "
+                   "the operator path, plain jobs through the local executor")
+def run(files, params, set_overrides, presets, project, name, host, local, watch,
+        data_dir, backend):
     """Run a polyaxonfile (upstream `polyaxon run -f ...`)."""
     import yaml
 
@@ -96,6 +103,11 @@ def run(files, params, set_overrides, presets, project, name, host, local, watch
     host = get_host(host)
 
     if host and not local:
+        if backend != "auto":
+            click.echo(
+                f"warning: --backend={backend} only applies to local execution; "
+                f"the remote server at {host} decides its own backend", err=True,
+            )
         from ..client import RunClient
 
         rc = RunClient(host, project=project)
@@ -110,7 +122,7 @@ def run(files, params, set_overrides, presets, project, name, host, local, watch
         return
 
     # local embedded mode
-    store, agent = _local_stack(data_dir)
+    store, agent = _local_stack(data_dir, backend=backend)
     agent.start()
     run_row = store.create_run(project, spec=op.to_dict(), name=op.name or name)
     click.echo(f"Run {run_row['uuid']} created (local)")
@@ -370,7 +382,10 @@ def config_cmd(host, project, show):
 @click.option("--port", default=8000)
 @click.option("--data-dir", default=".plx")
 @click.option("--max-parallel", default=4)
-def server(host, port, data_dir, max_parallel):
+@click.option("--backend", default="auto", type=click.Choice(["auto", "local", "cluster"]),
+              help="execution backend: auto routes distributed kinds through "
+                   "the operator path, plain jobs through the local executor")
+def server(host, port, data_dir, max_parallel, backend):
     """Start the API server + scheduling agent (one process)."""
     from ..api.server import ApiServer
     from ..scheduler.agent import LocalAgent
@@ -384,7 +399,7 @@ def server(host, port, data_dir, max_parallel):
     srv.start()
     agent = LocalAgent(
         srv.store, artifacts_root=os.path.join(data_dir, "artifacts"),
-        api_host=srv.url, max_parallel=max_parallel,
+        api_host=srv.url, max_parallel=max_parallel, backend=backend,
     )
     agent.start()
     click.echo(f"polyaxon_tpu server on {srv.url} (agent: {max_parallel} parallel)")

@@ -25,6 +25,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import (
@@ -65,7 +66,7 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     attn_impl: str = "auto"                     # "auto" | "dense" | "flash"
     seq_parallel: str = "ring"                  # "ring" | "ulysses" (context axis >1)
-    remat: str = "none"                         # "none" | "full" | "dots"
+    remat: str = "none"             # "none" | "full" | "attn" | "attn_qkv" | "dots"
     attn_block_q: int = 512
     attn_block_k: int = 512
     loss_chunk_tokens: int = 4096               # blockwise-CE chunk; 0 = unchunked
@@ -261,7 +262,11 @@ def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret):
         cos, sin = rope_tables
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
+    q = checkpoint_name(q, "qkv")
+    k = checkpoint_name(k, "qkv")
+    v = checkpoint_name(v, "qkv")
     o = _sharded_attention(q, k, v, cfg, mesh, interpret)
+    o = checkpoint_name(o, "attn_out")
     o = jnp.einsum("bnsd,ndh->bsh", o, ap["wo"].astype(dt))
     if cfg.use_bias:
         o = o + ap["bo"].astype(dt)
@@ -290,10 +295,34 @@ def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interp
     body = lambda x, lp: (_layer_body(x, lp, cfg, rope_tables, mesh, interpret), None)
     if cfg.remat == "full":
         body = jax.checkpoint(body, prevent_cse=False)
+    elif cfg.remat == "attn":
+        # Save only the attention outputs: the one tensor whose recompute
+        # re-runs the flash kernel (its bwd already recomputes scores);
+        # projections/MLP recompute as single MXU matmuls. HBM cost over
+        # "full" is just [B,S,H] per layer; recompute cost drops by the whole
+        # attention pass. The winning policy for ~1B on one 16 GiB chip.
+        body = jax.checkpoint(
+            body, prevent_cse=False,
+            policy=jax.checkpoint_policies.save_only_these_names("attn_out"),
+        )
+    elif cfg.remat == "attn_qkv":
+        # Also keep post-RoPE q/k/v: +[B,S,(heads+2*kv)*hd] per layer buys
+        # the backward out of recomputing the qkv projections + rope (cheap
+        # with GQA: kv is heads/8 of q).
+        body = jax.checkpoint(
+            body, prevent_cse=False,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "qkv"),
+        )
     elif cfg.remat == "dots":
         body = jax.checkpoint(
             body, prevent_cse=False,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    elif cfg.remat != "none":
+        raise ValueError(
+            f"unknown remat policy {cfg.remat!r}; "
+            f"valid: none|full|attn|attn_qkv|dots"
         )
     x, _ = jax.lax.scan(body, x, layer_params)
     return x

@@ -10,7 +10,9 @@ Spec keys:
     steps, batch_size, seq_len, learning_rate, warmup_steps, schedule,
     optimizer, remat, parallelism {data,fsdp,model,context,expert,stage},
     data {kind, path, ...}, checkpoint {save_interval_steps, max_to_keep},
-    platform ("cpu" forces CPU — tests), num_cpu_devices
+    platform ("cpu" forces CPU — tests), num_cpu_devices,
+    mu_dtype / nu_dtype / grad_dtype (e.g. "bfloat16" — HBM savers),
+    loss_chunk_tokens (blockwise-CE chunk)
 """
 
 from __future__ import annotations
@@ -52,6 +54,8 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
         overrides = {}
         if spec.get("remat"):
             overrides["remat"] = spec["remat"]
+        if spec.get("loss_chunk_tokens") is not None:
+            overrides["loss_chunk_tokens"] = int(spec["loss_chunk_tokens"])
         seq_len = int(spec.get("seq_len", min(2048, mcfg.max_seq)))
         if seq_len > mcfg.max_seq:
             overrides["max_seq"] = seq_len
@@ -100,12 +104,15 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
             warmup_steps=int(spec.get("warmup_steps", min(100, steps // 10 + 1))),
             total_steps=steps,
             schedule=spec.get("schedule", "cosine"),
+            mu_dtype=spec.get("mu_dtype"),
+            nu_dtype=spec.get("nu_dtype"),
         ),
         batch_size=batch_size,
         seq_len=seq_len,
         parallelism=spec.get("parallelism"),
         checkpoint=ckpt,
         log_interval=int(spec.get("log_interval", 10)),
+        grad_dtype=spec.get("grad_dtype"),
     )
     track = None
     if run is not None:

@@ -22,12 +22,17 @@ from ..schemas.statuses import V1Statuses, is_done
 
 
 class LocalAgent:
-    """Poll/compile/schedule loop with two execution backends:
+    """Poll/compile/schedule loop with kind-aware execution backends:
 
     - ``local``  — LocalExecutor subprocesses (upstream's docker-less path)
     - ``cluster``— render K8s manifests and hand them to the L3 operator
       (OperationReconciler over a Cluster; FakeCluster by default), the
       upstream agent→operator→pods path (SURVEY.md §3a steps 4-6)
+    - ``auto``   — per-run: distributed kinds (tpujob/jaxjob/pytorchjob/...)
+      take the cluster path — manifests, reconciler, per-host pods with
+      rendezvous env — while plain job/service runs stay local. This makes
+      the SURVEY.md §3a chain the *product* path for distributed work
+      (VERDICT r2 #2), not a test fixture.
     """
 
     def __init__(
@@ -48,7 +53,7 @@ class LocalAgent:
         self.backend = backend
         self.executor = LocalExecutor(on_status=self._on_status)
         self.reconciler = None
-        if backend == "cluster":
+        if backend in ("cluster", "auto"):
             from ..operator import FakeCluster, OperationReconciler
 
             if cluster is None:
@@ -205,7 +210,7 @@ class LocalAgent:
                 api_host=self.api_host,
             )
             self.store.transition(uuid, V1Statuses.SCHEDULED.value)
-            if self.reconciler is not None:
+            if self._use_cluster(resolved):
                 self._submit_to_cluster(uuid, resolved)
             else:
                 execution = self.executor.submit(resolved.payload)
@@ -215,6 +220,18 @@ class LocalAgent:
             self.store.transition(
                 uuid, V1Statuses.FAILED.value, reason="SchedulingError", message=str(e)[:500],
             )
+
+    def _use_cluster(self, resolved) -> bool:
+        """Route this run to the operator path? ``cluster`` always,
+        ``local`` never, ``auto`` for distributed kinds (their manifests
+        carry per-host pods + rendezvous env that LocalExecutor can't)."""
+        if self.reconciler is None:
+            return False
+        if self.backend == "cluster":
+            return True
+        from ..schemas.run import V1RunKind
+
+        return resolved.compiled.get_run_kind() in V1RunKind.DISTRIBUTED
 
     def _submit_to_cluster(self, uuid: str, resolved) -> None:
         from ..operator import OperationCR

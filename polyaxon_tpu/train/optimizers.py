@@ -11,6 +11,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
 import optax
 
 
@@ -27,9 +29,12 @@ class OptimizerConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     momentum: float = 0.9             # sgd
-    # bf16 first moments halve adam/lion state HBM with negligible quality
-    # impact — what lets a ~1B model + full optimizer fit one v5e chip
+    # Reduced-precision adam moments cut optimizer-state HBM (the ceiling on
+    # what fits one 16 GiB v5e chip: a ~1B model is params f32 4G + mu + nu).
+    # bf16 keeps f32's exponent range, so nu (always >= 0, consumed under
+    # sqrt+eps) tolerates it; updates still accumulate in f32.
     mu_dtype: Optional[str] = None    # e.g. "bfloat16"; None = param dtype
+    nu_dtype: Optional[str] = None    # e.g. "bfloat16"; None = param dtype
 
 
 def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
@@ -50,9 +55,63 @@ def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
     return optax.join_schedules([warmup, decay], [cfg.warmup_steps])
 
 
+def scale_by_adam_lowmem(
+    b1: float, b2: float, eps: float = 1e-8,
+    mu_dtype: Optional[str] = None, nu_dtype: Optional[str] = None,
+) -> optax.GradientTransformation:
+    """``optax.scale_by_adam`` with independently reduced-precision moments.
+
+    optax only exposes ``mu_dtype``; storing ``nu`` in bf16 as well halves the
+    remaining f32 optimizer state. The moment *update* math runs in f32 (cast
+    up, accumulate, cast back down) so the only loss is storage rounding.
+    """
+    md = jnp.dtype(mu_dtype) if mu_dtype else None
+    nd = jnp.dtype(nu_dtype) if nu_dtype else None
+
+    def init(params):
+        mu = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=md or p.dtype), params)
+        nu = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=nd or p.dtype), params)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update(updates, state, params=None):
+        del params
+        count = optax.safe_increment(state.count)
+
+        def _mu(m, g):
+            return (b1 * m.astype(jnp.float32) + (1 - b1) * g.astype(jnp.float32)).astype(m.dtype)
+
+        def _nu(v, g):
+            g32 = g.astype(jnp.float32)
+            return (b2 * v.astype(jnp.float32) + (1 - b2) * g32 * g32).astype(v.dtype)
+
+        mu = jax.tree.map(_mu, state.mu, updates)
+        nu = jax.tree.map(_nu, state.nu, updates)
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** count.astype(jnp.float32)
+
+        def _upd(m, v, g):
+            del g  # updates always emerge f32: they go straight into the
+            # f32 master-param add and are tiny relative to HBM peaks
+            m_hat = m.astype(jnp.float32) / c1
+            v_hat = v.astype(jnp.float32) / c2
+            return m_hat / (jnp.sqrt(v_hat) + eps)
+
+        new_updates = jax.tree.map(_upd, mu, nu, updates)
+        return new_updates, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)
+
+
 def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     sched = make_schedule(cfg)
-    if cfg.name == "adamw":
+    if cfg.name == "adamw" and cfg.nu_dtype:
+        tx = optax.chain(
+            scale_by_adam_lowmem(cfg.b1, cfg.b2, mu_dtype=cfg.mu_dtype,
+                                 nu_dtype=cfg.nu_dtype),
+            optax.add_decayed_weights(cfg.weight_decay),
+            optax.scale_by_learning_rate(sched),
+        )
+    elif cfg.name == "adamw":
         tx = optax.adamw(sched, b1=cfg.b1, b2=cfg.b2,
                          weight_decay=cfg.weight_decay, mu_dtype=cfg.mu_dtype)
     elif cfg.name == "sgd":

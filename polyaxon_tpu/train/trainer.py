@@ -49,6 +49,16 @@ class TrainerConfig:
     checkpoint: Optional[CheckpointConfig] = None
     log_interval: int = 10
     accelerator: str = "v5e"
+    # Differentiate w.r.t. params cast to this dtype: grads materialize at
+    # this precision (bf16 halves the largest transient of the backward
+    # pass; the backward matmuls already run in bf16 either way since the
+    # forward casts per-use). f32 master params still own the update.
+    grad_dtype: Optional[str] = None  # e.g. "bfloat16"; None = param dtype
+    # Gradient accumulation: split the global batch into this many
+    # sequentially-executed microbatches (lax.scan) and average grads.
+    # Shrinks live activations by the same factor — the lever that lets a
+    # cheap remat policy (or none) replace full recompute on one chip.
+    microbatches: int = 1
 
 
 class Trainer:
@@ -163,10 +173,46 @@ class Trainer:
         if self._compiled_step is not None:
             return self._compiled_step
 
+        gd = jnp.dtype(self.cfg.grad_dtype) if self.cfg.grad_dtype else None
+        k = max(int(self.cfg.microbatches), 1)
+        if self.cfg.batch_size % k:
+            raise ValueError(
+                f"batch_size {self.cfg.batch_size} not divisible by "
+                f"microbatches {k}"
+            )
+
+        def _grads(diff_params, extra, batch):
+            return jax.value_and_grad(self._loss_fn, has_aux=True)(
+                diff_params, extra, batch)
+
         def step_fn(state: TrainState, batch) -> tuple[TrainState, dict]:
-            (loss, (metrics, new_extra)), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True
-            )(state.params, state.extra, batch)
+            diff_params = state.params
+            if gd is not None:
+                diff_params = jax.tree.map(
+                    lambda p: p.astype(gd)
+                    if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                    state.params,
+                )
+            if k == 1:
+                (loss, (metrics, new_extra)), grads = _grads(
+                    diff_params, state.extra, batch)
+            else:
+                micro = jax.tree.map(
+                    lambda x: x.reshape((k, x.shape[0] // k) + x.shape[1:]),
+                    batch,
+                )
+
+                def acc_body(carry, mb):
+                    g_acc, extra = carry
+                    (_, (m, new_extra)), g = _grads(diff_params, extra, mb)
+                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    return (g_acc, new_extra), m
+
+                zeros = jax.tree.map(jnp.zeros_like, diff_params)
+                (grads, new_extra), ms = jax.lax.scan(
+                    acc_body, (zeros, state.extra), micro)
+                grads = jax.tree.map(lambda g: g / k, grads)
+                metrics = jax.tree.map(lambda m: m.mean(), ms)
             updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             metrics = {**metrics, "grad_norm": optax.global_norm(grads)}

@@ -1,0 +1,91 @@
+"""Single-chip perf experiments for the MFU push (VERDICT r2 #1).
+
+Usage: python scripts/perf_exp.py MODEL BATCH SEQ REMAT [STEPS] [--profile DIR]
+
+Runs the real Trainer on whatever backend is live and prints one JSON line
+with tokens/s/chip + MFU, so configs can be swept from the shell.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    from polyaxon_tpu.models import llama
+    from polyaxon_tpu.train import (
+        DataConfig, OptimizerConfig, Trainer, TrainerConfig, make_batches,
+    )
+
+    model = sys.argv[1]
+    batch = int(sys.argv[2])
+    seq = int(sys.argv[3])
+    remat = sys.argv[4]
+    steps = int(sys.argv[5]) if len(sys.argv) > 5 and not sys.argv[5].startswith("--") else 12
+    profile_dir = None
+    if "--profile" in sys.argv:
+        profile_dir = sys.argv[sys.argv.index("--profile") + 1]
+    mu_dtype = "bfloat16" if "--mu-bf16" in sys.argv else None
+    nu_dtype = "bfloat16" if "--nu-bf16" in sys.argv else None
+    grad_dtype = "bfloat16" if "--grad-bf16" in sys.argv else None
+    chunk = None
+    if "--chunk" in sys.argv:
+        chunk = int(sys.argv[sys.argv.index("--chunk") + 1])
+    micro = 1
+    if "--micro" in sys.argv:
+        micro = int(sys.argv[sys.argv.index("--micro") + 1])
+
+    mcfg = replace(llama.CONFIGS[model], remat=remat, max_seq=seq)
+    if chunk is not None:
+        mcfg = replace(mcfg, loss_chunk_tokens=chunk)
+    if "--block" in sys.argv:
+        blk = int(sys.argv[sys.argv.index("--block") + 1])
+        mcfg = replace(mcfg, attn_block_q=blk, attn_block_k=blk)
+    if "--bq" in sys.argv:
+        mcfg = replace(mcfg, attn_block_q=int(sys.argv[sys.argv.index("--bq") + 1]))
+    if "--bk" in sys.argv:
+        mcfg = replace(mcfg, attn_block_k=int(sys.argv[sys.argv.index("--bk") + 1]))
+    n = len(jax.devices())
+    cfg = TrainerConfig(
+        model=mcfg,
+        optimizer=OptimizerConfig(learning_rate=3e-4, warmup_steps=5,
+                                  total_steps=steps, mu_dtype=mu_dtype,
+                                  nu_dtype=nu_dtype),
+        batch_size=batch,
+        seq_len=seq,
+        parallelism={"data": n},
+        accelerator="v5e",
+        grad_dtype=grad_dtype,
+        microbatches=micro,
+    )
+    trainer = Trainer(cfg)
+    data = make_batches(
+        DataConfig(kind="synthetic-lm", batch_size=batch, seq_len=seq,
+                   vocab_size=mcfg.vocab_size), trainer.mesh,
+    )
+    if profile_dir:
+        state, _ = trainer.fit(data, num_steps=3)
+        with jax.profiler.trace(profile_dir):
+            state, metrics = trainer.fit(data, num_steps=6, state=state)
+    else:
+        state, metrics = trainer.fit(data, num_steps=steps)
+
+    print(json.dumps({
+        "model": model, "batch": batch, "seq": seq, "remat": remat,
+        "mu_bf16": bool(mu_dtype), "nu_bf16": bool(nu_dtype),
+        "grad_bf16": bool(grad_dtype), "chunk": chunk, "micro": micro,
+        "tokens_per_sec_per_chip": round(metrics["tokens_per_sec_per_chip"], 1),
+        "step_time_ms": round(metrics["step_time_ms"], 1),
+        "mfu": round(metrics["mfu"], 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""CLI-level e2e for the product cluster path (VERDICT r2 #2): `polyaxon run
+-f examples/resnet50_ddp.yaml` must flow through manifests + reconciler +
+pods — no test internals — because the default backend is ``auto`` and
+pytorchjob is a distributed kind."""
+
+import json
+import os
+
+from click.testing import CliRunner
+
+from polyaxon_tpu.cli.main import cli
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "examples")
+
+
+class TestCliAutoBackend:
+    def test_run_ddp_example_routes_through_operator(self, tmp_path):
+        data_dir = str(tmp_path / "plx")
+        runner = CliRunner()
+        result = runner.invoke(
+            cli,
+            [
+                "run", "-f", os.path.join(EXAMPLES, "resnet50_ddp.yaml"),
+                "--data-dir", data_dir,
+                "--set", "component.run.worker.replicas=1",
+                "--set", "component.run.runtime.model=resnet18-cifar",
+                "--set", "component.run.runtime.steps=2",
+                "--set", "component.run.runtime.batch_size=4",
+                "--set", "component.run.runtime.checkpoint=false",
+                "--set", "component.run.runtime.platform=cpu",
+            ],
+            catch_exceptions=False,
+        )
+        assert result.exit_code == 0, result.output
+        assert "succeeded" in result.output
+        # the operator path ran: the FakeCluster workdir holds the pods'
+        # stdout logs (one per replica), written by the reconciler backend
+        cluster_dir = os.path.join(data_dir, "artifacts", ".cluster")
+        assert os.path.isdir(cluster_dir), result.output
+        logs = [f for f in os.listdir(cluster_dir) if f.endswith(".log")]
+        assert len(logs) >= 2, sorted(os.listdir(cluster_dir))
+
+    def test_plain_job_stays_local(self, tmp_path):
+        data_dir = str(tmp_path / "plx")
+        spec = tmp_path / "job.yaml"
+        spec.write_text(
+            "version: 1.1\n"
+            "kind: component\n"
+            "name: hello\n"
+            "run:\n"
+            "  kind: job\n"
+            "  container:\n"
+            "    command: [python, -c, \"print('hi')\"]\n"
+        )
+        runner = CliRunner()
+        result = runner.invoke(
+            cli, ["run", "-f", str(spec), "--data-dir", data_dir],
+            catch_exceptions=False,
+        )
+        assert result.exit_code == 0, result.output
+        assert "succeeded" in result.output
+        cluster_dir = os.path.join(data_dir, "artifacts", ".cluster")
+        # auto backend builds the FakeCluster dir but plain jobs never
+        # create pods in it
+        pods = [f for f in os.listdir(cluster_dir)] if os.path.isdir(cluster_dir) else []
+        assert not [f for f in pods if f.endswith(".log")], pods

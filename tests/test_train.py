@@ -1,11 +1,14 @@
 """Trainer tests: sharded end-to-end training step, loss goes down,
 checkpoint save/resume round-trip (SURVEY.md §5 checkpoint/resume)."""
 
+from dataclasses import replace
+
 import numpy as np
 import pytest
 
 import jax
 import jax.numpy as jnp
+import optax
 
 from polyaxon_tpu.models import llama
 from polyaxon_tpu.train import (
@@ -127,3 +130,78 @@ class TestMeter:
         assert m.tokens_per_sec_per_chip == 5000
         # 5000 * 1e9 / 1e12 = 5 TFLOP/s vs 197 peak
         assert abs(m.mfu - 5.0 / 197.0) < 1e-6
+
+
+class TestLowmemAdam:
+    """scale_by_adam_lowmem in f32 must match optax.adamw step-for-step;
+    bf16 moments must stay close (storage rounding only)."""
+
+    def _updates(self, tx, params, grads, steps=3):
+        state = tx.init(params)
+        for _ in range(steps):
+            upd, state = tx.update(grads, state, params)
+            params = optax.apply_updates(params, upd)
+        return params
+
+    def test_f32_matches_optax_adamw(self):
+        from polyaxon_tpu.train.optimizers import OptimizerConfig, make_optimizer
+
+        params = {"w": jnp.linspace(-1, 1, 32).reshape(4, 8)}
+        grads = {"w": jnp.linspace(0.5, -0.5, 32).reshape(4, 8)}
+        base = OptimizerConfig(learning_rate=1e-2, warmup_steps=0,
+                               schedule="constant", total_steps=10, grad_clip=0)
+        ref = self._updates(make_optimizer(base), params, grads)
+        low = self._updates(
+            make_optimizer(replace(base, nu_dtype="float32")), params, grads)
+        assert jnp.allclose(ref["w"], low["w"], atol=1e-6), (ref["w"] - low["w"])
+
+    def test_bf16_moments_close(self):
+        from polyaxon_tpu.train.optimizers import OptimizerConfig, make_optimizer
+
+        params = {"w": jnp.linspace(-1, 1, 32).reshape(4, 8)}
+        grads = {"w": jnp.linspace(0.5, -0.5, 32).reshape(4, 8)}
+        base = OptimizerConfig(learning_rate=1e-2, warmup_steps=0,
+                               schedule="constant", total_steps=10, grad_clip=0)
+        ref = self._updates(make_optimizer(base), params, grads)
+        low = self._updates(
+            make_optimizer(replace(base, mu_dtype="bfloat16", nu_dtype="bfloat16")),
+            params, grads)
+        # moments rounded to bf16: updates agree to ~1e-2 relative
+        assert jnp.allclose(ref["w"], low["w"], atol=5e-4), (ref["w"] - low["w"]).max()
+
+
+class TestGradAccumulation:
+    """microbatches=k must match the single-shot step on the same global
+    batch (grads averaged over microbatches == grads over full batch)."""
+
+    def test_microbatch_parity(self):
+        from polyaxon_tpu.train import (
+            DataConfig, OptimizerConfig, Trainer, TrainerConfig, make_batches,
+        )
+
+        mcfg = llama.LLAMA_TINY
+        base = dict(
+            model=mcfg,
+            optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=0,
+                                      schedule="constant", total_steps=4),
+            batch_size=32, seq_len=32, parallelism={"data": 8},
+        )
+        losses = {}
+        for k in (1, 4):
+            tr = Trainer(TrainerConfig(**base, microbatches=k))
+            data = make_batches(DataConfig(kind="synthetic-lm", batch_size=32,
+                                           seq_len=32, vocab_size=mcfg.vocab_size,
+                                           seed=7), tr.mesh)
+            state, metrics = tr.fit(data, num_steps=4)
+            losses[k] = metrics["loss"]
+        assert abs(losses[1] - losses[4]) < 1e-3, losses
+
+    def test_indivisible_microbatches_rejected(self):
+        from polyaxon_tpu.train import OptimizerConfig, Trainer, TrainerConfig
+
+        tr = Trainer(TrainerConfig(
+            model=llama.LLAMA_TINY, optimizer=OptimizerConfig(total_steps=1),
+            batch_size=8, seq_len=32, parallelism={"data": 1}, microbatches=3,
+        ))
+        with pytest.raises(ValueError, match="divisible"):
+            tr.make_step()
