@@ -49,14 +49,33 @@ def _not_found(msg="not found"):
 
 
 class ApiApp:
-    def __init__(self, store: Store, artifacts_root: str):
+    def __init__(self, store: Store, artifacts_root: str,
+                 auth_token: Optional[str] = None):
         self.store = store
         self.artifacts_root = os.path.abspath(artifacts_root)
         os.makedirs(self.artifacts_root, exist_ok=True)
-        self.app = web.Application()
+        # Token auth (SURVEY.md §2 API "RBAC(-lite)"): when a token is
+        # configured every endpoint except /healthz requires
+        # `Authorization: Bearer <token>`. No token = open (local dev).
+        self.auth_token = auth_token if auth_token is not None \
+            else os.environ.get("PLX_AUTH_TOKEN")
+        middlewares = [self._auth_middleware] if self.auth_token else []
+        self.app = web.Application(middlewares=middlewares)
         self._routes()
         # the scheduler (if attached in-process) watches this queue
         self.new_run_event = asyncio.Event()
+
+    @web.middleware
+    async def _auth_middleware(self, request, handler):
+        # the static dashboard shell carries no data; it collects the token
+        # client-side and sends it on its API calls
+        if request.path in ("/healthz", "/", "/ui"):
+            return await handler(request)
+        header = request.headers.get("Authorization", "")
+        token = header[7:] if header.startswith("Bearer ") else None
+        if token != self.auth_token:
+            return _json({"error": "unauthorized"}, status=401)
+        return await handler(request)
 
     def run_dir(self, project: str, uuid: str) -> str:
         return run_artifacts_dir(self.artifacts_root, project, uuid)
@@ -64,6 +83,8 @@ class ApiApp:
     def _routes(self) -> None:
         r = self.app.router
         r.add_get("/healthz", self.healthz)
+        r.add_get("/", self.ui)
+        r.add_get("/ui", self.ui)
         r.add_get("/api/v1/projects", self.list_projects)
         r.add_post("/api/v1/projects", self.create_project)
         r.add_get("/api/v1/projects/{project}", self.get_project)
@@ -88,6 +109,11 @@ class ApiApp:
 
     async def healthz(self, request):
         return _json({"status": "ok"})
+
+    async def ui(self, request):
+        from .ui import UI_HTML
+
+        return web.Response(text=UI_HTML, content_type="text/html")
 
     async def list_projects(self, request):
         return _json(self.store.list_projects())

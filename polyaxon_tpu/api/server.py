@@ -26,9 +26,10 @@ class ApiServer:
         artifacts_root: str = ".plx/artifacts",
         host: str = "127.0.0.1",
         port: int = 8000,
+        auth_token: "Optional[str]" = None,
     ):
         self.store = Store(db_path)
-        self.api = ApiApp(self.store, artifacts_root)
+        self.api = ApiApp(self.store, artifacts_root, auth_token=auth_token)
         self.host = host
         self.port = port
         self._loop: Optional[asyncio.AbstractEventLoop] = None

@@ -1,0 +1,141 @@
+"""Minimal dashboard (upstream `ui/` — SURVEY.md §2 "UI" row, here a
+single static page over the existing REST endpoints: runs table, status,
+metrics sparkline, log tail). Served at ``GET /`` by the API app; no build
+step, no dependencies — vanilla JS + fetch."""
+
+UI_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8"/>
+<title>polyaxon_tpu</title>
+<style>
+  body { font-family: -apple-system, system-ui, sans-serif; margin: 0;
+         background: #f6f7f9; color: #1a1f36; }
+  header { background: #1a1f36; color: #fff; padding: 10px 20px;
+           display: flex; gap: 16px; align-items: baseline; }
+  header h1 { font-size: 16px; margin: 0; }
+  header input { margin-left: auto; font-size: 12px; padding: 2px 6px; }
+  main { display: flex; gap: 16px; padding: 16px; }
+  section { background: #fff; border: 1px solid #e3e8ee; border-radius: 6px;
+            padding: 12px; }
+  #runs { width: 46%; } #detail { flex: 1; min-width: 0; }
+  table { border-collapse: collapse; width: 100%; font-size: 13px; }
+  th, td { text-align: left; padding: 4px 8px; border-bottom: 1px solid #eef1f4; }
+  tr:hover td { background: #f0f4ff; cursor: pointer; }
+  .st { padding: 1px 7px; border-radius: 9px; font-size: 11px; color: #fff; }
+  .st.succeeded { background: #18794e; } .st.failed { background: #cd2b31; }
+  .st.running { background: #0b68cb; } .st.stopped { background: #6c757d; }
+  .st.created, .st.compiled, .st.queued, .st.scheduled, .st.starting,
+  .st.stopping { background: #b98900; }
+  pre { background: #0f1320; color: #d6deeb; padding: 10px; border-radius: 6px;
+        max-height: 320px; overflow: auto; font-size: 12px; }
+  svg { background: #fbfcfe; border: 1px solid #eef1f4; border-radius: 4px; }
+  h2 { font-size: 14px; margin: 4px 0 10px; } h3 { font-size: 12px; margin: 12px 0 6px; }
+  select { font-size: 13px; }
+  .muted { color: #697386; font-size: 12px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>polyaxon_tpu</h1>
+  <select id="project"></select>
+  <span class="muted" id="count"></span>
+  <input id="token" placeholder="auth token (if required)" type="password"/>
+</header>
+<main>
+  <section id="runs"><h2>Runs</h2><table id="runsTable">
+    <thead><tr><th>name</th><th>kind</th><th>status</th><th>uuid</th></tr></thead>
+    <tbody></tbody></table></section>
+  <section id="detail"><h2 id="dTitle">Select a run</h2>
+    <div id="dBody"></div></section>
+</main>
+<script>
+const $ = (s) => document.querySelector(s);
+const tokenBox = $("#token");
+tokenBox.value = localStorage.getItem("plx_token") || "";
+tokenBox.addEventListener("change", () => {
+  localStorage.setItem("plx_token", tokenBox.value); refresh();
+});
+function hdrs() {
+  const t = tokenBox.value;
+  return t ? {"Authorization": "Bearer " + t} : {};
+}
+async function j(path) {
+  const r = await fetch(path, {headers: hdrs()});
+  if (!r.ok) throw new Error(r.status + " " + path);
+  return r.json();
+}
+async function text(path) {
+  const r = await fetch(path, {headers: hdrs()});
+  return r.ok ? r.text() : "";
+}
+let project = null, selected = null;
+async function loadProjects() {
+  const ps = await j("/api/v1/projects");
+  const sel = $("#project");
+  sel.innerHTML = "";
+  for (const p of ps) {
+    const o = document.createElement("option");
+    o.value = o.textContent = p.name; sel.appendChild(o);
+  }
+  if (!project && ps.length) project = ps[0].name;
+  sel.value = project || "";
+  sel.onchange = () => { project = sel.value; refresh(); };
+}
+function stBadge(s) { return `<span class="st ${s}">${s}</span>`; }
+async function loadRuns() {
+  if (!project) return;
+  const runs = await j(`/api/v1/${project}/runs?limit=100`);
+  $("#count").textContent = runs.length + " runs";
+  const tb = $("#runsTable tbody");
+  tb.innerHTML = "";
+  for (const r of runs) {
+    const tr = document.createElement("tr");
+    tr.innerHTML = `<td>${r.name || ""}</td><td>${r.kind || ""}</td>` +
+      `<td>${stBadge(r.status)}</td><td class="muted">${r.uuid.slice(0,8)}</td>`;
+    tr.onclick = () => { selected = r.uuid; loadDetail(); };
+    tb.appendChild(tr);
+  }
+}
+function sparkline(events) {
+  const vals = events.map(e => e.metric).filter(v => typeof v === "number");
+  if (!vals.length) return "";
+  const w = 420, h = 80, min = Math.min(...vals), max = Math.max(...vals);
+  const pts = vals.map((v, i) => {
+    const x = (i / Math.max(vals.length - 1, 1)) * (w - 10) + 5;
+    const y = h - 5 - ((v - min) / (max - min || 1)) * (h - 10);
+    return `${x.toFixed(1)},${y.toFixed(1)}`;
+  }).join(" ");
+  return `<svg width="${w}" height="${h}"><polyline fill="none" ` +
+    `stroke="#0b68cb" stroke-width="1.5" points="${pts}"/></svg>` +
+    `<div class="muted">min ${min.toPrecision(4)} · last ` +
+    `${vals[vals.length-1].toPrecision(4)}</div>`;
+}
+async function loadDetail() {
+  if (!selected) return;
+  const r = await j(`/api/v1/${project}/runs/${selected}`);
+  $("#dTitle").innerHTML = `${r.name || r.uuid} ${stBadge(r.status)}`;
+  let html = "";
+  if (r.outputs) html += `<h3>Outputs</h3><pre>` +
+    JSON.stringify(r.outputs, null, 2) + `</pre>`;
+  try {
+    const m = await j(`/api/v1/${project}/runs/${selected}/metrics`);
+    for (const [name, events] of Object.entries(m)) {
+      const sl = sparkline(events);
+      if (sl) html += `<h3>${name}</h3>` + sl;
+    }
+  } catch (e) {}
+  const logs = await text(`/api/v1/${project}/runs/${selected}/logs`);
+  if (logs) html += `<h3>Logs</h3><pre>${logs.replace(/</g, "&lt;")}</pre>`;
+  $("#dBody").innerHTML = html || '<span class="muted">no data yet</span>';
+}
+async function refresh() {
+  try { await loadProjects(); await loadRuns(); if (selected) await loadDetail(); }
+  catch (e) { $("#count").textContent = String(e); }
+}
+refresh();
+setInterval(refresh, 3000);
+</script>
+</body>
+</html>
+"""

@@ -41,6 +41,10 @@ def get_host(explicit: Optional[str]) -> Optional[str]:
     return explicit or os.environ.get("PLX_API_HOST") or load_config().get("host")
 
 
+def get_token() -> Optional[str]:
+    return os.environ.get("PLX_AUTH_TOKEN") or load_config().get("token")
+
+
 def _local_stack(data_dir: str = ".plx", backend: str = "auto"):
     """Embedded store + agent for hostless local runs. ``auto`` routes
     distributed kinds through the operator/reconciler (per-host pods with
@@ -110,7 +114,7 @@ def run(files, params, set_overrides, presets, project, name, host, local, watch
             )
         from ..client import RunClient
 
-        rc = RunClient(host, project=project)
+        rc = RunClient(host, project=project, auth_token=get_token())
         run_data = rc.create(operation=op)
         click.echo(f"Run {run_data['uuid']} created ({run_data['status']})")
         if watch:
@@ -185,7 +189,7 @@ def _ops_client(host, project):
     if host:
         from ..client import RunClient
 
-        return RunClient(host, project=project), None
+        return RunClient(host, project=project, auth_token=get_token()), None
     from ..api.app import run_artifacts_dir
     from ..api.store import Store
 
@@ -333,7 +337,7 @@ def project_create(name, description, host):
     if h:
         from ..client import ProjectClient
 
-        ProjectClient(h).create(name, description)
+        ProjectClient(h, auth_token=get_token()).create(name, description)
     else:
         from ..api.store import Store
 
@@ -348,7 +352,7 @@ def project_ls(host):
     if h:
         from ..client import ProjectClient
 
-        rows = ProjectClient(h).list()
+        rows = ProjectClient(h, auth_token=get_token()).list()
     else:
         from ..api.store import Store
 
@@ -363,16 +367,19 @@ def project_ls(host):
 @cli.command("config")
 @click.option("--host", default=None)
 @click.option("--project", default=None)
+@click.option("--token", default=None, help="API auth token (or PLX_AUTH_TOKEN env)")
 @click.option("--show", is_flag=True)
-def config_cmd(host, project, show):
+def config_cmd(host, project, token, show):
     cfg = load_config()
-    if show or (host is None and project is None):
+    if show or (host is None and project is None and token is None):
         click.echo(json.dumps(cfg, indent=2))
         return
     if host is not None:
         cfg["host"] = host
     if project is not None:
         cfg["project"] = project
+    if token is not None:
+        cfg["token"] = token
     save_config(cfg)
     click.echo("config saved")
 
@@ -382,10 +389,21 @@ def config_cmd(host, project, show):
 @click.option("--port", default=8000)
 @click.option("--data-dir", default=".plx")
 @click.option("--max-parallel", default=4)
+@click.option("--capacity-chips", default=None, type=int,
+              help="schedule by TPU chip budget instead of run count "
+                   "(tpujobs cost their slice/sub-slice chips)")
 @click.option("--backend", default="auto", type=click.Choice(["auto", "local", "cluster"]),
               help="execution backend: auto routes distributed kinds through "
                    "the operator path, plain jobs through the local executor")
-def server(host, port, data_dir, max_parallel, backend):
+@click.option("--auth-token", default=None, envvar="PLX_AUTH_TOKEN",
+              help="require `Authorization: Bearer <token>` on every API "
+                   "call (default: PLX_AUTH_TOKEN env; unset = open)")
+@click.option("--artifacts-store", default=None,
+              help="remote artifacts store (fsspec URL or path): run "
+                   "artifacts sync there (sidecar loop for local jobs, "
+                   "final sync for cluster runs)")
+def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_token,
+           artifacts_store):
     """Start the API server + scheduling agent (one process)."""
     from ..api.server import ApiServer
     from ..scheduler.agent import LocalAgent
@@ -394,12 +412,14 @@ def server(host, port, data_dir, max_parallel, backend):
     srv = ApiServer(
         db_path=os.path.join(data_dir, "db.sqlite"),
         artifacts_root=os.path.join(data_dir, "artifacts"),
-        host=host, port=port,
+        host=host, port=port, auth_token=auth_token,
     )
     srv.start()
     agent = LocalAgent(
         srv.store, artifacts_root=os.path.join(data_dir, "artifacts"),
         api_host=srv.url, max_parallel=max_parallel, backend=backend,
+        capacity_chips=capacity_chips, artifacts_store=artifacts_store,
+        api_token=auth_token,
     )
     agent.start()
     click.echo(f"polyaxon_tpu server on {srv.url} (agent: {max_parallel} parallel)")

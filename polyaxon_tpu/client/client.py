@@ -4,6 +4,7 @@ of OpenAPI-generated)."""
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Optional
 
@@ -20,10 +21,15 @@ class ApiError(RuntimeError):
 
 
 class BaseClient:
-    def __init__(self, host: str = "http://127.0.0.1:8000", timeout: float = 30.0):
+    def __init__(self, host: str = "http://127.0.0.1:8000", timeout: float = 30.0,
+                 auth_token: Optional[str] = None):
         self.host = host.rstrip("/")
         self.timeout = timeout
         self._session = requests.Session()
+        token = auth_token if auth_token is not None \
+            else os.environ.get("PLX_AUTH_TOKEN")
+        if token:
+            self._session.headers["Authorization"] = f"Bearer {token}"
 
     def _req(self, method: str, path: str, **kwargs: Any):
         url = f"{self.host}{path}"
@@ -57,8 +63,9 @@ class RunClient(BaseClient):
         project: str = "default",
         run_uuid: Optional[str] = None,
         timeout: float = 30.0,
+        auth_token: Optional[str] = None,
     ):
-        super().__init__(host, timeout)
+        super().__init__(host, timeout, auth_token=auth_token)
         self.project = project
         self.run_uuid = run_uuid
 

@@ -64,6 +64,7 @@ def build_context(
     artifacts_path: str,
     api_host: Optional[str] = None,
     extra: Optional[dict[str, Any]] = None,
+    api_token: Optional[str] = None,
 ) -> dict[str, Any]:
     params = resolve_params(compiled)
     ctx: dict[str, Any] = {
@@ -74,6 +75,7 @@ def build_context(
             "run_artifacts_path": artifacts_path,
             "run_outputs_path": f"{artifacts_path}/outputs",
             "api_host": api_host or "",
+            "api_token": api_token or "",
         },
         "params": params,
         # flat access too: {{ lr }} — upstream allows both
@@ -95,6 +97,11 @@ def context_env(ctx: dict[str, Any]) -> dict[str, str]:
     }
     if g.get("api_host"):
         env["PLX_API_HOST"] = g["api_host"]
+    if g.get("api_token"):
+        # children report statuses/metrics through the API; when the server
+        # requires a token, runs must carry it (tracking's RunClient reads
+        # PLX_AUTH_TOKEN)
+        env["PLX_AUTH_TOKEN"] = g["api_token"]
     if ctx.get("params"):
         env["PLX_PARAMS"] = json.dumps(ctx["params"])
     return env

@@ -27,6 +27,7 @@ from ..schemas.tpu import SliceTopology
 from .contexts import context_env, render_value
 
 DEFAULT_COORDINATOR_PORT = 8476
+MEGASCALE_PORT = 8080  # DCN transport rendezvous for multislice (num_slices>1)
 
 
 @dataclass
@@ -201,12 +202,16 @@ def to_k8s_resources(
 
     if isinstance(run, V1TPUJob):
         topo: SliceTopology = run.get_slice()
-        hosts = topo.num_hosts
+        hosts = topo.num_hosts  # total over all slices
+        hosts_per_slice = topo.hosts_per_slice
         svc = f"plx-{run_uuid[:12]}-hosts"
         builtin = _render_builtin(run, ctx)
         pods = []
         for host_idx in range(hosts):
             env = dict(base_env)
+            # jax.distributed spans every host of every slice (one SPMD
+            # program); intra-slice collectives ride ICI, cross-slice ones
+            # ride DCN via the megascale transport env below
             env.update(rendezvous_env(
                 coordinator_host=f"plx-{run_uuid[:12]}-0.{svc}",
                 port=DEFAULT_COORDINATOR_PORT,
@@ -215,8 +220,36 @@ def to_k8s_resources(
             ))
             env["PLX_SLICE_TOPOLOGY"] = topo.topology
             env["PLX_SLICE_ACCELERATOR"] = topo.accelerator
+            if topo.num_slices > 1:
+                slice_id = host_idx // hosts_per_slice
+                env["PLX_SLICE_ID"] = str(slice_id)
+                env["MEGASCALE_NUM_SLICES"] = str(topo.num_slices)
+                env["MEGASCALE_SLICE_ID"] = str(slice_id)
+                env["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                    f"plx-{run_uuid[:12]}-0.{svc}:{MEGASCALE_PORT}"
+                )
+                env["MEGASCALE_PORT"] = str(MEGASCALE_PORT)
             if run.parallelism:
                 env["PLX_PARALLELISM"] = json.dumps(run.parallelism.to_dict())
+            selectors = topo.node_selectors()
+            if topo.num_slices > 1:
+                # one GKE node pool per slice: pin each host pod to its
+                # slice's pool
+                selectors = {
+                    **selectors,
+                    "app.polyaxon.com/slice-id": str(host_idx // hosts_per_slice),
+                }
+            if run.subslice_origin is not None:
+                # sub-slice placement (tuner packing): pin this job to its
+                # rectangle of the parent slice. GKE can't address chips
+                # inside a slice by label, so the contract is a dedicated
+                # node-pool label per origin + env for the runtime.
+                origin = "-".join(str(c) for c in run.subslice_origin)
+                env["PLX_SUBSLICE_ORIGIN"] = origin
+                selectors = {
+                    **selectors,
+                    "app.polyaxon.com/subslice-origin": origin,
+                }
             cm = _container_manifest(run.container, ctx, env)
             _apply_builtin_to_pod(cm, builtin, ctx)
             cm["resources"] = {"limits": {k: str(v) for k, v in topo.tpu_resources().items()}}
@@ -224,7 +257,7 @@ def to_k8s_resources(
                 f"plx-{run_uuid[:12]}-{host_idx}",
                 cm,
                 extra={
-                    "nodeSelector": topo.node_selectors(),
+                    "nodeSelector": selectors,
                     "subdomain": svc,
                     "hostname": f"plx-{run_uuid[:12]}-{host_idx}",
                 },

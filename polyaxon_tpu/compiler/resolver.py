@@ -36,6 +36,7 @@ def resolve(
     project: str,
     artifacts_path: str,
     api_host: Optional[str] = None,
+    api_token: Optional[str] = None,
 ) -> ResolvedRun:
     if isinstance(op_or_compiled, dict):
         kind = op_or_compiled.get("kind")
@@ -47,7 +48,8 @@ def resolve(
         compiled = compile_operation(op_or_compiled)
     else:
         compiled = op_or_compiled
-    ctx = build_context(compiled, run_uuid, project, artifacts_path, api_host)
+    ctx = build_context(compiled, run_uuid, project, artifacts_path, api_host,
+                        api_token=api_token)
     payload = to_local_payload(compiled, ctx, run_uuid, project)
     return ResolvedRun(
         run_uuid=run_uuid, project=project, compiled=compiled,

@@ -44,6 +44,19 @@ LLAMA_1B = replace(
     mlp_dim=5632, max_seq=2048,
 )
 
+# Mixtral-style sparse MoE (public 8x7B architecture constants): 8 experts,
+# top-2 routing, otherwise the 7B trunk with GQA 32/8 and 32k context.
+# Experts shard over the `expert` mesh axis (EP).
+MIXTRAL_8X7B = replace(
+    LLAMA2_7B, vocab_size=32000, hidden=4096, num_layers=32, num_heads=32,
+    num_kv_heads=8, mlp_dim=14336, max_seq=32768, rope_theta=1e6,
+    num_experts=8, expert_top_k=2,
+)
+
+LLAMA_MOE_TINY = replace(
+    LLAMA_TINY, num_experts=4, expert_top_k=2, mlp_dim=64,
+)
+
 CONFIGS = {
     "llama2-7b": LLAMA2_7B,
     "llama2-13b": LLAMA2_13B,
@@ -51,4 +64,6 @@ CONFIGS = {
     "llama-tiny": LLAMA_TINY,
     "llama-125m": LLAMA_125M,
     "llama-1b": LLAMA_1B,
+    "mixtral-8x7b": MIXTRAL_8X7B,
+    "llama-moe-tiny": LLAMA_MOE_TINY,
 }

@@ -70,6 +70,14 @@ class TransformerConfig:
     attn_block_q: int = 512
     attn_block_k: int = 512
     loss_chunk_tokens: int = 4096               # blockwise-CE chunk; 0 = unchunked
+    pp_microbatches: int = 0                    # GPipe microbatches; 0 = 2*stages
+    # Mixture-of-experts: >0 replaces each layer's MLP with num_experts
+    # expert MLPs + a top-k router. Experts shard over the `expert` mesh
+    # axis (EP). Round-3 dispatch is dense (every expert computes every
+    # token, gates mask the combine) — exact, simple, and XLA shards the
+    # expert dim; ragged all-to-all token dispatch is the round-4 upgrade.
+    num_experts: int = 0
+    expert_top_k: int = 2
 
     @property
     def kv_heads(self) -> int:
@@ -80,17 +88,30 @@ class TransformerConfig:
         return self.head_dim or self.hidden // self.num_heads
 
     def flops_per_token(self, seq_len: int) -> float:
-        """Approximate training FLOPs/token (fwd+bwd = 6N + attention term);
-        feeds the MFU meter (BASELINE.md metric)."""
-        n_params = self.num_params()
+        """Approximate training FLOPs/token (fwd+bwd = 6N_active + attention
+        term); feeds the MFU meter (BASELINE.md metric). For MoE, N_active
+        counts top_k experts, not all of them."""
+        n_params = self.active_params()
         attn = 12 * self.num_layers * self.hidden * seq_len  # qk+av fwd+bwd
         return 6 * n_params + attn
+
+    def active_params(self) -> int:
+        """Params touched per token: == num_params() for dense; for MoE the
+        per-layer expert block counts only top_k of num_experts experts."""
+        total = self.num_params()
+        if not self.num_experts:
+            return total
+        k = min(self.expert_top_k, self.num_experts)
+        per_expert = (3 if self.act == "swiglu" else 2) * self.hidden * self.mlp_dim
+        return total - self.num_layers * (self.num_experts - k) * per_expert
 
     def num_params(self) -> int:
         h, l = self.hidden, self.num_layers
         attn = h * self.num_heads * self.hd + 2 * h * self.kv_heads * self.hd \
             + self.num_heads * self.hd * h
         mlp = (3 if self.act == "swiglu" else 2) * h * self.mlp_dim
+        if self.num_experts:
+            mlp = self.num_experts * mlp + h * self.num_experts  # + router
         norms = (2 * l + 1) * h
         if self.norm == "ln" or self.use_bias:
             norms *= 2  # scale + bias
@@ -131,13 +152,23 @@ def abstract_params(cfg: TransformerConfig) -> dict:
             "wv": ((L, h, kvh, hd), ("layers", "embed", "kv_heads", "head_dim")),
             "wo": ((L, nh, hd, h), ("layers", "heads", "head_dim", "embed")),
         },
-        "mlp": {
+    }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        layer["mlp"] = {
+            "router": ((L, h, E), ("layers", "embed", None)),
+            "wi": ((L, E, h, mlp), ("layers", "expert", "embed", "mlp")),
+            "wo": ((L, E, mlp, h), ("layers", "expert", "mlp", "embed")),
+        }
+        if cfg.act == "swiglu":
+            layer["mlp"]["wg"] = ((L, E, h, mlp), ("layers", "expert", "embed", "mlp"))
+    else:
+        layer["mlp"] = {
             "wi": ((L, h, mlp), ("layers", "embed", "mlp")),
             "wo": ((L, mlp, h), ("layers", "mlp", "embed")),
-        },
-    }
-    if cfg.act == "swiglu":
-        layer["mlp"]["wg"] = ((L, h, mlp), ("layers", "embed", "mlp"))
+        }
+        if cfg.act == "swiglu":
+            layer["mlp"]["wg"] = ((L, h, mlp), ("layers", "embed", "mlp"))
     if cfg.use_bias:
         layer["attn"]["bq"] = ((L, nh, hd), ("layers", "heads", "head_dim"))
         layer["attn"]["bk"] = ((L, kvh, hd), ("layers", "kv_heads", "head_dim"))
@@ -145,6 +176,8 @@ def abstract_params(cfg: TransformerConfig) -> dict:
         layer["attn"]["bo"] = ((L, h), ("layers", "embed_act"))
         layer["mlp"]["bi"] = ((L, mlp), ("layers", "mlp"))
         layer["mlp"]["bo"] = ((L, h), ("layers", "embed_act"))
+    if cfg.num_experts and cfg.use_bias:
+        raise ValueError("MoE layers do not support use_bias")
     params = {
         "embed": {"tokens": ((cfg.vocab_size, h), ("vocab", "embed"))},
         "layers": layer,
@@ -273,6 +306,8 @@ def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret):
     x = x + o
 
     y = _norm(x, lp["mlp_norm"], cfg)
+    if cfg.num_experts:
+        return x + _moe_mlp(y, mp, cfg)
     if cfg.act == "swiglu":
         inner = swiglu(
             jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt)),
@@ -289,9 +324,55 @@ def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret):
     return x + out
 
 
+def _moe_mlp(y, mp, cfg: TransformerConfig):
+    """Top-k routed expert MLPs, dense dispatch (see TransformerConfig).
+
+    Router math in f32 (softmax over selected logits, scattered back to a
+    [b,s,E] gate map, zero for unselected experts). Expert einsums carry the
+    E dim, which the `expert` mesh axis shards; the gated combine reduces
+    it, so under EP XLA emits the psum over expert shards.
+    """
+    dt = cfg.dtype
+    E, k = cfg.num_experts, min(cfg.expert_top_k, cfg.num_experts)
+    logits = jnp.einsum("bsh,he->bse", y.astype(jnp.float32),
+                        mp["router"].astype(jnp.float32))
+    top_vals, top_idx = jax.lax.top_k(logits, k)          # [b,s,k]
+    top_gates = jax.nn.softmax(top_vals, axis=-1)
+    gates = jnp.zeros_like(logits).at[                    # [b,s,E]
+        jnp.arange(logits.shape[0])[:, None, None],
+        jnp.arange(logits.shape[1])[None, :, None],
+        top_idx,
+    ].set(top_gates)
+    hi = jnp.einsum("bsh,ehm->ebsm", y, mp["wi"].astype(dt))
+    if cfg.act == "swiglu":
+        hg = jnp.einsum("bsh,ehm->ebsm", y, mp["wg"].astype(dt))
+        inner = swiglu(hi, hg)
+    else:
+        inner = gelu(hi)
+    ye = jnp.einsum("ebsm,emh->ebsh", inner, mp["wo"].astype(dt))
+    return jnp.einsum("ebsh,bse->bsh", ye, gates.astype(dt))
+
+
 def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interpret):
     """Scan the stacked layers over x with the configured remat policy
-    (shared by apply() and encoder-only models like ViT)."""
+    (shared by apply() and encoder-only models like ViT). With a ``stage``
+    mesh axis >1 the trunk runs as a GPipe pipeline instead: layers shard
+    over stages, activations rotate via ppermute (parallel/pipeline.py)."""
+    if mesh is not None and mesh.shape.get("stage", 1) > 1:
+        from ..parallel.pipeline import gpipe_trunk
+
+        return gpipe_trunk(
+            x, layer_params,
+            # inside the pipeline shard_map everything is device-local, so
+            # the per-stage body scans its layers with mesh=None attention
+            lambda xl, lp: _scan_layers(xl, lp, cfg, rope_tables, None, interpret),
+            mesh,
+            num_microbatches=cfg.pp_microbatches,
+        )
+    return _scan_layers(x, layer_params, cfg, rope_tables, mesh, interpret)
+
+
+def _scan_layers(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interpret):
     body = lambda x, lp: (_layer_body(x, lp, cfg, rope_tables, mesh, interpret), None)
     if cfg.remat == "full":
         body = jax.checkpoint(body, prevent_cse=False)

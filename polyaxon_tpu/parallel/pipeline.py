@@ -1,0 +1,115 @@
+"""GPipe pipeline parallelism over the ``stage`` mesh axis.
+
+SURVEY.md §2 "absent components": the reference delegated PP to user code
+(Megatron inside containers); here it is a mesh axis like the others. The
+TPU-native shape (§7 stage 4): the scan-stacked layer dimension is *sharded*
+over ``stage`` — each device group owns L/S layers — and a microbatch
+schedule rotates activations stage→stage+1 with ``lax.ppermute`` over ICI
+neighbors. Everything lives inside one ``shard_map``, so XLA sees a single
+SPMD program and the backward pass (reverse ppermute, per-stage param grads,
+psum over ``data``) falls out of the shard_map transpose.
+
+Schedule: plain GPipe — M microbatches, S stages, M+S-1 ticks, bubble
+fraction (S-1)/(M+S-1). Composes with data/fsdp batch sharding; tensor/
+context parallelism inside a stage is rejected loudly (round-3 scope).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def validate_pipeline_mesh(mesh: Mesh) -> int:
+    """Stage count, after rejecting unsupported axis combos."""
+    s = mesh.shape["stage"]
+    if s > 1:
+        for ax in ("context", "model", "expert"):
+            if mesh.shape[ax] > 1:
+                raise NotImplementedError(
+                    f"pipeline (stage={s}) with {ax}>1 is not supported yet: "
+                    f"intra-stage {ax} collectives inside the pipeline "
+                    f"shard_map are round-4 work. Use stage with data/fsdp."
+                )
+    return s
+
+
+def gpipe_trunk(
+    x: jax.Array,
+    layer_params: Any,
+    body_fn: Callable[[jax.Array, Any], jax.Array],
+    mesh: Mesh,
+    *,
+    num_microbatches: int = 0,
+) -> jax.Array:
+    """Run the stacked-layer trunk as a GPipe pipeline.
+
+    ``x``: [batch, seq, hidden] (global). ``layer_params``: pytree with a
+    leading layer axis L, L % stages == 0. ``body_fn(x_local, stage_params)``
+    applies that stage's layers to a local microbatch (it may scan + remat
+    internally). Returns the trunk output, batch-sharded like the input.
+    """
+    num_stages = validate_pipeline_mesh(mesh)
+    if num_stages == 1:
+        return body_fn(x, layer_params)
+
+    layer_count = jax.tree.leaves(layer_params)[0].shape[0]
+    if layer_count % num_stages:
+        raise ValueError(
+            f"{layer_count} layers do not divide over {num_stages} stages"
+        )
+    m = num_microbatches or 2 * num_stages
+    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    if (x.shape[0] // dp) % m:
+        raise ValueError(
+            f"per-replica batch {x.shape[0]}//{dp} not divisible by "
+            f"{m} pipeline microbatches"
+        )
+
+    batch_spec = P(("data", "fsdp"), None, None)
+    param_spec = jax.tree.map(lambda _: P("stage"), layer_params)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, check_vma=False,
+        in_specs=(batch_spec, param_spec), out_specs=batch_spec,
+    )
+    def _pipeline(xl, stage_params):
+        b, s, h = xl.shape
+        mb = b // m
+        sidx = jax.lax.axis_index("stage")
+        xm = xl.reshape(m, mb, s, h)
+        state = jnp.zeros((mb, s, h), xl.dtype)
+        outs = jnp.zeros((m, mb, s, h), xl.dtype)
+        fwd = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 injects microbatch t (clamped: ticks past M feed a
+            # repeat whose results never reach the last stage in time)
+            inject = jax.lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            stage_in = jnp.where(sidx == 0, inject, state)
+            out = body_fn(stage_in, stage_params)
+            # the last stage completed microbatch t-(S-1) this tick
+            widx = jnp.clip(t - (num_stages - 1), 0, m - 1)
+            write = jnp.logical_and(sidx == num_stages - 1,
+                                    t >= num_stages - 1)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outs, out.astype(outs.dtype), widx, 0)
+            outs = jnp.where(write, updated, outs)
+            state = jax.lax.ppermute(out, "stage", fwd)
+            return (state, outs), None
+
+        (state, outs), _ = jax.lax.scan(
+            tick, (state, outs), jnp.arange(m + num_stages - 1))
+        # replicate the last stage's outputs to every stage (each stage's
+        # copy is zero elsewhere, so a psum is a broadcast)
+        outs = outs * jnp.where(sidx == num_stages - 1, 1.0, 0.0).astype(outs.dtype)
+        outs = jax.lax.psum(outs, "stage")
+        return outs.reshape(b, s, h)
+
+    return _pipeline(x, layer_params)

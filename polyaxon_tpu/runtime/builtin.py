@@ -12,7 +12,10 @@ Spec keys:
     data {kind, path, ...}, checkpoint {save_interval_steps, max_to_keep},
     platform ("cpu" forces CPU — tests), num_cpu_devices,
     mu_dtype / nu_dtype / grad_dtype (e.g. "bfloat16" — HBM savers),
-    loss_chunk_tokens (blockwise-CE chunk)
+    loss_chunk_tokens (blockwise-CE chunk),
+    profile (true or {steps: N}: capture a jax.profiler trace of N steps
+    after warmup into outputs/profile — browsable via the artifacts API,
+    loadable in XProf; SURVEY.md §5 tracing)
 """
 
 from __future__ import annotations
@@ -139,7 +142,30 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
     )
     batches = make_batches(data_cfg, trainer.mesh)
 
-    state, metrics = trainer.fit(batches, num_steps=steps)
+    profile = spec.get("profile")
+    if profile:
+        # Warm up (compile + first steps), then trace a few real steps into
+        # the run's artifacts. EVERY process runs the same fit structure —
+        # fit() ends with a checkpoint save, an orbax cross-process
+        # collective, so diverging here would deadlock multi-host runs.
+        # Only process 0 wraps the middle segment in the profiler.
+        prof_steps = int(profile.get("steps", 3)) if isinstance(profile, dict) else 3
+        warm = min(2, steps)
+        state, metrics = trainer.fit(batches, num_steps=warm)
+        prof_dir = os.path.join(artifacts_dir, "outputs", "profile")
+        end = min(warm + prof_steps, steps)
+        if end > warm:
+            if is_primary:
+                with jax.profiler.trace(prof_dir):
+                    state, metrics = trainer.fit(batches, num_steps=end, state=state)
+            else:
+                state, metrics = trainer.fit(batches, num_steps=end, state=state)
+        if end < steps:
+            state, metrics = trainer.fit(batches, num_steps=steps, state=state)
+        if run is not None:
+            run.log_artifact("profile", "outputs/profile", kind="profile")
+    else:
+        state, metrics = trainer.fit(batches, num_steps=steps)
     summary = {k: v for k, v in metrics.items() if isinstance(v, (int, float))}
     if run is not None:
         run.log_outputs(**summary)

@@ -21,6 +21,11 @@ from ..runtime.local import LocalExecution, LocalExecutor
 from ..schemas.statuses import V1Statuses, is_done
 
 
+def _is_dag_spec(spec: dict) -> bool:
+    run = (spec.get("component") or {}).get("run") or {}
+    return run.get("kind") == "dag"
+
+
 class LocalAgent:
     """Poll/compile/schedule loop with kind-aware execution backends:
 
@@ -44,14 +49,28 @@ class LocalAgent:
         poll_interval: float = 0.2,
         backend: str = "local",
         cluster=None,
+        capacity_chips: Optional[int] = None,
+        artifacts_store: Optional[str] = None,
+        api_token: Optional[str] = None,
     ):
         self.store = store
         self.artifacts_root = os.path.abspath(artifacts_root)
         self.api_host = api_host
+        self.api_token = api_token
         self.max_parallel = max_parallel
+        # Remote artifacts store (fsspec URL or path). The local executor
+        # runs the sidecar sync loop against it; cluster runs get a final
+        # sync when they finish (upstream sidecar semantics, SURVEY.md §2).
+        self.artifacts_store = artifacts_store
+        # When set, scheduling budgets TPU *chips* instead of run count: a
+        # tpujob costs its slice/sub-slice chips, anything else costs one.
+        # This is what lets 16 packed 4x4 trials run concurrently on a
+        # v5e-256 while a 17th waits (BASELINE config 5).
+        self.capacity_chips = capacity_chips
         self.poll_interval = poll_interval
         self.backend = backend
-        self.executor = LocalExecutor(on_status=self._on_status)
+        self.executor = LocalExecutor(on_status=self._on_status,
+                                      remote_store=artifacts_store)
         self.reconciler = None
         if backend in ("cluster", "auto"):
             from ..operator import FakeCluster, OperationReconciler
@@ -63,6 +82,7 @@ class LocalAgent:
         elif backend != "local":
             raise ValueError(f"unknown agent backend {backend!r}")
         self._active: dict[str, LocalExecution] = {}
+        self._chips_in_use: dict[str, int] = {}
         self._tuners: dict[str, threading.Thread] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -91,8 +111,10 @@ class LocalAgent:
             self._collect_outputs(run_uuid)
             with self._lock:
                 self._active.pop(run_uuid, None)
+                self._chips_in_use.pop(run_uuid, None)
             if self.reconciler is not None and self.reconciler.is_tracked(run_uuid):
                 self._scrape_pod_logs(run_uuid)
+                self._sync_to_store(run_uuid)
 
     def _scrape_pod_logs(self, run_uuid: str) -> None:
         """Copy pod logs into the run's logs/ dir so `ops logs` shows them
@@ -111,6 +133,24 @@ class LocalAgent:
                 with open(os.path.join(logs_dir, f"{pod.name}.txt"), "w",
                           encoding="utf-8") as f:
                     f.write(text)
+
+    def _sync_to_store(self, run_uuid: str) -> None:
+        """Final artifacts sync for cluster-backend runs (the local executor
+        handles its own periodic sidecar loop)."""
+        if not self.artifacts_store:
+            return
+        run = self.store.get_run(run_uuid)
+        if not run:
+            return
+        from ..fs import sync_dir
+
+        local = run_artifacts_dir(self.artifacts_root, run["project"], run_uuid)
+        if os.path.isdir(local):
+            try:
+                sync_dir(local, os.path.join(self.artifacts_store,
+                                             run["project"], run_uuid))
+            except OSError:
+                traceback.print_exc()
 
     def _collect_outputs(self, run_uuid: str) -> None:
         """Merge the run's offline outputs.json (tracking writes it at end())
@@ -161,8 +201,9 @@ class LocalAgent:
             spec = run.get("spec")
             if not spec:
                 raise ValueError("run has no spec")
-            if spec.get("matrix"):
-                # matrix pipeline: the run itself becomes the pipeline record
+            if spec.get("matrix") or _is_dag_spec(spec):
+                # matrix/dag pipeline: the run itself becomes the pipeline
+                # record; children compile individually
                 self.store.transition(uuid, V1Statuses.COMPILED.value)
                 return
             resolved = resolve(
@@ -171,6 +212,7 @@ class LocalAgent:
                 project=run["project"],
                 artifacts_path=run_artifacts_dir(self.artifacts_root, run["project"], uuid),
                 api_host=self.api_host,
+                api_token=self.api_token,
             )
             self.store.update_run(
                 uuid,
@@ -183,24 +225,58 @@ class LocalAgent:
                 uuid, V1Statuses.FAILED.value, reason="CompilationError", message=str(e)[:500],
             )
 
+    @staticmethod
+    def _chip_demand(spec: dict) -> int:
+        """Chips a run occupies under chip budgeting: a tpujob costs its
+        (sub-)slice size, everything else costs 1. Reads the raw spec dict
+        (cheap — runs every poll tick for every queued run)."""
+        r = (spec.get("component") or {}).get("run") or {}
+        if r.get("kind") not in ("tpujob", "jaxjob"):
+            return 1
+        try:
+            from ..schemas.run import V1TPUJob
+
+            return max(V1TPUJob.from_dict(
+                {**r, "kind": "tpujob"}).get_slice().num_chips, 1)
+        except Exception:
+            return 1
+
     def _maybe_schedule(self, run: dict) -> None:
         uuid = run["uuid"]
         spec = run.get("spec") or {}
         if spec.get("matrix"):
             self._start_tuner(run)
             return
-        with self._lock:
-            active = len(self._active)
-            if self.reconciler is not None:
-                # reconciler.active_count() takes only its own lock; no
-                # lock-ordering cycle with self._lock
-                active += self.reconciler.active_count()
-            if active >= self.max_parallel:
-                return
-            if uuid in self._active:
-                return
+        if _is_dag_spec(spec):
+            self._start_dag(run)
+            return
         if self.reconciler is not None and self.reconciler.is_tracked(uuid):
             return
+        if uuid in self._active:
+            return
+        # capacity gate BEFORE the (expensive) resolve: queued-over-capacity
+        # runs must cost ~nothing per tick
+        with self._lock:
+            if self.capacity_chips is not None:
+                demand = self._chip_demand(run["compiled"] or spec)
+                if demand > self.capacity_chips:
+                    self.store.transition(
+                        uuid, V1Statuses.FAILED.value, reason="SchedulingError",
+                        message=f"run needs {demand} chips but the agent's "
+                                f"capacity is {self.capacity_chips}",
+                    )
+                    return
+                if sum(self._chips_in_use.values()) + demand > self.capacity_chips:
+                    return
+                self._chips_in_use[uuid] = demand
+            else:
+                active = len(self._active)
+                if self.reconciler is not None:
+                    # reconciler.active_count() takes only its own lock; no
+                    # lock-ordering cycle with self._lock
+                    active += self.reconciler.active_count()
+                if active >= self.max_parallel:
+                    return
         try:
             resolved = resolve(
                 run["compiled"] or spec,
@@ -208,15 +284,25 @@ class LocalAgent:
                 project=run["project"],
                 artifacts_path=run_artifacts_dir(self.artifacts_root, run["project"], uuid),
                 api_host=self.api_host,
+                api_token=self.api_token,
             )
             self.store.transition(uuid, V1Statuses.SCHEDULED.value)
             if self._use_cluster(resolved):
+                # pods write logs/outputs into the run's artifacts dir via
+                # PLX_ARTIFACTS_PATH; the local executor creates it for its
+                # runs, the operator path must too
+                os.makedirs(
+                    run_artifacts_dir(self.artifacts_root, run["project"], uuid),
+                    exist_ok=True,
+                )
                 self._submit_to_cluster(uuid, resolved)
             else:
                 execution = self.executor.submit(resolved.payload)
                 with self._lock:
                     self._active[uuid] = execution
         except Exception as e:
+            with self._lock:
+                self._chips_in_use.pop(uuid, None)
             self.store.transition(
                 uuid, V1Statuses.FAILED.value, reason="SchedulingError", message=str(e)[:500],
             )
@@ -249,6 +335,9 @@ class LocalAgent:
         uuid = run["uuid"]
         with self._lock:
             ex = self._active.pop(uuid, None)
+            # reconciler.delete() below fires no status callback, so release
+            # the chip reservation here (not only in _on_status)
+            self._chips_in_use.pop(uuid, None)
         # mark stopped BEFORE killing: the dying process's late 'failed'
         # report must land on a done status and be rejected (atomic
         # transition in the store)
@@ -271,7 +360,7 @@ class LocalAgent:
 
         def _run_tuner():
             try:
-                tuner = Tuner(self.store, run)
+                tuner = Tuner(self.store, run, artifacts_root=self.artifacts_root)
                 best = tuner.run()
                 self.store.merge_outputs(uuid, {"best": best})
                 self.store.transition(uuid, V1Statuses.SUCCEEDED.value)
@@ -284,6 +373,32 @@ class LocalAgent:
                 self._tuners.pop(uuid, None)
 
         t = threading.Thread(target=_run_tuner, daemon=True)
+        self._tuners[uuid] = t
+        t.start()
+
+    def _start_dag(self, run: dict) -> None:
+        uuid = run["uuid"]
+        if uuid in self._tuners:
+            return
+        from .dag_runner import DagRunner
+
+        self.store.transition(uuid, V1Statuses.SCHEDULED.value)
+        self.store.transition(uuid, V1Statuses.RUNNING.value)
+
+        def _run_dag():
+            try:
+                summary = DagRunner(self.store, run).run()
+                self.store.merge_outputs(uuid, {"dag": summary})
+                self.store.transition(uuid, V1Statuses.SUCCEEDED.value)
+            except Exception as e:
+                traceback.print_exc()
+                self.store.transition(
+                    uuid, V1Statuses.FAILED.value, reason="DagError", message=str(e)[:500],
+                )
+            finally:
+                self._tuners.pop(uuid, None)
+
+        t = threading.Thread(target=_run_dag, daemon=True)
         self._tuners[uuid] = t
         t.start()
 

@@ -194,6 +194,11 @@ class _BaseSearch(BaseSchema):
     concurrency: Optional[int] = None
     early_stopping: Optional[list[EarlyStoppingUnion]] = None
     tuner: Optional[V1Tuner] = None
+    # Parent TPU slice the sweep packs trials onto (alias "v5e-256" or bare
+    # topology "16x16" in the trial's accelerator). With a tpujob component
+    # the tuner assigns each concurrency slot a disjoint sub-slice of this
+    # parent (BASELINE config 5: 16 ViT trials on one v5e-256).
+    slice: Optional[str] = None
 
 
 class V1Mapping(BaseSchema):
@@ -203,6 +208,7 @@ class V1Mapping(BaseSchema):
     values: list[dict[str, Any]]
     concurrency: Optional[int] = None
     early_stopping: Optional[list[EarlyStoppingUnion]] = None
+    slice: Optional[str] = None  # parent TPU slice for sub-slice packing
 
 
 class V1GridSearch(_BaseSearch):

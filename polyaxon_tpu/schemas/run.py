@@ -256,6 +256,10 @@ class V1TPUJob(_BaseRun):
     topology: Optional[str] = None  # e.g. "8x8"; or use `slices` alias e.g. v5e-64
     slice_alias: Optional[str] = None  # e.g. "v5e-64"
     num_slices: int = 1
+    # Placement inside a parent slice (chip coordinates of this job's
+    # sub-rectangle). Set by the tuner's sub-slice packing (BASELINE config
+    # 5: 16 trials on one v5e-256); rendered as nodeSelector + PLX env.
+    subslice_origin: Optional[list[int]] = None
     parallelism: Optional[V1Parallelism] = None
     init: Optional[list[V1Init]] = None
     sidecars: Optional[list[V1Container]] = None

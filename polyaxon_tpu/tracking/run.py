@@ -130,6 +130,11 @@ class Run:
             self.log_status(status)
         self._writer.close()
         self._logger.close()
+        global _active
+        if _active is self:
+            # a later get_run() must mint a fresh Run, not hand back this
+            # one with closed writers (matters for in-proc sequential runs)
+            _active = None
 
 
 # -- module-level convenience (upstream `tracking.init()` pattern) ----------

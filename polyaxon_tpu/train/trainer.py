@@ -59,6 +59,11 @@ class TrainerConfig:
     # Shrinks live activations by the same factor — the lever that lets a
     # cheap remat policy (or none) replace full recompute on one chip.
     microbatches: int = 1
+    # Accumulator dtype for the microbatch gradient sum. f32 by default
+    # (summing k bf16 trees in bf16 rounds away low-order contributions);
+    # set "bfloat16" explicitly to halve accumulator HBM when that is the
+    # difference between fitting and OOM.
+    accum_dtype: Optional[str] = None  # None = float32
 
 
 class Trainer:
@@ -83,7 +88,22 @@ class Trainer:
             task = LMTask(cfg.model)
         self.task = task
         self.mesh = mesh if mesh is not None else build_mesh(cfg.parallelism)
-        self.rules = rules or ShardingRules()
+        if rules is None:
+            rules = ShardingRules()
+            if self.mesh.shape.get("stage", 1) > 1:
+                from ..parallel.pipeline import validate_pipeline_mesh
+
+                validate_pipeline_mesh(self.mesh)
+                from .tasks import ViTTask
+
+                if not isinstance(task, (LMTask, ViTTask)):
+                    raise NotImplementedError(
+                        f"pipeline parallelism needs a layered transformer "
+                        f"trunk; {type(task).__name__} has none"
+                    )
+                # layers shard over stages: each stage owns L/S layers
+                rules = rules.override(layers="stage")
+        self.rules = rules
         self.tx = make_optimizer(cfg.optimizer)
         self.track = track
         self.checkpointer = Checkpointer(cfg.checkpoint) if cfg.checkpoint else None
@@ -202,13 +222,22 @@ class Trainer:
                     batch,
                 )
 
+                ad = jnp.dtype(self.cfg.accum_dtype or jnp.float32)
+
                 def acc_body(carry, mb):
                     g_acc, extra = carry
                     (_, (m, new_extra)), g = _grads(diff_params, extra, mb)
-                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    g_acc = jax.tree.map(
+                        lambda a, gi: a + gi.astype(a.dtype), g_acc, g)
                     return (g_acc, new_extra), m
 
-                zeros = jax.tree.map(jnp.zeros_like, diff_params)
+                zeros = jax.tree.map(
+                    lambda p: jnp.zeros(
+                        p.shape,
+                        ad if jnp.issubdtype(p.dtype, jnp.floating) else p.dtype,
+                    ),
+                    diff_params,
+                )
                 (grads, new_extra), ms = jax.lax.scan(
                     acc_body, (zeros, state.extra), micro)
                 grads = jax.tree.map(lambda g: g / k, grads)

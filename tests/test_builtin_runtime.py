@@ -1,0 +1,63 @@
+"""Builtin-runtime spec plumbing: the HBM/perf knobs and the profiler
+capture must be reachable from a polyaxonfile runtime section (not just the
+Python API) — VERDICT r2/r3 code-review finding."""
+
+import os
+
+import pytest
+
+
+class TestBuiltinSpec:
+    def test_profile_capture_writes_trace_artifact(self, tmp_path, monkeypatch):
+        from polyaxon_tpu.runtime.builtin import run_builtin
+
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv("PLX_ARTIFACTS_PATH", str(tmp_path))
+        summary = run_builtin({
+            "model": "llama-tiny",
+            "steps": 4,
+            "batch_size": 8,
+            "seq_len": 16,
+            "checkpoint": False,
+            "profile": {"steps": 2},
+            "parallelism": {"data": 1},
+        })
+        assert summary["loss"] > 0
+        prof = os.path.join(tmp_path, "outputs", "profile")
+        files = []
+        for root, _, fs in os.walk(prof):
+            files += fs
+        assert any(f.endswith(".xplane.pb") for f in files), files
+
+    def test_lowmem_knobs_reach_trainer(self, tmp_path, monkeypatch):
+        """mu/nu/grad dtype + loss_chunk_tokens flow spec -> TrainerConfig."""
+        import polyaxon_tpu.runtime.builtin as builtin_mod
+        from polyaxon_tpu.train import Trainer
+
+        captured = {}
+        orig_init = Trainer.__init__
+
+        def spy(self, cfg, *a, **kw):
+            captured["cfg"] = cfg
+            return orig_init(self, cfg, *a, **kw)
+
+        monkeypatch.setattr(Trainer, "__init__", spy)
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv("PLX_ARTIFACTS_PATH", str(tmp_path))
+        builtin_mod.run_builtin({
+            "model": "llama-tiny",
+            "steps": 1,
+            "batch_size": 8,
+            "seq_len": 16,
+            "checkpoint": False,
+            "mu_dtype": "bfloat16",
+            "nu_dtype": "bfloat16",
+            "grad_dtype": "bfloat16",
+            "loss_chunk_tokens": 8,
+            "parallelism": {"data": 1},
+        })
+        cfg = captured["cfg"]
+        assert cfg.optimizer.mu_dtype == "bfloat16"
+        assert cfg.optimizer.nu_dtype == "bfloat16"
+        assert cfg.grad_dtype == "bfloat16"
+        assert cfg.model.loss_chunk_tokens == 8

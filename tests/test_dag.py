@@ -1,0 +1,137 @@
+"""DAG pipeline execution (SURVEY.md §3c; VERDICT r2 #10): dependency
+order, ops.NAME output refs, concurrency, failure fan-out."""
+
+import sys
+import time
+
+import pytest
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.scheduler.agent import LocalAgent
+
+WRITE_OUT = (
+    "import json, os; "
+    "json.dump({'x': %s}, open(os.path.join("
+    "os.environ['PLX_ARTIFACTS_PATH'], 'outputs.json'), 'w'))"
+)
+
+
+def _job(cmd):
+    return {"kind": "component",
+            "run": {"kind": "job",
+                    "container": {"command": [sys.executable, "-c", cmd]}}}
+
+
+def _dag_spec():
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "pipe",
+        "component": {
+            "kind": "component",
+            "run": {
+                "kind": "dag",
+                "operations": [
+                    {"kind": "operation", "name": "a",
+                     "component": _job(WRITE_OUT % "41")},
+                    {"kind": "operation", "name": "b",
+                     "component": {
+                         "kind": "component",
+                         "inputs": [{"name": "seed", "type": "int"}],
+                         "run": {"kind": "job", "container": {"command": [
+                             sys.executable, "-c",
+                             "import json, os; "
+                             "seed = int(json.loads(os.environ['PLX_PARAMS'])['seed']); "
+                             "json.dump({'x': seed + 1}, open(os.path.join("
+                             "os.environ['PLX_ARTIFACTS_PATH'], 'outputs.json'), 'w'))",
+                         ]}},
+                     },
+                     "params": {"seed": {"ref": "ops.a", "value": "outputs.x"}}},
+                    {"kind": "operation", "name": "c",
+                     "component": _job(WRITE_OUT % "1"),
+                     "dependencies": ["a"]},
+                ],
+            },
+        },
+    }).to_dict()
+
+
+class TestDagExecution:
+    def test_dependency_order_and_output_refs(self, tmp_path):
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path),
+                           poll_interval=0.05)
+        agent.start()
+        try:
+            pipeline = store.create_run("p", spec=_dag_spec(), name="pipe")
+            agent.wait_all(timeout=120)
+            final = store.get_run(pipeline["uuid"])
+            assert final["status"] == "succeeded", store.get_statuses(pipeline["uuid"])
+            assert final["outputs"]["dag"]["succeeded"] == ["a", "b", "c"]
+            children = {r["meta"]["dag_op"]: r
+                        for r in store.list_runs(pipeline_uuid=pipeline["uuid"])}
+            assert children["b"]["outputs"]["x"] == 42  # a's 41 + 1
+            # b was created only after a finished
+            assert children["b"]["created_at"] > children["a"]["created_at"]
+        finally:
+            agent.stop()
+
+    def test_failed_dep_fails_pipeline(self, tmp_path):
+        spec = check_polyaxonfile({
+            "kind": "operation",
+            "name": "pipe",
+            "component": {
+                "kind": "component",
+                "run": {
+                    "kind": "dag",
+                    "operations": [
+                        {"kind": "operation", "name": "boom",
+                         "component": _job("raise SystemExit(1)")},
+                        {"kind": "operation", "name": "after",
+                         "component": _job(WRITE_OUT % "1"),
+                         "dependencies": ["boom"]},
+                    ],
+                },
+            },
+        }).to_dict()
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        agent.start()
+        try:
+            pipeline = store.create_run("p", spec=spec, name="pipe")
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                row = store.get_run(pipeline["uuid"])
+                if row["status"] in ("succeeded", "failed", "stopped"):
+                    break
+                time.sleep(0.05)
+            assert row["status"] == "failed"
+            children = {r["meta"]["dag_op"]: r
+                        for r in store.list_runs(pipeline_uuid=pipeline["uuid"])}
+            assert children["boom"]["status"] == "failed"
+            assert "after" not in children  # never launched
+        finally:
+            agent.stop()
+
+    def test_cycle_rejected(self):
+        from polyaxon_tpu.schemas.operation import V1Operation
+
+        spec = check_polyaxonfile({
+            "kind": "operation",
+            "name": "pipe",
+            "component": {
+                "kind": "component",
+                "run": {
+                    "kind": "dag",
+                    "operations": [
+                        {"kind": "operation", "name": "a",
+                         "component": _job("pass"), "dependencies": ["b"]},
+                        {"kind": "operation", "name": "b",
+                         "component": _job("pass"), "dependencies": ["a"]},
+                    ],
+                },
+            },
+        }).to_dict()
+        op = V1Operation.from_dict(spec)
+        with pytest.raises(ValueError, match="[Cc]ycle"):
+            op.component.run.topological_order()

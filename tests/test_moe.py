@@ -1,0 +1,92 @@
+"""Mixture-of-experts tests (SURVEY.md §2 expert parallelism; VERDICT r2
+#6): parity vs dense MLP at k=num_experts with shared weights, EP sharding
+on the `expert` mesh axis, and end-to-end training."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import llama, transformer
+from polyaxon_tpu.parallel.mesh import ShardingRules, build_mesh
+from polyaxon_tpu.train import (
+    DataConfig, OptimizerConfig, Trainer, TrainerConfig, make_batches,
+)
+
+
+class TestMoEParity:
+    def test_topk_all_with_tied_experts_equals_dense(self):
+        """k = num_experts and every expert = the dense MLP weights =>
+        gates sum to 1 and the MoE layer reproduces the dense model."""
+        dense_cfg = llama.LLAMA_TINY
+        moe_cfg = llama.LLAMA_TINY.__class__(**{
+            **dense_cfg.__dict__, "num_experts": 4, "expert_top_k": 4,
+        })
+        key = jax.random.PRNGKey(0)
+        dense = transformer.init(key, dense_cfg)
+        moe = transformer.init(key, moe_cfg)
+        # tie every expert to the dense weights
+        for name in ("wi", "wg", "wo"):
+            moe["layers"]["mlp"][name] = jnp.broadcast_to(
+                dense["layers"]["mlp"][name][:, None],
+                moe["layers"]["mlp"][name].shape,
+            )
+        # attention/embeds/norms: copy verbatim
+        moe["layers"]["attn"] = dense["layers"]["attn"]
+        moe["layers"]["attn_norm"] = dense["layers"]["attn_norm"]
+        moe["layers"]["mlp_norm"] = dense["layers"]["mlp_norm"]
+        moe["embed"] = dense["embed"]
+        moe["final_norm"] = dense["final_norm"]
+        moe["lm_head"] = dense["lm_head"]
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                    dense_cfg.vocab_size)
+        ref = transformer.apply(dense, tokens, dense_cfg)
+        out = transformer.apply(moe, tokens, moe_cfg)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_topk_selects_k_experts(self):
+        cfg = llama.LLAMA_MOE_TINY
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                    cfg.vocab_size)
+        logits = transformer.apply(params, tokens, cfg)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_param_counts(self):
+        cfg = llama.LLAMA_MOE_TINY
+        total, active = cfg.num_params(), cfg.active_params()
+        assert total > active  # 4 experts, top-2: half the expert params idle
+        abstract = transformer.abstract_params(cfg)
+        assert abstract["layers"]["mlp"]["wi"][0][1] == cfg.num_experts
+
+
+class TestExpertParallel:
+    def test_ep_sharded_training_step(self):
+        """Mesh {expert:4, data:2}: expert weights shard over the expert
+        axis and a training step runs with finite loss."""
+        cfg = llama.LLAMA_MOE_TINY
+        tr = Trainer(TrainerConfig(
+            model=cfg,
+            optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=0,
+                                      schedule="constant", total_steps=2),
+            batch_size=8, seq_len=16, parallelism={"expert": 4, "data": 2},
+        ))
+        spec = tr.rules.spec(("layers", "expert", "embed", "mlp"))
+        assert spec[1] == "expert"
+        state = tr.init_state()
+        wi = state.params["layers"]["mlp"]["wi"]
+        # 4 experts over 4 expert-shards: each shard holds 1 expert
+        assert wi.addressable_shards[0].data.shape[1] == 1
+        data = make_batches(DataConfig(kind="synthetic-lm", batch_size=8,
+                                       seq_len=16, vocab_size=cfg.vocab_size),
+                            tr.mesh)
+        _, metrics = tr.fit(data, num_steps=2)
+        assert np.isfinite(metrics["loss"])
+
+    def test_moe_in_registry(self):
+        from polyaxon_tpu.models import REGISTRY
+
+        fam, cfg = REGISTRY["mixtral-8x7b"]
+        assert fam == "lm" and cfg.num_experts == 8

@@ -1,0 +1,93 @@
+"""Multislice (num_slices > 1) rendering + e2e (SURVEY.md §5 "distributed
+communication backend", VERDICT r2 #7): pods span slices, jax.distributed
+env is global, and the megascale/DCN transport env + per-slice node pools
+are injected."""
+
+import sys
+import time
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.compiler.resolver import resolve
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.scheduler.agent import LocalAgent
+
+
+def _tpujob_spec(num_slices=2, command=None):
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "ms",
+        "component": {
+            "kind": "component",
+            "run": {
+                "kind": "tpujob",
+                "accelerator": "v5e",
+                "topology": "2x2",       # 1 host per slice
+                "numSlices": num_slices,
+                "container": {
+                    "command": command or [sys.executable, "-c", "print('hi')"],
+                },
+            },
+        },
+    }).to_dict()
+
+
+class TestMultisliceRendering:
+    def test_megascale_env_and_slice_pools(self):
+        spec = _tpujob_spec(num_slices=2)
+        resolved = resolve(spec, run_uuid="u" * 32, project="p",
+                           artifacts_path="/tmp/x")
+        resources = resolved.k8s_resources()
+        pods = [r for r in resources if r["kind"] == "Pod"]
+        assert len(pods) == 2  # 2 slices x 1 host each
+        for i, pod in enumerate(pods):
+            env = {e["name"]: e["value"]
+                   for e in pod["spec"]["containers"][0]["env"]}
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == str(i)
+            assert env["PLX_SLICE_ID"] == str(i)
+            assert ":8080" in env["MEGASCALE_COORDINATOR_ADDRESS"]
+            # one jax.distributed job across all slices
+            assert env["PLX_NUM_PROCESSES"] == "2"
+            assert env["PLX_PROCESS_ID"] == str(i)
+            assert pod["spec"]["nodeSelector"]["app.polyaxon.com/slice-id"] == str(i)
+
+    def test_single_slice_has_no_megascale(self):
+        spec = _tpujob_spec(num_slices=1)
+        resolved = resolve(spec, run_uuid="u" * 32, project="p",
+                           artifacts_path="/tmp/x")
+        pods = [r for r in resolved.k8s_resources() if r["kind"] == "Pod"]
+        env = {e["name"]: e["value"]
+               for e in pods[0]["spec"]["containers"][0]["env"]}
+        assert "MEGASCALE_NUM_SLICES" not in env
+        assert "app.polyaxon.com/slice-id" not in pods[0]["spec"].get("nodeSelector", {})
+
+
+class TestMultisliceE2E:
+    def test_two_slice_pods_run_with_env(self, tmp_path):
+        """FakeCluster e2e: a 2-slice tpujob's pods each see their slice's
+        megascale env and the run succeeds."""
+        check_cmd = [
+            sys.executable, "-c",
+            "import os; assert os.environ['MEGASCALE_NUM_SLICES'] == '2'; "
+            "assert os.environ['MEGASCALE_SLICE_ID'] == os.environ['PLX_SLICE_ID']; "
+            "print('slice', os.environ['PLX_SLICE_ID'], 'ok')",
+        ]
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), backend="cluster",
+                           poll_interval=0.05)
+        uuid = store.create_run("p", spec=_tpujob_spec(2, check_cmd), name="ms")["uuid"]
+        deadline = time.monotonic() + 120
+        status = None
+        try:
+            while time.monotonic() < deadline:
+                agent.tick()
+                status = store.get_run(uuid)["status"]
+                if status in ("succeeded", "failed", "stopped"):
+                    break
+                time.sleep(0.05)
+            assert status == "succeeded", store.get_statuses(uuid)
+            envs = agent.cluster.launched_env
+            slice_ids = sorted(e["MEGASCALE_SLICE_ID"] for e in envs.values())
+            assert slice_ids == ["0", "1"]
+        finally:
+            agent.stop()

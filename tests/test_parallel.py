@@ -134,23 +134,24 @@ class TestDistributedEnv:
             initialize(ProcessInfo(1, 4, None))
 
 
-class TestUnimplementedAxes:
-    """build_mesh must reject stage/expert > 1 loudly until PP/EP land
-    (VERDICT r1+r2): a silently-built mesh would run with wrong semantics."""
+class TestStageExpertAxes:
+    """stage/expert >1 build real meshes now (GPipe + MoE); the loud
+    rejection VERDICT r1/r2 demanded lives on only for unsupported
+    *combinations* (pipeline × model/context), in validate_pipeline_mesh."""
 
-    def test_stage_gt1_rejected(self):
-        import pytest
+    def test_stage_and_expert_meshes_build(self):
         from polyaxon_tpu.parallel.mesh import build_mesh
 
-        with pytest.raises(NotImplementedError, match="stage"):
-            build_mesh({"stage": 2})
+        assert build_mesh({"stage": 2}).shape["stage"] == 2
+        assert build_mesh({"expert": 2}).shape["expert"] == 2
 
-    def test_expert_gt1_rejected(self):
+    def test_pipeline_rejects_model_context_combo(self):
         import pytest
         from polyaxon_tpu.parallel.mesh import build_mesh
+        from polyaxon_tpu.parallel.pipeline import validate_pipeline_mesh
 
-        with pytest.raises(NotImplementedError, match="expert"):
-            build_mesh({"expert": 2})
+        with pytest.raises(NotImplementedError, match="context"):
+            validate_pipeline_mesh(build_mesh({"stage": 2, "context": 2, "data": 2}))
 
     def test_size1_axes_fine(self):
         from polyaxon_tpu.parallel.mesh import build_mesh

@@ -1,0 +1,85 @@
+"""Pipeline-parallel (GPipe over the `stage` axis) tests: loss parity vs
+the single-stage trunk on the 8-device CPU mesh (SURVEY.md §4 distributed
+testing; VERDICT r2 #4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import llama, transformer
+from polyaxon_tpu.parallel.mesh import build_mesh
+from polyaxon_tpu.parallel.pipeline import gpipe_trunk, validate_pipeline_mesh
+from polyaxon_tpu.train import (
+    DataConfig, OptimizerConfig, Trainer, TrainerConfig, make_batches,
+)
+
+
+class TestGpipeTrunk:
+    def test_trunk_matches_single_stage(self):
+        """The pipelined trunk output equals the plain scan, elementwise."""
+        cfg = llama.LLAMA_TINY
+        key = jax.random.PRNGKey(0)
+        params = transformer.init(key, cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                    cfg.vocab_size)
+        mesh = build_mesh({"stage": 2}, devices=jax.devices()[:2])
+        ref = transformer.apply_hidden(params, tokens, cfg, mesh=None)
+        out = transformer.apply_hidden(params, tokens, cfg, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_rejects_model_axis_combo(self):
+        mesh = build_mesh({"stage": 2, "model": 2, "data": 2})
+        with pytest.raises(NotImplementedError, match="model"):
+            validate_pipeline_mesh(mesh)
+
+    def test_layers_must_divide(self):
+        cfg = llama.LLAMA_TINY  # 2 layers
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        mesh = build_mesh({"stage": 2}, devices=jax.devices()[:2])
+        # fake a 3-layer tree: 3 does not divide over 2 stages
+        bad = jax.tree.map(
+            lambda x: jnp.concatenate([x, x[:1]], axis=0), params["layers"])
+        with pytest.raises(ValueError, match="divide"):
+            gpipe_trunk(jnp.zeros((4, 8, cfg.hidden)), bad,
+                        lambda xl, lp: xl, mesh)
+
+
+class TestPipelineTraining:
+    def test_loss_parity_dp_vs_dp_pp(self):
+        """3 training steps on mesh {data:4, stage:2} track the pure-DP
+        mesh step for step (same global batch, same init)."""
+        cfg = llama.LLAMA_TINY
+        base = dict(
+            model=cfg,
+            optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=0,
+                                      schedule="constant", total_steps=3),
+            batch_size=16, seq_len=32,
+        )
+        losses = {}
+        for name, par in (("dp", {"data": 8}), ("pp", {"stage": 2})):
+            tr = Trainer(TrainerConfig(**base, parallelism=par))
+            data = make_batches(DataConfig(kind="synthetic-lm", batch_size=16,
+                                           seq_len=32, vocab_size=cfg.vocab_size,
+                                           seed=3), tr.mesh)
+            _, metrics = tr.fit(data, num_steps=3)
+            losses[name] = metrics["loss"]
+        assert abs(losses["dp"] - losses["pp"]) < 1e-4, losses
+
+    def test_resnet_stage_rejected(self):
+        from polyaxon_tpu.models import resnet
+        from polyaxon_tpu.train.tasks import ResNetTask
+
+        cfg = resnet.CONFIGS["resnet18-cifar"][1] if isinstance(
+            resnet.CONFIGS.get("resnet18-cifar"), tuple) else None
+        if cfg is None:
+            from polyaxon_tpu.models import REGISTRY
+
+            _, cfg = REGISTRY["resnet18-cifar"]
+        with pytest.raises(NotImplementedError, match="trunk"):
+            Trainer(TrainerConfig(
+                model=cfg, optimizer=OptimizerConfig(total_steps=1),
+                batch_size=8, seq_len=1, parallelism={"stage": 2},
+            ), task=ResNetTask(cfg))

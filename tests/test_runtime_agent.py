@@ -142,3 +142,65 @@ class TestAgentE2E:
         while store.get_run(run["uuid"])["status"] != "stopped":
             assert time.monotonic() < deadline
             time.sleep(0.1)
+
+
+class TestArtifactsStoreSync:
+    """VERDICT r2 #9: an agent configured with an artifacts store syncs run
+    artifacts there — sidecar loop for local jobs, final sync for cluster
+    runs."""
+
+    def _spec(self, kind):
+        from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+        run = {
+            "kind": kind,
+            "container": {"command": [
+                sys.executable, "-c",
+                "import os; open(os.path.join(os.environ['PLX_ARTIFACTS_PATH'],"
+                " 'result.txt'), 'w').write('payload')",
+            ]},
+        }
+        if kind == "tpujob":
+            run.update({"accelerator": "v5e", "topology": "1x1"})
+        return check_polyaxonfile({
+            "kind": "operation", "name": f"sync-{kind}",
+            "component": {"kind": "component", "run": run},
+        }).to_dict()
+
+    def _run(self, tmp_path, kind, backend):
+        import time as _t
+
+        from polyaxon_tpu.api.store import Store
+        from polyaxon_tpu.scheduler.agent import LocalAgent
+
+        remote = str(tmp_path / "remote")
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path / "local"),
+                           backend=backend, artifacts_store=remote,
+                           poll_interval=0.05)
+        uuid = store.create_run("p", spec=self._spec(kind), name="s")["uuid"]
+        deadline = _t.monotonic() + 90
+        try:
+            while _t.monotonic() < deadline:
+                agent.tick()
+                st = store.get_run(uuid)["status"]
+                if st in ("succeeded", "failed", "stopped"):
+                    break
+                _t.sleep(0.05)
+            assert st == "succeeded", store.get_statuses(uuid)
+            # local executor syncs on termination; poll briefly for the file
+            target = os.path.join(remote, "p", uuid, "result.txt")
+            for _ in range(100):
+                if os.path.exists(target):
+                    break
+                _t.sleep(0.1)
+            assert os.path.exists(target), os.listdir(remote) if os.path.isdir(remote) else "no remote dir"
+            assert open(target).read() == "payload"
+        finally:
+            agent.stop()
+
+    def test_local_job_sidecar_sync(self, tmp_path):
+        self._run(tmp_path, "job", "local")
+
+    def test_cluster_run_final_sync(self, tmp_path):
+        self._run(tmp_path, "tpujob", "cluster")

@@ -205,3 +205,25 @@ class TestGradAccumulation:
         ))
         with pytest.raises(ValueError, match="divisible"):
             tr.make_step()
+
+    def test_bf16_accumulator_close_to_f32(self):
+        from polyaxon_tpu.train import (
+            DataConfig, OptimizerConfig, Trainer, TrainerConfig, make_batches,
+        )
+
+        mcfg = llama.LLAMA_TINY
+        base = dict(
+            model=mcfg,
+            optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=0,
+                                      schedule="constant", total_steps=4),
+            batch_size=32, seq_len=32, parallelism={"data": 8}, microbatches=4,
+        )
+        losses = {}
+        for ad in (None, "bfloat16"):
+            tr = Trainer(TrainerConfig(**base, accum_dtype=ad))
+            data = make_batches(DataConfig(kind="synthetic-lm", batch_size=32,
+                                           seq_len=32, vocab_size=mcfg.vocab_size,
+                                           seed=7), tr.mesh)
+            _, metrics = tr.fit(data, num_steps=4)
+            losses[ad] = metrics["loss"]
+        assert abs(losses[None] - losses["bfloat16"]) < 5e-3, losses
