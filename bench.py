@@ -35,25 +35,30 @@ def main() -> None:
     accum_dtype = None
 
     if on_tpu and n >= 32:
-        mcfg = replace(llama.LLAMA2_7B, remat="attn",
+        # north-star config: 7B over an fsdp slice, 4 samples/chip, same
+        # HBM recipe as the measured single-chip path
+        mcfg = replace(llama.LLAMA2_7B, remat="attn_qkv",
                        attn_block_q=1024, attn_block_k=1024)
-        batch, seq, axes, steps = 64, 2048, {"fsdp": n}, 20
+        batch, seq, axes, steps = 4 * n, 2048, {"fsdp": n}, 20
+        micro = 2
         moments = {"mu_dtype": "bfloat16", "nu_dtype": "bfloat16"}
         grad_dtype = "bfloat16"
+        accum_dtype = "bfloat16"
     elif on_tpu:
         # single chip: ~1.1B (TinyLlama shape) — big enough that matmul
-        # shapes hit MXU efficiency; fits 16 GiB via attn-only remat +
-        # bf16 moments/grads + 8-way grad accumulation (measured r3:
-        # MFU 0.474 vs 0.365 for the old 125M/dots config; the accumulation
-        # amortizes the optimizer pass and per-step dispatch)
-        mcfg = replace(llama.LLAMA_1B, remat="attn", max_seq=2048,
+        # shapes hit MXU efficiency; fits 16 GiB via attn+qkv remat +
+        # bf16 moments/grads + 16-way grad accumulation (measured r3:
+        # MFU 0.485 vs 0.365 for the old 125M/dots config; the accumulation
+        # amortizes the optimizer pass, the small microbatch buys HBM room
+        # to save qkv and skip its backward recompute)
+        mcfg = replace(llama.LLAMA_1B, remat="attn_qkv", max_seq=2048,
                        attn_block_q=1024, attn_block_k=1024)
-        batch, seq, axes, steps = 16 * n, 2048, {"data": n}, 12
-        micro = 8
+        batch, seq, axes, steps = 32 * n, 2048, {"data": n}, 8
+        micro = 16
         moments = {"mu_dtype": "bfloat16", "nu_dtype": "bfloat16"}
         grad_dtype = "bfloat16"
         # bf16 accumulator is a measured, deliberate trade: the f32 one
-        # overflows HBM by 1.6G at this config; 8-term bf16 sums cost ~2-3
+        # overflows HBM at this config; 16-term bf16 sums cost ~3-4
         # low-order bits on the step direction (loss parity verified on CPU)
         accum_dtype = "bfloat16"
     else:

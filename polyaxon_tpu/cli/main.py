@@ -402,8 +402,19 @@ def config_cmd(host, project, token, show):
               help="remote artifacts store (fsspec URL or path): run "
                    "artifacts sync there (sidecar loop for local jobs, "
                    "final sync for cluster runs)")
+@click.option("--kube", is_flag=True,
+              help="use a real Kubernetes cluster for the operator backend "
+                   "(in-cluster service-account auth, or --kube-host)")
+@click.option("--kube-host", default=None, help="K8s API server URL")
+@click.option("--kube-namespace", default=None, help="K8s namespace")
+@click.option("--kube-token", default=None, envvar="PLX_KUBE_TOKEN",
+              help="bearer token for out-of-cluster use "
+                   "(default: the mounted service-account token)")
+@click.option("--kube-ca", default=None, help="CA bundle file for the K8s API")
+@click.option("--kube-insecure", is_flag=True, help="skip K8s API TLS verification")
 def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_token,
-           artifacts_store):
+           artifacts_store, kube, kube_host, kube_namespace, kube_token, kube_ca,
+           kube_insecure):
     """Start the API server + scheduling agent (one process)."""
     from ..api.server import ApiServer
     from ..scheduler.agent import LocalAgent
@@ -415,11 +426,18 @@ def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_tok
         host=host, port=port, auth_token=auth_token,
     )
     srv.start()
+    cluster = None
+    if kube:
+        from ..operator import KubeCluster
+
+        cluster = KubeCluster(host=kube_host, namespace=kube_namespace,
+                              token=kube_token, ca_file=kube_ca,
+                              verify=not kube_insecure)
     agent = LocalAgent(
         srv.store, artifacts_root=os.path.join(data_dir, "artifacts"),
         api_host=srv.url, max_parallel=max_parallel, backend=backend,
         capacity_chips=capacity_chips, artifacts_store=artifacts_store,
-        api_token=auth_token,
+        api_token=auth_token, cluster=cluster,
     )
     agent.start()
     click.echo(f"polyaxon_tpu server on {srv.url} (agent: {max_parallel} parallel)")

@@ -8,6 +8,7 @@ later.
 """
 
 from .cluster import Cluster, FakeCluster, PodPhase, PodStatus
+from .kube import KubeApiError, KubeCluster
 from .native import (
     Action,
     Decision,
@@ -24,6 +25,8 @@ __all__ = [
     "Cluster",
     "Decision",
     "FakeCluster",
+    "KubeApiError",
+    "KubeCluster",
     "Observed",
     "OperationCR",
     "OperationReconciler",

@@ -50,6 +50,11 @@ class Cluster(ABC):
     def delete(self, kind: str, name: str) -> None: ...
 
     @abstractmethod
+    def delete_selected(self, label_selector: dict[str, str]) -> None:
+        """Delete every pod + service matching the selector (the
+        reconciler's teardown verb: restart, fail, stop, TTL-GC)."""
+
+    @abstractmethod
     def pod_statuses(self, label_selector: dict[str, str]) -> list[PodStatus]: ...
 
     @abstractmethod

@@ -1,0 +1,221 @@
+"""Real-Kubernetes ``Cluster`` backend over the K8s REST API.
+
+The role upstream's Go operator filled through controller-runtime
+(SURVEY.md §2 "Operator" row): apply/delete pods + services, read pod
+phases, stream logs. Stdlib-only HTTP (no kubernetes client dependency —
+the env bakes none): in-cluster service-account auth (token + CA from
+``/var/run/secrets/kubernetes.io/serviceaccount``) or explicit host/token,
+e.g. from a kubeconfig-derived env.
+
+The reconciler stays the brain (polling reconcile passes, C++ decision
+kernel); this class is only the verbs, so FakeCluster and KubeCluster are
+interchangeable behind the same ``Cluster`` ABC — which is how the entire
+operator layer stays testable without a kubelet (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+from .cluster import Cluster, PodPhase, PodStatus
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeApiError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"K8s API {status}: {message[:300]}")
+        self.status = status
+
+
+class KubeCluster(Cluster):
+    """Cluster verbs against a real K8s API server.
+
+    Args:
+        host: API server base URL (default: in-cluster
+            ``https://$KUBERNETES_SERVICE_HOST:$KUBERNETES_SERVICE_PORT``).
+        token: bearer token (default: the mounted service-account token).
+        namespace: target namespace (default: the service account's).
+        ca_file: CA bundle; ``verify=False`` disables TLS verification
+            (dev clusters).
+    """
+
+    def __init__(
+        self,
+        host: Optional[str] = None,
+        token: Optional[str] = None,
+        namespace: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        verify: bool = True,
+        timeout: float = 10.0,
+        replace_timeout: float = 30.0,
+    ):
+        if host is None:
+            h = os.environ.get("KUBERNETES_SERVICE_HOST")
+            p = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not h:
+                raise ValueError(
+                    "KubeCluster needs `host` or in-cluster env "
+                    "(KUBERNETES_SERVICE_HOST)"
+                )
+            host = f"https://{h}:{p}"
+        self.host = host.rstrip("/")
+        if token is None:
+            token_path = os.path.join(SA_DIR, "token")
+            token = open(token_path, encoding="utf-8").read().strip() \
+                if os.path.exists(token_path) else None
+        self.token = token
+        if namespace is None:
+            ns_path = os.path.join(SA_DIR, "namespace")
+            namespace = open(ns_path, encoding="utf-8").read().strip() \
+                if os.path.exists(ns_path) else "default"
+        self.namespace = namespace
+        self.timeout = timeout
+        self._replace_timeout = replace_timeout
+        if ca_file is None and os.path.exists(os.path.join(SA_DIR, "ca.crt")):
+            ca_file = os.path.join(SA_DIR, "ca.crt")
+        if self.host.startswith("https"):
+            self._ssl: Optional[ssl.SSLContext] = (
+                ssl.create_default_context(cafile=ca_file) if verify
+                else ssl._create_unverified_context()  # noqa: S323 — opt-in
+            )
+        else:
+            self._ssl = None
+
+    # -- HTTP plumbing -------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 raw: bool = False) -> Any:
+        url = f"{self.host}{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ssl) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            raise KubeApiError(e.code, e.read().decode(errors="replace")) from e
+        if raw:
+            return payload.decode(errors="replace")
+        return json.loads(payload) if payload else {}
+
+    def _resource_path(self, kind: str, name: str = "") -> str:
+        plural = {"Pod": "pods", "Service": "services"}[kind]
+        suffix = f"/{name}" if name else ""
+        return f"/api/v1/namespaces/{self.namespace}/{plural}{suffix}"
+
+    # -- Cluster verbs -------------------------------------------------------
+
+    def apply(self, manifest: dict) -> None:
+        kind = manifest.get("kind")
+        if kind not in ("Pod", "Service"):
+            raise ValueError(f"KubeCluster cannot apply kind {kind!r}")
+        name = manifest["metadata"]["name"]
+        try:
+            self._request("POST", self._resource_path(kind), body=manifest)
+            return
+        except KubeApiError as e:
+            if e.status != 409:
+                raise
+        # AlreadyExists. A Service re-apply is idempotent — keep the old
+        # one. A Pod conflict means a prior attempt's pod (possibly still
+        # Terminating: K8s DELETE returns before etcd removal): replace it,
+        # or the reconciler's RESTART would silently adopt the dead pod and
+        # burn its backoff budget without ever re-running.
+        if kind != "Pod":
+            return
+        self.delete(kind, name)
+        deadline = time.monotonic() + self._replace_timeout
+        while True:
+            try:
+                self._request("POST", self._resource_path(kind), body=manifest)
+                return
+            except KubeApiError as e:
+                if e.status != 409 or time.monotonic() > deadline:
+                    raise
+            time.sleep(0.5)
+
+    def delete(self, kind: str, name: str) -> None:
+        try:
+            self._request(
+                "DELETE", self._resource_path(kind, name),
+                body={"gracePeriodSeconds": 0, "propagationPolicy": "Background"},
+            )
+        except KubeApiError as e:
+            if e.status != 404:  # already gone
+                raise
+
+    def delete_selected(self, label_selector: dict[str, str]) -> None:
+        sel = self._selector(label_selector)
+        # pods support collection delete; services must go one by one
+        try:
+            self._request(
+                "DELETE", self._resource_path("Pod") + "?labelSelector=" + sel,
+                body={"gracePeriodSeconds": 0, "propagationPolicy": "Background"},
+            )
+        except KubeApiError as e:
+            if e.status != 404:
+                raise
+        svc_list = self._request(
+            "GET", self._resource_path("Service") + "?labelSelector=" + sel)
+        for item in svc_list.get("items", []):
+            self.delete("Service", item["metadata"]["name"])
+
+    @staticmethod
+    def _selector(label_selector: dict[str, str]) -> str:
+        return urllib.parse.quote(
+            ",".join(f"{k}={v}" for k, v in sorted(label_selector.items())))
+
+    def pod_statuses(self, label_selector: dict[str, str]) -> list[PodStatus]:
+        path = self._resource_path("Pod") + "?labelSelector=" + \
+            self._selector(label_selector)
+        out = []
+        for item in self._request("GET", path).get("items", []):
+            out.append(self._to_status(item))
+        return out
+
+    def pod_logs(self, name: str) -> str:
+        try:
+            return self._request(
+                "GET", self._resource_path("Pod", name) + "/log", raw=True)
+        except KubeApiError as e:
+            if e.status == 404:
+                return ""
+            raise
+
+    # -- translation ---------------------------------------------------------
+
+    @staticmethod
+    def _to_status(pod: dict) -> PodStatus:
+        name = pod["metadata"]["name"]
+        status = pod.get("status") or {}
+        phase_raw = status.get("phase", "Pending")
+        phase = {
+            "Pending": PodPhase.PENDING,
+            "Running": PodPhase.RUNNING,
+            "Succeeded": PodPhase.SUCCEEDED,
+            "Failed": PodPhase.FAILED,
+            # Unknown (node gone) counts as failed: slice-level restart
+            # semantics want all-or-nothing anyway
+            "Unknown": PodPhase.FAILED,
+        }.get(phase_raw, PodPhase.PENDING)
+        exit_code = None
+        message = status.get("message")
+        for cs in status.get("containerStatuses") or []:
+            term = (cs.get("state") or {}).get("terminated")
+            if term:
+                exit_code = term.get("exitCode")
+                message = message or term.get("reason")
+        return PodStatus(name, phase, exit_code=exit_code, message=message)

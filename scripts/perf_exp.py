@@ -41,6 +41,7 @@ def main() -> None:
     micro = 1
     if "--micro" in sys.argv:
         micro = int(sys.argv[sys.argv.index("--micro") + 1])
+    accum_dtype = "bfloat16" if "--accum-bf16" in sys.argv else None
 
     mcfg = replace(llama.CONFIGS[model], remat=remat, max_seq=seq)
     if chunk is not None:
@@ -64,6 +65,7 @@ def main() -> None:
         accelerator="v5e",
         grad_dtype=grad_dtype,
         microbatches=micro,
+        accum_dtype=accum_dtype,
     )
     trainer = Trainer(cfg)
     data = make_batches(

@@ -126,24 +126,27 @@ class TestGPT2MPIJob:
 
 class TestViTHyperband:
     def test_hyperband_matrix_fanout(self, tmp_path):
-        """Config 5 shrunk: tiny Hyperband (maxIterations=2, eta=2) over
-        vit-tiny; the tuner creates child tpujob runs, children train through
-        the builtin runtime, the pipeline reports a best trial."""
+        """Config 5 shrunk but structurally complete: Hyperband over
+        vit-tiny tpujob trials PACKED onto sub-slices of the matrix's
+        parent slice, running through the cluster backend (manifests ->
+        reconciler -> pods) — the full BASELINE-5 stack at 1/8 scale."""
         spec = check_polyaxonfile(
             os.path.join(EXAMPLES, "vit_hyperband.yaml"),
             set_overrides=[
                 "matrix.maxIterations=2",
                 "matrix.eta=2",
+                "matrix.concurrency=2",
+                "matrix.slice=4x4",
                 "matrix.params.learning_rate={kind: linspace, value: '0.001:0.01:4'}",
                 "matrix.params.batch_size={kind: choice, value: [8]}",
-                "component.run.topology=2x4",
+                "component.run.topology=2x2",
                 "component.run.runtime.model=vit-tiny",
                 "component.run.runtime.checkpoint=false",
                 "component.run.runtime.platform=cpu",
             ],
         ).to_dict()
         store, agent, uuid, status = _run_through_agent(
-            tmp_path, spec, timeout=420, backend="local",
+            tmp_path, spec, timeout=420, backend="cluster",
         )
         try:
             assert status == "succeeded", _dump_debug(store, agent, uuid)
@@ -153,5 +156,10 @@ class TestViTHyperband:
             assert len(children) >= 2  # hyperband actually fanned out
             done = [c for c in children if c["status"] == "succeeded"]
             assert done, [c["status"] for c in children]
+            # every trial was pinned to a sub-slice of the 4x4 parent
+            origins = {tuple(c["spec"]["component"]["run"]["subslice_origin"])
+                       for c in children}
+            assert origins <= {(0, 0), (0, 2), (2, 0), (2, 2)}, origins
+            assert len(origins) >= 2
         finally:
             agent.stop()

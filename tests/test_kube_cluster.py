@@ -1,0 +1,235 @@
+"""KubeCluster (real-K8s REST backend) against an in-proc fake API server:
+verbs round-trip, and the OperationReconciler drives a run to completion
+through it — proving the Cluster ABC seam holds for a real cluster
+(SURVEY.md §2 Operator; §4 "no real cluster in CI")."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from polyaxon_tpu.operator import KubeApiError, KubeCluster, OperationCR, OperationReconciler
+from polyaxon_tpu.operator.cluster import PodPhase
+
+
+class _FakeK8sApi:
+    """Tiny subset of the K8s REST API: pods/services CRUD + logs."""
+
+    def __init__(self):
+        self.objects = {"pods": {}, "services": {}}
+        self.logs = {}
+        self.requests = []
+        handler_self = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, payload, raw=False):
+                body = payload.encode() if raw else json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "text/plain" if raw else "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _parts(self):
+                u = urlparse(self.path)
+                return u.path.strip("/").split("/"), parse_qs(u.query)
+
+            def do_POST(self):
+                parts, _ = self._parts()
+                handler_self.requests.append(("POST", self.path))
+                plural = parts[4]
+                body = json.loads(self.rfile.read(
+                    int(self.headers["Content-Length"])))
+                name = body["metadata"]["name"]
+                if name in handler_self.objects[plural]:
+                    self._send(409, {"reason": "AlreadyExists"})
+                    return
+                body.setdefault("status", {"phase": "Pending"})
+                handler_self.objects[plural][name] = body
+                self._send(201, body)
+
+            def do_GET(self):
+                parts, query = self._parts()
+                handler_self.requests.append(("GET", self.path))
+                plural = parts[4]
+                if len(parts) == 5:  # list
+                    sel = query.get("labelSelector", [""])[0]
+                    wanted = dict(kv.split("=") for kv in sel.split(",") if kv)
+                    items = [
+                        o for o in handler_self.objects[plural].values()
+                        if all((o["metadata"].get("labels") or {}).get(k) == v
+                               for k, v in wanted.items())
+                    ]
+                    self._send(200, {"items": items})
+                elif parts[-1] == "log":
+                    name = parts[5]
+                    if name not in handler_self.objects[plural]:
+                        self._send(404, {"reason": "NotFound"})
+                    else:
+                        self._send(200, handler_self.logs.get(name, ""), raw=True)
+                else:
+                    name = parts[5]
+                    o = handler_self.objects[plural].get(name)
+                    self._send(200, o) if o else self._send(404, {})
+
+            def do_DELETE(self):
+                parts, query = self._parts()
+                handler_self.requests.append(("DELETE", self.path))
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    self.rfile.read(length)
+                plural = parts[4]
+                if len(parts) == 5:  # collection delete with labelSelector
+                    sel = query.get("labelSelector", [""])[0]
+                    wanted = dict(kv.split("=") for kv in sel.split(",") if kv)
+                    doomed = [
+                        n for n, o in handler_self.objects[plural].items()
+                        if all((o["metadata"].get("labels") or {}).get(k) == v
+                               for k, v in wanted.items())
+                    ]
+                    for n in doomed:
+                        handler_self.objects[plural].pop(n)
+                    self._send(200, {"items": doomed})
+                    return
+                name = parts[5]
+                if handler_self.objects[plural].pop(name, None) is None:
+                    self._send(404, {})
+                else:
+                    self._send(200, {})
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def set_phase(self, name, phase, exit_code=None):
+        pod = self.objects["pods"][name]
+        pod["status"] = {"phase": phase}
+        if exit_code is not None:
+            pod["status"]["containerStatuses"] = [
+                {"state": {"terminated": {"exitCode": exit_code}}}]
+
+    def stop(self):
+        self.server.shutdown()
+
+
+@pytest.fixture()
+def api():
+    srv = _FakeK8sApi()
+    yield srv
+    srv.stop()
+
+
+def _pod(name, labels=None):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "labels": labels or {}},
+            "spec": {"containers": [{"name": "main", "image": "x"}]}}
+
+
+class TestKubeClusterVerbs:
+    def test_apply_list_logs_delete(self, api):
+        kc = KubeCluster(host=api.url, token="t", namespace="plx")
+        kc.apply(_pod("p1", {"app": "a"}))
+        kc.apply(_pod("p1", {"app": "a"}))  # 409 swallowed (re-apply)
+        kc.apply(_pod("p2", {"app": "b"}))
+        api.logs["p1"] = "hello from pod"
+        sts = kc.pod_statuses({"app": "a"})
+        assert [s.name for s in sts] == ["p1"]
+        assert sts[0].phase == PodPhase.PENDING
+        api.set_phase("p1", "Succeeded", exit_code=0)
+        sts = kc.pod_statuses({"app": "a"})
+        assert sts[0].phase == PodPhase.SUCCEEDED and sts[0].exit_code == 0
+        assert kc.pod_logs("p1") == "hello from pod"
+        assert kc.pod_logs("ghost") == ""
+        kc.delete("Pod", "p1")
+        kc.delete("Pod", "p1")  # 404 swallowed
+        assert kc.pod_statuses({"app": "a"}) == []
+
+    def test_unknown_kind_rejected(self, api):
+        kc = KubeCluster(host=api.url, token="t", namespace="plx")
+        with pytest.raises(ValueError, match="kind"):
+            kc.apply({"kind": "Deployment", "metadata": {"name": "d"}})
+
+    def test_http_error_surfaces(self, api):
+        kc = KubeCluster(host=api.url, token="t", namespace="plx")
+        with pytest.raises(KubeApiError):
+            kc._request("GET", "/api/v1/namespaces/plx/pods/zzz")
+
+
+class TestReconcilerOverKube:
+    def test_run_to_succeeded(self, api):
+        kc = KubeCluster(host=api.url, token="t", namespace="plx")
+        statuses = []
+        rec = OperationReconciler(
+            kc, on_status=lambda u, s, m: statuses.append(s))
+        labels = {"app.polyaxon.com/run": "u1"}
+        rec.apply(OperationCR(run_uuid="u1", resources=[
+            _pod("plx-u1-0", labels), _pod("plx-u1-1", labels),
+        ]))
+        rec.reconcile_once()
+        assert "running" not in statuses  # pods still Pending
+        api.set_phase("plx-u1-0", "Running")
+        api.set_phase("plx-u1-1", "Running")
+        rec.reconcile_once()
+        assert statuses[-1] == "running"
+        api.set_phase("plx-u1-0", "Succeeded", exit_code=0)
+        api.set_phase("plx-u1-1", "Succeeded", exit_code=0)
+        rec.reconcile_once()
+        assert statuses[-1] == "succeeded"
+
+
+def _svc(name, labels=None):
+    return {"apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": name, "labels": labels or {}},
+            "spec": {"clusterIP": "None"}}
+
+
+class TestKubeTeardownPaths:
+    def test_delete_selected_removes_pods_and_services(self, api):
+        kc = KubeCluster(host=api.url, token="t", namespace="plx")
+        kc.apply(_pod("p1", {"run": "r1"}))
+        kc.apply(_pod("p2", {"run": "r1"}))
+        kc.apply(_pod("other", {"run": "r2"}))
+        kc.apply(_svc("s1", {"run": "r1"}))
+        kc.delete_selected({"run": "r1"})
+        assert kc.pod_statuses({"run": "r1"}) == []
+        assert [s.name for s in kc.pod_statuses({"run": "r2"})] == ["other"]
+        assert "s1" not in api.objects["services"]
+
+    def test_apply_replaces_conflicting_pod(self, api):
+        """A 409 on a Pod must REPLACE the old object (a restart's new
+        attempt), not silently adopt it."""
+        kc = KubeCluster(host=api.url, token="t", namespace="plx")
+        kc.apply(_pod("p1", {"gen": "old"}))
+        api.set_phase("p1", "Failed", exit_code=1)
+        kc.apply(_pod("p1", {"gen": "new"}))
+        assert api.objects["pods"]["p1"]["metadata"]["labels"]["gen"] == "new"
+        # replaced pod starts Pending again, not Failed
+        assert kc.pod_statuses({"gen": "new"})[0].phase == PodPhase.PENDING
+
+    def test_reconciler_restart_recreates_pods(self, api):
+        """Full RESTART path over the real-K8s verbs: failed pod with
+        backoff budget -> pods torn down and re-applied fresh."""
+        kc = KubeCluster(host=api.url, token="t", namespace="plx")
+        statuses = []
+        rec = OperationReconciler(kc, on_status=lambda u, s, m: statuses.append(s))
+        labels = {"app.polyaxon.com/run": "u2"}
+        rec.apply(OperationCR(run_uuid="u2", backoff_limit=1,
+                              resources=[_pod("plx-u2-0", labels)]))
+        api.set_phase("plx-u2-0", "Failed", exit_code=1)
+        rec.reconcile_once()   # observes failure -> RESTART (budget 1)
+        # the pod exists again and is Pending (fresh), not the old Failed one
+        sts = kc.pod_statuses(labels)
+        assert len(sts) == 1 and sts[0].phase == PodPhase.PENDING
+        api.set_phase("plx-u2-0", "Succeeded", exit_code=0)
+        rec.reconcile_once()
+        assert statuses[-1] == "succeeded"
