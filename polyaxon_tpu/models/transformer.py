@@ -73,11 +73,21 @@ class TransformerConfig:
     pp_microbatches: int = 0                    # GPipe microbatches; 0 = 2*stages
     # Mixture-of-experts: >0 replaces each layer's MLP with num_experts
     # expert MLPs + a top-k router. Experts shard over the `expert` mesh
-    # axis (EP). Round-3 dispatch is dense (every expert computes every
-    # token, gates mask the combine) — exact, simple, and XLA shards the
-    # expert dim; ragged all-to-all token dispatch is the round-4 upgrade.
+    # axis (EP). Dispatch:
+    # - "capacity" (default): GShard/Switch-style — tokens sort to their
+    #   experts into fixed [E, capacity, h] buffers (static shapes for XLA);
+    #   capacity = tokens*k/E * capacity_factor, overflow tokens drop their
+    #   overflowing assignment. Compute cost scales with top_k, not E.
+    # - "dense": every expert computes every token, gates mask the combine —
+    #   exact (no drops), cost scales with E; the parity oracle for tests.
     num_experts: int = 0
     expert_top_k: int = 2
+    moe_dispatch: str = "capacity"              # "capacity" | "dense"
+    expert_capacity_factor: float = 1.25
+    # Switch-style load-balance aux loss coefficient (aux is 1.0 at perfect
+    # balance and grows as routing collapses; added to the LM loss as
+    # coef * mean-over-layers)
+    router_aux_coef: float = 0.01
 
     @property
     def kv_heads(self) -> int:
@@ -307,7 +317,8 @@ def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret):
 
     y = _norm(x, lp["mlp_norm"], cfg)
     if cfg.num_experts:
-        return x + _moe_mlp(y, mp, cfg)
+        out, aux = _moe_mlp(y, mp, cfg)
+        return x + out, aux
     if cfg.act == "swiglu":
         inner = swiglu(
             jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt)),
@@ -321,36 +332,93 @@ def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret):
     out = jnp.einsum("bsm,mh->bsh", inner, mp["wo"].astype(dt))
     if cfg.use_bias:
         out = out + mp["bo"].astype(dt)
-    return x + out
+    return x + out, jnp.zeros((), jnp.float32)
 
 
 def _moe_mlp(y, mp, cfg: TransformerConfig):
-    """Top-k routed expert MLPs, dense dispatch (see TransformerConfig).
+    """Top-k routed expert MLPs (see TransformerConfig.moe_dispatch).
 
-    Router math in f32 (softmax over selected logits, scattered back to a
-    [b,s,E] gate map, zero for unselected experts). Expert einsums carry the
-    E dim, which the `expert` mesh axis shards; the gated combine reduces
-    it, so under EP XLA emits the psum over expert shards.
+    Router math in f32. Expert tensors carry a leading E dim which the
+    `expert` mesh axis shards; the dispatch scatter/gather (capacity mode)
+    is the all-to-all XLA lowers onto the mesh.
     """
-    dt = cfg.dtype
     E, k = cfg.num_experts, min(cfg.expert_top_k, cfg.num_experts)
     logits = jnp.einsum("bsh,he->bse", y.astype(jnp.float32),
                         mp["router"].astype(jnp.float32))
     top_vals, top_idx = jax.lax.top_k(logits, k)          # [b,s,k]
     top_gates = jax.nn.softmax(top_vals, axis=-1)
-    gates = jnp.zeros_like(logits).at[                    # [b,s,E]
-        jnp.arange(logits.shape[0])[:, None, None],
-        jnp.arange(logits.shape[1])[None, :, None],
-        top_idx,
-    ].set(top_gates)
-    hi = jnp.einsum("bsh,ehm->ebsm", y, mp["wi"].astype(dt))
+    # Switch-style load balance: f_e = fraction of routed assignments on
+    # expert e, P_e = mean router prob. aux = E * sum f_e P_e — equals 1.0
+    # at perfect balance, approaches E as routing collapses onto one expert.
+    probs = jax.nn.softmax(logits, axis=-1)               # [b,s,E]
+    sel = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)   # [b,s,k,E]
+    f = sel.sum(axis=2).mean(axis=(0, 1)) / k             # [E], sums to 1
+    p_mean = probs.mean(axis=(0, 1))
+    aux = (E * (f * p_mean).sum()).astype(jnp.float32)
+    if cfg.moe_dispatch == "dense":
+        return _moe_dense(y, mp, cfg, top_idx, top_gates), aux
+    if cfg.moe_dispatch != "capacity":
+        raise ValueError(
+            f"unknown moe_dispatch {cfg.moe_dispatch!r}; valid: capacity|dense")
+    return _moe_capacity(y, mp, cfg, top_idx, top_gates), aux
+
+
+def _expert_ffn(xin, mp, cfg: TransformerConfig):
+    """The expert MLP stack over [E, ..., h] inputs."""
+    dt = cfg.dtype
+    hi = jnp.einsum("e...h,ehm->e...m", xin, mp["wi"].astype(dt))
     if cfg.act == "swiglu":
-        hg = jnp.einsum("bsh,ehm->ebsm", y, mp["wg"].astype(dt))
+        hg = jnp.einsum("e...h,ehm->e...m", xin, mp["wg"].astype(dt))
         inner = swiglu(hi, hg)
     else:
         inner = gelu(hi)
-    ye = jnp.einsum("ebsm,emh->ebsh", inner, mp["wo"].astype(dt))
+    return jnp.einsum("e...m,emh->e...h", inner, mp["wo"].astype(dt))
+
+
+def _moe_dense(y, mp, cfg: TransformerConfig, top_idx, top_gates):
+    dt = cfg.dtype
+    logits_shape = (*top_idx.shape[:2], cfg.num_experts)
+    gates = jnp.zeros(logits_shape, jnp.float32).at[     # [b,s,E]
+        jnp.arange(top_idx.shape[0])[:, None, None],
+        jnp.arange(top_idx.shape[1])[None, :, None],
+        top_idx,
+    ].set(top_gates)
+    ye = _expert_ffn(
+        jnp.broadcast_to(y[None], (cfg.num_experts, *y.shape)), mp, cfg)
     return jnp.einsum("ebsh,bse->bsh", ye, gates.astype(dt))
+
+
+def _moe_capacity(y, mp, cfg: TransformerConfig, top_idx, top_gates):
+    """Sort-based capacity dispatch: (token, slot) assignments group by
+    expert; each expert computes a fixed [capacity, h] block. Assignments
+    past an expert's capacity are dropped (their combine weight is zero) —
+    the standard GShard trade for static shapes."""
+    dt = cfg.dtype
+    b, s, h = y.shape
+    E, k = cfg.num_experts, min(cfg.expert_top_k, cfg.num_experts)
+    T = b * s
+    cap = max(int(T * k / E * cfg.expert_capacity_factor), 1)
+
+    x = y.reshape(T, h)
+    flat_e = top_idx.reshape(T * k)                        # expert per assignment
+    flat_g = top_gates.reshape(T * k).astype(jnp.float32)
+    flat_t = jnp.repeat(jnp.arange(T), k)                  # token per assignment
+
+    order = jnp.argsort(flat_e, stable=True)               # group by expert
+    se, st, sg = flat_e[order], flat_t[order], flat_g[order]
+    # position of each assignment within its expert's group
+    group_start = jnp.searchsorted(se, jnp.arange(E), side="left")
+    pos = jnp.arange(T * k) - group_start[se]
+    keep = pos < cap
+    slot = jnp.where(keep, pos, 0)
+
+    xin = jnp.zeros((E, cap, h), y.dtype)
+    xin = xin.at[se, slot].add(
+        jnp.where(keep[:, None], x[st], jnp.zeros_like(x[st])))
+    ye = _expert_ffn(xin, mp, cfg)                         # [E, cap, h]
+    contrib = ye[se, slot] * (sg * keep.astype(jnp.float32))[:, None].astype(dt)
+    out = jnp.zeros((T, h), dt).at[st].add(contrib)
+    return out.reshape(b, s, h)
 
 
 def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interpret):
@@ -361,19 +429,27 @@ def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interp
     if mesh is not None and mesh.shape.get("stage", 1) > 1:
         from ..parallel.pipeline import gpipe_trunk
 
-        return gpipe_trunk(
+        if cfg.num_experts:
+            raise NotImplementedError(
+                "MoE + pipeline parallelism: threading the router aux loss "
+                "through the GPipe schedule is not supported yet"
+            )
+        out = gpipe_trunk(
             x, layer_params,
             # inside the pipeline shard_map everything is device-local, so
             # the per-stage body scans its layers with mesh=None attention
-            lambda xl, lp: _scan_layers(xl, lp, cfg, rope_tables, None, interpret),
+            lambda xl, lp: _scan_layers(xl, lp, cfg, rope_tables, None, interpret)[0],
             mesh,
             num_microbatches=cfg.pp_microbatches,
         )
+        return out, jnp.zeros((), jnp.float32)
     return _scan_layers(x, layer_params, cfg, rope_tables, mesh, interpret)
 
 
 def _scan_layers(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interpret):
-    body = lambda x, lp: (_layer_body(x, lp, cfg, rope_tables, mesh, interpret), None)
+    def body(x, lp):
+        new_x, aux = _layer_body(x, lp, cfg, rope_tables, mesh, interpret)
+        return new_x, aux
     if cfg.remat == "full":
         body = jax.checkpoint(body, prevent_cse=False)
     elif cfg.remat == "attn":
@@ -405,8 +481,8 @@ def _scan_layers(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, int
             f"unknown remat policy {cfg.remat!r}; "
             f"valid: none|full|attn|attn_qkv|dots"
         )
-    x, _ = jax.lax.scan(body, x, layer_params)
-    return x
+    x, aux = jax.lax.scan(body, x, layer_params)
+    return x, aux.mean()
 
 
 def apply_hidden(
@@ -417,6 +493,7 @@ def apply_hidden(
     mesh: Optional[Mesh] = None,
     interpret: Optional[bool] = None,
     inputs_embeds: Optional[jax.Array] = None,
+    return_aux: bool = False,
 ) -> jax.Array:
     """Trunk forward: tokens [batch, seq] -> final-norm hidden states
     [batch, seq, hidden] (activation dtype). The vocab projection is left to
@@ -443,8 +520,11 @@ def apply_hidden(
         cos, sin = rope_frequencies(cfg.hd, cfg.max_seq, cfg.rope_theta)
         rope_tables = (cos[:s], sin[:s])
 
-    x = run_trunk(x, params["layers"], cfg, rope_tables, mesh, interpret)
-    return _norm(x, params["final_norm"], cfg)
+    x, aux = run_trunk(x, params["layers"], cfg, rope_tables, mesh, interpret)
+    hidden = _norm(x, params["final_norm"], cfg)
+    if return_aux:
+        return hidden, aux
+    return hidden
 
 
 def head_weights(params: dict, cfg: TransformerConfig) -> tuple[jax.Array, bool]:

@@ -119,17 +119,14 @@ def apply(params: dict, images: jax.Array, cfg: ViTConfig, *, mesh=None, interpr
 
 
 def _encode(enc_params, x, cfg: ViTConfig, mesh, interpret):
-    """Run the transformer trunk on embeddings, skipping the LM head."""
+    """Run the transformer trunk on embeddings, skipping the LM head
+    (shares run_trunk with the LM models, so every remat policy and the
+    GPipe stage path apply to ViT too)."""
     ecfg = cfg.encoder
     s = x.shape[1]
     x = x + enc_params["embed"]["pos"].astype(ecfg.dtype)[None, :s]
-    rope_tables = None
-    body = lambda x, lp: (
-        transformer._layer_body(x, lp, ecfg, rope_tables, mesh, interpret), None,
-    )
-    if ecfg.remat == "full":
-        body = jax.checkpoint(body, prevent_cse=False)
-    x, _ = jax.lax.scan(body, x, enc_params["layers"])
+    x, _aux = transformer.run_trunk(
+        x, enc_params["layers"], ecfg, None, mesh, interpret)
     return transformer._norm(x, enc_params["final_norm"], ecfg)
 
 

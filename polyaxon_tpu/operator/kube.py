@@ -174,9 +174,12 @@ class KubeCluster(Cluster):
             self.delete("Service", item["metadata"]["name"])
 
     @staticmethod
-    def _selector(label_selector: dict[str, str]) -> str:
-        return urllib.parse.quote(
-            ",".join(f"{k}={v}" for k, v in sorted(label_selector.items())))
+    def _selector(label_selector: dict) -> str:
+        """Equality selectors; a None value means key-existence (the watch
+        uses this to follow only this framework's pods)."""
+        return urllib.parse.quote(",".join(
+            k if v is None else f"{k}={v}"
+            for k, v in sorted(label_selector.items())))
 
     def pod_statuses(self, label_selector: dict[str, str]) -> list[PodStatus]:
         path = self._resource_path("Pod") + "?labelSelector=" + \
@@ -194,6 +197,52 @@ class KubeCluster(Cluster):
             if e.status == 404:
                 return ""
             raise
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch_pods(self, label_selector: dict[str, str], on_event,
+                   stop_event=None) -> None:
+        """Stream pod change events (upstream's operator was watch-driven,
+        not poll-driven). Blocks until ``stop_event`` is set; reconnects on
+        stream end/timeouts (the K8s watch contract). ``on_event(type,
+        pod_status)`` fires per event — typically a closure that pokes the
+        reconciler instead of waiting for its next poll tick.
+        """
+        import sys
+        import threading
+
+        stop_event = stop_event or threading.Event()
+        path = (self._resource_path("Pod")
+                + "?watch=true&labelSelector=" + self._selector(label_selector))
+        backoff = 1.0
+        while not stop_event.is_set():
+            try:
+                req = urllib.request.Request(self.host + path, method="GET")
+                if self.token:
+                    req.add_header("Authorization", f"Bearer {self.token}")
+                with urllib.request.urlopen(
+                        req, timeout=30, context=self._ssl) as resp:
+                    backoff = 1.0  # stream established
+                    for line in resp:
+                        if stop_event.is_set():
+                            return
+                        try:
+                            event = json.loads(line)
+                        except ValueError:
+                            continue
+                        obj = event.get("object") or {}
+                        if obj.get("kind") == "Pod":
+                            on_event(event.get("type", ""),
+                                     self._to_status(obj))
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                # a permanent 401/403 (bad token, role missing the watch
+                # verb) must be visible, not a silent 1 Hz retry loop
+                print(f"[kube-watch] {e!r}; retrying in {backoff:.0f}s",
+                      file=sys.stderr)
+                stop_event.wait(backoff)
+                backoff = min(backoff * 2, 60.0)
+                continue
+            stop_event.wait(1.0)  # stream ended normally; reconnect
 
     # -- translation ---------------------------------------------------------
 

@@ -67,6 +67,7 @@ class OperationReconciler:
         self.on_status = on_status or (lambda *a: None)
         self._ops: dict[str, _OpState] = {}
         self._lock = threading.Lock()
+        self._reconcile_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -135,13 +136,17 @@ class OperationReconciler:
     # -- the reconcile loop ------------------------------------------------
 
     def reconcile_once(self) -> None:
-        with self._lock:
-            states = list(self._ops.values())
-        for state in states:
-            try:
-                self._reconcile_op(state)
-            except Exception:
-                traceback.print_exc()
+        # serialized: both the agent poll loop and the (kube) watch thread
+        # call this; concurrent passes would double-count a failure's
+        # retry or race a restart's delete against its re-apply
+        with self._reconcile_lock:
+            with self._lock:
+                states = list(self._ops.values())
+            for state in states:
+                try:
+                    self._reconcile_op(state)
+                except Exception:
+                    traceback.print_exc()
 
     def _observe(self, state: _OpState) -> Observed:
         statuses = self.cluster.pod_statuses(state.op.label_selector)

@@ -85,6 +85,7 @@ class LocalAgent:
         self._chips_in_use: dict[str, int] = {}
         self._tuners: dict[str, threading.Thread] = {}
         self._stop = threading.Event()
+        self._wake = threading.Event()  # set by the watch thread
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
 
@@ -93,10 +94,24 @@ class LocalAgent:
     def start(self) -> "LocalAgent":
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        if self.reconciler is not None and hasattr(self.cluster, "watch_pods"):
+            # watch-driven reconciliation (KubeCluster): pod events wake the
+            # poll loop immediately instead of waiting out the interval.
+            # Events coalesce into one tick (a churn burst = one reconcile),
+            # and the periodic poll stays as the resync fallback. Watch only
+            # this framework's pods (run-label existence selector).
+            self._watch_thread = threading.Thread(
+                target=self.cluster.watch_pods,
+                args=({"app.polyaxon.com/run": None},
+                      lambda _t, _s: self._wake.set(), self._stop),
+                daemon=True,
+            )
+            self._watch_thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()  # unblock the poll loop immediately
         if self._thread:
             self._thread.join(timeout=10)
         with self._lock:
@@ -174,7 +189,11 @@ class LocalAgent:
     # -- the poll loop -----------------------------------------------------
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.poll_interval):
+        while True:
+            self._wake.wait(timeout=self.poll_interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
             try:
                 self.tick()
             except Exception:

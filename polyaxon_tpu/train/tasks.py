@@ -78,15 +78,22 @@ class LMTask(Task):
         return transformer.param_specs(self.cfg, rules)
 
     def loss(self, params, extra, batch, *, mesh=None, interpret=None):
-        hidden = transformer.apply_hidden(
+        hidden, aux = transformer.apply_hidden(
             params, batch["inputs"], self.cfg, mesh=mesh, interpret=interpret,
+            return_aux=True,
         )
         w, vocab_major = transformer.head_weights(params, self.cfg)
         loss = transformer.lm_loss_from_hidden(
             hidden, w, batch["labels"], batch.get("mask"),
             vocab_major=vocab_major, chunk_tokens=self.cfg.loss_chunk_tokens,
         )
-        return loss, {"loss": loss}, None
+        metrics = {"loss": loss}
+        if self.cfg.num_experts and self.cfg.router_aux_coef:
+            # Switch-style load-balance term keeps the router from
+            # collapsing onto few experts
+            loss = loss + self.cfg.router_aux_coef * aux
+            metrics["router_aux"] = aux
+        return loss, metrics, None
 
     def tokens_per_step(self, batch_size, seq_len):
         return batch_size * seq_len

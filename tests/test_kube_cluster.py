@@ -58,7 +58,7 @@ class _FakeK8sApi:
                 parts, query = self._parts()
                 handler_self.requests.append(("GET", self.path))
                 plural = parts[4]
-                if len(parts) == 5:  # list
+                if len(parts) == 5:  # list or watch
                     sel = query.get("labelSelector", [""])[0]
                     wanted = dict(kv.split("=") for kv in sel.split(",") if kv)
                     items = [
@@ -66,6 +66,19 @@ class _FakeK8sApi:
                         if all((o["metadata"].get("labels") or {}).get(k) == v
                                for k, v in wanted.items())
                     ]
+                    if query.get("watch", ["false"])[0] == "true":
+                        # stream current objects as ADDED events, then close
+                        # (client reconnects — the K8s watch contract)
+                        body = b"".join(
+                            json.dumps({"type": "ADDED", "object": o}).encode() + b"\n"
+                            for o in items
+                        )
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     self._send(200, {"items": items})
                 elif parts[-1] == "log":
                     name = parts[5]
@@ -233,3 +246,28 @@ class TestKubeTeardownPaths:
         api.set_phase("plx-u2-0", "Succeeded", exit_code=0)
         rec.reconcile_once()
         assert statuses[-1] == "succeeded"
+
+
+class TestWatch:
+    def test_watch_streams_pod_events(self, api):
+        """watch_pods delivers events from the streaming endpoint and
+        reconnects until stopped."""
+        import threading
+        import time
+
+        kc = KubeCluster(host=api.url, token="t", namespace="plx")
+        kc.apply(_pod("w1", {"run": "w"}))
+        events = []
+        stop = threading.Event()
+        t = threading.Thread(
+            target=kc.watch_pods,
+            args=({"run": "w"}, lambda ty, st: events.append((ty, st.name)), stop),
+            daemon=True,
+        )
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not events:
+            time.sleep(0.05)
+        stop.set()
+        t.join(timeout=5)
+        assert ("ADDED", "w1") in events, events

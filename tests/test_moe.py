@@ -22,6 +22,7 @@ class TestMoEParity:
         dense_cfg = llama.LLAMA_TINY
         moe_cfg = llama.LLAMA_TINY.__class__(**{
             **dense_cfg.__dict__, "num_experts": 4, "expert_top_k": 4,
+            "moe_dispatch": "dense",
         })
         key = jax.random.PRNGKey(0)
         dense = transformer.init(key, dense_cfg)
@@ -90,3 +91,99 @@ class TestExpertParallel:
 
         fam, cfg = REGISTRY["mixtral-8x7b"]
         assert fam == "lm" and cfg.num_experts == 8
+
+
+class TestCapacityDispatch:
+    def test_capacity_matches_dense_when_nothing_drops(self):
+        """With capacity >= every expert's worst-case load the sort-based
+        dispatch must equal the dense-dispatch oracle exactly."""
+        base = llama.LLAMA_MOE_TINY
+        dense_cfg = base.__class__(**{**base.__dict__, "moe_dispatch": "dense"})
+        cap_cfg = base.__class__(**{
+            **base.__dict__, "moe_dispatch": "capacity",
+            # worst case: every token routes to one expert
+            "expert_capacity_factor": float(base.num_experts) / base.expert_top_k,
+        })
+        params = transformer.init(jax.random.PRNGKey(0), base)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    base.vocab_size)
+        ref = transformer.apply(params, tokens, dense_cfg)
+        out = transformer.apply(params, tokens, cap_cfg)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_tight_capacity_drops_but_stays_finite(self):
+        base = llama.LLAMA_MOE_TINY
+        cfg = base.__class__(**{
+            **base.__dict__, "moe_dispatch": "capacity",
+            "expert_capacity_factor": 0.25,
+        })
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        out = transformer.apply(params, tokens, cfg)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_capacity_gradients_flow(self):
+        base = llama.LLAMA_MOE_TINY
+        params = transformer.init(jax.random.PRNGKey(0), base)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                    base.vocab_size)
+        labels = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0,
+                                    base.vocab_size)
+
+        def loss(p):
+            logits = transformer.apply(p, tokens, base)
+            return transformer.cross_entropy_loss(logits, labels)
+
+        g = jax.grad(loss)(params)
+        gn = jax.tree.map(lambda x: float(jnp.abs(x).sum()), g)
+        assert gn["layers"]["mlp"]["wi"] > 0
+        assert gn["layers"]["mlp"]["router"] > 0
+
+
+class TestAuxLoss:
+    def test_aux_is_one_at_perfect_balance(self):
+        """With a zero router every expert gets equal probability and
+        (ties aside) balanced assignment: aux == 1.0, the lower bound."""
+        cfg = llama.LLAMA_MOE_TINY
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        params["layers"]["mlp"]["router"] = jnp.zeros_like(
+            params["layers"]["mlp"]["router"])
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        _, aux = transformer.apply_hidden(params, tokens, cfg, return_aux=True)
+        assert float(aux) == pytest.approx(1.0, abs=1e-3), float(aux)
+
+    def test_collapsed_router_has_high_aux(self):
+        """Drive the MoE layer directly with inputs that make expert 0 win
+        every token: aux must sit far above the balanced 1.0."""
+        cfg = llama.LLAMA_MOE_TINY
+        E, h, m = cfg.num_experts, cfg.hidden, cfg.mlp_dim
+        key = jax.random.PRNGKey(0)
+        mp = {
+            # positive inputs x positive expert-0 column => expert 0 wins
+            "router": jnp.zeros((h, E)).at[:, 0].set(1.0),
+            "wi": jax.random.normal(key, (E, h, m)) * 0.02,
+            "wg": jax.random.normal(key, (E, h, m)) * 0.02,
+            "wo": jax.random.normal(key, (E, m, h)) * 0.02,
+        }
+        y = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (2, 16, h)))
+        _, aux = transformer._moe_mlp(y, mp, cfg)
+        assert float(aux) > 1.5, float(aux)
+
+    def test_lm_task_adds_aux(self):
+        from polyaxon_tpu.train.tasks import LMTask
+
+        cfg = llama.LLAMA_MOE_TINY
+        task = LMTask(cfg)
+        params, _ = task.init(jax.random.PRNGKey(0))
+        batch = {
+            "inputs": jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                         cfg.vocab_size),
+            "labels": jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                                         cfg.vocab_size),
+        }
+        loss, metrics, _ = task.loss(params, None, batch)
+        assert "router_aux" in metrics
+        assert float(loss) > float(metrics["loss"])  # aux added on top
