@@ -412,9 +412,12 @@ def config_cmd(host, project, token, show):
                    "(default: the mounted service-account token)")
 @click.option("--kube-ca", default=None, help="CA bundle file for the K8s API")
 @click.option("--kube-insecure", is_flag=True, help="skip K8s API TLS verification")
+@click.option("--agent-config", default=None, type=click.Path(exists=True),
+              help="agent config YAML: connections catalog runs may request "
+                   "+ which connection is the artifacts store")
 def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_token,
            artifacts_store, kube, kube_host, kube_namespace, kube_token, kube_ca,
-           kube_insecure):
+           kube_insecure, agent_config):
     """Start the API server + scheduling agent (one process)."""
     from ..api.server import ApiServer
     from ..scheduler.agent import LocalAgent
@@ -426,6 +429,18 @@ def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_tok
         host=host, port=port, auth_token=auth_token,
     )
     srv.start()
+    connections = {}
+    if agent_config:
+        import yaml
+
+        from ..schemas import V1AgentConfig
+
+        with open(agent_config, encoding="utf-8") as f:
+            acfg = V1AgentConfig.from_dict(yaml.safe_load(f))
+        connections = acfg.connection_map()
+        store_conn = acfg.resolve_artifacts_store()
+        if store_conn and not artifacts_store:
+            artifacts_store = store_conn.store_path()
     cluster = None
     if kube:
         from ..operator import KubeCluster
@@ -437,7 +452,7 @@ def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_tok
         srv.store, artifacts_root=os.path.join(data_dir, "artifacts"),
         api_host=srv.url, max_parallel=max_parallel, backend=backend,
         capacity_chips=capacity_chips, artifacts_store=artifacts_store,
-        api_token=auth_token, cluster=cluster,
+        api_token=auth_token, cluster=cluster, connections=connections,
     )
     agent.start()
     click.echo(f"polyaxon_tpu server on {srv.url} (agent: {max_parallel} parallel)")

@@ -65,6 +65,7 @@ def build_context(
     api_host: Optional[str] = None,
     extra: Optional[dict[str, Any]] = None,
     api_token: Optional[str] = None,
+    connections: Optional[dict[str, Any]] = None,
 ) -> dict[str, Any]:
     params = resolve_params(compiled)
     ctx: dict[str, Any] = {
@@ -81,6 +82,13 @@ def build_context(
         # flat access too: {{ lr }} — upstream allows both
         **params,
     }
+    if connections:
+        # {{ connections.<name>.path }} renders against this
+        ctx["connections"] = {
+            name: {"path": c.store_path(), "kind": c.kind, "name": c.name}
+            for name, c in connections.items()
+        }
+        ctx["globals"]["connections"] = connections
     if extra:
         ctx.update(extra)
     return ctx
@@ -104,4 +112,10 @@ def context_env(ctx: dict[str, Any]) -> dict[str, str]:
         env["PLX_AUTH_TOKEN"] = g["api_token"]
     if ctx.get("params"):
         env["PLX_PARAMS"] = json.dumps(ctx["params"])
+    for name, conn in (ctx["globals"].get("connections") or {}).items():
+        key = name.upper().replace("-", "_")
+        env[f"PLX_CONNECTION_{key}"] = conn.store_path()
+        for e in conn.env or []:
+            if e.get("name") and e.get("value") is not None:
+                env[e["name"]] = str(e["value"])
     return env

@@ -37,6 +37,7 @@ def resolve(
     artifacts_path: str,
     api_host: Optional[str] = None,
     api_token: Optional[str] = None,
+    connections: Optional[dict[str, Any]] = None,
 ) -> ResolvedRun:
     if isinstance(op_or_compiled, dict):
         kind = op_or_compiled.get("kind")
@@ -48,8 +49,19 @@ def resolve(
         compiled = compile_operation(op_or_compiled)
     else:
         compiled = op_or_compiled
+    requested = getattr(compiled.run, "connections", None) or []
+    resolved_conns = None
+    if requested:
+        catalog = connections or {}
+        missing = [n for n in requested if n not in catalog]
+        if missing:
+            raise ValueError(
+                f"run requests unknown connections {missing}; the agent "
+                f"declares {sorted(catalog)}"
+            )
+        resolved_conns = {n: catalog[n] for n in requested}
     ctx = build_context(compiled, run_uuid, project, artifacts_path, api_host,
-                        api_token=api_token)
+                        api_token=api_token, connections=resolved_conns)
     payload = to_local_payload(compiled, ctx, run_uuid, project)
     return ResolvedRun(
         run_uuid=run_uuid, project=project, compiled=compiled,

@@ -52,11 +52,14 @@ class LocalAgent:
         capacity_chips: Optional[int] = None,
         artifacts_store: Optional[str] = None,
         api_token: Optional[str] = None,
+        connections: Optional[dict] = None,
     ):
         self.store = store
         self.artifacts_root = os.path.abspath(artifacts_root)
         self.api_host = api_host
         self.api_token = api_token
+        # name -> V1Connection catalog runs may request (agent config)
+        self.connections = connections or {}
         self.max_parallel = max_parallel
         # Remote artifacts store (fsspec URL or path). The local executor
         # runs the sidecar sync loop against it; cluster runs get a final
@@ -232,6 +235,7 @@ class LocalAgent:
                 artifacts_path=run_artifacts_dir(self.artifacts_root, run["project"], uuid),
                 api_host=self.api_host,
                 api_token=self.api_token,
+                connections=self.connections,
             )
             self.store.update_run(
                 uuid,
@@ -304,6 +308,7 @@ class LocalAgent:
                 artifacts_path=run_artifacts_dir(self.artifacts_root, run["project"], uuid),
                 api_host=self.api_host,
                 api_token=self.api_token,
+                connections=self.connections,
             )
             self.store.transition(uuid, V1Statuses.SCHEDULED.value)
             if self._use_cluster(resolved):

@@ -5,6 +5,7 @@ from .component import V1Component
 from .connections import (
     V1BucketConnection,
     V1ClaimConnection,
+    V1AgentConfig,
     V1Connection,
     V1ConnectionKind,
     V1GitConnection,

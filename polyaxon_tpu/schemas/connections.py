@@ -83,3 +83,31 @@ class V1Connection(BaseSchema):
         if isinstance(s, dict):
             return s.get("bucket") or s.get("mountPath") or s.get("hostPath") or ""
         return ""
+
+
+class V1AgentConfig(BaseSchema):
+    """Agent-side deployment config (upstream's agent configuration file):
+    the connections catalog runs may request, and which connection is the
+    artifacts store. Loaded by `polyaxon server --agent-config <yaml>`."""
+
+    connections: Optional[list[V1Connection]] = None
+    artifacts_store: Optional[str] = None  # name of a connection above
+
+    def connection_map(self) -> dict[str, V1Connection]:
+        return {c.name: c for c in self.connections or []}
+
+    def resolve_artifacts_store(self) -> Optional[V1Connection]:
+        if not self.artifacts_store:
+            return None
+        conn = self.connection_map().get(self.artifacts_store)
+        if conn is None:
+            raise ValueError(
+                f"artifacts_store {self.artifacts_store!r} names no declared "
+                f"connection"
+            )
+        if not conn.is_artifact_store():
+            raise ValueError(
+                f"connection {conn.name!r} (kind {conn.kind}) cannot serve "
+                f"as an artifacts store"
+            )
+        return conn

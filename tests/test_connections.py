@@ -1,0 +1,102 @@
+"""Connections catalog (upstream V1Connection/agent config — SURVEY.md §2
+"FS / connections" + "Compiler" rows): runs request declared connections,
+the resolver injects env + template context, unknown names fail loudly."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.compiler.resolver import resolve
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.scheduler.agent import LocalAgent
+from polyaxon_tpu.schemas import V1AgentConfig, V1Connection
+
+
+def _catalog(tmp_path):
+    return V1AgentConfig.from_dict({
+        "connections": [
+            {"name": "training-data", "kind": "host_path",
+             "schema": {"mountPath": str(tmp_path / "data")},
+             "env": [{"name": "DATA_FORMAT", "value": "jsonl"}]},
+            {"name": "gcs-store", "kind": "gcs",
+             "schema": {"bucket": "gs://my-bucket/plx"}},
+        ],
+        "artifactsStore": "gcs-store",
+    })
+
+
+def _spec(conns):
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "c",
+        "component": {
+            "kind": "component",
+            "run": {
+                "kind": "job",
+                "connections": conns,
+                "container": {
+                    "command": [sys.executable, "-c",
+                                "import os; print(os.environ['PLX_CONNECTION_TRAINING_DATA'])"],
+                },
+            },
+        },
+    }).to_dict()
+
+
+class TestConnections:
+    def test_env_and_context_injection(self, tmp_path):
+        acfg = _catalog(tmp_path)
+        resolved = resolve(_spec(["training-data"]), run_uuid="u" * 32,
+                           project="p", artifacts_path=str(tmp_path),
+                           connections=acfg.connection_map())
+        env = resolved.payload.env
+        assert env["PLX_CONNECTION_TRAINING_DATA"] == str(tmp_path / "data")
+        assert env["DATA_FORMAT"] == "jsonl"
+        assert resolved.context["connections"]["training-data"]["path"] == \
+            str(tmp_path / "data")
+
+    def test_unknown_connection_fails(self, tmp_path):
+        acfg = _catalog(tmp_path)
+        with pytest.raises(ValueError, match="unknown connections"):
+            resolve(_spec(["nope"]), run_uuid="u" * 32, project="p",
+                    artifacts_path=str(tmp_path),
+                    connections=acfg.connection_map())
+
+    def test_agent_config_artifacts_store(self, tmp_path):
+        acfg = _catalog(tmp_path)
+        conn = acfg.resolve_artifacts_store()
+        assert conn.name == "gcs-store"
+        assert conn.store_path() == "gs://my-bucket/plx"
+
+    def test_bad_artifacts_store_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="artifacts_store"):
+            V1AgentConfig.from_dict({
+                "connections": [], "artifactsStore": "ghost",
+            }).resolve_artifacts_store()
+
+    def test_run_through_agent_sees_connection(self, tmp_path):
+        acfg = _catalog(tmp_path)
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path / "a"),
+                           poll_interval=0.05,
+                           connections=acfg.connection_map())
+        uuid = store.create_run("p", spec=_spec(["training-data"]), name="c")["uuid"]
+        deadline = time.monotonic() + 60
+        status = None
+        try:
+            while time.monotonic() < deadline:
+                agent.tick()
+                status = store.get_run(uuid)["status"]
+                if status in ("succeeded", "failed", "stopped"):
+                    break
+                time.sleep(0.05)
+            assert status == "succeeded", store.get_statuses(uuid)
+            logs_dir = tmp_path / "a" / "p" / uuid / "logs"
+            text = "".join(open(logs_dir / f).read()
+                           for f in os.listdir(logs_dir))
+            assert str(tmp_path / "data") in text
+        finally:
+            agent.stop()

@@ -204,3 +204,53 @@ class TestArtifactsStoreSync:
 
     def test_cluster_run_final_sync(self, tmp_path):
         self._run(tmp_path, "tpujob", "cluster")
+
+
+class TestWatchWake:
+    def test_watch_events_wake_poll_loop(self, tmp_path):
+        """A cluster backend exposing watch_pods gets wired to the agent's
+        wake event: pod events trigger an immediate tick instead of waiting
+        out poll_interval."""
+        import threading
+        import time as _t
+
+        from polyaxon_tpu.operator.cluster import FakeCluster
+
+        fired = threading.Event()
+
+        class WatchingCluster(FakeCluster):
+            def watch_pods(self, selector, on_event, stop_event=None):
+                # one synthetic event, then idle until stopped
+                on_event("MODIFIED", None)
+                fired.set()
+                (stop_event or threading.Event()).wait(30)
+
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path),
+                           backend="cluster",
+                           cluster=WatchingCluster(str(tmp_path / "c")),
+                           poll_interval=30.0)  # poll alone would be too slow
+        agent.start()
+        try:
+            assert fired.wait(5)
+            # the wake from the watch must drive a tick well before the 30s
+            # poll interval: a created run gets compiled+queued quickly
+            store.create_run("p", spec={
+                "kind": "operation",
+                "component": {"kind": "component", "run": {
+                    "kind": "job",
+                    "container": {"command": [sys.executable, "-c", "print('x')"]},
+                }},
+            }, name="w")
+            agent._wake.set()  # second wake (watch would fire on real events)
+            deadline = _t.monotonic() + 10
+            status = None
+            while _t.monotonic() < deadline:
+                rows = store.list_runs()
+                status = rows[0]["status"] if rows else None
+                if status not in (None, "created"):
+                    break
+                _t.sleep(0.1)
+            assert status not in (None, "created"), status
+        finally:
+            agent.stop()
