@@ -26,6 +26,10 @@ def _is_dag_spec(spec: dict) -> bool:
     return run.get("kind") == "dag"
 
 
+def _is_scheduled_spec(spec: dict) -> bool:
+    return bool(spec.get("schedule"))
+
+
 class LocalAgent:
     """Poll/compile/schedule loop with kind-aware execution backends:
 
@@ -223,9 +227,9 @@ class LocalAgent:
             spec = run.get("spec")
             if not spec:
                 raise ValueError("run has no spec")
-            if spec.get("matrix") or _is_dag_spec(spec):
-                # matrix/dag pipeline: the run itself becomes the pipeline
-                # record; children compile individually
+            if spec.get("matrix") or _is_dag_spec(spec) or _is_scheduled_spec(spec):
+                # matrix/dag/schedule pipeline: the run itself becomes the
+                # pipeline record; children compile individually
                 self.store.transition(uuid, V1Statuses.COMPILED.value)
                 return
             resolved = resolve(
@@ -272,6 +276,9 @@ class LocalAgent:
             return
         if _is_dag_spec(spec):
             self._start_dag(run)
+            return
+        if _is_scheduled_spec(spec):
+            self._start_schedule(run)
             return
         if self.reconciler is not None and self.reconciler.is_tracked(uuid):
             return
@@ -423,6 +430,35 @@ class LocalAgent:
                 self._tuners.pop(uuid, None)
 
         t = threading.Thread(target=_run_dag, daemon=True)
+        self._tuners[uuid] = t
+        t.start()
+
+    def _start_schedule(self, run: dict) -> None:
+        uuid = run["uuid"]
+        if uuid in self._tuners:
+            return
+        from .schedules import ScheduleRunner
+
+        self.store.transition(uuid, V1Statuses.SCHEDULED.value)
+        self.store.transition(uuid, V1Statuses.RUNNING.value)
+
+        def _run_schedule():
+            try:
+                summary = ScheduleRunner(self.store, run).run()
+                self.store.merge_outputs(uuid, {"schedule": summary})
+                self.store.transition(uuid, V1Statuses.SUCCEEDED.value)
+            except InterruptedError:
+                pass  # stopped by the user; _do_stop already transitioned
+            except Exception as e:
+                traceback.print_exc()
+                self.store.transition(
+                    uuid, V1Statuses.FAILED.value, reason="ScheduleError",
+                    message=str(e)[:500],
+                )
+            finally:
+                self._tuners.pop(uuid, None)
+
+        t = threading.Thread(target=_run_schedule, daemon=True)
         self._tuners[uuid] = t
         t.start()
 

@@ -1,0 +1,157 @@
+"""Schedule execution (upstream operation ``schedule:`` — SURVEY.md §2
+"Polyflow schemas" lifecycle objects): an operation with a cron/interval/
+datetime schedule becomes a long-lived scheduler record whose firings are
+ordinary child runs through the same queue.
+
+The cron matcher is a minimal 5-field implementation (minute, hour,
+day-of-month, month, day-of-week; ``*``, lists, ranges, ``*/n``) — enough
+for upstream polyaxonfile parity without a dependency.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from datetime import datetime, timedelta, timezone
+from typing import Any, Optional
+
+from ..schemas.lifecycle import V1CronSchedule, V1DateTimeSchedule, V1IntervalSchedule
+
+
+def _parse_when(value: Optional[str]) -> Optional[datetime]:
+    if not value:
+        return None
+    dt = datetime.fromisoformat(value)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt
+
+
+def _parse_field(field: str, lo: int, hi: int) -> set[int]:
+    out: set[int] = set()
+    for part in field.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+        if part in ("*", ""):
+            start, stop = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            start, stop = int(a), int(b)
+        else:
+            start = int(part)
+            stop = hi if step > 1 else start  # "5/15" = from 5, every 15
+        out.update(range(start, stop + 1, step))
+    if not out:
+        raise ValueError(f"empty cron field {field!r}")
+    bad = {v for v in out if v < lo or v > hi}
+    if bad:
+        raise ValueError(f"cron field {field!r} out of range [{lo},{hi}]")
+    return out
+
+
+def cron_matches(expr: str, dt: datetime) -> bool:
+    """5-field cron match (dow: 0=Sunday, 7 also accepted as Sunday)."""
+    fields = expr.split()
+    if len(fields) != 5:
+        raise ValueError(f"cron needs 5 fields, got {expr!r}")
+    minute = _parse_field(fields[0], 0, 59)
+    hour = _parse_field(fields[1], 0, 23)
+    dom = _parse_field(fields[2], 1, 31)
+    month = _parse_field(fields[3], 1, 12)
+    dow = {v % 7 for v in _parse_field(fields[4], 0, 7)}
+    return (
+        dt.minute in minute and dt.hour in hour and dt.month in month
+        and dt.day in dom and ((dt.weekday() + 1) % 7) in dow
+    )
+
+
+def next_cron_fire(expr: str, after: datetime, horizon_days: int = 366) -> Optional[datetime]:
+    """First minute strictly after ``after`` matching ``expr``."""
+    dt = after.replace(second=0, microsecond=0) + timedelta(minutes=1)
+    for _ in range(horizon_days * 24 * 60):
+        if cron_matches(expr, dt):
+            return dt
+        dt += timedelta(minutes=1)
+    return None
+
+
+def next_fire(schedule: Any, after: datetime, runs_so_far: int) -> Optional[datetime]:
+    """When this schedule fires next, or None if exhausted."""
+    if isinstance(schedule, V1DateTimeSchedule):
+        start = _parse_when(schedule.start_at)
+        return start if runs_so_far == 0 else None
+    if schedule.max_runs and runs_so_far >= schedule.max_runs:
+        return None
+    end = _parse_when(schedule.end_at)
+    if isinstance(schedule, V1IntervalSchedule):
+        freq = float(schedule.frequency)
+        start = _parse_when(schedule.start_at) or after
+        if runs_so_far == 0 and start > after:
+            nxt = start
+        else:
+            nxt = after + timedelta(seconds=freq)
+    elif isinstance(schedule, V1CronSchedule):
+        base = max(after, _parse_when(schedule.start_at) or after)
+        nxt = next_cron_fire(schedule.cron, base)
+        if nxt is None:
+            return None
+    else:
+        raise ValueError(f"unknown schedule {type(schedule).__name__}")
+    if end and nxt > end:
+        return None
+    return nxt
+
+
+class ScheduleRunner:
+    """Drives one scheduled operation: sleeps to each firing, creates a
+    child run (spec minus ``schedule``), optionally waits for it when
+    ``dependsOnPast`` is set."""
+
+    def __init__(self, store, pipeline_run: dict, poll_interval: float = 0.5):
+        from ..schemas.operation import V1Operation
+
+        self.store = store
+        self.pipeline = pipeline_run
+        self.poll_interval = poll_interval
+        op = V1Operation.from_dict(pipeline_run["spec"])
+        if op.schedule is None:
+            raise ValueError("run has no schedule")
+        self.schedule = op.schedule
+        self._child_spec = copy.deepcopy(pipeline_run["spec"])
+        self._child_spec.pop("schedule", None)
+
+    def run(self, now_fn=None) -> dict[str, Any]:
+        from ..schemas.statuses import V1Statuses, is_done
+
+        now_fn = now_fn or (lambda: datetime.now(timezone.utc))
+        fired = 0
+        children: list[str] = []
+        while True:
+            nxt = next_fire(self.schedule, now_fn(), fired)
+            if nxt is None:
+                break
+            while now_fn() < nxt:
+                pl = self.store.get_run(self.pipeline["uuid"])
+                if pl and pl["status"] in (V1Statuses.STOPPING.value,
+                                           V1Statuses.STOPPED.value):
+                    raise InterruptedError("schedule stopped")
+                time.sleep(self.poll_interval)
+            spec = copy.deepcopy(self._child_spec)
+            name = f"{self.pipeline.get('name') or 'sched'}-{fired}"
+            spec["name"] = name
+            row = self.store.create_run(
+                self.pipeline["project"], spec=spec, name=name,
+                meta={"schedule_index": fired, "fired_at": nxt.isoformat()},
+                pipeline_uuid=self.pipeline["uuid"],
+            )
+            children.append(row["uuid"])
+            fired += 1
+            if getattr(self.schedule, "depends_on_past", None):
+                while True:
+                    child = self.store.get_run(row["uuid"])
+                    if child is None or is_done(child["status"]):
+                        break
+                    time.sleep(self.poll_interval)
+        return {"fired": fired, "children": children}

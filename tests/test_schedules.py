@@ -1,0 +1,94 @@
+"""Schedule execution (operation `schedule:` — cron/interval/datetime):
+firings become child runs; cron matching; bounds (maxRuns/endAt)."""
+
+import sys
+import time
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.scheduler.agent import LocalAgent
+from polyaxon_tpu.scheduler.schedules import cron_matches, next_cron_fire, next_fire
+from polyaxon_tpu.schemas.lifecycle import V1CronSchedule, V1IntervalSchedule
+
+
+UTC = timezone.utc
+
+
+class TestCronMatcher:
+    def test_basic_fields(self):
+        dt = datetime(2026, 7, 30, 9, 30, tzinfo=UTC)  # Thursday
+        assert cron_matches("30 9 * * *", dt)
+        assert cron_matches("*/15 * * * *", dt.replace(minute=45))
+        assert not cron_matches("0 9 * * *", dt)
+        assert cron_matches("30 9 30 7 *", dt)
+        assert cron_matches("30 9 * * 4", dt)       # Thursday = 4
+        assert not cron_matches("30 9 * * 0", dt)   # not Sunday
+
+    def test_ranges_and_lists(self):
+        dt = datetime(2026, 7, 30, 14, 10, tzinfo=UTC)
+        assert cron_matches("10 9-17 * * 1-5", dt)
+        assert cron_matches("0,10,20 * * * *", dt)
+        assert not cron_matches("10 9-12 * * *", dt)
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            cron_matches("61 * * * *", datetime.now(UTC))
+        with pytest.raises(ValueError):
+            cron_matches("* * * *", datetime.now(UTC))
+
+    def test_next_fire(self):
+        after = datetime(2026, 7, 30, 9, 31, tzinfo=UTC)
+        nxt = next_cron_fire("0 12 * * *", after)
+        assert nxt == datetime(2026, 7, 30, 12, 0, tzinfo=UTC)
+
+
+class TestNextFire:
+    def test_interval_bounds(self):
+        s = V1IntervalSchedule(frequency=60, maxRuns=2)
+        t0 = datetime(2026, 7, 30, 9, 0, tzinfo=UTC)
+        assert next_fire(s, t0, 0) == t0 + timedelta(seconds=60)
+        assert next_fire(s, t0, 2) is None  # maxRuns reached
+
+    def test_end_at(self):
+        s = V1IntervalSchedule(frequency=3600,
+                               endAt="2026-07-30T09:30:00+00:00")
+        t0 = datetime(2026, 7, 30, 9, 0, tzinfo=UTC)
+        assert next_fire(s, t0, 1) is None  # next would be 10:00 > end
+
+    def test_cron_respects_start_at(self):
+        s = V1CronSchedule(cron="0 * * * *",
+                           startAt="2026-07-30T12:00:00+00:00")
+        t0 = datetime(2026, 7, 30, 9, 0, tzinfo=UTC)
+        assert next_fire(s, t0, 0) == datetime(2026, 7, 30, 13, 0, tzinfo=UTC)
+
+
+class TestScheduleE2E:
+    def test_interval_fires_children(self, tmp_path):
+        spec = check_polyaxonfile({
+            "kind": "operation",
+            "name": "tick",
+            "schedule": {"kind": "interval", "frequency": 1, "maxRuns": 2},
+            "component": {
+                "kind": "component",
+                "run": {"kind": "job", "container": {
+                    "command": [sys.executable, "-c", "print('tick')"]}},
+            },
+        }).to_dict()
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path),
+                           poll_interval=0.05)
+        agent.start()
+        try:
+            pipeline = store.create_run("p", spec=spec, name="tick")
+            agent.wait_all(timeout=90)
+            final = store.get_run(pipeline["uuid"])
+            assert final["status"] == "succeeded", store.get_statuses(pipeline["uuid"])
+            assert final["outputs"]["schedule"]["fired"] == 2
+            children = store.list_runs(pipeline_uuid=pipeline["uuid"])
+            assert len(children) == 2
+            assert all(c["status"] == "succeeded" for c in children)
+        finally:
+            agent.stop()
