@@ -74,6 +74,7 @@ class Store:
         # serializes status transitions (read-check-insert-update must be
         # atomic across the agent/executor/API threads)
         self._transition_lock = threading.Lock()
+        self._transition_listeners: list = []
         self._memory_conn: Optional[sqlite3.Connection] = None
         if path == ":memory:":
             # a single shared connection (serialized by a lock)
@@ -288,7 +289,37 @@ class Store:
                     "INSERT INTO status_conditions (run_uuid, condition, created_at) VALUES (?,?,?)",
                     (uuid, json.dumps(cond.to_dict()), now),
                 )
-            return self.update_run(uuid, **fields), True
+            result = self.update_run(uuid, **fields), True
+        # observers run OUTSIDE the lock (they may read the store) and only
+        # for transitions that actually happened — hooks keyed off rejected
+        # late reports (a killed process's 'failed' after 'stopped') never
+        # fire with the wrong status
+        for listener in self._transition_listeners:
+            try:
+                listener(uuid, dst.value)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+        return result
+
+    def add_transition_listener(self, fn) -> None:
+        """Register ``fn(uuid, new_status)`` called after every applied
+        transition (any writer: agent, executor callbacks, API clients)."""
+        self._transition_listeners.append(fn)
+
+    def find_cached_run(self, project: str, cache_key: str) -> Optional[dict]:
+        """Most recent succeeded run in ``project`` whose meta.cache_key
+        matches — SQL-side so the lookup is one row, not a page scan."""
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                f"SELECT {','.join(self._RUN_COLS)} FROM runs "
+                "WHERE project=? AND status='succeeded' "
+                "AND json_extract(meta, '$.cache_key')=? "
+                "ORDER BY created_at DESC LIMIT 1",
+                (project, cache_key),
+            ).fetchone()
+        return self._row_to_run(row) if row else None
 
     def get_statuses(self, uuid: str) -> list[dict]:
         with self._conn_ctx() as conn:

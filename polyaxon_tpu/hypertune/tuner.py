@@ -234,8 +234,10 @@ class Tuner:
                         del inflight[slot]
                         free.append(slot)
                         metric = self._trial_metric(run) if run else None
-                        ok = run is not None and \
-                            run["status"] == V1Statuses.SUCCEEDED.value
+                        ok = run is not None and run["status"] in (
+                            V1Statuses.SUCCEEDED.value,
+                            V1Statuses.SKIPPED.value,  # cache hit, outputs reused
+                        )
                         if not ok:
                             metric = None
                             failures += 1

@@ -95,6 +95,10 @@ class LocalAgent:
         self._wake = threading.Event()  # set by the watch thread
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        # hooks fire off applied store transitions (any writer, any path:
+        # executor callbacks, stops, compile failures, pipelines, cache
+        # skips) — never off rejected late reports
+        store.add_transition_listener(self._on_transition_applied)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -137,6 +141,59 @@ class LocalAgent:
             if self.reconciler is not None and self.reconciler.is_tracked(run_uuid):
                 self._scrape_pod_logs(run_uuid)
                 self._sync_to_store(run_uuid)
+
+    def _on_transition_applied(self, run_uuid: str, status: str) -> None:
+        if is_done(status):
+            self._fire_hooks(run_uuid, status)
+
+    def _fire_hooks(self, run_uuid: str, status: str) -> None:
+        """Post-run hooks (upstream V1Hook): webhook/slack connections get
+        a POST with the run summary when the trigger matches. Fire-and-
+        forget threads — a slow endpoint must not stall the agent."""
+        run = self.store.get_run(run_uuid)
+        if not run:
+            return
+        hooks = ((run.get("compiled") or {}).get("hooks")
+                 or (run.get("spec") or {}).get("hooks") or [])
+        for hook in hooks:
+            trigger = hook.get("trigger") or "done"
+            if trigger != "done" and trigger != status:
+                continue
+            conn = self.connections.get(hook.get("connection") or "")
+            if conn is None or conn.kind not in ("webhook", "slack"):
+                continue
+            s = conn.schema_
+            url = (s.get("url") if isinstance(s, dict)
+                   else getattr(s, "url", None)) or ""
+            if not url:
+                continue
+            payload = {
+                "uuid": run_uuid,
+                "name": run.get("name"),
+                "project": run.get("project"),
+                "status": status,
+                "outputs": run.get("outputs"),
+            }
+            if conn.kind == "slack":
+                payload = {"text": f"run {run.get('name') or run_uuid} "
+                                   f"finished: {status}"}
+            threading.Thread(
+                target=self._post_hook, args=(url, payload), daemon=True,
+            ).start()
+
+    @staticmethod
+    def _post_hook(url: str, payload: dict) -> None:
+        import json as _json
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                url, data=_json.dumps(payload).encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=10).read()
+        except Exception:
+            traceback.print_exc()
 
     def _scrape_pod_logs(self, run_uuid: str) -> None:
         """Copy pod logs into the run's logs/ dir so `ops logs` shows them
@@ -241,6 +298,9 @@ class LocalAgent:
                 api_token=self.api_token,
                 connections=self.connections,
             )
+            hit = self._cache_lookup(run, resolved)
+            if hit is not None:
+                return
             self.store.update_run(
                 uuid,
                 compiled=resolved.compiled.to_dict(),
@@ -267,6 +327,50 @@ class LocalAgent:
                 {**r, "kind": "tpujob"}).get_slice().num_chips, 1)
         except Exception:
             return 1
+
+    def _cache_lookup(self, run: dict, resolved) -> Optional[dict]:
+        """Run-result caching (upstream V1Cache): a run whose `cache:` is
+        active and whose compiled spec hash matches a previous succeeded
+        run is SKIPPED with the original's outputs instead of executing.
+        Returns the hit row, or None to execute normally (the computed key
+        is stamped into meta either way so future runs can hit this one)."""
+        import hashlib
+        import json as _json
+        from datetime import datetime, timezone
+
+        cache_cfg = getattr(resolved.compiled, "cache", None)
+        if cache_cfg is None or cache_cfg.disable:
+            return None
+        payload = resolved.compiled.to_dict()
+        # only execution-semantic content keys the cache: editing the cache
+        # policy itself, names, or docs must not bust it. (V1Cache io/
+        # sections narrowing of the key is not applied yet — ignoring it
+        # only loses hits, never fabricates them.)
+        for vol in ("name", "description", "tags", "cache", "hooks"):
+            payload.pop(vol, None)
+        key = hashlib.sha256(
+            _json.dumps(payload, sort_keys=True).encode()).hexdigest()
+        uuid = run["uuid"]
+        meta = dict(run.get("meta") or {})
+        meta["cache_key"] = key
+        hit = self.store.find_cached_run(run["project"], key)
+        if hit is not None and hit["uuid"] == uuid:
+            hit = None
+        if hit is not None and cache_cfg.ttl:
+            age = (datetime.now(timezone.utc)
+                   - datetime.fromisoformat(hit["created_at"])).total_seconds()
+            if age > cache_cfg.ttl:
+                hit = None
+        if hit is None:
+            self.store.update_run(uuid, meta=meta)
+            return None
+        meta["cached_from"] = hit["uuid"]
+        self.store.update_run(uuid, meta=meta, outputs=hit.get("outputs"))
+        self.store.transition(
+            uuid, V1Statuses.SKIPPED.value,
+            message=f"cache hit: reusing outputs of run {hit['uuid']}",
+        )
+        return hit
 
     def _maybe_schedule(self, run: dict) -> None:
         uuid = run["uuid"]

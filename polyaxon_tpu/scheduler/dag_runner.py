@@ -124,7 +124,9 @@ class DagRunner:
                 row = self.store.get_run(uuid)
                 if row is None or is_done(row["status"]):
                     del running[key]
-                    if row is not None and row["status"] == V1Statuses.SUCCEEDED.value:
+                    ok_statuses = (V1Statuses.SUCCEEDED.value,
+                                   V1Statuses.SKIPPED.value)  # cache hit
+                    if row is not None and row["status"] in ok_statuses:
                         results[key] = row
                     else:
                         failed.append(key)

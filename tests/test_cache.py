@@ -1,0 +1,148 @@
+"""Run-result caching (upstream V1Cache — SURVEY.md §2 polyflow lifecycle):
+identical cached specs skip execution and reuse outputs; disable/ttl/param
+changes bust the cache."""
+
+import sys
+import time
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.scheduler.agent import LocalAgent
+
+
+def _spec(x=1, cache=None):
+    op = {
+        "kind": "operation",
+        "name": "c",
+        "params": {"x": {"value": x}},
+        "component": {
+            "kind": "component",
+            "inputs": [{"name": "x", "type": "int"}],
+            "run": {"kind": "job", "container": {
+                "command": [sys.executable, "-c",
+                            "import json, os; json.dump({'y': 42}, "
+                            "open(os.path.join(os.environ['PLX_ARTIFACTS_PATH'],"
+                            "'outputs.json'), 'w'))"]}},
+        },
+    }
+    if cache is not None:
+        op["cache"] = cache
+    return check_polyaxonfile(op).to_dict()
+
+
+def _run(store, agent, spec):
+    row = store.create_run("p", spec=spec, name="c")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        agent.tick()
+        cur = store.get_run(row["uuid"])
+        if cur["status"] in ("succeeded", "failed", "stopped", "skipped"):
+            return cur
+        time.sleep(0.05)
+    raise TimeoutError(store.get_statuses(row["uuid"]))
+
+
+class TestRunCache:
+    def test_hit_skips_and_reuses_outputs(self, tmp_path):
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        try:
+            first = _run(store, agent, _spec(cache={}))
+            assert first["status"] == "succeeded"
+            assert first["outputs"]["y"] == 42
+            second = _run(store, agent, _spec(cache={}))
+            assert second["status"] == "skipped", second["status"]
+            assert second["outputs"]["y"] == 42
+            assert second["meta"]["cached_from"] == first["uuid"]
+        finally:
+            agent.stop()
+
+    def test_param_change_misses(self, tmp_path):
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        try:
+            assert _run(store, agent, _spec(1, cache={}))["status"] == "succeeded"
+            other = _run(store, agent, _spec(2, cache={}))
+            assert other["status"] == "succeeded"  # executed, not skipped
+        finally:
+            agent.stop()
+
+    def test_no_cache_section_always_executes(self, tmp_path):
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        try:
+            assert _run(store, agent, _spec())["status"] == "succeeded"
+            assert _run(store, agent, _spec())["status"] == "succeeded"
+        finally:
+            agent.stop()
+
+    def test_disable_busts(self, tmp_path):
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        try:
+            assert _run(store, agent, _spec(cache={}))["status"] == "succeeded"
+            again = _run(store, agent, _spec(cache={"disable": True}))
+            assert again["status"] == "succeeded"
+        finally:
+            agent.stop()
+
+
+class TestCacheInPipelines:
+    def test_cache_hit_inside_dag_succeeds(self, tmp_path):
+        """A SKIPPED (cache-hit) op inside a DAG must count as success and
+        feed its reused outputs downstream (review r3 finding)."""
+        import time as _t
+
+        from polyaxon_tpu.polyaxonfile import check_polyaxonfile as chk
+
+        def dag_spec():
+            return chk({
+                "kind": "operation",
+                "name": "pipe",
+                "component": {
+                    "kind": "component",
+                    "run": {
+                        "kind": "dag",
+                        "operations": [
+                            {"kind": "operation", "name": "a",
+                             "cache": {},
+                             "component": {
+                                 "kind": "component",
+                                 "run": {"kind": "job", "container": {
+                                     "command": [sys.executable, "-c",
+                                                 "import json, os; json.dump({'v': 5}, "
+                                                 "open(os.path.join(os.environ['PLX_ARTIFACTS_PATH'],"
+                                                 "'outputs.json'), 'w'))"]}},
+                             }},
+                            {"kind": "operation", "name": "b",
+                             "component": {
+                                 "kind": "component",
+                                 "inputs": [{"name": "v", "type": "int"}],
+                                 "run": {"kind": "job", "container": {
+                                     "command": [sys.executable, "-c", "print('b')"]}},
+                             },
+                             "params": {"v": {"ref": "ops.a", "value": "outputs.v"}}},
+                        ],
+                    },
+                },
+            }).to_dict()
+
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        agent.start()
+        try:
+            p1 = store.create_run("p", spec=dag_spec(), name="pipe1")
+            agent.wait_all(timeout=120)
+            assert store.get_run(p1["uuid"])["status"] == "succeeded"
+            # second pipeline: op `a` should cache-hit (SKIPPED) and the
+            # DAG must still complete with b consuming a's reused output
+            p2 = store.create_run("p", spec=dag_spec(), name="pipe2")
+            agent.wait_all(timeout=120)
+            final = store.get_run(p2["uuid"])
+            assert final["status"] == "succeeded", store.get_statuses(p2["uuid"])
+            kids = {r["meta"]["dag_op"]: r
+                    for r in store.list_runs(pipeline_uuid=p2["uuid"])}
+            assert kids["a"]["status"] == "skipped", kids["a"]["status"]
+            assert kids["b"]["status"] == "succeeded"
+        finally:
+            agent.stop()

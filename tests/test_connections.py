@@ -100,3 +100,67 @@ class TestConnections:
             assert str(tmp_path / "data") in text
         finally:
             agent.stop()
+
+
+class TestHooks:
+    def test_webhook_fires_on_done(self, tmp_path):
+        """A run with a webhook hook POSTs its summary to the connection's
+        url when it finishes (upstream V1Hook)."""
+        import json
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        received = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                received.append(json.loads(body))
+                self.send_response(200)
+                self.end_headers()
+
+        srv = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            acfg = V1AgentConfig.from_dict({
+                "connections": [{
+                    "name": "notify", "kind": "webhook",
+                    "schema": {"url": f"http://127.0.0.1:{srv.server_port}/h"},
+                }],
+            })
+            spec = check_polyaxonfile({
+                "kind": "operation",
+                "name": "hooked",
+                "hooks": [{"connection": "notify", "trigger": "succeeded"}],
+                "component": {
+                    "kind": "component",
+                    "run": {"kind": "job", "container": {
+                        "command": [sys.executable, "-c", "print('ok')"]}},
+                },
+            }).to_dict()
+            store = Store(":memory:")
+            agent = LocalAgent(store, artifacts_root=str(tmp_path),
+                               poll_interval=0.05,
+                               connections=acfg.connection_map())
+            uuid = store.create_run("p", spec=spec, name="hooked")["uuid"]
+            deadline = time.monotonic() + 60
+            try:
+                while time.monotonic() < deadline:
+                    agent.tick()
+                    if store.get_run(uuid)["status"] in ("succeeded", "failed"):
+                        break
+                    time.sleep(0.05)
+                assert store.get_run(uuid)["status"] == "succeeded"
+                for _ in range(100):
+                    if received:
+                        break
+                    time.sleep(0.1)
+                assert received and received[0]["uuid"] == uuid
+                assert received[0]["status"] == "succeeded"
+            finally:
+                agent.stop()
+        finally:
+            srv.shutdown()
