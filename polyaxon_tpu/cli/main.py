@@ -281,6 +281,49 @@ def ops_metrics(uuid, project, host, names):
     click.echo(json.dumps(data, indent=2))
 
 
+@ops.command("artifacts")
+@click.argument("uuid")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+@click.option("--path", default="", help="subpath to list, or file to download")
+@click.option("--dest", default=None, type=click.Path(),
+              help="download PATH to this local file")
+def ops_artifacts(uuid, project, host, path, dest):
+    """Browse or download a run's artifacts."""
+    rc, local = _ops_client(host, project)
+    if rc:
+        if dest:
+            rc.download_artifact(path, dest, uuid=uuid)
+            click.echo(dest)
+            return
+        tree = rc.artifacts_tree(path, uuid=uuid)
+        for d in tree.get("dirs", []):
+            click.echo(f"{d}/")
+        for f in tree.get("files", []):
+            click.echo(f)
+        return
+    store, project = local
+    run = store.get_run(uuid)
+    if not run:
+        raise click.ClickException("run not found")
+    root = os.path.realpath(os.path.join(".plx", "artifacts", run["project"], uuid))
+    target = os.path.realpath(os.path.join(root, path)) if path else root
+    if not target.startswith(root):
+        raise click.ClickException("path escapes the run's artifacts")
+    if dest:
+        import shutil
+
+        shutil.copyfile(target, dest)
+        click.echo(dest)
+        return
+    if os.path.isdir(target):
+        for name in sorted(os.listdir(target)):
+            suffix = "/" if os.path.isdir(os.path.join(target, name)) else ""
+            click.echo(name + suffix)
+    else:
+        click.echo(target)
+
+
 @ops.command("stop")
 @click.argument("uuid")
 @click.option("--project", "-p", default=None)

@@ -147,6 +147,10 @@ def check_polyaxonfile(
                 resource = getattr(op.matrix, "resource", None)
                 if resource is not None:
                     matrix_params.add(resource.name)
+            # join params bind at compile time (agent queries the store),
+            # so like matrix params they count as provided here
+            for join in op.joins or []:
+                matrix_params.update((join.params or {}).keys())
             validate_params_against_io(
                 op.component.inputs, op.component.outputs, op.params,
                 matrix_params=matrix_params,

@@ -289,6 +289,11 @@ class LocalAgent:
                 # pipeline record; children compile individually
                 self.store.transition(uuid, V1Statuses.COMPILED.value)
                 return
+            if spec.get("joins"):
+                from .joins import materialize_joins
+
+                spec = materialize_joins(self.store, run["project"], spec,
+                                         artifacts_root=self.artifacts_root)
             resolved = resolve(
                 spec,
                 run_uuid=uuid,

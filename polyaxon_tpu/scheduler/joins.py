@@ -1,0 +1,101 @@
+"""V1Join materialization (upstream joins — SURVEY.md §3c "tuner V1Joins
+child metrics"): an operation's ``joins`` section queries finished runs and
+binds each join param to the LIST of values extracted from them, before the
+operation compiles.
+
+Query mini-language (comma-separated ``field:value`` terms, all must match):
+    status:succeeded    pipeline:<uuid>     kind:trial
+    name:<prefix>*      tag:<tag>           project:<name> (default: own)
+Sort: ``created_at`` / ``-created_at`` / ``outputs.<m>`` / ``-outputs.<m>``.
+Extraction exprs per param: ``uuid``, ``outputs.<k>``, ``inputs.<k>``,
+``artifacts_path``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def _match(run: dict, field: str, value: str) -> bool:
+    if field == "status":
+        return run.get("status") == value
+    if field == "pipeline":
+        return run.get("pipeline_uuid") == value
+    if field == "kind":
+        return run.get("kind") == value
+    if field == "name":
+        name = run.get("name") or ""
+        return name.startswith(value[:-1]) if value.endswith("*") else name == value
+    if field == "tag":
+        return value in (run.get("tags") or [])
+    raise ValueError(f"unknown join query field {field!r}")
+
+
+def _sort_key(run: dict, sort: str):
+    field = sort.lstrip("-")
+    if field == "created_at":
+        return run.get("created_at") or ""
+    if field.startswith("outputs."):
+        v = (run.get("outputs") or {}).get(field.split(".", 1)[1])
+        return v if isinstance(v, (int, float)) else float("inf")
+    raise ValueError(f"unknown join sort {sort!r}")
+
+
+def _extract(run: dict, expr: Optional[str], artifacts_root: str) -> Any:
+    if expr in (None, "uuid"):
+        return run["uuid"]
+    if expr == "artifacts_path":
+        import os
+
+        return os.path.join(artifacts_root, run["project"], run["uuid"])
+    if expr.startswith("outputs."):
+        return (run.get("outputs") or {}).get(expr.split(".", 1)[1])
+    if expr.startswith("inputs."):
+        return (run.get("inputs") or {}).get(expr.split(".", 1)[1])
+    raise ValueError(f"unknown join value expr {expr!r}")
+
+
+def query_runs(store, project: str, join: dict) -> list[dict]:
+    terms = []
+    for term in (join.get("query") or "").split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if ":" not in term:
+            raise ValueError(f"join query term {term!r} is not field:value")
+        f, v = term.split(":", 1)
+        terms.append((f.strip(), v.strip()))
+    proj = dict(terms).get("project", project)
+    rows = [
+        r for r in store.list_runs(project=proj, limit=1000)
+        if all(_match(r, f, v) for f, v in terms if f != "project")
+    ]
+    sort = join.get("sort")
+    if sort:
+        rows.sort(key=lambda r: _sort_key(r, sort), reverse=sort.startswith("-"))
+    offset = int(join.get("offset") or 0)
+    limit = join.get("limit")
+    rows = rows[offset:]
+    if limit:
+        rows = rows[: int(limit)]
+    return rows
+
+
+def materialize_joins(store, project: str, spec: dict,
+                      artifacts_root: str = "") -> dict:
+    """Returns a spec with ``joins`` replaced by bound list params."""
+    joins = spec.get("joins") or []
+    if not joins:
+        return spec
+    params = dict(spec.get("params") or {})
+    for join in joins:
+        rows = query_runs(store, project, join)
+        for pname, p in (join.get("params") or {}).items():
+            expr = p.get("value") if isinstance(p, dict) else None
+            params[pname] = {
+                "value": [_extract(r, expr, artifacts_root) for r in rows]
+            }
+    out = dict(spec)
+    out["params"] = params
+    out.pop("joins", None)
+    return out

@@ -1,0 +1,114 @@
+"""V1Join materialization (upstream joins): an operation's joins query
+finished runs and bind list params before compilation."""
+
+import sys
+import time
+
+import pytest
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.scheduler.agent import LocalAgent
+from polyaxon_tpu.scheduler.joins import materialize_joins, query_runs
+
+
+class TestJoinQueries:
+    def _store(self):
+        store = Store(":memory:")
+        for i, (st, loss) in enumerate([("succeeded", 3.0), ("succeeded", 1.0),
+                                        ("failed", None), ("succeeded", 2.0)]):
+            row = store.create_run("p", spec={}, name=f"r{i}",
+                                   meta={}, inputs={"i": i})
+            for s in ("compiled", "queued", "scheduled", "running"):
+                store.transition(row["uuid"], s)
+            store.transition(row["uuid"], st)
+            if loss is not None:
+                store.merge_outputs(row["uuid"], {"loss": loss})
+        return store
+
+    def test_query_filter_sort_limit(self):
+        store = self._store()
+        rows = query_runs(store, "p", {
+            "query": "status:succeeded", "sort": "outputs.loss", "limit": 2,
+        })
+        assert [r["outputs"]["loss"] for r in rows] == [1.0, 2.0]
+
+    def test_materialize_binds_lists(self):
+        store = self._store()
+        spec = {
+            "kind": "operation",
+            "joins": [{
+                "query": "status:succeeded",
+                "sort": "outputs.loss",
+                "params": {"losses": {"value": "outputs.loss"},
+                           "uuids": {"value": "uuid"}},
+            }],
+            "component": {"kind": "component"},
+        }
+        out = materialize_joins(store, "p", spec)
+        assert out["params"]["losses"]["value"] == [1.0, 2.0, 3.0]
+        assert len(out["params"]["uuids"]["value"]) == 3
+        assert "joins" not in out
+
+    def test_bad_query_term(self):
+        with pytest.raises(ValueError, match="field:value"):
+            query_runs(self._store(), "p", {"query": "nonsense"})
+
+
+class TestJoinE2E:
+    def test_join_feeds_aggregation_run(self, tmp_path):
+        """Producer runs emit metrics; a join run receives all their losses
+        as one list param (the upstream tuner-join pattern, SURVEY.md §3c)."""
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+
+        def _producer(loss):
+            return check_polyaxonfile({
+                "kind": "operation", "name": f"prod-{loss}",
+                "component": {"kind": "component", "run": {
+                    "kind": "job", "container": {"command": [
+                        sys.executable, "-c",
+                        f"import json, os; json.dump({{'loss': {loss}}}, "
+                        "open(os.path.join(os.environ['PLX_ARTIFACTS_PATH'],"
+                        "'outputs.json'), 'w'))"]}},
+                },
+            }).to_dict()
+
+        def _wait(uuid, timeout=60):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                agent.tick()
+                cur = store.get_run(uuid)
+                if cur["status"] in ("succeeded", "failed", "stopped", "skipped"):
+                    return cur
+                time.sleep(0.05)
+            raise TimeoutError(store.get_statuses(uuid))
+
+        try:
+            for loss in (0.5, 0.25):
+                assert _wait(store.create_run(
+                    "p", spec=_producer(loss), name="x")["uuid"])["status"] == "succeeded"
+            agg = check_polyaxonfile({
+                "kind": "operation", "name": "agg",
+                "joins": [{
+                    "query": "status:succeeded",
+                    "sort": "outputs.loss",
+                    "params": {"losses": {"value": "outputs.loss"}},
+                }],
+                "component": {
+                    "kind": "component",
+                    "inputs": [{"name": "losses", "type": "list"}],
+                    "run": {"kind": "job", "container": {"command": [
+                        sys.executable, "-c",
+                        "import json, os; losses = json.loads("
+                        "os.environ['PLX_PARAMS'])['losses']; "
+                        "json.dump({'best': min(losses)}, "
+                        "open(os.path.join(os.environ['PLX_ARTIFACTS_PATH'],"
+                        "'outputs.json'), 'w'))"]}},
+                },
+            }).to_dict()
+            final = _wait(store.create_run("p", spec=agg, name="agg")["uuid"])
+            assert final["status"] == "succeeded", store.get_statuses(final["uuid"])
+            assert final["outputs"]["best"] == 0.25
+        finally:
+            agent.stop()
