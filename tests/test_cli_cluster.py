@@ -65,3 +65,42 @@ class TestCliAutoBackend:
         # create pods in it
         pods = [f for f in os.listdir(cluster_dir)] if os.path.isdir(cluster_dir) else []
         assert not [f for f in pods if f.endswith(".log")], pods
+
+
+class TestOpsArtifacts:
+    def test_browse_and_download_local(self, tmp_path, monkeypatch):
+        data_dir = str(tmp_path / "plx")
+        spec = tmp_path / "job.yaml"
+        spec.write_text(
+            "version: 1.1\n"
+            "kind: component\n"
+            "name: arts\n"
+            "run:\n"
+            "  kind: job\n"
+            "  container:\n"
+            "    command: [python, -c, \"import os; open(os.path.join("
+            "os.environ['PLX_ARTIFACTS_PATH'], 'model.bin'), 'w').write('W')\"]\n"
+        )
+        runner = CliRunner()
+        result = runner.invoke(
+            cli, ["run", "-f", str(spec), "--data-dir", data_dir],
+            catch_exceptions=False,
+        )
+        assert result.exit_code == 0, result.output
+        # local-mode ops commands read ./.plx relative to the cwd
+        monkeypatch.chdir(tmp_path)
+        os.rename(data_dir, str(tmp_path / ".plx"))
+        ls = runner.invoke(cli, ["ops", "ls"], catch_exceptions=False)
+        uuid = ls.output.split()[0]
+        tree = runner.invoke(cli, ["ops", "artifacts", uuid],
+                             catch_exceptions=False)
+        assert "model.bin" in tree.output, tree.output
+        dest = str(tmp_path / "out.bin")
+        dl = runner.invoke(cli, ["ops", "artifacts", uuid, "--path",
+                                 "model.bin", "--dest", dest],
+                           catch_exceptions=False)
+        assert dl.exit_code == 0, dl.output
+        assert open(dest).read() == "W"
+        # escape attempt is rejected
+        esc = runner.invoke(cli, ["ops", "artifacts", uuid, "--path", "../.."])
+        assert esc.exit_code != 0
