@@ -113,6 +113,32 @@ class OperationReconciler:
         if concurrent_delete:
             self.cluster.delete_selected(op.label_selector)
 
+    def adopt(self, op: OperationCR, elapsed_s: float = 0.0,
+              retries_done: int = 0) -> bool:
+        """Re-track an operation whose pods may already exist (agent
+        restart recovery): if any pods match the selector, track WITHOUT
+        re-applying — the next reconcile pass observes them as usual; if
+        none exist (cluster lost them too), fall back to a fresh apply.
+
+        ``elapsed_s`` backdates the deadline clock (the run's wall time so
+        far, from the store's started_at) and ``retries_done`` restores the
+        backoff budget already burned — otherwise every agent restart would
+        reset activeDeadlineSeconds/backoff_limit to zero.
+        Returns True when existing pods were adopted."""
+        existing = self.cluster.pod_statuses(op.label_selector)
+        if not existing:
+            self.apply(op)
+            return False
+        with self._lock:
+            if op.run_uuid in self._ops:
+                raise ValueError(f"operation {op.run_uuid} already tracked")
+            state = _OpState(op=op)
+            state.applied_at = time.monotonic() - max(elapsed_s, 0.0)
+            state.applying = False
+            state.retries_done = int(retries_done)
+            self._ops[op.run_uuid] = state
+        return True
+
     def delete(self, run_uuid: str) -> None:
         """Stop tracking and tear down resources (stop / user delete)."""
         with self._lock:

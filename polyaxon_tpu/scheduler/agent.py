@@ -30,6 +30,24 @@ def _is_scheduled_spec(spec: dict) -> bool:
     return bool(spec.get("schedule"))
 
 
+def _is_pipeline_spec(spec: dict) -> bool:
+    """Specs driven by an in-agent thread instead of an executor/operator:
+    matrix sweeps, DAGs, schedules."""
+    return bool(spec.get("matrix")) or _is_dag_spec(spec) or _is_scheduled_spec(spec)
+
+
+def _list_runs_all(store, status: str) -> list[dict]:
+    """Paginate past list_runs' limit — recovery must see every run."""
+    out: list[dict] = []
+    offset = 0
+    while True:
+        page = store.list_runs(status=status, limit=500, offset=offset)
+        out += page
+        if len(page) < 500:
+            return out
+        offset += 500
+
+
 class LocalAgent:
     """Poll/compile/schedule loop with kind-aware execution backends:
 
@@ -103,6 +121,7 @@ class LocalAgent:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "LocalAgent":
+        self.recover_orphans()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         if self.reconciler is not None and hasattr(self.cluster, "watch_pods"):
@@ -130,6 +149,79 @@ class LocalAgent:
                 ex.stop()
         if self.reconciler is not None and hasattr(self.cluster, "shutdown"):
             self.cluster.shutdown()
+
+    def recover_orphans(self) -> None:
+        """Re-attach runs left in-flight by a previous agent process
+        (SURVEY.md §5 failure detection). Cluster-backend runs whose pods
+        still exist are adopted by the reconciler (no restart); pods gone =
+        re-applied fresh. Local-executor runs died with the old agent's
+        subprocesses — they fail loudly rather than hang in 'running'.
+        Pipelines (matrix/dag/schedule) lose their driver thread — failed
+        with a clear message; their finished children keep their results."""
+        inflight = []
+        for st in (V1Statuses.SCHEDULED.value, V1Statuses.STARTING.value,
+                   V1Statuses.RUNNING.value, V1Statuses.STOPPING.value):
+            inflight += _list_runs_all(self.store, st)
+        for run in inflight:
+            uuid = run["uuid"]
+            if uuid in self._active or uuid in self._tuners or (
+                    self.reconciler is not None and self.reconciler.is_tracked(uuid)):
+                continue
+            spec = run.get("spec") or {}
+            if run["status"] == V1Statuses.STOPPING.value:
+                # the previous agent died mid-stop: finish the teardown so
+                # cluster pods don't leak
+                if self.reconciler is not None:
+                    try:
+                        self.cluster.delete_selected(
+                            {"app.polyaxon.com/run": uuid})
+                    except Exception:
+                        traceback.print_exc()
+                self.store.transition(uuid, V1Statuses.STOPPED.value, force=True)
+                continue
+            if _is_pipeline_spec(spec):
+                self.store.transition(
+                    uuid, V1Statuses.FAILED.value, force=True,
+                    reason="AgentRestart",
+                    message="pipeline driver lost in agent restart",
+                )
+                continue
+            adopted = False
+            if self.reconciler is not None:
+                try:
+                    resolved = resolve(
+                        run["compiled"] or spec, run_uuid=uuid,
+                        project=run["project"],
+                        artifacts_path=run_artifacts_dir(
+                            self.artifacts_root, run["project"], uuid),
+                        api_host=self.api_host, api_token=self.api_token,
+                        connections=self.connections,
+                    )
+                    if self._use_cluster(resolved):
+                        elapsed = 0.0
+                        if run.get("started_at"):
+                            from datetime import datetime, timezone
+
+                            elapsed = max(
+                                (datetime.now(timezone.utc)
+                                 - datetime.fromisoformat(run["started_at"])
+                                 ).total_seconds(), 0.0)
+                        retries = sum(
+                            1 for c in self.store.get_statuses(uuid)
+                            if c.get("type") == V1Statuses.RETRYING.value)
+                        self.reconciler.adopt(
+                            self._operation_cr(uuid, resolved),
+                            elapsed_s=elapsed, retries_done=retries)
+                        adopted = True
+                except Exception:
+                    traceback.print_exc()
+            if not adopted and not (self.reconciler is not None
+                                    and self.reconciler.is_tracked(uuid)):
+                self.store.transition(
+                    uuid, V1Statuses.FAILED.value, force=True,
+                    reason="AgentRestart",
+                    message="orphaned by agent restart (local process lost)",
+                )
 
     def _on_status(self, run_uuid: str, status: str, message: Optional[str]) -> None:
         self.store.transition(run_uuid, status, message=message)
@@ -284,7 +376,7 @@ class LocalAgent:
             spec = run.get("spec")
             if not spec:
                 raise ValueError("run has no spec")
-            if spec.get("matrix") or _is_dag_spec(spec) or _is_scheduled_spec(spec):
+            if _is_pipeline_spec(spec):
                 # matrix/dag/schedule pipeline: the run itself becomes the
                 # pipeline record; children compile individually
                 self.store.transition(uuid, V1Statuses.COMPILED.value)
@@ -459,17 +551,21 @@ class LocalAgent:
 
         return resolved.compiled.get_run_kind() in V1RunKind.DISTRIBUTED
 
-    def _submit_to_cluster(self, uuid: str, resolved) -> None:
+    @staticmethod
+    def _operation_cr(uuid: str, resolved):
         from ..operator import OperationCR
 
         term = resolved.compiled.termination
-        self.reconciler.apply(OperationCR(
+        return OperationCR(
             run_uuid=uuid,
             resources=resolved.k8s_resources(),
             backoff_limit=(term.max_retries if term and term.max_retries else 0),
             active_deadline_s=(term.timeout if term and term.timeout else 0.0),
             ttl_s=(term.ttl if term and term.ttl is not None else -1.0),
-        ))
+        )
+
+    def _submit_to_cluster(self, uuid: str, resolved) -> None:
+        self.reconciler.apply(self._operation_cr(uuid, resolved))
 
     def _do_stop(self, run: dict) -> None:
         uuid = run["uuid"]

@@ -254,3 +254,115 @@ class TestWatchWake:
             assert status not in (None, "created"), status
         finally:
             agent.stop()
+
+
+class TestOrphanRecovery:
+    def test_cluster_run_adopted_across_agent_restart(self, tmp_path):
+        """An in-flight cluster run survives an agent restart: the new
+        agent's reconciler adopts the still-running pods and completes the
+        run without restarting it."""
+        import time as _t
+
+        from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+        spec = check_polyaxonfile({
+            "kind": "operation", "name": "longish",
+            "component": {"kind": "component", "run": {
+                "kind": "tpujob", "accelerator": "v5e", "topology": "1x1",
+                "container": {"command": [
+                    sys.executable, "-c", "import time; time.sleep(4); print('done')",
+                ]},
+            }},
+        }).to_dict()
+        store = Store(":memory:")
+        agent_a = LocalAgent(store, artifacts_root=str(tmp_path),
+                             backend="cluster", poll_interval=0.05)
+        uuid = store.create_run("p", spec=spec, name="l")["uuid"]
+        deadline = _t.monotonic() + 30
+        while _t.monotonic() < deadline:
+            agent_a.tick()
+            if store.get_run(uuid)["status"] == "running":
+                break
+            _t.sleep(0.05)
+        assert store.get_run(uuid)["status"] == "running"
+        pods_before = [p.name for p in agent_a.cluster.pod_statuses(
+            {"app.polyaxon.com/run": uuid})]
+        assert pods_before
+        # "restart": a fresh agent over the same store + cluster; the old
+        # one is simply abandoned (its reconciler state is lost)
+        agent_b = LocalAgent(store, artifacts_root=str(tmp_path),
+                             backend="cluster", cluster=agent_a.cluster,
+                             poll_interval=0.05)
+        agent_b.recover_orphans()
+        assert agent_b.reconciler.is_tracked(uuid)
+        # same pods — adopted, not re-applied
+        pods_after = [p.name for p in agent_b.cluster.pod_statuses(
+            {"app.polyaxon.com/run": uuid})]
+        assert pods_after == pods_before
+        deadline = _t.monotonic() + 60
+        status = None
+        while _t.monotonic() < deadline:
+            agent_b.tick()
+            status = store.get_run(uuid)["status"]
+            if status in ("succeeded", "failed", "stopped"):
+                break
+            _t.sleep(0.05)
+        try:
+            assert status == "succeeded", store.get_statuses(uuid)
+        finally:
+            agent_b.stop()
+
+    def test_local_run_orphan_fails_loudly(self, tmp_path):
+        store = Store(":memory:")
+        uuid = store.create_run("p", spec={
+            "kind": "operation",
+            "component": {"kind": "component", "run": {
+                "kind": "job", "container": {"command": ["true"]}}},
+        }, name="gone")["uuid"]
+        for st in ("compiled", "queued", "scheduled", "running"):
+            store.transition(uuid, st)
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        agent.recover_orphans()
+        row = store.get_run(uuid)
+        assert row["status"] == "failed"
+        assert "orphaned" in store.get_statuses(uuid)[-1]["message"]
+
+    def test_stopping_run_teardown_completes_after_restart(self, tmp_path):
+        """An agent dying mid-stop leaves a run 'stopping' with live pods;
+        the next agent finishes the teardown instead of leaking them."""
+        import time as _t
+
+        from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+        spec = check_polyaxonfile({
+            "kind": "operation", "name": "stuck",
+            "component": {"kind": "component", "run": {
+                "kind": "tpujob", "accelerator": "v5e", "topology": "1x1",
+                "container": {"command": [
+                    sys.executable, "-c", "import time; time.sleep(30)",
+                ]},
+            }},
+        }).to_dict()
+        store = Store(":memory:")
+        agent_a = LocalAgent(store, artifacts_root=str(tmp_path),
+                             backend="cluster", poll_interval=0.05)
+        uuid = store.create_run("p", spec=spec, name="s")["uuid"]
+        deadline = _t.monotonic() + 30
+        while _t.monotonic() < deadline:
+            agent_a.tick()
+            if store.get_run(uuid)["status"] == "running":
+                break
+            _t.sleep(0.05)
+        sel = {"app.polyaxon.com/run": uuid}
+        assert agent_a.cluster.pod_statuses(sel)
+        # user asked to stop, then the agent "died" before _do_stop ran
+        store.transition(uuid, "stopping")
+        agent_b = LocalAgent(store, artifacts_root=str(tmp_path),
+                             backend="cluster", cluster=agent_a.cluster,
+                             poll_interval=0.05)
+        agent_b.recover_orphans()
+        try:
+            assert store.get_run(uuid)["status"] == "stopped"
+            assert agent_b.cluster.pod_statuses(sel) == []
+        finally:
+            agent_b.stop()
