@@ -673,11 +673,14 @@ class LocalAgent:
 
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            busy = self.store.list_runs(status=V1Statuses.QUEUED.value) or \
-                self.store.list_runs(status=V1Statuses.CREATED.value) or \
-                self.store.list_runs(status=V1Statuses.RUNNING.value) or \
-                self.store.list_runs(status=V1Statuses.SCHEDULED.value) or \
-                self.store.list_runs(status=V1Statuses.STARTING.value)
+            busy = None
+            for st in (V1Statuses.CREATED, V1Statuses.COMPILED,
+                       V1Statuses.QUEUED, V1Statuses.SCHEDULED,
+                       V1Statuses.STARTING, V1Statuses.RUNNING,
+                       V1Statuses.STOPPING):
+                busy = self.store.list_runs(status=st.value)
+                if busy:
+                    break
             cluster_busy = self.reconciler is not None and self.reconciler.active_count() > 0
             if not busy and not self._active and not self._tuners and not cluster_busy:
                 return
