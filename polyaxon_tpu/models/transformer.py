@@ -287,11 +287,61 @@ def _sharded_attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh], in
     return _attn(q, k, v)
 
 
-def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret):
+@dataclass(frozen=True)
+class InnerAxes:
+    """Manual-collective mode for layer bodies running *inside* a shard_map
+    (the pipeline): GSPMD constraints don't reach in there, so when the mesh
+    has model/context axes the body psums its partial projections itself
+    (tp) and runs ring/Ulysses attention over the context axis (cp)."""
+
+    tp: bool = False
+    cp: bool = False
+
+
+def _inner_attention(q, k, v, cfg: TransformerConfig, inner: InnerAxes, interpret):
+    """Attention for a device-local shard inside the pipeline shard_map:
+    heads are already model-sharded; the context axis (if >1) runs ring or
+    Ulysses exactly like the non-pipelined shard_map path."""
+    if inner.cp:
+        k = repeat_kv(k, q.shape[1])
+        v = repeat_kv(v, q.shape[1])
+        if cfg.seq_parallel == "ring":
+            return ring_attention(
+                q, k, v, axis_name="context", causal=cfg.causal,
+                block_q=min(cfg.attn_block_q, q.shape[2]),
+                block_k=min(cfg.attn_block_k, k.shape[2]),
+                interpret=interpret,
+            )
+        return ulysses_attention(
+            q, k, v, axis_name="context", causal=cfg.causal,
+            impl=cfg.attn_impl, interpret=interpret,
+        )
+    return attention(
+        q, k, v, causal=cfg.causal, impl=cfg.attn_impl,
+        block_q=min(cfg.attn_block_q, q.shape[2]),
+        block_k=min(cfg.attn_block_k, k.shape[2]), interpret=interpret,
+    )
+
+
+def _save_flat(t, name):
+    """checkpoint_name a [b, n, s, d] tensor in merged [b, s, n*d] layout.
+
+    Saved residuals with a trailing head_dim < 128 pad 2x on the lane dim
+    (TPU tiling T(8,128)); merging heads makes the save lane-aligned. The
+    round-trip transposes are cheap relative to the HBM they free.
+    """
+    b, n, s, d = t.shape
+    tf = checkpoint_name(t.transpose(0, 2, 1, 3).reshape(b, s, n * d), name)
+    return tf.reshape(b, s, n, d).transpose(0, 2, 1, 3)
+
+
+def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret,
+                inner: Optional[InnerAxes] = None):
     b, s, h = x.shape
     nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
     ap, mp = lp["attn"], lp["mlp"]
     dt = cfg.dtype
+    tp = inner is not None and inner.tp
 
     y = _norm(x, lp["attn_norm"], cfg)
     q = jnp.einsum("bsh,hnd->bnsd", y, ap["wq"].astype(dt))
@@ -305,12 +355,22 @@ def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret):
         cos, sin = rope_tables
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-    q = checkpoint_name(q, "qkv")
-    k = checkpoint_name(k, "qkv")
-    v = checkpoint_name(v, "qkv")
-    o = _sharded_attention(q, k, v, cfg, mesh, interpret)
-    o = checkpoint_name(o, "attn_out")
-    o = jnp.einsum("bnsd,ndh->bsh", o, ap["wo"].astype(dt))
+    q = _save_flat(q, "qkv")
+    k = _save_flat(k, "qkv")
+    v = _save_flat(v, "qkv")
+    if inner is not None:
+        o = _inner_attention(q, k, v, cfg, inner, interpret)
+    else:
+        o = _sharded_attention(q, k, v, cfg, mesh, interpret)
+    # merge heads before the named save: [b, s, n*d] keeps the residual's
+    # last dim lane-aligned (head_dim 64 in [b,n,s,d] pads 2x to 128 lanes —
+    # a measured 700MB/layer-stack tax in the r4 seq-8192 OOM dumps)
+    o = checkpoint_name(
+        o.transpose(0, 2, 1, 3).reshape(b, s, -1), "attn_out"
+    )
+    o = jnp.einsum("bse,eh->bsh", o, ap["wo"].astype(dt).reshape(-1, h))
+    if tp:  # partial sum over the local head shard
+        o = jax.lax.psum(o, "model")
     if cfg.use_bias:
         o = o + ap["bo"].astype(dt)
     x = x + o
@@ -318,18 +378,22 @@ def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret):
     y = _norm(x, lp["mlp_norm"], cfg)
     if cfg.num_experts:
         out, aux = _moe_mlp(y, mp, cfg)
+        if tp:
+            out = jax.lax.psum(out, "model")
         return x + out, aux
     if cfg.act == "swiglu":
-        inner = swiglu(
+        hidden = swiglu(
             jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt)),
             jnp.einsum("bsh,hm->bsm", y, mp["wg"].astype(dt)),
         )
     else:
-        inner = jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt))
+        hidden = jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt))
         if cfg.use_bias:
-            inner = inner + mp["bi"].astype(dt)
-        inner = gelu(inner)
-    out = jnp.einsum("bsm,mh->bsh", inner, mp["wo"].astype(dt))
+            hidden = hidden + mp["bi"].astype(dt)
+        hidden = gelu(hidden)
+    out = jnp.einsum("bsm,mh->bsh", hidden, mp["wo"].astype(dt))
+    if tp:  # partial sum over the local mlp shard
+        out = jax.lax.psum(out, "model")
     if cfg.use_bias:
         out = out + mp["bo"].astype(dt)
     return x + out, jnp.zeros((), jnp.float32)
@@ -429,26 +493,39 @@ def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interp
     if mesh is not None and mesh.shape.get("stage", 1) > 1:
         from ..parallel.pipeline import gpipe_trunk
 
-        if cfg.num_experts:
-            raise NotImplementedError(
-                "MoE + pipeline parallelism: threading the router aux loss "
-                "through the GPipe schedule is not supported yet"
-            )
-        out = gpipe_trunk(
-            x, layer_params,
-            # inside the pipeline shard_map everything is device-local, so
-            # the per-stage body scans its layers with mesh=None attention
-            lambda xl, lp: _scan_layers(xl, lp, cfg, rope_tables, None, interpret)[0],
-            mesh,
-            num_microbatches=cfg.pp_microbatches,
-        )
-        return out, jnp.zeros((), jnp.float32)
+        inner = InnerAxes(
+            tp=mesh.shape["model"] > 1, cp=mesh.shape["context"] > 1)
+        # params enter the pipeline shard_map sharded over stage (layer dim)
+        # and model (TP dims); fsdp-sharded storage all-gathers at entry —
+        # the same gather FSDP pays anyway, hoisted once per step.
+        rules = ShardingRules().override(layers="stage", embed=None, vocab=None)
+        pspec = param_specs(cfg, rules)["layers"]
+
+        def pp_body(xl, lp):
+            tables = rope_tables
+            if inner.cp and tables is not None:
+                # each context shard rotates with its *global* positions
+                c = jax.lax.axis_index("context")
+                sl = xl.shape[1]
+                tables = tuple(
+                    jax.lax.dynamic_slice_in_dim(t, c * sl, sl, 0)
+                    for t in tables)
+            return _scan_layers(xl, lp, cfg, tables, None, interpret, inner=inner)
+
+        return gpipe_trunk(
+            x, layer_params, pp_body, mesh,
+            num_microbatches=cfg.pp_microbatches, param_spec=pspec,
+            # TP psums / ring ppermutes inside the body must run on every
+            # device every tick (collectives can't sit under a stage-gated
+            # cond); without them, bubble ticks are skipped entirely
+            gate_ticks=not (inner.tp or inner.cp))
     return _scan_layers(x, layer_params, cfg, rope_tables, mesh, interpret)
 
 
-def _scan_layers(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interpret):
+def _scan_layers(x, layer_params, cfg: TransformerConfig, rope_tables, mesh,
+                 interpret, inner: Optional[InnerAxes] = None):
     def body(x, lp):
-        new_x, aux = _layer_body(x, lp, cfg, rope_tables, mesh, interpret)
+        new_x, aux = _layer_body(x, lp, cfg, rope_tables, mesh, interpret, inner)
         return new_x, aux
     if cfg.remat == "full":
         body = jax.checkpoint(body, prevent_cse=False)

@@ -28,9 +28,10 @@ MESH_AXES: tuple[str, ...] = ("data", "fsdp", "stage", "expert", "context", "mod
 
 # Every axis has real execution support as of round 3 (VERDICT r1/r2
 # demanded loud rejection while any were unimplemented): ``stage`` via the
-# GPipe schedule in parallel/pipeline.py (which rejects unsupported
-# stage×model/context combos itself), ``expert`` via the MoE layer's
-# expert-sharded einsums (models/transformer.py _moe_mlp).
+# bubble-gated pipeline in parallel/pipeline.py (stage composes with
+# data/fsdp/model/context as of round 4; stage×expert is still rejected
+# there), ``expert`` via the MoE layer's expert-sharded einsums
+# (models/transformer.py _moe_mlp).
 
 
 def normalize_axis_sizes(parallelism: Union[Mapping[str, int], Any, None]) -> dict[str, int]:

@@ -116,6 +116,8 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
         checkpoint=ckpt,
         log_interval=int(spec.get("log_interval", 10)),
         grad_dtype=spec.get("grad_dtype"),
+        microbatches=int(spec.get("microbatches", 1)),
+        accum_dtype=spec.get("accum_dtype"),
     )
     track = None
     if run is not None:

@@ -440,11 +440,55 @@ class LocalAgent:
             return None
         payload = resolved.compiled.to_dict()
         # only execution-semantic content keys the cache: editing the cache
-        # policy itself, names, or docs must not bust it. (V1Cache io/
-        # sections narrowing of the key is not applied yet — ignoring it
-        # only loses hits, never fabricates them.)
+        # policy itself, names, or docs must not bust it.
         for vol in ("name", "description", "tags", "cache", "hooks"):
             payload.pop(vol, None)
+        # V1Cache narrowing (upstream semantics, SURVEY.md:99): `sections`
+        # limits which parts of the run section key the cache; `io` limits
+        # which input/output entries do. Unset = everything keys. Declared
+        # names are validated — a typo would otherwise silently narrow the
+        # key past the real params and FABRICATE hits (review r4 finding:
+        # a run with changed inputs reusing a stale run's outputs).
+        if cache_cfg.sections:
+            run_sec = payload.get("run") or {}
+            # validate against the run *schema* fields, not just the keys
+            # present in this serialization (exclude_none drops unset ones:
+            # an absent-but-valid section keys as None, it isn't a typo)
+            schema_keys = set(run_sec)
+            run_obj = getattr(resolved.compiled, "run", None)
+            for fname, f in getattr(type(run_obj), "model_fields", {}).items():
+                schema_keys.add(fname)
+                if getattr(f, "alias", None):
+                    schema_keys.add(f.alias)
+            unknown = set(cache_cfg.sections) - schema_keys
+            if unknown:
+                raise ValueError(
+                    f"cache.sections {sorted(unknown)} match no field of the "
+                    f"run section (has: {sorted(schema_keys)})"
+                )
+            payload["run"] = {s: run_sec.get(s) for s in sorted(cache_cfg.sections)}
+        if cache_cfg.io:
+            wanted = set(cache_cfg.io)
+            known = {
+                e.get("name")
+                for io_key in ("inputs", "outputs")
+                for e in (payload.get(io_key) or [])
+            } | set(payload.get("params") or {})
+            unknown = wanted - known
+            if unknown:
+                raise ValueError(
+                    f"cache.io names {sorted(unknown)} match no declared "
+                    f"input/output/param (has: {sorted(known)})"
+                )
+            for io_key in ("inputs", "outputs"):
+                payload[io_key] = [
+                    e for e in (payload.get(io_key) or [])
+                    if e.get("name") in wanted
+                ]
+            payload["params"] = {
+                n: v for n, v in (payload.get("params") or {}).items()
+                if n in wanted
+            }
         key = hashlib.sha256(
             _json.dumps(payload, sort_keys=True).encode()).hexdigest()
         uuid = run["uuid"]

@@ -117,6 +117,21 @@ class Trainer:
 
     # -- init / restore ----------------------------------------------------
 
+    def abstract_state(self) -> Any:
+        """ShapeDtypeStruct pytree of the TrainState with shardings attached
+        — feeds AOT compilation (``make_step().lower(...)``) of configs too
+        big to materialize (the 7B dryrun phase)."""
+        def _init(key):
+            params, extra = self.task.init(key)
+            return TrainState.create(params, self.tx, extra=extra)
+
+        abstract = jax.eval_shape(_init, jax.random.PRNGKey(0))
+        shardings = self._state_shardings(abstract)
+        return jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract, shardings,
+        )
+
     def init_state(self, seed: int = 0) -> TrainState:
         def _init(key):
             params, extra = self.task.init(key)

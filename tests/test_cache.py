@@ -87,6 +87,102 @@ class TestRunCache:
             agent.stop()
 
 
+def _scoped_spec(x=1, z=1, env_label="a", cmd="print('ok')", cache=None):
+    """Two-input job with a tweakable environment label, for io/sections
+    key-scoping tests (VERDICT r3 missing #5)."""
+    op = {
+        "kind": "operation",
+        "name": "c",
+        "params": {"x": {"value": x}, "z": {"value": z}},
+        "component": {
+            "kind": "component",
+            "inputs": [{"name": "x", "type": "int"}, {"name": "z", "type": "int"}],
+            "run": {
+                "kind": "job",
+                "environment": {"labels": {"tier": env_label}},
+                "container": {"command": [sys.executable, "-c", cmd]},
+            },
+        },
+    }
+    if cache is not None:
+        op["cache"] = cache
+    return check_polyaxonfile(op).to_dict()
+
+
+class TestCacheKeyScoping:
+    """V1Cache io/sections narrow the cache key: differences outside the
+    declared scope share a key; differences inside never do."""
+
+    def test_io_scoped_key_ignores_undeclared_inputs(self, tmp_path):
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        cache = {"io": ["x"]}
+        try:
+            first = _run(store, agent, _scoped_spec(x=1, z=1, cache=cache))
+            assert first["status"] == "succeeded"
+            # z not in cache.io -> changing it must still hit
+            hit = _run(store, agent, _scoped_spec(x=1, z=2, cache=cache))
+            assert hit["status"] == "skipped", hit["status"]
+            assert hit["meta"]["cached_from"] == first["uuid"]
+            # x is in cache.io -> changing it must miss
+            miss = _run(store, agent, _scoped_spec(x=2, z=1, cache=cache))
+            assert miss["status"] == "succeeded"
+        finally:
+            agent.stop()
+
+    def test_typoed_io_name_fails_loudly(self, tmp_path):
+        """A cache.io name matching nothing must fail the run, not narrow
+        the key to nothing and fabricate hits (review r4 finding)."""
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        try:
+            bad = _run(store, agent, _scoped_spec(cache={"io": ["typo_name"]}))
+            assert bad["status"] == "failed", bad["status"]
+            msgs = " ".join(
+                c.get("message") or "" for c in store.get_statuses(bad["uuid"]))
+            assert "typo_name" in msgs, msgs
+        finally:
+            agent.stop()
+
+    def test_typoed_section_name_fails_loudly(self, tmp_path):
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        try:
+            bad = _run(store, agent, _scoped_spec(cache={"sections": ["contianer"]}))
+            assert bad["status"] == "failed", bad["status"]
+        finally:
+            agent.stop()
+
+    def test_absent_but_valid_section_is_not_a_typo(self, tmp_path):
+        """Declaring a schema-valid section the spec doesn't set (e.g. init)
+        must not fail the run — it keys as absent (review r4 finding)."""
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        try:
+            ok = _run(store, agent, _scoped_spec(
+                cache={"sections": ["container", "init"]}))
+            assert ok["status"] == "succeeded", ok["status"]
+        finally:
+            agent.stop()
+
+    def test_sections_scoped_key_ignores_undeclared_sections(self, tmp_path):
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path), poll_interval=0.05)
+        cache = {"sections": ["kind", "container"]}
+        try:
+            first = _run(store, agent, _scoped_spec(env_label="a", cache=cache))
+            assert first["status"] == "succeeded"
+            # environment is outside the declared sections -> still hits
+            hit = _run(store, agent, _scoped_spec(env_label="b", cache=cache))
+            assert hit["status"] == "skipped", hit["status"]
+            # container is declared -> changing the command must miss
+            miss = _run(store, agent, _scoped_spec(
+                env_label="a", cmd="print('changed')", cache=cache))
+            assert miss["status"] == "succeeded"
+        finally:
+            agent.stop()
+
+
 class TestCacheInPipelines:
     def test_cache_hit_inside_dag_succeeds(self, tmp_path):
         """A SKIPPED (cache-hit) op inside a DAG must count as success and

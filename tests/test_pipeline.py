@@ -30,10 +30,61 @@ class TestGpipeTrunk:
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                    rtol=2e-5, atol=2e-5)
 
-    def test_rejects_model_axis_combo(self):
-        mesh = build_mesh({"stage": 2, "model": 2, "data": 2})
-        with pytest.raises(NotImplementedError, match="model"):
+    def test_rejects_expert_axis_combo(self):
+        mesh = build_mesh({"stage": 2, "expert": 2, "data": 2})
+        with pytest.raises(NotImplementedError, match="expert"):
             validate_pipeline_mesh(mesh)
+
+    def test_trunk_matches_single_stage_with_tp(self):
+        """stage x model: TP inside pipeline stages (manual psums) matches
+        the plain trunk elementwise (VERDICT r3 #2 composability)."""
+        cfg = llama.LLAMA_TINY
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                    cfg.vocab_size)
+        mesh = build_mesh({"stage": 2, "model": 2, "data": 2})
+        ref = transformer.apply_hidden(params, tokens, cfg, mesh=None)
+        out = transformer.apply_hidden(params, tokens, cfg, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_trunk_matches_single_stage_with_cp(self):
+        """stage x context: ring attention inside pipeline stages, with
+        per-shard global RoPE positions, matches the plain trunk."""
+        cfg = llama.LLAMA_TINY
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                    cfg.vocab_size)
+        mesh = build_mesh({"stage": 2, "context": 2, "data": 2})
+        ref = transformer.apply_hidden(params, tokens, cfg, mesh=None)
+        out = transformer.apply_hidden(params, tokens, cfg, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_moe_trunk_matches_and_threads_aux(self):
+        """MoE + PP: dense-dispatch trunk matches single-stage elementwise
+        and the router aux loss survives the pipeline schedule."""
+        from dataclasses import replace as _replace
+
+        cfg = _replace(llama.LLAMA_MOE_TINY, moe_dispatch="dense")
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                    cfg.vocab_size)
+        ref, ref_aux = transformer.apply_hidden(
+            params, tokens, cfg, mesh=None, return_aux=True)
+        for axes, devs in (
+            ({"stage": 2, "data": 2}, 4),           # MoE x PP
+            ({"stage": 2, "model": 2, "data": 2}, 8),  # MoE x PP x TP
+        ):
+            mesh = build_mesh(axes, devices=jax.devices()[:devs])
+            out, aux = transformer.apply_hidden(
+                params, tokens, cfg, mesh=mesh, return_aux=True)
+            np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                       rtol=2e-5, atol=2e-5, err_msg=str(axes))
+            # aux is averaged per microbatch under PP vs over the full batch
+            # in one shot; same tokens, same router -> close, and never zero
+            assert float(aux) > 0.5, (axes, float(aux))
+            np.testing.assert_allclose(float(aux), float(ref_aux), rtol=0.2)
 
     def test_layers_must_divide(self):
         cfg = llama.LLAMA_TINY  # 2 layers
@@ -59,7 +110,8 @@ class TestPipelineTraining:
             batch_size=16, seq_len=32,
         )
         losses = {}
-        for name, par in (("dp", {"data": 8}), ("pp", {"stage": 2})):
+        for name, par in (("dp", {"data": 8}), ("pp", {"stage": 2}),
+                          ("pp_tp", {"stage": 2, "model": 2, "data": 2})):
             tr = Trainer(TrainerConfig(**base, parallelism=par))
             data = make_batches(DataConfig(kind="synthetic-lm", batch_size=16,
                                            seq_len=32, vocab_size=cfg.vocab_size,
@@ -67,6 +119,7 @@ class TestPipelineTraining:
             _, metrics = tr.fit(data, num_steps=3)
             losses[name] = metrics["loss"]
         assert abs(losses["dp"] - losses["pp"]) < 1e-4, losses
+        assert abs(losses["dp"] - losses["pp_tp"]) < 1e-4, losses
 
     def test_resnet_stage_rejected(self):
         from polyaxon_tpu.models import resnet
