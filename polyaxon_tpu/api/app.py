@@ -54,16 +54,29 @@ class ApiApp:
         self.store = store
         self.artifacts_root = os.path.abspath(artifacts_root)
         os.makedirs(self.artifacts_root, exist_ok=True)
-        # Token auth (SURVEY.md §2 API "RBAC(-lite)"): when a token is
-        # configured every endpoint except /healthz requires
-        # `Authorization: Bearer <token>`. No token = open (local dev).
+        # Token auth (SURVEY.md §2 API "RBAC(-lite)"): auth engages when a
+        # static admin token is configured OR the store holds minted tokens.
+        # The static token is the admin bootstrap; store tokens (POST
+        # /api/v1/tokens) are per-project capabilities — a scoped token
+        # reaching another project's endpoints gets 403, not data.
+        # No tokens anywhere = open (local dev).
         self.auth_token = auth_token if auth_token is not None \
             else os.environ.get("PLX_AUTH_TOKEN")
-        middlewares = [self._auth_middleware] if self.auth_token else []
-        self.app = web.Application(middlewares=middlewares)
+        self._tokens_seen = False
+        self.app = web.Application(middlewares=[self._auth_middleware])
         self._routes()
         # the scheduler (if attached in-process) watches this queue
         self.new_run_event = asyncio.Event()
+
+    def _auth_enabled(self) -> bool:
+        if self.auth_token:
+            return True
+        # sticky: once tokens exist auth stays on for this process (even if
+        # all are later revoked — fail closed), and the hot path stops
+        # paying a per-request DB probe
+        if not self._tokens_seen:
+            self._tokens_seen = self.store.has_tokens()
+        return self._tokens_seen
 
     @web.middleware
     async def _auth_middleware(self, request, handler):
@@ -71,10 +84,32 @@ class ApiApp:
         # client-side and sends it on its API calls
         if request.path in ("/healthz", "/", "/ui"):
             return await handler(request)
+        if not self._auth_enabled():
+            return await handler(request)
         header = request.headers.get("Authorization", "")
         token = header[7:] if header.startswith("Bearer ") else None
-        if token != self.auth_token:
+        if token is None:
             return _json({"error": "unauthorized"}, status=401)
+        if self.auth_token and token == self.auth_token:
+            return await handler(request)  # static admin token
+        row = self.store.resolve_token(token)
+        if row is None:
+            return _json({"error": "unauthorized"}, status=401)
+        if row["project"] is None:
+            return await handler(request)  # minted admin token
+        # project-scoped: only that project's routes; token admin and
+        # project creation stay admin-only
+        path_project = request.match_info.get("project")
+        if request.path.startswith("/api/v1/tokens") or (
+                path_project is None and request.path != "/api/v1/projects"):
+            return _json({"error": "forbidden"}, status=403)
+        if request.path == "/api/v1/projects":
+            if request.method != "GET":
+                return _json({"error": "forbidden"}, status=403)
+        elif path_project != row["project"]:
+            return _json({"error": "forbidden",
+                          "detail": f"token is scoped to project "
+                                    f"{row['project']!r}"}, status=403)
         return await handler(request)
 
     def run_dir(self, project: str, uuid: str) -> str:
@@ -87,6 +122,9 @@ class ApiApp:
         r.add_get("/ui", self.ui)
         r.add_get("/api/v1/projects", self.list_projects)
         r.add_post("/api/v1/projects", self.create_project)
+        r.add_post("/api/v1/tokens", self.create_token)
+        r.add_get("/api/v1/tokens", self.list_tokens)
+        r.add_delete("/api/v1/tokens/{token_id}", self.revoke_token)
         r.add_get("/api/v1/projects/{project}", self.get_project)
         r.add_post("/api/v1/{project}/runs", self.create_run)
         r.add_get("/api/v1/{project}/runs", self.list_runs)
@@ -117,6 +155,34 @@ class ApiApp:
 
     async def list_projects(self, request):
         return _json(self.store.list_projects())
+
+    async def create_token(self, request):
+        # minting over the network requires an authenticated caller: on an
+        # open server an anonymous first mint would flip auth ON with the
+        # attacker holding the only admin credential (review r4 finding).
+        # Bootstrap is the --auth-token flag or the local hostless CLI.
+        if not self._auth_enabled():
+            return _json(
+                {"error": "token minting needs auth bootstrap: start the "
+                          "server with --auth-token, or mint locally with "
+                          "`polyaxon_tpu token create` (no --host)"},
+                status=403)
+        body = await request.json() if request.can_read_body else {}
+        out = self.store.create_token(
+            project=body.get("project"), label=body.get("label"))
+        self._tokens_seen = True
+        return _json(out, 201)
+
+    async def list_tokens(self, request):
+        return _json(self.store.list_tokens())
+
+    async def revoke_token(self, request):
+        try:
+            tid = int(request.match_info["token_id"])
+        except ValueError:
+            return _not_found("token id must be an integer")
+        ok = self.store.revoke_token(tid)
+        return _json({"revoked": ok}) if ok else _not_found()
 
     async def create_project(self, request):
         body = await request.json()

@@ -57,6 +57,14 @@ CREATE TABLE IF NOT EXISTS lineage (
     artifact TEXT NOT NULL
 );
 CREATE INDEX IF NOT EXISTS idx_lineage_run ON lineage (run_uuid);
+CREATE TABLE IF NOT EXISTS tokens (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    token_hash TEXT NOT NULL UNIQUE,
+    project TEXT,
+    label TEXT,
+    created_at TEXT NOT NULL,
+    revoked INTEGER NOT NULL DEFAULT 0
+);
 """
 
 
@@ -137,6 +145,61 @@ class Store:
                 "SELECT name, description, created_at FROM projects ORDER BY name"
             ).fetchall()
         return [{"name": r[0], "description": r[1], "created_at": r[2]} for r in rows]
+
+    # -- tokens (RBAC-lite, SURVEY.md:104) ----------------------------------
+
+    @staticmethod
+    def _token_hash(raw: str) -> str:
+        import hashlib
+
+        return hashlib.sha256(raw.encode()).hexdigest()
+
+    def create_token(self, project: Optional[str] = None,
+                     label: Optional[str] = None) -> dict:
+        """Mint an access token. ``project=None`` = admin (all projects);
+        otherwise scoped to that one project. Only the sha256 lands in the
+        DB — the raw token is returned once and never recoverable."""
+        import secrets
+
+        raw = secrets.token_hex(24)
+        with self._conn_ctx() as conn:
+            cur = conn.execute(
+                "INSERT INTO tokens (token_hash, project, label, created_at) "
+                "VALUES (?,?,?,?)",
+                (self._token_hash(raw), project, label, _now()),
+            )
+            tid = cur.lastrowid
+        return {"id": tid, "token": raw, "project": project, "label": label}
+
+    def resolve_token(self, raw: str) -> Optional[dict]:
+        """{'id', 'project'} for a live token (project None = admin), or
+        None for unknown/revoked."""
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                "SELECT id, project FROM tokens WHERE token_hash=? AND revoked=0",
+                (self._token_hash(raw),),
+            ).fetchone()
+        return {"id": row[0], "project": row[1]} if row else None
+
+    def list_tokens(self) -> list[dict]:
+        with self._conn_ctx() as conn:
+            rows = conn.execute(
+                "SELECT id, project, label, created_at, revoked FROM tokens "
+                "ORDER BY id"
+            ).fetchall()
+        return [{"id": r[0], "project": r[1], "label": r[2],
+                 "created_at": r[3], "revoked": bool(r[4])} for r in rows]
+
+    def revoke_token(self, token_id: int) -> bool:
+        with self._conn_ctx() as conn:
+            cur = conn.execute(
+                "UPDATE tokens SET revoked=1 WHERE id=?", (token_id,))
+            return cur.rowcount > 0
+
+    def has_tokens(self) -> bool:
+        with self._conn_ctx() as conn:
+            return conn.execute(
+                "SELECT 1 FROM tokens WHERE revoked=0 LIMIT 1").fetchone() is not None
 
     # -- runs --------------------------------------------------------------
 

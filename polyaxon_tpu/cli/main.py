@@ -41,8 +41,17 @@ def get_host(explicit: Optional[str]) -> Optional[str]:
     return explicit or os.environ.get("PLX_API_HOST") or load_config().get("host")
 
 
-def get_token() -> Optional[str]:
-    return os.environ.get("PLX_AUTH_TOKEN") or load_config().get("token")
+def get_token(host: Optional[str] = None) -> Optional[str]:
+    """Env wins; then the per-host context (`config --host H --token T`);
+    then the global token."""
+    env = os.environ.get("PLX_AUTH_TOKEN")
+    if env:
+        return env
+    cfg = load_config()
+    ctx = (cfg.get("contexts") or {}).get(host or cfg.get("host") or "")
+    if ctx and ctx.get("token"):
+        return ctx["token"]
+    return cfg.get("token")
 
 
 def _local_stack(data_dir: str = ".plx", backend: str = "auto"):
@@ -114,7 +123,7 @@ def run(files, params, set_overrides, presets, project, name, host, local, watch
             )
         from ..client import RunClient
 
-        rc = RunClient(host, project=project, auth_token=get_token())
+        rc = RunClient(host, project=project, auth_token=get_token(host))
         run_data = rc.create(operation=op)
         click.echo(f"Run {run_data['uuid']} created ({run_data['status']})")
         if watch:
@@ -189,7 +198,7 @@ def _ops_client(host, project):
     if host:
         from ..client import RunClient
 
-        return RunClient(host, project=project, auth_token=get_token()), None
+        return RunClient(host, project=project, auth_token=get_token(host)), None
     from ..api.app import run_artifacts_dir
     from ..api.store import Store
 
@@ -380,7 +389,7 @@ def project_create(name, description, host):
     if h:
         from ..client import ProjectClient
 
-        ProjectClient(h, auth_token=get_token()).create(name, description)
+        ProjectClient(h, auth_token=get_token(h)).create(name, description)
     else:
         from ..api.store import Store
 
@@ -395,7 +404,7 @@ def project_ls(host):
     if h:
         from ..client import ProjectClient
 
-        rows = ProjectClient(h, auth_token=get_token()).list()
+        rows = ProjectClient(h, auth_token=get_token(h)).list()
     else:
         from ..api.store import Store
 
@@ -413,18 +422,79 @@ def project_ls(host):
 @click.option("--token", default=None, help="API auth token (or PLX_AUTH_TOKEN env)")
 @click.option("--show", is_flag=True)
 def config_cmd(host, project, token, show):
+    """Set defaults. `--host H --token T [--project P]` saves a per-host
+    context (project-scoped tokens, SURVEY.md:104); `--token` alone sets
+    the global fallback token."""
     cfg = load_config()
     if show or (host is None and project is None and token is None):
         click.echo(json.dumps(cfg, indent=2))
         return
+    if host is not None and (token is not None or project is not None):
+        ctx = cfg.setdefault("contexts", {}).setdefault(host, {})
+        if token is not None:
+            ctx["token"] = token
+        if project is not None:
+            ctx["project"] = project
     if host is not None:
         cfg["host"] = host
     if project is not None:
         cfg["project"] = project
-    if token is not None:
+    if host is None and token is not None:
         cfg["token"] = token
     save_config(cfg)
     click.echo("config saved")
+
+
+@cli.group()
+def token():
+    """Mint / list / revoke API access tokens (admin)."""
+
+
+def _token_backend(host):
+    """TokenClient when a host is configured, else the local store (the
+    hostless path is also the auth *bootstrap*: network minting on an open
+    server is rejected by the API)."""
+    h = get_host(host)
+    if h:
+        from ..client import TokenClient
+
+        return TokenClient(h, auth_token=get_token(h))
+    from ..api.store import Store
+
+    return Store(os.path.join(".plx", "db.sqlite"))
+
+
+@token.command("create")
+@click.option("--project", "-p", default=None,
+              help="scope to one project; omit for an admin token")
+@click.option("--label", default=None)
+@click.option("--host", default=None)
+def token_create(project, label, host):
+    be = _token_backend(host)
+    out = be.create(project=project, label=label) if hasattr(be, "_req") \
+        else be.create_token(project=project, label=label)
+    click.echo(json.dumps(out, indent=2))
+    click.echo("save it now — the raw token is not recoverable", err=True)
+
+
+@token.command("ls")
+@click.option("--host", default=None)
+def token_ls(host):
+    be = _token_backend(host)
+    rows = be.list() if hasattr(be, "_req") else be.list_tokens()
+    for r in rows:
+        scope = r["project"] or "*admin*"
+        flag = " (revoked)" if r["revoked"] else ""
+        click.echo(f"{r['id']}  {scope:<20} {r.get('label') or ''}{flag}")
+
+
+@token.command("revoke")
+@click.argument("token_id", type=int)
+@click.option("--host", default=None)
+def token_revoke(token_id, host):
+    be = _token_backend(host)
+    be.revoke(token_id) if hasattr(be, "_req") else be.revoke_token(token_id)
+    click.echo("revoked")
 
 
 @cli.command()

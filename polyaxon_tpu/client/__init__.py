@@ -1,3 +1,3 @@
 """API clients (upstream RunClient/ProjectClient equivalents)."""
 
-from .client import ApiError, BaseClient, ProjectClient, RunClient
+from .client import ApiError, BaseClient, ProjectClient, RunClient, TokenClient

@@ -54,6 +54,21 @@ class ProjectClient(BaseClient):
         return self._json("GET", "/api/v1/projects")
 
 
+class TokenClient(BaseClient):
+    """Token administration (RBAC-lite): mint/list/revoke access tokens."""
+
+    def create(self, project: Optional[str] = None,
+               label: Optional[str] = None) -> dict:
+        return self._json("POST", "/api/v1/tokens",
+                          json={"project": project, "label": label})
+
+    def list(self) -> list[dict]:
+        return self._json("GET", "/api/v1/tokens")
+
+    def revoke(self, token_id: int) -> dict:
+        return self._json("DELETE", f"/api/v1/tokens/{token_id}")
+
+
 class RunClient(BaseClient):
     """Operations on runs; binds (project, run_uuid) like upstream."""
 
