@@ -106,6 +106,9 @@ class ApiApp:
         if request.path == "/api/v1/projects":
             if request.method != "GET":
                 return _json({"error": "forbidden"}, status=403)
+            # the listing is visible but filtered to the token's project —
+            # other tenants' names/descriptions are data too
+            request["scope_project"] = row["project"]
         elif path_project != row["project"]:
             return _json({"error": "forbidden",
                           "detail": f"token is scoped to project "
@@ -154,7 +157,11 @@ class ApiApp:
         return web.Response(text=UI_HTML, content_type="text/html")
 
     async def list_projects(self, request):
-        return _json(self.store.list_projects())
+        projects = self.store.list_projects()
+        scope = request.get("scope_project")
+        if scope is not None:
+            projects = [p for p in projects if p["name"] == scope]
+        return _json(projects)
 
     async def create_token(self, request):
         # minting over the network requires an authenticated caller: on an

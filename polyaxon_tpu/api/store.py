@@ -197,9 +197,12 @@ class Store:
             return cur.rowcount > 0
 
     def has_tokens(self) -> bool:
+        """Any token row, revoked or not: once a server has ever minted a
+        token, auth stays engaged across restarts — revoking the last token
+        must lock the server down, not silently reopen it."""
         with self._conn_ctx() as conn:
             return conn.execute(
-                "SELECT 1 FROM tokens WHERE revoked=0 LIMIT 1").fetchone() is not None
+                "SELECT 1 FROM tokens LIMIT 1").fetchone() is not None
 
     # -- runs --------------------------------------------------------------
 

@@ -2,6 +2,7 @@
 grid/random/mapping/Hyperband/Bayes/TPE managers + the tuner pipeline loop."""
 
 from .managers import (
+    AshaManager,
     BaseManager,
     BayesManager,
     GridSearchManager,

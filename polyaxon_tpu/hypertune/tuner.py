@@ -8,9 +8,12 @@ else. Two behaviors the upstream tuner never had (VERDICT r2 #3/#5):
 
 - **Rolling windows**: up to ``concurrency`` trials stay in flight and a
   new trial starts the moment one finishes — wall-clock no longer scales
-  with the slowest trial of a window. (Suggestion *batches* still form a
-  barrier: iterative managers — Hyperband rungs, Bayes — need the full
-  batch observed before suggesting the next.)
+  with the slowest trial of a window. Synchronous managers (Hyperband
+  rungs, Bayes) still barrier between suggestion batches; managers with
+  ``asynchronous = True`` (ASHA — ``hyperband`` with ``asynchronous:
+  true``) skip batches entirely: every freed slot immediately asks
+  ``propose`` for one more trial, so rungs promote mid-flight and a
+  straggler never idles the other slots (VERDICT r3 #5).
 - **Live metric events**: while trials run, the tuner tails their metric
   event files (the same jsonl the streams API serves). A
   ``V1MetricEarlyStopping`` target reached by a *running* trial stops every
@@ -37,6 +40,31 @@ from ..schemas.operation import V1Operation
 from ..schemas.statuses import V1Statuses, is_done
 from ..schemas.tpu import SliceTopology, SubSliceAssignment, pack_subslices
 from .managers import Observation, Suggestion, make_manager
+
+
+class _SweepState:
+    """Mutable state shared by the sync and async tuner loops."""
+
+    def __init__(self, concurrency: int, early: list):
+        self.concurrency = concurrency
+        self.early = early
+        self.observations: list[Observation] = []
+        self.inflight: dict[int, tuple[Suggestion, dict]] = {}
+        self.free: list[int] = list(range(concurrency))[::-1]
+        self.live_vals: dict[str, float] = {}
+        self.trial_index = 0
+        self.failures = 0
+        self.target_reached = False
+
+    def reset_slots(self, n: int) -> None:
+        self.free = list(range(n))[::-1]
+
+    def observe(self, sugg: Suggestion, trial: dict,
+                metric: Optional[float]) -> None:
+        self.observations.append(Observation(
+            params=sugg.params, metric=metric,
+            trial_meta={**(sugg.meta or {}), "uuid": trial["uuid"]},
+        ))
 
 
 class Tuner:
@@ -196,99 +224,132 @@ class Tuner:
     # -- the loop ----------------------------------------------------------
 
     def run(self) -> dict[str, Any]:
-        observations: list[Observation] = []
-        early = getattr(self.matrix, "early_stopping", None) or []
-        concurrency = self.manager.concurrency
-        trial_index = 0
-        failures = 0
-        target_reached = False
+        if self.manager.asynchronous:
+            return self._run_async()
+        return self._run_sync()
 
-        while not target_reached and not self.manager.done(observations):
-            batch = self.manager.suggest(observations)
+    def _run_async(self) -> dict[str, Any]:
+        """ASHA-style loop: no suggestion batches, no rung barriers. Any
+        free slot immediately asks the manager for one more trial
+        (promotion or fresh sample); a straggler occupies exactly its own
+        slot while every other sub-slice keeps churning (VERDICT r3 #5)."""
+        st = _SweepState(self.manager.concurrency,
+                         getattr(self.matrix, "early_stopping", None) or [])
+
+        while True:
+            while st.free:
+                batch = self.manager.propose(st.observations, 1)
+                if not batch:
+                    break
+                self._launch(st, batch[0])
+
+            if not st.inflight:
+                break  # nothing running, nothing proposable: sweep is done
+
+            self._check_pipeline_stop(st.inflight)
+            self._reap(st)
+            if st.target_reached:
+                self._stop_and_drain(st)
+                break
+            # denominator: everything launched so far (there is no batch)
+            if self._failure_stop(st.early, st.failures, st.trial_index):
+                self._stop_inflight(st)
+                raise RuntimeError(
+                    f"failure early stopping: {st.failures}/{st.trial_index}"
+                    f" trials failed"
+                )
+            if st.inflight:
+                time.sleep(self.poll_interval)
+
+        return self._summary(st.observations, stopped_early=st.target_reached)
+
+    def _run_sync(self) -> dict[str, Any]:
+        st = _SweepState(self.manager.concurrency,
+                         getattr(self.matrix, "early_stopping", None) or [])
+
+        while not st.target_reached and not self.manager.done(st.observations):
+            batch = self.manager.suggest(st.observations)
             if not batch:
                 break
             queue = list(batch)
-            # slot -> (sugg, trial_row) for trials in flight; slot index
-            # doubles as the sub-slice assignment when packing
-            inflight: dict[int, tuple[Suggestion, dict]] = {}
-            free = list(range(min(concurrency, max(len(queue), 1))))[::-1]
-            # objective values seen in metric events while trials run: the
-            # record of a winner stopped mid-flight, and the tail for
-            # stopped losers whose outputs never landed
-            live_vals: dict[str, float] = {}
+            st.reset_slots(min(st.concurrency, max(len(queue), 1)))
 
-            while queue or inflight:
-                while queue and free:
-                    slot = free.pop()
-                    sugg = queue.pop(0)
-                    assignment = self.assignments[slot] if self.assignments else None
-                    trial = self._create_trial(sugg, trial_index, assignment)
-                    trial_index += 1
-                    inflight[slot] = (sugg, trial)
+            while queue or st.inflight:
+                while queue and st.free:
+                    self._launch(st, queue.pop(0))
 
-                self._check_pipeline_stop(inflight)
-
-                for slot, (sugg, trial) in list(inflight.items()):
-                    run = self.store.get_run(trial["uuid"])
-                    if run is None or is_done(run["status"]):
-                        del inflight[slot]
-                        free.append(slot)
-                        metric = self._trial_metric(run) if run else None
-                        ok = run is not None and run["status"] in (
-                            V1Statuses.SUCCEEDED.value,
-                            V1Statuses.SKIPPED.value,  # cache hit, outputs reused
-                        )
-                        if not ok:
-                            metric = None
-                            failures += 1
-                        observations.append(Observation(
-                            params=sugg.params, metric=metric,
-                            trial_meta={**(sugg.meta or {}), "uuid": trial["uuid"]},
-                        ))
-                        if self._metric_value_met(metric, early):
-                            target_reached = True
-                    elif run["status"] == V1Statuses.RUNNING.value:
-                        # live check: a running trial can hit the target
-                        # before it completes
-                        lv = self._live_metric(run)
-                        if lv is not None:
-                            live_vals[trial["uuid"]] = lv
-                        if self._metric_value_met(lv, early):
-                            target_reached = True
-
-                if target_reached:
-                    # stop the losers mid-flight
-                    for slot, (sugg, trial) in list(inflight.items()):
-                        self.store.transition(
-                            trial["uuid"], V1Statuses.STOPPING.value)
-                    # drain: stopped trials keep their last live value so
-                    # the mid-flight winner still ranks
-                    for slot, (sugg, trial) in list(inflight.items()):
-                        run = self._wait_done(trial["uuid"])
-                        metric = self._trial_metric(run) if run else None
-                        if metric is None:
-                            metric = live_vals.get(trial["uuid"])
-                        observations.append(Observation(
-                            params=sugg.params, metric=metric,
-                            trial_meta={**(sugg.meta or {}), "uuid": trial["uuid"]},
-                        ))
-                    inflight.clear()
+                self._check_pipeline_stop(st.inflight)
+                self._reap(st)
+                if st.target_reached:
+                    self._stop_and_drain(st)
                     break
-
                 # percent is over the whole batch, not just finished trials:
                 # one fast crash among 16 in-flight must not read as 100%
-                if self._failure_stop(early, failures, len(batch)):
-                    for slot, (sugg, trial) in list(inflight.items()):
-                        self.store.transition(
-                            trial["uuid"], V1Statuses.STOPPING.value)
+                if self._failure_stop(st.early, st.failures, len(batch)):
+                    self._stop_inflight(st)
                     raise RuntimeError(
-                        f"failure early stopping: {failures}/"
-                        f"{len(observations)} trials failed"
+                        f"failure early stopping: {st.failures}/"
+                        f"{len(batch)} trials failed"
                     )
-                if queue or inflight:
+                if queue or st.inflight:
                     time.sleep(self.poll_interval)
 
-        return self._summary(observations, stopped_early=target_reached)
+        return self._summary(st.observations, stopped_early=st.target_reached)
+
+    # -- shared loop mechanics --------------------------------------------
+
+    def _launch(self, st: "_SweepState", sugg: Suggestion) -> None:
+        """Create a trial for ``sugg`` in a free slot (slot index doubles
+        as the sub-slice assignment when packing)."""
+        slot = st.free.pop()
+        assignment = self.assignments[slot] if self.assignments else None
+        trial = self._create_trial(sugg, st.trial_index, assignment)
+        st.trial_index += 1
+        st.inflight[slot] = (sugg, trial)
+
+    def _reap(self, st: "_SweepState") -> None:
+        """One poll pass: record finished trials as observations, free
+        their slots, track live metric events of running trials (a running
+        trial can hit the early-stopping target before it completes)."""
+        for slot, (sugg, trial) in list(st.inflight.items()):
+            run = self.store.get_run(trial["uuid"])
+            if run is None or is_done(run["status"]):
+                del st.inflight[slot]
+                st.free.append(slot)
+                metric = self._trial_metric(run) if run else None
+                ok = run is not None and run["status"] in (
+                    V1Statuses.SUCCEEDED.value,
+                    V1Statuses.SKIPPED.value,  # cache hit, outputs reused
+                )
+                if not ok:
+                    metric = None
+                    st.failures += 1
+                st.observe(sugg, trial, metric)
+                if self._metric_value_met(metric, st.early):
+                    st.target_reached = True
+            elif run["status"] == V1Statuses.RUNNING.value:
+                lv = self._live_metric(run)
+                if lv is not None:
+                    st.live_vals[trial["uuid"]] = lv
+                if self._metric_value_met(lv, st.early):
+                    st.target_reached = True
+
+    def _stop_inflight(self, st: "_SweepState") -> None:
+        for slot, (sugg, trial) in list(st.inflight.items()):
+            self.store.transition(trial["uuid"], V1Statuses.STOPPING.value)
+
+    def _stop_and_drain(self, st: "_SweepState") -> None:
+        """Target reached: stop the losers mid-flight, then drain — stopped
+        trials keep their last live value so a mid-flight winner still
+        ranks."""
+        self._stop_inflight(st)
+        for slot, (sugg, trial) in list(st.inflight.items()):
+            run = self._wait_done(trial["uuid"])
+            metric = self._trial_metric(run) if run else None
+            if metric is None:
+                metric = st.live_vals.get(trial["uuid"])
+            st.observe(sugg, trial, metric)
+        st.inflight.clear()
 
     def _wait_done(self, uuid: str, timeout: float = 60.0) -> Optional[dict]:
         deadline = time.monotonic() + timeout

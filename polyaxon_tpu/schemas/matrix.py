@@ -235,7 +235,14 @@ class V1RandomSearch(_BaseSearch):
 class V1Hyperband(_BaseSearch):
     """Hyperband successive halving (Li et al. 2018). Bracket math in
     ``hypertune.hyperband`` mirrors the paper: s_max = floor(log_eta(R)),
-    n_i/r_i per rung; upstream ``V1Hyperband``."""
+    n_i/r_i per rung; upstream ``V1Hyperband``.
+
+    ``asynchronous: true`` switches to ASHA (Li et al., MLSys 2020): one
+    bracket, rungs promote the moment they have a top-1/eta candidate, new
+    base configs fill idle slots — no rung barriers, so a straggler trial
+    never idles the other packed sub-slices (VERDICT r3 #5). ``num_runs``
+    caps the base-rung configs ASHA samples (default eta**s_max, the width
+    of synchronous Hyperband's most exploratory bracket)."""
 
     kind: Literal["hyperband"] = "hyperband"
     max_iterations: int
@@ -244,6 +251,8 @@ class V1Hyperband(_BaseSearch):
     metric: V1OptimizationMetric
     resume: Optional[bool] = None
     seed: Optional[int] = None
+    asynchronous: Optional[bool] = None
+    num_runs: Optional[int] = None  # ASHA base-config budget
 
 
 class V1Bayes(_BaseSearch):

@@ -219,6 +219,15 @@ class TestApiAuth:
                               headers={"Authorization":
                                        f"Bearer {scoped['token']}"})
             assert r.status_code == 403
+            # the project listing is filtered to the token's own project —
+            # other tenants' names/descriptions are data too
+            RunClient(srv.url, project="beta",
+                      auth_token=admin["token"]).create(spec={})
+            r = requests.get(f"{srv.url}/api/v1/projects", timeout=5,
+                             headers={"Authorization":
+                                      f"Bearer {scoped['token']}"})
+            assert r.status_code == 200
+            assert [p["name"] for p in r.json()] == ["alpha"]
             # admin token spans projects and admin endpoints
             assert RunClient(srv.url, project="beta",
                              auth_token=admin["token"]).create(spec={})["uuid"]
@@ -235,6 +244,34 @@ class TestApiAuth:
                 assert e.status == 401
         finally:
             srv.stop()
+
+    def test_auth_survives_restart_after_all_tokens_revoked(self, tmp_path):
+        """Revoking the last token must lock the server down, not reopen
+        it on the next restart: has_tokens counts revoked rows too."""
+        import requests
+
+        from polyaxon_tpu.api.server import ApiServer
+
+        db = str(tmp_path / "plx.db")
+        srv = ApiServer(db_path=db, artifacts_root=str(tmp_path / "a"),
+                        port=0).start()
+        try:
+            tok = srv.store.create_token(label="only")
+            srv.store.revoke_token(tok["id"])
+        finally:
+            srv.stop()
+        # fresh process over the same DB: anonymous must still be rejected
+        srv2 = ApiServer(db_path=db, artifacts_root=str(tmp_path / "a"),
+                         port=0).start()
+        try:
+            assert srv2.store.has_tokens()
+            r = requests.get(f"{srv2.url}/api/v1/projects", timeout=5)
+            assert r.status_code == 401
+            r = requests.get(f"{srv2.url}/api/v1/projects", timeout=5,
+                             headers={"Authorization": f"Bearer {tok['token']}"})
+            assert r.status_code == 401  # revoked stays revoked
+        finally:
+            srv2.stop()
 
     def test_no_token_stays_open(self, tmp_path):
         import requests
