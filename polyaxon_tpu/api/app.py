@@ -329,9 +329,22 @@ class ApiApp:
                 with open(os.path.join(logs_dir, f), encoding="utf-8") as fh:
                     chunks.append(fh.read())
         text = "".join(chunks)
+        total = len(text)
+        text = text[offset:]
+        tail = request.rel_url.query.get("tail")
+        if tail is not None:
+            try:
+                tail_n = int(tail)
+            except ValueError:
+                return _json({"error": f"invalid tail {tail!r}"}, status=400)
+            if tail_n <= 0:
+                text = ""
+            else:
+                lines = text.splitlines(keepends=True)
+                text = "".join(lines[-tail_n:])
         return web.Response(
-            text=text[offset:],
-            headers={"X-Log-Offset": str(len(text))},
+            text=text,
+            headers={"X-Log-Offset": str(total)},
             content_type="text/plain",
         )
 

@@ -256,6 +256,15 @@ class Store:
                  json.dumps(V1StatusCondition.get_condition(V1Statuses.CREATED).to_dict()),
                  now),
             )
+        # creation flows through the same feed as transitions so a
+        # subscribed agent learns about new runs without scanning
+        for listener in self._transition_listeners:
+            try:
+                listener(run_uuid, V1Statuses.CREATED.value)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
         return self.get_run(run_uuid)
 
     def get_run(self, uuid: str) -> Optional[dict]:
@@ -272,6 +281,7 @@ class Store:
         pipeline_uuid: Optional[str] = None,
         limit: int = 100,
         offset: int = 0,
+        statuses: Optional[list[str]] = None,
     ) -> list[dict]:
         q = f"SELECT {','.join(self._RUN_COLS)} FROM runs WHERE 1=1"
         args: list = []
@@ -281,6 +291,9 @@ class Store:
         if status:
             q += " AND status=?"
             args.append(status)
+        if statuses:
+            q += f" AND status IN ({','.join('?' * len(statuses))})"
+            args.extend(statuses)
         if pipeline_uuid:
             q += " AND pipeline_uuid=?"
             args.append(pipeline_uuid)

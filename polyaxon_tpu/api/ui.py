@@ -1,7 +1,13 @@
-"""Minimal dashboard (upstream `ui/` — SURVEY.md §2 "UI" row, here a
-single static page over the existing REST endpoints: runs table, status,
-metrics sparkline, log tail). Served at ``GET /`` by the API app; no build
-step, no dependencies — vanilla JS + fetch."""
+"""Dashboard (upstream `ui/` — SURVEY.md §2 "UI" row; VERDICT r3 #10
+"dashboard v2"): a single static page over the existing REST endpoints.
+
+v2 features: runs table with status filter, real metric line charts (axes,
+ticks, grid, hover readout) drawn from the metric event files, multi-run
+compare (check runs -> overlaid per-metric charts + params/outputs table),
+an artifact browser over ``/artifacts/tree`` with per-file download links
+(profile traces highlighted), statuses timeline, and a live log tail.
+No build step, no dependencies — vanilla JS + fetch + inline SVG.
+"""
 
 UI_HTML = """<!DOCTYPE html>
 <html>
@@ -15,42 +21,72 @@ UI_HTML = """<!DOCTYPE html>
            display: flex; gap: 16px; align-items: baseline; }
   header h1 { font-size: 16px; margin: 0; }
   header input { margin-left: auto; font-size: 12px; padding: 2px 6px; }
-  main { display: flex; gap: 16px; padding: 16px; }
+  main { display: flex; gap: 16px; padding: 16px; align-items: flex-start; }
   section { background: #fff; border: 1px solid #e3e8ee; border-radius: 6px;
             padding: 12px; }
-  #runs { width: 46%; } #detail { flex: 1; min-width: 0; }
+  #runs { width: 40%; } #detail { flex: 1; min-width: 0; }
   table { border-collapse: collapse; width: 100%; font-size: 13px; }
   th, td { text-align: left; padding: 4px 8px; border-bottom: 1px solid #eef1f4; }
   tr:hover td { background: #f0f4ff; cursor: pointer; }
   .st { padding: 1px 7px; border-radius: 9px; font-size: 11px; color: #fff; }
   .st.succeeded { background: #18794e; } .st.failed { background: #cd2b31; }
   .st.running { background: #0b68cb; } .st.stopped { background: #6c757d; }
+  .st.skipped { background: #6c757d; }
   .st.created, .st.compiled, .st.queued, .st.scheduled, .st.starting,
   .st.stopping { background: #b98900; }
   pre { background: #0f1320; color: #d6deeb; padding: 10px; border-radius: 6px;
-        max-height: 320px; overflow: auto; font-size: 12px; }
-  svg { background: #fbfcfe; border: 1px solid #eef1f4; border-radius: 4px; }
+        max-height: 340px; overflow: auto; font-size: 12px; }
+  svg.chart { background: #fbfcfe; border: 1px solid #eef1f4; border-radius: 4px; }
   h2 { font-size: 14px; margin: 4px 0 10px; } h3 { font-size: 12px; margin: 12px 0 6px; }
   select { font-size: 13px; }
   .muted { color: #697386; font-size: 12px; }
+  .tabs { display: flex; gap: 2px; margin-bottom: 10px; border-bottom: 1px solid #e3e8ee; }
+  .tabs button { border: none; background: none; padding: 6px 12px; font-size: 13px;
+                 cursor: pointer; border-bottom: 2px solid transparent; color: #697386; }
+  .tabs button.active { color: #1a1f36; border-bottom-color: #0b68cb; font-weight: 600; }
+  .crumb a { color: #0b68cb; cursor: pointer; text-decoration: none; }
+  .file a { color: #0b68cb; text-decoration: none; }
+  .dir { cursor: pointer; color: #1a1f36; font-weight: 600; }
+  .trace { background: #fff7e0; }
+  .legend { display: inline-block; width: 10px; height: 10px; border-radius: 2px;
+            margin-right: 4px; vertical-align: middle; }
+  .cmp { font-size: 12px; }
+  #cmpBar { margin: 6px 0; }
+  button.small { font-size: 12px; padding: 2px 8px; }
 </style>
 </head>
 <body>
 <header>
   <h1>polyaxon_tpu</h1>
   <select id="project"></select>
+  <select id="stFilter">
+    <option value="">all statuses</option>
+    <option>running</option><option>succeeded</option><option>failed</option>
+    <option>stopped</option><option>created</option><option>queued</option>
+  </select>
   <span class="muted" id="count"></span>
   <input id="token" placeholder="auth token (if required)" type="password"/>
 </header>
 <main>
-  <section id="runs"><h2>Runs</h2><table id="runsTable">
-    <thead><tr><th>name</th><th>kind</th><th>status</th><th>uuid</th></tr></thead>
+  <section id="runs"><h2>Runs</h2>
+    <div id="cmpBar" class="muted">check ≥2 runs to compare
+      <button class="small" id="cmpBtn" style="display:none">compare</button></div>
+    <table id="runsTable">
+    <thead><tr><th></th><th>name</th><th>kind</th><th>status</th><th>uuid</th></tr></thead>
     <tbody></tbody></table></section>
   <section id="detail"><h2 id="dTitle">Select a run</h2>
+    <div class="tabs" id="tabs" style="display:none">
+      <button data-tab="overview" class="active">Overview</button>
+      <button data-tab="metrics">Metrics</button>
+      <button data-tab="artifacts">Artifacts</button>
+      <button data-tab="logs">Logs</button>
+    </div>
     <div id="dBody"></div></section>
 </main>
 <script>
 const $ = (s) => document.querySelector(s);
+const COLORS = ["#0b68cb", "#cd2b31", "#18794e", "#b98900", "#7c3aed",
+                "#0e7490", "#be185d", "#4d7c0f"];
 const tokenBox = $("#token");
 tokenBox.value = localStorage.getItem("plx_token") || "";
 tokenBox.addEventListener("change", () => {
@@ -69,7 +105,10 @@ async function text(path) {
   const r = await fetch(path, {headers: hdrs()});
   return r.ok ? r.text() : "";
 }
-let project = null, selected = null;
+function esc(s) { return String(s).replace(/&/g, "&amp;").replace(/</g, "&lt;")
+                  .split('"').join("&quot;"); }
+let project = null, selected = null, tab = "overview", compare = null;
+let checked = new Set(), runCache = [];
 async function loadProjects() {
   const ps = await j("/api/v1/projects");
   const sel = $("#project");
@@ -80,61 +119,271 @@ async function loadProjects() {
   }
   if (!project && ps.length) project = ps[0].name;
   sel.value = project || "";
-  sel.onchange = () => { project = sel.value; refresh(); };
+  sel.onchange = () => { project = sel.value; selected = null; compare = null;
+                         checked.clear(); refresh(); };
 }
 function stBadge(s) { return `<span class="st ${s}">${s}</span>`; }
 async function loadRuns() {
   if (!project) return;
-  const runs = await j(`/api/v1/${project}/runs?limit=100`);
+  const f = $("#stFilter").value;
+  const runs = await j(`/api/v1/${project}/runs?limit=200` +
+                       (f ? `&status=${f}` : ""));
+  runCache = runs;
   $("#count").textContent = runs.length + " runs";
   const tb = $("#runsTable tbody");
   tb.innerHTML = "";
   for (const r of runs) {
     const tr = document.createElement("tr");
-    tr.innerHTML = `<td>${r.name || ""}</td><td>${r.kind || ""}</td>` +
+    tr.innerHTML =
+      `<td><input type="checkbox" data-u="${r.uuid}"` +
+      `${checked.has(r.uuid) ? " checked" : ""}/></td>` +
+      `<td>${esc(r.name || "")}</td><td>${esc(r.kind || "")}</td>` +
       `<td>${stBadge(r.status)}</td><td class="muted">${r.uuid.slice(0,8)}</td>`;
-    tr.onclick = () => { selected = r.uuid; loadDetail(); };
+    tr.querySelector("input").onclick = (ev) => {
+      ev.stopPropagation();
+      if (ev.target.checked) checked.add(r.uuid); else checked.delete(r.uuid);
+      updateCmpBar();
+    };
+    tr.onclick = () => { selected = r.uuid; compare = null; render(); };
     tb.appendChild(tr);
   }
+  updateCmpBar();
 }
-function sparkline(events) {
-  const vals = events.map(e => e.metric).filter(v => typeof v === "number");
-  if (!vals.length) return "";
-  const w = 420, h = 80, min = Math.min(...vals), max = Math.max(...vals);
-  const pts = vals.map((v, i) => {
-    const x = (i / Math.max(vals.length - 1, 1)) * (w - 10) + 5;
-    const y = h - 5 - ((v - min) / (max - min || 1)) * (h - 10);
-    return `${x.toFixed(1)},${y.toFixed(1)}`;
-  }).join(" ");
-  return `<svg width="${w}" height="${h}"><polyline fill="none" ` +
-    `stroke="#0b68cb" stroke-width="1.5" points="${pts}"/></svg>` +
-    `<div class="muted">min ${min.toPrecision(4)} · last ` +
-    `${vals[vals.length-1].toPrecision(4)}</div>`;
+function updateCmpBar() {
+  $("#cmpBtn").style.display = checked.size >= 2 ? "" : "none";
 }
-async function loadDetail() {
+$("#cmpBtn").onclick = () => { compare = [...checked]; selected = null; render(); };
+$("#stFilter").onchange = () => loadRuns();
+
+// ---- charts ---------------------------------------------------------------
+function niceTicks(lo, hi, n) {
+  if (!(hi > lo)) { hi = lo + 1; }
+  const span = hi - lo, step0 = span / Math.max(n, 1);
+  const mag = Math.pow(10, Math.floor(Math.log10(step0)));
+  const step = [1, 2, 5, 10].map(m => m * mag).find(s => span / s <= n + 1) || mag * 10;
+  const t = [];
+  for (let v = Math.ceil(lo / step) * step; v <= hi + 1e-12; v += step) t.push(v);
+  return t;
+}
+function fmt(v) {
+  if (v === 0) return "0";
+  const a = Math.abs(v);
+  if (a >= 1e5 || a < 1e-3) return v.toExponential(1);
+  return String(+v.toPrecision(4));
+}
+function lineChart(series, opts) {
+  // series: [{label, color, pts: [[x, y], ...]}]; real axes + grid + hover
+  const w = opts.w || 520, h = opts.h || 200, mL = 52, mR = 10, mT = 8, mB = 22;
+  const all = series.flatMap(s => s.pts);
+  if (!all.length) return "";
+  let xmin = Math.min(...all.map(p => p[0])), xmax = Math.max(...all.map(p => p[0]));
+  let ymin = Math.min(...all.map(p => p[1])), ymax = Math.max(...all.map(p => p[1]));
+  if (xmax === xmin) xmax = xmin + 1;
+  if (ymax === ymin) { ymax += Math.abs(ymax) * 0.05 + 1e-9; ymin -= Math.abs(ymin) * 0.05 + 1e-9; }
+  const X = x => mL + (x - xmin) / (xmax - xmin) * (w - mL - mR);
+  const Y = y => h - mB - (y - ymin) / (ymax - ymin) * (h - mT - mB);
+  let g = "";
+  for (const ty of niceTicks(ymin, ymax, 5)) {
+    const y = Y(ty);
+    g += `<line x1="${mL}" y1="${y}" x2="${w - mR}" y2="${y}" stroke="#eef1f4"/>` +
+         `<text x="${mL - 6}" y="${y + 3}" font-size="10" fill="#697386" ` +
+         `text-anchor="end">${fmt(ty)}</text>`;
+  }
+  for (const tx of niceTicks(xmin, xmax, 6)) {
+    const x = X(tx);
+    g += `<line x1="${x}" y1="${mT}" x2="${x}" y2="${h - mB}" stroke="#f4f6f8"/>` +
+         `<text x="${x}" y="${h - 8}" font-size="10" fill="#697386" ` +
+         `text-anchor="middle">${fmt(tx)}</text>`;
+  }
+  let lines = "";
+  for (const s of series) {
+    const pts = s.pts.map(p => `${X(p[0]).toFixed(1)},${Y(p[1]).toFixed(1)}`).join(" ");
+    lines += `<polyline fill="none" stroke="${s.color}" stroke-width="1.5" points="${pts}"/>`;
+  }
+  const id = "c" + Math.random().toString(36).slice(2, 8);
+  const chart =
+    `<svg class="chart" id="${id}" width="${w}" height="${h}">` + g + lines +
+    `<line id="${id}x" x1="0" y1="${mT}" x2="0" y2="${h - mB}" stroke="#b98900" ` +
+    `stroke-dasharray="3,2" visibility="hidden"/>` +
+    `<text id="${id}t" x="${mL + 4}" y="${mT + 10}" font-size="10" fill="#1a1f36"></text>` +
+    `</svg>`;
+  // post-render hover wiring
+  setTimeout(() => {
+    const el = document.getElementById(id);
+    if (!el) return;
+    el.addEventListener("mousemove", ev => {
+      const r = el.getBoundingClientRect();
+      const px = ev.clientX - r.left;
+      if (px < mL || px > w - mR) return;
+      const xv = xmin + (px - mL) / (w - mL - mR) * (xmax - xmin);
+      const parts = series.map(s => {
+        if (!s.pts.length) return null;
+        let best = s.pts[0];
+        for (const p of s.pts) if (Math.abs(p[0] - xv) < Math.abs(best[0] - xv)) best = p;
+        return `${s.label}: ${fmt(best[1])}`;
+      }).filter(Boolean);
+      document.getElementById(id + "x").setAttribute("x1", px);
+      document.getElementById(id + "x").setAttribute("x2", px);
+      document.getElementById(id + "x").setAttribute("visibility", "visible");
+      document.getElementById(id + "t").textContent =
+        `x=${fmt(xv)}  ` + parts.join("  ");
+    });
+    el.addEventListener("mouseleave", () => {
+      document.getElementById(id + "x").setAttribute("visibility", "hidden");
+      document.getElementById(id + "t").textContent = "";
+    });
+  }, 0);
+  return chart;
+}
+function toPts(events) {
+  const pts = [];
+  events.forEach((e, i) => {
+    if (typeof e.metric === "number")
+      pts.push([typeof e.step === "number" ? e.step : i, e.metric]);
+  });
+  return pts;
+}
+function legendHtml(series) {
+  return series.map(s =>
+    `<span class="legend" style="background:${s.color}"></span>` +
+    `<span class="muted">${esc(s.label)}</span>`).join(" &nbsp; ");
+}
+
+// ---- detail tabs ----------------------------------------------------------
+document.querySelectorAll("#tabs button").forEach(b => {
+  b.onclick = () => { tab = b.dataset.tab; render(); };
+});
+async function renderOverview(r) {
+  let html = `<table class="cmp"><tr><th></th><th>value</th></tr>`;
+  for (const k of ["uuid", "kind", "created_at", "started_at", "finished_at"])
+    if (r[k]) html += `<tr><td class="muted">${k}</td><td>${esc(r[k])}</td></tr>`;
+  html += `</table>`;
+  if (r.inputs && Object.keys(r.inputs).length)
+    html += `<h3>Params</h3><pre>${esc(JSON.stringify(r.inputs, null, 2))}</pre>`;
+  if (r.outputs)
+    html += `<h3>Outputs</h3><pre>${esc(JSON.stringify(r.outputs, null, 2))}</pre>`;
+  try {
+    const sts = await j(`/api/v1/${project}/runs/${r.uuid}/statuses`);
+    html += `<h3>Status timeline</h3><table class="cmp">`;
+    for (const s of sts) html +=
+      `<tr><td>${stBadge(s.type || s.status || "")}</td>` +
+      `<td class="muted">${esc(s.created_at || "")}</td>` +
+      `<td class="muted">${esc(s.reason || "")}</td></tr>`;
+    html += `</table>`;
+  } catch (e) {}
+  return html;
+}
+async function renderMetrics(r) {
+  let html = "";
+  try {
+    const m = await j(`/api/v1/${project}/runs/${r.uuid}/metrics`);
+    const names = Object.keys(m).sort();
+    if (!names.length) return '<span class="muted">no metrics yet</span>';
+    for (const name of names) {
+      const pts = toPts(m[name]);
+      if (!pts.length) continue;
+      const series = [{label: name, color: COLORS[0], pts}];
+      const last = pts[pts.length - 1][1];
+      html += `<h3>${esc(name)} <span class="muted">last ${fmt(last)}</span></h3>` +
+              lineChart(series, {});
+    }
+  } catch (e) { html = `<span class="muted">${esc(e)}</span>`; }
+  return html;
+}
+let artPath = "";
+function isTrace(name) {
+  return /\\.trace\\.json(\\.gz)?$|\\.pb$|perfetto|xplane/.test(name);
+}
+async function renderArtifacts(r) {
+  let html = "";
+  try {
+    const t = await j(`/api/v1/${project}/runs/${r.uuid}/artifacts/tree` +
+                      (artPath ? `?path=${encodeURIComponent(artPath)}` : ""));
+    const crumbs = ["<a data-p=''>artifacts</a>"];
+    let acc = "";
+    for (const part of (artPath ? artPath.split("/") : [])) {
+      acc = acc ? acc + "/" + part : part;
+      crumbs.push(`<a data-p="${esc(acc)}">${esc(part)}</a>`);
+    }
+    html += `<div class="crumb">${crumbs.join(" / ")}</div><table class="cmp">`;
+    for (const d of t.dirs)
+      html += `<tr class="dirrow"><td class="dir" data-p="` +
+        esc(artPath ? artPath + "/" + d : d) + `">📁 ${esc(d)}</td><td></td></tr>`;
+    for (const f of t.files) {
+      const rel = artPath ? artPath + "/" + f.name : f.name;
+      const href = `/api/v1/${project}/runs/${r.uuid}/artifacts/file?path=` +
+                   encodeURIComponent(rel);
+      html += `<tr${isTrace(f.name) ? ' class="trace"' : ""}><td class="file">` +
+        `<a href="${href}" download>${esc(f.name)}</a>` +
+        `${isTrace(f.name) ? ' <span class="muted">(profile trace)</span>' : ""}</td>` +
+        `<td class="muted">${(f.size / 1024).toFixed(1)} KB</td></tr>`;
+    }
+    html += `</table>`;
+  } catch (e) { html = `<span class="muted">no artifacts</span>`; }
+  return html;
+}
+async function renderLogs(r) {
+  const logs = await text(`/api/v1/${project}/runs/${r.uuid}/logs?tail=400`);
+  return logs ? `<pre>${esc(logs)}</pre>` : '<span class="muted">no logs yet</span>';
+}
+async function renderCompare(uuids) {
+  const runs = await Promise.all(
+    uuids.map(u => j(`/api/v1/${project}/runs/${u}`)));
+  $("#dTitle").textContent = `Compare ${runs.length} runs`;
+  $("#tabs").style.display = "none";
+  const label = r => r.name || r.uuid.slice(0, 8);
+  let html = `<h3>Runs</h3><table class="cmp"><tr><th></th><th>run</th>` +
+             `<th>status</th><th>params</th><th>outputs</th></tr>`;
+  runs.forEach((r, i) => {
+    html += `<tr><td><span class="legend" style="background:${COLORS[i % COLORS.length]}">` +
+      `</span></td><td>${esc(label(r))}</td><td>${stBadge(r.status)}</td>` +
+      `<td><pre style="max-height:80px">${esc(JSON.stringify(r.inputs || {}))}</pre></td>` +
+      `<td><pre style="max-height:80px">${esc(JSON.stringify(r.outputs || {}))}</pre></td></tr>`;
+  });
+  html += `</table>`;
+  const all = await Promise.all(
+    uuids.map(u => j(`/api/v1/${project}/runs/${u}/metrics`).catch(() => ({}))));
+  const names = [...new Set(all.flatMap(m => Object.keys(m)))].sort();
+  for (const name of names) {
+    const series = [];
+    runs.forEach((r, i) => {
+      const pts = toPts(all[i][name] || []);
+      if (pts.length) series.push(
+        {label: label(r), color: COLORS[i % COLORS.length], pts});
+    });
+    if (!series.length) continue;
+    html += `<h3>${esc(name)}</h3><div>${legendHtml(series)}</div>` +
+            lineChart(series, {});
+  }
+  $("#dBody").innerHTML = html;
+}
+async function render() {
+  if (compare && compare.length >= 2) return renderCompare(compare);
   if (!selected) return;
   const r = await j(`/api/v1/${project}/runs/${selected}`);
-  $("#dTitle").innerHTML = `${r.name || r.uuid} ${stBadge(r.status)}`;
+  $("#dTitle").innerHTML = `${esc(r.name || r.uuid)} ${stBadge(r.status)}`;
+  $("#tabs").style.display = "";
+  document.querySelectorAll("#tabs button").forEach(b =>
+    b.classList.toggle("active", b.dataset.tab === tab));
   let html = "";
-  if (r.outputs) html += `<h3>Outputs</h3><pre>` +
-    JSON.stringify(r.outputs, null, 2) + `</pre>`;
-  try {
-    const m = await j(`/api/v1/${project}/runs/${selected}/metrics`);
-    for (const [name, events] of Object.entries(m)) {
-      const sl = sparkline(events);
-      if (sl) html += `<h3>${name}</h3>` + sl;
-    }
-  } catch (e) {}
-  const logs = await text(`/api/v1/${project}/runs/${selected}/logs`);
-  if (logs) html += `<h3>Logs</h3><pre>${logs.replace(/</g, "&lt;")}</pre>`;
+  if (tab === "overview") html = await renderOverview(r);
+  else if (tab === "metrics") html = await renderMetrics(r);
+  else if (tab === "artifacts") html = await renderArtifacts(r);
+  else if (tab === "logs") html = await renderLogs(r);
   $("#dBody").innerHTML = html || '<span class="muted">no data yet</span>';
+  if (tab === "artifacts") {
+    document.querySelectorAll("#dBody .dir, #dBody .crumb a").forEach(el => {
+      el.onclick = () => { artPath = el.dataset.p || ""; render(); };
+    });
+  }
 }
 async function refresh() {
-  try { await loadProjects(); await loadRuns(); if (selected) await loadDetail(); }
+  try { await loadProjects(); await loadRuns();
+        if (selected || compare) await render(); }
   catch (e) { $("#count").textContent = String(e); }
 }
 refresh();
-setInterval(refresh, 3000);
+setInterval(refresh, 4000);
 </script>
 </body>
 </html>

@@ -57,6 +57,14 @@ LLAMA_MOE_TINY = replace(
     LLAMA_TINY, num_experts=4, expert_top_k=2, mlp_dim=64,
 )
 
+# ~1.2B-total / ~0.4B-active sparse MoE sized for one 16 GiB chip with
+# full AdamW (bf16 moments) — the single-chip MoE bench model (VERDICT r3
+# #6: measure the dispatch, don't just dryrun it).
+LLAMA_MOE_1B = replace(
+    LLAMA2_7B, hidden=1024, num_layers=16, num_heads=16, num_kv_heads=4,
+    mlp_dim=2560, max_seq=2048, num_experts=8, expert_top_k=2,
+)
+
 CONFIGS = {
     "llama2-7b": LLAMA2_7B,
     "llama2-13b": LLAMA2_13B,
@@ -66,4 +74,5 @@ CONFIGS = {
     "llama-1b": LLAMA_1B,
     "mixtral-8x7b": MIXTRAL_8X7B,
     "llama-moe-tiny": LLAMA_MOE_TINY,
+    "llama-moe-1b": LLAMA_MOE_1B,
 }

@@ -100,7 +100,11 @@ def build_mesh(
 # code, only these rules (the TPU analogue of the reference swapping
 # DDP <-> Horovod launchers without touching the model).
 DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
-    ("batch", ("data", "fsdp")),
+    # the expert axis carries data parallelism everywhere except the expert
+    # tensors themselves: tokens shard over it (attention/embeddings are not
+    # computed Eax-times redundantly) and the MoE dispatch moves tokens to
+    # their experts with an all-to-all over the axis
+    ("batch", ("data", "fsdp", "expert")),
     ("layers", None),           # scan-stacked layer dim is never sharded
     ("seq", "context"),
     ("embed", "fsdp"),          # params: fsdp-shard the embed dim (zero-3 style)

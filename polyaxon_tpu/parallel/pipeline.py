@@ -108,7 +108,7 @@ def gpipe_trunk(
         xm = xl.reshape(m, mb, s, h)
         state = jnp.zeros((mb, s, h), xl.dtype)
         outs = jnp.zeros((m, mb, s, h), xl.dtype)
-        aux_sum = jnp.zeros((), jnp.float32)
+        aux_sum = jnp.zeros((2,), jnp.float32)
         fwd = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
         def tick(carry, t):
@@ -131,7 +131,7 @@ def gpipe_trunk(
                 out, aux = jax.lax.cond(
                     active,
                     lambda xi: body_fn(xi, stage_params),
-                    lambda xi: (xi, jnp.zeros((), jnp.float32)),
+                    lambda xi: (xi, jnp.zeros((2,), jnp.float32)),
                     stage_in,
                 )
             else:

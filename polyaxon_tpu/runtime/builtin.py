@@ -59,6 +59,10 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
             overrides["remat"] = spec["remat"]
         if spec.get("loss_chunk_tokens") is not None:
             overrides["loss_chunk_tokens"] = int(spec["loss_chunk_tokens"])
+        if spec.get("moe_dispatch"):
+            # "capacity" (default) | "a2a" (explicit all-to-all over the
+            # expert axis) | "dense" (parity oracle)
+            overrides["moe_dispatch"] = spec["moe_dispatch"]
         seq_len = int(spec.get("seq_len", min(2048, mcfg.max_seq)))
         if seq_len > mcfg.max_seq:
             overrides["max_seq"] = seq_len

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import traceback
 from typing import Optional
 
@@ -142,6 +143,16 @@ class LocalAgent:
         self._wake = threading.Event()  # set by the watch thread
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        # change feed (VERDICT r3 weak #8): store events carry *which* runs
+        # changed, so a busy loop advances exactly those instead of issuing
+        # four status-indexed scans every 0.2s tick. None = overflow -> the
+        # next tick falls back to a full scan. The periodic full resync
+        # below covers writers outside this process (a second process on
+        # the same db file never reaches in-process listeners).
+        self._dirty: Optional[set] = set()
+        self._dirty_lock = threading.Lock()
+        self._last_full = 0.0
+        self.resync_interval = max(2.0, poll_interval * 10)
         # hooks fire off applied store transitions (any writer, any path:
         # executor callbacks, stops, compile failures, pipelines, cache
         # skips) — never off rejected late reports
@@ -288,6 +299,12 @@ class LocalAgent:
                 self._sync_to_store(run_uuid)
 
     def _on_transition_applied(self, run_uuid: str, status: str) -> None:
+        with self._dirty_lock:
+            if self._dirty is not None:
+                self._dirty.add(run_uuid)
+                if len(self._dirty) > 512:
+                    self._dirty = None  # overflow: next tick full-scans
+        self._wake.set()
         if is_done(status):
             self._fire_hooks(run_uuid, status)
 
@@ -424,12 +441,26 @@ class LocalAgent:
             if self._stop.is_set():
                 return
             try:
-                self.tick()
+                with self._dirty_lock:
+                    dirty = self._dirty
+                    self._dirty = set()
+                now = time.monotonic()
+                if dirty is None or now - self._last_full >= self.resync_interval:
+                    # overflow, or the periodic safety resync (catches
+                    # writers outside this process)
+                    self._last_full = now
+                    self.tick()
+                elif dirty:
+                    self._tick_dirty(dirty)
+                elif self.reconciler is not None:
+                    # nothing changed in the store; pods still need watching
+                    self.reconciler.reconcile_once()
+                    self._reconcile_sidecars()
             except Exception:
                 traceback.print_exc()
 
     def tick(self) -> None:
-        """One reconcile pass (public for deterministic tests)."""
+        """One full reconcile pass (public for deterministic tests)."""
         for run in self.store.list_runs(status=V1Statuses.CREATED.value):
             self._compile(run)
         for run in self.store.list_runs(status=V1Statuses.COMPILED.value):
@@ -438,6 +469,30 @@ class LocalAgent:
             self._maybe_schedule(run)
         for run in self.store.list_runs(status=V1Statuses.STOPPING.value):
             self._do_stop(run)
+        if self.reconciler is not None:
+            self.reconciler.reconcile_once()
+            self._reconcile_sidecars()
+
+    def _tick_dirty(self, dirty: set) -> None:
+        """Event-driven pass: advance exactly the runs the change feed
+        named. Each stage's transition re-fires the feed, so a run walks
+        created -> compiled -> queued -> scheduled across consecutive
+        wakes without any full-table scan. Queued runs are rescanned as a
+        set each pass — a terminal event means freed capacity, and the
+        waiting runs it unblocks are not in ``dirty``."""
+        for uuid in dirty:
+            run = self.store.get_run(uuid)
+            if run is None:
+                continue
+            status = run["status"]
+            if status == V1Statuses.CREATED.value:
+                self._compile(run)
+            elif status == V1Statuses.COMPILED.value:
+                self.store.transition(uuid, V1Statuses.QUEUED.value)
+            elif status == V1Statuses.STOPPING.value:
+                self._do_stop(run)
+        for run in self.store.list_runs(status=V1Statuses.QUEUED.value):
+            self._maybe_schedule(run)
         if self.reconciler is not None:
             self.reconciler.reconcile_once()
             self._reconcile_sidecars()
@@ -800,15 +855,12 @@ class LocalAgent:
         import time
 
         deadline = time.monotonic() + timeout
+        busy_statuses = [st.value for st in (
+            V1Statuses.CREATED, V1Statuses.COMPILED, V1Statuses.QUEUED,
+            V1Statuses.SCHEDULED, V1Statuses.STARTING, V1Statuses.RUNNING,
+            V1Statuses.STOPPING)]
         while time.monotonic() < deadline:
-            busy = None
-            for st in (V1Statuses.CREATED, V1Statuses.COMPILED,
-                       V1Statuses.QUEUED, V1Statuses.SCHEDULED,
-                       V1Statuses.STARTING, V1Statuses.RUNNING,
-                       V1Statuses.STOPPING):
-                busy = self.store.list_runs(status=st.value)
-                if busy:
-                    break
+            busy = self.store.list_runs(statuses=busy_statuses, limit=1)
             cluster_busy = self.reconciler is not None and self.reconciler.active_count() > 0
             if not busy and not self._active and not self._tuners and not cluster_busy:
                 return

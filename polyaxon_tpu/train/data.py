@@ -28,7 +28,7 @@ class DataConfig:
 def _batch_sharding(mesh: Optional[Mesh], extra_dims: int, seq_axis: bool = False):
     if mesh is None:
         return None
-    spec = [("data", "fsdp")] + ([None] * extra_dims)
+    spec = [("data", "fsdp", "expert")] + ([None] * extra_dims)
     if seq_axis:
         spec[1] = "context"
     return NamedSharding(mesh, P(*spec))

@@ -88,11 +88,16 @@ class LMTask(Task):
             vocab_major=vocab_major, chunk_tokens=self.cfg.loss_chunk_tokens,
         )
         metrics = {"loss": loss}
-        if self.cfg.num_experts and self.cfg.router_aux_coef:
-            # Switch-style load-balance term keeps the router from
-            # collapsing onto few experts
-            loss = loss + self.cfg.router_aux_coef * aux
-            metrics["router_aux"] = aux
+        if self.cfg.num_experts:
+            balance, drop_frac = aux[0], aux[1]
+            if self.cfg.router_aux_coef:
+                # Switch-style load-balance term keeps the router from
+                # collapsing onto few experts
+                loss = loss + self.cfg.router_aux_coef * balance
+            metrics["router_aux"] = balance
+            # silent quality loss otherwise: tokens past expert capacity
+            # contribute nothing to the MoE layer's output
+            metrics["router_drop_frac"] = drop_frac
         return loss, metrics, None
 
     def tokens_per_step(self, batch_size, seq_len):

@@ -112,7 +112,8 @@ class Trainer:
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), pspecs
         )
-        self.batch_sharding = NamedSharding(self.mesh, P(("data", "fsdp"), "context"))
+        self.batch_sharding = NamedSharding(
+            self.mesh, P(("data", "fsdp", "expert"), "context"))
         self._compiled_step = None
 
     # -- init / restore ----------------------------------------------------

@@ -297,6 +297,10 @@ class TestUi:
             r = requests.get(f"{srv.url}/", timeout=5)
             assert r.status_code == 200
             assert "polyaxon_tpu" in r.text and "runsTable" in r.text
+            # v2 surfaces: tabbed detail, compare, artifact browser, charts
+            for marker in ("renderCompare", "renderArtifacts", "lineChart",
+                           "data-tab=\"metrics\"", "artifacts/tree"):
+                assert marker in r.text, marker
             # the shell is open; the data endpoints it calls are not
             assert requests.get(f"{srv.url}/api/v1/projects", timeout=5).status_code == 401
         finally:

@@ -60,14 +60,14 @@ class TestBuildMesh:
 class TestShardingRules:
     def test_default_rules_batch(self):
         rules = ShardingRules()
-        assert rules.mesh_axes("batch") == ("data", "fsdp")
+        assert rules.mesh_axes("batch") == ("data", "fsdp", "expert")
         assert rules.mesh_axes("mlp") == "model"
         assert rules.mesh_axes(None) is None
 
     def test_spec(self):
         rules = ShardingRules()
         spec = rules.spec(["batch", "seq", None])
-        assert spec == PartitionSpec(("data", "fsdp"), "context", None)
+        assert spec == PartitionSpec(("data", "fsdp", "expert"), "context", None)
 
     def test_override(self):
         rules = ShardingRules().override(embed=None, custom="model")

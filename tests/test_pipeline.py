@@ -83,7 +83,7 @@ class TestGpipeTrunk:
                                        rtol=2e-5, atol=2e-5, err_msg=str(axes))
             # aux is averaged per microbatch under PP vs over the full batch
             # in one shot; same tokens, same router -> close, and never zero
-            assert float(aux) > 0.5, (axes, float(aux))
+            assert float(aux[0]) > 0.5, (axes, aux)
             np.testing.assert_allclose(float(aux), float(ref_aux), rtol=0.2)
 
     def test_layers_must_divide(self):
