@@ -144,7 +144,7 @@ async function loadRuns() {
       if (ev.target.checked) checked.add(r.uuid); else checked.delete(r.uuid);
       updateCmpBar();
     };
-    tr.onclick = () => { selected = r.uuid; compare = null; render(); };
+    tr.onclick = () => { selected = r.uuid; compare = null; artPath = ""; render(); };
     tb.appendChild(tr);
   }
   updateCmpBar();

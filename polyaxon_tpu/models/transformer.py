@@ -292,10 +292,13 @@ class InnerAxes:
     """Manual-collective mode for layer bodies running *inside* a shard_map
     (the pipeline): GSPMD constraints don't reach in there, so when the mesh
     has model/context axes the body psums its partial projections itself
-    (tp) and runs ring/Ulysses attention over the context axis (cp)."""
+    (tp), runs ring/Ulysses attention over the context axis (cp), and
+    dispatches MoE tokens with the manual all-to-all over the expert axis
+    (ep_size > 1; requires moe_dispatch="a2a")."""
 
     tp: bool = False
     cp: bool = False
+    ep_size: int = 1
 
 
 def _inner_attention(q, k, v, cfg: TransformerConfig, inner: InnerAxes, interpret):
@@ -569,11 +572,13 @@ def _moe_a2a(y, mp, cfg: TransformerConfig, top_idx, top_gates, mesh,
     local path — identical math, no comms.
     """
     if inner is not None:
-        # already inside a manual region (the pipeline's shard_map); the
-        # pipeline rejects stage x expert, so every device holds all
-        # experts here — the local core with no comm axis
+        # already inside a manual region (the pipeline's shard_map): run
+        # the local core directly, with the expert comm axis when the mesh
+        # shards experts
+        ep = inner.ep_size
         return _moe_a2a_local(
-            y, top_idx, top_gates, mp, cfg, None, 1,
+            y, top_idx, top_gates, mp, cfg,
+            "expert" if ep > 1 else None, ep,
             model_axis="model" if inner.tp else None)
     if mesh is None:
         return _moe_a2a_local(y, top_idx, top_gates, mp, cfg, None, 1)
@@ -618,8 +623,17 @@ def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interp
     if mesh is not None and mesh.shape.get("stage", 1) > 1:
         from ..parallel.pipeline import gpipe_trunk
 
+        ep_size = mesh.shape["expert"]
+        if cfg.num_experts and ep_size > 1 and cfg.moe_dispatch != "a2a":
+            raise ValueError(
+                f"pipeline with expert={ep_size} needs moe_dispatch='a2a': "
+                f"{cfg.moe_dispatch!r} dispatch assumes every expert is "
+                f"device-local, but each stage shard holds only "
+                f"num_experts/{ep_size} of them"
+            )
         inner = InnerAxes(
-            tp=mesh.shape["model"] > 1, cp=mesh.shape["context"] > 1)
+            tp=mesh.shape["model"] > 1, cp=mesh.shape["context"] > 1,
+            ep_size=ep_size)
         # params enter the pipeline shard_map sharded over stage (layer dim)
         # and model (TP dims); fsdp-sharded storage all-gathers at entry —
         # the same gather FSDP pays anyway, hoisted once per step.
@@ -640,10 +654,14 @@ def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interp
         return gpipe_trunk(
             x, layer_params, pp_body, mesh,
             num_microbatches=cfg.pp_microbatches, param_spec=pspec,
-            # TP psums / ring ppermutes inside the body must run on every
-            # device every tick (collectives can't sit under a stage-gated
-            # cond); without them, bubble ticks are skipped entirely
-            gate_ticks=not (inner.tp or inner.cp))
+            # TP psums / ring ppermutes / expert all-to-alls inside the
+            # body must run on every device every tick (collectives can't
+            # sit under a stage-gated cond); without them, bubble ticks
+            # are skipped entirely
+            # (the expert a2a only exists in MoE layers — dense models on
+            # an expert-axis mesh still gate their bubble ticks)
+            gate_ticks=not (inner.tp or inner.cp
+                            or (cfg.num_experts and inner.ep_size > 1)))
     return _scan_layers(x, layer_params, cfg, rope_tables, mesh, interpret)
 
 

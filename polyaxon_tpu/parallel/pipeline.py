@@ -26,9 +26,10 @@ zero garbage ticks and a single fused SPMD program.
 
 Composability (round 4): the pipeline shard_map now spans data/fsdp (batch),
 model (tensor parallelism: heads/mlp dims arrive pre-sharded, the layer body
-psums partial projections over ``model``) and context (sequence shards with
-ring attention inside the stage). ``expert`` inside a stage is still
-rejected loudly.
+psums partial projections over ``model``), context (sequence shards with
+ring attention inside the stage) and expert (tokens batch-shard over the
+axis; the MoE layer's manual all-to-all dispatch — moe_dispatch="a2a" —
+moves them to their experts inside the stage body).
 """
 
 from __future__ import annotations
@@ -42,16 +43,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def validate_pipeline_mesh(mesh: Mesh) -> int:
-    """Stage count, after rejecting unsupported axis combos."""
-    s = mesh.shape["stage"]
-    if s > 1 and mesh.shape["expert"] > 1:
-        raise NotImplementedError(
-            f"pipeline (stage={s}) with expert>1 is not supported: "
-            f"expert-sharded dispatch inside the pipeline shard_map would "
-            f"need a second manual all-to-all level. Use stage with "
-            f"data/fsdp/model/context."
-        )
-    return s
+    """Stage count. Every axis combo is supported as of round 4: expert>1
+    inside a stage runs the manual all-to-all dispatch (the model layer
+    must use moe_dispatch="a2a"; the transformer's pipeline path enforces
+    that loudly)."""
+    return mesh.shape["stage"]
 
 
 def gpipe_trunk(
@@ -86,14 +82,14 @@ def gpipe_trunk(
             f"{layer_count} layers do not divide over {num_stages} stages"
         )
     m = num_microbatches or 2 * num_stages
-    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    dp = mesh.shape["data"] * mesh.shape["fsdp"] * mesh.shape["expert"]
     if (x.shape[0] // dp) % m:
         raise ValueError(
             f"per-replica batch {x.shape[0]}//{dp} not divisible by "
             f"{m} pipeline microbatches"
         )
 
-    batch_spec = P(("data", "fsdp"), "context", None)
+    batch_spec = P(("data", "fsdp", "expert"), "context", None)
     if param_spec is None:
         param_spec = jax.tree.map(lambda _: P("stage"), layer_params)
 
@@ -160,7 +156,7 @@ def gpipe_trunk(
         # microbatches. Batch/context shards each saw different tokens, so
         # their means average too; model shards hold identical copies.
         aux = jax.lax.psum(aux_sum, "stage") / (num_stages * m)
-        aux = jax.lax.pmean(aux, ("data", "fsdp", "context"))
+        aux = jax.lax.pmean(aux, ("data", "fsdp", "expert", "context"))
         return outs.reshape(b, s, h), aux
 
     return _pipeline(x, layer_params)

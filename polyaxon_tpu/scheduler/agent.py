@@ -852,8 +852,6 @@ class LocalAgent:
 
     def wait_all(self, timeout: float = 300.0) -> None:
         """Block until no runs are active/queued (tests)."""
-        import time
-
         deadline = time.monotonic() + timeout
         busy_statuses = [st.value for st in (
             V1Statuses.CREATED, V1Statuses.COMPILED, V1Statuses.QUEUED,

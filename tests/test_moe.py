@@ -289,3 +289,58 @@ class TestA2ADispatch:
         mesh = self._mesh({"expert": 4, "data": 2})
         with pytest.raises(ValueError, match="not divisible"):
             transformer.apply(params, tokens, cfg, mesh=mesh)
+
+
+class TestMoEPipeline:
+    """MoE x PP composability (VERDICT r3 #2/#6 leftover): expert-sharded
+    a2a dispatch inside the pipeline's shard_map."""
+
+    def test_pp_ep_matches_dense_reference(self):
+        base = llama.LLAMA_MOE_TINY
+        ample = base.__class__(**{
+            **base.__dict__, "moe_dispatch": "a2a",
+            "expert_capacity_factor": float(base.num_experts) / base.expert_top_k,
+        })
+        dense_cfg = base.__class__(**{**base.__dict__, "moe_dispatch": "dense"})
+        params = transformer.init(jax.random.PRNGKey(0), ample)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (16, 16), 0,
+                                    base.vocab_size)
+        ref, ref_aux = transformer.apply_hidden(
+            params, tokens, dense_cfg, return_aux=True)
+        mesh = build_mesh({"stage": 2, "expert": 2, "data": 2},
+                          devices=jax.devices())
+        out, aux = transformer.apply_hidden(
+            params, tokens, ample, mesh=mesh, return_aux=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=2e-4, atol=2e-4)
+        # nothing drops at ample capacity; balance survives the schedule
+        assert float(aux[1]) == 0.0, aux
+        np.testing.assert_allclose(float(aux[0]), float(ref_aux[0]), rtol=0.2)
+
+    def test_pp_ep_training_step(self):
+        cfg = llama.LLAMA_MOE_TINY.__class__(**{
+            **llama.LLAMA_MOE_TINY.__dict__, "moe_dispatch": "a2a",
+        })
+        tr = Trainer(TrainerConfig(
+            model=cfg,
+            optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=0,
+                                      schedule="constant", total_steps=2),
+            batch_size=16, seq_len=16,
+            parallelism={"stage": 2, "expert": 2, "data": 2},
+        ))
+        data = make_batches(DataConfig(kind="synthetic-lm", batch_size=16,
+                                       seq_len=16, vocab_size=cfg.vocab_size),
+                            tr.mesh)
+        _, metrics = tr.fit(data, num_steps=2)
+        assert np.isfinite(metrics["loss"])
+        assert "router_drop_frac" in metrics
+
+    def test_pp_ep_rejects_capacity_dispatch(self):
+        cfg = llama.LLAMA_MOE_TINY  # capacity dispatch (default)
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (16, 16), 0,
+                                    cfg.vocab_size)
+        mesh = build_mesh({"stage": 2, "expert": 2, "data": 2},
+                          devices=jax.devices())
+        with pytest.raises(ValueError, match="a2a"):
+            transformer.apply(params, tokens, cfg, mesh=mesh)

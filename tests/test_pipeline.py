@@ -30,10 +30,12 @@ class TestGpipeTrunk:
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                    rtol=2e-5, atol=2e-5)
 
-    def test_rejects_expert_axis_combo(self):
+    def test_expert_axis_accepted(self):
+        # stage x expert composes as of round 4 (manual a2a dispatch in the
+        # stage body); the a2a requirement is enforced by the transformer's
+        # pipeline path (tests/test_moe.py::TestMoEPipeline)
         mesh = build_mesh({"stage": 2, "expert": 2, "data": 2})
-        with pytest.raises(NotImplementedError, match="expert"):
-            validate_pipeline_mesh(mesh)
+        assert validate_pipeline_mesh(mesh) == 2
 
     def test_trunk_matches_single_stage_with_tp(self):
         """stage x model: TP inside pipeline stages (manual psums) matches
@@ -84,7 +86,7 @@ class TestGpipeTrunk:
             # aux is averaged per microbatch under PP vs over the full batch
             # in one shot; same tokens, same router -> close, and never zero
             assert float(aux[0]) > 0.5, (axes, aux)
-            np.testing.assert_allclose(float(aux), float(ref_aux), rtol=0.2)
+            np.testing.assert_allclose(float(aux[0]), float(ref_aux[0]), rtol=0.2)
 
     def test_layers_must_divide(self):
         cfg = llama.LLAMA_TINY  # 2 layers
