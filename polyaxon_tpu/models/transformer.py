@@ -624,13 +624,18 @@ def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interp
         from ..parallel.pipeline import gpipe_trunk
 
         ep_size = mesh.shape["expert"]
-        if cfg.num_experts and ep_size > 1 and cfg.moe_dispatch != "a2a":
-            raise ValueError(
-                f"pipeline with expert={ep_size} needs moe_dispatch='a2a': "
-                f"{cfg.moe_dispatch!r} dispatch assumes every expert is "
-                f"device-local, but each stage shard holds only "
-                f"num_experts/{ep_size} of them"
-            )
+        if cfg.num_experts and ep_size > 1:
+            if cfg.moe_dispatch != "a2a":
+                raise ValueError(
+                    f"pipeline with expert={ep_size} needs moe_dispatch="
+                    f"'a2a': {cfg.moe_dispatch!r} dispatch assumes every "
+                    f"expert is device-local, but each stage shard holds "
+                    f"only num_experts/{ep_size} of them"
+                )
+            if cfg.num_experts % ep_size:
+                raise ValueError(
+                    f"num_experts {cfg.num_experts} not divisible by expert "
+                    f"mesh axis {ep_size}")
         inner = InnerAxes(
             tp=mesh.shape["model"] > 1, cp=mesh.shape["context"] > 1,
             ep_size=ep_size)

@@ -26,12 +26,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 # Canonical mesh axis order, outermost first.
 MESH_AXES: tuple[str, ...] = ("data", "fsdp", "stage", "expert", "context", "model")
 
-# Every axis has real execution support as of round 3 (VERDICT r1/r2
-# demanded loud rejection while any were unimplemented): ``stage`` via the
-# bubble-gated pipeline in parallel/pipeline.py (stage composes with
-# data/fsdp/model/context as of round 4; stage×expert is still rejected
-# there), ``expert`` via the MoE layer's expert-sharded einsums
-# (models/transformer.py _moe_mlp).
+# Every axis has real execution support, and as of round 4 every axis
+# composes with ``stage``: the bubble-gated pipeline in
+# parallel/pipeline.py spans data/fsdp/model/context/expert (expert via
+# the MoE layer's manual all-to-all dispatch — moe_dispatch="a2a" — the
+# only remaining loud rejection is capacity/dense dispatch inside a
+# pipeline, models/transformer.py run_trunk).
 
 
 def normalize_axis_sizes(parallelism: Union[Mapping[str, int], Any, None]) -> dict[str, int]:
