@@ -20,10 +20,7 @@ import re
 import sys
 from typing import Any
 
-try:
-    import yaml
-except ImportError:  # pragma: no cover
-    yaml = None
+import yaml
 
 CHART_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "chart")
 

@@ -15,6 +15,7 @@ Two backends (upstream rendered K8s podspecs only — SURVEY.md §2
 from __future__ import annotations
 
 import json
+import posixpath
 import shlex
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -189,8 +190,41 @@ def to_k8s_resources(
         "app.polyaxon.com/kind": kind or "job",
     }
 
+    # init steps become real initContainers: one per step, running this
+    # package's init entrypoint with the step spec in env — a kubelet (or
+    # the FakeCluster's fake one) runs them sequentially before main, and
+    # a failing step fails the pod (SURVEY.md §2 "Init container")
+    init_steps = [render_value(i.to_dict(), ctx)
+                  for i in (getattr(run, "init", None) or [])]
+    code_dir = posixpath.join(ctx["globals"]["run_artifacts_path"], "code")
+
+    run_dir = ctx["globals"]["run_artifacts_path"]
+
     def pod(name: str, container: dict, extra: Optional[dict] = None) -> dict:
         spec: dict[str, Any] = {"restartPolicy": "Never", "containers": [container]}
+        if init_steps:
+            # an emptyDir at the run context path makes the init output
+            # visible to main on a real kubelet (separate container
+            # filesystems); FakeCluster shares the host fs and ignores
+            # volumes
+            mount = [{"name": "plx-context", "mountPath": run_dir}]
+            spec["volumes"] = [{"name": "plx-context", "emptyDir": {}}]
+            spec["initContainers"] = [
+                {
+                    "name": f"plx-init-{i}",
+                    "image": container.get("image"),
+                    "command": ["python", "-m", "polyaxon_tpu.runtime.init"],
+                    "env": [{"name": k, "value": v} for k, v in base_env.items()]
+                           + [{"name": "PLX_INIT_STEP", "value": json.dumps(step)}],
+                    "volumeMounts": mount,
+                }
+                for i, step in enumerate(init_steps)
+            ]
+            container.setdefault("volumeMounts", []).append(mount[0])
+            if not container.get("workingDir"):
+                # parity with the local executor: fetched code is the
+                # default working dir, so `python t.py` finds init files
+                container["workingDir"] = code_dir
         if extra:
             spec.update(extra)
         return {

@@ -231,6 +231,40 @@ class FakeCluster(Cluster):
         os.makedirs(cwd, exist_ok=True)
         pod.log_path = os.path.join(self.workdir, f"{pod.name}.log")
         log_file = open(pod.log_path, "w", encoding="utf-8")
+        # fake kubelet: initContainers run sequentially before main, a
+        # non-zero exit fails the pod (real kubelet semantics). They run
+        # synchronously here — init steps are file/artifact fetches; a
+        # pathological clone would stall this tick, which the test double
+        # accepts for the determinism it buys.
+        for ic in spec.get("initContainers") or []:
+            argv_i = list(ic.get("command") or []) + list(ic.get("args") or [])
+            if not argv_i:
+                continue
+            if argv_i[0] in ("python", "python3"):
+                argv_i[0] = sys.executable
+            env_i = dict(os.environ)
+            for e in ic.get("env") or []:
+                if e.get("value") is not None:
+                    env_i[e["name"]] = self._rewrite_dns(str(e["value"]))
+            env_i = _with_pythonpath(env_i)
+            icwd = ic.get("workingDir") or self.workdir
+            os.makedirs(icwd, exist_ok=True)
+            try:
+                proc = subprocess.run(
+                    argv_i, env=env_i, cwd=icwd, stdout=log_file,
+                    stderr=subprocess.STDOUT, timeout=600,
+                )
+            except (OSError, subprocess.TimeoutExpired) as e:
+                log_file.write(f"[init:{ic.get('name')}] launch failed: {e}\n")
+                log_file.close()
+                pod.forced_phase = PodPhase.FAILED
+                return
+            if proc.returncode != 0:
+                log_file.write(
+                    f"[init:{ic.get('name')}] exit code {proc.returncode}\n")
+                log_file.close()
+                pod.forced_phase = PodPhase.FAILED
+                return
         try:
             pod.proc = subprocess.Popen(
                 argv, env=env, cwd=cwd,

@@ -34,6 +34,11 @@ def _init_git(spec: dict, run_dir: str) -> None:
     if not url:
         raise InitError("git init step needs 'url'")
     dest = os.path.join(run_dir, "code")
+    # idempotent across retries and across the host pods of a multi-host
+    # job sharing one run dir (FakeCluster serializes pod launches, so the
+    # last clone wins; real kubelets run inits in per-pod emptyDirs)
+    if os.path.isdir(dest):
+        shutil.rmtree(dest, ignore_errors=True)
     args = ["git", "clone", "--depth", "1"]
     if spec.get("revision"):
         args += ["--branch", spec["revision"]]
@@ -81,3 +86,26 @@ def _init_connection_path(step: dict, run_dir: str) -> None:
         raise InitError("connection init step needs 'path'")
     dest = os.path.join(run_dir, "artifacts_in", os.path.basename(path.rstrip("/")))
     download(path, dest)
+
+
+def main() -> None:
+    """Init-container entrypoint (``python -m polyaxon_tpu.runtime.init``):
+    the converter renders one pod initContainer per init step carrying the
+    step spec in ``PLX_INIT_STEP``; a real kubelet (or the FakeCluster's
+    fake one) runs them sequentially before the main container, the same
+    contract upstream's init containers had (SURVEY.md §2 "Init
+    container")."""
+    import json
+    import sys
+
+    step = json.loads(os.environ["PLX_INIT_STEP"])
+    run_dir = os.environ["PLX_ARTIFACTS_PATH"]
+    try:
+        run_init_step(step, run_dir)
+    except InitError as e:
+        print(f"[init] failed: {e}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

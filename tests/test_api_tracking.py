@@ -305,3 +305,36 @@ class TestUi:
             assert requests.get(f"{srv.url}/api/v1/projects", timeout=5).status_code == 401
         finally:
             srv.stop()
+
+
+class TestOpenApi:
+    def test_descriptor_covers_routes_and_is_open(self, tmp_path):
+        import requests
+
+        from polyaxon_tpu.api.server import ApiServer
+
+        srv = ApiServer(artifacts_root=str(tmp_path), port=0,
+                        auth_token="t0ken").start()
+        try:
+            # open even when auth is engaged: it carries no tenant data
+            r = requests.get(f"{srv.url}/api/v1/openapi.json", timeout=5)
+            assert r.status_code == 200
+            spec = r.json()
+            assert spec["openapi"].startswith("3.")
+            paths = spec["paths"]
+            for p, method in (
+                ("/api/v1/projects", "get"),
+                ("/api/v1/{project}/runs", "post"),
+                ("/api/v1/{project}/runs/{uuid}/statuses", "post"),
+                ("/api/v1/{project}/runs/{uuid}/logs", "get"),
+                ("/api/v1/{project}/runs/{uuid}/artifacts/file", "get"),
+                ("/api/v1/tokens", "post"),
+            ):
+                assert method in paths.get(p, {}), p
+            # path params are declared
+            op = paths["/api/v1/{project}/runs/{uuid}"]["get"]
+            names = {x["name"] for x in op["parameters"]}
+            assert names == {"project", "uuid"}
+            assert spec["security"] == [{"bearer": []}]
+        finally:
+            srv.stop()

@@ -171,3 +171,80 @@ run:
         r = _resolved(yaml_text)
         assert r.payload.builtin["model"] == "llama-tiny"
         assert r.payload.builtin["parallelism"]["data"] == 8
+
+
+class TestInitContainers:
+    """Init steps render as real pod initContainers (SURVEY.md §2 "Init
+    container"): a kubelet — or the FakeCluster's fake one — runs them
+    sequentially before main, and a failing step fails the pod."""
+
+    INIT_YAML = """
+kind: component
+name: with-init
+run:
+  kind: tpujob
+  accelerator: v5e
+  topology: 2x2
+  init:
+    - file: {filename: t.py, content: "print('hi')"}
+    - git: {url: "https://example.com/r.git"}
+  container:
+    command: [python, t.py]
+"""
+
+    def test_init_steps_become_init_containers(self):
+        import json as _json
+
+        r = _resolved(self.INIT_YAML)
+        pod = [d for d in r.k8s_resources() if d["kind"] == "Pod"][0]
+        ics = pod["spec"]["initContainers"]
+        assert len(ics) == 2
+        for ic in ics:
+            assert ic["command"] == ["python", "-m", "polyaxon_tpu.runtime.init"]
+            env = {e["name"]: e["value"] for e in ic["env"]}
+            assert "PLX_INIT_STEP" in env and env["PLX_ARTIFACTS_PATH"]
+        step0 = _json.loads(
+            {e["name"]: e["value"] for e in ics[0]["env"]}["PLX_INIT_STEP"])
+        assert step0["file"]["filename"] == "t.py"
+        # main container defaults its workingDir to the fetched code dir,
+        # matching the local executor's semantics
+        main = pod["spec"]["containers"][0]
+        assert main["workingDir"] == "/tmp/plx/proj/abc/code"
+
+    def test_no_init_no_init_containers(self):
+        r = _resolved(TPU_YAML)
+        pod = [d for d in r.k8s_resources() if d["kind"] == "Pod"][0]
+        assert "initContainers" not in pod["spec"]
+        assert pod["spec"]["containers"][0]["workingDir"] is None
+
+    def test_failing_init_fails_cluster_pod(self, tmp_path):
+        """FakeCluster (fake kubelet): a failing initContainer fails the
+        pod before main ever runs."""
+        import os
+        import sys as _sys
+
+        from polyaxon_tpu.operator.cluster import FakeCluster, PodPhase
+
+        fc = FakeCluster(str(tmp_path / "c"))
+        fc.apply({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p1", "labels": {"app.polyaxon.com/run": "r"}},
+            "spec": {
+                "restartPolicy": "Never",
+                "initContainers": [{
+                    "name": "plx-init-0",
+                    "command": [_sys.executable, "-c", "raise SystemExit(3)"],
+                    "env": [],
+                }],
+                "containers": [{
+                    "name": "main",
+                    "command": [_sys.executable, "-c",
+                                f"open({str(tmp_path / 'ran')!r}, 'w').write('x')"],
+                    "env": [],
+                }],
+            },
+        })
+        st = fc.pod_statuses({"app.polyaxon.com/run": "r"})[0]
+        assert st.phase == PodPhase.FAILED
+        assert not os.path.exists(tmp_path / "ran"), "main ran after failed init"
+        assert "exit code 3" in fc.pod_logs("p1")
