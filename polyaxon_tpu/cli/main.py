@@ -137,7 +137,11 @@ def run(files, params, set_overrides, presets, project, name, host, local, watch
     # local embedded mode
     store, agent = _local_stack(data_dir, backend=backend)
     agent.start()
-    run_row = store.create_run(project, spec=op.to_dict(), name=op.name or name)
+    from ..client import params_to_inputs
+
+    op_spec = op.to_dict()
+    run_row = store.create_run(project, spec=op_spec, name=op.name or name,
+                               inputs=params_to_inputs(op_spec))
     click.echo(f"Run {run_row['uuid']} created (local)")
     if not watch:
         click.echo("agent running in this process only with --watch; "
@@ -331,6 +335,51 @@ def ops_artifacts(uuid, project, host, path, dest):
             click.echo(name + suffix)
     else:
         click.echo(target)
+
+
+@ops.command("compare")
+@click.argument("uuids", nargs=-1, required=True)
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+def ops_compare(uuids, project, host):
+    """Side-by-side params / outputs / status for two or more runs (the
+    CLI face of the dashboard's compare view)."""
+    if len(uuids) < 2:
+        raise click.ClickException("compare needs at least two run uuids")
+    from ..client import ApiError
+
+    rc, local = _ops_client(host, project)
+    rows = []
+    for u in uuids:
+        try:
+            row = rc.refresh(u) if rc else local[0].get_run(u)
+        except ApiError as e:
+            if e.status == 404:
+                row = None
+            else:
+                raise
+        if not row:
+            raise click.ClickException(f"run not found: {u}")
+        rows.append(row)
+    keys: list[str] = []
+    for r in rows:
+        for k in list((r.get("inputs") or {})) + list((r.get("outputs") or {})):
+            if k not in keys:
+                keys.append(k)
+    name_w = max(12, *(len(str(r.get("name") or r["uuid"][:8])) for r in rows))
+    header = f"{'':<16}" + "".join(
+        f"{str(r.get('name') or r['uuid'][:8]):<{name_w + 2}}" for r in rows)
+    click.echo(header)
+    click.echo(f"{'status':<16}" + "".join(
+        f"{r['status']:<{name_w + 2}}" for r in rows))
+    for k in keys:
+        vals = []
+        for r in rows:
+            v = (r.get("inputs") or {}).get(k, (r.get("outputs") or {}).get(k))
+            if isinstance(v, float):
+                v = f"{v:.6g}"
+            vals.append(str(v) if v is not None else "-")
+        click.echo(f"{k:<16}" + "".join(f"{v:<{name_w + 2}}" for v in vals))
 
 
 @ops.command("stop")

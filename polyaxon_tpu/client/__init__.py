@@ -1,3 +1,4 @@
 """API clients (upstream RunClient/ProjectClient equivalents)."""
 
-from .client import ApiError, BaseClient, ProjectClient, RunClient, TokenClient
+from .client import (ApiError, BaseClient, ProjectClient, RunClient,
+                     TokenClient, params_to_inputs)

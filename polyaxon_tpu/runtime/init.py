@@ -34,18 +34,32 @@ def _init_git(spec: dict, run_dir: str) -> None:
     if not url:
         raise InitError("git init step needs 'url'")
     dest = os.path.join(run_dir, "code")
-    # idempotent across retries and across the host pods of a multi-host
-    # job sharing one run dir (FakeCluster serializes pod launches, so the
-    # last clone wins; real kubelets run inits in per-pod emptyDirs)
-    if os.path.isdir(dest):
-        shutil.rmtree(dest, ignore_errors=True)
+    if os.path.isdir(os.path.join(dest, ".git")):
+        # already cloned: a retry, or another host pod of a multi-host job
+        # sharing one run dir (FakeCluster serializes init launches; real
+        # kubelets give each pod its own emptyDir). Never re-clone — the
+        # first pod's main container may already be running from dest.
+        return
+    # clone beside dest, then merge in: dest may already hold earlier
+    # file/dockerfile init-step outputs that must survive
+    tmp = dest + ".cloning"
+    shutil.rmtree(tmp, ignore_errors=True)
     args = ["git", "clone", "--depth", "1"]
     if spec.get("revision"):
         args += ["--branch", spec["revision"]]
-    args += list(spec.get("flags") or []) + [url, dest]
+    args += list(spec.get("flags") or []) + [url, tmp]
     proc = subprocess.run(args, capture_output=True, text=True, timeout=300)
     if proc.returncode != 0:
+        shutil.rmtree(tmp, ignore_errors=True)
         raise InitError(f"git clone failed: {proc.stderr[-500:]}")
+    os.makedirs(dest, exist_ok=True)
+    for entry in os.listdir(tmp):
+        src, dst = os.path.join(tmp, entry), os.path.join(dest, entry)
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+        else:
+            shutil.copy2(src, dst)
+    shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _init_file(spec: dict, run_dir: str) -> None:

@@ -104,3 +104,50 @@ class TestOpsArtifacts:
         # escape attempt is rejected
         esc = runner.invoke(cli, ["ops", "artifacts", uuid, "--path", "../.."])
         assert esc.exit_code != 0
+
+
+class TestOpsCompare:
+    def test_compare_two_runs(self, tmp_path, monkeypatch):
+        """`ops compare` prints params/outputs side by side (the CLI face
+        of the dashboard compare view)."""
+        data_dir = str(tmp_path / "state")
+        spec = tmp_path / "job.yaml"
+        spec.write_text(
+            "version: 1.1\n"
+            "kind: component\n"
+            "name: cmp\n"
+            "inputs:\n"
+            "  - {name: lr, type: float}\n"
+            "run:\n"
+            "  kind: job\n"
+            "  container:\n"
+            "    command: [python, -c, \"import os, json; "
+            "open(os.path.join(os.environ['PLX_ARTIFACTS_PATH'], "
+            "'outputs.json'), 'w').write(json.dumps({'loss': "
+            "float(json.loads(os.environ['PLX_PARAMS'])['lr']) * 2}))\"]\n"
+        )
+        runner = CliRunner()
+        for lr in ("0.1", "0.2"):
+            result = runner.invoke(
+                cli, ["run", "-f", str(spec), "-P", f"lr={lr}",
+                      "--data-dir", data_dir],
+                catch_exceptions=False,
+            )
+            assert result.exit_code == 0, result.output
+        monkeypatch.chdir(tmp_path)
+        os.rename(data_dir, str(tmp_path / ".plx"))
+        ls = runner.invoke(cli, ["ops", "ls"], catch_exceptions=False)
+        uuids = [line.split()[0] for line in ls.output.strip().splitlines()]
+        assert len(uuids) == 2
+        out = runner.invoke(cli, ["ops", "compare", *uuids],
+                            catch_exceptions=False)
+        assert out.exit_code == 0, out.output
+        lines = out.output.splitlines()
+        assert lines[1].startswith("status")
+        assert any(line.startswith("lr") and "0.1" in line and "0.2" in line
+                   for line in lines), out.output
+        assert any(line.startswith("loss") and "0.2" in line and "0.4" in line
+                   for line in lines), out.output
+        # one uuid is an error, not a degenerate table
+        single = runner.invoke(cli, ["ops", "compare", uuids[0]])
+        assert single.exit_code != 0

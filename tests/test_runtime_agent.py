@@ -421,3 +421,39 @@ class TestChangeFeed:
             agent._on_transition_applied(f"u{i}", "created")
         # >512 dirty uuids -> overflow marker, next loop pass full-scans
         assert agent._dirty is None
+
+
+class TestGitInitIdempotency:
+    def _make_repo(self, tmp_path):
+        import subprocess as sp
+
+        repo = str(tmp_path / "repo")
+        os.makedirs(repo)
+        (tmp_path / "repo" / "r.txt").write_text("from-git")
+        for cmd in (["git", "init", "-q"],
+                    ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                     "add", "."],
+                    ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                     "commit", "-q", "-m", "x"]):
+            sp.run(cmd, cwd=repo, check=True, capture_output=True)
+        return repo
+
+    def test_clone_preserves_earlier_file_steps_and_skips_reclone(self, tmp_path):
+        """file -> git init ordering must keep the file step's output (the
+        clone merges in beside it), and a second git step — a retry or a
+        sibling host pod on a shared run dir — skips instead of yanking
+        the directory from under a running main."""
+        from polyaxon_tpu.runtime.init import run_init_step
+
+        repo = self._make_repo(tmp_path)
+        run_dir = str(tmp_path / "run")
+        run_init_step({"file": {"filename": "t.py", "content": "print(1)"}},
+                      run_dir)
+        run_init_step({"git": {"url": f"file://{repo}"}}, run_dir)
+        code = tmp_path / "run" / "code"
+        assert (code / "t.py").read_text() == "print(1)"
+        assert (code / "r.txt").read_text() == "from-git"
+        # marker survives a repeat git step (skip, not re-clone)
+        (code / "marker").write_text("m")
+        run_init_step({"git": {"url": f"file://{repo}"}}, run_dir)
+        assert (code / "marker").exists()
