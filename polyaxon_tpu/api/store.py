@@ -219,6 +219,23 @@ class Store:
             d[c] = json.loads(d[c]) if d[c] else None
         return d
 
+    @staticmethod
+    def _params_to_inputs(spec: dict) -> Optional[dict]:
+        """A run's queryable inputs default to its bound param values
+        (upstream stored resolved params on the run row; compare/sort
+        read them). Ref params carry an unresolved context expression as
+        their value and context_only params aren't inputs — skip both."""
+        params = spec.get("params") or {}
+        out = {}
+        for k, v in params.items():
+            if isinstance(v, dict):
+                if v.get("ref") or v.get("context_only") or v.get("contextOnly"):
+                    continue
+                out[k] = v.get("value")
+            else:
+                out[k] = v
+        return out or None
+
     def create_run(
         self,
         project: str,
@@ -234,6 +251,10 @@ class Store:
         pipeline_uuid: Optional[str] = None,
     ) -> dict:
         self.create_project(project)
+        if inputs is None and spec:
+            # one place for every creation path (CLI, client, server, DAG
+            # and schedule children, tuner trials pass explicit inputs)
+            inputs = self._params_to_inputs(spec)
         run_uuid = uuid or uuid_mod.uuid4().hex
         now = _now()
         with self._conn_ctx() as conn:

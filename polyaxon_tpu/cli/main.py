@@ -137,11 +137,7 @@ def run(files, params, set_overrides, presets, project, name, host, local, watch
     # local embedded mode
     store, agent = _local_stack(data_dir, backend=backend)
     agent.start()
-    from ..client import params_to_inputs
-
-    op_spec = op.to_dict()
-    run_row = store.create_run(project, spec=op_spec, name=op.name or name,
-                               inputs=params_to_inputs(op_spec))
+    run_row = store.create_run(project, spec=op.to_dict(), name=op.name or name)
     click.echo(f"Run {run_row['uuid']} created (local)")
     if not watch:
         click.echo("agent running in this process only with --watch; "

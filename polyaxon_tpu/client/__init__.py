@@ -1,4 +1,3 @@
 """API clients (upstream RunClient/ProjectClient equivalents)."""
 
-from .client import (ApiError, BaseClient, ProjectClient, RunClient,
-                     TokenClient, params_to_inputs)
+from .client import ApiError, BaseClient, ProjectClient, RunClient, TokenClient

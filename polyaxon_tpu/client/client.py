@@ -14,16 +14,6 @@ from ..schemas.operation import V1Operation
 from ..schemas.statuses import V1Statuses, is_done
 
 
-def params_to_inputs(spec: dict) -> Optional[dict]:
-    """A run's queryable inputs are its bound param values (upstream
-    stored resolved params on the run row; compare/sort features read
-    them)."""
-    params = spec.get("params") or {}
-    out = {k: (v.get("value") if isinstance(v, dict) else v)
-           for k, v in params.items()}
-    return out or None
-
-
 class ApiError(RuntimeError):
     def __init__(self, status: int, message: str):
         super().__init__(f"API error {status}: {message}")
@@ -115,8 +105,8 @@ class RunClient(BaseClient):
         if operation is not None:
             spec = operation.to_dict()
             name = name or operation.name
-        if inputs is None and spec:
-            inputs = params_to_inputs(spec)
+        # inputs default server-side: the store derives them from the
+        # spec's bound params (Store._params_to_inputs)
         run = self._json("POST", f"/api/v1/{self.project}/runs", json={
             "spec": spec, "name": name, "kind": kind, "inputs": inputs,
             "meta": meta, "tags": tags, "pipeline_uuid": pipeline_uuid,

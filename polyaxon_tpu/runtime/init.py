@@ -53,13 +53,26 @@ def _init_git(spec: dict, run_dir: str) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
         raise InitError(f"git clone failed: {proc.stderr[-500:]}")
     os.makedirs(dest, exist_ok=True)
-    for entry in os.listdir(tmp):
-        src, dst = os.path.join(tmp, entry), os.path.join(dest, entry)
-        if os.path.isdir(src):
-            shutil.copytree(src, dst, dirs_exist_ok=True)
-        else:
-            shutil.copy2(src, dst)
-    shutil.rmtree(tmp, ignore_errors=True)
+    # .git copies LAST: its presence is the already-cloned marker above, so
+    # a merge interrupted mid-way (eviction, OOM kill) leaves no .git and
+    # the retry re-clones instead of latching onto a partial checkout.
+    # symlinks copy as links — repos carry relative/broken links routinely.
+    entries = sorted(os.listdir(tmp), key=lambda e: e == ".git")
+    try:
+        for entry in entries:
+            src, dst = os.path.join(tmp, entry), os.path.join(dest, entry)
+            if os.path.islink(src):
+                if os.path.lexists(dst):
+                    os.remove(dst)
+                os.symlink(os.readlink(src), dst)
+            elif os.path.isdir(src):
+                shutil.copytree(src, dst, symlinks=True, dirs_exist_ok=True)
+            else:
+                shutil.copy2(src, dst, follow_symlinks=False)
+    except (OSError, shutil.Error) as e:
+        raise InitError(f"git checkout merge failed: {e}") from e
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _init_file(spec: dict, run_dir: str) -> None:

@@ -338,3 +338,22 @@ class TestOpenApi:
             assert spec["security"] == [{"bearer": []}]
         finally:
             srv.stop()
+
+
+class TestRunInputsDerivation:
+    def test_store_derives_inputs_from_spec_params(self):
+        from polyaxon_tpu.api.store import Store
+
+        store = Store(":memory:")
+        run = store.create_run("p", spec={"params": {
+            "lr": {"value": 0.1},
+            "opt": "adam",                          # bare value form
+            "prev": {"ref": "ops.train", "value": "outputs.loss"},
+            "ctx": {"value": 1, "contextOnly": True},
+        }})
+        # bound values recorded; ref exprs and context-only params skipped
+        assert run["inputs"] == {"lr": 0.1, "opt": "adam"}
+        # explicit inputs always win
+        run2 = store.create_run("p", spec={"params": {"lr": {"value": 0.1}}},
+                                inputs={"override": True})
+        assert run2["inputs"] == {"override": True}

@@ -430,6 +430,8 @@ class TestGitInitIdempotency:
         repo = str(tmp_path / "repo")
         os.makedirs(repo)
         (tmp_path / "repo" / "r.txt").write_text("from-git")
+        os.symlink("r.txt", str(tmp_path / "repo" / "alias"))
+        os.symlink("/nonexistent/broken", str(tmp_path / "repo" / "dangling"))
         for cmd in (["git", "init", "-q"],
                     ["git", "-c", "user.email=t@t", "-c", "user.name=t",
                      "add", "."],
@@ -453,6 +455,9 @@ class TestGitInitIdempotency:
         code = tmp_path / "run" / "code"
         assert (code / "t.py").read_text() == "print(1)"
         assert (code / "r.txt").read_text() == "from-git"
+        # symlinks survive as links; a dangling link must not fail the step
+        assert (code / "alias").read_text() == "from-git"
+        assert os.path.islink(code / "dangling")
         # marker survives a repeat git step (skip, not re-clone)
         (code / "marker").write_text("m")
         run_init_step({"git": {"url": f"file://{repo}"}}, run_dir)
