@@ -30,13 +30,13 @@ import os
 import re
 from typing import Optional
 
-_PATH_PARAM_RE = re.compile(r"\{(\w+)\}")
-
 from aiohttp import web
 
 from ..schemas.statuses import V1Statuses
 from ..tracking.writer import list_event_names, read_events
 from .store import Store
+
+_PATH_PARAM_RE = re.compile(r"\{(\w+)\}")
 
 
 def run_artifacts_dir(artifacts_root: str, project: str, uuid: str) -> str:
@@ -198,6 +198,7 @@ class ApiApp:
         })
 
     async def list_projects(self, request):
+        """List projects (scoped tokens see only their own)."""
         projects = self.store.list_projects()
         scope = request.get("scope_project")
         if scope is not None:
@@ -205,6 +206,7 @@ class ApiApp:
         return _json(projects)
 
     async def create_token(self, request):
+        """Mint an access token: admin, or scoped to one project."""
         # minting over the network requires an authenticated caller: on an
         # open server an anonymous first mint would flip auth ON with the
         # attacker holding the only admin credential (review r4 finding).
@@ -222,9 +224,11 @@ class ApiApp:
         return _json(out, 201)
 
     async def list_tokens(self, request):
+        """List token metadata (never raw tokens)."""
         return _json(self.store.list_tokens())
 
     async def revoke_token(self, request):
+        """Revoke a token by id."""
         try:
             tid = int(request.match_info["token_id"])
         except ValueError:
@@ -233,14 +237,17 @@ class ApiApp:
         return _json({"revoked": ok}) if ok else _not_found()
 
     async def create_project(self, request):
+        """Create a project (idempotent)."""
         body = await request.json()
         return _json(self.store.create_project(body["name"], body.get("description")), 201)
 
     async def get_project(self, request):
+        """Fetch one project."""
         p = self.store.get_project(request.match_info["project"])
         return _json(p) if p else _not_found()
 
     async def create_run(self, request):
+        """Create a run from an operation spec body."""
         project = request.match_info["project"]
         body = await request.json()
         run = self.store.create_run(
@@ -257,6 +264,7 @@ class ApiApp:
         return _json(run, 201)
 
     async def list_runs(self, request):
+        """List runs (?status=&limit=&offset=)."""
         q = request.rel_url.query
         return _json(self.store.list_runs(
             project=request.match_info["project"],
@@ -270,14 +278,17 @@ class ApiApp:
         return self.store.get_run(request.match_info["uuid"])
 
     async def get_run(self, request):
+        """Fetch one run row."""
         run = self._run(request)
         return _json(run) if run else _not_found()
 
     async def delete_run(self, request):
+        """Delete a run and its artifacts."""
         ok = self.store.delete_run(request.match_info["uuid"])
         return _json({"deleted": ok}, 200 if ok else 404)
 
     async def post_status(self, request):
+        """Apply a status transition {status, reason?, message?}."""
         body = await request.json()
         run, changed = self.store.transition(
             request.match_info["uuid"], body["status"],
@@ -289,6 +300,7 @@ class ApiApp:
         return _json({"run": run, "changed": changed})
 
     async def get_statuses(self, request):
+        """Status condition history for a run."""
         run = self._run(request)
         if run is None:
             return _not_found()
@@ -296,11 +308,13 @@ class ApiApp:
                       "conditions": self.store.get_statuses(run["uuid"])})
 
     async def post_outputs(self, request):
+        """Merge a dict into run.outputs."""
         body = await request.json()
         run = self.store.merge_outputs(request.match_info["uuid"], body)
         return _json(run) if run else _not_found()
 
     async def stop_run(self, request):
+        """Request the run stop (stopping -> stopped)."""
         run, changed = self.store.transition(
             request.match_info["uuid"], V1Statuses.STOPPING.value
         )
@@ -336,6 +350,7 @@ class ApiApp:
         return _json(clone, 201)
 
     async def get_metrics(self, request):
+        """Metric events per name (?names=a,b)."""
         run = self._run(request)
         if run is None:
             return _not_found()
@@ -348,6 +363,7 @@ class ApiApp:
         return _json(out)
 
     async def get_events(self, request):
+        """Events of any kind per name."""
         run = self._run(request)
         if run is None:
             return _not_found()
@@ -358,6 +374,7 @@ class ApiApp:
         return _json({n: [e.to_dict() for e in read_events(rd, kind, n)] for n in names})
 
     async def get_logs(self, request):
+        """Log text (?offset=N&tail=M; X-Log-Offset header)."""
         run = self._run(request)
         if run is None:
             return _not_found()
@@ -399,6 +416,7 @@ class ApiApp:
         return p
 
     async def artifacts_tree(self, request):
+        """List an artifact directory (?path=)."""
         run = self._run(request)
         if run is None:
             return _not_found()
@@ -417,6 +435,7 @@ class ApiApp:
         return _json({"path": rel, "dirs": dirs, "files": files})
 
     async def artifacts_file(self, request):
+        """Download one artifact file (?path=)."""
         run = self._run(request)
         if run is None:
             return _not_found()
@@ -428,6 +447,7 @@ class ApiApp:
         return web.FileResponse(p)
 
     async def post_lineage(self, request):
+        """Record an artifact lineage entry."""
         run = self._run(request)
         if run is None:
             return _not_found()
@@ -436,6 +456,7 @@ class ApiApp:
         return _json({"ok": True}, 201)
 
     async def get_lineage(self, request):
+        """Artifact lineage entries for a run."""
         run = self._run(request)
         if run is None:
             return _not_found()

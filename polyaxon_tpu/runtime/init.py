@@ -52,27 +52,41 @@ def _init_git(spec: dict, run_dir: str) -> None:
     if proc.returncode != 0:
         shutil.rmtree(tmp, ignore_errors=True)
         raise InitError(f"git clone failed: {proc.stderr[-500:]}")
-    os.makedirs(dest, exist_ok=True)
-    # .git copies LAST: its presence is the already-cloned marker above, so
-    # a merge interrupted mid-way (eviction, OOM kill) leaves no .git and
-    # the retry re-clones instead of latching onto a partial checkout.
-    # symlinks copy as links — repos carry relative/broken links routinely.
-    entries = sorted(os.listdir(tmp), key=lambda e: e == ".git")
+    # Fold dest's earlier init-step outputs into the temp clone (clone
+    # content wins on collision), then swap tmp into place. Each rename is
+    # atomic, so an interruption at any point leaves either the old dest
+    # (no .git — the retry re-clones) or the complete new checkout; the
+    # .git marker can never latch onto a partial merge. Symlinks copy as
+    # links — repos carry relative/broken links routinely.
     try:
-        for entry in entries:
-            src, dst = os.path.join(tmp, entry), os.path.join(dest, entry)
-            if os.path.islink(src):
-                if os.path.lexists(dst):
-                    os.remove(dst)
-                os.symlink(os.readlink(src), dst)
-            elif os.path.isdir(src):
-                shutil.copytree(src, dst, symlinks=True, dirs_exist_ok=True)
-            else:
-                shutil.copy2(src, dst, follow_symlinks=False)
+        if os.path.isdir(dest):
+            _merge_missing(dest, tmp)
+        old = dest + ".old"
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.isdir(dest):
+            os.rename(dest, old)
+        os.rename(tmp, dest)
+        shutil.rmtree(old, ignore_errors=True)
     except (OSError, shutil.Error) as e:
-        raise InitError(f"git checkout merge failed: {e}") from e
-    finally:
         shutil.rmtree(tmp, ignore_errors=True)
+        raise InitError(f"git checkout merge failed: {e}") from e
+
+
+def _merge_missing(src_dir: str, dst_dir: str) -> None:
+    """Recursively copy entries of src_dir that dst_dir lacks (existing
+    dst entries win); symlinks are recreated, never dereferenced."""
+    for name in os.listdir(src_dir):
+        s, d = os.path.join(src_dir, name), os.path.join(dst_dir, name)
+        if os.path.islink(s):
+            if not os.path.lexists(d):
+                os.symlink(os.readlink(s), d)
+        elif os.path.isdir(s):
+            if os.path.lexists(d) and not os.path.isdir(d):
+                continue  # dst's file wins over src's directory
+            os.makedirs(d, exist_ok=True)
+            _merge_missing(s, d)
+        elif not os.path.lexists(d):
+            shutil.copy2(s, d, follow_symlinks=False)
 
 
 def _init_file(spec: dict, run_dir: str) -> None:

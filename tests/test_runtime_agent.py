@@ -462,3 +462,21 @@ class TestGitInitIdempotency:
         (code / "marker").write_text("m")
         run_init_step({"git": {"url": f"file://{repo}"}}, run_dir)
         assert (code / "marker").exists()
+
+    def test_retry_after_interrupted_merge_self_heals(self, tmp_path):
+        """A prior merge killed mid-way (symlinks/files present, no .git)
+        must not wedge the retry: the clone folds the leftovers in and
+        swaps atomically."""
+        from polyaxon_tpu.runtime.init import run_init_step
+
+        repo = self._make_repo(tmp_path)
+        run_dir = str(tmp_path / "run")
+        code = tmp_path / "run" / "code"
+        # simulate the partial state: a symlink and a file, no .git marker
+        os.makedirs(code)
+        os.symlink("r.txt", code / "alias")
+        (code / "earlier.py").write_text("keep")
+        run_init_step({"git": {"url": f"file://{repo}"}}, run_dir)
+        assert (code / "r.txt").read_text() == "from-git"
+        assert (code / "earlier.py").read_text() == "keep"
+        assert (code / ".git").is_dir()
