@@ -32,6 +32,7 @@ from ..ops import (
     apply_rope,
     attention,
     dense_attention,
+    gated as _gated,
     gelu,
     layer_norm,
     repeat_kv,
@@ -71,6 +72,10 @@ class TransformerConfig:
     attn_block_k: int = 512
     loss_chunk_tokens: int = 4096               # blockwise-CE chunk; 0 = unchunked
     pp_microbatches: int = 0                    # GPipe microbatches; 0 = 2*stages
+    # Pipeline bubble-tick gating (parallel/pipeline.py): "auto" picks
+    # "inner" when the stage body carries collectives (TP/CP/EP) and "full"
+    # otherwise; "none" is the ungated masked oracle for parity tests.
+    pp_gate: str = "auto"                       # "auto" | "full" | "inner" | "none"
     # Mixture-of-experts: >0 replaces each layer's MLP with num_experts
     # expert MLPs + a top-k router. Experts shard over the `expert` mesh
     # axis (EP). Dispatch:
@@ -302,10 +307,13 @@ class InnerAxes:
     ep_size: int = 1
 
 
-def _inner_attention(q, k, v, cfg: TransformerConfig, inner: InnerAxes, interpret):
+def _inner_attention(q, k, v, cfg: TransformerConfig, inner: InnerAxes,
+                     interpret, active=None):
     """Attention for a device-local shard inside the pipeline shard_map:
     heads are already model-sharded; the context axis (if >1) runs ring or
-    Ulysses exactly like the non-pipelined shard_map path."""
+    Ulysses exactly like the non-pipelined shard_map path. ``active`` gates
+    the kernel launches on bubble ticks; ring/Ulysses run their
+    ppermutes/all-to-alls unconditionally either way."""
     if inner.cp:
         k = repeat_kv(k, q.shape[1])
         v = repeat_kv(v, q.shape[1])
@@ -314,17 +322,17 @@ def _inner_attention(q, k, v, cfg: TransformerConfig, inner: InnerAxes, interpre
                 q, k, v, axis_name="context", causal=cfg.causal,
                 block_q=min(cfg.attn_block_q, q.shape[2]),
                 block_k=min(cfg.attn_block_k, k.shape[2]),
-                interpret=interpret,
+                interpret=interpret, active=active,
             )
         return ulysses_attention(
             q, k, v, axis_name="context", causal=cfg.causal,
-            impl=cfg.attn_impl, interpret=interpret,
+            impl=cfg.attn_impl, interpret=interpret, active=active,
         )
-    return attention(
-        q, k, v, causal=cfg.causal, impl=cfg.attn_impl,
+    return _gated(active, lambda a, b, c: attention(
+        a, b, c, causal=cfg.causal, impl=cfg.attn_impl,
         block_q=min(cfg.attn_block_q, q.shape[2]),
         block_k=min(cfg.attn_block_k, k.shape[2]), interpret=interpret,
-    )
+    ), q, k, v)
 
 
 def _save_flat(t, name):
@@ -340,30 +348,40 @@ def _save_flat(t, name):
 
 
 def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret,
-                inner: Optional[InnerAxes] = None):
+                inner: Optional[InnerAxes] = None, active=None):
+    """One transformer layer. ``active`` (a traced bool, pipeline gate mode
+    "inner" only) wraps each matmul-heavy segment in ``_gated`` while the
+    collectives — TP psums here, ring/Ulysses comms inside
+    ``_inner_attention``, expert all-to-alls inside ``_moe_a2a_local`` —
+    run unconditionally between the segments, so every device hits them in
+    the same program order regardless of its tick predicate. checkpoint_name
+    saves stay OUTSIDE the conds so remat policies see them in every mode."""
     b, s, h = x.shape
-    nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.hd
     ap, mp = lp["attn"], lp["mlp"]
     dt = cfg.dtype
     tp = inner is not None and inner.tp
 
-    y = _norm(x, lp["attn_norm"], cfg)
-    q = jnp.einsum("bsh,hnd->bnsd", y, ap["wq"].astype(dt))
-    k = jnp.einsum("bsh,hnd->bnsd", y, ap["wk"].astype(dt))
-    v = jnp.einsum("bsh,hnd->bnsd", y, ap["wv"].astype(dt))
-    if cfg.use_bias:
-        q = q + ap["bq"].astype(dt)[None, :, None, :]
-        k = k + ap["bk"].astype(dt)[None, :, None, :]
-        v = v + ap["bv"].astype(dt)[None, :, None, :]
-    if cfg.pos == "rope":
-        cos, sin = rope_tables
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+    def qkv_fn(x):
+        y = _norm(x, lp["attn_norm"], cfg)
+        q = jnp.einsum("bsh,hnd->bnsd", y, ap["wq"].astype(dt))
+        k = jnp.einsum("bsh,hnd->bnsd", y, ap["wk"].astype(dt))
+        v = jnp.einsum("bsh,hnd->bnsd", y, ap["wv"].astype(dt))
+        if cfg.use_bias:
+            q = q + ap["bq"].astype(dt)[None, :, None, :]
+            k = k + ap["bk"].astype(dt)[None, :, None, :]
+            v = v + ap["bv"].astype(dt)[None, :, None, :]
+        if cfg.pos == "rope":
+            cos, sin = rope_tables
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+        return q, k, v
+
+    q, k, v = _gated(active, qkv_fn, x)
     q = _save_flat(q, "qkv")
     k = _save_flat(k, "qkv")
     v = _save_flat(v, "qkv")
     if inner is not None:
-        o = _inner_attention(q, k, v, cfg, inner, interpret)
+        o = _inner_attention(q, k, v, cfg, inner, interpret, active=active)
     else:
         o = _sharded_attention(q, k, v, cfg, mesh, interpret)
     # merge heads before the named save: [b, s, n*d] keeps the residual's
@@ -372,32 +390,40 @@ def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret,
     o = checkpoint_name(
         o.transpose(0, 2, 1, 3).reshape(b, s, -1), "attn_out"
     )
-    o = jnp.einsum("bse,eh->bsh", o, ap["wo"].astype(dt).reshape(-1, h))
-    if tp:  # partial sum over the local head shard
+    o = _gated(active, lambda oo: jnp.einsum(
+        "bse,eh->bsh", oo, ap["wo"].astype(dt).reshape(-1, h)), o)
+    if tp:  # partial sum over the local head shard (unconditional)
         o = jax.lax.psum(o, "model")
-    if cfg.use_bias:
-        o = o + ap["bo"].astype(dt)
-    x = x + o
 
-    y = _norm(x, lp["mlp_norm"], cfg)
+    def resid_attn(x, o):
+        if cfg.use_bias:
+            o = o + ap["bo"].astype(dt)
+        x = x + o
+        return x, _norm(x, lp["mlp_norm"], cfg)
+
+    x, y = _gated(active, resid_attn, x, o)
     if cfg.num_experts:
-        out, aux = _moe_mlp(y, mp, cfg, mesh=mesh, inner=inner)
+        out, aux = _moe_mlp(y, mp, cfg, mesh=mesh, inner=inner, active=active)
         if tp and cfg.moe_dispatch != "a2a":
             # a2a's shard_map psums its own model-partial projections
             out = jax.lax.psum(out, "model")
         return x + out, aux
-    if cfg.act == "swiglu":
-        hidden = swiglu(
-            jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt)),
-            jnp.einsum("bsh,hm->bsm", y, mp["wg"].astype(dt)),
-        )
-    else:
-        hidden = jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt))
-        if cfg.use_bias:
-            hidden = hidden + mp["bi"].astype(dt)
-        hidden = gelu(hidden)
-    out = jnp.einsum("bsm,mh->bsh", hidden, mp["wo"].astype(dt))
-    if tp:  # partial sum over the local mlp shard
+
+    def mlp_fn(y):
+        if cfg.act == "swiglu":
+            hidden = swiglu(
+                jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt)),
+                jnp.einsum("bsh,hm->bsm", y, mp["wg"].astype(dt)),
+            )
+        else:
+            hidden = jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt))
+            if cfg.use_bias:
+                hidden = hidden + mp["bi"].astype(dt)
+            hidden = gelu(hidden)
+        return jnp.einsum("bsm,mh->bsh", hidden, mp["wo"].astype(dt))
+
+    out = _gated(active, mlp_fn, y)
+    if tp:  # partial sum over the local mlp shard (unconditional)
         out = jax.lax.psum(out, "model")
     if cfg.use_bias:
         out = out + mp["bo"].astype(dt)
@@ -405,7 +431,7 @@ def _layer_body(x, lp, cfg: TransformerConfig, rope_tables, mesh, interpret,
 
 
 def _moe_mlp(y, mp, cfg: TransformerConfig, mesh=None,
-             inner: "Optional[InnerAxes]" = None):
+             inner: "Optional[InnerAxes]" = None, active=None):
     """Top-k routed expert MLPs (see TransformerConfig.moe_dispatch).
 
     Router math in f32. Expert tensors carry a leading E dim which the
@@ -419,25 +445,34 @@ def _moe_mlp(y, mp, cfg: TransformerConfig, mesh=None,
     fraction of routed assignments dropped at expert capacity].
     """
     E, k = cfg.num_experts, min(cfg.expert_top_k, cfg.num_experts)
-    logits = jnp.einsum("bsh,he->bse", y.astype(jnp.float32),
-                        mp["router"].astype(jnp.float32))
-    top_vals, top_idx = jax.lax.top_k(logits, k)          # [b,s,k]
-    top_gates = jax.nn.softmax(top_vals, axis=-1)
-    # Switch-style load balance: f_e = fraction of routed assignments on
-    # expert e, P_e = mean router prob. aux = E * sum f_e P_e — equals 1.0
-    # at perfect balance, approaches E as routing collapses onto one expert.
-    probs = jax.nn.softmax(logits, axis=-1)               # [b,s,E]
-    sel = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)   # [b,s,k,E]
-    f = sel.sum(axis=2).mean(axis=(0, 1)) / k             # [E], sums to 1
-    p_mean = probs.mean(axis=(0, 1))
-    balance = (E * (f * p_mean).sum()).astype(jnp.float32)
+
+    def route_fn(y):
+        logits = jnp.einsum("bsh,he->bse", y.astype(jnp.float32),
+                            mp["router"].astype(jnp.float32))
+        top_vals, top_idx = jax.lax.top_k(logits, k)          # [b,s,k]
+        top_gates = jax.nn.softmax(top_vals, axis=-1)
+        # Switch-style load balance: f_e = fraction of routed assignments on
+        # expert e, P_e = mean router prob. aux = E * sum f_e P_e — equals
+        # 1.0 at perfect balance, approaches E as routing collapses onto one
+        # expert.
+        probs = jax.nn.softmax(logits, axis=-1)               # [b,s,E]
+        sel = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)   # [b,s,k,E]
+        f = sel.sum(axis=2).mean(axis=(0, 1)) / k             # [E], sums to 1
+        p_mean = probs.mean(axis=(0, 1))
+        balance = (E * (f * p_mean).sum()).astype(jnp.float32)
+        return top_idx, top_gates, balance
+
+    top_idx, top_gates, balance = _gated(active, route_fn, y)
     if cfg.moe_dispatch == "dense":
-        out = _moe_dense(y, mp, cfg, top_idx, top_gates)
+        out = _gated(active, lambda yy, ti, tg: _moe_dense(
+            yy, mp, cfg, ti, tg), y, top_idx, top_gates)
         drop = jnp.zeros((), jnp.float32)
     elif cfg.moe_dispatch == "capacity":
-        out, drop = _moe_capacity(y, mp, cfg, top_idx, top_gates)
+        out, drop = _gated(active, lambda yy, ti, tg: _moe_capacity(
+            yy, mp, cfg, ti, tg), y, top_idx, top_gates)
     elif cfg.moe_dispatch == "a2a":
-        out, drop = _moe_a2a(y, mp, cfg, top_idx, top_gates, mesh, inner)
+        out, drop = _moe_a2a(y, mp, cfg, top_idx, top_gates, mesh, inner,
+                             active=active)
     else:
         raise ValueError(
             f"unknown moe_dispatch {cfg.moe_dispatch!r}; "
@@ -479,16 +514,17 @@ def _capacity_plan(top_idx, top_gates, E: int, k: int, cap: int):
     Positions come from a cumsum over the one-hot expert selection, not an
     argsort+searchsorted group-by: TPU sorts are bitonic networks while the
     [T*k, E] cumsum is bandwidth-cheap — measured +4.6% end-to-end on the
-    MoE-1B bench (MFU 0.288 -> 0.302). f32 cumsum counts are exact up to
-    2^24 assignments, far beyond any single-device microbatch. Slot order
+    MoE-1B bench (MFU 0.288 -> 0.302). The cumsum runs in int32 — exact up
+    to 2^31 assignments, comfortably past any GSPMD global token array,
+    where an f32 count would saturate at 2^24 (ADVICE r4). Slot order
     within an expert is token order, the same order the stable sort
     produced."""
     T = top_idx.shape[0]
     flat_e = top_idx.reshape(T * k)                        # expert per assignment
     flat_g = top_gates.reshape(T * k).astype(jnp.float32)
     flat_t = jnp.repeat(jnp.arange(T), k)                  # token per assignment
-    sel = jax.nn.one_hot(flat_e, E, dtype=jnp.float32)     # [T*k, E]
-    pos = ((jnp.cumsum(sel, axis=0) * sel).sum(-1) - 1.0).astype(jnp.int32)
+    sel = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)       # [T*k, E]
+    pos = (jnp.cumsum(sel, axis=0) * sel).sum(-1) - 1
     keep = pos < cap
     slot = jnp.where(keep, pos, 0)
     drop = 1.0 - keep.astype(jnp.float32).mean()
@@ -522,7 +558,7 @@ def _moe_capacity(y, mp, cfg: TransformerConfig, top_idx, top_gates):
 
 def _moe_a2a_local(y, top_idx, top_gates, mp, cfg: TransformerConfig,
                    axis_name: Optional[str], ep_size: int,
-                   model_axis: Optional[str] = None):
+                   model_axis: Optional[str] = None, active=None):
     """Device-local half of the explicit all-to-all dispatch (GShard
     layout, SURVEY.md:130). Runs inside a shard_map (or any manual-
     collective region): the local tokens' assignments scatter into per-
@@ -541,12 +577,20 @@ def _moe_a2a_local(y, top_idx, top_gates, mp, cfg: TransformerConfig,
     cap = max(int(T * k / E * cfg.expert_capacity_factor), 1)
 
     x = y.reshape(T, h)
-    ae, at_, ag, slot, keep, drop = _capacity_plan(
-        top_idx.reshape(T, k), top_gates.reshape(T, k), E, k, cap)
 
-    xin = jnp.zeros((E, cap, h), y.dtype)
-    xin = xin.at[ae, slot].add(
-        jnp.where(keep[:, None], x[at_], jnp.zeros_like(x[at_])))
+    def scatter_fn(x, ti, tg):
+        ae, at_, ag, slot, keep, drop = _capacity_plan(
+            ti.reshape(T, k), tg.reshape(T, k), E, k, cap)
+        xin = jnp.zeros((E, cap, h), y.dtype)
+        xin = xin.at[ae, slot].add(
+            jnp.where(keep[:, None], x[at_], jnp.zeros_like(x[at_])))
+        return xin, ae, at_, ag, slot, keep, drop
+
+    # plan + scatter gated; the all_to_alls and the model psum run
+    # unconditionally (on zero buffers during pipeline bubble ticks) so the
+    # collective program order is identical on every device
+    xin, ae, at_, ag, slot, keep, drop = _gated(
+        active, scatter_fn, x, top_idx, top_gates)
     if ep_size > 1:
         # [ep, e_loc, cap, h]: peer p's block -> device p; received axis 0
         # indexes the source device
@@ -555,7 +599,7 @@ def _moe_a2a_local(y, top_idx, top_gates, mp, cfg: TransformerConfig,
         xin_loc = recv.transpose(1, 0, 2, 3).reshape(e_loc, ep_size * cap, h)
     else:
         xin_loc = xin
-    ye = _expert_ffn(xin_loc, mp, cfg)                     # [e_loc, ep*cap, h]
+    ye = _gated(active, lambda xi: _expert_ffn(xi, mp, cfg), xin_loc)
     if model_axis is not None:
         ye = jax.lax.psum(ye, model_axis)
     if ep_size > 1:
@@ -563,13 +607,18 @@ def _moe_a2a_local(y, top_idx, top_gates, mp, cfg: TransformerConfig,
             ye.reshape(e_loc, ep_size, cap, h).transpose(1, 0, 2, 3),
             axis_name, 0, 0)                               # axis 0: owner
         ye = back.reshape(E, cap, h)
-    contrib = ye[ae, slot] * (ag * keep.astype(jnp.float32))[:, None].astype(dt)
-    out = jnp.zeros((T, h), dt).at[at_].add(contrib)
+
+    def combine_fn(ye):
+        contrib = ye[ae, slot] * (
+            ag * keep.astype(jnp.float32))[:, None].astype(dt)
+        return jnp.zeros((T, h), dt).at[at_].add(contrib)
+
+    out = _gated(active, combine_fn, ye)
     return out.reshape(b, s, h), drop
 
 
 def _moe_a2a(y, mp, cfg: TransformerConfig, top_idx, top_gates, mesh,
-             inner: "Optional[InnerAxes]"):
+             inner: "Optional[InnerAxes]", active=None):
     """Dispatch wrapper for moe_dispatch="a2a".
 
     In jit-auto mode a shard_map over the full mesh runs the manual
@@ -585,7 +634,7 @@ def _moe_a2a(y, mp, cfg: TransformerConfig, top_idx, top_gates, mesh,
         return _moe_a2a_local(
             y, top_idx, top_gates, mp, cfg,
             "expert" if ep > 1 else None, ep,
-            model_axis="model" if inner.tp else None)
+            model_axis="model" if inner.tp else None, active=active)
     if mesh is None:
         return _moe_a2a_local(y, top_idx, top_gates, mp, cfg, None, 1)
 
@@ -651,7 +700,7 @@ def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interp
         rules = ShardingRules().override(layers="stage", embed=None, vocab=None)
         pspec = param_specs(cfg, rules)["layers"]
 
-        def pp_body(xl, lp):
+        def pp_body(xl, lp, act=None):
             tables = rope_tables
             if inner.cp and tables is not None:
                 # each context shard rotates with its *global* positions
@@ -660,26 +709,37 @@ def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interp
                 tables = tuple(
                     jax.lax.dynamic_slice_in_dim(t, c * sl, sl, 0)
                     for t in tables)
-            return _scan_layers(xl, lp, cfg, tables, None, interpret, inner=inner)
+            return _scan_layers(xl, lp, cfg, tables, None, interpret,
+                                inner=inner, active=act)
 
+        # bodies with collectives (TP psums / ring ppermutes / expert
+        # all-to-alls) gate their matmul segments around unconditionally-
+        # executed collectives (gate="inner"); collective-free bodies sit
+        # under one whole-body cond (gate="full"). Either way bubble ticks
+        # skip the stage's FLOPs — VERDICT r4 #1.
+        # (the expert a2a only exists in MoE layers — dense models on an
+        # expert-axis mesh still take the whole-body gate)
+        has_collectives = (inner.tp or inner.cp
+                           or bool(cfg.num_experts and inner.ep_size > 1))
+        gate = cfg.pp_gate
+        if gate == "auto":
+            gate = "inner" if has_collectives else "full"
+        elif gate == "full" and has_collectives:
+            raise ValueError(
+                "pp_gate='full' is unsound for stage bodies with "
+                "collectives (TP/CP/EP) — use 'auto', 'inner', or 'none'")
         return gpipe_trunk(
             x, layer_params, pp_body, mesh,
             num_microbatches=cfg.pp_microbatches, param_spec=pspec,
-            # TP psums / ring ppermutes / expert all-to-alls inside the
-            # body must run on every device every tick (collectives can't
-            # sit under a stage-gated cond); without them, bubble ticks
-            # are skipped entirely
-            # (the expert a2a only exists in MoE layers — dense models on
-            # an expert-axis mesh still gate their bubble ticks)
-            gate_ticks=not (inner.tp or inner.cp
-                            or (cfg.num_experts and inner.ep_size > 1)))
+            gate=gate)
     return _scan_layers(x, layer_params, cfg, rope_tables, mesh, interpret)
 
 
 def _scan_layers(x, layer_params, cfg: TransformerConfig, rope_tables, mesh,
-                 interpret, inner: Optional[InnerAxes] = None):
+                 interpret, inner: Optional[InnerAxes] = None, active=None):
     def body(x, lp):
-        new_x, aux = _layer_body(x, lp, cfg, rope_tables, mesh, interpret, inner)
+        new_x, aux = _layer_body(x, lp, cfg, rope_tables, mesh, interpret,
+                                 inner, active)
         return new_x, aux
     if cfg.remat == "full":
         body = jax.checkpoint(body, prevent_cse=False)

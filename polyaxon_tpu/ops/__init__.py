@@ -4,12 +4,14 @@ the reference orchestrates but never owns these)."""
 
 from .attention import attention, dense_attention, repeat_kv
 from .flash_attention import flash_attention_bhsd
+from .gating import gated
 from .layers import apply_rope, gelu, layer_norm, rms_norm, rope_frequencies, swiglu
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 
 __all__ = [
     "attention",
+    "gated",
     "dense_attention",
     "repeat_kv",
     "flash_attention_bhsd",

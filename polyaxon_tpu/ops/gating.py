@@ -1,0 +1,34 @@
+"""Bubble-tick compute gating for pipeline stage bodies.
+
+The collective-safe pipeline schedule (parallel/pipeline.py, gate="inner")
+hands the stage body its tick's ``active`` predicate; the body wraps each
+matmul-heavy, collective-free segment in :func:`gated` while collectives
+execute unconditionally between segments. One implementation so the gating
+semantics (zeros false-branch, pytree outputs, dtype fidelity) can't drift
+between call sites (transformer layer body, Ulysses attention).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gated(active, fn, *args):
+    """Run ``fn(*args)`` under ``lax.cond(active)`` with an all-zeros false
+    branch — the bubble-tick compute skip for pipeline stage bodies whose
+    collectives are hoisted OUT of the gated segments (VERDICT r4 #1).
+    ``active=None`` (not inside a gated pipeline tick) runs ``fn`` directly.
+
+    ``fn`` must be collective-free: the false branch skips it entirely, so a
+    collective inside would desynchronize devices whose predicates differ.
+    """
+    if active is None:
+        return fn(*args)
+    shapes = jax.eval_shape(fn, *args)
+    return jax.lax.cond(
+        active,
+        fn,
+        lambda *_: jax.tree.map(lambda t: jnp.zeros(t.shape, t.dtype), shapes),
+        *args,
+    )

@@ -20,6 +20,7 @@ import jax
 from jax import lax
 
 from .attention import attention
+from .gating import gated
 
 
 def ulysses_attention(
@@ -30,8 +31,15 @@ def ulysses_attention(
     sm_scale: Optional[float] = None,
     impl: str = "auto",
     interpret: Optional[bool] = None,
+    active: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """q/k/v: per-device shards [batch, heads, seq_local, head_dim]."""
+    """q/k/v: per-device shards [batch, heads, seq_local, head_dim].
+
+    ``active`` (traced bool, pipeline gate mode "inner") gates the attention
+    kernel under ``lax.cond`` while both all_to_alls run unconditionally —
+    on zero shards during bubble ticks — so the collective order is uniform
+    across stages (and so is their transpose in the backward pass).
+    """
     cp = int(lax.psum(1, axis_name))
     h = q.shape[1]
     if h % cp != 0:
@@ -43,8 +51,11 @@ def ulysses_attention(
     def to_seq(x):  # [B, H/cp, S, D] -> [B, H, S/cp, D]
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
-    o = attention(
-        to_heads(q), to_heads(k), to_heads(v),
-        causal=causal, sm_scale=sm_scale, impl=impl, interpret=interpret,
-    )
+    def attn(qh, kh, vh):
+        return attention(
+            qh, kh, vh,
+            causal=causal, sm_scale=sm_scale, impl=impl, interpret=interpret,
+        )
+
+    o = gated(active, attn, to_heads(q), to_heads(k), to_heads(v))
     return to_seq(o)
