@@ -37,8 +37,8 @@ def main() -> None:
 
     if moe:
         # secondary entry (VERDICT r3 #6): sparse-MoE training throughput —
-        # measures the capacity/a2a dispatch (sort + scatter + expert FFN),
-        # and reports the router drop fraction alongside
+        # measures the capacity dispatch (cumsum plan + index-table gathers
+        # + expert FFN), and reports the router drop fraction alongside
         if on_tpu:
             mcfg = replace(llama.LLAMA_MOE_1B, remat="attn_qkv",
                            attn_block_q=1024, attn_block_k=1024)

@@ -83,10 +83,12 @@ class ApiApp:
 
     @web.middleware
     async def _auth_middleware(self, request, handler):
-        # the static dashboard shell and the API descriptor carry no data;
-        # the shell collects the token client-side and sends it on its
-        # API calls
-        if request.path in ("/healthz", "/", "/ui", "/api/v1/openapi.json"):
+        # the static dashboard shell carries no data; the shell collects
+        # the token client-side and sends it on its API calls. The OpenAPI
+        # descriptor sits BEHIND auth (ADVICE r4): it carries no tenant
+        # data either, but enumerating every route + summary is
+        # reconnaissance surface, and SDK generators already hold a token.
+        if request.path in ("/healthz", "/", "/ui"):
             return await handler(request)
         if not self._auth_enabled():
             return await handler(request)

@@ -199,7 +199,15 @@ class Store:
     def has_tokens(self) -> bool:
         """Any token row, revoked or not: once a server has ever minted a
         token, auth stays engaged across restarts — revoking the last token
-        must lock the server down, not silently reopen it."""
+        must lock the server down, not silently reopen it.
+
+        Break-glass recovery (ADVICE r4): the lockdown has no *network*
+        escape hatch by design, but an operator with shell access to the
+        server host can always recover — start the server with
+        ``--auth-token <secret>`` (the static admin token bypasses the
+        store) and mint a fresh scoped token via ``POST /api/v1/tokens``,
+        or delete rows from the ``tokens`` table in the store's sqlite db.
+        Documented in README "Auth"."""
         with self._conn_ctx() as conn:
             return conn.execute(
                 "SELECT 1 FROM tokens LIMIT 1").fetchone() is not None

@@ -209,12 +209,21 @@ def to_k8s_resources(
             # volumes
             mount = [{"name": "plx-context", "mountPath": run_dir}]
             spec["volumes"] = [{"name": "plx-context", "emptyDir": {}}]
+            # init steps (git clone, file writes, fsspec pulls) never call
+            # the API: keep PLX_AUTH_TOKEN out of every rendered
+            # initContainer manifest (ADVICE r4). A denylist, not an
+            # allowlist — connection-provided env vars carry verbatim
+            # user-chosen names (contexts.py), so filtering by prefix
+            # would silently strip credentials an init fsspec pull needs
+            init_env = {
+                k: v for k, v in base_env.items() if k != "PLX_AUTH_TOKEN"
+            }
             spec["initContainers"] = [
                 {
                     "name": f"plx-init-{i}",
                     "image": container.get("image"),
                     "command": ["python", "-m", "polyaxon_tpu.runtime.init"],
-                    "env": [{"name": k, "value": v} for k, v in base_env.items()]
+                    "env": [{"name": k, "value": v} for k, v in init_env.items()]
                            + [{"name": "PLX_INIT_STEP", "value": json.dumps(step)}],
                     "volumeMounts": mount,
                 }

@@ -283,13 +283,17 @@ class Tuner:
                 if st.target_reached:
                     self._stop_and_drain(st)
                     break
-                # percent is over the whole batch, not just finished trials:
-                # one fast crash among 16 in-flight must not read as 100%
-                if self._failure_stop(st.early, st.failures, len(batch)):
+                # denominator: every trial launched so far (st.trial_index),
+                # matching the cumulative st.failures numerator — len(batch)
+                # would mix a cumulative count over a per-batch total and
+                # can report "9/4 trials failed" (ADVICE r4). Launched (not
+                # finished) keeps one fast crash among 16 in-flight from
+                # reading as 100%.
+                if self._failure_stop(st.early, st.failures, st.trial_index):
                     self._stop_inflight(st)
                     raise RuntimeError(
                         f"failure early stopping: {st.failures}/"
-                        f"{len(batch)} trials failed"
+                        f"{st.trial_index} trials failed"
                     )
                 if queue or st.inflight:
                     time.sleep(self.poll_interval)

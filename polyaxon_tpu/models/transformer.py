@@ -531,12 +531,97 @@ def _capacity_plan(top_idx, top_gates, E: int, k: int, cap: int):
     return flat_e, flat_t, flat_g, slot, keep, drop
 
 
+def _dispatch_tables(top_idx, top_gates, E: int, k: int, cap: int):
+    """Gather-form dispatch plan (round 5, VERDICT r4 #2).
+
+    The r4 dispatch scattered token rows into the [E, cap, h] buffer and
+    scatter-added expert outputs back per assignment — and XLA lowers
+    f32/bf16 scatters on TPU to serialized update loops. The dispatch
+    relation is a bipartite matching with bounded degree on BOTH sides
+    (k assignments per token, one token per slot), so with index tables in
+    both directions every data movement — forward dispatch, forward
+    combine, and both their transposes (_gather_dispatch/_gather_combine
+    custom VJPs) — is a gather. The only scatters left are the int32/f32
+    [E, cap+1] tables built here (~KBs). Empty slots point at the sentinel
+    row T (the ops pad with a zero row); dropped assignments land in the
+    discarded overflow column cap.
+
+    Returns (token_for_slot [E, cap], slot [T, k], keep [T, k], drop).
+    """
+    T = top_idx.shape[0]
+    ae, at_, _, slot, keep, drop = _capacity_plan(top_idx, top_gates, E, k, cap)
+    tfs = jnp.full((E, cap + 1), T, jnp.int32)
+    tfs = tfs.at[ae, jnp.where(keep, slot, cap)].set(at_)
+    return tfs[:, :cap], slot.reshape(T, k), keep.reshape(T, k), drop
+
+
+@jax.custom_vjp
+def _gather_dispatch(x, tfs, top_idx, slot, keep):
+    """xin[e, c] = x[tfs[e, c]] ([E, cap, h]; sentinel row T reads zeros).
+    The custom transpose turns what autodiff would make a scatter-add over
+    slots into a per-token gather: dx[t] = sum_j keep[t,j] *
+    dxin[top_idx[t,j], slot[t,j]]."""
+    xp = jnp.concatenate([x, jnp.zeros((1, x.shape[1]), x.dtype)], axis=0)
+    return xp[tfs]
+
+
+def _gather_dispatch_fwd(x, tfs, top_idx, slot, keep):
+    return _gather_dispatch(x, tfs, top_idx, slot, keep), (top_idx, slot, keep)
+
+
+def _gather_dispatch_bwd(res, dxin):
+    top_idx, slot, keep = res
+    dx = jnp.einsum("tkh,tk->th", dxin[top_idx, slot],
+                    keep.astype(dxin.dtype))
+    return dx, None, None, None, None
+
+
+_gather_dispatch.defvjp(_gather_dispatch_fwd, _gather_dispatch_bwd)
+
+
+@jax.custom_vjp
+def _gather_combine(ye, w, tfs, top_idx, slot, keep):
+    """out[t] = sum_j w[t,j] * ye[top_idx[t,j], slot[t,j]] ([T, h]).
+    ``w`` [T, k] f32 carries the gate weights (zero for dropped
+    assignments) so router gradients flow. The custom transpose gathers in
+    both directions: dye via the token-for-slot table, dw via the same
+    [T, k, h] gather as the forward."""
+    return jnp.einsum("tkh,tk->th", ye[top_idx, slot], w.astype(ye.dtype))
+
+
+def _gather_combine_fwd(ye, w, tfs, top_idx, slot, keep):
+    return _gather_combine(ye, w, tfs, top_idx, slot, keep), (
+        ye, w, tfs, top_idx, slot, keep)
+
+
+def _gather_combine_bwd(res, dout):
+    ye, w, tfs, top_idx, slot, keep = res
+    E, cap, h = ye.shape
+    # per-slot gate weight (tiny f32 scatter; dropped -> overflow column)
+    slot_w = jnp.where(keep, slot, cap)
+    w_slot = jnp.zeros((E, cap + 1), jnp.float32).at[top_idx, slot_w].set(w)
+    w_slot = w_slot[:, :cap]
+    dout_pad = jnp.concatenate(
+        [dout, jnp.zeros((1, h), dout.dtype)], axis=0)
+    # stay in the activation dtype: an f32 [E, cap, h] intermediate would
+    # spike HBM by 2x for no accuracy the fwd (bf16 multiply) ever had
+    dye = (w_slot.astype(dout.dtype)[..., None] * dout_pad[tfs]
+           ).astype(ye.dtype)
+    dw = jnp.einsum("tkh,th->tk", ye[top_idx, slot].astype(jnp.float32),
+                    dout.astype(jnp.float32))
+    return dye, dw, None, None, None, None
+
+
+_gather_combine.defvjp(_gather_combine_fwd, _gather_combine_bwd)
+
+
 def _moe_capacity(y, mp, cfg: TransformerConfig, top_idx, top_gates):
-    """Sort-based capacity dispatch: (token, slot) assignments group by
-    expert; each expert computes a fixed [capacity, h] block. Assignments
-    past an expert's capacity are dropped (their combine weight is zero) —
-    the standard GShard trade for static shapes. The scatter/gather is
-    global; XLA lowers it onto the expert mesh axis."""
+    """Capacity dispatch: tokens group into each expert's fixed [cap, h]
+    block, assignments past capacity are dropped (their combine weight is
+    zero) — the standard GShard trade for static shapes. Both data
+    movements are GATHERS from the int32 plan tables (_dispatch_tables):
+    no [*, h]-width scatter anywhere. The gathers are global; XLA lowers
+    them onto the expert mesh axis."""
     dt = cfg.dtype
     b, s, h = y.shape
     E, k = cfg.num_experts, min(cfg.expert_top_k, cfg.num_experts)
@@ -544,16 +629,13 @@ def _moe_capacity(y, mp, cfg: TransformerConfig, top_idx, top_gates):
     cap = max(int(T * k / E * cfg.expert_capacity_factor), 1)
 
     x = y.reshape(T, h)
-    ae, at_, ag, slot, keep, drop = _capacity_plan(
-        top_idx.reshape(T, k), top_gates.reshape(T, k), E, k, cap)
-
-    xin = jnp.zeros((E, cap, h), y.dtype)
-    xin = xin.at[ae, slot].add(
-        jnp.where(keep[:, None], x[at_], jnp.zeros_like(x[at_])))
+    ti, tg = top_idx.reshape(T, k), top_gates.reshape(T, k)
+    tfs, slot, keep, drop = _dispatch_tables(ti, tg, E, k, cap)
+    xin = _gather_dispatch(x, tfs, ti, slot, keep)         # [E, cap, h]
     ye = _expert_ffn(xin, mp, cfg)                         # [E, cap, h]
-    contrib = ye[ae, slot] * (ag * keep.astype(jnp.float32))[:, None].astype(dt)
-    out = jnp.zeros((T, h), dt).at[at_].add(contrib)
-    return out.reshape(b, s, h), drop
+    w = tg.astype(jnp.float32) * keep.astype(jnp.float32)
+    out = _gather_combine(ye, w, tfs, ti, slot, keep)      # [T, h]
+    return out.astype(dt).reshape(b, s, h), drop
 
 
 def _moe_a2a_local(y, top_idx, top_gates, mp, cfg: TransformerConfig,
@@ -577,20 +659,18 @@ def _moe_a2a_local(y, top_idx, top_gates, mp, cfg: TransformerConfig,
     cap = max(int(T * k / E * cfg.expert_capacity_factor), 1)
 
     x = y.reshape(T, h)
+    ti, tg = top_idx.reshape(T, k), top_gates.reshape(T, k)
 
-    def scatter_fn(x, ti, tg):
-        ae, at_, ag, slot, keep, drop = _capacity_plan(
-            ti.reshape(T, k), tg.reshape(T, k), E, k, cap)
-        xin = jnp.zeros((E, cap, h), y.dtype)
-        xin = xin.at[ae, slot].add(
-            jnp.where(keep[:, None], x[at_], jnp.zeros_like(x[at_])))
-        return xin, ae, at_, ag, slot, keep, drop
+    def dispatch_fn(x, ti, tg):
+        tfs, slot, keep, drop = _dispatch_tables(ti, tg, E, k, cap)
+        xin = _gather_dispatch(x, tfs, ti, slot, keep)     # [E, cap, h]
+        return xin, tfs, slot, keep, drop
 
-    # plan + scatter gated; the all_to_alls and the model psum run
+    # plan + gather-dispatch gated; the all_to_alls and the model psum run
     # unconditionally (on zero buffers during pipeline bubble ticks) so the
     # collective program order is identical on every device
-    xin, ae, at_, ag, slot, keep, drop = _gated(
-        active, scatter_fn, x, top_idx, top_gates)
+    xin, tfs, slot, keep, drop = _gated(
+        active, dispatch_fn, x, ti, tg)
     if ep_size > 1:
         # [ep, e_loc, cap, h]: peer p's block -> device p; received axis 0
         # indexes the source device
@@ -608,12 +688,11 @@ def _moe_a2a_local(y, top_idx, top_gates, mp, cfg: TransformerConfig,
             axis_name, 0, 0)                               # axis 0: owner
         ye = back.reshape(E, cap, h)
 
-    def combine_fn(ye):
-        contrib = ye[ae, slot] * (
-            ag * keep.astype(jnp.float32))[:, None].astype(dt)
-        return jnp.zeros((T, h), dt).at[at_].add(contrib)
+    def combine_fn(ye, tg):
+        w = tg.astype(jnp.float32) * keep.astype(jnp.float32)
+        return _gather_combine(ye, w, tfs, ti, slot, keep).astype(dt)
 
-    out = _gated(active, combine_fn, ye)
+    out = _gated(active, combine_fn, ye, tg)
     return out.reshape(b, s, h), drop
 
 

@@ -44,6 +44,12 @@ def main() -> None:
     accum_dtype = "bfloat16" if "--accum-bf16" in sys.argv else None
 
     mcfg = replace(llama.CONFIGS[model], remat=remat, max_seq=seq)
+    if "--dispatch" in sys.argv:
+        # MoE dispatch mode sweep (capacity | a2a | dense): a2a on one chip
+        # runs the ep=1 degenerate local core — same plan + gathers + FFN,
+        # no collective — isolating router+dispatch cost from a2a traffic
+        mcfg = replace(
+            mcfg, moe_dispatch=sys.argv[sys.argv.index("--dispatch") + 1])
     if chunk is not None:
         mcfg = replace(mcfg, loss_chunk_tokens=chunk)
     if "--block" in sys.argv:

@@ -308,7 +308,7 @@ class TestUi:
 
 
 class TestOpenApi:
-    def test_descriptor_covers_routes_and_is_open(self, tmp_path):
+    def test_descriptor_covers_routes_and_requires_auth(self, tmp_path):
         import requests
 
         from polyaxon_tpu.api.server import ApiServer
@@ -316,8 +316,12 @@ class TestOpenApi:
         srv = ApiServer(artifacts_root=str(tmp_path), port=0,
                         auth_token="t0ken").start()
         try:
-            # open even when auth is engaged: it carries no tenant data
-            r = requests.get(f"{srv.url}/api/v1/openapi.json", timeout=5)
+            # behind auth when engaged (ADVICE r4): the descriptor is
+            # route-enumeration surface, and SDK generators hold a token
+            assert requests.get(f"{srv.url}/api/v1/openapi.json",
+                                timeout=5).status_code == 401
+            r = requests.get(f"{srv.url}/api/v1/openapi.json", timeout=5,
+                             headers={"Authorization": "Bearer t0ken"})
             assert r.status_code == 200
             spec = r.json()
             assert spec["openapi"].startswith("3.")

@@ -211,6 +211,22 @@ run:
         main = pod["spec"]["containers"][0]
         assert main["workingDir"] == "/tmp/plx/proj/abc/code"
 
+    def test_init_containers_never_carry_auth_token(self):
+        """ADVICE r4: init steps never call the API, so PLX_AUTH_TOKEN must
+        not spread into rendered initContainer manifests (the main
+        container still gets it for tracking)."""
+        op = check_polyaxonfile(self.INIT_YAML)
+        r = resolve(op, run_uuid="abc123def456xyz", project="proj",
+                    artifacts_path="/tmp/plx/proj/abc",
+                    api_host="http://api:8000", api_token="s3cret")
+        pod = [d for d in r.k8s_resources() if d["kind"] == "Pod"][0]
+        for ic in pod["spec"]["initContainers"]:
+            names = {e["name"] for e in ic["env"]}
+            assert "PLX_AUTH_TOKEN" not in names, names
+        main_env = {e["name"]: e["value"]
+                    for e in pod["spec"]["containers"][0]["env"]}
+        assert main_env["PLX_AUTH_TOKEN"] == "s3cret"
+
     def test_no_init_no_init_containers(self):
         r = _resolved(TPU_YAML)
         pod = [d for d in r.k8s_resources() if d["kind"] == "Pod"][0]
