@@ -262,8 +262,14 @@ def _sharded_attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh], in
             block_q=cfg.attn_block_q, block_k=cfg.attn_block_k, interpret=interpret,
         )
     cp = mesh.shape["context"]
-    k = repeat_kv(k, q.shape[1])
-    v = repeat_kv(v, q.shape[1])
+    ring = cp > 1 and cfg.seq_parallel == "ring"
+    if not (ring and k.shape[1] % mesh.shape["model"] == 0):
+        # ring keeps GQA kv compact (expanded per visit inside the ring) as
+        # long as the kv heads still divide over the model axis; every
+        # other path — and TP degrees finer than the kv head count — wants
+        # the q-head expansion up front
+        k = repeat_kv(k, q.shape[1])
+        v = repeat_kv(v, q.shape[1])
     qkv_spec = P(("data", "fsdp", "expert"), "model", "context", None)
 
     @functools.partial(
@@ -271,7 +277,7 @@ def _sharded_attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh], in
         in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec,
     )
     def _attn(q, k, v):
-        if cp > 1 and cfg.seq_parallel == "ring":
+        if ring:
             return ring_attention(
                 q, k, v, axis_name="context", axis_size=cp, causal=cfg.causal,
                 block_q=min(cfg.attn_block_q, q.shape[2]),
@@ -315,15 +321,17 @@ def _inner_attention(q, k, v, cfg: TransformerConfig, inner: InnerAxes,
     the kernel launches on bubble ticks; ring/Ulysses run their
     ppermutes/all-to-alls unconditionally either way."""
     if inner.cp:
-        k = repeat_kv(k, q.shape[1])
-        v = repeat_kv(v, q.shape[1])
         if cfg.seq_parallel == "ring":
+            # compact GQA kv rides the ring (ICI traffic / (heads/kv_heads));
+            # ring_attention expands per visit
             return ring_attention(
                 q, k, v, axis_name="context", causal=cfg.causal,
                 block_q=min(cfg.attn_block_q, q.shape[2]),
                 block_k=min(cfg.attn_block_k, k.shape[2]),
                 interpret=interpret, active=active,
             )
+        k = repeat_kv(k, q.shape[1])
+        v = repeat_kv(v, q.shape[1])
         return ulysses_attention(
             q, k, v, axis_name="context", causal=cfg.causal,
             impl=cfg.attn_impl, interpret=interpret, active=active,

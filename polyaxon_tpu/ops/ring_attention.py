@@ -55,8 +55,30 @@ def _visit_pred(causal, gated, src, my, act):
     return pred
 
 
+def _expand_kv(kc, group):
+    """[b*nk, s, d] -> [b*nk*group, s, d], each kv head repeated ``group``
+    times contiguously — repeat_kv's convention, so q head i reads kv head
+    i // group. Runs per ring visit (locally, HBM bandwidth) so the
+    ppermute carries only the compact kv-head chunk: for GQA models the
+    ICI traffic drops by q_heads/kv_heads (8x on the Llama shapes) vs the
+    r4 ring, which shipped pre-expanded chunks."""
+    if group == 1:
+        return kc
+    bnk, s, d = kc.shape
+    return jnp.repeat(kc, group, axis=0).reshape(bnk * group, s, d)
+
+
+def _collapse_dkv(dk, group):
+    """Transpose of _expand_kv: sum the ``group`` q-head copies back onto
+    their kv head. [b*nk*group, s, d] -> [b*nk, s, d]."""
+    if group == 1:
+        return dk
+    bh, s, d = dk.shape
+    return dk.reshape(bh // group, group, s, d).sum(axis=1)
+
+
 def _ring_fwd_loop(q, k, v, act, axis_name, cp, causal, sm_scale, block_q,
-                   block_k, interpret, gated):
+                   block_k, interpret, gated, group):
     bh, s, d = q.shape
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
@@ -67,7 +89,8 @@ def _ring_fwd_loop(q, k, v, act, axis_name, cp, causal, sm_scale, block_q,
 
         def visit(o, lse):
             o_i, lse_i = _flash_fwd(
-                q, k_cur, v_cur, my * s, src * s,
+                q, _expand_kv(k_cur, group), _expand_kv(v_cur, group),
+                my * s, src * s,
                 sm_scale=sm_scale, causal=causal,
                 block_q=block_q, block_k=block_k, interpret=interpret,
             )
@@ -93,19 +116,19 @@ def _ring_fwd_loop(q, k, v, act, axis_name, cp, causal, sm_scale, block_q,
 
 @functools.lru_cache(maxsize=64)
 def _make_ring(axis_name, cp, causal, sm_scale, block_q, block_k, interpret,
-               gated):
+               gated, group):
     @jax.custom_vjp
     def ring(q, k, v, act):
         o, _ = _ring_fwd_loop(
             q, k, v, act, axis_name, cp, causal, sm_scale, block_q, block_k,
-            interpret, gated
+            interpret, gated, group
         )
         return o
 
     def fwd(q, k, v, act):
         o, lse = _ring_fwd_loop(
             q, k, v, act, axis_name, cp, causal, sm_scale, block_q, block_k,
-            interpret, gated
+            interpret, gated, group
         )
         return o, (q, k, v, act, o, lse)
 
@@ -122,14 +145,17 @@ def _make_ring(axis_name, cp, causal, sm_scale, block_q, block_k, interpret,
 
             def visit(dq, dk, dv):
                 dq_i, dk_i, dv_i = _flash_bwd(
-                    q, k_cur, v_cur, o, lse, do, my * s, src * s,
+                    q, _expand_kv(k_cur, group), _expand_kv(v_cur, group),
+                    o, lse, do, my * s, src * s,
                     sm_scale=sm_scale, causal=causal,
                     block_q=block_q, block_k=block_k, interpret=interpret,
                     row_stats=row_stats,
                 )
+                # dk/dv ride the ring compact: collapse the q-head copies
+                # onto their kv head before accumulating
                 return (dq + dq_i.astype(jnp.float32),
-                        dk + dk_i.astype(jnp.float32),
-                        dv + dv_i.astype(jnp.float32))
+                        dk + _collapse_dkv(dk_i.astype(jnp.float32), group),
+                        dv + _collapse_dkv(dv_i.astype(jnp.float32), group))
 
             # fully-future chunks have zero grads; inactive gated ticks
             # skip both kernels — same predicate as the forward sweep
@@ -148,7 +174,8 @@ def _make_ring(axis_name, cp, causal, sm_scale, block_q, block_k, interpret,
             return dq, k_cur, v_cur, dk, dv
 
         z = jnp.zeros((bh, s, d), jnp.float32)
-        dq, _, _, dk, dv = lax.fori_loop(0, cp, step, (z, k, v, z, z))
+        zk = jnp.zeros(k.shape, jnp.float32)  # compact kv heads
+        dq, _, _, dk, dv = lax.fori_loop(0, cp, step, (z, k, v, zk, zk))
         return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
                 jnp.zeros_like(act))
 
@@ -170,13 +197,19 @@ def ring_attention(
 ) -> jax.Array:
     """Exact causal attention over a sequence sharded on ``axis_name``.
 
-    q/k/v: per-device shards [batch, heads, seq_local, head_dim] (GQA must be
-    expanded by the caller). Returns the local output shard. ``active`` (a
-    traced bool, pipeline gate mode "inner") skips every kernel launch —
-    forward and backward — while the ppermutes still run each step, keeping
-    the ring's collective order uniform across gated/ungated stages.
+    q: per-device shard [batch, heads, seq_local, head_dim]; k/v may carry
+    FEWER heads (GQA/MQA: heads % kv_heads == 0) — the compact chunks ride
+    the ring and expand locally per visit, cutting ICI traffic by
+    heads/kv_heads vs shipping pre-expanded KV (8x on the Llama shapes).
+    Returns the local output shard. ``active`` (a traced bool, pipeline
+    gate mode "inner") skips every kernel launch — forward and backward —
+    while the ppermutes still run each step, keeping the ring's collective
+    order uniform across gated/ungated stages.
     """
     b, h, s, d = q.shape
+    nk = k.shape[1]
+    if h % nk:
+        raise ValueError(f"q heads ({h}) not divisible by kv heads ({nk})")
     if sm_scale is None:
         sm_scale = d ** -0.5
     if interpret is None:
@@ -186,10 +219,10 @@ def ring_attention(
         axis_size = int(axis_size)  # static under shard_map tracing
     fn = _make_ring(
         axis_name, int(axis_size), causal, float(sm_scale),
-        block_q, block_k, bool(interpret), active is not None,
+        block_q, block_k, bool(interpret), active is not None, h // nk,
     )
     act = (jnp.float32(1.0) if active is None
            else active.astype(jnp.float32))
-    o = fn(q.reshape(b * h, s, d), k.reshape(b * h, s, d),
-           v.reshape(b * h, s, d), act)
+    o = fn(q.reshape(b * h, s, d), k.reshape(b * nk, s, d),
+           v.reshape(b * nk, s, d), act)
     return o.reshape(b, h, s, d)

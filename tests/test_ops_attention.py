@@ -153,6 +153,49 @@ class TestRingAttention:
         for a, b_ in zip(gr, gd):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-4)
 
+    def test_gqa_compact_kv_matches_expanded(self, mesh):
+        """r5: GQA kv rides the ring compact (kv heads, expanded locally
+        per visit) — outputs AND all grads must match the ring over
+        pre-expanded kv, with dk/dv group-summed exactly like autodiff of
+        repeat_kv would."""
+        from polyaxon_tpu.ops import repeat_kv
+
+        key = jax.random.PRNGKey(11)
+        kq, kk, kv_ = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (1, 8, 256, 32), jnp.float32) * 0.3
+        k = jax.random.normal(kk, (1, 2, 256, 32), jnp.float32) * 0.3
+        v = jax.random.normal(kv_, (1, 2, 256, 32), jnp.float32) * 0.3
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, check_vma=False,
+            in_specs=(P(None, None, "context", None),) * 3,
+            out_specs=P(None, None, "context", None),
+        )
+        def ring(q, k, v):
+            return ring_attention(q, k, v, axis_name="context", axis_size=8,
+                                  causal=True, block_q=32, block_k=32,
+                                  interpret=True)
+
+        def loss_compact(q, k, v):
+            return (ring(q, k, v) ** 2).sum()
+
+        def loss_expanded(q, k, v):
+            return (ring(q, repeat_kv(k, 8), repeat_kv(v, 8)) ** 2).sum()
+
+        out_c = ring(q, k, v)
+        out_e = ring(q, repeat_kv(k, 8), repeat_kv(v, 8))
+        np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_e),
+                                   atol=2e-5, rtol=2e-5)
+        gc = jax.grad(loss_compact, argnums=(0, 1, 2))(q, k, v)
+        ge = jax.grad(loss_expanded, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(gc[0]), np.asarray(ge[0]),
+                                   atol=5e-5, rtol=5e-4)
+        for i in (1, 2):
+            # the expanded path differentiates through repeat_kv, whose
+            # transpose is the same group-sum the compact ring does inline
+            np.testing.assert_allclose(np.asarray(gc[i]), np.asarray(ge[i]),
+                                       atol=5e-5, rtol=5e-4)
+
 
 class TestUlysses:
     def test_matches_dense(self):
