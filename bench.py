@@ -20,6 +20,7 @@ from dataclasses import replace
 
 def main() -> None:
     import jax
+    import numpy as np
 
     from polyaxon_tpu.models import llama
     from polyaxon_tpu.parallel import build_mesh
@@ -101,10 +102,35 @@ def main() -> None:
         accum_dtype=accum_dtype,
     )
     trainer = Trainer(cfg)
-    data = make_batches(
-        DataConfig(kind="synthetic-lm", batch_size=batch, seq_len=seq,
-                   vocab_size=mcfg.vocab_size), trainer.mesh,
-    )
+    dcfg = DataConfig(kind="synthetic-lm", batch_size=batch, seq_len=seq,
+                      vocab_size=mcfg.vocab_size)
+    data_kind = None
+    if "--data" in sys.argv:
+        i = sys.argv.index("--data") + 1
+        data_kind = sys.argv[i] if i < len(sys.argv) else None
+        if data_kind != "tokens-file":
+            raise SystemExit(f"--data takes 'tokens-file', got {data_kind!r}")
+    if data_kind == "tokens-file":
+        # prove the input pipeline keeps the chips fed from a real packed
+        # corpus (VERDICT r4 #5): a generated uint16 token file streamed
+        # through memmap + vectorized window gather + background prefetch.
+        # Done-bar: within 2% of the synthetic row.
+        import os
+        import tempfile
+
+        # vocab in the name: a cached file from another model config would
+        # silently feed out-of-range or degenerate tokens
+        path = os.path.join(
+            tempfile.gettempdir(), f"plx_bench_tokens_v{mcfg.vocab_size}.npy")
+        need = 200_000_000  # ~50x the tokens one bench consumes
+        if not (os.path.exists(path) and
+                np.load(path, mmap_mode="r").shape[0] >= need):
+            rng = np.random.default_rng(0)
+            tdt = np.uint16 if mcfg.vocab_size <= 65536 else np.uint32
+            np.save(path, rng.integers(0, mcfg.vocab_size, need, dtype=tdt))
+        dcfg = DataConfig(kind="tokens-file", path=path, batch_size=batch,
+                          seq_len=seq, vocab_size=mcfg.vocab_size)
+    data = make_batches(dcfg, trainer.mesh)
     state, metrics = trainer.fit(data, num_steps=steps)
 
     mfu = metrics["mfu"]

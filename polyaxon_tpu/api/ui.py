@@ -1,11 +1,19 @@
 """Dashboard (upstream `ui/` — SURVEY.md §2 "UI" row; VERDICT r3 #10
-"dashboard v2"): a single static page over the existing REST endpoints.
+"dashboard v2", r4 #4 "sweep UI"): a single static page over the existing
+REST endpoints.
 
 v2 features: runs table with status filter, real metric line charts (axes,
 ticks, grid, hover readout) drawn from the metric event files, multi-run
 compare (check runs -> overlaid per-metric charts + params/outputs table),
 an artifact browser over ``/artifacts/tree`` with per-file download links
 (profile traces highlighted), statuses timeline, and a live log tail.
+
+v3 (round 5) adds the tuning views: the runs table groups pipeline
+children under their parent as a collapsible tree with live statuses, and
+pipeline runs get a **Sweep** tab — params-vs-metric scatter and a
+parallel-coordinates plot over the children's recorded inputs/outputs
+(queryable since the r4 store work), plus a ranked leaderboard. Open a
+finished ASHA sweep and see which params won without the CLI.
 No build step, no dependencies — vanilla JS + fetch + inline SVG.
 """
 
@@ -53,6 +61,8 @@ UI_HTML = """<!DOCTYPE html>
   .cmp { font-size: 12px; }
   #cmpBar { margin: 6px 0; }
   button.small { font-size: 12px; padding: 2px 8px; }
+  .twist { cursor: pointer; color: #697386; user-select: none; }
+  .winner td { background: #f0faf4; }
 </style>
 </head>
 <body>
@@ -78,6 +88,7 @@ UI_HTML = """<!DOCTYPE html>
     <div class="tabs" id="tabs" style="display:none">
       <button data-tab="overview" class="active">Overview</button>
       <button data-tab="metrics">Metrics</button>
+      <button data-tab="sweep" id="sweepTab" style="display:none">Sweep</button>
       <button data-tab="artifacts">Artifacts</button>
       <button data-tab="logs">Logs</button>
     </div>
@@ -123,6 +134,52 @@ async function loadProjects() {
                          checked.clear(); refresh(); };
 }
 function stBadge(s) { return `<span class="st ${s}">${s}</span>`; }
+let collapsed = new Set();
+function addRunRow(tb, r, depth, kids) {
+  const tr = document.createElement("tr");
+  const pad = depth ? `style="padding-left:${8 + depth * 18}px"` : "";
+  const twist = kids.length
+    ? `<span class="twist" data-u="${r.uuid}">${collapsed.has(r.uuid) ? "&#9656;" : "&#9662;"}</span> `
+    : (depth ? `<span class="muted">&#9492;</span> ` : "");
+  const kidNote = kids.length
+    ? ` <span class="muted">(${kids.length} children)</span>` : "";
+  tr.innerHTML =
+    `<td><input type="checkbox" data-u="${r.uuid}"` +
+    `${checked.has(r.uuid) ? " checked" : ""}/></td>` +
+    `<td ${pad}>${twist}${esc(r.name || "")}${kidNote}</td>` +
+    `<td>${esc(r.kind || "")}</td>` +
+    `<td>${stBadge(r.status)}</td><td class="muted">${r.uuid.slice(0,8)}</td>`;
+  tr.querySelector("input").onclick = (ev) => {
+    ev.stopPropagation();
+    if (ev.target.checked) checked.add(r.uuid); else checked.delete(r.uuid);
+    updateCmpBar();
+  };
+  const tw = tr.querySelector(".twist");
+  if (tw) tw.onclick = (ev) => {
+    ev.stopPropagation();
+    if (collapsed.has(r.uuid)) collapsed.delete(r.uuid);
+    else collapsed.add(r.uuid);
+    renderRunsTable();
+  };
+  tr.onclick = () => { selected = r.uuid; compare = null; artPath = ""; render(); };
+  tb.appendChild(tr);
+  if (!collapsed.has(r.uuid))
+    for (const c of kids) addRunRow(tb, c, depth + 1, childrenOf(c.uuid));
+}
+function childrenOf(uuid) {
+  return runCache.filter(r => r.pipeline_uuid === uuid);
+}
+function renderRunsTable() {
+  const tb = $("#runsTable tbody");
+  tb.innerHTML = "";
+  const present = new Set(runCache.map(r => r.uuid));
+  for (const r of runCache) {
+    // top level: no parent, or parent not in the listing (filtered out)
+    if (r.pipeline_uuid && present.has(r.pipeline_uuid)) continue;
+    addRunRow(tb, r, 0, childrenOf(r.uuid));
+  }
+  updateCmpBar();
+}
 async function loadRuns() {
   if (!project) return;
   const f = $("#stFilter").value;
@@ -130,24 +187,7 @@ async function loadRuns() {
                        (f ? `&status=${f}` : ""));
   runCache = runs;
   $("#count").textContent = runs.length + " runs";
-  const tb = $("#runsTable tbody");
-  tb.innerHTML = "";
-  for (const r of runs) {
-    const tr = document.createElement("tr");
-    tr.innerHTML =
-      `<td><input type="checkbox" data-u="${r.uuid}"` +
-      `${checked.has(r.uuid) ? " checked" : ""}/></td>` +
-      `<td>${esc(r.name || "")}</td><td>${esc(r.kind || "")}</td>` +
-      `<td>${stBadge(r.status)}</td><td class="muted">${r.uuid.slice(0,8)}</td>`;
-    tr.querySelector("input").onclick = (ev) => {
-      ev.stopPropagation();
-      if (ev.target.checked) checked.add(r.uuid); else checked.delete(r.uuid);
-      updateCmpBar();
-    };
-    tr.onclick = () => { selected = r.uuid; compare = null; artPath = ""; render(); };
-    tb.appendChild(tr);
-  }
-  updateCmpBar();
+  renderRunsTable();
 }
 function updateCmpBar() {
   $("#cmpBtn").style.display = checked.size >= 2 ? "" : "none";
@@ -234,6 +274,61 @@ function lineChart(series, opts) {
     });
   }, 0);
   return chart;
+}
+// ---- sweep charts ---------------------------------------------------------
+function heat(t) {
+  // 0 (best, green) -> 1 (worst, red) through amber
+  const h = 140 - 140 * Math.min(Math.max(t, 0), 1);
+  return `hsl(${h}, 70%, 45%)`;
+}
+function scatterChart(pts, xlabel, ylabel) {
+  // pts: [{x, y, label, color}]
+  const w = 420, h = 230, mL = 56, mR = 12, mT = 10, mB = 30;
+  if (!pts.length) return "";
+  let xmin = Math.min(...pts.map(p => p.x)), xmax = Math.max(...pts.map(p => p.x));
+  let ymin = Math.min(...pts.map(p => p.y)), ymax = Math.max(...pts.map(p => p.y));
+  if (xmax === xmin) { xmax += Math.abs(xmax) * 0.05 + 1e-9; xmin -= Math.abs(xmin) * 0.05 + 1e-9; }
+  if (ymax === ymin) { ymax += Math.abs(ymax) * 0.05 + 1e-9; ymin -= Math.abs(ymin) * 0.05 + 1e-9; }
+  const X = x => mL + (x - xmin) / (xmax - xmin) * (w - mL - mR);
+  const Y = y => h - mB - (y - ymin) / (ymax - ymin) * (h - mT - mB);
+  let g = "";
+  for (const ty of niceTicks(ymin, ymax, 5)) g +=
+    `<line x1="${mL}" y1="${Y(ty)}" x2="${w - mR}" y2="${Y(ty)}" stroke="#eef1f4"/>` +
+    `<text x="${mL - 6}" y="${Y(ty) + 3}" font-size="10" fill="#697386" text-anchor="end">${fmt(ty)}</text>`;
+  for (const tx of niceTicks(xmin, xmax, 5)) g +=
+    `<text x="${X(tx)}" y="${h - 14}" font-size="10" fill="#697386" text-anchor="middle">${fmt(tx)}</text>`;
+  let dots = "";
+  for (const p of pts) dots +=
+    `<circle cx="${X(p.x).toFixed(1)}" cy="${Y(p.y).toFixed(1)}" r="4" ` +
+    `fill="${p.color}" fill-opacity="0.85"><title>${esc(p.label)}: ` +
+    `${xlabel}=${fmt(p.x)} ${ylabel}=${fmt(p.y)}</title></circle>`;
+  return `<svg class="chart" width="${w}" height="${h}">` + g + dots +
+    `<text x="${(w + mL) / 2}" y="${h - 2}" font-size="10" fill="#1a1f36" ` +
+    `text-anchor="middle">${esc(xlabel)}</text>` +
+    `<text x="12" y="${mT + 8}" font-size="10" fill="#1a1f36">${esc(ylabel)}</text></svg>`;
+}
+function parcoords(axes, rows) {
+  // axes: [{name, min, max}]; rows: [{vals: [...], t (0 best..1 worst), label}]
+  const w = Math.max(420, axes.length * 110), h = 230, mT = 24, mB = 14;
+  const ax = i => 40 + i * (w - 80) / Math.max(axes.length - 1, 1);
+  const Y = (a, v) => {
+    const lo = a.min, hi = a.max === a.min ? a.min + 1 : a.max;
+    return h - mB - (v - lo) / (hi - lo) * (h - mT - mB);
+  };
+  let g = "";
+  axes.forEach((a, i) => {
+    g += `<line x1="${ax(i)}" y1="${mT}" x2="${ax(i)}" y2="${h - mB}" stroke="#cfd7e0"/>` +
+         `<text x="${ax(i)}" y="12" font-size="10" fill="#1a1f36" text-anchor="middle">${esc(a.name)}</text>` +
+         `<text x="${ax(i)}" y="${mT - 2}" font-size="9" fill="#697386" text-anchor="middle">${fmt(a.max)}</text>` +
+         `<text x="${ax(i)}" y="${h - 2}" font-size="9" fill="#697386" text-anchor="middle">${fmt(a.min)}</text>`;
+  });
+  let lines = "";
+  for (const r of rows) {
+    const pts = r.vals.map((v, i) => `${ax(i).toFixed(1)},${Y(axes[i], v).toFixed(1)}`).join(" ");
+    lines += `<polyline fill="none" stroke="${heat(r.t)}" stroke-width="1.5" ` +
+      `stroke-opacity="0.75" points="${pts}"><title>${esc(r.label)}</title></polyline>`;
+  }
+  return `<svg class="chart" width="${w}" height="${h}">` + g + lines + `</svg>`;
 }
 function toPts(events) {
   const pts = [];
@@ -326,6 +421,97 @@ async function renderLogs(r) {
   const logs = await text(`/api/v1/${project}/runs/${r.uuid}/logs?tail=400`);
   return logs ? `<pre>${esc(logs)}</pre>` : '<span class="muted">no logs yet</span>';
 }
+let sweepMetric = null, sweepParam = null, sweepMax = false;
+async function renderSweep(r) {
+  const LIM = 2000;
+  const kids = await j(`/api/v1/${project}/runs?pipeline_uuid=${r.uuid}&limit=${LIM}`);
+  if (!kids.length) return '<span class="muted">no child runs yet</span>';
+  const truncated = kids.length >= LIM;
+  const num = v => typeof v === "number" && isFinite(v);
+  const pkeys = [...new Set(kids.flatMap(k => Object.keys(k.inputs || {})
+                  .filter(p => num((k.inputs || {})[p]))))].sort();
+  const mkeys = [...new Set(kids.flatMap(k => Object.keys(k.outputs || {})
+                  .filter(m => num((k.outputs || {})[m]))))].sort();
+  if (!mkeys.length)
+    return `<span class="muted">${kids.length} children, no numeric outputs yet</span>`;
+  if (!mkeys.includes(sweepMetric))
+    sweepMetric = mkeys.includes("loss") ? "loss" : mkeys[0];
+  if (!pkeys.includes(sweepParam)) sweepParam = pkeys[0] || null;
+  const done = kids.filter(k => num((k.outputs || {})[sweepMetric]));
+  const vals = done.map(k => k.outputs[sweepMetric]);
+  const lo = Math.min(...vals), hi = Math.max(...vals);
+  const tOf = v => { // 0 = best
+    const t = hi === lo ? 0 : (v - lo) / (hi - lo);
+    return sweepMax ? 1 - t : t;
+  };
+  const label = k => k.name || k.uuid.slice(0, 8);
+  let html =
+    (truncated ? `<div class="muted">&#9888; showing first ${LIM} children ` +
+                 `only — leaderboard may be incomplete</div>` : "") +
+    `<div class="muted">${kids.length} children, ${done.length} with ` +
+    `<b>${esc(sweepMetric)}</b> &nbsp; metric ` +
+    `<select id="swMetric">${mkeys.map(m =>
+      `<option${m === sweepMetric ? " selected" : ""}>${esc(m)}</option>`).join("")}` +
+    `</select> <label><input type="checkbox" id="swMax"${sweepMax ? " checked" : ""}/>` +
+    ` higher is better</label></div>`;
+  if (sweepParam && done.length) {
+    html += `<h3>${esc(sweepMetric)} vs <select id="swParam">${pkeys.map(p =>
+      `<option${p === sweepParam ? " selected" : ""}>${esc(p)}</option>`).join("")}` +
+      `</select></h3>`;
+    html += scatterChart(done
+      .filter(k => num((k.inputs || {})[sweepParam]))
+      .map(k => ({
+        x: k.inputs[sweepParam], y: k.outputs[sweepMetric],
+        label: label(k), color: heat(tOf(k.outputs[sweepMetric])),
+      })), sweepParam, sweepMetric);
+  }
+  if (pkeys.length >= 1 && done.length) {
+    const axes = pkeys.map(p => {
+      const vs = done.map(k => (k.inputs || {})[p]).filter(num);
+      return {name: p, min: Math.min(...vs), max: Math.max(...vs)};
+    }).concat([{name: sweepMetric, min: lo, max: hi}]);
+    const rows = done
+      .filter(k => pkeys.every(p => num((k.inputs || {})[p])))
+      .map(k => ({
+        vals: pkeys.map(p => k.inputs[p]).concat([k.outputs[sweepMetric]]),
+        t: tOf(k.outputs[sweepMetric]), label: label(k),
+      }));
+    html += `<h3>Parallel coordinates <span class="muted">green = best</span></h3>` +
+            parcoords(axes, rows);
+  }
+  const ranked = [...done].sort((a, b) =>
+    sweepMax ? b.outputs[sweepMetric] - a.outputs[sweepMetric]
+             : a.outputs[sweepMetric] - b.outputs[sweepMetric]);
+  html += `<h3>Leaderboard</h3><table class="cmp"><tr><th>#</th><th>run</th>` +
+    `<th>status</th><th>${esc(sweepMetric)}</th>` +
+    pkeys.map(p => `<th>${esc(p)}</th>`).join("") + `</tr>`;
+  ranked.slice(0, 10).forEach((k, i) => {
+    html += `<tr class="${i === 0 ? "winner" : ""} krow" data-u="${k.uuid}">` +
+      `<td>${i + 1}</td><td>${esc(label(k))}</td><td>${stBadge(k.status)}</td>` +
+      `<td>${fmt(k.outputs[sweepMetric])}</td>` +
+      pkeys.map(p => `<td>${num((k.inputs || {})[p]) ? fmt(k.inputs[p]) : ""}</td>`).join("") +
+      `</tr>`;
+  });
+  html += `</table>`;
+  const pending = kids.filter(k => !num((k.outputs || {})[sweepMetric]));
+  if (pending.length) {
+    html += `<h3>In flight / no result</h3><table class="cmp">`;
+    for (const k of pending) html +=
+      `<tr class="krow" data-u="${k.uuid}"><td>${esc(label(k))}</td>` +
+      `<td>${stBadge(k.status)}</td></tr>`;
+    html += `</table>`;
+  }
+  return html;
+}
+function wireSweep() {
+  const m = $("#swMetric"), p = $("#swParam"), x = $("#swMax");
+  if (m) m.onchange = () => { sweepMetric = m.value; render(); };
+  if (p) p.onchange = () => { sweepParam = p.value; render(); };
+  if (x) x.onchange = () => { sweepMax = x.checked; render(); };
+  document.querySelectorAll("#dBody .krow").forEach(el => {
+    el.onclick = () => { selected = el.dataset.u; tab = "overview"; render(); };
+  });
+}
 async function renderCompare(uuids) {
   const runs = await Promise.all(
     uuids.map(u => j(`/api/v1/${project}/runs/${u}`)));
@@ -363,14 +549,27 @@ async function render() {
   const r = await j(`/api/v1/${project}/runs/${selected}`);
   $("#dTitle").innerHTML = `${esc(r.name || r.uuid)} ${stBadge(r.status)}`;
   $("#tabs").style.display = "";
+  // own children query, not the status-filtered runCache: a finished
+  // pipeline viewed under a "running" filter must keep its Sweep tab
+  let hasKids = childrenOf(r.uuid).length > 0;
+  if (!hasKids) {
+    try {
+      hasKids = (await j(
+        `/api/v1/${project}/runs?pipeline_uuid=${r.uuid}&limit=1`)).length > 0;
+    } catch (e) {}
+  }
+  $("#sweepTab").style.display = hasKids ? "" : "none";
+  if (tab === "sweep" && !hasKids) tab = "overview";
   document.querySelectorAll("#tabs button").forEach(b =>
     b.classList.toggle("active", b.dataset.tab === tab));
   let html = "";
   if (tab === "overview") html = await renderOverview(r);
   else if (tab === "metrics") html = await renderMetrics(r);
+  else if (tab === "sweep") html = await renderSweep(r);
   else if (tab === "artifacts") html = await renderArtifacts(r);
   else if (tab === "logs") html = await renderLogs(r);
   $("#dBody").innerHTML = html || '<span class="muted">no data yet</span>';
+  if (tab === "sweep") wireSweep();
   if (tab === "artifacts") {
     document.querySelectorAll("#dBody .dir, #dBody .crumb a").forEach(el => {
       el.onclick = () => { artPath = el.dataset.p || ""; render(); };

@@ -301,6 +301,13 @@ class TestUi:
             for marker in ("renderCompare", "renderArtifacts", "lineChart",
                            "data-tab=\"metrics\"", "artifacts/tree"):
                 assert marker in r.text, marker
+            # v3 sweep/tree surfaces (VERDICT r4 #4): pipeline tree rows,
+            # sweep tab with scatter + parallel coordinates + leaderboard,
+            # children fetched by pipeline_uuid
+            for marker in ("renderSweep", "parcoords", "scatterChart",
+                           "data-tab=\"sweep\"", "pipeline_uuid=",
+                           "childrenOf", "Leaderboard"):
+                assert marker in r.text, marker
             # the shell is open; the data endpoints it calls are not
             assert requests.get(f"{srv.url}/api/v1/projects", timeout=5).status_code == 401
         finally:

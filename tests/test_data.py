@@ -1,0 +1,129 @@
+"""Input-pipeline tests (VERDICT r4 #5): vectorized token-file windows,
+background prefetch semantics, and training end-to-end from a token file
+on the 8-device mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.parallel.mesh import build_mesh
+from polyaxon_tpu.train import DataConfig, make_batches
+from polyaxon_tpu.train.data import prefetch, token_file_batches
+
+
+@pytest.fixture()
+def token_file(tmp_path):
+    rng = np.random.default_rng(42)
+    toks = rng.integers(0, 256, 40_000, dtype=np.uint16)  # llama-tiny vocab
+    p = tmp_path / "corpus.npy"
+    np.save(p, toks)
+    return str(p), toks
+
+
+class TestTokenFile:
+    def test_windows_are_contiguous_corpus_slices(self, token_file):
+        path, toks = token_file
+        cfg = DataConfig(kind="tokens-file", path=path, batch_size=4,
+                         seq_len=16, vocab_size=257, seed=7)
+        it = token_file_batches(cfg)
+        for _ in range(3):
+            b = next(it)
+            inputs = np.asarray(b["inputs"])
+            labels = np.asarray(b["labels"])
+            assert inputs.shape == (4, 16) and inputs.dtype == np.int32
+            # labels are inputs shifted by one: both views of one window
+            np.testing.assert_array_equal(inputs[:, 1:], labels[:, :-1])
+            # every row is a contiguous slice of the corpus
+            for row_in, row_lb in zip(inputs, labels):
+                window = np.concatenate([row_in, row_lb[-1:]])
+                s = np.flatnonzero(toks[: len(toks) - 17] == window[0])
+                assert any(
+                    np.array_equal(toks[i : i + 17].astype(np.int32), window)
+                    for i in s
+                ), "window is not a corpus slice"
+
+    def test_deterministic_per_seed(self, token_file):
+        path, _ = token_file
+        cfg = DataConfig(kind="tokens-file", path=path, batch_size=4,
+                         seq_len=16, vocab_size=257, seed=3)
+        a = next(token_file_batches(cfg))
+        b = next(token_file_batches(cfg))
+        np.testing.assert_array_equal(np.asarray(a["inputs"]),
+                                      np.asarray(b["inputs"]))
+
+    def test_raw_bin_dtype_by_vocab(self, tmp_path):
+        toks = np.arange(70_000, dtype=np.uint32) % 66_000
+        p = tmp_path / "corpus.bin"
+        toks.tofile(p)
+        cfg = DataConfig(kind="tokens-file", path=str(p), batch_size=2,
+                         seq_len=8, vocab_size=66_000)
+        b = next(token_file_batches(cfg))
+        assert int(np.asarray(b["inputs"]).max()) < 66_000
+
+    def test_sharded_on_mesh(self, token_file):
+        path, _ = token_file
+        mesh = build_mesh({"data": 4, "context": 2})
+        cfg = DataConfig(kind="tokens-file", path=path, batch_size=8,
+                         seq_len=32, vocab_size=257)
+        b = next(make_batches(cfg, mesh))
+        assert b["inputs"].shape == (8, 32)
+        assert len(b["inputs"].sharding.device_set) == 8
+        assert jnp.issubdtype(b["inputs"].dtype, jnp.int32)
+
+    def test_e2e_training_step(self, token_file):
+        from polyaxon_tpu.train import OptimizerConfig, Trainer, TrainerConfig
+        from polyaxon_tpu.models import llama
+
+        path, _ = token_file
+        cfg = TrainerConfig(
+            model=llama.LLAMA_TINY,
+            optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=0,
+                                      schedule="constant", total_steps=2),
+            batch_size=8, seq_len=32, parallelism={"data": 8},
+        )
+        tr = Trainer(cfg)
+        data = make_batches(
+            DataConfig(kind="tokens-file", path=path, batch_size=8,
+                       seq_len=32, vocab_size=257), tr.mesh)
+        _, metrics = tr.fit(data, num_steps=2)
+        assert np.isfinite(metrics["loss"])
+
+
+class TestPrefetch:
+    def test_order_preserved(self):
+        out = list(prefetch(iter(range(20)), size=3))
+        assert out == list(range(20))
+
+    def test_exception_propagates(self):
+        def gen():
+            yield 1
+            raise RuntimeError("disk gone")
+
+        it = prefetch(gen(), size=2)
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="disk gone"):
+            list(it)
+
+    def test_runs_ahead_of_consumer(self):
+        import threading
+
+        produced = []
+        gate = threading.Event()
+
+        def gen():
+            for i in range(5):
+                produced.append(i)
+                yield i
+
+        it = prefetch(gen(), size=2)
+        first = next(it)
+        assert first == 0
+        # give the worker a beat: it should have buffered ahead without
+        # the consumer asking
+        for _ in range(100):
+            if len(produced) >= 3:
+                break
+            gate.wait(0.01)
+        assert len(produced) >= 3

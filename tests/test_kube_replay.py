@@ -1,0 +1,224 @@
+"""Apiserver-conformance replay (VERDICT r4 #6): canned wire-format traces
+— watch bursts, bookmarks, both 410 delivery paths, a Terminating 409
+window — played through a scripted HTTP server against the REAL
+KubeCluster client. Unlike tests/test_kube_cluster.py's behavioral stub,
+the server here has no behavior of its own: every response byte comes from
+the fixture, in the apiserver's wire format (PodList metadata, Status
+bodies, JSON-lines watch chunks), and the harness additionally asserts the
+CLIENT side of the contract — e.g. that a reconnect carries exactly the
+last delivered resourceVersion. No transition may be lost or duplicated."""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from polyaxon_tpu.operator.kube import KubeApiError, KubeCluster
+
+TRACE_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "kube_traces")
+
+
+def _load(name):
+    with open(os.path.join(TRACE_DIR, name), encoding="utf-8") as f:
+        return json.load(f)
+
+
+class _ReplayServer:
+    """Serves exactly the scripted steps of a trace, records violations."""
+
+    def __init__(self, trace):
+        self.trace = trace
+        self.cursor = 0
+        self.violations = []
+        self.lock = threading.Lock()
+        self.done = threading.Event()  # all steps consumed
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                q = parse_qs(parsed.query)
+                watching = q.get("watch", ["false"])[0] == "true"
+                with outer.lock:
+                    if outer.cursor >= len(outer.steps):
+                        # past the script: hold the connection open so the
+                        # client just waits (watch) or record a violation
+                        if watching:
+                            self.send_response(200)
+                            self.send_header("Content-Type", "application/json")
+                            self.end_headers()
+                            outer.done.set()
+                            time.sleep(30)
+                            return
+                        outer.violations.append(f"unexpected GET {self.path}")
+                        self._reply(500, {})
+                        return
+                    step = outer.steps[outer.cursor]
+                    outer.cursor += 1
+                if step["op"] == "list":
+                    if watching:
+                        outer.violations.append(
+                            f"expected LIST, got WATCH: {self.path}")
+                    self._reply(200, step["response"])
+                    return
+                # watch step
+                if not watching:
+                    outer.violations.append(
+                        f"expected WATCH, got LIST: {self.path}")
+                    self._reply(200, {"kind": "PodList", "items": [],
+                                      "metadata": {"resourceVersion": "0"}})
+                    return
+                got_rv = q.get("resourceVersion", [None])[0]
+                want_rv = step.get("expect_rv")
+                if want_rv is not None and got_rv != want_rv:
+                    outer.violations.append(
+                        f"watch reconnect rv={got_rv!r}, trace expects "
+                        f"{want_rv!r} (losing or replaying events)")
+                if q.get("allowWatchBookmarks", ["false"])[0] != "true":
+                    outer.violations.append("watch without allowWatchBookmarks")
+                if step.get("http_status"):
+                    self._reply(step["http_status"], step["response"])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                # no Content-Length: streamed; connection closes at end
+                self.send_header("Connection", "close")
+                self.end_headers()
+                for ev in step["events"]:
+                    self.wfile.write((json.dumps(ev) + "\n").encode())
+                    self.wfile.flush()
+                    time.sleep(0.01)
+                if step.get("end") == "hold":
+                    outer.done.set()
+                    time.sleep(30)
+                # "close": just return -> TCP close -> client reconnects
+
+            def _crud(self):
+                with outer.lock:
+                    if outer.cursor >= len(outer.crud):
+                        outer.violations.append(
+                            f"unexpected {self.command} {self.path}")
+                        self._reply(500, {})
+                        return
+                    step = outer.crud[outer.cursor]
+                    outer.cursor += 1
+                if step["method"] != self.command or \
+                        step["path_contains"] not in self.path:
+                    outer.violations.append(
+                        f"step {outer.cursor}: trace has {step['method']} "
+                        f"*{step['path_contains']}*, client sent "
+                        f"{self.command} {self.path}")
+                ln = int(self.headers.get("Content-Length") or 0)
+                if ln:
+                    self.rfile.read(ln)
+                if outer.cursor >= len(outer.crud):
+                    outer.done.set()
+                self._reply(step["status"], step["response"])
+
+            def do_POST(self):
+                self._crud()
+
+            def do_DELETE(self):
+                self._crud()
+
+        self.steps = trace.get("steps", [])
+        self.crud = trace.get("crud", [])
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _collect_watch(trace_name, min_events, timeout=20):
+    trace = _load(trace_name)
+    srv = _ReplayServer(trace)
+    kc = KubeCluster(host=srv.url, token="replay-token", namespace="default")
+    events = []
+    stop = threading.Event()
+    got_all = threading.Event()
+
+    def on_event(typ, st):
+        events.append([typ, st.name, st.phase.value])
+        if len(events) >= min_events:
+            got_all.set()
+
+    t = threading.Thread(
+        target=kc.watch_pods,
+        args=({"app.polyaxon.com/run": None}, on_event, stop), daemon=True)
+    t.start()
+    got_all.wait(timeout)
+    stop.set()
+    srv.stop()
+    t.join(timeout=5)
+    return trace, srv, events
+
+
+class TestWatchReplay:
+    @pytest.mark.parametrize("trace_name", [
+        "burst_reconnect.json",
+        "compaction_410_midburst.json",
+        "http_410_on_reconnect.json",
+    ])
+    def test_trace_replays_exactly(self, trace_name):
+        trace = _load(trace_name)
+        expect = trace["expect_events"]
+        trace, srv, events = _collect_watch(trace_name, len(expect))
+        assert srv.violations == [], srv.violations
+        assert events == expect, (
+            f"\nexpected: {json.dumps(expect, indent=1)}"
+            f"\ngot:      {json.dumps(events, indent=1)}")
+
+
+class TestCrudReplay:
+    def test_terminating_conflict_window(self):
+        trace = _load("terminating_conflict.json")
+        srv = _ReplayServer(trace)
+        kc = KubeCluster(host=srv.url, token="replay-token",
+                         namespace="default")
+        manifest = {
+            "kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": "plx-run-tt-0",
+                         "labels": {"app.polyaxon.com/run": "tt"}},
+            "spec": {"containers": [{"name": "main", "image": "plx:latest"}]},
+        }
+        kc.apply(manifest)  # must ride out the 409/DELETE/409/201 window
+        assert srv.done.wait(5), "trace not fully consumed"
+        assert srv.violations == [], srv.violations
+
+    def test_apply_surfaces_non_conflict_errors(self):
+        # a 403 must raise, not be retried into oblivion
+        trace = {"crud": [{
+            "method": "POST", "path_contains": "/pods", "status": 403,
+            "response": {"kind": "Status", "status": "Failure",
+                         "message": "pods is forbidden", "reason": "Forbidden",
+                         "code": 403}}]}
+        srv = _ReplayServer(trace)
+        kc = KubeCluster(host=srv.url, token="replay-token",
+                         namespace="default")
+        with pytest.raises(KubeApiError) as ei:
+            kc.apply({"kind": "Pod", "metadata": {"name": "x"}, "spec": {}})
+        assert ei.value.status == 403
+        srv.stop()
