@@ -152,6 +152,7 @@ class ApiApp:
         r.add_get("/api/v1/{project}/runs/{uuid}/artifacts/file", self.artifacts_file)
         r.add_post("/api/v1/{project}/runs/{uuid}/lineage", self.post_lineage)
         r.add_get("/api/v1/{project}/runs/{uuid}/lineage", self.get_lineage)
+        r.add_get("/api/v1/{project}/runs/{uuid}/portforward", self.portforward)
 
     # -- handlers ----------------------------------------------------------
 
@@ -374,6 +375,79 @@ class ApiApp:
         names = request.rel_url.query.get("names")
         names = names.split(",") if names else list_event_names(rd, kind)
         return _json({n: [e.to_dict() for e in read_events(rd, kind, n)] for n in names})
+
+    async def portforward(self, request):
+        """TCP-over-websocket bridge to a `kind: service` run (SURVEY.md:97
+        `polyaxon port-forward`). The agent stamped where the service is
+        reachable *from this server* into meta["service"] (loopback for
+        local/FakeCluster pods, Service DNS under KubeCluster); the CLI
+        bridges a local listening socket to this endpoint — an SSH-less
+        TCP proxy through the agent, no SPDY required. Binary ws messages
+        carry the byte stream in both directions; either side closing
+        tears down the other."""
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        svc = (run.get("meta") or {}).get("service")
+        if not svc:
+            return _json(
+                {"error": "run has no service endpoint (not a service "
+                          "kind, or not scheduled yet)"}, status=409)
+        port = int(request.rel_url.query.get("port", svc["port"]))
+        # only the run's DECLARED ports are reachable: the stamped host is
+        # the server's own vantage point (loopback in local deployments),
+        # so a free-form ?port= would be a bridge to every local daemon
+        declared = {int(svc["port"])}
+        run_sec = (((run.get("spec") or {}).get("component") or {})
+                   .get("run") or {})
+        declared.update(int(p) for p in (run_sec.get("ports") or []))
+        if port not in declared:
+            return _json(
+                {"error": f"port {port} is not a declared port of this "
+                          f"service (declared: {sorted(declared)})"},
+                status=403)
+        ws = web.WebSocketResponse(max_msg_size=1 << 22)
+        await ws.prepare(request)
+        try:
+            reader, writer = await asyncio.open_connection(svc["host"], port)
+        except OSError as e:
+            await ws.close(code=1011, message=str(e).encode()[:120])
+            return ws
+
+        async def to_target():
+            async for msg in ws:
+                if msg.type != web.WSMsgType.BINARY:
+                    break
+                if not msg.data:
+                    # in-band EOF marker: the CLI's local client half-closed
+                    # — forward the FIN, keep reading (ws stays open for
+                    # the response direction)
+                    if writer.can_write_eof():
+                        writer.write_eof()
+                    continue
+                writer.write(msg.data)
+                await writer.drain()
+
+        async def to_client():
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    break
+                await ws.send_bytes(data)
+            await ws.close()
+
+        tasks = [asyncio.ensure_future(to_target()),
+                 asyncio.ensure_future(to_client())]
+        try:
+            await asyncio.wait(tasks, return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            for t in tasks:
+                t.cancel()
+            # retrieve results so abrupt disconnects don't log
+            # "Task exception was never retrieved" per dropped tunnel
+            await asyncio.gather(*tasks, return_exceptions=True)
+            writer.close()
+        return ws
 
     async def get_logs(self, request):
         """Log text (?offset=N&tail=M; X-Log-Offset header)."""

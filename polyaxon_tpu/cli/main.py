@@ -458,6 +458,60 @@ def project_ls(host):
         click.echo(r["name"])
 
 
+@cli.command("port-forward")
+@click.argument("uuid")
+@click.option("--port", "local_port", default=0, type=int,
+              help="local port to listen on (default: auto-pick)")
+@click.option("--remote-port", default=None, type=int,
+              help="service port to target (default: the declared one)")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+def port_forward(uuid, local_port, remote_port, project, host):
+    """Forward a local port to a `kind: service` run (upstream
+    `polyaxon port-forward`). Local runs proxy straight to the service's
+    endpoint; remote runs bridge TCP over a websocket through the API
+    server, which dials the Service from inside the deployment."""
+    from .portforward import start_tcp_proxy, start_ws_proxy
+
+    rc, local = _ops_client(host, project)
+    if rc:
+        run = rc.refresh(uuid)
+        svc = (run.get("meta") or {}).get("service")
+        if not svc:
+            raise click.ClickException(
+                "run has no service endpoint (not a `kind: service` run, "
+                "or not scheduled yet)")
+        h = get_host(host)
+        ws_url = (h.replace("https://", "wss://").replace("http://", "ws://")
+                  + f"/api/v1/{rc.project}/runs/{uuid}/portforward")
+        if remote_port:
+            ws_url += f"?port={remote_port}"
+        bound, stop = start_ws_proxy(ws_url, token=get_token(h),
+                                     local_port=local_port)
+        target = f"{h} -> service:{remote_port or svc['port']}"
+    else:
+        store, proj = local
+        run = store.get_run(uuid)
+        if run is None:
+            raise click.ClickException(f"run {uuid} not found")
+        svc = (run.get("meta") or {}).get("service")
+        if not svc:
+            raise click.ClickException(
+                "run has no service endpoint (not a `kind: service` run, "
+                "or not scheduled yet)")
+        bound, stop = start_tcp_proxy(
+            svc["host"], int(remote_port or svc["port"]),
+            local_port=local_port)
+        target = f"{svc['host']}:{remote_port or svc['port']}"
+    click.echo(f"Forwarding 127.0.0.1:{bound} -> {target} (Ctrl-C to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        stop()
+        click.echo("stopped")
+
+
 # -- config / server --------------------------------------------------------
 
 

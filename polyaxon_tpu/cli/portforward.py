@@ -1,0 +1,177 @@
+"""`polyaxon_tpu port-forward` plumbing (SURVEY.md:97).
+
+Two transports behind one UX:
+
+- **direct** — the service endpoint is reachable from this machine
+  (hostless local mode: the agent stamped loopback + port into
+  meta["service"]). A plain threaded TCP proxy.
+- **websocket** — the service runs behind a remote API server; bytes
+  bridge over ``GET /api/v1/{project}/runs/{uuid}/portforward`` (the
+  server side dials the Service from its own vantage point — an SSH-less
+  TCP proxy through the agent, no SPDY needed).
+
+Both return ``(bound_local_port, stop_callable)`` so the CLI can print
+the port and block, and tests can drive them programmatically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+from typing import Callable, Optional
+
+
+def start_tcp_proxy(
+    target_host: str, target_port: int, local_port: int = 0,
+) -> tuple[int, Callable[[], None]]:
+    """Listen on 127.0.0.1:local_port, pipe each connection to the target."""
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", local_port))
+    lsock.listen(16)
+    stop = threading.Event()
+
+    def bridge(a: socket.socket, b: socket.socket) -> None:
+        # TCP half-close preserved: a clean EOF forwards as shutdown(WR) on
+        # the peer (the response keeps flowing the other way — `nc -N`
+        # style clients rely on it); sockets close only when BOTH
+        # directions have finished, or on error.
+        lock = threading.Lock()
+        finished = [0]
+
+        def pump(src: socket.socket, dst: socket.socket) -> None:
+            try:
+                while True:
+                    data = src.recv(1 << 16)
+                    if not data:
+                        try:
+                            dst.shutdown(socket.SHUT_WR)
+                        except OSError:
+                            pass
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                with lock:
+                    finished[0] += 1
+                    last = finished[0] == 2
+                if last:
+                    for s in (a, b):
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+
+        threading.Thread(target=pump, args=(a, b), daemon=True).start()
+        threading.Thread(target=pump, args=(b, a), daemon=True).start()
+
+    def accept_loop() -> None:
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                tgt = socket.create_connection(
+                    (target_host, target_port), timeout=10)
+            except OSError:
+                conn.close()
+                continue
+            bridge(conn, tgt)
+
+    threading.Thread(target=accept_loop, daemon=True,
+                     name="plx-portforward").start()
+    port = lsock.getsockname()[1]
+
+    def stopper() -> None:
+        stop.set()
+        try:
+            lsock.close()
+        except OSError:
+            pass
+
+    return port, stopper
+
+
+def start_ws_proxy(
+    ws_url: str, token: Optional[str] = None, local_port: int = 0,
+) -> tuple[int, Callable[[], None]]:
+    """Listen on 127.0.0.1:local_port, bridge each connection over a fresh
+    websocket to the API's portforward endpoint."""
+    import aiohttp
+
+    ready = threading.Event()
+    state: dict = {}
+
+    async def handle(reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        try:
+            async with aiohttp.ClientSession(headers=headers) as session:
+                async with session.ws_connect(
+                        ws_url, max_msg_size=1 << 22) as ws:
+
+                    async def to_ws():
+                        while True:
+                            data = await reader.read(1 << 16)
+                            if not data:
+                                # local half-close: forward as the in-band
+                                # empty-frame EOF marker (the server does
+                                # write_eof to the target) but keep the ws
+                                # open for the response direction
+                                await ws.send_bytes(b"")
+                                return
+                            await ws.send_bytes(data)
+
+                    async def to_sock():
+                        async for msg in ws:
+                            if msg.type != aiohttp.WSMsgType.BINARY:
+                                break
+                            writer.write(msg.data)
+                            await writer.drain()
+
+                    send_task = asyncio.ensure_future(to_ws())
+                    # the tunnel lives until the response direction ends
+                    # (server closes the ws on target EOF)
+                    try:
+                        await to_sock()
+                    finally:
+                        send_task.cancel()
+                        await asyncio.gather(send_task, return_exceptions=True)
+        except Exception as e:  # noqa: BLE001 — must be VISIBLE to the user
+            import sys
+
+            print(f"[port-forward] tunnel error: {e!r}", file=sys.stderr)
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def run() -> None:
+        async def amain():
+            loop = asyncio.get_running_loop()
+            server = await asyncio.start_server(
+                handle, "127.0.0.1", local_port)
+            state["loop"] = loop
+            state["port"] = server.sockets[0].getsockname()[1]
+            state["stop"] = loop.create_future()
+            ready.set()
+            async with server:
+                await state["stop"]
+
+        asyncio.run(amain())
+
+    threading.Thread(target=run, daemon=True, name="plx-portforward-ws").start()
+    if not ready.wait(10):
+        raise RuntimeError("port-forward listener failed to start")
+
+    def stopper() -> None:
+        loop = state.get("loop")
+        if loop is not None and loop.is_running():
+            loop.call_soon_threadsafe(
+                lambda: state["stop"].done() or state["stop"].set_result(None))
+
+    return state["port"], stopper

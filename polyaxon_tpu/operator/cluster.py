@@ -60,6 +60,13 @@ class Cluster(ABC):
     @abstractmethod
     def pod_logs(self, name: str) -> str: ...
 
+    def service_host(self, name: str) -> str:
+        """Host a Service's declared port is reachable at from the agent's
+        vantage point — feeds ``polyaxon_tpu port-forward``. FakeCluster
+        pods are loopback processes binding their declared ports directly;
+        a real cluster resolves the Service DNS name."""
+        return "127.0.0.1"
+
 
 def _match_labels(manifest: dict, selector: dict[str, str]) -> bool:
     labels = (manifest.get("metadata") or {}).get("labels") or {}

@@ -198,6 +198,12 @@ class KubeCluster(Cluster):
                 return ""
             raise
 
+    def service_host(self, name: str) -> str:
+        """Service DNS name — resolvable from any pod in the cluster, so
+        the agent (which runs in-cluster) can proxy ``port-forward``
+        traffic to it."""
+        return f"{name}.{self.namespace}.svc"
+
     # -- watch ---------------------------------------------------------------
 
     def watch_pods(self, label_selector: dict[str, str], on_event,

@@ -702,6 +702,7 @@ class LocalAgent:
                 connections=self.connections,
             )
             self.store.transition(uuid, V1Statuses.SCHEDULED.value)
+            self._stamp_service_endpoint(uuid, run, resolved)
             if self._use_cluster(resolved):
                 # pods write logs/outputs into the run's artifacts dir via
                 # PLX_ARTIFACTS_PATH; the local executor creates it for its
@@ -721,6 +722,24 @@ class LocalAgent:
             self.store.transition(
                 uuid, V1Statuses.FAILED.value, reason="SchedulingError", message=str(e)[:500],
             )
+
+    def _stamp_service_endpoint(self, uuid: str, run: dict, resolved) -> None:
+        """`kind: service` runs record where their first declared port is
+        reachable from the agent (meta["service"]) — the target
+        ``polyaxon_tpu port-forward`` proxies to (SURVEY.md:97). Local and
+        FakeCluster pods bind their declared ports on loopback; KubeCluster
+        resolves the Service DNS name."""
+        from ..schemas.run import V1RunKind
+
+        if resolved.compiled.get_run_kind() != V1RunKind.SERVICE:
+            return
+        ports = getattr(resolved.compiled.run, "ports", None) or [80]
+        host = "127.0.0.1"
+        if self._use_cluster(resolved):
+            host = self.cluster.service_host(f"plx-{uuid[:12]}")
+        meta = dict(run.get("meta") or {})
+        meta["service"] = {"host": host, "port": int(ports[0])}
+        self.store.update_run(uuid, meta=meta)
 
     def _use_cluster(self, resolved) -> bool:
         """Route this run to the operator path? ``cluster`` always,

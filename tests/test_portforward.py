@@ -1,0 +1,227 @@
+"""`polyaxon_tpu port-forward` e2e (SURVEY.md:97, VERDICT r4 #7): a
+`kind: service` run gets a reachable endpoint stamped into meta, and the
+CLI plumbing forwards a local port to it — directly for local/FakeCluster
+backends, over the API's TCP-over-websocket bridge for remote servers."""
+
+import socket
+import time
+
+import pytest
+import requests
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.cli.portforward import start_tcp_proxy, start_ws_proxy
+from polyaxon_tpu.scheduler.agent import LocalAgent
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _service_spec(port):
+    return {
+        "kind": "operation",
+        "component": {
+            "kind": "component",
+            "name": "tiny-http",
+            "run": {
+                "kind": "service",
+                "ports": [port],
+                "container": {
+                    "command": ["python", "-m", "http.server", str(port),
+                                "--bind", "127.0.0.1"],
+                },
+            },
+        },
+    }
+
+
+def _wait_service_meta(store, uuid, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        run = store.get_run(uuid)
+        svc = (run.get("meta") or {}).get("service")
+        if svc and run["status"] == "running":
+            return svc
+        if run["status"] in ("failed", "stopped"):
+            raise AssertionError(store.get_statuses(uuid))
+        time.sleep(0.1)
+    raise AssertionError("service never reached running with an endpoint")
+
+
+def _wait_http(url, timeout=15):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return requests.get(url, timeout=3)
+        except requests.RequestException as e:
+            last = e
+            time.sleep(0.2)
+    raise AssertionError(f"{url} unreachable: {last}")
+
+
+@pytest.mark.parametrize("backend", ["local", "cluster"])
+def test_port_forward_service_run(tmp_path, backend):
+    """Start a service run under each backend, forward a local port to its
+    stamped endpoint, GET through the tunnel."""
+    port = _free_port()
+    store = Store(":memory:")
+    agent = LocalAgent(store, artifacts_root=str(tmp_path / "a"),
+                       backend=backend)
+    agent.start()
+    stop_proxy = None
+    try:
+        uuid = store.create_run("p", spec=_service_spec(port),
+                                name="svc")["uuid"]
+        svc = _wait_service_meta(store, uuid)
+        assert svc == {"host": "127.0.0.1", "port": port}
+        local_port, stop_proxy = start_tcp_proxy(svc["host"], svc["port"])
+        assert local_port != port
+        r = _wait_http(f"http://127.0.0.1:{local_port}/")
+        assert r.status_code == 200
+        assert "Directory listing" in r.text or r.text
+    finally:
+        if stop_proxy:
+            stop_proxy()
+        agent.stop()
+
+
+def test_port_forward_over_websocket(tmp_path):
+    """Remote mode: bytes bridge local socket -> ws -> API server -> the
+    service, with auth enforced on the endpoint."""
+    import http.server
+    import threading
+
+    from polyaxon_tpu.api.server import ApiServer
+
+    # a real HTTP service the API server will dial
+    httpd = http.server.HTTPServer(
+        ("127.0.0.1", 0), http.server.SimpleHTTPRequestHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    svc_port = httpd.server_address[1]
+
+    srv = ApiServer(artifacts_root=str(tmp_path), port=0,
+                    auth_token="pf-token").start()
+    try:
+        run = srv.store.create_run("p", spec=_service_spec(svc_port),
+                                   name="svc")
+        srv.store.update_run(
+            run["uuid"],
+            meta={"service": {"host": "127.0.0.1", "port": svc_port}})
+        ws_url = (srv.url.replace("http://", "ws://")
+                  + f"/api/v1/p/runs/{run['uuid']}/portforward")
+
+        # auth enforced: no token -> 401 before any bridging
+        assert requests.get(
+            srv.url + f"/api/v1/p/runs/{run['uuid']}/portforward",
+            timeout=5).status_code == 401
+
+        local_port, stop = start_ws_proxy(ws_url, token="pf-token")
+        try:
+            r = _wait_http(f"http://127.0.0.1:{local_port}/")
+            assert r.status_code == 200
+            # a second request through the same tunnel listener works too
+            # (each connection gets its own websocket)
+            assert requests.get(f"http://127.0.0.1:{local_port}/",
+                                timeout=5).status_code == 200
+        finally:
+            stop()
+    finally:
+        srv.stop()
+        httpd.shutdown()
+
+
+def _half_close_get(local_port):
+    """Send a GET, half-close the write side, then read the full response
+    — the tunnel must keep the response direction alive (kubectl-style
+    half-open semantics)."""
+    s = socket.create_connection(("127.0.0.1", local_port), timeout=10)
+    s.sendall(b"GET / HTTP/1.0\r\nHost: x\r\n\r\n")
+    s.shutdown(socket.SHUT_WR)
+    chunks = []
+    s.settimeout(10)
+    while True:
+        d = s.recv(65536)
+        if not d:
+            break
+        chunks.append(d)
+    s.close()
+    return b"".join(chunks)
+
+
+def test_half_close_preserved_both_transports(tmp_path):
+    import http.server
+    import threading
+
+    from polyaxon_tpu.api.server import ApiServer
+
+    httpd = http.server.HTTPServer(
+        ("127.0.0.1", 0), http.server.SimpleHTTPRequestHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    svc_port = httpd.server_address[1]
+
+    # direct TCP proxy
+    lp, stop = start_tcp_proxy("127.0.0.1", svc_port)
+    try:
+        resp = _half_close_get(lp)
+        assert resp.startswith(b"HTTP/1.0 200"), resp[:80]
+    finally:
+        stop()
+
+    # websocket transport
+    srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+    try:
+        run = srv.store.create_run("p", spec=_service_spec(svc_port), name="s")
+        srv.store.update_run(
+            run["uuid"],
+            meta={"service": {"host": "127.0.0.1", "port": svc_port}})
+        ws_url = (srv.url.replace("http://", "ws://")
+                  + f"/api/v1/p/runs/{run['uuid']}/portforward")
+        lp, stop = start_ws_proxy(ws_url)
+        try:
+            resp = _half_close_get(lp)
+            assert resp.startswith(b"HTTP/1.0 200"), resp[:80]
+        finally:
+            stop()
+    finally:
+        srv.stop()
+        httpd.shutdown()
+
+
+def test_portforward_restricts_to_declared_ports(tmp_path):
+    """?port= outside the run's declared ports is refused — the stamped
+    host is the server's own loopback in local deployments, so this would
+    otherwise bridge to any local daemon."""
+    from polyaxon_tpu.api.server import ApiServer
+
+    srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+    try:
+        run = srv.store.create_run("p", spec=_service_spec(8080), name="s")
+        srv.store.update_run(
+            run["uuid"], meta={"service": {"host": "127.0.0.1", "port": 8080}})
+        r = requests.get(
+            srv.url + f"/api/v1/p/runs/{run['uuid']}/portforward?port=22",
+            timeout=5)
+        assert r.status_code == 403
+        assert "declared" in r.json()["error"]
+    finally:
+        srv.stop()
+
+
+def test_port_forward_rejects_non_service_runs(tmp_path):
+    from polyaxon_tpu.api.server import ApiServer
+
+    srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+    try:
+        run = srv.store.create_run("p", spec={"kind": "operation"}, name="j")
+        r = requests.get(
+            srv.url + f"/api/v1/p/runs/{run['uuid']}/portforward", timeout=5)
+        assert r.status_code == 409
+        assert "service" in r.json()["error"]
+    finally:
+        srv.stop()
