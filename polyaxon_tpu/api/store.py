@@ -35,6 +35,7 @@ CREATE TABLE IF NOT EXISTS runs (
     original_uuid TEXT,
     cloning_kind TEXT,
     pipeline_uuid TEXT,
+    created_by TEXT,
     created_at TEXT NOT NULL,
     updated_at TEXT NOT NULL,
     started_at TEXT,
@@ -90,6 +91,11 @@ class Store:
             self._memory_lock = threading.Lock()
         with self._conn_ctx() as conn:
             conn.executescript(_SCHEMA)
+            # additive migration for pre-r5 databases (CREATE TABLE IF NOT
+            # EXISTS won't grow an existing table)
+            cols = {r[1] for r in conn.execute("PRAGMA table_info(runs)")}
+            if "created_by" not in cols:
+                conn.execute("ALTER TABLE runs ADD COLUMN created_by TEXT")
 
     # -- connection plumbing ----------------------------------------------
 
@@ -172,14 +178,16 @@ class Store:
         return {"id": tid, "token": raw, "project": project, "label": label}
 
     def resolve_token(self, raw: str) -> Optional[dict]:
-        """{'id', 'project'} for a live token (project None = admin), or
-        None for unknown/revoked."""
+        """{'id', 'project', 'label'} for a live token (project None =
+        admin), or None for unknown/revoked."""
         with self._conn_ctx() as conn:
             row = conn.execute(
-                "SELECT id, project FROM tokens WHERE token_hash=? AND revoked=0",
+                "SELECT id, project, label FROM tokens "
+                "WHERE token_hash=? AND revoked=0",
                 (self._token_hash(raw),),
             ).fetchone()
-        return {"id": row[0], "project": row[1]} if row else None
+        return ({"id": row[0], "project": row[1], "label": row[2]}
+                if row else None)
 
     def list_tokens(self) -> list[dict]:
         with self._conn_ctx() as conn:
@@ -217,7 +225,8 @@ class Store:
     _RUN_COLS = (
         "uuid", "project", "name", "kind", "status", "spec", "compiled",
         "inputs", "outputs", "meta", "tags", "original_uuid", "cloning_kind",
-        "pipeline_uuid", "created_at", "updated_at", "started_at", "finished_at",
+        "pipeline_uuid", "created_by", "created_at", "updated_at",
+        "started_at", "finished_at",
     )
     _JSON_COLS = {"spec", "compiled", "inputs", "outputs", "meta", "tags"}
 
@@ -257,26 +266,35 @@ class Store:
         original_uuid: Optional[str] = None,
         cloning_kind: Optional[str] = None,
         pipeline_uuid: Optional[str] = None,
+        created_by: Optional[str] = None,
     ) -> dict:
         self.create_project(project)
         if inputs is None and spec:
             # one place for every creation path (CLI, client, server, DAG
             # and schedule children, tuner trials pass explicit inputs)
             inputs = self._params_to_inputs(spec)
+        if created_by is None and pipeline_uuid:
+            # pipeline children (DAG stages, sweep trials, schedule runs)
+            # inherit their parent's owner — ownership filtering must not
+            # split a user's pipeline from its stages (review r5)
+            parent = self.get_run(pipeline_uuid)
+            if parent:
+                created_by = parent.get("created_by")
         run_uuid = uuid or uuid_mod.uuid4().hex
         now = _now()
         with self._conn_ctx() as conn:
             conn.execute(
                 "INSERT INTO runs (uuid, project, name, kind, status, spec, inputs, meta, tags,"
-                " original_uuid, cloning_kind, pipeline_uuid, created_at, updated_at)"
-                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                " original_uuid, cloning_kind, pipeline_uuid, created_by, created_at, updated_at)"
+                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
                 (
                     run_uuid, project, name, kind, V1Statuses.CREATED.value,
                     json.dumps(spec) if spec else None,
                     json.dumps(inputs) if inputs else None,
                     json.dumps(meta) if meta else None,
                     json.dumps(tags) if tags else None,
-                    original_uuid, cloning_kind, pipeline_uuid, now, now,
+                    original_uuid, cloning_kind, pipeline_uuid, created_by,
+                    now, now,
                 ),
             )
             conn.execute(
@@ -311,12 +329,16 @@ class Store:
         limit: int = 100,
         offset: int = 0,
         statuses: Optional[list[str]] = None,
+        created_by: Optional[str] = None,
     ) -> list[dict]:
         q = f"SELECT {','.join(self._RUN_COLS)} FROM runs WHERE 1=1"
         args: list = []
         if project:
             q += " AND project=?"
             args.append(project)
+        if created_by:
+            q += " AND created_by=?"
+            args.append(created_by)
         if status:
             q += " AND status=?"
             args.append(status)

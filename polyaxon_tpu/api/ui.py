@@ -82,7 +82,7 @@ UI_HTML = """<!DOCTYPE html>
     <div id="cmpBar" class="muted">check ≥2 runs to compare
       <button class="small" id="cmpBtn" style="display:none">compare</button></div>
     <table id="runsTable">
-    <thead><tr><th></th><th>name</th><th>kind</th><th>status</th><th>uuid</th></tr></thead>
+    <thead><tr><th></th><th>name</th><th>kind</th><th>status</th><th>by</th><th>uuid</th></tr></thead>
     <tbody></tbody></table></section>
   <section id="detail"><h2 id="dTitle">Select a run</h2>
     <div class="tabs" id="tabs" style="display:none">
@@ -148,7 +148,9 @@ function addRunRow(tb, r, depth, kids) {
     `${checked.has(r.uuid) ? " checked" : ""}/></td>` +
     `<td ${pad}>${twist}${esc(r.name || "")}${kidNote}</td>` +
     `<td>${esc(r.kind || "")}</td>` +
-    `<td>${stBadge(r.status)}</td><td class="muted">${r.uuid.slice(0,8)}</td>`;
+    `<td>${stBadge(r.status)}</td>` +
+    `<td class="muted">${esc(r.created_by || "")}</td>` +
+    `<td class="muted">${r.uuid.slice(0,8)}</td>`;
   tr.querySelector("input").onclick = (ev) => {
     ev.stopPropagation();
     if (ev.target.checked) checked.add(r.uuid); else checked.delete(r.uuid);

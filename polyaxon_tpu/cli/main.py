@@ -215,13 +215,18 @@ def ops():
 @click.option("--project", "-p", default=None)
 @click.option("--host", default=None)
 @click.option("--status", default=None)
+@click.option("--created-by", default=None,
+              help="filter by the token identity that created the run")
 @click.option("--limit", default=20)
-def ops_ls(project, host, status, limit):
+def ops_ls(project, host, status, created_by, limit):
     rc, local = _ops_client(host, project)
-    runs = rc.list(status=status, limit=limit) if rc else \
-        local[0].list_runs(project=local[1], status=status, limit=limit)
+    runs = rc.list(status=status, created_by=created_by, limit=limit) if rc \
+        else local[0].list_runs(project=local[1], status=status,
+                                created_by=created_by, limit=limit)
     for r in runs:
-        click.echo(f"{r['uuid']}  {r['status']:<12} {r.get('kind') or '-':<10} {r.get('name') or ''}")
+        by = f" [{r['created_by']}]" if r.get("created_by") else ""
+        click.echo(f"{r['uuid']}  {r['status']:<12} "
+                   f"{r.get('kind') or '-':<10} {r.get('name') or ''}{by}")
 
 
 @ops.command("get")

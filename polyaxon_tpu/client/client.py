@@ -118,12 +118,15 @@ class RunClient(BaseClient):
         return self._json("GET", self._rpath(uuid=uuid))
 
     def list(self, status: Optional[str] = None, pipeline_uuid: Optional[str] = None,
+             created_by: Optional[str] = None,
              limit: int = 100, offset: int = 0) -> list[dict]:
         params = {"limit": limit, "offset": offset}
         if status:
             params["status"] = status
         if pipeline_uuid:
             params["pipeline_uuid"] = pipeline_uuid
+        if created_by:
+            params["created_by"] = created_by
         return self._json("GET", f"/api/v1/{self.project}/runs", params=params)
 
     def delete(self, uuid: Optional[str] = None) -> dict:

