@@ -370,19 +370,28 @@ async function renderOverview(r) {
   } catch (e) {}
   return html;
 }
+function isResourceMetric(n) { return /^(host_|tpu\\d*_)/.test(n); }
 async function renderMetrics(r) {
   let html = "";
   try {
     const m = await j(`/api/v1/${project}/runs/${r.uuid}/metrics`);
     const names = Object.keys(m).sort();
     if (!names.length) return '<span class="muted">no metrics yet</span>';
-    for (const name of names) {
+    const chart = (name) => {
       const pts = toPts(m[name]);
-      if (!pts.length) continue;
-      const series = [{label: name, color: COLORS[0], pts}];
+      if (!pts.length) return "";
       const last = pts[pts.length - 1][1];
-      html += `<h3>${esc(name)} <span class="muted">last ${fmt(last)}</span></h3>` +
-              lineChart(series, {});
+      return `<h3>${esc(name)} <span class="muted">last ${fmt(last)}</span></h3>` +
+             lineChart([{label: name, color: COLORS[0], pts}], {});
+    };
+    for (const name of names.filter(n => !isResourceMetric(n)))
+      html += chart(name);
+    const res = names.filter(isResourceMetric);
+    if (res.length) {
+      // host/TPU telemetry (ResourceLogger) charts in its own section so
+      // training curves stay uncluttered
+      html += `<h2>Resources</h2>`;
+      for (const name of res) html += chart(name);
     }
   } catch (e) { html = `<span class="muted">${esc(e)}</span>`; }
   return html;
@@ -419,9 +428,46 @@ async function renderArtifacts(r) {
   } catch (e) { html = `<span class="muted">no artifacts</span>`; }
   return html;
 }
+let logQuery = "";
 async function renderLogs(r) {
-  const logs = await text(`/api/v1/${project}/runs/${r.uuid}/logs?tail=400`);
-  return logs ? `<pre>${esc(logs)}</pre>` : '<span class="muted">no logs yet</span>';
+  const logs = await text(`/api/v1/${project}/runs/${r.uuid}/logs?tail=2000`);
+  if (!logs) return '<span class="muted">no logs yet</span>';
+  let lines = logs.split("\\n");
+  let note = "";
+  if (logQuery) {
+    const q = logQuery.toLowerCase();
+    const kept = lines.filter(l => l.toLowerCase().includes(q));
+    note = `<span class="muted">${kept.length}/${lines.length} lines</span>`;
+    lines = kept;
+  }
+  const shown = lines.slice(-800);
+  if (shown.length < lines.length)
+    note += ` <span class="muted">(showing last ${shown.length})</span>`;
+  // highlight on the RAW line, escaping per segment — running the query
+  // regex over escaped text would match inside &lt;-style entities and
+  // miss queries containing <, & or "
+  const hi = (l) => {
+    if (!logQuery) return esc(l);
+    const re = new RegExp(logQuery.replace(/[.*+?^${}()|[\\]\\\\]/g, "\\\\$&"), "gi");
+    let out = "", last = 0, mm;
+    while ((mm = re.exec(l)) !== null) {
+      out += esc(l.slice(last, mm.index)) + `<mark>${esc(mm[0])}</mark>`;
+      last = mm.index + mm[0].length;
+      if (mm.index === re.lastIndex) re.lastIndex++;  // zero-width guard
+    }
+    return out + esc(l.slice(last));
+  };
+  return `<div><input id="logQ" placeholder="search logs" value="${esc(logQuery)}"/> ${note}</div>` +
+         `<pre>${shown.map(hi).join("\\n")}</pre>`;
+}
+function wireLogs() {
+  const q = $("#logQ");
+  if (!q) return;
+  // blur before re-rendering: render() skips while the box is focused (the
+  // auto-refresh guard), so Enter must drop focus first to take effect
+  const go = () => { logQuery = q.value; q.blur(); render(); };
+  q.onchange = go;
+  q.onkeydown = (ev) => { if (ev.key === "Enter") go(); };
 }
 let sweepMetric = null, sweepParam = null, sweepMax = false;
 async function renderSweep(r) {
@@ -548,6 +594,8 @@ async function renderCompare(uuids) {
 async function render() {
   if (compare && compare.length >= 2) return renderCompare(compare);
   if (!selected) return;
+  // don't clobber an in-progress log search on the 4s auto-refresh
+  if (document.activeElement && document.activeElement.id === "logQ") return;
   const r = await j(`/api/v1/${project}/runs/${selected}`);
   $("#dTitle").innerHTML = `${esc(r.name || r.uuid)} ${stBadge(r.status)}`;
   $("#tabs").style.display = "";
@@ -572,6 +620,7 @@ async function render() {
   else if (tab === "logs") html = await renderLogs(r);
   $("#dBody").innerHTML = html || '<span class="muted">no data yet</span>';
   if (tab === "sweep") wireSweep();
+  if (tab === "logs") wireLogs();
   if (tab === "artifacts") {
     document.querySelectorAll("#dBody .dir, #dBody .crumb a").forEach(el => {
       el.onclick = () => { artPath = el.dataset.p || ""; render(); };

@@ -15,7 +15,11 @@ Spec keys:
     loss_chunk_tokens (blockwise-CE chunk),
     profile (true or {steps: N}: capture a jax.profiler trace of N steps
     after warmup into outputs/profile — browsable via the artifacts API,
-    loadable in XProf; SURVEY.md §5 tracing)
+    loadable in XProf; SURVEY.md §5 tracing),
+    resources (default true: background host/TPU telemetry every 10s into
+    the run's events — host_cpu_percent, host_mem_*, tpu_hbm_*; false
+    disables, {interval: N} tunes; charted in the dashboard's Resources
+    section)
 """
 
 from __future__ import annotations
@@ -148,30 +152,48 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
     )
     batches = make_batches(data_cfg, trainer.mesh)
 
-    profile = spec.get("profile")
-    if profile:
-        # Warm up (compile + first steps), then trace a few real steps into
-        # the run's artifacts. EVERY process runs the same fit structure —
-        # fit() ends with a checkpoint save, an orbax cross-process
-        # collective, so diverging here would deadlock multi-host runs.
-        # Only process 0 wraps the middle segment in the profiler.
-        prof_steps = int(profile.get("steps", 3)) if isinstance(profile, dict) else 3
-        warm = min(2, steps)
-        state, metrics = trainer.fit(batches, num_steps=warm)
-        prof_dir = os.path.join(artifacts_dir, "outputs", "profile")
-        end = min(warm + prof_steps, steps)
-        if end > warm:
-            if is_primary:
-                with jax.profiler.trace(prof_dir):
+    # host/TPU resource telemetry (upstream traceml's ResourceLogger ran in
+    # the sidecar by default): metrics land in the run's event files under
+    # host_*/tpu_* names, charted in the dashboard's Resources section.
+    # `resources: false` disables; `resources: {interval: N}` tunes.
+    res_spec = spec.get("resources", True)
+    res_logger = None
+    if run is not None and res_spec is not False:
+        interval = (float(res_spec.get("interval", 10.0))
+                    if isinstance(res_spec, dict) else 10.0)
+        res_logger = tracking.ResourceLogger(run, interval=interval).start()
+
+    try:
+        profile = spec.get("profile")
+        if profile:
+            # Warm up (compile + first steps), then trace a few real steps
+            # into the run's artifacts. EVERY process runs the same fit
+            # structure — fit() ends with a checkpoint save, an orbax
+            # cross-process collective, so diverging here would deadlock
+            # multi-host runs. Only process 0 wraps the middle segment in
+            # the profiler.
+            prof_steps = int(profile.get("steps", 3)) if isinstance(profile, dict) else 3
+            warm = min(2, steps)
+            state, metrics = trainer.fit(batches, num_steps=warm)
+            prof_dir = os.path.join(artifacts_dir, "outputs", "profile")
+            end = min(warm + prof_steps, steps)
+            if end > warm:
+                if is_primary:
+                    with jax.profiler.trace(prof_dir):
+                        state, metrics = trainer.fit(batches, num_steps=end, state=state)
+                else:
                     state, metrics = trainer.fit(batches, num_steps=end, state=state)
-            else:
-                state, metrics = trainer.fit(batches, num_steps=end, state=state)
-        if end < steps:
-            state, metrics = trainer.fit(batches, num_steps=steps, state=state)
-        if run is not None:
-            run.log_artifact("profile", "outputs/profile", kind="profile")
-    else:
-        state, metrics = trainer.fit(batches, num_steps=steps)
+            if end < steps:
+                state, metrics = trainer.fit(batches, num_steps=steps, state=state)
+            if run is not None:
+                run.log_artifact("profile", "outputs/profile", kind="profile")
+        else:
+            state, metrics = trainer.fit(batches, num_steps=steps)
+    finally:
+        # a failing fit must not leak the telemetry thread (it would keep
+        # writing events for a dead run until process exit)
+        if res_logger is not None:
+            res_logger.stop()
     summary = {k: v for k, v in metrics.items() if isinstance(v, (int, float))}
     if run is not None:
         run.log_outputs(**summary)

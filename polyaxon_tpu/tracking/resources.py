@@ -56,10 +56,15 @@ class ResourceLogger:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
-            metrics = sample_host()
-            if self.tpu:
-                metrics.update(sample_tpu())
-            self.run.log_metrics(**metrics)
+            try:
+                metrics = sample_host()
+                if self.tpu:
+                    metrics.update(sample_tpu())
+                self.run.log_metrics(**metrics)
+            except Exception:  # noqa: BLE001 — telemetry must never kill a run
+                # (e.g. psutil absent in a user image): stop sampling, the
+                # training loop is the product
+                return
 
     def stop(self) -> None:
         self._stop.set()
