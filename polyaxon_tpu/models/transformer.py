@@ -74,7 +74,10 @@ class TransformerConfig:
     pp_microbatches: int = 0                    # GPipe microbatches; 0 = 2*stages
     # Pipeline bubble-tick gating (parallel/pipeline.py): "auto" picks
     # "inner" when the stage body carries collectives (TP/CP/EP) and "full"
-    # otherwise; "none" is the ungated masked oracle for parity tests.
+    # otherwise; "none" disables (the masked oracle — and the right choice
+    # on CPU meshes, where XLA:CPU single-threads conditional bodies and
+    # the gates measure SLOWER; see bench_artifacts/README.md r5. On TPU
+    # gating saves the bubble FLOPs/energy at identical step time.)
     pp_gate: str = "auto"                       # "auto" | "full" | "inner" | "none"
     # 1F1B-style O(S) activation stash: each pipeline tick becomes a remat
     # island (recompute the stage forward during the backward sweep)

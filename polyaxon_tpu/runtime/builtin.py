@@ -9,6 +9,8 @@ Spec keys:
     model: registry name (e.g. "llama2-7b", "llama-tiny", "vit-b16", ...)
     steps, batch_size, seq_len, learning_rate, warmup_steps, schedule,
     optimizer, remat, parallelism {data,fsdp,model,context,expert,stage},
+    pp_microbatches / pp_remat_ticks (pipeline schedule: microbatch count,
+    1F1B-style O(stages) activation stash),
     data {kind, path, ...}, checkpoint {save_interval_steps, max_to_keep},
     platform ("cpu" forces CPU — tests), num_cpu_devices,
     mu_dtype / nu_dtype / grad_dtype (e.g. "bfloat16" — HBM savers),
@@ -67,6 +69,15 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
             # "capacity" (default) | "a2a" (explicit all-to-all over the
             # expert axis) | "dense" (parity oracle)
             overrides["moe_dispatch"] = spec["moe_dispatch"]
+        if spec.get("pp_microbatches") is not None:
+            overrides["pp_microbatches"] = int(spec["pp_microbatches"])
+        if spec.get("pp_remat_ticks") is not None:
+            # 1F1B-style O(stages) activation stash (parallel/pipeline.py)
+            overrides["pp_remat_ticks"] = bool(spec["pp_remat_ticks"])
+        if spec.get("pp_gate"):
+            # "auto" | "full" | "inner" | "none" — "none" is the documented
+            # choice for CPU-mesh pipeline runs (bench_artifacts/README.md)
+            overrides["pp_gate"] = spec["pp_gate"]
         seq_len = int(spec.get("seq_len", min(2048, mcfg.max_seq)))
         if seq_len > mcfg.max_seq:
             overrides["max_seq"] = seq_len

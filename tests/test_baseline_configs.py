@@ -163,3 +163,16 @@ class TestViTHyperband:
             assert len(origins) >= 2
         finally:
             agent.stop()
+
+
+class TestAllExamplesParse:
+    def test_every_example_compiles(self):
+        """Every shipped example must at least parse + compile — a docs
+        file that check_polyaxonfile rejects is worse than no docs."""
+        from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+        for name in sorted(os.listdir(EXAMPLES)):
+            if not name.endswith((".yaml", ".yml")):
+                continue
+            op = check_polyaxonfile(os.path.join(EXAMPLES, name))
+            assert op is not None, name
