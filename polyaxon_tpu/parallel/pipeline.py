@@ -31,6 +31,16 @@ ring attention inside the stage) and expert (tokens batch-shard over the
 axis; the MoE layer's manual all-to-all dispatch — moe_dispatch="a2a" —
 moves them to their experts inside the stage body).
 
+Why NOT Megatron-style interleaved virtual stages (round-5 analysis):
+with v layer blocks per device (round-robin placement) each tick does 1/v
+of the per-stage work over M·v + v·S - 1 ticks, so the bubble fraction is
+(S - 1/v)/(M + S - 1/v) — strictly WORSE than the contiguous schedule's
+(S-1)/(M+S-1). Interleaving only pays inside an async 1F1B ordering where
+backward ticks fill forward bubbles, which lockstep autodiff (backward =
+transposed forward sweep) cannot express without a hand-written backward
+schedule. The stash cost it would mitigate is addressed instead by
+``remat_ticks`` below.
+
 Collective-safe gating (round 5, VERDICT r4 #1): bodies WITH collectives
 can't sit under the tick ``lax.cond`` wholesale — a collective inside a
 cond whose predicate differs across stages makes two stage groups

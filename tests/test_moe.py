@@ -291,6 +291,45 @@ class TestA2ADispatch:
             transformer.apply(params, tokens, cfg, mesh=mesh)
 
 
+class TestGatherDispatchSweep:
+    def test_capacity_matches_dense_across_shapes_and_seeds(self):
+        """Randomized hardening for the r5 gather-form dispatch custom
+        VJPs: outputs AND router/input/expert grads must match the dense
+        oracle across expert counts, top-k, shapes and seeds whenever
+        capacity is ample (no drops)."""
+        from dataclasses import replace as _replace
+
+        base = llama.LLAMA_MOE_TINY
+        for seed, (E, k, b, s) in enumerate([
+            (2, 1, 2, 8), (4, 2, 3, 16), (8, 2, 2, 32), (8, 4, 1, 16),
+            (3, 3, 2, 8),
+        ]):
+            cap_cfg = _replace(
+                base, num_experts=E, expert_top_k=k,
+                moe_dispatch="capacity",
+                expert_capacity_factor=float(E) / k,  # ample: nothing drops
+            )
+            dense_cfg = _replace(cap_cfg, moe_dispatch="dense")
+            params = transformer.init(jax.random.PRNGKey(seed), cap_cfg)
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(seed + 100), (b, s), 0, base.vocab_size)
+
+            def loss(p, cfg):
+                hid, aux = transformer.apply_hidden(
+                    p, tokens, cfg, return_aux=True)
+                return (hid.astype(jnp.float32) ** 2).mean() + 0.01 * aux[0]
+
+            lc, gc = jax.value_and_grad(lambda p: loss(p, cap_cfg))(params)
+            ld, gd = jax.value_and_grad(lambda p: loss(p, dense_cfg))(params)
+            np.testing.assert_allclose(float(lc), float(ld), rtol=2e-4,
+                                       err_msg=f"E={E} k={k}")
+            jax.tree.map(
+                lambda a, c: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(c), rtol=5e-3, atol=5e-5,
+                    err_msg=f"E={E} k={k} b={b} s={s}"),
+                gc, gd)
+
+
 class TestMoEPipeline:
     """MoE x PP composability (VERDICT r3 #2/#6 leftover): expert-sharded
     a2a dispatch inside the pipeline's shard_map."""
