@@ -370,6 +370,41 @@ async function renderOverview(r) {
   } catch (e) {}
   return html;
 }
+function barChart(values, counts) {
+  // histogram event: bin centers + counts -> SVG bars
+  const w = 420, h = 160, mL = 46, mR = 8, mT = 8, mB = 20;
+  if (!counts.length) return "";
+  const cmax = Math.max(...counts), n = counts.length;
+  const bw = (w - mL - mR) / n;
+  let bars = "";
+  counts.forEach((c, i) => {
+    const bh = cmax > 0 ? c / cmax * (h - mT - mB) : 0;
+    bars += `<rect x="${(mL + i * bw).toFixed(1)}" y="${(h - mB - bh).toFixed(1)}" ` +
+      `width="${Math.max(bw - 1, 1).toFixed(1)}" height="${bh.toFixed(1)}" ` +
+      `fill="#0b68cb" fill-opacity="0.8"><title>${fmt(values[i] ?? i)}: ${fmt(c)}</title></rect>`;
+  });
+  let g = `<text x="${mL - 4}" y="${mT + 8}" font-size="10" fill="#697386" text-anchor="end">${fmt(cmax)}</text>`;
+  if (values.length) {
+    g += `<text x="${mL}" y="${h - 6}" font-size="10" fill="#697386">${fmt(values[0])}</text>` +
+         `<text x="${w - mR}" y="${h - 6}" font-size="10" fill="#697386" text-anchor="end">${fmt(values[values.length - 1])}</text>`;
+  }
+  return `<svg class="chart" width="${w}" height="${h}">${g}${bars}</svg>`;
+}
+const imgCache = {};  // url -> blob object URL (events are append-only,
+                      // a path's bytes never change: cache forever so the
+                      // 4s refresh neither refetches nor leaks blob URLs)
+async function authedImg(url, imgId) {
+  // <img src> can't carry the Authorization header: fetch -> blob URL
+  try {
+    if (!imgCache[url]) {
+      const r = await fetch(url, {headers: hdrs()});
+      if (!r.ok) return;
+      imgCache[url] = URL.createObjectURL(await r.blob());
+    }
+    const el = document.getElementById(imgId);
+    if (el) el.src = imgCache[url];
+  } catch (e) {}
+}
 function isResourceMetric(n) { return /^(host_|tpu\\d*_)/.test(n); }
 async function renderMetrics(r) {
   let html = "";
@@ -393,6 +428,40 @@ async function renderMetrics(r) {
       html += `<h2>Resources</h2>`;
       for (const name of res) html += chart(name);
     }
+    // histogram events: latest-step distribution per name
+    try {
+      const hm = await j(`/api/v1/${project}/runs/${r.uuid}/events/histogram`);
+      const hnames = Object.keys(hm).sort();
+      if (hnames.length) html += `<h2>Histograms</h2>`;
+      for (const name of hnames) {
+        const evs = hm[name];
+        const last = evs[evs.length - 1];
+        const hg = last && last.histogram;
+        if (!hg) continue;
+        html += `<h3>${esc(name)} <span class="muted">step ${last.step ?? "-"}</span></h3>` +
+                barChart(hg.values || [], hg.counts || []);
+      }
+    } catch (e) {}
+    // image events: latest image per name (auth-fetched into blob URLs)
+    try {
+      const im = await j(`/api/v1/${project}/runs/${r.uuid}/events/image`);
+      const inames = Object.keys(im).sort();
+      if (inames.length) html += `<h2>Images</h2>`;
+      inames.forEach((name, idx) => {
+        const evs = im[name];
+        const last = evs[evs.length - 1];
+        const img = last && last.image;
+        if (!img || !img.path) return;
+        const iid = "im" + idx;  // index, not name: lossy-stripped names
+                                 // ("attn_1"/"attn1") would collide
+        html += `<h3>${esc(name)} <span class="muted">step ${last.step ?? "-"}</span></h3>` +
+          `<img id="${iid}" style="max-width:480px;border:1px solid #e3e8ee;border-radius:4px"/>`;
+        // defer until the html lands in the DOM (same trick as lineChart)
+        setTimeout(() => authedImg(
+          `/api/v1/${project}/runs/${r.uuid}/artifacts/file?path=` +
+          encodeURIComponent(img.path), iid), 0);
+      });
+    } catch (e) {}
   } catch (e) { html = `<span class="muted">${esc(e)}</span>`; }
   return html;
 }

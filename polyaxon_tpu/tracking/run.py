@@ -15,7 +15,14 @@ import os
 import uuid as uuid_mod
 from typing import Any, Optional
 
-from .events import V1Event, V1EventArtifact, V1EventHistogram, V1EventSpan, V1RunArtifact
+from .events import (
+    V1Event,
+    V1EventArtifact,
+    V1EventHistogram,
+    V1EventImage,
+    V1EventSpan,
+    V1RunArtifact,
+)
 from .writer import EventFileWriter, LogWriter
 
 # Env contract injected by the compiler/operator (compiler/converter.py).
@@ -72,6 +79,48 @@ class Run:
         self._writer.add(
             "histogram", name,
             V1Event.make(step=step, histogram=V1EventHistogram(values=values, counts=counts)),
+        )
+
+    def log_image(self, name: str, image: Any, step: Optional[int] = None) -> None:
+        """Log an image event (upstream traceml `log_image`). ``image`` is a
+        path to an existing image file (copied into the run's assets) or an
+        HxW / HxWx3 array (f32 in [0,1] or uint8; saved as PNG). The event
+        references the run-relative path — the streams API serves it and
+        the dashboard renders the latest image per name."""
+        import shutil
+
+        # TensorBoard-style names ("val/sample") become subdirectories;
+        # ".."/absolute components are rejected — an event name must never
+        # write outside the run's assets dir
+        parts = [p for p in str(name).replace("\\", "/").split("/") if p]
+        if not parts or any(p == ".." for p in parts):
+            raise ValueError(f"bad image name {name!r}")
+        assets_rel = os.path.join("assets", "images", *parts[:-1])
+        leaf = parts[-1]
+        os.makedirs(os.path.join(self.run_dir, assets_rel), exist_ok=True)
+        suffix = f"_{step}" if step is not None else ""
+        width = height = None
+        if isinstance(image, (str, os.PathLike)):
+            src = str(image)
+            ext = os.path.splitext(src)[1] or ".png"
+            rel = os.path.join(assets_rel, f"{leaf}{suffix}{ext}")
+            shutil.copyfile(src, os.path.join(self.run_dir, rel))
+        else:
+            import numpy as np
+
+            arr = np.asarray(image)
+            if arr.dtype != np.uint8:
+                arr = (np.clip(np.asarray(arr, dtype=np.float64), 0.0, 1.0)
+                       * 255).astype(np.uint8)
+            from PIL import Image as _Image
+
+            rel = os.path.join(assets_rel, f"{leaf}{suffix}.png")
+            _Image.fromarray(arr).save(os.path.join(self.run_dir, rel))
+            height, width = int(arr.shape[0]), int(arr.shape[1])
+        self._writer.add(
+            "image", name,
+            V1Event.make(step=step, image=V1EventImage(
+                path=rel, width=width, height=height)),
         )
 
     def log_span(self, name: str, start: float, end: float, **meta: Any) -> None:
