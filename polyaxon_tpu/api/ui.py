@@ -89,6 +89,7 @@ UI_HTML = """<!DOCTYPE html>
       <button data-tab="overview" class="active">Overview</button>
       <button data-tab="metrics">Metrics</button>
       <button data-tab="sweep" id="sweepTab" style="display:none">Sweep</button>
+      <button data-tab="graph" id="graphTab" style="display:none">Graph</button>
       <button data-tab="artifacts">Artifacts</button>
       <button data-tab="logs">Logs</button>
     </div>
@@ -538,6 +539,102 @@ function wireLogs() {
   q.onchange = go;
   q.onkeydown = (ev) => { if (ev.key === "Enter") go(); };
 }
+// ---- DAG graph ------------------------------------------------------------
+const ST_COLORS = {succeeded: "#18794e", failed: "#cd2b31", running: "#0b68cb",
+                   stopped: "#6c757d", skipped: "#6c757d"};
+function dagOps(r) {
+  const run = (((r.spec || {}).component || {}).run) || {};
+  return run.kind === "dag" ? (run.operations || []) : null;
+}
+function opDeps(op) {
+  // mirror the backend's edge sources (V1Dag.topological_order): explicit
+  // dependencies + structured param refs ({"ref": "ops.train"}) +
+  // template refs scoped INSIDE {{ }} braces — a literal string value
+  // mentioning "ops.train" must not fabricate an edge
+  const deps = new Set(op.dependencies || []);
+  for (const p of Object.values(op.params || {})) {
+    if (p && typeof p === "object" && typeof p.ref === "string" &&
+        p.ref.startsWith("ops."))
+      deps.add(p.ref.slice(4));
+    if (typeof p === "string")
+      for (const m of p.matchAll(/\\{\\{[^}]*?\\bops\\.([A-Za-z0-9_-]+)/g))
+        deps.add(m[1]);
+  }
+  deps.delete(op.name);  // self-mentions must not loop the layering
+  return [...deps];
+}
+async function renderGraph(r) {
+  const ops = dagOps(r);
+  if (!ops || !ops.length) return '<span class="muted">this DAG has no operations</span>';
+  const kids = await j(`/api/v1/${project}/runs?pipeline_uuid=${r.uuid}&limit=500`);
+  // the dag runner stamps meta.dag_op on every child — the exact key.
+  // Name fallbacks cover manually-created children ("-{op}" suffix can
+  // mis-match ops that are suffixes of one another, so it comes last)
+  const childOf = (op) =>
+    kids.find(k => k.meta && k.meta.dag_op === op) ||
+    kids.find(k => k.name === op) ||
+    kids.find(k => (k.name || "").endsWith("-" + op));
+  // topological levels
+  const level = {}, names = ops.map(o => o.name);
+  const depMap = {};
+  for (const op of ops) depMap[op.name] = opDeps(op).filter(d => names.includes(d));
+  let changed = true, guard = 0;
+  for (const n of names) level[n] = 0;
+  while (changed && guard++ < 100) {
+    changed = false;
+    for (const n of names) {
+      const want = Math.max(0, ...depMap[n].map(d => level[d] + 1));
+      if (want !== level[n]) { level[n] = want; changed = true; }
+    }
+  }
+  const cols = {};
+  for (const n of names) (cols[level[n]] = cols[level[n]] || []).push(n);
+  const nlevels = Object.keys(cols).length;
+  const NW = 150, NH = 44, GX = 70, GY = 18, PAD = 12;
+  const pos = {};
+  Object.entries(cols).forEach(([lv, ns]) => {
+    ns.forEach((n, i) => {
+      pos[n] = {x: PAD + lv * (NW + GX), y: PAD + i * (NH + GY)};
+    });
+  });
+  const w = PAD * 2 + nlevels * NW + (nlevels - 1) * GX;
+  const h = PAD * 2 + Math.max(...Object.values(cols).map(c => c.length))
+            * (NH + GY) - GY;
+  let edges = "";
+  for (const n of names) for (const d of depMap[n]) {
+    const a = pos[d], b = pos[n];
+    const x1 = a.x + NW, y1 = a.y + NH / 2, x2 = b.x, y2 = b.y + NH / 2;
+    edges += `<path d="M${x1},${y1} C${x1 + GX / 2},${y1} ${x2 - GX / 2},${y2} ` +
+      `${x2},${y2}" fill="none" stroke="#9aa5b1" stroke-width="1.5" ` +
+      `marker-end="url(#arr)"/>`;
+  }
+  let nodes = "";
+  for (const n of names) {
+    const k = childOf(n);
+    const st = k ? k.status : "created";
+    const color = ST_COLORS[st] || "#b98900";
+    const p = pos[n];
+    nodes += `<g class="dagnode" data-u="${k ? k.uuid : ""}" style="cursor:pointer">` +
+      `<rect x="${p.x}" y="${p.y}" width="${NW}" height="${NH}" rx="6" ` +
+      `fill="#fff" stroke="${color}" stroke-width="2"/>` +
+      `<text x="${p.x + 10}" y="${p.y + 18}" font-size="12" ` +
+      `fill="#1a1f36" font-weight="600">${esc(n)}</text>` +
+      `<text x="${p.x + 10}" y="${p.y + 34}" font-size="10" ` +
+      `fill="${color}">${esc(st)}</text></g>`;
+  }
+  return `<svg class="chart" width="${w}" height="${h}">` +
+    `<defs><marker id="arr" markerWidth="8" markerHeight="8" refX="7" ` +
+    `refY="3" orient="auto"><path d="M0,0 L7,3 L0,6 z" fill="#9aa5b1"/>` +
+    `</marker></defs>` + edges + nodes + `</svg>` +
+    `<div class="muted">click a node to open its run</div>`;
+}
+function wireGraph() {
+  document.querySelectorAll("#dBody .dagnode").forEach(el => {
+    el.onclick = () => {
+      if (el.dataset.u) { selected = el.dataset.u; tab = "overview"; render(); }
+    };
+  });
+}
 let sweepMetric = null, sweepParam = null, sweepMax = false;
 async function renderSweep(r) {
   const LIM = 2000;
@@ -679,16 +776,22 @@ async function render() {
   }
   $("#sweepTab").style.display = hasKids ? "" : "none";
   if (tab === "sweep" && !hasKids) tab = "overview";
+  const dops = dagOps(r);
+  const isDag = !!(dops && dops.length);
+  $("#graphTab").style.display = isDag ? "" : "none";
+  if (tab === "graph" && !isDag) tab = "overview";
   document.querySelectorAll("#tabs button").forEach(b =>
     b.classList.toggle("active", b.dataset.tab === tab));
   let html = "";
   if (tab === "overview") html = await renderOverview(r);
   else if (tab === "metrics") html = await renderMetrics(r);
   else if (tab === "sweep") html = await renderSweep(r);
+  else if (tab === "graph") html = await renderGraph(r);
   else if (tab === "artifacts") html = await renderArtifacts(r);
   else if (tab === "logs") html = await renderLogs(r);
   $("#dBody").innerHTML = html || '<span class="muted">no data yet</span>';
   if (tab === "sweep") wireSweep();
+  if (tab === "graph") wireGraph();
   if (tab === "logs") wireLogs();
   if (tab === "artifacts") {
     document.querySelectorAll("#dBody .dir, #dBody .crumb a").forEach(el => {

@@ -330,7 +330,9 @@ class TestUi:
                            # missing #1's enumerated dashboard gaps)
                            "isResourceMetric", "Resources", "logQ",
                            # histogram + image event rendering
-                           "barChart", "events/histogram", "authedImg"):
+                           "barChart", "events/histogram", "authedImg",
+                           # DAG graph tab (nodes + dependency edges)
+                           "renderGraph", "data-tab=\"graph\"", "dagOps"):
                 assert marker in r.text, marker
             # the shell is open; the data endpoints it calls are not
             assert requests.get(f"{srv.url}/api/v1/projects", timeout=5).status_code == 401
