@@ -172,6 +172,14 @@ class RunClient(BaseClient):
         params = {"names": ",".join(names)} if names else {}
         return self._json("GET", self._rpath("/metrics", uuid=uuid), params=params)
 
+    def get_events(self, kind: str, names: Optional[list[str]] = None,
+                   uuid: Optional[str] = None) -> dict:
+        """Events of any V1Event kind (histogram/image/text/span/...) per
+        name — the same endpoint the dashboard charts read."""
+        params = {"names": ",".join(names)} if names else {}
+        return self._json("GET", self._rpath(f"/events/{kind}", uuid=uuid),
+                          params=params)
+
     def get_logs(self, offset: int = 0, uuid: Optional[str] = None) -> tuple[str, int]:
         resp = self._req("GET", self._rpath("/logs", uuid=uuid), params={"offset": offset})
         return resp.text, int(resp.headers.get("X-Log-Offset", 0))

@@ -400,6 +400,32 @@ class TestImageEvents:
         evs2 = read_events(str(tmp_path), "image", "sample")
         assert (tmp_path / evs2[0].image.path).exists()
 
+    def test_client_get_events_serves_kinds(self, tmp_path, monkeypatch):
+        """RunClient.get_events reads any V1Event kind through the streams
+        API — the same endpoint the dashboard's histogram/image sections
+        chart."""
+        from polyaxon_tpu import tracking
+        from polyaxon_tpu.api.server import ApiServer
+        from polyaxon_tpu.client import RunClient
+
+        srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+        try:
+            run = srv.store.create_run("p", spec={"kind": "operation"},
+                                       name="ev")
+            rd = tmp_path / "p" / run["uuid"]
+            rd.mkdir(parents=True)
+            monkeypatch.setenv("PLX_RUN_UUID", run["uuid"])
+            monkeypatch.setenv("PLX_PROJECT", "p")
+            monkeypatch.setenv("PLX_ARTIFACTS_PATH", str(rd))
+            tr = tracking.Run()
+            tr.log_histogram("w", values=[0.0, 1.0], counts=[3.0, 7.0], step=2)
+            tr.end()
+            rc = RunClient(srv.url, project="p")
+            ev = rc.get_events("histogram", uuid=run["uuid"])
+            assert ev["w"][0]["histogram"]["counts"] == [3.0, 7.0]
+        finally:
+            srv.stop()
+
     def test_log_image_namespaced_and_traversal_rejected(self, tmp_path,
                                                          monkeypatch):
         import numpy as np

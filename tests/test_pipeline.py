@@ -101,6 +101,10 @@ class TestGpipeTrunk:
         cases = [
             (llama.LLAMA_TINY, {"stage": 2, "model": 2, "data": 2}),
             (llama.LLAMA_TINY, {"stage": 2, "context": 2, "data": 2}),
+            # ulysses inside the gated pipeline: all_to_alls unconditional,
+            # attention kernel under the cond
+            (_replace(llama.LLAMA_TINY, seq_parallel="ulysses"),
+             {"stage": 2, "context": 2, "data": 2}),
             (_replace(llama.LLAMA_MOE_TINY, moe_dispatch="a2a"),
              {"stage": 2, "expert": 2, "data": 2}),
         ]
