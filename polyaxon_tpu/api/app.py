@@ -53,7 +53,10 @@ def _not_found(msg="not found"):
 
 class ApiApp:
     def __init__(self, store: Store, artifacts_root: str,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 extra_middlewares: Optional[list] = None):
+        """``extra_middlewares`` run BEFORE auth — the chaos harness
+        injects its flaky-HTTP middleware here (resilience/chaos.py)."""
         self.store = store
         self.artifacts_root = os.path.abspath(artifacts_root)
         os.makedirs(self.artifacts_root, exist_ok=True)
@@ -66,7 +69,8 @@ class ApiApp:
         self.auth_token = auth_token if auth_token is not None \
             else os.environ.get("PLX_AUTH_TOKEN")
         self._tokens_seen = False
-        self.app = web.Application(middlewares=[self._auth_middleware])
+        self.app = web.Application(
+            middlewares=[*(extra_middlewares or []), self._auth_middleware])
         self._routes()
         # the scheduler (if attached in-process) watches this queue
         self.new_run_event = asyncio.Event()
@@ -147,6 +151,7 @@ class ApiApp:
         r.add_post("/api/v1/{project}/runs/{uuid}/statuses", self.post_status)
         r.add_get("/api/v1/{project}/runs/{uuid}/statuses", self.get_statuses)
         r.add_post("/api/v1/{project}/runs/{uuid}/outputs", self.post_outputs)
+        r.add_post("/api/v1/{project}/runs/{uuid}/heartbeat", self.post_heartbeat)
         r.add_post("/api/v1/{project}/runs/{uuid}/stop", self.stop_run)
         r.add_post("/api/v1/{project}/runs/{uuid}/restart", self.restart_run)
         r.add_get("/api/v1/{project}/runs/{uuid}/metrics", self.get_metrics)
@@ -257,13 +262,20 @@ class ApiApp:
         """Create a run from an operation spec body."""
         project = request.match_info["project"]
         body = await request.json()
+        meta = body.get("meta")
+        if isinstance(meta, dict):
+            # meta["service"] is the agent-stamped portforward endpoint —
+            # honoring a client-supplied value would let a tenant point the
+            # server's TCP bridge at ANY host:port it can reach (SSRF,
+            # ADVICE r5 high). Only the agent writes it, via the store.
+            meta = {k: v for k, v in meta.items() if k != "service"}
         run = self.store.create_run(
             project,
             spec=body.get("spec"),
             name=body.get("name"),
             kind=body.get("kind"),
             inputs=body.get("inputs"),
-            meta=body.get("meta"),
+            meta=meta,
             tags=body.get("tags"),
             pipeline_uuid=body.get("pipeline_uuid"),
             # server-derived from the auth token, never client-supplied
@@ -323,6 +335,11 @@ class ApiApp:
         run = self.store.merge_outputs(request.match_info["uuid"], body)
         return _json(run) if run else _not_found()
 
+    async def post_heartbeat(self, request):
+        """Renew the run's liveness lease (zombie-reaper input)."""
+        ok = self.store.heartbeat(request.match_info["uuid"])
+        return _json({"ok": True}) if ok else _not_found()
+
     async def stop_run(self, request):
         """Request the run stop (stopping -> stopped)."""
         run, changed = self.store.transition(
@@ -344,6 +361,10 @@ class ApiApp:
         except Exception:
             pass
         meta = dict(run.get("meta") or {})
+        # the clone's endpoint is stamped fresh by the agent when the clone
+        # schedules; carrying the original's over would leave a stale (or
+        # dead) portforward target on the new run
+        meta.pop("service", None)
         meta["resume_from"] = self.run_dir(run["project"], run["uuid"])
         clone = self.store.create_run(
             run["project"],
@@ -403,14 +424,19 @@ class ApiApp:
             return _json(
                 {"error": "run has no service endpoint (not a service "
                           "kind, or not scheduled yet)"}, status=409)
-        port = int(request.rel_url.query.get("port", svc["port"]))
-        # only the run's DECLARED ports are reachable: the stamped host is
-        # the server's own vantage point (loopback in local deployments),
-        # so a free-form ?port= would be a bridge to every local daemon
+        raw_port = request.rel_url.query.get("port", svc["port"])
+        try:
+            port = int(raw_port)
+        except (TypeError, ValueError):
+            return _json({"error": f"invalid port {raw_port!r}"}, status=400)
+        # only AGENT-STAMPED ports are reachable: the stamped host is the
+        # server's own vantage point (loopback in local deployments), so a
+        # free-form ?port= would be a bridge to every local daemon — and
+        # the client-supplied spec is not trustworthy either (a tenant
+        # could declare 22); the agent stamps the resolved declared ports
+        # into meta["service"]["ports"] at schedule time
         declared = {int(svc["port"])}
-        run_sec = (((run.get("spec") or {}).get("component") or {})
-                   .get("run") or {})
-        declared.update(int(p) for p in (run_sec.get("ports") or []))
+        declared.update(int(p) for p in (svc.get("ports") or []))
         if port not in declared:
             return _json(
                 {"error": f"port {port} is not a declared port of this "

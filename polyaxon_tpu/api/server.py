@@ -27,9 +27,12 @@ class ApiServer:
         host: str = "127.0.0.1",
         port: int = 8000,
         auth_token: "Optional[str]" = None,
+        extra_middlewares: "Optional[list]" = None,
+        store: "Optional[Store]" = None,
     ):
-        self.store = Store(db_path)
-        self.api = ApiApp(self.store, artifacts_root, auth_token=auth_token)
+        self.store = store if store is not None else Store(db_path)
+        self.api = ApiApp(self.store, artifacts_root, auth_token=auth_token,
+                          extra_middlewares=extra_middlewares)
         self.host = host
         self.port = port
         self._loop: Optional[asyncio.AbstractEventLoop] = None

@@ -39,7 +39,8 @@ CREATE TABLE IF NOT EXISTS runs (
     created_at TEXT NOT NULL,
     updated_at TEXT NOT NULL,
     started_at TEXT,
-    finished_at TEXT
+    finished_at TEXT,
+    heartbeat_at TEXT
 );
 CREATE INDEX IF NOT EXISTS idx_runs_project ON runs (project, created_at);
 CREATE INDEX IF NOT EXISTS idx_runs_status ON runs (status);
@@ -96,6 +97,8 @@ class Store:
             cols = {r[1] for r in conn.execute("PRAGMA table_info(runs)")}
             if "created_by" not in cols:
                 conn.execute("ALTER TABLE runs ADD COLUMN created_by TEXT")
+            if "heartbeat_at" not in cols:
+                conn.execute("ALTER TABLE runs ADD COLUMN heartbeat_at TEXT")
 
     # -- connection plumbing ----------------------------------------------
 
@@ -226,7 +229,7 @@ class Store:
         "uuid", "project", "name", "kind", "status", "spec", "compiled",
         "inputs", "outputs", "meta", "tags", "original_uuid", "cloning_kind",
         "pipeline_uuid", "created_by", "created_at", "updated_at",
-        "started_at", "finished_at",
+        "started_at", "finished_at", "heartbeat_at",
     )
     _JSON_COLS = {"spec", "compiled", "inputs", "outputs", "meta", "tags"}
 
@@ -380,6 +383,14 @@ class Store:
             merged = dict(run.get("outputs") or {})
             merged.update(outputs)
             return self.update_run(uuid, outputs=merged)
+
+    def heartbeat(self, uuid: str) -> bool:
+        """Renew a run's liveness lease (zombie-reaper input). Cheap direct
+        UPDATE — no listeners fire, no updated_at churn."""
+        with self._conn_ctx() as conn:
+            cur = conn.execute(
+                "UPDATE runs SET heartbeat_at=? WHERE uuid=?", (_now(), uuid))
+        return cur.rowcount > 0
 
     def delete_run(self, uuid: str) -> bool:
         with self._conn_ctx() as conn:

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
+from ..parallel.compat import shard_map
 
 from ..ops import (
     apply_rope,
@@ -281,7 +282,7 @@ def _sharded_attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh], in
     qkv_spec = P(("data", "fsdp", "expert"), "model", "context", None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, check_vma=False,
+        shard_map, mesh=mesh, check_vma=False,
         in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec,
     )
     def _attn(q, k, v):
@@ -750,7 +751,7 @@ def _moe_a2a(y, mp, cfg: TransformerConfig, top_idx, top_gates, mesh,
     experts = {name: mp[name] for name in w_specs}
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, check_vma=False,
+        shard_map, mesh=mesh, check_vma=False,
         in_specs=(tok_spec, idx_spec, idx_spec,
                   {n: w_specs[n] for n in experts}),
         out_specs=(tok_spec, P()),

@@ -209,13 +209,13 @@ class FakeCluster(Cluster):
     def _launch(self, pod: _FakePod) -> None:
         import sys
 
-        from ..runtime.local import _with_pythonpath
+        from ..runtime.local import _with_pythonpath, pod_base_env
 
         spec = pod.manifest.get("spec") or {}
         containers = spec.get("containers") or []
         c = containers[0] if containers else {}
         argv = list(c.get("command") or []) + list(c.get("args") or [])
-        env = dict(os.environ)
+        env = pod_base_env()
         for e in c.get("env") or []:
             if e.get("value") is not None:
                 env[e["name"]] = self._rewrite_dns(str(e["value"]))
@@ -249,7 +249,7 @@ class FakeCluster(Cluster):
                 continue
             if argv_i[0] in ("python", "python3"):
                 argv_i[0] = sys.executable
-            env_i = dict(os.environ)
+            env_i = pod_base_env()
             for e in ic.get("env") or []:
                 if e.get("value") is not None:
                     env_i[e["name"]] = self._rewrite_dns(str(e["value"]))

@@ -158,7 +158,11 @@ def reconcile_python(obs: Observed) -> Decision:
         return Decision(Action.WAIT, Reason.NONE)
     if obs.active_deadline_s > 0.0 and obs.elapsed_s > obs.active_deadline_s:
         return Decision(Action.FAIL, Reason.DEADLINE)
-    if obs.failed > 0:
+    # a failed pod, OR a slice whose pods vanished wholesale after it was
+    # running (node GC, external delete): both are slice loss — restart
+    # whole within budget, else fail. Without the vanished-pods arm the
+    # operation would WAIT forever on an empty pod set.
+    if obs.failed > 0 or (obs.pods_total == 0 and obs.was_running):
         if obs.retries_done < obs.backoff_limit:
             return Decision(Action.RESTART, Reason.BACKOFF)
         return Decision(Action.FAIL, Reason.POD_FAILED)

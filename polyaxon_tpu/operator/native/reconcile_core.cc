@@ -80,7 +80,11 @@ int32_t plx_reconcile(const plx_observed* obs, plx_decision* out) {
     return 0;
   }
 
-  if (obs->failed > 0) {
+  // A failed pod, or a slice whose pods vanished wholesale after it was
+  // running (node GC, external delete), is slice loss either way; without
+  // the vanished-pods arm the operation would WAIT forever on an empty
+  // pod set.
+  if (obs->failed > 0 || (obs->pods_total == 0 && obs->was_running)) {
     // all-or-nothing: even with partial success, the slice restarts whole
     if (obs->retries_done < obs->backoff_limit) {
       out->action = PLX_RESTART;

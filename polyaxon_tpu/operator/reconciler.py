@@ -62,8 +62,18 @@ _REASON_MSG = {
 
 
 class OperationReconciler:
-    def __init__(self, cluster: Cluster, on_status: Optional[StatusFn] = None):
+    def __init__(self, cluster: Cluster, on_status: Optional[StatusFn] = None,
+                 retry=None):
+        from ..resilience.retry import RetryPolicy
+
         self.cluster = cluster
+        # Cluster verbs ride through a transient-failure retry: a 5xx/429/
+        # timeout burst mid-RESTART must not strand an op between "pods
+        # deleted" and "pods re-applied" (it would burn the whole backoff
+        # budget on API weather, not slice failures). Bounded tighter than
+        # the HTTP default so a reconcile pass can't stall for long.
+        self.retry: RetryPolicy = retry if retry is not None else RetryPolicy(
+            max_attempts=4, base_delay=0.1, max_delay=2.0, deadline=8.0)
         self.on_status = on_status or (lambda *a: None)
         self._ops: dict[str, _OpState] = {}
         self._lock = threading.Lock()
@@ -88,13 +98,13 @@ class OperationReconciler:
             self._ops[op.run_uuid] = state
         try:
             for manifest in op.resources:
-                self.cluster.apply(manifest)
+                self._c(self.cluster.apply, manifest)
         except Exception:
             # tear down BEFORE freeing the uuid so a concurrent re-apply
             # can't register (and create pods) that this rollback would then
             # delete; swallow teardown errors so the apply error propagates
             try:
-                self.cluster.delete_selected(op.label_selector)
+                self._c(self.cluster.delete_selected, op.label_selector)
             except Exception:
                 pass
             with self._lock:
@@ -111,7 +121,7 @@ class OperationReconciler:
                 state.applying = False
                 concurrent_delete = False
         if concurrent_delete:
-            self.cluster.delete_selected(op.label_selector)
+            self._c(self.cluster.delete_selected, op.label_selector)
 
     def adopt(self, op: OperationCR, elapsed_s: float = 0.0,
               retries_done: int = 0) -> bool:
@@ -125,7 +135,7 @@ class OperationReconciler:
         backoff budget already burned — otherwise every agent restart would
         reset activeDeadlineSeconds/backoff_limit to zero.
         Returns True when existing pods were adopted."""
-        existing = self.cluster.pod_statuses(op.label_selector)
+        existing = self._c(self.cluster.pod_statuses, op.label_selector)
         if not existing:
             self.apply(op)
             return False
@@ -144,11 +154,15 @@ class OperationReconciler:
         with self._lock:
             state = self._ops.pop(run_uuid, None)
         if state:
-            self.cluster.delete_selected(state.op.label_selector)
+            self._c(self.cluster.delete_selected, state.op.label_selector)
 
     def is_tracked(self, run_uuid: str) -> bool:
         with self._lock:
             return run_uuid in self._ops
+
+    def tracked_uuids(self) -> set:
+        with self._lock:
+            return set(self._ops)
 
     def active_count(self) -> int:
         with self._lock:
@@ -174,8 +188,12 @@ class OperationReconciler:
                 except Exception:
                     traceback.print_exc()
 
+    def _c(self, fn, *args):
+        """Run one cluster verb through the transient-failure retry."""
+        return self.retry.call(fn, *args)
+
     def _observe(self, state: _OpState) -> Observed:
-        statuses = self.cluster.pod_statuses(state.op.label_selector)
+        statuses = self._c(self.cluster.pod_statuses, state.op.label_selector)
         counts = {phase: 0 for phase in PodPhase}
         for s in statuses:
             counts[s.phase] += 1
@@ -222,9 +240,9 @@ class OperationReconciler:
             )
             self.on_status(op.run_uuid, V1Statuses.QUEUED.value, None)
             self.on_status(op.run_uuid, V1Statuses.SCHEDULED.value, None)
-            self.cluster.delete_selected(op.label_selector)
+            self._c(self.cluster.delete_selected, op.label_selector)
             for manifest in op.resources:
-                self.cluster.apply(manifest)
+                self._c(self.cluster.apply, manifest)
             state.applied_at = time.monotonic()
             state.was_running = False
             return
@@ -243,12 +261,12 @@ class OperationReconciler:
             # success leaves them until TTL (or forever when ttl < 0)
             self.on_status(op.run_uuid, status.value, _REASON_MSG.get(decision.reason))
             if decision.action == Action.FAIL or op.ttl_s == 0:
-                self.cluster.delete_selected(op.label_selector)
+                self._c(self.cluster.delete_selected, op.label_selector)
                 if op.ttl_s == 0:
                     state.gc_done = True
             return
         if decision.action == Action.GC:
-            self.cluster.delete_selected(op.label_selector)
+            self._c(self.cluster.delete_selected, op.label_selector)
             state.gc_done = True
             return
 

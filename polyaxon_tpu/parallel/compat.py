@@ -1,0 +1,38 @@
+"""jax version compatibility shims.
+
+The codebase targets current jax (``jax.shard_map`` with ``check_vma``);
+older runtimes (< 0.5) ship the same primitive as
+``jax.experimental.shard_map.shard_map`` with the replication check spelled
+``check_rep``. One call-site-compatible wrapper keeps every kernel/model
+call site on the modern spelling.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def shard_map(f: Any = None, *, mesh: Any, in_specs: Any, out_specs: Any,
+              check_vma: bool = True, **kw: Any) -> Any:
+    """Drop-in for ``jax.shard_map`` that also runs on jax < 0.5.
+
+    Usable exactly like the modern API, including the
+    ``functools.partial(shard_map, mesh=..., in_specs=..., out_specs=...)``
+    decorator idiom used throughout the models/parallel layers.
+    """
+    modern = getattr(jax, "shard_map", None)
+    if modern is not None:
+        return modern(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma, **kw)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    if f is None:
+        import functools
+
+        return functools.partial(
+            shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kw)
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma, **kw)

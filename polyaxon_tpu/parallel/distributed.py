@@ -75,6 +75,17 @@ def initialize(info: Optional[ProcessInfo] = None) -> ProcessInfo:
         raise RuntimeError(
             f"{ENV_NUM_PROCESSES}={info.num_processes} but no {ENV_COORDINATOR} set"
         )
+    platforms = str(getattr(jax.config, "jax_platforms", None)
+                    or os.environ.get("JAX_PLATFORMS") or "")
+    if "cpu" in platforms:
+        # multi-process SPMD on the CPU backend needs the Gloo collectives
+        # implementation; newer jax defaults to it, jax < 0.5 defaults to
+        # "none" and fails with "Multiprocess computations aren't
+        # implemented on the CPU backend"
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — option absent/renamed: rely on default
+            pass
     jax.distributed.initialize(
         coordinator_address=info.coordinator_address,
         num_processes=info.num_processes,

@@ -64,6 +64,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from .compat import shard_map
 
 
 def validate_pipeline_mesh(mesh: Mesh) -> int:
@@ -143,7 +144,7 @@ def gpipe_trunk(
             policy=jax.checkpoint_policies.nothing_saveable)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, check_vma=False,
+        shard_map, mesh=mesh, check_vma=False,
         in_specs=(batch_spec, param_spec), out_specs=(batch_spec, P()),
     )
     def _pipeline(xl, stage_params):

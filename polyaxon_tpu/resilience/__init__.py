@@ -1,0 +1,32 @@
+"""Failure as a first-class condition (VERDICT r5 Missing #3).
+
+Three pieces, one contract:
+
+- ``retry``: a shared :class:`RetryPolicy` (jittered exponential backoff,
+  deadline budget, retryable-error classification) wired into every HTTP
+  edge — ``KubeCluster._request``, the tracking ``BaseClient._req``, the
+  reconciler's cluster verbs, the agent sidecar's log/artifact sync.
+- ``chaos``: deterministic, seed-driven fault injection — ``ChaosCluster``
+  wraps any ``Cluster`` (preemptions, API 5xx/429/timeouts, watch event
+  drops), ``FaultyStore`` and ``flaky_http_middleware`` shim the client
+  path — so the retry/restart machinery is *tested*, not assumed.
+- ``heartbeat``: run heartbeats in the store plus the agent-side
+  :class:`ZombieReaper` that detects runs stuck in ``running`` with a dead
+  executor and routes them through the existing RETRYING/backoff machinery.
+
+See docs/RESILIENCE.md for the failure model and how to run the chaos soak.
+"""
+
+from .chaos import ChaosCluster, ChaosConfig, FaultyStore, flaky_http_middleware
+from .heartbeat import ZombieReaper
+from .retry import DEFAULT_HTTP_RETRY, RetryPolicy
+
+__all__ = [
+    "ChaosCluster",
+    "ChaosConfig",
+    "DEFAULT_HTTP_RETRY",
+    "FaultyStore",
+    "RetryPolicy",
+    "ZombieReaper",
+    "flaky_http_middleware",
+]

@@ -1,0 +1,285 @@
+"""Deterministic, seed-driven fault injection.
+
+Three shims, one per trust boundary:
+
+- :class:`ChaosCluster` wraps any ``Cluster`` and injects the failure
+  modes a real apiserver/kubelet produces: pod preemptions (the paper's
+  all-or-nothing ICI-slice failure model), apply/delete/list 5xx/429/
+  timeouts, and dropped watch events.
+- :func:`flaky_http_middleware` puts a seeded 5xx/429 fault schedule in
+  front of the aiohttp API app, so the tracking client's RetryPolicy is
+  exercised over the wire.
+- :class:`FaultyStore` wraps the SQLite store with transient
+  ``OperationalError("database is locked")`` bursts — the API surfaces
+  them as 500s, which clients must ride out.
+
+Everything draws from one ``random.Random(seed)`` per shim: the same seed
+replays the same fault schedule, so chaos tests are reproducible runs, not
+dice rolls.
+"""
+
+from __future__ import annotations
+
+import random
+import sqlite3
+import threading
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..operator.cluster import Cluster, PodPhase, PodStatus
+from ..operator.kube import KubeApiError
+
+
+@dataclass
+class ChaosConfig:
+    """Fault schedule knobs. Rates are per-call probabilities in [0, 1];
+    ``max_api_faults``/``max_preemptions`` bound the total injected so a
+    finite retry/backoff budget is always eventually enough."""
+
+    seed: int = 0
+    api_fault_rate: float = 0.0       # apply/delete/pod_statuses/pod_logs
+    timeout_rate: float = 0.0         # raise TimeoutError instead of a 5xx
+    preempt_rate: float = 0.0         # per observe pass, kill a running pod
+    watch_drop_rate: float = 0.0      # swallow watch events
+    max_api_faults: Optional[int] = None
+    max_preemptions: Optional[int] = None
+    fault_statuses: tuple = (503, 429, 500)
+
+
+class ChaosCluster(Cluster):
+    """A ``Cluster`` decorator that injects faults on the way through.
+
+    The wrapped backend keeps full authority over real state; chaos only
+    perturbs the *interface*: verbs may raise transient API errors before
+    reaching the backend, observe passes may preempt a running pod first,
+    and watch events may be dropped. ``injected`` records every fault
+    (kind, detail) for assertions.
+    """
+
+    def __init__(self, inner: Cluster, config: Optional[ChaosConfig] = None,
+                 **kw: Any):
+        self.inner = inner
+        self.config = config or ChaosConfig(**kw)
+        self.rng = random.Random(self.config.seed)
+        self.injected: list[tuple[str, str]] = []
+        self._lock = threading.Lock()
+        self._api_faults = 0
+        self._preemptions = 0
+
+    # -- fault scheduling ----------------------------------------------------
+
+    def _maybe_api_fault(self, op: str) -> None:
+        cfg = self.config
+        with self._lock:
+            if cfg.max_api_faults is not None and self._api_faults >= cfg.max_api_faults:
+                return
+            roll = self.rng.random()
+            if roll < cfg.timeout_rate:
+                self._api_faults += 1
+                self.injected.append(("timeout", op))
+                raise TimeoutError(f"chaos: injected timeout on {op}")
+            if roll < cfg.timeout_rate + cfg.api_fault_rate:
+                self._api_faults += 1
+                status = self.rng.choice(cfg.fault_statuses)
+                self.injected.append((f"http-{status}", op))
+                raise KubeApiError(status, f"chaos: injected {status} on {op}")
+
+    def _maybe_preempt(self) -> None:
+        cfg = self.config
+        with self._lock:
+            if cfg.preempt_rate <= 0:
+                return
+            if (cfg.max_preemptions is not None
+                    and self._preemptions >= cfg.max_preemptions):
+                return
+            if self.rng.random() >= cfg.preempt_rate:
+                return
+        victim = self._pick_running_pod()
+        if victim is not None:
+            self.preempt(victim)
+
+    def _pick_running_pod(self) -> Optional[str]:
+        pods = getattr(self.inner, "pods", None)
+        if pods is None:
+            return None
+        running = sorted(
+            name for name, pod in list(pods.items())
+            if pod.proc is not None and pod.proc.poll() is None
+        )
+        if not running:
+            return None
+        with self._lock:
+            return self.rng.choice(running)
+
+    def preempt(self, name: Optional[str] = None) -> Optional[str]:
+        """Kill a pod's process without deleting the pod object — exactly
+        what node preemption looks like to the operator: the pod is still
+        listed, phase Failed. Returns the victim name (None when there was
+        nothing to preempt). Deterministic victim choice under the seed;
+        pass ``name`` for a targeted kill (the preemption→resume proof)."""
+        if name is None:
+            name = self._pick_running_pod()
+        if name is None:
+            return None
+        pods = getattr(self.inner, "pods", None)
+        pod = pods.get(name) if pods is not None else None
+        if pod is not None and pod.proc is not None and pod.proc.poll() is None:
+            pod.proc.kill()
+            pod.proc.wait(timeout=10)
+        else:
+            # backend without reachable processes (e.g. a real cluster):
+            # model preemption as the pod vanishing
+            self.inner.delete("Pod", name)
+        with self._lock:
+            self._preemptions += 1
+            self.injected.append(("preempt", name))
+        return name
+
+    @property
+    def preemptions(self) -> int:
+        with self._lock:
+            return self._preemptions
+
+    # -- Cluster verbs (chaos, then delegate) --------------------------------
+
+    def apply(self, manifest: dict) -> None:
+        self._maybe_api_fault("apply")
+        self.inner.apply(manifest)
+
+    def delete(self, kind: str, name: str) -> None:
+        self._maybe_api_fault("delete")
+        self.inner.delete(kind, name)
+
+    def delete_selected(self, label_selector: dict[str, str]) -> None:
+        self._maybe_api_fault("delete_selected")
+        self.inner.delete_selected(label_selector)
+
+    def pod_statuses(self, label_selector: dict[str, str]) -> list[PodStatus]:
+        self._maybe_preempt()
+        self._maybe_api_fault("pod_statuses")
+        return self.inner.pod_statuses(label_selector)
+
+    def pod_logs(self, name: str) -> str:
+        self._maybe_api_fault("pod_logs")
+        return self.inner.pod_logs(name)
+
+    def service_host(self, name: str) -> str:
+        return self.inner.service_host(name)
+
+    def __getattr__(self, name: str):
+        # watch_pods materializes ONLY when the wrapped backend has one, so
+        # `hasattr(cluster, "watch_pods")` keeps steering the agent's
+        # watch-vs-poll choice correctly through the chaos wrapper
+        if name == "watch_pods":
+            inner_watch = getattr(self.inner, "watch_pods")  # may raise
+
+            def watch_pods(label_selector: dict[str, str], on_event,
+                           stop_event=None) -> None:
+                """Delegate the watch, dropping events per
+                ``watch_drop_rate`` — a lossy stream the level-triggered
+                poll resync must paper over."""
+
+                def _lossy(typ: str, status: PodStatus) -> None:
+                    with self._lock:
+                        dropped = self.rng.random() < self.config.watch_drop_rate
+                        if dropped:
+                            self.injected.append(
+                                ("watch-drop", f"{typ}:{status.name}"))
+                    if not dropped:
+                        on_event(typ, status)
+
+                inner_watch(label_selector, _lossy, stop_event)
+
+            return watch_pods
+        raise AttributeError(name)
+
+    def shutdown(self) -> None:
+        inner_shutdown = getattr(self.inner, "shutdown", None)
+        if inner_shutdown is not None:
+            inner_shutdown()
+
+
+# -- client-path shims -------------------------------------------------------
+
+
+def flaky_http_middleware(seed: int = 0, fault_rate: float = 0.3,
+                          statuses: tuple = (503, 429, 500),
+                          max_faults: Optional[int] = None,
+                          path_prefix: str = "/api/"):
+    """An aiohttp middleware that fails requests with a seeded schedule
+    before they reach any handler. 429 responses carry ``Retry-After: 0``
+    so the client's Retry-After handling is exercised too. The returned
+    middleware exposes ``.injected`` (list of (status, path)) for tests."""
+    from aiohttp import web
+
+    rng = random.Random(seed)
+    lock = threading.Lock()
+    injected: list[tuple[int, str]] = []
+
+    @web.middleware
+    async def _middleware(request, handler):
+        if request.path.startswith(path_prefix):
+            with lock:
+                budget_left = max_faults is None or len(injected) < max_faults
+                if budget_left and rng.random() < fault_rate:
+                    status = rng.choice(statuses)
+                    injected.append((status, request.path))
+                else:
+                    status = None
+            if status is not None:
+                headers = {"Retry-After": "0"} if status == 429 else None
+                return web.json_response(
+                    {"error": f"chaos: injected {status}"},
+                    status=status, headers=headers)
+        return await handler(request)
+
+    _middleware.injected = injected
+    return _middleware
+
+
+class FaultyStore:
+    """Store decorator raising transient sqlite 'database is locked'
+    errors on a seeded schedule. Every attribute delegates to the wrapped
+    store; callables listed in ``methods`` get the fault gate (default:
+    the read/write verbs the API and agent hot paths hit)."""
+
+    _DEFAULT_METHODS = (
+        "get_run", "list_runs", "create_run", "update_run", "transition",
+        "merge_outputs", "get_statuses", "heartbeat",
+    )
+
+    def __init__(self, inner: Any, seed: int = 0, fault_rate: float = 0.2,
+                 max_faults: Optional[int] = None,
+                 methods: Optional[tuple] = None):
+        self._inner = inner
+        self._rng = random.Random(seed)
+        self._fault_rate = fault_rate
+        self._max_faults = max_faults
+        self._methods = methods or self._DEFAULT_METHODS
+        self._faults = 0
+        self._flock = threading.Lock()
+        self.injected: list[str] = []
+
+    def _gate(self, name: str) -> None:
+        with self._flock:
+            if self._max_faults is not None and self._faults >= self._max_faults:
+                return
+            if self._rng.random() < self._fault_rate:
+                self._faults += 1
+                self.injected.append(name)
+                raise sqlite3.OperationalError(
+                    f"chaos: database is locked (injected on {name})")
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._inner, name)
+        if name in self._methods and callable(attr):
+            def _guarded(*a: Any, _attr=attr, _name=name, **kw: Any) -> Any:
+                self._gate(_name)
+                return _attr(*a, **kw)
+
+            return _guarded
+        return attr
+
+
+__all__ = ["ChaosCluster", "ChaosConfig", "FaultyStore",
+           "flaky_http_middleware", "PodPhase"]

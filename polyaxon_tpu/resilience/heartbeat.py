@@ -1,0 +1,130 @@
+"""Run heartbeats + the agent-side zombie reaper.
+
+Failure detection gap (VERDICT r5 Missing #3): a run can sit in
+``running`` forever when its executor dies without reporting — executor
+thread crash, pod set lost while the reconciler wasn't tracking it, an
+agent driving a shared store that went away. The store now carries a
+``heartbeat_at`` lease per run (stamped by the agent for every run it
+actively drives, and POSTable by external executors via
+``/runs/{uuid}/heartbeat``); the reaper scans in-flight runs, renews the
+lease for runs with a live local driver, and routes lease-expired zombies
+through the EXISTING retrying/backoff machinery — a reaped run retries
+while ``termination.max_retries`` budget remains (resuming from its latest
+checkpoint, like any slice restart), then fails loudly.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Callable, Iterable, Optional
+
+from ..schemas.statuses import V1Statuses
+
+# runs the reaper considers in-flight enough to hold a lease
+_REAPABLE = (V1Statuses.STARTING.value, V1Statuses.RUNNING.value)
+
+
+def _age_seconds(iso: Optional[str]) -> Optional[float]:
+    if not iso:
+        return None
+    try:
+        t = datetime.datetime.fromisoformat(iso)
+    except ValueError:
+        return None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=datetime.timezone.utc)
+    return (datetime.datetime.now(datetime.timezone.utc) - t).total_seconds()
+
+
+def _max_retries(run: dict) -> int:
+    term = ((run.get("compiled") or {}).get("termination")
+            or (run.get("spec") or {}).get("termination") or {})
+    for key in ("maxRetries", "max_retries"):
+        if term.get(key) is not None:
+            try:
+                return int(term[key])
+            except (TypeError, ValueError):
+                return 0
+    return 0
+
+
+class ZombieReaper:
+    """Lease renewal + reaping over one store.
+
+    ``owned`` returns the uuids the calling agent is actively driving
+    (live executor threads, pipeline drivers, reconciler-tracked ops) —
+    those get their lease renewed every pass and are never reaped. Any
+    other run in ``starting``/``running`` whose lease (heartbeat_at,
+    falling back to started_at) is older than ``zombie_after`` seconds is
+    a zombie: retried while budget remains, failed otherwise.
+    """
+
+    def __init__(
+        self,
+        store,
+        owned: Callable[[], Iterable[str]],
+        zombie_after: float = 120.0,
+        list_runs: Optional[Callable[[str], list]] = None,
+    ):
+        import time
+
+        self.store = store
+        self.owned = owned
+        self.zombie_after = zombie_after
+        # self-throttle: callers (the agent tick) may fire every poll
+        # interval, but lease renewal + staleness scans only need to run a
+        # few times per zombie_after window — not 20x/second
+        self._min_interval = max(zombie_after, 0.0) / 4.0
+        self._last_pass = float("-inf")
+        self._clock = time.monotonic
+        self._list_runs = list_runs or (
+            lambda status: store.list_runs(status=status, limit=500))
+        self.reaped: list[tuple[str, str]] = []  # (uuid, action) audit trail
+
+    def pass_once(self) -> list[tuple[str, str]]:
+        """One renewal + reap pass (rate-limited; a call inside the
+        throttle window is a no-op); returns this pass's (uuid, action)s."""
+        if self.zombie_after <= 0:
+            return []
+        now = self._clock()
+        if now - self._last_pass < self._min_interval:
+            return []
+        self._last_pass = now
+        actions: list[tuple[str, str]] = []
+        owned = set(self.owned())
+        for status in _REAPABLE:
+            for run in self._list_runs(status):
+                uuid = run["uuid"]
+                if uuid in owned:
+                    self.store.heartbeat(uuid)
+                    continue
+                age = _age_seconds(run.get("heartbeat_at")
+                                   or run.get("started_at")
+                                   or run.get("updated_at"))
+                if age is None or age < self.zombie_after:
+                    continue
+                actions.append((uuid, self._reap(run)))
+        self.reaped.extend(actions)
+        return actions
+
+    def _reap(self, run: dict) -> str:
+        uuid = run["uuid"]
+        retries_done = sum(
+            1 for c in self.store.get_statuses(uuid)
+            if c.get("type") == V1Statuses.RETRYING.value)
+        budget = _max_retries(run)
+        if retries_done < budget:
+            # the same path a slice restart takes: retrying -> queued, the
+            # scheduler re-runs it (builtin runtimes resume from their
+            # latest checkpoint because the artifacts dir is unchanged)
+            self.store.transition(
+                uuid, V1Statuses.RETRYING.value, reason="ZombieReaped",
+                message=f"no heartbeat for {self.zombie_after:.0f}s; "
+                        f"attempt {retries_done + 2}/{budget + 1}")
+            self.store.transition(uuid, V1Statuses.QUEUED.value)
+            return "retried"
+        self.store.transition(
+            uuid, V1Statuses.FAILED.value, force=True, reason="ZombieReaped",
+            message=f"stuck in {run['status']} with no heartbeat for "
+                    f"{self.zombie_after:.0f}s and no retry budget left")
+        return "failed"

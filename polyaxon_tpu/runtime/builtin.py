@@ -40,7 +40,16 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
 
         jax.config.update("jax_platforms", platform)
         if spec.get("num_cpu_devices"):
-            jax.config.update("jax_num_cpu_devices", int(spec["num_cpu_devices"]))
+            try:
+                jax.config.update("jax_num_cpu_devices", int(spec["num_cpu_devices"]))
+            except AttributeError:
+                # jax < 0.5: the underlying XLA flag is read at first
+                # backend init, still ahead of us in a fresh pod process
+                flags = os.environ.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    os.environ["XLA_FLAGS"] = (
+                        flags + " --xla_force_host_platform_device_count="
+                        f"{int(spec['num_cpu_devices'])}").strip()
 
     from .. import tracking
     from ..models import REGISTRY
@@ -163,6 +172,15 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
     )
     batches = make_batches(data_cfg, trainer.mesh)
 
+    # Preemption -> resume (docs/RESILIENCE.md): a restarted attempt shares
+    # the artifacts dir, so restore_or_init picks up the latest checkpoint.
+    # The data stream must be fast-forwarded to the restored step — without
+    # this a resumed run re-consumes batches 0..k and diverges from an
+    # uninterrupted run (the chaos parity proof would catch it).
+    state, start_step = trainer.restore_or_init()
+    for _ in range(start_step):
+        next(batches)
+
     # host/TPU resource telemetry (upstream traceml's ResourceLogger ran in
     # the sidecar by default): metrics land in the run's event files under
     # host_*/tpu_* names, charted in the dashboard's Resources section.
@@ -184,8 +202,8 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
             # multi-host runs. Only process 0 wraps the middle segment in
             # the profiler.
             prof_steps = int(profile.get("steps", 3)) if isinstance(profile, dict) else 3
-            warm = min(2, steps)
-            state, metrics = trainer.fit(batches, num_steps=warm)
+            warm = max(min(2, steps), start_step)
+            state, metrics = trainer.fit(batches, num_steps=warm, state=state)
             prof_dir = os.path.join(artifacts_dir, "outputs", "profile")
             end = min(warm + prof_steps, steps)
             if end > warm:
@@ -199,13 +217,16 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
             if run is not None:
                 run.log_artifact("profile", "outputs/profile", kind="profile")
         else:
-            state, metrics = trainer.fit(batches, num_steps=steps)
+            state, metrics = trainer.fit(batches, num_steps=steps, state=state)
     finally:
         # a failing fit must not leak the telemetry thread (it would keep
         # writing events for a dead run until process exit)
         if res_logger is not None:
             res_logger.stop()
     summary = {k: v for k, v in metrics.items() if isinstance(v, (int, float))}
+    # which checkpoint step this attempt started from (0 = fresh): the
+    # preemption->resume proof asserts a restarted attempt reports > 0
+    summary["resumed_from_step"] = int(start_step)
     if run is not None:
         run.log_outputs(**summary)
         if ckpt:

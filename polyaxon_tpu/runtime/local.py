@@ -34,6 +34,25 @@ def _with_pythonpath(env: dict) -> dict:
     return env
 
 
+def pod_base_env() -> dict:
+    """The inherited (os.environ) half of a pod's env, with any forced XLA
+    host device count dropped: pods declare their own device topology
+    (runtime spec ``num_cpu_devices``), and a test harness forcing an
+    8-device mesh on ITS process must not hand every "host" 8 devices.
+    Applied BEFORE the operation's env spec merges in, so a pod that
+    explicitly sets XLA_FLAGS keeps exactly what it asked for."""
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        kept = [t for t in flags.split()
+                if "xla_force_host_platform_device_count" not in t]
+        if kept:
+            env["XLA_FLAGS"] = " ".join(kept)
+        else:
+            env.pop("XLA_FLAGS", None)
+    return env
+
+
 class LocalExecution:
     """Handle on a launched local run."""
 
@@ -68,11 +87,16 @@ class LocalExecutor:
         on_status: Optional[Callable[[str, str, Optional[str]], None]] = None,
         remote_store: Optional[str] = None,
         sync_interval: float = 5.0,
+        retry=None,
     ):
+        from ..resilience.retry import DEFAULT_HTTP_RETRY
+
         # on_status(run_uuid, status, message)
         self.on_status = on_status or (lambda *a: None)
         self.remote_store = remote_store
         self.sync_interval = sync_interval
+        # transient-failure policy for the sidecar's artifact sync
+        self.retry = retry if retry is not None else DEFAULT_HTTP_RETRY
 
     # -- submit ------------------------------------------------------------
 
@@ -134,7 +158,7 @@ class LocalExecutor:
         if not payload.argv:
             log.write("[main] no container command; nothing to run")
             return 0
-        env = _with_pythonpath({**os.environ, **payload.env})
+        env = _with_pythonpath({**pod_base_env(), **payload.env})
         workdir = payload.workdir or os.path.join(payload.artifacts_path, "code")
         if not os.path.isdir(workdir):
             workdir = payload.artifacts_path
@@ -146,7 +170,7 @@ class LocalExecutor:
         import json
 
         spec = dict(payload.builtin or {})
-        env = _with_pythonpath({**os.environ, **payload.env})
+        env = _with_pythonpath({**pod_base_env(), **payload.env})
         env["PLX_BUILTIN_SPEC"] = json.dumps(spec)
         argv = [sys.executable, "-m", "polyaxon_tpu.runtime.builtin"]
         return self._spawn_and_pump(payload, execution, log, argv, env, payload.artifacts_path)
@@ -202,8 +226,16 @@ class LocalExecutor:
 
         def loop():
             while not stop.wait(self.sync_interval):
-                sync_dir(payload.artifacts_path, remote)
-            sync_dir(payload.artifacts_path, remote)  # final sync
+                try:
+                    # retried within the policy budget; a sync that still
+                    # fails skips this interval instead of killing the
+                    # sidecar thread (the next interval tries again)
+                    self.retry.call(sync_dir, payload.artifacts_path, remote)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+            self.retry.call(sync_dir, payload.artifacts_path, remote)  # final sync
 
         t = threading.Thread(target=loop, daemon=True)
         t.start()

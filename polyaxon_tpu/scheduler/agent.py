@@ -67,7 +67,12 @@ class _RunSidecar(threading.Thread):
     def run(self) -> None:
         while not self.stop_evt.wait(self.interval):
             try:
-                self.agent._stream_pod_logs(self.run_uuid, self._offsets)
+                # lease renewal: the sidecar is alive iff the agent is
+                # actively driving this run — exactly what the zombie
+                # reaper wants to know
+                self.agent.store.heartbeat(self.run_uuid)
+                self.agent.retry.call(
+                    self.agent._stream_pod_logs, self.run_uuid, self._offsets)
                 self.agent._sync_to_store(self.run_uuid)
             except Exception:
                 traceback.print_exc()
@@ -103,8 +108,21 @@ class LocalAgent:
         artifacts_store: Optional[str] = None,
         api_token: Optional[str] = None,
         connections: Optional[dict] = None,
+        zombie_after: float = 120.0,
+        retry=None,
     ):
+        from ..resilience.heartbeat import ZombieReaper
+        from ..resilience.retry import DEFAULT_HTTP_RETRY
+
         self.store = store
+        # transient-failure policy for the sidecar's log/artifact sync
+        self.retry = retry if retry is not None else DEFAULT_HTTP_RETRY
+        # lease-based failure detection (docs/RESILIENCE.md): runs this
+        # agent drives get their heartbeat renewed; runs stuck in
+        # starting/running with a stale lease and no live driver are routed
+        # through the retrying/backoff machinery. <=0 disables.
+        self.reaper = ZombieReaper(
+            store, owned=self._driven_uuids, zombie_after=zombie_after)
         self.artifacts_root = os.path.abspath(artifacts_root)
         self.api_host = api_host
         self.api_token = api_token
@@ -123,7 +141,8 @@ class LocalAgent:
         self.poll_interval = poll_interval
         self.backend = backend
         self.executor = LocalExecutor(on_status=self._on_status,
-                                      remote_store=artifacts_store)
+                                      remote_store=artifacts_store,
+                                      retry=self.retry)
         self.reconciler = None
         if backend in ("cluster", "auto"):
             from ..operator import FakeCluster, OperationReconciler
@@ -266,6 +285,19 @@ class LocalAgent:
                     message="orphaned by agent restart (local process lost)",
                 )
 
+    def _driven_uuids(self) -> set:
+        """Runs with a LIVE driver in this agent: executor threads still
+        running, pipeline driver threads, reconciler-tracked operations.
+        A dead executor thread whose run never reached a terminal status is
+        exactly the zombie case — so liveness, not mere membership."""
+        with self._lock:
+            owned = {u for u, ex in self._active.items()
+                     if ex.thread is not None and ex.thread.is_alive()}
+            owned |= {u for u, t in self._tuners.items() if t.is_alive()}
+        if self.reconciler is not None:
+            owned |= self.reconciler.tracked_uuids()
+        return owned
+
     def _reconcile_sidecars(self) -> None:
         """Ensure every live reconciler-tracked run has a streaming sidecar
         (covers fresh schedules AND adopted orphans) and reap dead ones."""
@@ -295,7 +327,13 @@ class LocalAgent:
                 # duplicate trailing log lines — wait the sidecar out
                 sidecar.join(timeout=5)
             if self.reconciler is not None and self.reconciler.is_tracked(run_uuid):
-                self._scrape_pod_logs(run_uuid)
+                try:
+                    # cluster API weather on the way out must not blow back
+                    # into the reconciler's status path: the run IS terminal
+                    # at this point, the scrape is best-effort
+                    self.retry.call(self._scrape_pod_logs, run_uuid)
+                except Exception:
+                    traceback.print_exc()
                 self._sync_to_store(run_uuid)
 
     def _on_transition_applied(self, run_uuid: str, status: str) -> None:
@@ -408,7 +446,8 @@ class LocalAgent:
         local = run_artifacts_dir(self.artifacts_root, run["project"], run_uuid)
         if os.path.isdir(local):
             try:
-                sync_dir(local, os.path.join(self.artifacts_store,
+                self.retry.call(sync_dir, local,
+                                os.path.join(self.artifacts_store,
                                              run["project"], run_uuid))
             except OSError:
                 traceback.print_exc()
@@ -472,6 +511,10 @@ class LocalAgent:
         if self.reconciler is not None:
             self.reconciler.reconcile_once()
             self._reconcile_sidecars()
+        try:
+            self.reaper.pass_once()
+        except Exception:
+            traceback.print_exc()
 
     def _tick_dirty(self, dirty: set) -> None:
         """Event-driven pass: advance exactly the runs the change feed
@@ -738,7 +781,11 @@ class LocalAgent:
         if self._use_cluster(resolved):
             host = self.cluster.service_host(f"plx-{uuid[:12]}")
         meta = dict(run.get("meta") or {})
-        meta["service"] = {"host": host, "port": int(ports[0])}
+        # the FULL resolved port list is stamped too: the portforward
+        # handler validates ?port= against agent-stamped ports only (the
+        # client-supplied spec is not a trustworthy source — SSRF fix)
+        meta["service"] = {"host": host, "port": int(ports[0]),
+                           "ports": [int(p) for p in ports]}
         self.store.update_run(uuid, meta=meta)
 
     def _use_cluster(self, resolved) -> bool:

@@ -73,9 +73,13 @@ def scale_by_adam_lowmem(
         nu = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=nd or p.dtype), params)
         return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
 
+    # optax < 0.2.3 spells the overflow-safe counter bump safe_int32_increment
+    _safe_increment = getattr(optax, "safe_increment", None) \
+        or optax.safe_int32_increment
+
     def update(updates, state, params=None):
         del params
-        count = optax.safe_increment(state.count)
+        count = _safe_increment(state.count)
 
         def _mu(m, g):
             return (b1 * m.astype(jnp.float32) + (1 - b1) * g.astype(jnp.float32)).astype(m.dtype)

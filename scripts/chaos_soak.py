@@ -1,0 +1,173 @@
+"""Manual chaos soak driver (docs/RESILIENCE.md).
+
+Drives a DAG + a grid matrix sweep through the full agent/operator stack
+while a seed-driven fault schedule injects cluster API 5xx/429/timeouts
+and pod preemptions, then compares every run's terminal status against a
+fault-free oracle pass. Exit code 0 iff the chaotic pass converges to the
+oracle.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/chaos_soak.py \
+        [--seed 2024] [--fault-rate 0.08] [--timeout-rate 0.02] \
+        [--preempt-rate 0.03] [--max-preemptions 2] [--trials 3] \
+        [--rounds 1] [--keep]
+
+Every knob maps 1:1 onto ChaosConfig; --rounds repeats the chaotic pass
+with seed, seed+1, ... for endurance sweeps. The pytest-integrated proofs
+live in tests/test_chaos_soak.py (slow) and tests/test_resilience.py
+(tier-1 smoke).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _specs(trials: int):
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    write_out = (
+        "import json, os; "
+        "json.dump({'x': %s}, open(os.path.join("
+        "os.environ['PLX_ARTIFACTS_PATH'], 'outputs.json'), 'w'))"
+    )
+
+    def job(cmd):
+        return {"kind": "component",
+                "run": {"kind": "job",
+                        "container": {"command": [sys.executable, "-c", cmd]}}}
+
+    dag = check_polyaxonfile({
+        "kind": "operation",
+        "name": "soak-dag",
+        "component": {"kind": "component", "run": {"kind": "dag", "operations": [
+            {"kind": "operation", "name": "prep",
+             "termination": {"maxRetries": 3}, "component": job(write_out % "13")},
+            {"kind": "operation", "name": "tail",
+             "termination": {"maxRetries": 3}, "component": job(write_out % "1"),
+             "dependencies": ["prep"]},
+        ]}},
+    }).to_dict()
+    sweep = check_polyaxonfile({
+        "kind": "operation",
+        "name": "soak-sweep",
+        "termination": {"maxRetries": 3},
+        "matrix": {"kind": "grid", "concurrency": 2,
+                   "params": {"x": {"kind": "choice",
+                                    "value": list(range(1, trials + 1))}}},
+        "component": {
+            "kind": "component",
+            "inputs": [{"name": "x", "type": "int"}],
+            "run": {"kind": "job", "container": {"command": [
+                sys.executable, "-c",
+                "import json, os; "
+                "x = int(json.loads(os.environ['PLX_PARAMS'])['x']); "
+                "json.dump({'loss': float(x)}, open(os.path.join("
+                "os.environ['PLX_ARTIFACTS_PATH'], 'outputs.json'), 'w'))",
+            ]}},
+        },
+    }).to_dict()
+    return [dag, sweep]
+
+
+def _pass(workdir: str, trials: int, chaos_cfg=None, timeout: float = 600.0):
+    from polyaxon_tpu.api.store import Store
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.resilience import ChaosCluster
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    store = Store(":memory:")
+    cluster = FakeCluster(os.path.join(workdir, ".cluster"))
+    if chaos_cfg is not None:
+        cluster = ChaosCluster(cluster, chaos_cfg)
+    agent = LocalAgent(store, workdir, backend="cluster", cluster=cluster,
+                       poll_interval=0.05)
+    agent.start()
+    try:
+        uuids = [store.create_run("p", spec=s, name=s.get("name"))["uuid"]
+                 for s in _specs(trials)]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = [store.get_run(u) for u in uuids]
+            if all(r["status"] in ("succeeded", "failed", "stopped")
+                   for r in rows):
+                break
+            time.sleep(0.2)
+        statuses = {}
+        for row in store.list_runs(limit=500):
+            statuses[row["name"]] = row["status"]
+        injected = list(getattr(cluster, "injected", []))
+        return statuses, injected
+    finally:
+        agent.stop()
+
+
+def main() -> int:
+    p = argparse.ArgumentParser("chaos_soak", description=__doc__)
+    p.add_argument("--seed", type=int, default=2024)
+    p.add_argument("--fault-rate", type=float, default=0.08,
+                   help="per-verb probability of an injected API 5xx/429")
+    p.add_argument("--timeout-rate", type=float, default=0.02)
+    p.add_argument("--preempt-rate", type=float, default=0.03)
+    p.add_argument("--max-api-faults", type=int, default=12)
+    p.add_argument("--max-preemptions", type=int, default=2)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--rounds", type=int, default=1)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--keep", action="store_true",
+                   help="keep the scratch workdir for inspection")
+    args = p.parse_args()
+
+    from polyaxon_tpu.resilience import ChaosConfig
+
+    root = tempfile.mkdtemp(prefix="plx-chaos-soak-")
+    ok = True
+    try:
+        oracle, _ = _pass(os.path.join(root, "oracle"), args.trials,
+                          timeout=args.timeout)
+        print(json.dumps({"pass": "oracle", "statuses": oracle}))
+        if any(v != "succeeded" for v in oracle.values()):
+            print(json.dumps({"error": "oracle pass did not fully succeed"}))
+            return 2
+        for i in range(args.rounds):
+            seed = args.seed + i
+            cfg = ChaosConfig(
+                seed=seed, api_fault_rate=args.fault_rate,
+                timeout_rate=args.timeout_rate,
+                preempt_rate=args.preempt_rate,
+                max_api_faults=args.max_api_faults,
+                max_preemptions=args.max_preemptions,
+            )
+            statuses, injected = _pass(
+                os.path.join(root, f"chaos-{seed}"), args.trials, cfg,
+                timeout=args.timeout)
+            converged = statuses == oracle
+            ok = ok and converged
+            print(json.dumps({
+                "pass": f"chaos-{seed}",
+                "converged": converged,
+                "injected": len(injected),
+                "injected_kinds": sorted({k for k, _ in injected}),
+                "diff": {k: (oracle.get(k), statuses.get(k))
+                         for k in set(oracle) | set(statuses)
+                         if oracle.get(k) != statuses.get(k)},
+            }))
+    finally:
+        if args.keep:
+            print(json.dumps({"workdir": root}))
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

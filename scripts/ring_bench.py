@@ -48,6 +48,7 @@ def main() -> None:
     from polyaxon_tpu.ops import ring_attention
     from polyaxon_tpu.ops.flash_attention import _flash_fwd
     from polyaxon_tpu.parallel import build_mesh
+    from polyaxon_tpu.parallel.compat import shard_map
 
     seqs = [8192, 16384]
     if "--seq" in sys.argv:
@@ -84,7 +85,7 @@ def main() -> None:
         ]
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, check_vma=False,
+            shard_map, mesh=mesh, check_vma=False,
             in_specs=(spec,) * 3, out_specs=spec,
         )
         def ring(q, k, v):
@@ -93,7 +94,7 @@ def main() -> None:
                 block_q=block, block_k=block, interpret=True)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, check_vma=False,
+            shard_map, mesh=mesh, check_vma=False,
             in_specs=(spec,) * 3, out_specs=spec,
         )
         def gather(q, k, v):

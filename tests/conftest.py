@@ -12,6 +12,18 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# NOTE: do NOT enable the persistent compilation cache here — on jax 0.4.x
+# CPU it aborts the process (donated buffers + cached executables) the
+# second time a cached program runs.
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; the XLA flag it wraps is
+    # read at first backend initialization, which hasn't happened yet even
+    # when jax itself was pre-imported
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

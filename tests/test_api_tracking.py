@@ -354,10 +354,24 @@ class TestResourceLogger:
         monkeypatch.setenv("PLX_PROJECT", "p")
         monkeypatch.setenv("PLX_ARTIFACTS_PATH", str(tmp_path))
         run = tracking.Run()
-        logger = ResourceLogger(run, interval=0.1).start()
-        _time.sleep(0.5)
+        # event-driven (ISSUE 1 de-flake): wait for the second SAMPLE, not a
+        # fixed wall-clock nap — on a loaded box the sampler thread may get
+        # far fewer than interval-rate slices
+        samples = []
+        orig_log_metrics = run.log_metrics
+
+        def counting(step=None, **metrics):
+            samples.append(metrics)
+            orig_log_metrics(step=step, **metrics)
+
+        run.log_metrics = counting
+        logger = ResourceLogger(run, interval=0.05).start()
+        deadline = _time.monotonic() + 60
+        while len(samples) < 2 and _time.monotonic() < deadline:
+            _time.sleep(0.02)
         logger.stop()
         run.end()
+        assert len(samples) >= 2, "sampler thread never ran twice in 60s"
         from polyaxon_tpu.tracking.writer import list_event_names, read_events
 
         names = list_event_names(str(tmp_path), "metric")

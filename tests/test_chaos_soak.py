@@ -1,0 +1,311 @@
+"""ISSUE 1 capstone proofs (slow; run with `pytest -m slow`):
+
+1. **Preemption → resume**: a mid-training builtin-runtime tpujob is
+   killed by injected preemption; the reconciler's all-or-nothing restart
+   brings up a fresh attempt which must resume from the latest checkpoint
+   step (> 0, Orbax restore through ``train/trainer.py``) and land on the
+   same final loss as an uninterrupted oracle run — proving the whole
+   chain (checkpoint wiring in runtime/builtin.py, slice restart in
+   operator/reconciler.py, data-stream fast-forward) works end to end.
+
+2. **Seeded chaos soak**: a DAG and a matrix sweep driven through the
+   agent while a fixed-seed fault schedule injects cluster API errors,
+   timeouts and pod preemptions, with the client talking through flaky
+   HTTP — every run must converge to the same terminal status as the
+   fault-free oracle.
+
+The fast fixed-seed smoke lives in test_resilience.py (tier-1).
+"""
+
+import glob
+import os
+import sys
+import time
+
+import pytest
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.client import RunClient
+from polyaxon_tpu.operator import FakeCluster
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.resilience import (
+    ChaosCluster, ChaosConfig, RetryPolicy, flaky_http_middleware,
+)
+from polyaxon_tpu.scheduler.agent import LocalAgent
+
+pytestmark = pytest.mark.slow
+
+FAST_RETRY = RetryPolicy(max_attempts=8, base_delay=0.02, max_delay=0.2,
+                         deadline=30.0)
+
+
+# ---------------------------------------------------------------------------
+# 1. preemption -> resume, with loss parity against an uninterrupted run
+# ---------------------------------------------------------------------------
+
+
+TRAIN_RUNTIME = {
+    "model": "llama-tiny",
+    "steps": 60,
+    # divisible by any CPU-device count the harness forces (1/2/4/8): the
+    # mesh data axis absorbs every visible device
+    "batch_size": 8,
+    "seq_len": 32,
+    "learning_rate": 1e-3,
+    "platform": "cpu",
+    "parallelism": {"data": 1},
+    "data": {"kind": "synthetic-lm", "seed": 7},
+    # sync saves: the preemption must never catch a half-written async
+    # checkpoint in flight for this proof (prod uses async; Orbax's atomic
+    # rename protects it there too)
+    "checkpoint": {"save_interval_steps": 2, "max_to_keep": 2,
+                   "async_save": False},
+    "resources": False,
+}
+
+
+def _train_spec():
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "preemptee",
+        "termination": {"maxRetries": 1},
+        "component": {
+            "kind": "component",
+            "name": "train",
+            "run": {
+                "kind": "tpujob",
+                "accelerator": "v5e",
+                "topology": "2x2",  # one v5e host -> one pod
+                "runtime": dict(TRAIN_RUNTIME),
+            },
+        },
+    }).to_dict()
+
+
+class TestPreemptionResume:
+    def test_restart_resumes_from_checkpoint_with_loss_parity(self, tmp_path):
+        from polyaxon_tpu.api.app import run_artifacts_dir
+
+        store = Store(":memory:")
+        chaos = ChaosCluster(FakeCluster(str(tmp_path / ".cluster")),
+                             ChaosConfig(seed=0))
+        agent = LocalAgent(store, str(tmp_path), backend="cluster",
+                           cluster=chaos, poll_interval=0.05)
+        agent.start()
+        try:
+            run = store.create_run("p", spec=_train_spec(), name="preemptee")
+            uuid = run["uuid"]
+            ckpt_glob = os.path.join(
+                run_artifacts_dir(str(tmp_path), "p", uuid),
+                "outputs", "checkpoints", "*")
+
+            # wait for the first FINALIZED checkpoint of the first attempt
+            # (a pure-digit dir name; Orbax tmp dirs carry a suffix until
+            # the atomic finalize rename — preempting on one of those would
+            # legitimately resume from 0)
+            def _finalized():
+                return [d for d in glob.glob(ckpt_glob)
+                        if os.path.basename(d).isdigit()]
+
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                row = store.get_run(uuid)
+                assert row["status"] not in ("failed", "stopped"), \
+                    store.get_statuses(uuid)
+                if row["status"] == "succeeded":
+                    pytest.fail("run finished before the preemption landed — "
+                                "raise TRAIN_RUNTIME['steps']")
+                if _finalized():
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("no checkpoint appeared within 300s")
+
+            # ...then preempt the training pod (kill -9 the 'host')
+            victim = chaos.preempt()
+            assert victim is not None, "no running pod to preempt"
+
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline:
+                row = store.get_run(uuid)
+                if row["status"] in ("succeeded", "failed", "stopped"):
+                    break
+                time.sleep(0.1)
+            assert row["status"] == "succeeded", store.get_statuses(uuid)
+
+            types = [c["type"] for c in store.get_statuses(uuid)]
+            assert "retrying" in types, types
+
+            outputs = row["outputs"] or {}
+            # the restarted attempt resumed from a real checkpoint step —
+            # NOT from step 0
+            assert outputs.get("resumed_from_step", 0) > 0, outputs
+
+            # loss parity: an uninterrupted oracle with the same seed and
+            # config must land on the same final loss (the resumed data
+            # stream is fast-forwarded to the restored step)
+            oracle = self._oracle_loss(tmp_path / "oracle")
+            assert outputs["loss"] == pytest.approx(oracle, rel=1e-2), (
+                outputs["loss"], oracle)
+        finally:
+            agent.stop()
+
+    @staticmethod
+    def _oracle_loss(workdir) -> float:
+        """The fault-free reference: same runtime spec, run in-process."""
+        from polyaxon_tpu import tracking
+        from polyaxon_tpu.runtime.builtin import run_builtin
+
+        os.makedirs(workdir, exist_ok=True)
+        old_env = {k: os.environ.get(k) for k in
+                   ("PLX_RUN_UUID", "PLX_PROJECT", "PLX_ARTIFACTS_PATH")}
+        os.environ["PLX_RUN_UUID"] = "oracle"
+        os.environ["PLX_PROJECT"] = "p"
+        os.environ["PLX_ARTIFACTS_PATH"] = str(workdir)
+        try:
+            summary = run_builtin(dict(TRAIN_RUNTIME))
+            return summary["loss"]
+        finally:
+            tracking.end()
+            for k, v in old_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# 2. seeded chaos soak: DAG + matrix sweep vs the fault-free oracle
+# ---------------------------------------------------------------------------
+
+
+WRITE_OUT = (
+    "import json, os; "
+    "json.dump({'x': %s}, open(os.path.join("
+    "os.environ['PLX_ARTIFACTS_PATH'], 'outputs.json'), 'w'))"
+)
+
+
+def _job(cmd):
+    return {"kind": "component",
+            "run": {"kind": "job",
+                    "container": {"command": [sys.executable, "-c", cmd]}}}
+
+
+def _dag_spec():
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "soak-dag",
+        "component": {
+            "kind": "component",
+            "run": {
+                "kind": "dag",
+                "operations": [
+                    {"kind": "operation", "name": "prep",
+                     "termination": {"maxRetries": 3},
+                     "component": _job(WRITE_OUT % "13")},
+                    {"kind": "operation", "name": "consume",
+                     "termination": {"maxRetries": 3},
+                     "component": {
+                         "kind": "component",
+                         "inputs": [{"name": "seed", "type": "int"}],
+                         "run": {"kind": "job", "container": {"command": [
+                             sys.executable, "-c",
+                             "import json, os; "
+                             "seed = int(json.loads(os.environ['PLX_PARAMS'])['seed']); "
+                             "json.dump({'x': seed * 2}, open(os.path.join("
+                             "os.environ['PLX_ARTIFACTS_PATH'], 'outputs.json'), 'w'))",
+                         ]}},
+                     },
+                     "params": {"seed": {"ref": "ops.prep",
+                                         "value": "outputs.x"}}},
+                    {"kind": "operation", "name": "tail",
+                     "termination": {"maxRetries": 3},
+                     "component": _job(WRITE_OUT % "1"),
+                     "dependencies": ["prep"]},
+                ],
+            },
+        },
+    }).to_dict()
+
+
+def _sweep_spec():
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "soak-sweep",
+        "termination": {"maxRetries": 3},
+        "matrix": {
+            "kind": "grid",
+            "concurrency": 2,
+            "params": {"x": {"kind": "choice", "value": [1, 2, 3]}},
+        },
+        "component": {
+            "kind": "component",
+            "inputs": [{"name": "x", "type": "int"}],
+            "run": {"kind": "job", "container": {"command": [
+                sys.executable, "-c",
+                "import json, os; "
+                "x = int(json.loads(os.environ['PLX_PARAMS'])['x']); "
+                "json.dump({'loss': float(x)}, open(os.path.join("
+                "os.environ['PLX_ARTIFACTS_PATH'], 'outputs.json'), 'w'))",
+            ]}},
+        },
+    }).to_dict()
+
+
+def _drive_soak(tmp_path, chaos_cfg=None, client_faults=False, timeout=420):
+    """Stand up API server (+ optional flaky HTTP) + agent (+ optional
+    ChaosCluster), drive the DAG and the sweep through the CLIENT, and
+    return {run name: terminal status} for every run in the store."""
+    from polyaxon_tpu.api.server import ApiServer
+
+    middlewares = []
+    if client_faults:
+        middlewares.append(flaky_http_middleware(
+            seed=77, fault_rate=0.25, max_faults=40))
+    srv = ApiServer(artifacts_root=str(tmp_path / "a"), port=0,
+                    extra_middlewares=middlewares).start()
+    cluster = FakeCluster(str(tmp_path / ".cluster"))
+    if chaos_cfg is not None:
+        cluster = ChaosCluster(cluster, chaos_cfg)
+    agent = LocalAgent(srv.store, str(tmp_path / "a"), backend="cluster",
+                       cluster=cluster, poll_interval=0.05)
+    agent.start()
+    try:
+        client = RunClient(host=srv.url, project="p", retry=FAST_RETRY)
+        created = [client.create(spec=_dag_spec(), name="soak-dag"),
+                   client.create(spec=_sweep_spec(), name="soak-sweep")]
+        for c in created:
+            client.wait(c["uuid"], timeout=timeout, poll=0.2)
+        out = {}
+        for row in srv.store.list_runs(limit=500):
+            out[row["name"]] = row["status"]
+        return out, cluster, middlewares[0] if middlewares else None
+    finally:
+        agent.stop()
+        srv.stop()
+
+
+class TestChaosSoak:
+    def test_fault_schedule_converges_to_oracle_terminal_states(self, tmp_path):
+        oracle, _, _ = _drive_soak(tmp_path / "oracle")
+        assert oracle["soak-dag"] == "succeeded", oracle
+        assert oracle["soak-sweep"] == "succeeded", oracle
+
+        chaotic, cluster, chaos_mw = _drive_soak(
+            tmp_path / "chaos",
+            chaos_cfg=ChaosConfig(seed=2024, api_fault_rate=0.08,
+                                  timeout_rate=0.02, max_api_faults=12,
+                                  preempt_rate=0.03, max_preemptions=2),
+            client_faults=True,
+        )
+        assert chaotic == oracle, {
+            "diff": {k: (oracle.get(k), chaotic.get(k))
+                     for k in set(oracle) | set(chaotic)
+                     if oracle.get(k) != chaotic.get(k)},
+            "injected_cluster": cluster.injected,
+            "injected_http": chaos_mw.injected if chaos_mw else None,
+        }
+        # the schedule genuinely fired on both layers
+        assert cluster.injected, "cluster chaos never fired"
+        assert chaos_mw.injected, "client-path chaos never fired"

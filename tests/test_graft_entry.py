@@ -30,13 +30,21 @@ def test_dryrun_multichip_in_process():
     graft.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_subprocess_reexec():
     """Simulate the driver: a process whose jax platform is NOT pre-forced
-    to n devices. dryrun_multichip must re-exec and still succeed."""
+    to n devices. dryrun_multichip must re-exec and still succeed.
+
+    Slow-marked: the re-exec pays a full from-scratch compile (~3 min) to
+    cover exactly the same dryrun the in-process test above runs — tier-1
+    keeps the in-process guard, `-m slow` runs this end-to-end variant."""
     code = (
         "import jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
-        "jax.config.update('jax_num_cpu_devices', 1)\n"  # driver sees 1 chip
+        "try:\n"
+        "    jax.config.update('jax_num_cpu_devices', 1)\n"  # driver sees 1 chip
+        "except AttributeError:\n"
+        "    pass\n"  # jax < 0.5 defaults to 1 CPU device anyway
         "import sys\n"
         f"sys.path.insert(0, {graft._REPO_DIR!r})\n"
         "import __graft_entry__\n"

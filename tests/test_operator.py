@@ -101,7 +101,7 @@ def _pod(name, argv, env=None, labels=None, workdir=None):
 def test_fake_cluster_runs_pod(tmp_path):
     cluster = FakeCluster(str(tmp_path))
     cluster.apply(_pod("p1", [sys.executable, "-c", "print('hello pod')"]))
-    deadline = time.monotonic() + 30
+    deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
         st = cluster.pod_statuses({"app.polyaxon.com/run": "r1"})
         if st[0].phase == PodPhase.SUCCEEDED:
@@ -123,7 +123,7 @@ def test_fake_cluster_dns_rewrite(tmp_path):
         [sys.executable, "-c", "import os; print(os.environ['PLX_COORDINATOR_ADDRESS'])"],
         env={"PLX_COORDINATOR_ADDRESS": "plx-abc-0.plx-abc-hosts:8476"},
     ))
-    deadline = time.monotonic() + 30
+    deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
         if cluster.pod_statuses({"app.polyaxon.com/run": "r1"})[0].phase == PodPhase.SUCCEEDED:
             break
@@ -150,7 +150,10 @@ class _Recorder:
         return [s for u, s, _ in self.events if u == uuid]
 
 
-def _wait(pred, timeout=30.0, tick=None):
+def _wait(pred, timeout=120.0, tick=None):
+    # load-tolerant bound (ISSUE 1 de-flake): the predicates are
+    # event-driven — a quiet box exits in well under a second; the wide
+    # deadline only matters when CI contention starves subprocess spawns
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if tick:

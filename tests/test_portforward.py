@@ -40,7 +40,8 @@ def _service_spec(port):
     }
 
 
-def _wait_service_meta(store, uuid, timeout=30):
+def _wait_service_meta(store, uuid, timeout=90):
+    # event-driven wait under a load-tolerant ceiling (ISSUE 1 de-flake)
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         run = store.get_run(uuid)
@@ -53,7 +54,7 @@ def _wait_service_meta(store, uuid, timeout=30):
     raise AssertionError("service never reached running with an endpoint")
 
 
-def _wait_http(url, timeout=15):
+def _wait_http(url, timeout=60):
     deadline = time.monotonic() + timeout
     last = None
     while time.monotonic() < deadline:
@@ -79,7 +80,7 @@ def test_port_forward_service_run(tmp_path, backend):
         uuid = store.create_run("p", spec=_service_spec(port),
                                 name="svc")["uuid"]
         svc = _wait_service_meta(store, uuid)
-        assert svc == {"host": "127.0.0.1", "port": port}
+        assert svc == {"host": "127.0.0.1", "port": port, "ports": [port]}
         local_port, stop_proxy = start_tcp_proxy(svc["host"], svc["port"])
         assert local_port != port
         r = _wait_http(f"http://127.0.0.1:{local_port}/")
@@ -209,6 +210,77 @@ def test_portforward_restricts_to_declared_ports(tmp_path):
             timeout=5)
         assert r.status_code == 403
         assert "declared" in r.json()["error"]
+    finally:
+        srv.stop()
+
+
+def test_portforward_non_numeric_port_is_400(tmp_path):
+    """?port=abc must be a client error, not a 500 (ISSUE 1 satellite)."""
+    from polyaxon_tpu.api.server import ApiServer
+
+    srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+    try:
+        run = srv.store.create_run("p", spec=_service_spec(8080), name="s")
+        srv.store.update_run(
+            run["uuid"], meta={"service": {"host": "127.0.0.1", "port": 8080}})
+        r = requests.get(
+            srv.url + f"/api/v1/p/runs/{run['uuid']}/portforward?port=abc",
+            timeout=5)
+        assert r.status_code == 400
+        assert "invalid port" in r.json()["error"]
+    finally:
+        srv.stop()
+
+
+def test_portforward_ignores_spec_declared_ports(tmp_path):
+    """Only AGENT-STAMPED ports open the bridge: a port present in the
+    (client-supplied) spec but not stamped by the agent is refused — the
+    SSRF fix's core property."""
+    from polyaxon_tpu.api.server import ApiServer
+
+    srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+    try:
+        spec = _service_spec(8080)
+        spec["component"]["run"]["ports"] = [8080, 22]  # 22 never stamped
+        run = srv.store.create_run("p", spec=spec, name="s")
+        srv.store.update_run(
+            run["uuid"],
+            meta={"service": {"host": "127.0.0.1", "port": 8080,
+                              "ports": [8080]}})
+        r = requests.get(
+            srv.url + f"/api/v1/p/runs/{run['uuid']}/portforward?port=22",
+            timeout=5)
+        assert r.status_code == 403
+    finally:
+        srv.stop()
+
+
+def test_create_and_restart_strip_client_service_meta(tmp_path):
+    """meta['service'] is agent-only: a client smuggling one at create (or
+    inheriting a stale one through restart) must not get a bridge target."""
+    from polyaxon_tpu.api.server import ApiServer
+
+    srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+    try:
+        r = requests.post(
+            srv.url + "/api/v1/p/runs",
+            json={"spec": {"kind": "operation"}, "name": "evil",
+                  "meta": {"service": {"host": "169.254.169.254", "port": 80},
+                           "note": "kept"}},
+            timeout=5)
+        assert r.status_code == 201
+        run = r.json()
+        assert (run.get("meta") or {}).get("service") is None
+        assert run["meta"]["note"] == "kept"  # only `service` is stripped
+        # agent-stamped endpoint on the original must not survive a restart
+        srv.store.update_run(
+            run["uuid"], meta={"service": {"host": "127.0.0.1", "port": 8080},
+                               "note": "kept"})
+        r2 = requests.post(
+            srv.url + f"/api/v1/p/runs/{run['uuid']}/restart", timeout=5)
+        assert r2.status_code == 201
+        clone = r2.json()
+        assert (clone.get("meta") or {}).get("service") is None
     finally:
         srv.stop()
 

@@ -1,0 +1,637 @@
+"""Resilience layer (ISSUE 1 tentpole): RetryPolicy classification/backoff,
+KubeCluster/Client transparently surviving injected 5xx/429/timeout bursts,
+deterministic ChaosCluster fault injection through the reconciler, run
+heartbeats + the agent-side zombie reaper, and a fast fixed-seed chaos
+smoke (matrix sweep under faults == fault-free oracle). The slow soak and
+the mid-training preemption→resume proof live in test_chaos_soak.py."""
+
+import json
+import random
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import requests
+
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.client import ApiError, RunClient
+from polyaxon_tpu.operator import (
+    FakeCluster, KubeApiError, KubeCluster, OperationCR, OperationReconciler,
+)
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.resilience import (
+    ChaosCluster, ChaosConfig, FaultyStore, RetryPolicy, ZombieReaper,
+    flaky_http_middleware,
+)
+from polyaxon_tpu.scheduler.agent import LocalAgent
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_retries_transient_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise KubeApiError(503, "busy")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=5, base_delay=0.001, deadline=5.0)
+        assert policy.call(flaky, sleep=lambda _t: None) == "ok"
+        assert len(calls) == 3
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise KubeApiError(404, "nope")
+
+        policy = RetryPolicy(max_attempts=5, base_delay=0.001)
+        with pytest.raises(KubeApiError):
+            policy.call(bad, sleep=lambda _t: None)
+        assert len(calls) == 1
+
+    def test_budget_exhaustion_raises_last_error(self):
+        calls = []
+
+        def always_busy():
+            calls.append(1)
+            raise ApiError(503, "still busy")
+
+        policy = RetryPolicy(max_attempts=3, base_delay=0.001, deadline=5.0)
+        with pytest.raises(ApiError) as ei:
+            policy.call(always_busy, sleep=lambda _t: None)
+        assert ei.value.status == 503
+        assert len(calls) == 3
+
+    def test_deadline_budget_caps_attempts(self):
+        policy = RetryPolicy(max_attempts=100, base_delay=10.0,
+                             max_delay=10.0, deadline=0.5)
+        calls = []
+
+        def busy():
+            calls.append(1)
+            raise TimeoutError("slow")
+
+        with pytest.raises(TimeoutError):
+            policy.call(busy, sleep=lambda _t: None)
+        # first delay alone (10s) blows the 0.5s budget: no second attempt
+        assert len(calls) == 1
+
+    def test_retry_after_overrides_backoff(self):
+        policy = RetryPolicy(base_delay=100.0, max_delay=200.0, jitter=0.0)
+        exc = ApiError(429, "later", retry_after=0.25)
+        assert policy.delay(0, exc=exc) == 0.25
+
+    def test_jitter_deterministic_under_seed(self):
+        policy = RetryPolicy(base_delay=1.0, jitter=0.5)
+        a = [policy.delay(i, rng=random.Random(7)) for i in range(4)]
+        b = [policy.delay(i, rng=random.Random(7)) for i in range(4)]
+        assert a == b
+        assert a != [policy.delay(i, rng=random.Random(8)) for i in range(4)]
+
+    def test_classifies_connection_errors(self):
+        policy = RetryPolicy()
+        assert policy.is_retryable(ConnectionResetError("reset"))
+        assert policy.is_retryable(TimeoutError("slow"))
+        assert policy.is_retryable(requests.exceptions.ConnectionError("down"))
+        assert not policy.is_retryable(FileNotFoundError("gone"))
+        assert not policy.is_retryable(ValueError("bad"))
+
+
+# ---------------------------------------------------------------------------
+# KubeCluster survives injected API weather
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedKube:
+    """HTTP server replying from a mutable script of (status, body[, hdrs])."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _respond(self):
+                outer.requests.append((self.command, self.path))
+                status, body, *rest = (outer.script.pop(0)
+                                       if outer.script else (200, {}))
+                payload = json.dumps(body).encode()
+                self.send_response(status)
+                for k, v in (rest[0] if rest else {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_DELETE = _respond
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def shutdown(self):
+        self.httpd.shutdown()
+
+
+class TestKubeClusterRetry:
+    def _cluster(self, srv, **kw):
+        return KubeCluster(host=srv.url, token="t", namespace="ns",
+                           retry=RetryPolicy(max_attempts=5, base_delay=0.01,
+                                             max_delay=0.05, deadline=5.0),
+                           **kw)
+
+    def test_survives_5xx_and_429_burst(self):
+        srv = _ScriptedKube([
+            (503, {"message": "apiserver hiccup"}),
+            (429, {"message": "slow down"}, {"Retry-After": "0"}),
+            (500, {"message": "internal"}),
+            (200, {"items": [{"metadata": {"name": "p"},
+                              "status": {"phase": "Running"}}]}),
+        ])
+        try:
+            pods = self._cluster(srv).pod_statuses({"app": "x"})
+            assert [p.name for p in pods] == ["p"]
+            assert len(srv.requests) == 4  # three faults ridden out
+        finally:
+            srv.shutdown()
+
+    def test_non_retryable_status_is_immediate(self):
+        srv = _ScriptedKube([(404, {"message": "nope"})])
+        try:
+            with pytest.raises(KubeApiError) as ei:
+                self._cluster(srv)._request("GET", "/api/v1/whatever")
+            assert ei.value.status == 404
+            assert len(srv.requests) == 1  # no retry burned on a 404
+        finally:
+            srv.shutdown()
+
+    def test_connection_refused_retries_then_raises(self):
+        import urllib.error
+
+        cluster = KubeCluster(
+            host="http://127.0.0.1:1",  # nothing listens on port 1
+            token="t", namespace="ns",
+            retry=RetryPolicy(max_attempts=2, base_delay=0.01, deadline=2.0))
+        with pytest.raises((urllib.error.URLError, OSError)):
+            cluster.pod_statuses({"a": "b"})
+
+
+# ---------------------------------------------------------------------------
+# Client path: flaky HTTP middleware + FaultyStore
+# ---------------------------------------------------------------------------
+
+
+FAST_RETRY = RetryPolicy(max_attempts=6, base_delay=0.02, max_delay=0.1,
+                         deadline=10.0)
+
+
+class TestClientRetry:
+    def test_client_survives_injected_http_faults(self, tmp_path):
+        from polyaxon_tpu.api.server import ApiServer
+
+        chaos = flaky_http_middleware(seed=5, fault_rate=0.5, max_faults=8)
+        srv = ApiServer(artifacts_root=str(tmp_path), port=0,
+                        extra_middlewares=[chaos]).start()
+        try:
+            client = RunClient(host=srv.url, project="p", retry=FAST_RETRY)
+            run = client.create(spec={"kind": "operation"}, name="r1")
+            for _ in range(10):
+                client.refresh()
+                client.get_statuses()
+            assert client.refresh()["uuid"] == run["uuid"]
+            assert chaos.injected, "fault schedule never fired"
+        finally:
+            srv.stop()
+
+    def test_client_survives_faulty_store_500s(self, tmp_path):
+        from polyaxon_tpu.api.server import ApiServer
+
+        store = FaultyStore(Store(":memory:"), seed=3, fault_rate=0.4,
+                            max_faults=6)
+        srv = ApiServer(artifacts_root=str(tmp_path), port=0,
+                        store=store).start()
+        try:
+            client = RunClient(host=srv.url, project="p", retry=FAST_RETRY)
+            run = client.create(spec={"kind": "operation"}, name="r1")
+            for _ in range(10):
+                client.refresh()
+            assert client.refresh()["uuid"] == run["uuid"]
+            assert store.injected, "store faults never fired"
+        finally:
+            srv.stop()
+
+    def test_no_retry_on_4xx(self, tmp_path):
+        from polyaxon_tpu.api.server import ApiServer
+
+        srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+        try:
+            client = RunClient(host=srv.url, project="p", retry=FAST_RETRY)
+            t0 = time.monotonic()
+            with pytest.raises(ApiError) as ei:
+                client.refresh("no-such-uuid")
+            assert ei.value.status == 404
+            assert time.monotonic() - t0 < 2.0  # no backoff burned
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# ChaosCluster: deterministic fault injection
+# ---------------------------------------------------------------------------
+
+
+def _pod(name, argv, labels):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "labels": labels},
+        "spec": {"containers": [{"name": "main", "image": "python:3.12",
+                                 "command": argv}]},
+    }
+
+
+class TestChaosCluster:
+    def test_api_faults_deterministic_and_bounded(self, tmp_path):
+        chaos = ChaosCluster(FakeCluster(str(tmp_path)), ChaosConfig(
+            seed=1, api_fault_rate=1.0, max_api_faults=2))
+        manifest = _pod("p1", [sys.executable, "-c", "pass"], {"r": "x"})
+        with pytest.raises(KubeApiError):
+            chaos.apply(manifest)
+        with pytest.raises(KubeApiError):
+            chaos.apply(manifest)
+        chaos.apply(manifest)  # fault budget spent: the verb goes through
+        assert len(chaos.injected) == 2
+        assert chaos.inner.pods  # pod really exists now
+        chaos.shutdown()
+
+    def test_same_seed_same_schedule(self, tmp_path):
+        def schedule(seed):
+            chaos = ChaosCluster(FakeCluster(str(tmp_path / str(seed))),
+                                 ChaosConfig(seed=seed, api_fault_rate=0.5,
+                                             max_api_faults=100))
+            out = []
+            for _ in range(20):
+                try:
+                    chaos.pod_statuses({"a": "b"})
+                    out.append("ok")
+                except (KubeApiError, TimeoutError) as e:
+                    out.append(type(e).__name__ + str(getattr(e, "status", "")))
+            return out
+
+        assert schedule(42) == schedule(42)
+
+    def test_targeted_preempt_fails_pod_without_deleting_it(self, tmp_path):
+        cluster = FakeCluster(str(tmp_path))
+        chaos = ChaosCluster(cluster, ChaosConfig(seed=0))
+        chaos.apply(_pod("victim", [sys.executable, "-c",
+                                    "import time; time.sleep(60)"],
+                         {"r": "x"}))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            sts = cluster.pod_statuses({"r": "x"})
+            if sts and sts[0].phase == "Running":
+                break
+            time.sleep(0.05)
+        assert chaos.preempt("victim") == "victim"
+        sts = cluster.pod_statuses({"r": "x"})
+        assert len(sts) == 1  # still listed — preemption, not deletion
+        assert sts[0].phase == "Failed"
+        assert ("preempt", "victim") in chaos.injected
+        cluster.shutdown()
+
+    def test_watch_event_drops(self):
+        class _WatchableStub:
+            pods = {}
+
+            def apply(self, m):
+                pass
+
+            def delete(self, *a):
+                pass
+
+            def delete_selected(self, *a):
+                pass
+
+            def pod_statuses(self, *a):
+                return []
+
+            def pod_logs(self, *a):
+                return ""
+
+            def watch_pods(self, selector, on_event, stop_event=None):
+                from polyaxon_tpu.operator.cluster import PodPhase, PodStatus
+
+                for i in range(40):
+                    on_event("MODIFIED", PodStatus(f"p{i}", PodPhase.RUNNING))
+
+        chaos = ChaosCluster(_WatchableStub(), ChaosConfig(
+            seed=9, watch_drop_rate=0.5))
+        seen = []
+        chaos.watch_pods({"a": None}, lambda t, s: seen.append(s.name))
+        dropped = [d for d in chaos.injected if d[0] == "watch-drop"]
+        assert dropped and seen
+        assert len(seen) + len(dropped) == 40
+
+
+# ---------------------------------------------------------------------------
+# Reconciler rides through chaos
+# ---------------------------------------------------------------------------
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, uuid, status, message):
+        self.events.append((uuid, status, message))
+
+    def statuses(self, uuid):
+        return [s for u, s, _ in self.events if u == uuid]
+
+
+def _drive(rec, pred, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        rec.reconcile_once()
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestReconcilerUnderChaos:
+    def test_apply_faults_ridden_out_by_reconciler_retry(self, tmp_path):
+        chaos = ChaosCluster(FakeCluster(str(tmp_path)), ChaosConfig(
+            seed=2, api_fault_rate=0.6, max_api_faults=4))
+        events = _Recorder()
+        r = OperationReconciler(chaos, on_status=events,
+                                retry=RetryPolicy(max_attempts=8,
+                                                  base_delay=0.01,
+                                                  max_delay=0.05,
+                                                  deadline=10.0))
+        r.apply(OperationCR(run_uuid="u1", resources=[
+            _pod("c1", [sys.executable, "-c", "pass"],
+                 {"app.polyaxon.com/run": "u1"}),
+        ]))
+        assert _drive(r, lambda: r.final_status("u1") == "succeeded")
+        assert chaos.injected, "chaos never fired"
+
+    def test_preemption_consumes_backoff_then_succeeds(self, tmp_path):
+        cluster = FakeCluster(str(tmp_path))
+        chaos = ChaosCluster(cluster, ChaosConfig(seed=0))
+        events = _Recorder()
+        r = OperationReconciler(chaos, on_status=events)
+        # the pod finishes by touching a file the SECOND time around: the
+        # first (preempted) attempt leaves a marker, the retry sees it and
+        # exits 0 — so success REQUIRES the all-or-nothing restart
+        marker = tmp_path / "attempt.marker"
+        script = (
+            "import os, sys, time\n"
+            f"m = {str(marker)!r}\n"
+            "if os.path.exists(m):\n"
+            "    sys.exit(0)\n"
+            "open(m, 'w').close()\n"
+            "time.sleep(120)\n"
+        )
+        r.apply(OperationCR(run_uuid="u2", backoff_limit=1, resources=[
+            _pod("t1", [sys.executable, "-c", script],
+                 {"app.polyaxon.com/run": "u2"}),
+        ]))
+        assert _drive(r, lambda: marker.exists() and any(
+            s.phase == "Running" for s in cluster.pod_statuses(
+                {"app.polyaxon.com/run": "u2"})))
+        assert chaos.preempt() is not None
+        assert _drive(r, lambda: r.final_status("u2") == "succeeded")
+        assert "retrying" in events.statuses("u2")
+        cluster.shutdown()
+
+    def test_vanished_pods_route_through_restart(self, tmp_path):
+        """The lost-slice kernel arm: pods deleted wholesale out from under
+        a running op burn a retry instead of waiting forever."""
+        cluster = FakeCluster(str(tmp_path))
+        events = _Recorder()
+        r = OperationReconciler(cluster, on_status=events)
+        marker = tmp_path / "second.marker"
+        script = (
+            "import os, sys, time\n"
+            f"m = {str(marker)!r}\n"
+            "if os.path.exists(m):\n"
+            "    sys.exit(0)\n"
+            "open(m, 'w').close()\n"
+            "time.sleep(120)\n"
+        )
+        r.apply(OperationCR(run_uuid="u3", backoff_limit=1, resources=[
+            _pod("v1", [sys.executable, "-c", script],
+                 {"app.polyaxon.com/run": "u3"}),
+        ]))
+        assert _drive(r, lambda: marker.exists() and any(
+            s.phase == "Running" for s in cluster.pod_statuses(
+                {"app.polyaxon.com/run": "u3"})))
+        # node GC / external delete: the whole pod set vanishes
+        cluster.delete("Pod", "v1")
+        assert _drive(r, lambda: r.final_status("u3") == "succeeded")
+        assert "retrying" in events.statuses("u3")
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats + zombie reaper
+# ---------------------------------------------------------------------------
+
+
+def _force_running(store, uuid):
+    store.transition(uuid, "running", force=True)
+
+
+class TestZombieReaper:
+    def _zombie_run(self, store, max_retries=None):
+        spec = {"kind": "operation",
+                "component": {"kind": "component",
+                              "run": {"kind": "job", "container": {
+                                  "command": [sys.executable, "-c", "pass"]}}}}
+        if max_retries is not None:
+            spec["termination"] = {"maxRetries": max_retries}
+        run = store.create_run("p", spec=spec, name="z")
+        _force_running(store, run["uuid"])
+        return run["uuid"]
+
+    def test_reaps_stale_run_into_retrying(self):
+        store = Store(":memory:")
+        uuid = self._zombie_run(store, max_retries=1)
+        reaper = ZombieReaper(store, owned=set, zombie_after=0.05)
+        time.sleep(0.1)
+        assert reaper.pass_once() == [(uuid, "retried")]
+        run = store.get_run(uuid)
+        assert run["status"] == "queued"
+        types = [c["type"] for c in store.get_statuses(uuid)]
+        assert "retrying" in types
+
+    def test_reaps_to_failed_without_budget(self):
+        store = Store(":memory:")
+        uuid = self._zombie_run(store)  # no termination -> budget 0
+        reaper = ZombieReaper(store, owned=set, zombie_after=0.05)
+        time.sleep(0.1)
+        assert reaper.pass_once() == [(uuid, "failed")]
+        conds = store.get_statuses(uuid)
+        assert conds[-1]["type"] == "failed"
+        assert conds[-1]["reason"] == "ZombieReaped"
+
+    def test_owned_runs_get_lease_renewed_not_reaped(self):
+        store = Store(":memory:")
+        uuid = self._zombie_run(store, max_retries=1)
+        reaper = ZombieReaper(store, owned=lambda: {uuid}, zombie_after=0.05)
+        time.sleep(0.1)
+        assert reaper.pass_once() == []
+        assert store.get_run(uuid)["heartbeat_at"] is not None
+        assert store.get_run(uuid)["status"] == "running"
+
+    def test_fresh_heartbeat_defers_reaping(self):
+        store = Store(":memory:")
+        uuid = self._zombie_run(store, max_retries=1)
+        store.heartbeat(uuid)
+        reaper = ZombieReaper(store, owned=set, zombie_after=3600.0)
+        assert reaper.pass_once() == []
+
+    def test_agent_requeues_and_reruns_zombie(self, tmp_path):
+        """E2E: a run stuck in `running` with no driver gets routed through
+        retrying -> queued and then ACTUALLY re-executes to success."""
+        store = Store(":memory:")
+        agent = LocalAgent(store, str(tmp_path), poll_interval=0.05,
+                           zombie_after=0.2)
+        out = tmp_path / "done.txt"
+        spec = check_polyaxonfile({
+            "kind": "operation",
+            "name": "lazarus",
+            "termination": {"maxRetries": 1},
+            "component": {"kind": "component", "run": {
+                "kind": "job",
+                "container": {"command": [
+                    sys.executable, "-c",
+                    f"open({str(out)!r}, 'w').write('ran')"]},
+            }},
+        }).to_dict()
+        run = store.create_run("p", spec=spec, name="lazarus")
+        _force_running(store, run["uuid"])
+        time.sleep(0.3)
+        deadline = time.monotonic() + 120
+        try:
+            while time.monotonic() < deadline:
+                agent.tick()
+                row = store.get_run(run["uuid"])
+                if row["status"] in ("succeeded", "failed", "stopped"):
+                    break
+                time.sleep(0.05)
+            assert row["status"] == "succeeded", store.get_statuses(run["uuid"])
+            assert out.read_text() == "ran"
+            types = [c["type"] for c in store.get_statuses(run["uuid"])]
+            assert "retrying" in types
+        finally:
+            agent.stop()
+
+    def test_heartbeat_rest_endpoint(self, tmp_path):
+        from polyaxon_tpu.api.server import ApiServer
+
+        srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+        try:
+            client = RunClient(host=srv.url, project="p", retry=FAST_RETRY)
+            client.create(spec={"kind": "operation"}, name="hb")
+            assert client.heartbeat()["ok"] is True
+            assert client.refresh()["heartbeat_at"] is not None
+            with pytest.raises(ApiError) as ei:
+                client.heartbeat("missing-uuid")
+            assert ei.value.status == 404
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fast fixed-seed chaos smoke (tier-1): sweep under faults == oracle
+# ---------------------------------------------------------------------------
+
+
+def _sweep_spec():
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "smoke-sweep",
+        "termination": {"maxRetries": 2},
+        "matrix": {
+            "kind": "grid",
+            "concurrency": 2,
+            "params": {"x": {"kind": "choice", "value": [1, 2]}},
+        },
+        "component": {
+            "kind": "component",
+            "inputs": [{"name": "x", "type": "int"}],
+            "run": {
+                "kind": "job",
+                "container": {"command": [
+                    sys.executable, "-c",
+                    "import json, os; "
+                    "x = int(json.loads(os.environ['PLX_PARAMS'])['x']); "
+                    "json.dump({'loss': x}, open(os.path.join("
+                    "os.environ['PLX_ARTIFACTS_PATH'], 'outputs.json'), 'w'))",
+                ]},
+            },
+        },
+    }).to_dict()
+
+
+def _terminal_states(store, pipeline_uuid):
+    out = {}
+    row = store.get_run(pipeline_uuid)
+    out[row["name"]] = row["status"]
+    for child in store.list_runs(pipeline_uuid=pipeline_uuid, limit=200):
+        out[child["name"]] = child["status"]
+    return out
+
+
+def _run_sweep(tmp_path, chaos_cfg=None, timeout=180):
+    store = Store(":memory:")
+    cluster = FakeCluster(str(tmp_path / ".cluster"))
+    if chaos_cfg is not None:
+        cluster = ChaosCluster(cluster, chaos_cfg)
+    agent = LocalAgent(store, str(tmp_path), backend="cluster",
+                       cluster=cluster, poll_interval=0.05)
+    agent.start()
+    try:
+        run = store.create_run("p", spec=_sweep_spec(), name="smoke-sweep")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            row = store.get_run(run["uuid"])
+            if row["status"] in ("succeeded", "failed", "stopped"):
+                break
+            time.sleep(0.05)
+        return _terminal_states(store, run["uuid"]), cluster
+    finally:
+        agent.stop()
+
+
+class TestChaosSmoke:
+    def test_seeded_fault_schedule_matches_oracle(self, tmp_path):
+        oracle, _ = _run_sweep(tmp_path / "oracle")
+        assert oracle["smoke-sweep"] == "succeeded", oracle
+        chaotic, cluster = _run_sweep(
+            tmp_path / "chaos",
+            ChaosConfig(seed=1234, api_fault_rate=0.1, timeout_rate=0.02,
+                        max_api_faults=8, preempt_rate=0.02,
+                        max_preemptions=1),
+        )
+        assert chaotic == oracle, (chaotic, cluster.injected)
+        assert cluster.injected, "fault schedule never fired"
