@@ -107,8 +107,13 @@ class ApiApp:
         if row is None:
             return _json({"error": "unauthorized"}, status=401)
         # run ownership (SURVEY.md:104 RBAC-lite): the token identity
-        # stamps created_by on runs created through this request
-        request["identity"] = row.get("label") or f"token-{row['id']}"
+        # stamps created_by on runs created through this request. Derived
+        # from the STABLE token id — labels are user-chosen and non-unique,
+        # so two tokens labelled "ci" must not share an identity (ADVICE
+        # r5); the label rides along for display.
+        label = row.get("label")
+        request["identity"] = (
+            f"{label}#{row['id']}" if label else f"token-{row['id']}")
         if row["project"] is None:
             return await handler(request)  # minted admin token
         # project-scoped: only that project's routes; token admin and

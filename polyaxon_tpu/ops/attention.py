@@ -58,16 +58,27 @@ def attention(
     impl: str = "auto",
     block_q: int = 512,
     block_k: int = 512,
+    block_q_bwd: Optional[int] = None,
+    block_k_bwd: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Single-device attention entry point.
 
     ``impl``: 'flash' (pallas kernel), 'dense' (XLA), or 'auto' — flash on
-    TPU when block-divisible, dense otherwise.
+    TPU when block-divisible, dense otherwise. ``block_q_bwd``/``block_k_bwd``
+    retune the backward kernels independently (None = fwd blocks).
     """
     b, h, s, d = q.shape
     if impl == "auto":
-        divisible = s % min(block_q, s) == 0 and k.shape[2] % min(block_k, k.shape[2]) == 0
+        sk = k.shape[2]
+        # the bwd kernels run at their own (possibly retuned) blocks — a
+        # shape only the fwd blocks divide must fall back to dense, not
+        # assert mid-backward
+        divisible = all(
+            dim % min(blk, dim) == 0
+            for dim, blk in ((s, block_q), (sk, block_k),
+                             (s, block_q_bwd or block_q),
+                             (sk, block_k_bwd or block_k)))
         impl = "flash" if divisible and s >= 128 else "dense"
     if impl == "dense":
         return dense_attention(q, k, v, causal=causal, sm_scale=sm_scale)
@@ -81,6 +92,8 @@ def attention(
         sm_scale=sm_scale,
         block_q=block_q,
         block_k=block_k,
+        block_q_bwd=block_q_bwd,
+        block_k_bwd=block_k_bwd,
         interpret=interpret,
     )
     return o.reshape(b, h, s, d)

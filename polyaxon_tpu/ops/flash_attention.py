@@ -8,11 +8,23 @@ Design (TPU grid-accumulation pattern, see /opt/skills/guides/pallas_guide.md):
 - grid = (batch*heads, q_blocks, kv_blocks); the last grid dim executes
   sequentially on a core, so VMEM scratch (acc/m/l) carries the online
   softmax state across kv steps and the output is written on the last step.
-- position offsets (``q_offset``/``k_offset``, SMEM scalars) shift the causal
-  mask so the same kernel serves ring attention, where each step attends to a
-  KV chunk from a different global position (ops/ring_attention.py).
-- fully-masked kv blocks are skipped with ``pl.when`` (saves MXU work; the
-  DMA still lands — acceptable round-1 cost).
+- position offsets (``q_offset``/``k_offset``, scalar-prefetch SMEM values)
+  shift the causal mask so the same kernel serves ring attention, where each
+  step attends to a KV chunk from a different global position
+  (ops/ring_attention.py).
+- fully-masked kv blocks are skipped with ``pl.when`` (MXU work) AND their
+  HBM→VMEM DMA is elided (round 6, VERDICT r5 #2): the kernels run under a
+  ``PrefetchScalarGridSpec`` whose index maps clamp the streamed block index
+  to the causal extent — ``min(s, last_valid(j))`` for KV blocks in fwd/dq,
+  ``max(j, first_valid(s))`` for Q blocks in dkv. Pallas's pipeline emitter
+  skips the copy whenever consecutive grid steps map to the same block, so
+  a masked step costs a scalar-unit iteration, not HBM bandwidth. At causal
+  seq==kv this halves attention HBM traffic; the offsets feed the clamp
+  through scalar prefetch so ring steps get the same skip.
+- asymmetric ``block_q``/``block_k`` are first-class, and the backward
+  kernels take their own ``block_q_bwd``/``block_k_bwd`` (dq/dkv want
+  different aspect ratios than the fwd at long sequence now that the row
+  stats are compact; defaults fall back to the fwd blocks).
 - compute is f32 regardless of input dtype; outputs cast back. LSE is saved
   for the backward pass.
 
@@ -23,6 +35,7 @@ q blocks. ``delta = rowsum(do * o)`` is precomputed in XLA.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -32,9 +45,28 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_MASK_VALUE = -1e30
 
+# A/B switch for the masked-block DMA elision (and an escape hatch should a
+# toolchain lower the clamped index maps badly): PLX_FLASH_DMA_SKIP=0
+# restores the round-5 behavior — compute skipped, every block's DMA lands.
+# Read at import; perf_exp A/B runs set it per-process.
+_DMA_SKIP = os.environ.get("PLX_FLASH_DMA_SKIP", "1") != "0"
+
 
 def _causal_mask(s, q_ids, k_ids):
     return jnp.where(q_ids[:, None] >= k_ids[None, :], s, DEFAULT_MASK_VALUE)
+
+
+def _kv_clamp(j, s, qo_ref, ko_ref, *, block_q, block_k, num_k):
+    """Last causally-visible kv block for q block ``j``; masked steps map
+    here so their DMA is elided (same block index as the previous step)."""
+    last = (qo_ref[0] + (j + 1) * block_q - 1 - ko_ref[0]) // block_k
+    return jnp.minimum(s, jnp.clip(last, 0, num_k - 1))
+
+
+def _q_clamp(j, s, qo_ref, ko_ref, *, block_q, block_k, num_q):
+    """First q block that causally sees kv block ``s`` (dkv sweep)."""
+    first = (ko_ref[0] + s * block_k - qo_ref[0]) // block_q
+    return jnp.maximum(j, jnp.clip(first, 0, num_q - 1))
 
 
 # ---------------------------------------------------------------------------
@@ -43,7 +75,7 @@ def _causal_mask(s, q_ids, k_ids):
 
 
 def _fwd_kernel(
-    qo_ref, ko_ref,  # SMEM scalars: [1] int32 global position offsets
+    qo_ref, ko_ref,  # scalar prefetch: [1] int32 global position offsets
     q_ref, k_ref, v_ref,  # VMEM blocks
     o_ref, lse_ref,  # outputs
     acc_ref, m_ref, l_ref,  # VMEM scratch, persists across kv grid steps
@@ -64,7 +96,8 @@ def _fwd_kernel(
     k_ids = k_off + s * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_k,), 0)
 
     # Skip blocks entirely above the causal diagonal (scalar predicate only:
-    # vector-element extraction has no TPU lowering).
+    # vector-element extraction has no TPU lowering). The index maps clamp
+    # the same blocks' DMA, so a skipped step does no HBM traffic either.
     run = jnp.logical_or(
         not causal, q_off + (j + 1) * block_q - 1 >= k_off + s * block_k
     )
@@ -132,29 +165,37 @@ def _flash_fwd(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, num_k=num_k,
     )
-    out_shape = [
-        jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),  # lse, compact
-    ]
-    o, lse = pl.pallas_call(
-        kernel,
+    if causal and _DMA_SKIP:
+        clamp = functools.partial(
+            _kv_clamp, block_q=block_q, block_k=block_k, num_k=num_k)
+        kv_map = lambda i, j, s, qo, ko: (i, clamp(j, s, qo, ko), 0)
+    else:
+        kv_map = lambda i, j, s, qo, ko: (i, s, 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
         grid=grid,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, d), lambda i, j, s: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, s: (i, s, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, s: (i, s, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, s, qo, ko: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, s: (i, j, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j, s: (i, 0, j)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, s, qo, ko: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, s, qo, ko: (i, 0, j)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),  # lse, compact
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
     )(qo, ko, q, k, v)
@@ -295,6 +336,7 @@ def _flash_bwd(
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
     num_q, num_k = sq // block_q, sk // block_k
 
     lse_c, delta_c = row_stats if row_stats is not None else bwd_row_stats(o, lse, do)
@@ -304,43 +346,63 @@ def _flash_bwd(
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
     ko = jnp.asarray(k_offset, jnp.int32).reshape(1)
 
-    scalar_specs = [
-        pl.BlockSpec(memory_space=pltpu.SMEM),
-        pl.BlockSpec(memory_space=pltpu.SMEM),
-    ]
-    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, s: (i, j, 0))
-    kv_spec_dq = pl.BlockSpec((1, block_k, d), lambda i, j, s: (i, s, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q), lambda i, j, s: (i, 0, j))
+    # dq: grid (bh, q_blocks, kv_blocks) — kv is the sequential dim. Masked
+    # kv steps clamp to the diagonal block so their k/v DMA is elided.
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, s, qo, ko: (i, j, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda i, j, s, qo, ko: (i, 0, j))
+    if causal and _DMA_SKIP:
+        kv_clamp = functools.partial(
+            _kv_clamp, block_q=block_q, block_k=block_k, num_k=num_k)
+        kv_map_dq = lambda i, j, s, qo, ko: (i, kv_clamp(j, s, qo, ko), 0)
+    else:
+        kv_map_dq = lambda i, j, s, qo, ko: (i, s, 0)
+    kv_spec_dq = pl.BlockSpec((1, block_k, d), kv_map_dq)
 
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_k=num_k,
         ),
-        grid=(bh, num_q, num_k),
-        in_specs=scalar_specs + [q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
-        out_specs=q_spec,
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, num_q, num_k),
+            in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         interpret=interpret,
     )(qo, ko, q, k, v, do, lse_r, delta_r)
 
-    # dkv: grid (bh, kv_blocks, q_blocks) — q is the sequential dim
-    q_spec2 = pl.BlockSpec((1, block_q, d), lambda i, s, j: (i, j, 0))
-    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda i, s, j: (i, s, 0))
-    row_spec2 = pl.BlockSpec((1, 1, block_q), lambda i, s, j: (i, 0, j))
+    # dkv: grid (bh, kv_blocks, q_blocks) — q is the sequential dim. Steps
+    # before the diagonal clamp to the first visible q block, eliding the
+    # q/do/row-stat DMAs for the causal-dead prefix.
+    if causal and _DMA_SKIP:
+        q_clamp = functools.partial(
+            _q_clamp, block_q=block_q, block_k=block_k, num_q=num_q)
+        q_map2 = lambda i, s, j, qo, ko: (i, q_clamp(j, s, qo, ko), 0)
+        row_map2 = lambda i, s, j, qo, ko: (i, 0, q_clamp(j, s, qo, ko))
+    else:
+        q_map2 = lambda i, s, j, qo, ko: (i, j, 0)
+        row_map2 = lambda i, s, j, qo, ko: (i, 0, j)
+    q_spec2 = pl.BlockSpec((1, block_q, d), q_map2)
+    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda i, s, j, qo, ko: (i, s, 0))
+    row_spec2 = pl.BlockSpec((1, 1, block_q), row_map2)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q=num_q,
         ),
-        grid=(bh, num_k, num_q),
-        in_specs=scalar_specs + [q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
-        out_specs=[kv_spec2, kv_spec2],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, num_k, num_q),
+            in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+            out_specs=[kv_spec2, kv_spec2],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
@@ -356,7 +418,8 @@ def _flash_bwd(
 
 
 @functools.lru_cache(maxsize=64)
-def _make_flash(sm_scale, causal, block_q, block_k, interpret):
+def _make_flash(sm_scale, causal, block_q, block_k, block_q_bwd, block_k_bwd,
+                interpret):
     @jax.custom_vjp
     def flash(q, k, v, q_offset, k_offset):
         o, _ = _flash_fwd(
@@ -379,7 +442,7 @@ def _make_flash(sm_scale, causal, block_q, block_k, interpret):
         dq, dk, dv = _flash_bwd(
             q, k, v, o, lse, do, q_offset, k_offset,
             sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, interpret=interpret,
+            block_q=block_q_bwd, block_k=block_k_bwd, interpret=interpret,
         )
         return dq, dk, dv, None, None
 
@@ -396,6 +459,8 @@ def flash_attention_bhsd(
     k_offset=0,
     block_q: int = 512,
     block_k: int = 512,
+    block_q_bwd: Optional[int] = None,
+    block_k_bwd: Optional[int] = None,
     interpret: Optional[bool] = None,
     return_lse: bool = False,
 ):
@@ -404,6 +469,11 @@ def flash_attention_bhsd(
     ``q_offset``/``k_offset`` are *global* sequence positions of element 0 of
     the q/k chunks — the causal mask compares global positions, which is what
     ring attention needs. May be traced scalars.
+
+    ``block_q_bwd``/``block_k_bwd`` retune the dq/dkv kernels independently
+    of the forward (None = inherit the fwd blocks): at long sequence the
+    backward's two extra matmul operands per step shift the VMEM-optimal
+    aspect ratio.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -415,5 +485,8 @@ def flash_attention_bhsd(
             sm_scale=float(sm_scale), causal=causal,
             block_q=block_q, block_k=block_k, interpret=interpret,
         )
-    fn = _make_flash(float(sm_scale), causal, block_q, block_k, interpret)
+    fn = _make_flash(
+        float(sm_scale), causal, block_q, block_k,
+        block_q_bwd or block_q, block_k_bwd or block_k, interpret,
+    )
     return fn(q, k, v, q_offset, k_offset)

@@ -78,6 +78,16 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
             # "capacity" (default) | "a2a" (explicit all-to-all over the
             # expert axis) | "dense" (parity oracle)
             overrides["moe_dispatch"] = spec["moe_dispatch"]
+        if spec.get("moe_cap_block") is not None:
+            # stream the capacity dispatch per cap-chunk (models/
+            # transformer.py _moe_capacity_streamed); 0 = one-shot
+            overrides["moe_cap_block"] = int(spec["moe_cap_block"])
+        for knob in ("attn_block_q", "attn_block_k",
+                     "attn_block_q_bwd", "attn_block_k_bwd"):
+            # flash kernel block shapes (fwd + independently-retuned bwd) —
+            # the measured single-chip recipes pin these (BASELINE.md)
+            if spec.get(knob) is not None:
+                overrides[knob] = int(spec[knob])
         if spec.get("pp_microbatches") is not None:
             overrides["pp_microbatches"] = int(spec["pp_microbatches"])
         if spec.get("pp_remat_ticks") is not None:

@@ -64,7 +64,11 @@ class EventFileWriter:
         self._flush()
 
     def _flush(self) -> None:
-        for f in self._files.values():
+        # snapshot: flush() runs on the CALLER's thread while _loop may be
+        # opening a first-event file — iterating the live dict races
+        # ("dictionary changed size during iteration", seen in the profile
+        # e2e under load)
+        for f in list(self._files.values()):
             f.flush()
 
     def flush(self, timeout: float = 10.0) -> None:
@@ -82,7 +86,7 @@ class EventFileWriter:
         self._closed = True
         self._q.put(_SENTINEL)
         self._thread.join(timeout=10)
-        for f in self._files.values():
+        for f in list(self._files.values()):
             f.close()
         self._files.clear()
 

@@ -59,6 +59,14 @@ def main() -> None:
         mcfg = replace(mcfg, attn_block_q=int(sys.argv[sys.argv.index("--bq") + 1]))
     if "--bk" in sys.argv:
         mcfg = replace(mcfg, attn_block_k=int(sys.argv[sys.argv.index("--bk") + 1]))
+    if "--bq-bwd" in sys.argv:
+        # retune the dq/dkv kernels independently of the fwd (round 6)
+        mcfg = replace(mcfg, attn_block_q_bwd=int(sys.argv[sys.argv.index("--bq-bwd") + 1]))
+    if "--bk-bwd" in sys.argv:
+        mcfg = replace(mcfg, attn_block_k_bwd=int(sys.argv[sys.argv.index("--bk-bwd") + 1]))
+    if "--cap-block" in sys.argv:
+        # stream the MoE capacity dispatch per cap-chunk (round 6)
+        mcfg = replace(mcfg, moe_cap_block=int(sys.argv[sys.argv.index("--cap-block") + 1]))
     n = len(jax.devices())
     cfg = TrainerConfig(
         model=mcfg,

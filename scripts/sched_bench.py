@@ -1,0 +1,145 @@
+"""Scheduler-latency microbench (VERDICT r5 weak #8).
+
+Upstream Polyaxon's only published performance axis is scheduler/agent
+latency; this measures ours: queue N no-op runs against a live LocalAgent
+and report per-run **time-to-running** (create -> "running" transition)
+p50/p95, total wall time, and completed runs/min — in both agent drive
+modes:
+
+- ``wake``: the normal product path — store transitions feed the agent's
+  change feed and wake its loop immediately (event-driven).
+- ``poll``: the change feed detached (``use_change_feed=False``) — the
+  agent only acts on its ``poll_interval`` timer with full-table scans,
+  the strawman a watch-less deployment would run.
+
+Usage:
+    python scripts/sched_bench.py [N] [--mode wake|poll|both]
+        [--poll-interval SEC] [--max-parallel M] [--out PATH]
+
+Prints ONE JSON line (and optionally writes it to --out). Importable:
+``run_bench(...)`` returns the same dict — the tier-1 smoke
+(tests/test_sched_bench.py) runs a small N through it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+
+NOOP_SPEC = {
+    "kind": "operation",
+    "component": {
+        "kind": "component",
+        "name": "sched-bench-noop",
+        "run": {"kind": "job", "container": {"command": ["true"]}},
+    },
+}
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return float("nan")
+    vs = sorted(values)
+    idx = min(int(round(q * (len(vs) - 1))), len(vs) - 1)
+    return vs[idx]
+
+
+def run_mode(n: int, mode: str, poll_interval: float, max_parallel: int,
+             timeout: float = 300.0) -> dict:
+    from polyaxon_tpu.api.store import Store
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    workdir = tempfile.mkdtemp(prefix=f"sched_bench_{mode}_")
+    store = Store(":memory:")
+    created: dict[str, float] = {}
+    running: dict[str, float] = {}
+    done: dict[str, float] = {}
+
+    def _listener(uuid: str, status: str) -> None:
+        now = time.monotonic()
+        if status == "running":
+            running.setdefault(uuid, now)
+        elif status in ("succeeded", "failed", "stopped"):
+            done.setdefault(uuid, now)
+
+    store.add_transition_listener(_listener)
+    agent = LocalAgent(
+        store, workdir, backend="local", max_parallel=max_parallel,
+        poll_interval=poll_interval,
+        use_change_feed=(mode == "wake"),
+    )
+    agent.start()
+    t0 = time.monotonic()
+    try:
+        for i in range(n):
+            uuid = store.create_run(
+                project="bench", name=f"noop-{i}", spec=NOOP_SPEC)["uuid"]
+            created[uuid] = time.monotonic()
+        deadline = time.monotonic() + timeout
+        while len(done) < n and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        agent.stop()
+    wall = time.monotonic() - t0
+
+    ttr = [running[u] - created[u] for u in created if u in running]
+    failed = sum(
+        1 for u in created
+        if (store.get_run(u) or {}).get("status") != "succeeded")
+    return {
+        "mode": mode,
+        "runs": n,
+        "completed": len(done),
+        "failed": failed,
+        "poll_interval_s": poll_interval,
+        "max_parallel": max_parallel,
+        "time_to_running_p50_s": round(_percentile(ttr, 0.50), 4),
+        "time_to_running_p95_s": round(_percentile(ttr, 0.95), 4),
+        "time_to_running_mean_s": round(statistics.fmean(ttr), 4) if ttr else None,
+        "wall_s": round(wall, 3),
+        "runs_per_min": round(len(done) / wall * 60.0, 1) if wall > 0 else None,
+    }
+
+
+def run_bench(n: int = 100, mode: str = "both", poll_interval: float = 0.2,
+              max_parallel: int = 8) -> dict:
+    modes = ["wake", "poll"] if mode == "both" else [mode]
+    return {
+        "metric": "scheduler_time_to_running",
+        "results": [run_mode(n, m, poll_interval, max_parallel) for m in modes],
+    }
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if args else 100
+    mode = "both"
+    if "--mode" in sys.argv:
+        mode = sys.argv[sys.argv.index("--mode") + 1]
+        if mode not in ("wake", "poll", "both"):
+            raise SystemExit(f"--mode takes wake|poll|both, got {mode!r}")
+    poll_interval = 0.2
+    if "--poll-interval" in sys.argv:
+        poll_interval = float(sys.argv[sys.argv.index("--poll-interval") + 1])
+    max_parallel = 8
+    if "--max-parallel" in sys.argv:
+        max_parallel = int(sys.argv[sys.argv.index("--max-parallel") + 1])
+
+    out = run_bench(n, mode, poll_interval, max_parallel)
+    line = json.dumps(out)
+    if "--out" in sys.argv:
+        path = sys.argv[sys.argv.index("--out") + 1]
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    print(line)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main()

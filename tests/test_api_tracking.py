@@ -204,24 +204,33 @@ class TestApiAuth:
             run = rc.create(spec={"kind": "operation"}, name="ok")
             assert rc.refresh(run["uuid"])["status"] == "created"
             # ownership (SURVEY.md:104): created_by is derived server-side
-            # from the token identity and filterable end to end
-            assert run["created_by"] == "ci"
+            # from the STABLE token id (label rides along for display) —
+            # two tokens minted with the same label must not share an
+            # identity (ADVICE r5)
+            ci_ident = f"ci#{scoped['id']}"
+            assert run["created_by"] == ci_ident
+            twin = srv.store.create_token(project="alpha", label="ci")
+            twin_rc = RunClient(srv.url, project="alpha",
+                                auth_token=twin["token"])
+            twin_run = twin_rc.create(spec={"kind": "operation"}, name="t")
+            assert twin_run["created_by"] == f"ci#{twin['id']}"
+            assert twin_run["created_by"] != ci_ident
             admin_rc = RunClient(srv.url, project="alpha",
                                  auth_token=admin["token"])
             admin_run = admin_rc.create(spec={"kind": "operation"}, name="a")
-            assert admin_run["created_by"] == "admin"
-            mine = rc.list(created_by="ci")
+            assert admin_run["created_by"] == f"admin#{admin['id']}"
+            mine = rc.list(created_by=ci_ident)
             assert [r_["uuid"] for r_ in mine] == [run["uuid"]]
-            assert len(rc.list()) == 2
+            assert len(rc.list()) == 3
             # clones keep an owner (the restarter's), and pipeline children
             # inherit their parent's — ownership filtering must not lose
             # restarted runs or split a pipeline from its stages
             clone = rc.restart(run["uuid"])
-            assert clone["created_by"] == "ci"
+            assert clone["created_by"] == ci_ident
             child = srv.store.create_run(
                 "alpha", spec={"kind": "operation"}, name="stage-1",
                 pipeline_uuid=run["uuid"])
-            assert child["created_by"] == "ci"
+            assert child["created_by"] == ci_ident
             # cross-project access: 403, and no data
             try:
                 RunClient(srv.url, project="beta",
@@ -253,7 +262,7 @@ class TestApiAuth:
             r = requests.get(f"{srv.url}/api/v1/tokens", timeout=5,
                              headers={"Authorization":
                                       f"Bearer {admin['token']}"})
-            assert r.status_code == 200 and len(r.json()) == 2
+            assert r.status_code == 200 and len(r.json()) == 3
             # revocation kills the scoped key
             srv.store.revoke_token(scoped["id"])
             try:

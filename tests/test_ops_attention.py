@@ -75,6 +75,115 @@ class TestFlashForward:
         assert np.all(np.isinf(np.asarray(lse)))
 
 
+class TestFlashBlockShapes:
+    """Round 6 (VERDICT r5 #2): the scalar-prefetch index maps that elide
+    masked-block DMAs must be numerically invisible — parity vs the dense
+    reference across asymmetric fwd blocks, independently-retuned bwd
+    blocks, odd block counts (ragged diagonal), GQA, and traced offsets."""
+
+    @pytest.mark.parametrize("bq,bk", [(128, 64), (64, 128), (128, 128)])
+    def test_asymmetric_blocks_fwd_and_grads(self, bq, bk):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(20), s=256)
+        out = attention(q, k, v, causal=True, impl="flash", block_q=bq, block_k=bk)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+        gf = jax.grad(
+            lambda q, k, v: (attention(q, k, v, causal=True, impl="flash",
+                                       block_q=bq, block_k=bk) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: (dense_attention(q, k, v, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-4)
+
+    def test_bwd_blocks_retuned_independently(self):
+        """block_q_bwd/block_k_bwd reshape ONLY the dq/dkv kernels; grads
+        must match both the dense oracle and the inherit-fwd-blocks path."""
+        q, k, v = _rand_qkv(jax.random.PRNGKey(21), s=256)
+
+        def loss(q, k, v, **kw):
+            return (attention(q, k, v, causal=True, impl="flash", **kw) ** 2).sum()
+
+        g_tuned = jax.grad(loss, argnums=(0, 1, 2))(
+            q, k, v, block_q=128, block_k=128, block_q_bwd=64, block_k_bwd=128)
+        g_plain = jax.grad(loss, argnums=(0, 1, 2))(
+            q, k, v, block_q=128, block_k=128)
+        g_dense = jax.grad(
+            lambda q, k, v: (dense_attention(q, k, v, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b_, c in zip(g_tuned, g_plain, g_dense):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-4)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=5e-5, rtol=5e-4)
+
+    def test_masked_skip_odd_blocks_gqa(self):
+        """Ragged causal diagonal (384/64 = 6 blocks, asymmetric 128/64
+        tiles) + GQA: every (q-block, kv-block) pair above the diagonal is
+        both compute-skipped and DMA-clamped; fwd AND grads must survive."""
+        q, k, v = _rand_qkv(jax.random.PRNGKey(22), s=384, h=4, kv_heads=2)
+        out = attention(q, k, v, causal=True, impl="flash", block_q=128, block_k=64)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+        gf = jax.grad(
+            lambda q, k, v: (attention(q, k, v, causal=True, impl="flash",
+                                       block_q=128, block_k=64,
+                                       block_q_bwd=64, block_k_bwd=128) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: (dense_attention(q, k, v, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-4)
+
+    def test_auto_falls_back_to_dense_on_nondividing_bwd_blocks(self):
+        """impl='auto' must consult the BWD blocks too: a shape only the
+        fwd blocks divide has to take the dense path, not assert inside
+        jax.grad (code-review r6 finding)."""
+        q, k, v = _rand_qkv(jax.random.PRNGKey(24), s=256)
+        # 256 % 96 != 0 -> dense fallback; grads must just work
+        g = jax.grad(
+            lambda q, k, v: (attention(q, k, v, causal=True, impl="auto",
+                                       block_q=128, block_k=128,
+                                       block_q_bwd=96) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(
+            lambda q, k, v: (dense_attention(q, k, v, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=5e-5, rtol=5e-4)
+
+    def test_traced_offsets_clamp_under_jit(self):
+        """Ring-style traced q/k offsets flow through scalar prefetch into
+        the clamped index maps: the same jitted kernel must serve a
+        fully-visible chunk, a partially-masked chunk, and a fully-masked
+        chunk (offsets are runtime values, one compilation)."""
+        q, k, v = _rand_qkv(jax.random.PRNGKey(23), s=128)
+        b, h, s, d = q.shape
+        qf = q.reshape(b * h, s, d)
+        kf = k.reshape(b * h, s, d)
+        vf = v.reshape(b * h, s, d)
+
+        @functools.partial(jax.jit, static_argnames=())
+        def flash(qo, ko):
+            return flash_attention_bhsd(
+                qf, kf, vf, causal=True, q_offset=qo, k_offset=ko,
+                block_q=64, block_k=64)
+
+        # fully visible: keys strictly in the past
+        np.testing.assert_allclose(
+            np.asarray(flash(jnp.int32(s), jnp.int32(0))),
+            np.asarray(dense_attention(q, k, v, causal=False)).reshape(b * h, s, d),
+            atol=2e-5, rtol=2e-5)
+        # aligned diagonal chunk
+        np.testing.assert_allclose(
+            np.asarray(flash(jnp.int32(0), jnp.int32(0))),
+            np.asarray(dense_attention(q, k, v, causal=True)).reshape(b * h, s, d),
+            atol=2e-5, rtol=2e-5)
+        # keys strictly in the future: exact zeros
+        assert np.all(np.asarray(flash(jnp.int32(0), jnp.int32(s))) == 0)
+
+
 class TestFlashBackward:
     def test_grads_match_dense(self):
         q, k, v = _rand_qkv(jax.random.PRNGKey(5), s=256)

@@ -132,6 +132,40 @@ class TestGpipeTrunk:
                     err_msg=str(axes)),
                 results["auto"][1], results["none"][1])
 
+    def test_bubble_tick_emits_exact_zeros_with_bias(self):
+        """ADVICE r5: the dense-path MLP bias add used to sit OUTSIDE the
+        gated segment, so an inactive tick emitted `bo` instead of zeros —
+        harmless only because the schedule never consumes bubble outputs.
+        The invariant must not be load-bearing: with nonzero biases, an
+        inactive tick's layer output and aux must be exactly zero."""
+        from dataclasses import replace as _replace
+
+        cfg = _replace(llama.LLAMA_TINY, use_bias=True, norm="ln",
+                       act="gelu", pos="none", num_layers=1)
+        params = transformer.init(jax.random.PRNGKey(0), cfg)
+        lp = jax.tree.map(lambda x: x[0], params["layers"])
+        # biases init to zero — make them bite
+        lp["mlp"]["bo"] = jnp.ones_like(lp["mlp"]["bo"])
+        lp["mlp"]["bi"] = jnp.ones_like(lp["mlp"]["bi"])
+        lp["attn"]["bo"] = jnp.ones_like(lp["attn"]["bo"])
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, cfg.hidden),
+                              cfg.dtype)
+        out, aux = transformer._layer_body(
+            x, lp, cfg, None, None, True,
+            inner=transformer.InnerAxes(), active=jnp.asarray(False))
+        assert np.all(np.asarray(out) == 0), np.abs(np.asarray(out)).max()
+        assert np.all(np.asarray(aux) == 0)
+        # and an active tick is unchanged from the ungated body
+        out_a, _ = transformer._layer_body(
+            x, lp, cfg, None, None, True,
+            inner=transformer.InnerAxes(), active=jnp.asarray(True))
+        out_ref, _ = transformer._layer_body(
+            x, lp, cfg, None, None, True,
+            inner=transformer.InnerAxes(), active=None)
+        np.testing.assert_allclose(
+            np.asarray(out_a).astype(np.float32),
+            np.asarray(out_ref).astype(np.float32), rtol=1e-6)
+
     def test_full_gate_rejected_with_collectives(self):
         """pp_gate='full' on a TP body would deadlock/corrupt collective
         rendezvous — it must be rejected loudly."""
