@@ -7,7 +7,8 @@ Endpoints (all JSON unless noted):
     GET|POST /api/v1/projects
     GET  /api/v1/projects/{project}
     POST /api/v1/{project}/runs                     create (operation spec body)
-    GET  /api/v1/{project}/runs                     list (?status=&limit=&offset=)
+    GET  /api/v1/{project}/runs                     list (?status=&limit=&offset=;
+                                                    ?paged/?cursor/?since -> envelope)
     GET|DELETE /api/v1/{project}/runs/{uuid}
     POST /api/v1/{project}/runs/{uuid}/statuses     {status, reason?, message?}
     GET  /api/v1/{project}/runs/{uuid}/statuses
@@ -290,16 +291,71 @@ class ApiApp:
         return _json(run, 201)
 
     async def list_runs(self, request):
-        """List runs (?status=&limit=&offset=)."""
+        """List runs (?status=&limit=&offset=; ?paged=1 / ?cursor= /
+        ?since= return {results, count, next_cursor, server_time})."""
         q = request.rel_url.query
-        return _json(self.store.list_runs(
+        filters = dict(
             project=request.match_info["project"],
             status=q.get("status"),
             pipeline_uuid=q.get("pipeline_uuid"),
             created_by=q.get("created_by"),
-            limit=int(q.get("limit", 100)),
-            offset=int(q.get("offset", 0)),
-        ))
+        )
+        limit = int(q.get("limit", 100))
+        since, cursor = q.get("since"), q.get("cursor")
+        paged = q.get("paged") not in (None, "", "0")
+        if since is None and cursor is None and not paged:
+            # legacy shape: a bare JSON list
+            return _json(self.store.list_runs(
+                **filters, limit=limit, offset=int(q.get("offset", 0))))
+        # envelope shape (VERDICT r5 weak #7): keyset pagination means a
+        # deep page is O(page), and ?since= lets pollers fetch only the
+        # rows that changed — O(delta) instead of O(all-runs) every 4s.
+        if since is not None and cursor is not None:
+            # ambiguous: a delta poll with a stale cursor attached would
+            # consume rows but get no resume token back
+            return _json({"error": "cursor and since are mutually "
+                                   "exclusive"}, status=400)
+        if since is not None and not since.lstrip("-").isdigit():
+            return _json({"error": f"invalid since token {since!r} "
+                                   "(expected a change_seq int)"}, status=400)
+        # bootstrap token: the latest COMMITTED change_seq, read BEFORE the
+        # SELECT — an in-flight writer's bump is invisible until its
+        # commit, so its rows always sort after this token and the next
+        # delta poll delivers them (loss-free, at worst a duplicate)
+        server_time = str(self.store.current_seq())
+        # fetch one extra row to learn whether a further page exists —
+        # an exactly-full last page must not hand out a dangling cursor
+        rows = self.store.list_runs(
+            **filters, limit=limit + 1, cursor=cursor, since=since)
+        has_more = len(rows) > limit
+        rows = rows[:limit]
+        next_cursor = None
+        if since is None and has_more:
+            next_cursor = self.store.run_cursor(rows[-1])
+        if since is not None:
+            # delta polls resume exactly after the LAST DELIVERED row (a
+            # truncated page walks the remainder instead of losing it);
+            # an empty delta echoes the caller's token back unchanged
+            server_time = (self.store.since_token(rows[-1]) if rows
+                           else since)
+        if cursor is not None:
+            # continuation pages: no bootstrap token (a run created during
+            # a multi-page DESC walk never appears on LATER pages, so only
+            # the FIRST page's token is a loss-free since bootstrap) and
+            # no COUNT(*) re-scan (the total is identical across the walk;
+            # the first page already carried it)
+            server_time = None
+        return _json({
+            "results": rows,
+            # the COUNT(*) is for pagination UIs; delta polls and cursor
+            # continuations don't need it and must stay O(delta)/O(page)
+            "count": (self.store.count_runs(**filters)
+                      if since is None and cursor is None else None),
+            "next_cursor": next_cursor,
+            # clients echo this back as the next ?since= — an opaque
+            # commit-ordered token, no clock-skew games
+            "server_time": server_time,
+        })
 
     def _run(self, request) -> Optional[dict]:
         return self.store.get_run(request.match_info["uuid"])

@@ -40,10 +40,25 @@ CREATE TABLE IF NOT EXISTS runs (
     updated_at TEXT NOT NULL,
     started_at TEXT,
     finished_at TEXT,
-    heartbeat_at TEXT
+    heartbeat_at TEXT,
+    change_seq INTEGER
 );
+-- monotone change counter: bumped INSIDE every write transaction (the
+-- UPDATE takes SQLite's single-writer lock, so seq order == commit
+-- order), which is what makes ?since= incremental fetches loss-free —
+-- wall-clock timestamps can be stamped before a competing commit lands
+CREATE TABLE IF NOT EXISTS counters (
+    k TEXT PRIMARY KEY,
+    v INTEGER NOT NULL
+);
+INSERT OR IGNORE INTO counters (k, v) VALUES ('change_seq', 0);
 CREATE INDEX IF NOT EXISTS idx_runs_project ON runs (project, created_at);
 CREATE INDEX IF NOT EXISTS idx_runs_status ON runs (status);
+-- queue pops: the agent lists one status ordered by created_at (FIFO);
+-- without the composite index SQLite picks idx_runs_status then sorts
+CREATE INDEX IF NOT EXISTS idx_runs_status_created ON runs (status, created_at);
+-- (idx_runs_change_seq is created post-migration in __init__: on a
+-- pre-r7 db the column does not exist yet when this script runs)
 CREATE INDEX IF NOT EXISTS idx_runs_pipeline ON runs (pipeline_uuid);
 CREATE TABLE IF NOT EXISTS status_conditions (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -85,10 +100,16 @@ class Store:
         # atomic across the agent/executor/API threads)
         self._transition_lock = threading.Lock()
         self._transition_listeners: list = []
+        # cheap observability for scheduling-complexity tests and perf
+        # triage: transactions opened + run rows deserialized. A dirty
+        # scheduling pass must stay O(dirty) on both (tests/test_runtime_
+        # agent.py asserts it), so the counters are part of the contract.
+        self.stats = {"transactions": 0, "runs_deserialized": 0}
         self._memory_conn: Optional[sqlite3.Connection] = None
         if path == ":memory:":
             # a single shared connection (serialized by a lock)
             self._memory_conn = sqlite3.connect(":memory:", check_same_thread=False)
+            self._memory_conn.execute("PRAGMA busy_timeout=10000")
             self._memory_lock = threading.Lock()
         with self._conn_ctx() as conn:
             conn.executescript(_SCHEMA)
@@ -99,6 +120,17 @@ class Store:
                 conn.execute("ALTER TABLE runs ADD COLUMN created_by TEXT")
             if "heartbeat_at" not in cols:
                 conn.execute("ALTER TABLE runs ADD COLUMN heartbeat_at TEXT")
+            if "change_seq" not in cols:
+                # pre-r7: backfill in rowid (≈ insertion) order and point
+                # the counter past the backfill
+                conn.execute("ALTER TABLE runs ADD COLUMN change_seq INTEGER")
+                conn.execute("UPDATE runs SET change_seq=rowid")
+                conn.execute(
+                    "UPDATE counters SET v=COALESCE("
+                    "(SELECT MAX(change_seq) FROM runs), 0) "
+                    "WHERE k='change_seq'")
+            conn.execute("CREATE INDEX IF NOT EXISTS idx_runs_change_seq "
+                         "ON runs (change_seq)")
 
     # -- connection plumbing ----------------------------------------------
 
@@ -107,6 +139,7 @@ class Store:
 
         class _Ctx:
             def __enter__(self):
+                store.stats["transactions"] += 1
                 if store._memory_conn is not None:
                     store._memory_lock.acquire()
                     return store._memory_conn
@@ -115,17 +148,30 @@ class Store:
                     conn = sqlite3.connect(store.path, timeout=30)
                     conn.execute("PRAGMA journal_mode=WAL")
                     conn.execute("PRAGMA synchronous=NORMAL")
+                    # don't fail instantly on a writer collision across
+                    # processes (WAL allows one writer): wait it out
+                    conn.execute("PRAGMA busy_timeout=10000")
                     store._local.conn = conn
                 return conn
 
             def __exit__(self, et, ev, tb):
+                # rollback on error, ALWAYS: python sqlite3 leaves the
+                # implicit transaction open otherwise — a half-applied
+                # write would hold the writer lock and get silently flushed
+                # by the next unrelated commit on this connection
                 if store._memory_conn is not None:
-                    if et is None:
-                        store._memory_conn.commit()
-                    store._memory_lock.release()
+                    try:
+                        if et is None:
+                            store._memory_conn.commit()
+                        else:
+                            store._memory_conn.rollback()
+                    finally:
+                        store._memory_lock.release()
                 else:
                     if et is None:
                         store._local.conn.commit()
+                    else:
+                        store._local.conn.rollback()
 
         return _Ctx()
 
@@ -229,11 +275,30 @@ class Store:
         "uuid", "project", "name", "kind", "status", "spec", "compiled",
         "inputs", "outputs", "meta", "tags", "original_uuid", "cloning_kind",
         "pipeline_uuid", "created_by", "created_at", "updated_at",
-        "started_at", "finished_at", "heartbeat_at",
+        "started_at", "finished_at", "heartbeat_at", "change_seq",
     )
     _JSON_COLS = {"spec", "compiled", "inputs", "outputs", "meta", "tags"}
 
+    def _bump_seq(self, conn, n: int = 1) -> int:
+        """Advance the change counter by ``n`` inside the CURRENT write
+        transaction and return the new top value. The UPDATE acquires
+        SQLite's single-writer lock, so assigned seqs are strictly ordered
+        with commit order — the property ?since= needs to never lose a
+        row (a wall-clock stamp can predate a competing commit)."""
+        conn.execute("UPDATE counters SET v=v+? WHERE k='change_seq'", (n,))
+        return conn.execute(
+            "SELECT v FROM counters WHERE k='change_seq'").fetchone()[0]
+
+    def current_seq(self) -> int:
+        """Latest committed change_seq (snapshot-consistent bootstrap token
+        for incremental fetches: an in-flight writer's bump is invisible
+        until its commit, so its rows always land AFTER this value)."""
+        with self._conn_ctx() as conn:
+            return conn.execute(
+                "SELECT v FROM counters WHERE k='change_seq'").fetchone()[0]
+
     def _row_to_run(self, row) -> dict:
+        self.stats["runs_deserialized"] += 1
         d = dict(zip(self._RUN_COLS, row))
         for c in self._JSON_COLS:
             d[c] = json.loads(d[c]) if d[c] else None
@@ -271,51 +336,99 @@ class Store:
         pipeline_uuid: Optional[str] = None,
         created_by: Optional[str] = None,
     ) -> dict:
+        return self.create_runs(project, [dict(
+            spec=spec, name=name, kind=kind, inputs=inputs, meta=meta,
+            tags=tags, uuid=uuid, original_uuid=original_uuid,
+            cloning_kind=cloning_kind, pipeline_uuid=pipeline_uuid,
+            created_by=created_by,
+        )])[0]
+
+    def create_runs(self, project: str, runs: list[dict]) -> list[dict]:
+        """Create many runs in ONE transaction (DAG/matrix fan-out: a
+        16-wide suggestion batch is one commit, not 32). Each entry takes
+        the same keyword fields as ``create_run``. Listeners fire after the
+        commit, once per run, in order."""
         self.create_project(project)
-        if inputs is None and spec:
-            # one place for every creation path (CLI, client, server, DAG
-            # and schedule children, tuner trials pass explicit inputs)
-            inputs = self._params_to_inputs(spec)
-        if created_by is None and pipeline_uuid:
-            # pipeline children (DAG stages, sweep trials, schedule runs)
-            # inherit their parent's owner — ownership filtering must not
-            # split a user's pipeline from its stages (review r5)
-            parent = self.get_run(pipeline_uuid)
-            if parent:
-                created_by = parent.get("created_by")
-        run_uuid = uuid or uuid_mod.uuid4().hex
-        now = _now()
+        rows, conds = [], []
+        uuids: list[str] = []
+        parents: dict[str, Optional[dict]] = {}  # one lookup per batch
+        for r in runs:
+            spec = r.get("spec")
+            inputs = r.get("inputs")
+            if inputs is None and spec:
+                # one place for every creation path (CLI, client, server, DAG
+                # and schedule children, tuner trials pass explicit inputs)
+                inputs = self._params_to_inputs(spec)
+            created_by = r.get("created_by")
+            if created_by is None and r.get("pipeline_uuid"):
+                # pipeline children (DAG stages, sweep trials, schedule runs)
+                # inherit their parent's owner — ownership filtering must not
+                # split a user's pipeline from its stages (review r5)
+                puid = r["pipeline_uuid"]
+                if puid not in parents:
+                    parents[puid] = self.get_run(puid)
+                if parents[puid]:
+                    created_by = parents[puid].get("created_by")
+            run_uuid = r.get("uuid") or uuid_mod.uuid4().hex
+            uuids.append(run_uuid)
+            rows.append((
+                run_uuid, project, r.get("name"), r.get("kind"),
+                V1Statuses.CREATED.value,
+                json.dumps(spec) if spec else None,
+                json.dumps(inputs) if inputs else None,
+                json.dumps(r.get("meta")) if r.get("meta") else None,
+                json.dumps(r.get("tags")) if r.get("tags") else None,
+                r.get("original_uuid"), r.get("cloning_kind"),
+                r.get("pipeline_uuid"), created_by,
+            ))
+            conds.append((
+                run_uuid,
+                json.dumps(V1StatusCondition.get_condition(V1Statuses.CREATED).to_dict()),
+            ))
         with self._conn_ctx() as conn:
-            conn.execute(
-                "INSERT INTO runs (uuid, project, name, kind, status, spec, inputs, meta, tags,"
-                " original_uuid, cloning_kind, pipeline_uuid, created_by, created_at, updated_at)"
-                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                (
-                    run_uuid, project, name, kind, V1Statuses.CREATED.value,
-                    json.dumps(spec) if spec else None,
-                    json.dumps(inputs) if inputs else None,
-                    json.dumps(meta) if meta else None,
-                    json.dumps(tags) if tags else None,
-                    original_uuid, cloning_kind, pipeline_uuid, created_by,
-                    now, now,
-                ),
-            )
-            conn.execute(
-                "INSERT INTO status_conditions (run_uuid, condition, created_at) VALUES (?,?,?)",
-                (run_uuid,
-                 json.dumps(V1StatusCondition.get_condition(V1Statuses.CREATED).to_dict()),
-                 now),
-            )
+            try:
+                # timestamps + change seqs assigned INSIDE the write
+                # transaction (the seq bump takes the writer lock), so
+                # seq order matches commit order and ?since= pollers can
+                # never skip a row committed after their snapshot
+                now = _now()
+                top = self._bump_seq(conn, len(rows))
+                first = top - len(rows) + 1
+                conn.executemany(
+                    "INSERT INTO runs (uuid, project, name, kind, status, spec, inputs, meta, tags,"
+                    " original_uuid, cloning_kind, pipeline_uuid, created_by, created_at, updated_at,"
+                    " change_seq)"
+                    " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                    [row + (now, now, first + i) for i, row in enumerate(rows)])
+                conn.executemany(
+                    "INSERT INTO status_conditions (run_uuid, condition, created_at) VALUES (?,?,?)",
+                    [cond + (now,) for cond in conds])
+            except BaseException:
+                # same hazard transition_many guards against: a mid-batch
+                # failure (e.g. duplicate uuid) must not strand earlier
+                # rows uncommitted for the next unrelated commit to flush
+                # as ghost runs that never fired the change feed
+                conn.rollback()
+                raise
         # creation flows through the same feed as transitions so a
         # subscribed agent learns about new runs without scanning
-        for listener in self._transition_listeners:
-            try:
-                listener(run_uuid, V1Statuses.CREATED.value)
-            except Exception:
-                import traceback
+        self._notify_listeners(
+            [(u, V1Statuses.CREATED.value) for u in uuids])
+        by_uuid = {r["uuid"]: r for r in self.get_runs(uuids)}
+        return [by_uuid[u] for u in uuids]
 
-                traceback.print_exc()
-        return self.get_run(run_uuid)
+    def _notify_listeners(self, events: list[tuple[str, str]]) -> None:
+        """Fire ``(uuid, status)`` feed events in order. Always called
+        AFTER the commit and outside any store lock — listeners may read
+        the store."""
+        for run_uuid, status in events:
+            for listener in self._transition_listeners:
+                try:
+                    listener(run_uuid, status)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
 
     def get_run(self, uuid: str) -> Optional[dict]:
         with self._conn_ctx() as conn:
@@ -324,18 +437,30 @@ class Store:
             ).fetchone()
         return self._row_to_run(row) if row else None
 
-    def list_runs(
-        self,
-        project: Optional[str] = None,
-        status: Optional[str] = None,
-        pipeline_uuid: Optional[str] = None,
-        limit: int = 100,
-        offset: int = 0,
-        statuses: Optional[list[str]] = None,
-        created_by: Optional[str] = None,
-    ) -> list[dict]:
-        q = f"SELECT {','.join(self._RUN_COLS)} FROM runs WHERE 1=1"
-        args: list = []
+    def get_runs(self, uuids: list[str]) -> list[dict]:
+        """Fetch many runs by uuid in ONE query (the agent's dirty pass
+        reads its whole dirty set this way). Missing uuids are silently
+        absent; order is unspecified."""
+        if not uuids:
+            return []
+        out: list[dict] = []
+        with self._conn_ctx() as conn:
+            # chunked: SQLite's default parameter cap is 999
+            for i in range(0, len(uuids), 500):
+                chunk = uuids[i:i + 500]
+                rows = conn.execute(
+                    f"SELECT {','.join(self._RUN_COLS)} FROM runs "
+                    f"WHERE uuid IN ({','.join('?' * len(chunk))})",
+                    chunk).fetchall()
+                out += rows
+        return [self._row_to_run(r) for r in out]
+
+    @staticmethod
+    def _runs_where(
+        project=None, status=None, statuses=None, pipeline_uuid=None,
+        created_by=None,
+    ) -> tuple[str, list]:
+        q, args = " WHERE 1=1", []
         if project:
             q += " AND project=?"
             args.append(project)
@@ -351,16 +476,88 @@ class Store:
         if pipeline_uuid:
             q += " AND pipeline_uuid=?"
             args.append(pipeline_uuid)
-        q += " ORDER BY created_at DESC LIMIT ? OFFSET ?"
-        args += [limit, offset]
+        return q, args
+
+    @staticmethod
+    def run_cursor(run: dict) -> str:
+        """Opaque keyset-pagination cursor for a listing row."""
+        return f"{run['created_at']}|{run['uuid']}"
+
+    @staticmethod
+    def since_token(run: dict) -> str:
+        """Resume token for incremental (``since``) fetches: the row's
+        commit-ordered change_seq."""
+        return str(run["change_seq"])
+
+    def list_runs(
+        self,
+        project: Optional[str] = None,
+        status: Optional[str] = None,
+        pipeline_uuid: Optional[str] = None,
+        limit: int = 100,
+        offset: int = 0,
+        statuses: Optional[list[str]] = None,
+        created_by: Optional[str] = None,
+        order: str = "desc",
+        cursor: Optional[str] = None,
+        since: Optional[str] = None,
+    ) -> list[dict]:
+        """List runs, newest first by default (``order="asc"`` = FIFO).
+
+        ``cursor`` (from :meth:`run_cursor`) keyset-paginates: rows strictly
+        after the cursor position in the current order — O(page) however
+        deep the listing, unlike OFFSET which scans every skipped row.
+        ``since`` switches to incremental mode: rows whose commit-ordered
+        ``change_seq`` is after the token (an int string — the bootstrap is
+        :meth:`current_seq`, pages resume from :meth:`since_token` of the
+        last delivered row), ordered by change_seq ascending, so pollers
+        fetch O(delta) instead of O(all-runs) and can never lose a row to
+        a stamp-before-commit race (overrides order/cursor)."""
+        where, args = self._runs_where(
+            project=project, status=status, statuses=statuses,
+            pipeline_uuid=pipeline_uuid, created_by=created_by)
+        q = f"SELECT {','.join(self._RUN_COLS)} FROM runs" + where
+        if since is not None:
+            q += " AND change_seq>? ORDER BY change_seq ASC LIMIT ? OFFSET ?"
+            args += [int(since), limit, offset]
+        else:
+            if order not in ("desc", "asc"):
+                raise ValueError(f"bad order {order!r}")
+            if cursor is not None:
+                c_at, _, c_uuid = cursor.partition("|")
+                cmp = "<" if order == "desc" else ">"
+                q += (f" AND (created_at{cmp}? OR "
+                      f"(created_at=? AND uuid{cmp}?))")
+                args += [c_at, c_at, c_uuid]
+            # uuid tiebreak keeps the cursor total order stable when two
+            # runs share a created_at microsecond (bulk create_runs does)
+            q += (f" ORDER BY created_at {order.upper()}, "
+                  f"uuid {order.upper()} LIMIT ? OFFSET ?")
+            args += [limit, offset]
         with self._conn_ctx() as conn:
             rows = conn.execute(q, args).fetchall()
         return [self._row_to_run(r) for r in rows]
 
+    def count_runs(
+        self,
+        project: Optional[str] = None,
+        status: Optional[str] = None,
+        pipeline_uuid: Optional[str] = None,
+        statuses: Optional[list[str]] = None,
+        created_by: Optional[str] = None,
+    ) -> int:
+        """Total rows matching the listing filters (pagination UIs)."""
+        where, args = self._runs_where(
+            project=project, status=status, statuses=statuses,
+            pipeline_uuid=pipeline_uuid, created_by=created_by)
+        with self._conn_ctx() as conn:
+            return conn.execute(
+                "SELECT COUNT(*) FROM runs" + where, args).fetchone()[0]
+
     def update_run(self, uuid: str, **fields: Any) -> Optional[dict]:
         sets, args = [], []
         for k, v in fields.items():
-            if k not in self._RUN_COLS or k == "uuid":
+            if k not in self._RUN_COLS or k in ("uuid", "change_seq"):
                 raise ValueError(f"bad run field {k!r}")
             if k in self._JSON_COLS and v is not None and not isinstance(v, str):
                 v = json.dumps(v)
@@ -368,9 +565,11 @@ class Store:
             args.append(v)
         sets.append("updated_at=?")
         args.append(_now())
-        args.append(uuid)
+        sets.append("change_seq=?")
         with self._conn_ctx() as conn:
-            conn.execute(f"UPDATE runs SET {','.join(sets)} WHERE uuid=?", args)
+            args.append(self._bump_seq(conn))
+            conn.execute(f"UPDATE runs SET {','.join(sets)} WHERE uuid=?",
+                         args + [uuid])
         return self.get_run(uuid)
 
     def merge_outputs(self, uuid: str, outputs: dict) -> Optional[dict]:
@@ -410,39 +609,82 @@ class Store:
         concurrent writers (agent vs executor threads) cannot interleave —
         e.g. a late 'failed' from a killed process must not overwrite
         'stopped'."""
+        return self.transition_many([(uuid, status, reason, message, force)])[0]
+
+    def _get_run_conn(self, conn, uuid: str) -> Optional[dict]:
+        row = conn.execute(
+            f"SELECT {','.join(self._RUN_COLS)} FROM runs WHERE uuid=?", (uuid,)
+        ).fetchone()
+        return self._row_to_run(row) if row else None
+
+    def transition_many(
+        self, transitions: list[tuple],
+    ) -> list[tuple[Optional[dict], bool]]:
+        """Apply many status transitions in ONE lock hold + ONE commit.
+
+        ``transitions``: ``(uuid, status[, reason[, message[, force]]])``
+        tuples, applied in order — later entries see earlier ones (the
+        reconciler's restart path walks running -> retrying -> queued ->
+        scheduled on one run). Returns (run, changed) per entry, same
+        semantics as :meth:`transition`. Listeners fire after the batch
+        commits, in order, only for applied transitions — so a burst of
+        lifecycle updates is one fsync, not 3 transactions each."""
+        results: list[tuple[Optional[dict], bool]] = []
+        applied: list[tuple[str, str]] = []
         with self._transition_lock:
-            run = self.get_run(uuid)
-            if run is None:
-                return None, False
-            src = V1Statuses(run["status"])
-            dst = V1Statuses(status)
-            if (not force or src in DONE_STATUSES) and not can_transition(src, dst):
-                return run, False
-            cond = V1StatusCondition.get_condition(dst, reason=reason, message=message)
-            now = _now()
-            fields: dict[str, Any] = {"status": dst.value}
-            if dst == V1Statuses.RUNNING and not run.get("started_at"):
-                fields["started_at"] = now
-            if is_done(dst):
-                fields["finished_at"] = now
             with self._conn_ctx() as conn:
-                conn.execute(
-                    "INSERT INTO status_conditions (run_uuid, condition, created_at) VALUES (?,?,?)",
-                    (uuid, json.dumps(cond.to_dict()), now),
-                )
-            result = self.update_run(uuid, **fields), True
+                try:
+                    self._transition_batch(conn, transitions, results, applied)
+                except BaseException:
+                    # a mid-batch error (bad status string, corrupt row)
+                    # must not strand earlier entries' writes uncommitted
+                    # on the shared connection — the next unrelated commit
+                    # would flush them WITHOUT their listeners ever firing
+                    conn.rollback()
+                    applied.clear()
+                    raise
         # observers run OUTSIDE the lock (they may read the store) and only
         # for transitions that actually happened — hooks keyed off rejected
         # late reports (a killed process's 'failed' after 'stopped') never
         # fire with the wrong status
-        for listener in self._transition_listeners:
-            try:
-                listener(uuid, dst.value)
-            except Exception:
-                import traceback
+        self._notify_listeners(applied)
+        return results
 
-                traceback.print_exc()
-        return result
+    def _transition_batch(self, conn, transitions, results, applied) -> None:
+        for t in transitions:
+            uuid, status = t[0], t[1]
+            reason = t[2] if len(t) > 2 else None
+            message = t[3] if len(t) > 3 else None
+            force = bool(t[4]) if len(t) > 4 else False
+            run = self._get_run_conn(conn, uuid)
+            if run is None:
+                results.append((None, False))
+                continue
+            src = V1Statuses(run["status"])
+            dst = V1Statuses(status)
+            if (not force or src in DONE_STATUSES) and not can_transition(src, dst):
+                results.append((run, False))
+                continue
+            cond = V1StatusCondition.get_condition(
+                dst, reason=reason, message=message)
+            now = _now()
+            sets = ["status=?", "updated_at=?", "change_seq=?"]
+            args: list[Any] = [dst.value, now, self._bump_seq(conn)]
+            if dst == V1Statuses.RUNNING and not run.get("started_at"):
+                sets.append("started_at=?")
+                args.append(now)
+            if is_done(dst):
+                sets.append("finished_at=?")
+                args.append(now)
+            conn.execute(
+                "INSERT INTO status_conditions (run_uuid, condition, created_at) VALUES (?,?,?)",
+                (uuid, json.dumps(cond.to_dict()), now),
+            )
+            conn.execute(
+                f"UPDATE runs SET {','.join(sets)} WHERE uuid=?",
+                args + [uuid])
+            results.append((self._get_run_conn(conn, uuid), True))
+            applied.append((uuid, dst.value))
 
     def add_transition_listener(self, fn) -> None:
         """Register ``fn(uuid, new_status)`` called after every applied

@@ -162,6 +162,42 @@ class RunClient(BaseClient):
             params["created_by"] = created_by
         return self._json("GET", f"/api/v1/{self.project}/runs", params=params)
 
+    def list_page(self, status: Optional[str] = None,
+                  pipeline_uuid: Optional[str] = None,
+                  created_by: Optional[str] = None,
+                  limit: int = 100, cursor: Optional[str] = None) -> dict:
+        """Cursor-paginated listing: {results, count, next_cursor,
+        server_time}. Pass ``next_cursor`` back to walk deep listings in
+        O(page) server work per call (OFFSET re-scans every skipped row)."""
+        params: dict = {"limit": limit, "paged": 1}
+        if status:
+            params["status"] = status
+        if pipeline_uuid:
+            params["pipeline_uuid"] = pipeline_uuid
+        if created_by:
+            params["created_by"] = created_by
+        if cursor:
+            params["cursor"] = cursor
+        return self._json("GET", f"/api/v1/{self.project}/runs", params=params)
+
+    def list_since(self, since: str, status: Optional[str] = None,
+                   limit: int = 500) -> dict:
+        """Incremental fetch: runs changed after the opaque ``since`` token
+        (a commit-ordered change sequence). Bootstrap from the FIRST
+        (cursor-less) ``list_page`` response's ``server_time`` — snapshot
+        it, walk the pages, then poll; continuation pages carry no token
+        because a run created mid-walk never appears on later DESC pages.
+        Feed each returned ``server_time`` back as the next ``since`` — a
+        steady-state poller transfers O(changed rows), not the whole runs
+        table, and a truncated page resumes mid-delta on the next call
+        instead of losing rows. Deletions are NOT in the feed (no
+        tombstones): a mirror that must drop deleted runs needs a
+        periodic full re-list as its resync layer."""
+        params: dict = {"limit": limit, "since": since}
+        if status:
+            params["status"] = status
+        return self._json("GET", f"/api/v1/{self.project}/runs", params=params)
+
     def delete(self, uuid: Optional[str] = None) -> dict:
         return self._json("DELETE", self._rpath(uuid=uuid))
 

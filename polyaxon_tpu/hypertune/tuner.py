@@ -138,10 +138,11 @@ class Tuner:
 
     # -- trial plumbing ----------------------------------------------------
 
-    def _create_trial(
+    def _trial_payload(
         self, sugg: Suggestion, index: int,
         assignment: Optional[SubSliceAssignment] = None,
     ) -> dict:
+        """create_runs kwargs for one trial (batched by _launch_many)."""
         spec = copy.deepcopy(self._child_spec)
         params = dict(spec.get("params") or {})
         for name, value in sugg.params.items():
@@ -159,8 +160,7 @@ class Tuner:
             }
         name = f"{self.pipeline.get('name') or 'sweep'}-t{index}"
         spec["name"] = name
-        return self.store.create_run(
-            self.pipeline["project"],
+        return dict(
             spec=spec,
             name=name,
             kind="trial",
@@ -237,11 +237,14 @@ class Tuner:
                          getattr(self.matrix, "early_stopping", None) or [])
 
         while True:
-            while st.free:
+            to_launch = []
+            while len(to_launch) < len(st.free):
                 batch = self.manager.propose(st.observations, 1)
                 if not batch:
                     break
-                self._launch(st, batch[0])
+                to_launch.append(batch[0])
+            if to_launch:
+                self._launch_many(st, to_launch)
 
             if not st.inflight:
                 break  # nothing running, nothing proposable: sweep is done
@@ -275,8 +278,9 @@ class Tuner:
             st.reset_slots(min(st.concurrency, max(len(queue), 1)))
 
             while queue or st.inflight:
-                while queue and st.free:
-                    self._launch(st, queue.pop(0))
+                take = min(len(queue), len(st.free))
+                if take:
+                    self._launch_many(st, [queue.pop(0) for _ in range(take)])
 
                 self._check_pipeline_stop(st.inflight)
                 self._reap(st)
@@ -302,14 +306,23 @@ class Tuner:
 
     # -- shared loop mechanics --------------------------------------------
 
-    def _launch(self, st: "_SweepState", sugg: Suggestion) -> None:
-        """Create a trial for ``sugg`` in a free slot (slot index doubles
-        as the sub-slice assignment when packing)."""
-        slot = st.free.pop()
-        assignment = self.assignments[slot] if self.assignments else None
-        trial = self._create_trial(sugg, st.trial_index, assignment)
-        st.trial_index += 1
-        st.inflight[slot] = (sugg, trial)
+    def _launch_many(self, st: "_SweepState", suggs: list) -> None:
+        """Create trials for ``suggs`` in free slots (slot index doubles as
+        the sub-slice assignment when packing). The whole window is ONE
+        store transaction — a 16-wide suggestion batch used to be 32
+        commits (run + condition each)."""
+        entries = []
+        for sugg in suggs:
+            slot = st.free.pop()
+            assignment = self.assignments[slot] if self.assignments else None
+            entries.append(
+                (slot, sugg,
+                 self._trial_payload(sugg, st.trial_index, assignment)))
+            st.trial_index += 1
+        rows = self.store.create_runs(
+            self.pipeline["project"], [p for _, _, p in entries])
+        for (slot, sugg, _), row in zip(entries, rows):
+            st.inflight[slot] = (sugg, row)
 
     def _reap(self, st: "_SweepState") -> None:
         """One poll pass: record finished trials as observations, free

@@ -63,7 +63,7 @@ _REASON_MSG = {
 
 class OperationReconciler:
     def __init__(self, cluster: Cluster, on_status: Optional[StatusFn] = None,
-                 retry=None):
+                 retry=None, on_status_many=None):
         from ..resilience.retry import RetryPolicy
 
         self.cluster = cluster
@@ -75,6 +75,11 @@ class OperationReconciler:
         self.retry: RetryPolicy = retry if retry is not None else RetryPolicy(
             max_attempts=4, base_delay=0.1, max_delay=2.0, deadline=8.0)
         self.on_status = on_status or (lambda *a: None)
+        # optional batch form: [(uuid, status, message), ...] applied as one
+        # store transaction (the agent wires Store.transition_many). Multi-
+        # step edges (restart's 4-transition walk) use it when available.
+        self.on_status_many = on_status_many or (
+            lambda updates: [self.on_status(*u) for u in updates])
         self._ops: dict[str, _OpState] = {}
         self._lock = threading.Lock()
         self._reconcile_lock = threading.Lock()
@@ -230,16 +235,18 @@ class OperationReconciler:
             # Pods that fail faster than one observe interval were still
             # running — emit RUNNING first so the status machine accepts the
             # RETRYING edge (running->retrying; scheduled->retrying is not
-            # a legal transition).
-            if not state.was_running:
-                self.on_status(op.run_uuid, V1Statuses.RUNNING.value, None)
+            # a legal transition). The whole 4-step walk is one batch.
             state.retries_done += 1
-            self.on_status(
-                op.run_uuid, V1Statuses.RETRYING.value,
-                f"attempt {state.retries_done + 1}/{op.backoff_limit + 1}",
-            )
-            self.on_status(op.run_uuid, V1Statuses.QUEUED.value, None)
-            self.on_status(op.run_uuid, V1Statuses.SCHEDULED.value, None)
+            updates = []
+            if not state.was_running:
+                updates.append((op.run_uuid, V1Statuses.RUNNING.value, None))
+            updates += [
+                (op.run_uuid, V1Statuses.RETRYING.value,
+                 f"attempt {state.retries_done + 1}/{op.backoff_limit + 1}"),
+                (op.run_uuid, V1Statuses.QUEUED.value, None),
+                (op.run_uuid, V1Statuses.SCHEDULED.value, None),
+            ]
+            self.on_status_many(updates)
             self._c(self.cluster.delete_selected, op.label_selector)
             for manifest in op.resources:
                 self._c(self.cluster.apply, manifest)
@@ -249,17 +256,20 @@ class OperationReconciler:
         if decision.action in (Action.FAIL, Action.SUCCEED):
             status = (V1Statuses.SUCCEEDED if decision.action == Action.SUCCEED
                       else V1Statuses.FAILED)
+            updates = []
             if decision.action == Action.SUCCEED and not state.was_running:
                 # pods ran to completion between observe passes; the status
                 # machine has no scheduled->succeeded edge, so record the
                 # (true) running phase first
-                self.on_status(op.run_uuid, V1Statuses.RUNNING.value, None)
+                updates.append((op.run_uuid, V1Statuses.RUNNING.value, None))
             state.final_status = status.value
             state.finished_at = time.monotonic()
             # report BEFORE any teardown so on_status consumers (agent log
             # scraping) still see the pods; then failure tears them down,
             # success leaves them until TTL (or forever when ttl < 0)
-            self.on_status(op.run_uuid, status.value, _REASON_MSG.get(decision.reason))
+            updates.append(
+                (op.run_uuid, status.value, _REASON_MSG.get(decision.reason)))
+            self.on_status_many(updates)
             if decision.action == Action.FAIL or op.ttl_s == 0:
                 self._c(self.cluster.delete_selected, op.label_selector)
                 if op.ttl_s == 0:

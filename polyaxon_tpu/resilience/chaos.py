@@ -244,7 +244,8 @@ class FaultyStore:
     the read/write verbs the API and agent hot paths hit)."""
 
     _DEFAULT_METHODS = (
-        "get_run", "list_runs", "create_run", "update_run", "transition",
+        "get_run", "get_runs", "list_runs", "create_run", "create_runs",
+        "update_run", "transition", "transition_many",
         "merge_outputs", "get_statuses", "heartbeat",
     )
 

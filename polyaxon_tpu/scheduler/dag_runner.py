@@ -95,9 +95,11 @@ class DagRunner:
 
         while pending or running:
             self._check_pipeline_stop(running)
-            # launch everything whose deps succeeded
+            # launch everything whose deps succeeded — the whole wave (e.g.
+            # all roots of a fan-out) lands as ONE store transaction
+            wave: list[tuple[str, dict]] = []
             for key in list(pending):
-                if len(running) >= concurrency:
+                if len(running) + len(wave) >= concurrency:
                     break
                 d = deps[key]
                 if any(k in failed for k in d):
@@ -111,15 +113,18 @@ class DagRunner:
                     self._child_spec(by_key[key]),
                     {k: results[k] for k in d},
                 )
-                row = self.store.create_run(
-                    self.pipeline["project"],
+                wave.append((key, dict(
                     spec=child,
                     name=f"{self.pipeline.get('name') or 'dag'}-{key}",
                     kind="operation",
                     meta={"dag_op": key},
                     pipeline_uuid=self.pipeline["uuid"],
-                )
-                running[key] = row["uuid"]
+                )))
+            if wave:
+                rows = self.store.create_runs(
+                    self.pipeline["project"], [w for _, w in wave])
+                for (key, _), row in zip(wave, rows):
+                    running[key] = row["uuid"]
             for key, uuid in list(running.items()):
                 row = self.store.get_run(uuid)
                 if row is None or is_done(row["status"]):

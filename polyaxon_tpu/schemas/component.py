@@ -12,6 +12,23 @@ from .io import V1IO
 from .lifecycle import V1Build, V1Cache, V1Hook, V1Plugins, V1Termination
 from .run import RunUnion
 
+_RUN_ADAPTER = None
+
+
+def _run_union_adapter():
+    """Module-cached TypeAdapter(RunUnion): building the adapter walks and
+    simplifies the whole discriminated-union core schema (~35 ms) — per
+    CALL that was the single largest cost of compiling or scheduling a run
+    (2× resolve per run = ~70 ms of pure schema rebuild on the agent's hot
+    path, see docs/PERFORMANCE.md "Control-plane performance"). Validation
+    itself is microseconds."""
+    global _RUN_ADAPTER
+    if _RUN_ADAPTER is None:
+        from pydantic import TypeAdapter
+
+        _RUN_ADAPTER = TypeAdapter(RunUnion)
+    return _RUN_ADAPTER
+
 SPEC_VERSION = 1.1
 
 
@@ -56,9 +73,7 @@ class V1Component(BaseSchema):
             from .run import V1Tuner
 
             return V1Tuner.from_dict({k: x for k, x in v.items() if k != "kind"})
-        from pydantic import TypeAdapter
-
-        return TypeAdapter(RunUnion).validate_python(v)
+        return _run_union_adapter().validate_python(v)
 
     def get_run_kind(self) -> Optional[str]:
         if self.run is None:

@@ -25,15 +25,49 @@ class TestSchedBench:
             assert r["time_to_running_p95_s"] >= r["time_to_running_p50_s"]
             assert r["runs_per_min"] > 0
 
+    def test_saturated_burst_wake_beats_poll(self):
+        """Regression guard for the r7 dirty-set scheduler (BASELINE r6's
+        honest negative result: under a capacity-saturated burst the
+        event-driven pass rescanned the full queued list and LOST to
+        polling, 670 vs 982 runs/min). Scaled-down saturated burst: the
+        change-feed path must now deliver at least polling's throughput —
+        it sees freed capacity the instant a run finishes, and its pass
+        cost is O(dirty), so there is no regime left where it loses."""
+        attempts = []
+        for _ in range(3):  # perf smoke on a shared box: best of 3
+            out = run_bench(n=24, mode="both", poll_interval=0.2,
+                            max_parallel=4)
+            wake, poll = out["results"]
+            assert wake["mode"] == "wake" and poll["mode"] == "poll"
+            for r in (wake, poll):
+                assert r["completed"] == 24, r
+                assert r["failed"] == 0, r
+            attempts.append((wake, poll))
+            if (wake["runs_per_min"] >= poll["runs_per_min"]
+                    and wake["time_to_running_p50_s"]
+                    <= poll["time_to_running_p50_s"]):
+                return
+        raise AssertionError(
+            f"wake never matched poll throughput+p50 in "
+            f"{len(attempts)} attempts: {attempts}")
+
     def test_poll_mode_detaches_change_feed(self):
-        """use_change_feed=False must leave the store's listener list
-        untouched and force full scans every wake (resync_interval 0)."""
+        """use_change_feed=False must detach the SCHEDULING feed — no
+        dirty tracking, no loop wakes, full scans every tick
+        (resync_interval 0). The hooks-only listener stays (webhook/slack
+        notifications are a product feature, not a scheduling signal) but
+        must never wake the loop or touch the dirty set."""
         from polyaxon_tpu.api.store import Store
         from polyaxon_tpu.scheduler.agent import LocalAgent
 
         store = Store(":memory:")
-        before = len(store._transition_listeners)
         agent = LocalAgent(store, artifacts_root="/tmp/sched_bench_feed_t",
                            use_change_feed=False)
-        assert len(store._transition_listeners) == before
+        assert agent._on_transition_applied not in store._transition_listeners
         assert agent.resync_interval == 0.0
+        # transitions reach only the hook listener: loop stays asleep,
+        # dirty set stays empty
+        run = store.create_run("p", spec={}, name="x")
+        store.transition(run["uuid"], "compiled")
+        assert not agent._wake.is_set()
+        assert agent._dirty == set()
