@@ -71,7 +71,8 @@ class ApiApp:
             else os.environ.get("PLX_AUTH_TOKEN")
         self._tokens_seen = False
         self.app = web.Application(
-            middlewares=[*(extra_middlewares or []), self._auth_middleware])
+            middlewares=[*(extra_middlewares or []), self._auth_middleware,
+                         self._conflict_middleware])
         self._routes()
         # the scheduler (if attached in-process) watches this queue
         self.new_run_event = asyncio.Event()
@@ -135,6 +136,21 @@ class ApiApp:
                                     f"{row['project']!r}"}, status=403)
         return await handler(request)
 
+    @web.middleware
+    async def _conflict_middleware(self, request, handler):
+        """Fencing conflicts surface as HTTP 409 (never retried by the
+        client RetryPolicy — the writer is stale, not the weather). Only
+        reachable when an embedder serves a write-fenced store; the plain
+        API's own writes are unfenced by design (clients are not lease
+        holders)."""
+        from .store import StaleLeaseError
+
+        try:
+            return await handler(request)
+        except StaleLeaseError as e:
+            return _json({"error": "stale lease", "detail": str(e)},
+                         status=409)
+
     def run_dir(self, project: str, uuid: str) -> str:
         return run_artifacts_dir(self.artifacts_root, project, uuid)
 
@@ -150,6 +166,7 @@ class ApiApp:
         r.add_get("/api/v1/tokens", self.list_tokens)
         r.add_delete("/api/v1/tokens/{token_id}", self.revoke_token)
         r.add_get("/api/v1/projects/{project}", self.get_project)
+        r.add_get("/api/v1/agent/lease", self.get_agent_lease)
         r.add_post("/api/v1/{project}/runs", self.create_run)
         r.add_get("/api/v1/{project}/runs", self.list_runs)
         r.add_get("/api/v1/{project}/runs/{uuid}", self.get_run)
@@ -173,6 +190,14 @@ class ApiApp:
 
     async def healthz(self, request):
         return _json({"status": "ok"})
+
+    async def get_agent_lease(self, request):
+        """Who drives the control plane right now (admin-only by scoping:
+        the route carries no {project}). ``lease: null`` = no live agent —
+        either none started, or the holder crashed and its TTL has not
+        expired yet (``expired: true`` on the row when it has)."""
+        name = request.query.get("name", "scheduler")
+        return _json({"lease": self.store.get_lease(name)})
 
     async def ui(self, request):
         from .ui import UI_HTML

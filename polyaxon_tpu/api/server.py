@@ -98,8 +98,19 @@ def main() -> None:
     server = ApiServer(args.db, args.artifacts_root, args.host, args.port)
     server.start()
     print(f"polyaxon_tpu API listening on {server.url}")
+
+    # graceful SIGTERM (ISSUE 4 satellite): finish in-flight requests via
+    # AppRunner.cleanup (aiohttp drains open handlers), then exit 0
+    import signal
+    import threading as _threading
+
+    drain = _threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: drain.set())
     try:
-        server._thread.join()
+        while not drain.wait(timeout=3600):
+            pass
+        print("SIGTERM: draining API server")
+        server.stop()
     except KeyboardInterrupt:
         server.stop()
 

@@ -82,7 +82,52 @@ CREATE TABLE IF NOT EXISTS tokens (
     created_at TEXT NOT NULL,
     revoked INTEGER NOT NULL DEFAULT 0
 );
+-- control-plane crash safety (docs/RESILIENCE.md "Control-plane crash
+-- matrix"): one row per named lease (the scheduler holds "scheduler").
+-- ``token`` is the fencing token — monotonic across acquisitions AND
+-- releases (the counter lives in ``counters`` under lease_token:<name>,
+-- so a delete+reacquire can never reissue an old token). Agent-side
+-- writes carry (name, token) and are rejected when the row's token
+-- differs: a stale agent that wakes from a GC pause can observe but
+-- not mutate.
+CREATE TABLE IF NOT EXISTS agent_leases (
+    name TEXT PRIMARY KEY,
+    holder TEXT NOT NULL,
+    token INTEGER NOT NULL,
+    ttl REAL NOT NULL,
+    acquired_at TEXT NOT NULL,
+    renewed_at TEXT NOT NULL
+);
+-- write-ahead launch intents: the agent records (lease, token, attempt)
+-- BEFORE asking the cluster for pods, so a restarted agent can tell
+-- "intent recorded, pod never created" (safe to relaunch) from
+-- "pods launched, row stale" (adopt — never a duplicate pod set).
+CREATE TABLE IF NOT EXISTS launch_intents (
+    run_uuid TEXT PRIMARY KEY,
+    lease_name TEXT,
+    lease_holder TEXT,
+    token INTEGER,
+    attempt INTEGER NOT NULL,
+    state TEXT NOT NULL,
+    created_at TEXT NOT NULL,
+    updated_at TEXT NOT NULL
+);
 """
+
+
+class StaleLeaseError(RuntimeError):
+    """A fenced write carried a token older than the current lease — the
+    writer lost its lease (TTL takeover, double-start, explicit release)
+    and must stop mutating. The API surfaces this as HTTP 409."""
+
+    def __init__(self, name: str, token: Optional[int],
+                 current: Optional[int]):
+        self.lease_name = name
+        self.token = token
+        self.current = current
+        super().__init__(
+            f"stale lease token {token} for lease {name!r} "
+            f"(current: {current})")
 
 
 def _now() -> str:
@@ -104,7 +149,8 @@ class Store:
         # triage: transactions opened + run rows deserialized. A dirty
         # scheduling pass must stay O(dirty) on both (tests/test_runtime_
         # agent.py asserts it), so the counters are part of the contract.
-        self.stats = {"transactions": 0, "runs_deserialized": 0}
+        self.stats = {"transactions": 0, "runs_deserialized": 0,
+                      "fence_rejections": 0, "launch_intents": 0}
         self._memory_conn: Optional[sqlite3.Connection] = None
         if path == ":memory:":
             # a single shared connection (serialized by a lock)
@@ -269,6 +315,194 @@ class Store:
             return conn.execute(
                 "SELECT 1 FROM tokens LIMIT 1").fetchone() is not None
 
+    # -- agent leases + fencing (control-plane crash safety) ---------------
+
+    _LEASE_COLS = ("name", "holder", "token", "ttl", "acquired_at",
+                   "renewed_at")
+
+    @staticmethod
+    def _lease_age(renewed_at: str) -> float:
+        t = datetime.datetime.fromisoformat(renewed_at)
+        if t.tzinfo is None:
+            t = t.replace(tzinfo=datetime.timezone.utc)
+        return (datetime.datetime.now(datetime.timezone.utc)
+                - t).total_seconds()
+
+    def _lease_row(self, conn, name: str) -> Optional[dict]:
+        row = conn.execute(
+            f"SELECT {','.join(self._LEASE_COLS)} FROM agent_leases "
+            "WHERE name=?", (name,)).fetchone()
+        return dict(zip(self._LEASE_COLS, row)) if row else None
+
+    def acquire_lease(self, name: str, holder: str,
+                      ttl: float = 30.0) -> Optional[dict]:
+        """Take the named lease if it is free, expired (no renewal within
+        its TTL), or already ours. Every successful acquisition bumps the
+        monotonic fencing token — including self-reacquisition, so a
+        holder that lost track of time gets a NEW token and its old one
+        dies. Returns the lease dict, or None while another holder's
+        lease is live."""
+        with self._transition_lock:
+            with self._conn_ctx() as conn:
+                # liveness check and token bump must be ONE unit across
+                # processes too (the SELECT alone runs in autocommit on a
+                # file DB): two double-started agents must never both
+                # conclude "expired" and both believe they acquired
+                if not conn.in_transaction:
+                    conn.execute("BEGIN IMMEDIATE")
+                row = self._lease_row(conn, name)
+                if (row is not None and row["holder"] != holder
+                        and self._lease_age(row["renewed_at"]) < row["ttl"]):
+                    return None
+                key = f"lease_token:{name}"
+                conn.execute(
+                    "INSERT OR IGNORE INTO counters (k, v) VALUES (?, 0)",
+                    (key,))
+                conn.execute("UPDATE counters SET v=v+1 WHERE k=?", (key,))
+                token = conn.execute(
+                    "SELECT v FROM counters WHERE k=?", (key,)).fetchone()[0]
+                now = _now()
+                conn.execute(
+                    "INSERT OR REPLACE INTO agent_leases "
+                    "(name, holder, token, ttl, acquired_at, renewed_at) "
+                    "VALUES (?,?,?,?,?,?)",
+                    (name, holder, token, float(ttl), now, now))
+                return self._lease_row(conn, name)
+
+    def renew_lease(self, name: str, holder: str, token: int) -> bool:
+        """Stamp renewed_at iff (holder, token) still own the lease.
+        False means a newer acquisition exists (or the lease was
+        released): the caller is stale and must demote itself."""
+        with self._conn_ctx() as conn:
+            cur = conn.execute(
+                "UPDATE agent_leases SET renewed_at=? "
+                "WHERE name=? AND holder=? AND token=?",
+                (_now(), name, holder, token))
+        return cur.rowcount > 0
+
+    def release_lease(self, name: str, holder: str, token: int) -> bool:
+        """Explicit release on graceful shutdown — a successor acquires
+        instantly instead of waiting out the TTL. Only the current
+        (holder, token) may release; the token counter survives, so the
+        next acquisition still gets a strictly newer token."""
+        with self._conn_ctx() as conn:
+            cur = conn.execute(
+                "DELETE FROM agent_leases "
+                "WHERE name=? AND holder=? AND token=?",
+                (name, holder, token))
+        return cur.rowcount > 0
+
+    def get_lease(self, name: str) -> Optional[dict]:
+        with self._conn_ctx() as conn:
+            row = self._lease_row(conn, name)
+        if row is not None:
+            row["expired"] = self._lease_age(row["renewed_at"]) >= row["ttl"]
+        return row
+
+    def _check_fence(self, conn, fence) -> None:
+        """Reject a fenced write whose token is no longer current. Atomic
+        with the write it guards: python sqlite3 only opens the implicit
+        transaction on DML — a bare SELECT runs in autocommit, which on a
+        file DB shared by two processes would let a takeover commit
+        BETWEEN this read and our write. BEGIN IMMEDIATE grabs the writer
+        lock first, so the token read and the guarded write commit as one
+        unit — there is no window where a stale agent's batch lands after
+        a newer acquisition."""
+        if fence is None:
+            return
+        if not conn.in_transaction:
+            conn.execute("BEGIN IMMEDIATE")
+        name, token = fence
+        row = conn.execute(
+            "SELECT token FROM agent_leases WHERE name=?", (name,)).fetchone()
+        current = row[0] if row else None
+        if current != token:
+            self.stats["fence_rejections"] += 1
+            raise StaleLeaseError(name, token, current)
+
+    # -- launch intents (write-ahead pod creation) -------------------------
+
+    def record_launch_intent(self, run_uuid: str, lease_holder: Optional[str],
+                             token: Optional[int],
+                             lease_name: Optional[str] = None,
+                             fence=None) -> dict:
+        """Write-ahead row for a pod launch: bump the attempt counter, set
+        state='intent', and stamp ``meta.owner = {lease_id, token,
+        attempt}`` on the run — all in ONE transaction, BEFORE any cluster
+        call. A crash after this commit but before the pods exist leaves
+        state='intent' with no pods: the successor relaunches. A crash
+        after :meth:`mark_launched` leaves state='launched': the successor
+        adopts the live pods instead of creating a second set."""
+        with self._transition_lock:
+            with self._conn_ctx() as conn:
+                self._check_fence(conn, fence)
+                prev = conn.execute(
+                    "SELECT attempt FROM launch_intents WHERE run_uuid=?",
+                    (run_uuid,)).fetchone()
+                attempt = (prev[0] if prev else 0) + 1
+                now = _now()
+                conn.execute(
+                    "INSERT OR REPLACE INTO launch_intents (run_uuid, "
+                    "lease_name, lease_holder, token, attempt, state, "
+                    "created_at, updated_at) VALUES (?,?,?,?,?,?,?,?)",
+                    (run_uuid, lease_name, lease_holder, token, attempt,
+                     "intent", now, now))
+                self._stamp_owner(conn, run_uuid, lease_holder, token, attempt)
+                self.stats["launch_intents"] += 1
+        return {"run_uuid": run_uuid, "attempt": attempt, "state": "intent",
+                "lease_holder": lease_holder, "token": token}
+
+    def mark_launched(self, run_uuid: str, fence=None) -> None:
+        """Flip the intent to state='launched' AFTER the cluster accepted
+        every manifest — the pods exist now; a successor must adopt."""
+        with self._conn_ctx() as conn:
+            self._check_fence(conn, fence)
+            conn.execute(
+                "UPDATE launch_intents SET state='launched', updated_at=? "
+                "WHERE run_uuid=?", (_now(), run_uuid))
+
+    def adopt_launch(self, run_uuid: str, lease_holder: Optional[str],
+                     token: Optional[int], fence=None) -> None:
+        """Re-own a live pod set after an agent restart: update the intent
+        row and meta.owner to the NEW lease without bumping the attempt
+        counter — adoption is not a launch."""
+        with self._transition_lock:
+            with self._conn_ctx() as conn:
+                self._check_fence(conn, fence)
+                now = _now()
+                row = conn.execute(
+                    "SELECT attempt FROM launch_intents WHERE run_uuid=?",
+                    (run_uuid,)).fetchone()
+                attempt = row[0] if row else 1
+                conn.execute(
+                    "INSERT OR REPLACE INTO launch_intents (run_uuid, "
+                    "lease_name, lease_holder, token, attempt, state, "
+                    "created_at, updated_at) VALUES (?,?,?,?,?,'launched',?,?)",
+                    (run_uuid, None, lease_holder, token, attempt, now, now))
+                self._stamp_owner(conn, run_uuid, lease_holder, token, attempt)
+
+    def get_launch_intent(self, run_uuid: str) -> Optional[dict]:
+        cols = ("run_uuid", "lease_name", "lease_holder", "token", "attempt",
+                "state", "created_at", "updated_at")
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                f"SELECT {','.join(cols)} FROM launch_intents "
+                "WHERE run_uuid=?", (run_uuid,)).fetchone()
+        return dict(zip(cols, row)) if row else None
+
+    def _stamp_owner(self, conn, run_uuid: str, lease_holder, token,
+                     attempt: int) -> None:
+        row = conn.execute(
+            "SELECT meta FROM runs WHERE uuid=?", (run_uuid,)).fetchone()
+        if row is None:
+            return
+        meta = json.loads(row[0]) if row[0] else {}
+        meta["owner"] = {"lease_id": lease_holder, "token": token,
+                         "attempt": attempt}
+        conn.execute(
+            "UPDATE runs SET meta=?, updated_at=?, change_seq=? WHERE uuid=?",
+            (json.dumps(meta), _now(), self._bump_seq(conn), run_uuid))
+
     # -- runs --------------------------------------------------------------
 
     _RUN_COLS = (
@@ -335,19 +569,24 @@ class Store:
         cloning_kind: Optional[str] = None,
         pipeline_uuid: Optional[str] = None,
         created_by: Optional[str] = None,
+        fence=None,
     ) -> dict:
         return self.create_runs(project, [dict(
             spec=spec, name=name, kind=kind, inputs=inputs, meta=meta,
             tags=tags, uuid=uuid, original_uuid=original_uuid,
             cloning_kind=cloning_kind, pipeline_uuid=pipeline_uuid,
             created_by=created_by,
-        )])[0]
+        )], fence=fence)[0]
 
-    def create_runs(self, project: str, runs: list[dict]) -> list[dict]:
+    def create_runs(self, project: str, runs: list[dict],
+                    fence=None) -> list[dict]:
         """Create many runs in ONE transaction (DAG/matrix fan-out: a
         16-wide suggestion batch is one commit, not 32). Each entry takes
         the same keyword fields as ``create_run``. Listeners fire after the
-        commit, once per run, in order."""
+        commit, once per run, in order. ``fence=(lease_name, token)``
+        rejects the whole batch with :class:`StaleLeaseError` when the
+        token is no longer current — a stale agent's pipeline driver must
+        not fan out children after a takeover."""
         self.create_project(project)
         rows, conds = [], []
         uuids: list[str] = []
@@ -387,6 +626,7 @@ class Store:
             ))
         with self._conn_ctx() as conn:
             try:
+                self._check_fence(conn, fence)
                 # timestamps + change seqs assigned INSIDE the write
                 # transaction (the seq bump takes the writer lock), so
                 # seq order matches commit order and ?since= pollers can
@@ -554,7 +794,7 @@ class Store:
             return conn.execute(
                 "SELECT COUNT(*) FROM runs" + where, args).fetchone()[0]
 
-    def update_run(self, uuid: str, **fields: Any) -> Optional[dict]:
+    def update_run(self, uuid: str, fence=None, **fields: Any) -> Optional[dict]:
         sets, args = [], []
         for k, v in fields.items():
             if k not in self._RUN_COLS or k in ("uuid", "change_seq"):
@@ -567,12 +807,14 @@ class Store:
         args.append(_now())
         sets.append("change_seq=?")
         with self._conn_ctx() as conn:
+            self._check_fence(conn, fence)
             args.append(self._bump_seq(conn))
             conn.execute(f"UPDATE runs SET {','.join(sets)} WHERE uuid=?",
                          args + [uuid])
         return self.get_run(uuid)
 
-    def merge_outputs(self, uuid: str, outputs: dict) -> Optional[dict]:
+    def merge_outputs(self, uuid: str, outputs: dict,
+                      fence=None) -> Optional[dict]:
         # serialize the read-modify-write: concurrent writers (API
         # post_outputs, agent _collect_outputs, tuner merge) must not drop keys
         with self._transition_lock:
@@ -581,7 +823,7 @@ class Store:
                 return None
             merged = dict(run.get("outputs") or {})
             merged.update(outputs)
-            return self.update_run(uuid, outputs=merged)
+            return self.update_run(uuid, fence=fence, outputs=merged)
 
     def heartbeat(self, uuid: str) -> bool:
         """Renew a run's liveness lease (zombie-reaper input). Cheap direct
@@ -596,20 +838,22 @@ class Store:
             cur = conn.execute("DELETE FROM runs WHERE uuid=?", (uuid,))
             conn.execute("DELETE FROM status_conditions WHERE run_uuid=?", (uuid,))
             conn.execute("DELETE FROM lineage WHERE run_uuid=?", (uuid,))
+            conn.execute("DELETE FROM launch_intents WHERE run_uuid=?", (uuid,))
         return cur.rowcount > 0
 
     # -- statuses ----------------------------------------------------------
 
     def transition(
         self, uuid: str, status: str, reason: Optional[str] = None,
-        message: Optional[str] = None, force: bool = False,
+        message: Optional[str] = None, force: bool = False, fence=None,
     ) -> tuple[Optional[dict], bool]:
         """Apply a status transition if legal. Returns (run, changed).
         Atomic: the check + condition insert + status update hold one lock so
         concurrent writers (agent vs executor threads) cannot interleave —
         e.g. a late 'failed' from a killed process must not overwrite
         'stopped'."""
-        return self.transition_many([(uuid, status, reason, message, force)])[0]
+        return self.transition_many([(uuid, status, reason, message, force)],
+                                    fence=fence)[0]
 
     def _get_run_conn(self, conn, uuid: str) -> Optional[dict]:
         row = conn.execute(
@@ -618,7 +862,7 @@ class Store:
         return self._row_to_run(row) if row else None
 
     def transition_many(
-        self, transitions: list[tuple],
+        self, transitions: list[tuple], fence=None,
     ) -> list[tuple[Optional[dict], bool]]:
         """Apply many status transitions in ONE lock hold + ONE commit.
 
@@ -628,12 +872,16 @@ class Store:
         scheduled on one run). Returns (run, changed) per entry, same
         semantics as :meth:`transition`. Listeners fire after the batch
         commits, in order, only for applied transitions — so a burst of
-        lifecycle updates is one fsync, not 3 transactions each."""
+        lifecycle updates is one fsync, not 3 transactions each.
+        ``fence=(lease_name, token)`` rejects the whole batch with
+        :class:`StaleLeaseError` when a newer lease acquisition exists —
+        a stale agent's promotion wave cannot land after a takeover."""
         results: list[tuple[Optional[dict], bool]] = []
         applied: list[tuple[str, str]] = []
         with self._transition_lock:
             with self._conn_ctx() as conn:
                 try:
+                    self._check_fence(conn, fence)
                     self._transition_batch(conn, transitions, results, applied)
                 except BaseException:
                     # a mid-batch error (bad status string, corrupt row)
@@ -727,3 +975,45 @@ class Store:
                 "SELECT artifact FROM lineage WHERE run_uuid=? ORDER BY id", (uuid,)
             ).fetchall()
         return [json.loads(r[0]) for r in rows]
+
+
+class FencedStore:
+    """Write-fencing proxy over a :class:`Store` (or any store-shaped
+    wrapper, e.g. the chaos FaultyStore).
+
+    Every lifecycle write — run creation, transition batches, run updates,
+    launch-intent stamping — is stamped with the caller's CURRENT lease
+    fence, read lazily per call from ``fence_source`` (None = no lease
+    held = unfenced, preserving direct-call test semantics). The agent
+    hands this proxy to everything that writes on its behalf (pipeline
+    drivers, the zombie reaper, executor callbacks), so a takeover fences
+    out every code path at once instead of each call site remembering to.
+
+    ``on_stale`` fires (once per rejection, outside any store lock) before
+    the :class:`StaleLeaseError` propagates — the agent uses it to demote
+    itself to standby."""
+
+    _FENCED = ("create_run", "create_runs", "transition", "transition_many",
+               "update_run", "merge_outputs", "record_launch_intent",
+               "mark_launched", "adopt_launch")
+
+    def __init__(self, inner, fence_source, on_stale=None):
+        self._inner = inner
+        self._fence_source = fence_source
+        self._on_stale = on_stale
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name in self._FENCED and callable(attr):
+            def _fenced(*a: Any, _attr=attr, **kw: Any) -> Any:
+                if "fence" not in kw:
+                    kw["fence"] = self._fence_source()
+                try:
+                    return _attr(*a, **kw)
+                except StaleLeaseError:
+                    if self._on_stale is not None:
+                        self._on_stale()
+                    raise
+
+            return _fenced
+        return attr

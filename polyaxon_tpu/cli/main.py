@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 from pathlib import Path
 from typing import Optional
@@ -673,9 +674,21 @@ def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_tok
     )
     agent.start()
     click.echo(f"polyaxon_tpu server on {srv.url} (agent: {max_parallel} parallel)")
+
+    # graceful SIGTERM drain (ISSUE 4 satellite): finish the in-flight
+    # transition batch, release the scheduler lease explicitly — a
+    # supervisor-restarted successor acquires instantly instead of waiting
+    # out the TTL — leave runs/pods for it to adopt, exit 0.
+    import signal
+
+    drain = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: drain.set())
     try:
-        while True:
-            time.sleep(3600)
+        while not drain.wait(timeout=3600):
+            pass
+        click.echo("SIGTERM: draining agent (lease released for successor)")
+        agent.drain()
+        srv.stop()
     except KeyboardInterrupt:
         agent.stop()
         srv.stop()

@@ -1,3 +1,5 @@
 """API clients (upstream RunClient/ProjectClient equivalents)."""
 
-from .client import ApiError, BaseClient, ProjectClient, RunClient, TokenClient
+from .client import (
+    AgentClient, ApiError, BaseClient, ProjectClient, RunClient, TokenClient,
+)

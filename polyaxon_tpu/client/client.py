@@ -86,6 +86,18 @@ class ProjectClient(BaseClient):
         return self._json("GET", "/api/v1/projects")
 
 
+class AgentClient(BaseClient):
+    """Control-plane observability: who holds the scheduler lease."""
+
+    def lease(self, name: str = "scheduler") -> Optional[dict]:
+        """The live agent lease row ({holder, token, ttl, renewed_at,
+        expired}), or None when no agent has ever acquired (or the last
+        one released on drain). ``expired: true`` means the holder stopped
+        renewing — a successor may take over at any moment."""
+        return self._json("GET", "/api/v1/agent/lease",
+                          params={"name": name}).get("lease")
+
+
 class TokenClient(BaseClient):
     """Token administration (RBAC-lite): mint/list/revoke access tokens."""
 

@@ -38,6 +38,10 @@ class PodStatus:
     phase: PodPhase
     exit_code: Optional[int] = None
     message: Optional[str] = None
+    # deletionTimestamp set: the pod is on its way out (K8s DELETE is
+    # async). Adoption/resync must not treat such a pod as a live member
+    # of the slice — it will vanish moments later.
+    terminating: bool = False
 
 
 class Cluster(ABC):
@@ -67,10 +71,23 @@ class Cluster(ABC):
         a real cluster resolves the Service DNS name."""
         return "127.0.0.1"
 
+    def run_pods(self, label_key: str = "app.polyaxon.com/run",
+                 ) -> dict[str, list[PodStatus]]:
+        """ONE listing of every framework pod, grouped by run uuid (the
+        ``label_key`` value) — the agent's cold-start resync uses this to
+        classify every in-flight run with a single cluster call instead of
+        one ``pod_statuses`` per run. Backends without a grouped listing
+        may raise ``NotImplementedError``; the resync falls back to
+        per-run queries."""
+        raise NotImplementedError
+
 
 def _match_labels(manifest: dict, selector: dict[str, str]) -> bool:
+    """K8s-style equality selectors; a ``None`` value means key-existence
+    (same contract as ``KubeCluster._selector``)."""
     labels = (manifest.get("metadata") or {}).get("labels") or {}
-    return all(labels.get(k) == v for k, v in selector.items())
+    return all(k in labels if v is None else labels.get(k) == v
+               for k, v in selector.items())
 
 
 @dataclass
@@ -118,6 +135,13 @@ class FakeCluster(Cluster):
         self._lock = threading.Lock()
         # observability for tests: every env block a pod was launched with
         self.launched_env: dict[str, dict[str, str]] = {}
+        # launch-attempt audit (ISSUE 4): every accepted Pod apply counts
+        # against its run label; an apply for a pod name that is still
+        # live is a DUPLICATE launch — the exact bug agent crash-safety
+        # must rule out — recorded here and rejected (a real apiserver
+        # 409s an existing name the same way).
+        self.launch_counts: dict[str, int] = {}
+        self.duplicate_applies: list[str] = []
 
     # -- verbs -------------------------------------------------------------
 
@@ -141,11 +165,17 @@ class FakeCluster(Cluster):
         if kind != "Pod":
             raise ValueError(f"FakeCluster cannot apply kind {kind!r}")
         name = manifest["metadata"]["name"]
+        run_label = ((manifest.get("metadata") or {}).get("labels")
+                     or {}).get("app.polyaxon.com/run")
         with self._lock:
             if name in self.pods:
+                self.duplicate_applies.append(name)
                 raise ValueError(f"pod {name!r} already exists")
             pod = _FakePod(manifest=manifest)
             self.pods[name] = pod
+            if run_label:
+                self.launch_counts[run_label] = \
+                    self.launch_counts.get(run_label, 0) + 1
         self._launch(pod)
 
     def delete(self, kind: str, name: str) -> None:
@@ -186,6 +216,18 @@ class FakeCluster(Cluster):
             return ""
         with open(pod.log_path, encoding="utf-8", errors="replace") as f:
             return f.read()
+
+    def run_pods(self, label_key: str = "app.polyaxon.com/run",
+                 ) -> dict[str, list[PodStatus]]:
+        out: dict[str, list[PodStatus]] = {}
+        with self._lock:
+            pods = list(self.pods.values())
+        for p in pods:
+            labels = (p.manifest.get("metadata") or {}).get("labels") or {}
+            uuid = labels.get(label_key)
+            if uuid:
+                out.setdefault(uuid, []).append(p.phase())
+        return out
 
     def shutdown(self) -> None:
         """Kill every pod process (test teardown / agent stop)."""

@@ -222,6 +222,21 @@ class KubeCluster(Cluster):
                 return ""
             raise
 
+    def run_pods(self, label_key: str = "app.polyaxon.com/run",
+                 ) -> dict[str, list[PodStatus]]:
+        """ONE key-existence listing grouped by run label (the agent's
+        cold-start resync verb — O(1) API calls however many runs are
+        in flight)."""
+        path = (self._resource_path("Pod") + "?labelSelector="
+                + self._selector({label_key: None}))
+        out: dict[str, list[PodStatus]] = {}
+        for item in self._request("GET", path).get("items", []):
+            uuid = ((item.get("metadata") or {}).get("labels")
+                    or {}).get(label_key)
+            if uuid:
+                out.setdefault(uuid, []).append(self._to_status(item))
+        return out
+
     def service_host(self, name: str) -> str:
         """Service DNS name — resolvable from any pod in the cluster, so
         the agent (which runs in-cluster) can proxy ``port-forward``
@@ -341,4 +356,7 @@ class KubeCluster(Cluster):
             if term:
                 exit_code = term.get("exitCode")
                 message = message or term.get("reason")
-        return PodStatus(name, phase, exit_code=exit_code, message=message)
+        return PodStatus(
+            name, phase, exit_code=exit_code, message=message,
+            terminating=bool(pod["metadata"].get("deletionTimestamp")),
+        )

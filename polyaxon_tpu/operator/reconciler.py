@@ -141,6 +141,11 @@ class OperationReconciler:
         reset activeDeadlineSeconds/backoff_limit to zero.
         Returns True when existing pods were adopted."""
         existing = self._c(self.cluster.pod_statuses, op.label_selector)
+        # Terminating pods are not adoptable: K8s DELETE returns before
+        # etcd removal, so a just-deleted set still lists — adopting it
+        # would re-track pods that die moments later and read as a slice
+        # failure that never happened (burning a retry attempt).
+        existing = [s for s in existing if not s.terminating]
         if not existing:
             self.apply(op)
             return False

@@ -17,7 +17,10 @@ Three pieces, one contract:
 See docs/RESILIENCE.md for the failure model and how to run the chaos soak.
 """
 
-from .chaos import ChaosCluster, ChaosConfig, FaultyStore, flaky_http_middleware
+from .chaos import (
+    ChaosCluster, ChaosConfig, FaultyStore, flaky_http_middleware,
+    tear_latest_checkpoint,
+)
 from .heartbeat import ZombieReaper
 from .retry import DEFAULT_HTTP_RETRY, RetryPolicy
 
@@ -29,4 +32,5 @@ __all__ = [
     "RetryPolicy",
     "ZombieReaper",
     "flaky_http_middleware",
+    "tear_latest_checkpoint",
 ]

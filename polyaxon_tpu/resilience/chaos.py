@@ -159,6 +159,22 @@ class ChaosCluster(Cluster):
         self._maybe_api_fault("pod_statuses")
         return self.inner.pod_statuses(label_selector)
 
+    def run_pods(self, label_key: str = "app.polyaxon.com/run"):
+        # the agent's cold-start resync listing: same weather as any other
+        # list verb, so a restart into an API storm is exercised too
+        self._maybe_api_fault("run_pods")
+        return self.inner.run_pods(label_key)
+
+    @property
+    def launch_counts(self):
+        """Per-run pod-apply audit from the wrapped backend (FakeCluster
+        keeps it; the kill-the-agent soak asserts on it)."""
+        return getattr(self.inner, "launch_counts", {})
+
+    @property
+    def duplicate_applies(self):
+        return getattr(self.inner, "duplicate_applies", [])
+
     def pod_logs(self, name: str) -> str:
         self._maybe_api_fault("pod_logs")
         return self.inner.pod_logs(name)
@@ -247,6 +263,11 @@ class FaultyStore:
         "get_run", "get_runs", "list_runs", "create_run", "create_runs",
         "update_run", "transition", "transition_many",
         "merge_outputs", "get_statuses", "heartbeat",
+        # lease + launch-intent verbs (ISSUE 4): acquisition, renewal and
+        # fencing must ride out SQLITE_BUSY weather — a blip during
+        # renewal must not look like a lost lease to the agent
+        "acquire_lease", "renew_lease", "release_lease",
+        "record_launch_intent", "mark_launched", "adopt_launch",
     )
 
     def __init__(self, inner: Any, seed: int = 0, fault_rate: float = 0.2,
@@ -282,5 +303,35 @@ class FaultyStore:
         return attr
 
 
+def tear_latest_checkpoint(ckpt_dir: str,
+                           rng: Optional[random.Random] = None) -> Optional[str]:
+    """Chaos hook (ISSUE 4 satellite): truncate the largest payload file
+    of the NEWEST finalized checkpoint step to half its size — a torn
+    write, exactly what a node dying mid-sync leaves behind. Returns the
+    torn file path (None when no finalized step exists). The checksum
+    manifests (train/checkpoint.py) must catch it and ``restore()`` must
+    fall back to the newest COMPLETE step."""
+    import os
+
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = sorted((int(d) for d in os.listdir(ckpt_dir) if d.isdigit()),
+                   reverse=True)
+    if not steps:
+        return None
+    root = os.path.join(ckpt_dir, str(steps[0]))
+    largest, size = None, 0
+    for dirpath, _, names in os.walk(root):
+        for n in names:
+            p = os.path.join(dirpath, n)
+            if os.path.getsize(p) > size:
+                largest, size = p, os.path.getsize(p)
+    if largest is None:
+        return None
+    with open(largest, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    return largest
+
+
 __all__ = ["ChaosCluster", "ChaosConfig", "FaultyStore",
-           "flaky_http_middleware", "PodPhase"]
+           "flaky_http_middleware", "tear_latest_checkpoint", "PodPhase"]

@@ -56,7 +56,19 @@ class ZombieReaper:
     those get their lease renewed every pass and are never reaped. Any
     other run in ``starting``/``running`` whose lease (heartbeat_at,
     falling back to started_at) is older than ``zombie_after`` seconds is
-    a zombie: retried while budget remains, failed otherwise.
+    a zombie candidate — but a single stale read is not a verdict: the
+    run's sidecar may be alive while its heartbeat WRITE hit a transient
+    store fault (SQLITE_BUSY burst, chaos injection), and reaping it would
+    burn real retry budget on store weather. The reap only fires on TWO
+    CONSECUTIVE passes observing the same run stale (passes are at least
+    ``zombie_after/4`` apart, so a live sidecar heartbeating every second
+    has had hundreds of chances to land a write in between); a fresh beat
+    in between clears the strike.
+
+    Fencing (ISSUE 4): the agent hands the reaper its write-FENCED store,
+    so a stale agent's reaper — woken from a GC pause after a takeover —
+    gets its reap transitions rejected instead of yanking runs the new
+    agent is actively driving.
     """
 
     def __init__(
@@ -80,6 +92,8 @@ class ZombieReaper:
         self._list_runs = list_runs or (
             lambda status: store.list_runs(status=status, limit=500))
         self.reaped: list[tuple[str, str]] = []  # (uuid, action) audit trail
+        # uuid -> consecutive passes seen lease-expired; reap needs 2
+        self._strikes: dict[str, int] = {}
 
     def pass_once(self) -> list[tuple[str, str]]:
         """One renewal + reap pass (rate-limited; a call inside the
@@ -92,18 +106,32 @@ class ZombieReaper:
         self._last_pass = now
         actions: list[tuple[str, str]] = []
         owned = set(self.owned())
+        seen: set = set()
         for status in _REAPABLE:
             for run in self._list_runs(status):
                 uuid = run["uuid"]
+                seen.add(uuid)
                 if uuid in owned:
                     self.store.heartbeat(uuid)
+                    self._strikes.pop(uuid, None)
                     continue
                 age = _age_seconds(run.get("heartbeat_at")
                                    or run.get("started_at")
                                    or run.get("updated_at"))
                 if age is None or age < self.zombie_after:
+                    self._strikes.pop(uuid, None)
                     continue
-                actions.append((uuid, self._reap(run)))
+                # stale row read: first strike only. A live-but-unlucky
+                # sidecar (heartbeat write lost to a transient store
+                # fault) gets a whole inter-pass window to land a fresh
+                # beat before the second strike reaps.
+                strikes = self._strikes.get(uuid, 0) + 1
+                self._strikes[uuid] = strikes
+                if strikes >= 2:
+                    self._strikes.pop(uuid, None)
+                    actions.append((uuid, self._reap(run)))
+        # runs that left the reapable statuses drop their strike state
+        self._strikes = {u: s for u, s in self._strikes.items() if u in seen}
         self.reaped.extend(actions)
         return actions
 

@@ -17,8 +17,9 @@ import traceback
 from typing import Optional
 
 from ..api.app import run_artifacts_dir
-from ..api.store import Store
+from ..api.store import FencedStore, StaleLeaseError, Store
 from ..compiler.resolver import resolve
+from ..resilience.heartbeat import _max_retries
 from ..runtime.local import LocalExecution, LocalExecutor
 from ..schemas.statuses import V1Statuses, is_done
 
@@ -121,19 +122,47 @@ class LocalAgent:
         zombie_after: float = 120.0,
         retry=None,
         use_change_feed: bool = True,
+        lease_ttl: float = 15.0,
+        lease_name: str = "scheduler",
     ):
+        import uuid as uuid_mod
+
         from ..resilience.heartbeat import ZombieReaper
         from ..resilience.retry import DEFAULT_HTTP_RETRY
 
-        self.store = store
+        # Agent crash-safety (ISSUE 4, docs/RESILIENCE.md "Control-plane
+        # crash matrix"): the agent holds a TTL lease in the store with a
+        # monotonic fencing token; ``self.store`` is a write-fencing proxy
+        # that stamps the CURRENT token onto every lifecycle write this
+        # agent (and everything writing on its behalf: pipeline drivers,
+        # the reaper, executor callbacks) issues. A stale incarnation —
+        # double-start, GC pause past the TTL, supervisor restart racing
+        # the old process — can observe but not mutate. ``lease_ttl<=0``
+        # disables leasing (all writes unfenced, single-agent semantics).
+        self.lease_ttl = lease_ttl
+        self.lease_name = lease_name
+        self.lease: Optional[dict] = None
+        self._lease_id = uuid_mod.uuid4().hex
+        self._lease_renewed = 0.0
+        self._dead = False  # set by hard_kill(): poisons every fenced write
+        # set on demotion (rejected renewal / fenced-out write): a demoted
+        # agent's SURVIVING threads must stay fenced too — lease=None alone
+        # would make their writes unfenced, which is the opposite of the
+        # guarantee. Cleared only by a successful re-acquisition.
+        self._fenced_out = False
+        self._suspended = threading.Event()  # chaos hook: GC-pause stand-in
+        self.store = FencedStore(store, self._current_fence,
+                                 on_stale=self._on_stale_lease)
         # transient-failure policy for the sidecar's log/artifact sync
         self.retry = retry if retry is not None else DEFAULT_HTTP_RETRY
         # lease-based failure detection (docs/RESILIENCE.md): runs this
         # agent drives get their heartbeat renewed; runs stuck in
         # starting/running with a stale lease and no live driver are routed
-        # through the retrying/backoff machinery. <=0 disables.
+        # through the retrying/backoff machinery. <=0 disables. The reaper
+        # writes through the fenced proxy: a stale agent's reaper cannot
+        # reap runs the NEW agent is actively driving.
         self.reaper = ZombieReaper(
-            store, owned=self._driven_uuids, zombie_after=zombie_after)
+            self.store, owned=self._driven_uuids, zombie_after=zombie_after)
         self.artifacts_root = os.path.abspath(artifacts_root)
         self.api_host = api_host
         self.api_token = api_token
@@ -186,6 +215,9 @@ class LocalAgent:
         self._block_watermark: Optional[int] = None
         self._pending_fresh = False
         self._need_full = False
+        # runs whose pod listing failed during resync: classification
+        # deferred to the next full pass (never misread as slice loss)
+        self._resync_retry: set[str] = set()
         # change feed (VERDICT r3 weak #8): store events carry *which* runs
         # changed, so a busy loop advances exactly those instead of issuing
         # four status-indexed scans every 0.2s tick. None = overflow -> the
@@ -213,10 +245,103 @@ class LocalAgent:
             self.resync_interval = 0.0  # every poll wake runs a full tick()
             store.add_transition_listener(self._on_hook_event)
 
+    # -- lease lifecycle ---------------------------------------------------
+
+    def _current_fence(self) -> Optional[tuple]:
+        """Fence for the NEXT store write. None = unfenced (leasing off,
+        or direct-call test usage without start()). A hard-killed OR
+        demoted agent returns a poison fence so every late write from its
+        surviving threads (executor callbacks, pipeline drivers, sidecar
+        output merges) is rejected — demotion must not downgrade those
+        writes to UNFENCED, it must keep them out."""
+        if self._dead or self._fenced_out:
+            return ("__dead__", -1)
+        lease = self.lease
+        if lease is None:
+            return None
+        return (self.lease_name, lease["token"])
+
+    def _on_stale_lease(self) -> None:
+        """A fenced write was rejected (or renewal found a newer token):
+        demote to standby immediately — the loop keeps polling for
+        re-acquisition (it becomes the successor if the new holder dies),
+        and until then every write this incarnation attempts stays
+        fenced off via the poison fence."""
+        self._fenced_out = True
+        if self.lease is not None:
+            self.lease = None
+            print(f"[agent {self._lease_id[:8]}] lease fenced out — "
+                  "demoting to standby", flush=True)
+
+    def _try_acquire_lease(self) -> bool:
+        try:
+            lease = self.store.acquire_lease(
+                self.lease_name, self._lease_id, ttl=self.lease_ttl)
+        except Exception:
+            return False  # store weather: stay standby, retry next wake
+        if lease is None:
+            return False
+        self.lease = lease
+        # a fresh acquisition lifts the demotion poison: this incarnation
+        # is the legitimate holder again (hard_kill's _dead never lifts)
+        self._fenced_out = False
+        self._lease_renewed = time.monotonic()
+        return True
+
+    def _lease_tick(self) -> bool:
+        """Hold-or-acquire, called at the top of every loop pass. Returns
+        True when this agent may mutate (lease held or leasing disabled).
+        Standby agents return False and touch nothing. Renewal failures
+        split two ways: a REJECTED renewal (newer token exists) demotes
+        instantly; a store fault (SQLITE_BUSY burst) keeps the lease and
+        retries next pass — the TTL is sized so transient weather never
+        costs the lease (renew every ttl/3)."""
+        if self.lease_ttl <= 0:
+            return True
+        if self.lease is None:
+            if not self._try_acquire_lease():
+                return False
+            # fresh acquisition: this process's view of the world is stale
+            # by construction — rebuild it before scheduling anything
+            self.cold_start_resync()
+            return True
+        now = time.monotonic()
+        if now - self._lease_renewed >= self.lease_ttl / 3.0:
+            try:
+                ok = self.store.renew_lease(
+                    self.lease_name, self._lease_id, self.lease["token"])
+            except Exception:
+                return True  # transient fault: keep going, retry next pass
+            if ok:
+                self._lease_renewed = now
+            else:
+                self._on_stale_lease()
+                return False
+        return True
+
+    def release_lease(self) -> None:
+        """Explicit release (graceful SIGTERM drain): the successor
+        acquires instantly instead of waiting out the TTL."""
+        lease, self.lease = self.lease, None
+        if lease is None:
+            return
+        try:
+            self.store.release_lease(
+                self.lease_name, self._lease_id, lease["token"])
+        except Exception:
+            traceback.print_exc()
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "LocalAgent":
-        self.recover_orphans()
+        if self.lease_ttl <= 0:
+            self.cold_start_resync()
+        elif self._try_acquire_lease():
+            self.cold_start_resync()
+        else:
+            print(f"[agent {self._lease_id[:8]}] lease "
+                  f"{self.lease_name!r} held elsewhere — standing by",
+                  flush=True)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         if self.reconciler is not None and hasattr(self.cluster, "watch_pods"):
@@ -236,6 +361,7 @@ class LocalAgent:
 
     def stop(self) -> None:
         self._stop.set()
+        self._suspended.clear()
         self._wake.set()  # unblock the poll loop immediately
         if self._thread:
             self._thread.join(timeout=10)
@@ -247,32 +373,119 @@ class LocalAgent:
             self._sidecars.clear()
         if self.reconciler is not None and hasattr(self.cluster, "shutdown"):
             self.cluster.shutdown()
+        self.release_lease()
 
-    def recover_orphans(self) -> None:
-        """Re-attach runs left in-flight by a previous agent process
-        (SURVEY.md §5 failure detection). Cluster-backend runs whose pods
-        still exist are adopted by the reconciler (no restart); pods gone =
-        re-applied fresh. Local-executor runs died with the old agent's
-        subprocesses — they fail loudly rather than hang in 'running'.
-        Pipelines (matrix/dag/schedule) lose their driver thread — failed
-        with a clear message; their finished children keep their results."""
-        inflight = []
-        for st in (V1Statuses.SCHEDULED.value, V1Statuses.STARTING.value,
-                   V1Statuses.RUNNING.value, V1Statuses.STOPPING.value):
-            inflight += _list_runs_all(self.store, st)
-        for run in inflight:
+    def drain(self, timeout: float = 10.0) -> None:
+        """Graceful SIGTERM drain: let the in-flight loop pass finish (the
+        loop thread join IS the in-flight transition batch — batches are
+        applied synchronously inside the pass), release the lease so a
+        successor takes over instantly, and leave runs/pods untouched for
+        it to adopt. Unlike :meth:`stop`, nothing is torn down."""
+        self._stop.set()
+        self._suspended.clear()
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=timeout)
+        with self._lock:
+            for sc in self._sidecars.values():
+                sc.stop_evt.set()
+            self._sidecars.clear()
+        self.release_lease()
+
+    def hard_kill(self) -> None:
+        """Chaos hook: the closest in-process stand-in for SIGKILL. Stops
+        the loop/sidecar threads and poisons the write fence so any
+        surviving thread's late write (pipeline drivers, executor
+        callbacks) is rejected exactly like a dead process's would never
+        arrive. Deliberately does NOT release the lease, tear down pods,
+        or stop executors — the successor must win by TTL expiry or
+        fencing, and adopt or relaunch the survivors."""
+        self._dead = True
+        self._stop.set()
+        self._suspended.clear()
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        with self._lock:
+            for sc in self._sidecars.values():
+                sc.stop_evt.set()
+            self._sidecars.clear()
+
+    def suspend(self) -> None:
+        """Chaos hook: freeze the poll loop mid-flight (a GC pause / SIGSTOP
+        stand-in). The agent stops renewing its lease; past the TTL a
+        successor may acquire, and on :meth:`resume` every write this
+        incarnation attempts is fenced off."""
+        self._suspended.set()
+
+    def resume(self) -> None:
+        self._suspended.clear()
+        self._wake.set()
+
+    _INFLIGHT = (V1Statuses.SCHEDULED.value, V1Statuses.STARTING.value,
+                 V1Statuses.RUNNING.value)
+
+    def cold_start_resync(self) -> None:
+        """Rebuild this agent's entire in-memory world from ONE
+        ``created_at ASC`` store scan plus ONE cluster pod listing
+        (SURVEY.md §5 failure detection; ISSUE 4 tentpole (c)).
+
+        Rebuilt state: the capacity wait queue (FIFO, chip demand cached
+        at admission — the exact pre-crash order, since both orders are
+        created_at ASC), the budget watermark (cleared: first walk
+        recomputes it), and the reconciler's tracked set. In-flight runs
+        are classified through their write-ahead launch intent:
+
+        - state='intent' (crash between the intent commit and the cluster
+          accepting every manifest): any partial pod set is torn down and
+          the run relaunched under a bumped attempt — never a duplicate,
+          because the teardown precedes the apply.
+        - pods alive: adopt — re-track without re-applying, re-own
+          meta.owner under the new lease, re-attach the streaming sidecar.
+        - state='launched' but pods gone (the cluster lost the slice while
+          nobody watched): slice loss, routed through the EXISTING
+          retry/backoff machinery — retrying→queued while
+          ``termination.maxRetries`` budget remains, failed loudly after.
+
+        Local-executor runs died with the old agent's subprocesses — they
+        fail loudly rather than hang in 'running'. Pipelines
+        (matrix/dag/schedule) lose their driver thread — failed with a
+        clear message; finished children keep their results."""
+        self._resync_retry.clear()
+        scan_statuses = [V1Statuses.QUEUED.value, *self._INFLIGHT,
+                         V1Statuses.STOPPING.value]
+        runs: list[dict] = []
+        offset = 0
+        while True:
+            page = self.store.list_runs(statuses=scan_statuses, limit=500,
+                                        offset=offset, order="asc")
+            runs += page
+            if len(page) < 500:
+                break
+            offset += 500
+        pods_by_run = self._cluster_pods_by_run(
+            [r["uuid"] for r in runs if r["status"] in self._INFLIGHT])
+        self._pending.clear()
+        self._pending_set.clear()
+        self._block_watermark = None
+        for run in runs:  # created_at ASC: FIFO admission order preserved
             uuid = run["uuid"]
+            status = run["status"]
+            if status == V1Statuses.QUEUED.value:
+                self._enqueue_pending(run)
+                continue
             if uuid in self._active or uuid in self._tuners or (
-                    self.reconciler is not None and self.reconciler.is_tracked(uuid)):
+                    self.reconciler is not None
+                    and self.reconciler.is_tracked(uuid)):
                 continue
             spec = run.get("spec") or {}
-            if run["status"] == V1Statuses.STOPPING.value:
+            if status == V1Statuses.STOPPING.value:
                 # the previous agent died mid-stop: finish the teardown so
                 # cluster pods don't leak
                 if self.reconciler is not None:
                     try:
-                        self.cluster.delete_selected(
-                            {"app.polyaxon.com/run": uuid})
+                        self._cluster_call(self.cluster.delete_selected,
+                                           {"app.polyaxon.com/run": uuid})
                     except Exception:
                         traceback.print_exc()
                 self.store.transition(uuid, V1Statuses.STOPPED.value, force=True)
@@ -284,42 +497,155 @@ class LocalAgent:
                     message="pipeline driver lost in agent restart",
                 )
                 continue
-            adopted = False
-            if self.reconciler is not None:
-                try:
-                    resolved = resolve(
-                        run["compiled"] or spec, run_uuid=uuid,
-                        project=run["project"],
-                        artifacts_path=run_artifacts_dir(
-                            self.artifacts_root, run["project"], uuid),
-                        api_host=self.api_host, api_token=self.api_token,
-                        connections=self.connections,
-                    )
-                    if self._use_cluster(resolved):
-                        elapsed = 0.0
-                        if run.get("started_at"):
-                            from datetime import datetime, timezone
-
-                            elapsed = max(
-                                (datetime.now(timezone.utc)
-                                 - datetime.fromisoformat(run["started_at"])
-                                 ).total_seconds(), 0.0)
-                        retries = sum(
-                            1 for c in self.store.get_statuses(uuid)
-                            if c.get("type") == V1Statuses.RETRYING.value)
-                        self.reconciler.adopt(
-                            self._operation_cr(uuid, resolved),
-                            elapsed_s=elapsed, retries_done=retries)
-                        adopted = True
-                except Exception:
-                    traceback.print_exc()
-            if not adopted and not (self.reconciler is not None
-                                    and self.reconciler.is_tracked(uuid)):
+            pods = pods_by_run.get(uuid, [])
+            if pods is None:
+                # the cluster listing failed for this run: we know NOTHING
+                # about its pods — park it for re-classification on the
+                # next full pass instead of misreading live pods as lost
+                self._resync_retry.add(uuid)
+                continue
+            if not self._resync_inflight(run, pods):
                 self.store.transition(
                     uuid, V1Statuses.FAILED.value, force=True,
                     reason="AgentRestart",
                     message="orphaned by agent restart (local process lost)",
                 )
+        self._pending_fresh = True
+
+    # the pre-ISSUE-4 public name; direct callers (tests, embedding code)
+    # keep working
+    recover_orphans = cold_start_resync
+
+    def _cluster_call(self, fn, *args):
+        """Cluster verb through the reconciler's bounded retry (resync
+        must ride out API weather, not stall on it)."""
+        if self.reconciler is not None:
+            return self.reconciler.retry.call(fn, *args)
+        return fn(*args)
+
+    def _cluster_pods_by_run(self, inflight_uuids: list) -> dict:
+        """{run_uuid: [PodStatus] | None} for every in-flight run — ONE
+        grouped listing when the backend supports it, per-run queries
+        otherwise. ``None`` means the listing FAILED for that run: the
+        caller must treat it as *unknown* and defer classification — an
+        API outage must never read as 'pod set gone' and burn retry
+        budget (or duplicate pods) for runs whose slices are alive."""
+        if self.reconciler is None or not inflight_uuids:
+            return {}
+        try:
+            listing = self._cluster_call(self.cluster.run_pods)
+            return {u: listing.get(u, []) for u in inflight_uuids}
+        except NotImplementedError:
+            pass
+        except Exception:
+            traceback.print_exc()
+            return {u: None for u in inflight_uuids}
+        out = {}
+        for uuid in inflight_uuids:
+            try:
+                out[uuid] = self._cluster_call(
+                    self.cluster.pod_statuses, {"app.polyaxon.com/run": uuid})
+            except Exception:
+                traceback.print_exc()
+                out[uuid] = None
+        return out
+
+    def _resync_inflight(self, run: dict, pods: list) -> bool:
+        """Classify one scheduled/starting/running run against the cluster
+        and its launch intent. Returns False for a local orphan (caller
+        fails it loudly)."""
+        uuid = run["uuid"]
+        if self.reconciler is None:
+            return False
+        try:
+            resolved = resolve(
+                run["compiled"] or run.get("spec") or {}, run_uuid=uuid,
+                project=run["project"],
+                artifacts_path=run_artifacts_dir(
+                    self.artifacts_root, run["project"], uuid),
+                api_host=self.api_host, api_token=self.api_token,
+                connections=self.connections,
+            )
+            if not self._use_cluster(resolved):
+                return False
+            intent = self.store.get_launch_intent(uuid)
+            token = self.lease["token"] if self.lease else None
+            # a pod already being deleted is not a live slice member —
+            # count only pods that will still exist in a moment
+            pods = [p for p in pods if not p.terminating]
+            if intent is not None and intent["state"] == "intent":
+                # write-ahead intent, launch unconfirmed: the old agent
+                # died between the intent commit and the cluster call —
+                # possibly mid-apply. Tear down any partial set, then
+                # relaunch under a bumped attempt. Idempotent: there is
+                # never a moment with two live pod sets. Apply, not
+                # adopt: on real K8s the delete is async and adopt could
+                # observe the old pods still Terminating — apply replaces
+                # them (KubeCluster rides out the 409 window).
+                self._cluster_call(self.cluster.delete_selected,
+                                   {"app.polyaxon.com/run": uuid})
+                self.store.record_launch_intent(
+                    uuid, self._lease_id, token, lease_name=self.lease_name)
+                self.reconciler.apply(self._operation_cr(uuid, resolved))
+                self.store.mark_launched(uuid)
+                return True
+            if pods:
+                # pods alive, row stale: adopt — re-track WITHOUT
+                # re-applying, re-own under the new lease
+                elapsed = 0.0
+                if run.get("started_at"):
+                    from datetime import datetime, timezone
+
+                    elapsed = max(
+                        (datetime.now(timezone.utc)
+                         - datetime.fromisoformat(run["started_at"])
+                         ).total_seconds(), 0.0)
+                retries = sum(
+                    1 for c in self.store.get_statuses(uuid)
+                    if c.get("type") == V1Statuses.RETRYING.value)
+                self.reconciler.adopt(
+                    self._operation_cr(uuid, resolved),
+                    elapsed_s=elapsed, retries_done=retries)
+                self.store.adopt_launch(uuid, self._lease_id, token)
+                return True
+            if intent is None and run["status"] == V1Statuses.SCHEDULED.value:
+                # crash in the window between the 'scheduled' transition
+                # and the intent commit: the write-ahead intent precedes
+                # the first cluster call, so nothing was ever launched —
+                # re-queue for a normal launch, burning NO retry budget
+                # (this is not a slice loss, it's a launch that never
+                # started)
+                self.store.transition(
+                    uuid, V1Statuses.QUEUED.value, force=True,
+                    reason="AgentRestart",
+                    message="agent died before the launch intent; re-queued")
+                return True
+            # launched (or a pre-intent legacy row that made it past
+            # scheduled) and the pod set is gone: slice loss while nobody
+            # watched — the existing retry/backoff path decides, exactly
+            # like a slice failure under a live agent
+            retries = sum(
+                1 for c in self.store.get_statuses(uuid)
+                if c.get("type") == V1Statuses.RETRYING.value)
+            budget = _max_retries(run)
+            if retries < budget:
+                self.store.transition_many([
+                    (uuid, V1Statuses.RETRYING.value, "AgentRestart",
+                     f"pod set lost across agent restart; attempt "
+                     f"{retries + 2}/{budget + 1}", True),
+                    (uuid, V1Statuses.QUEUED.value),
+                ])
+            else:
+                self.store.transition(
+                    uuid, V1Statuses.FAILED.value, force=True,
+                    reason="AgentRestart",
+                    message="pod set lost across agent restart; no retry "
+                            "budget left")
+            return True
+        except Exception:
+            traceback.print_exc()
+            return self.reconciler.is_tracked(uuid)
+
 
     def _driven_uuids(self) -> set:
         """Runs with a LIVE driver in this agent: executor threads still
@@ -568,7 +894,18 @@ class LocalAgent:
             self._wake.clear()
             if self._stop.is_set():
                 return
+            while self._suspended.is_set() and not self._stop.is_set():
+                time.sleep(0.01)  # chaos hook: GC-pause stand-in
+            if self._stop.is_set():
+                return
             try:
+                # inside the try: a fresh acquisition runs cold_start_resync,
+                # whose fenced writes can raise StaleLeaseError (another
+                # standby outran our TTL mid-resync) — escaping here would
+                # kill the loop thread and this agent could never become
+                # the successor again
+                if not self._lease_tick():
+                    continue  # standby: observe (dirty accrues), mutate nothing
                 with self._dirty_lock:
                     dirty = self._dirty
                     self._dirty = set()
@@ -594,6 +931,10 @@ class LocalAgent:
                     self._tick_dirty(dirty)
                 else:
                     self._idle_pass()
+            except StaleLeaseError:
+                # fenced out mid-pass: _on_stale_lease already demoted us;
+                # the pass's partial work is someone else's to redo
+                continue
             except Exception:
                 traceback.print_exc()
 
@@ -631,6 +972,8 @@ class LocalAgent:
         self._schedule_pending()
         for run in self.store.list_runs(status=V1Statuses.STOPPING.value):
             self._do_stop(run)
+        if self._resync_retry:
+            self._retry_resync_classification()
         if self.reconciler is not None:
             self.reconciler.reconcile_once()
             self._reconcile_sidecars()
@@ -638,6 +981,39 @@ class LocalAgent:
             self.reaper.pass_once()
         except Exception:
             traceback.print_exc()
+
+    def _retry_resync_classification(self) -> None:
+        """Classify runs whose pod listing failed during cold-start resync,
+        now that the cluster may be reachable again. They stay parked —
+        neither failed, relaunched, nor adopted — until a listing for them
+        succeeds; an unreachable API defers again to the next full pass."""
+        for uuid in list(self._resync_retry):
+            try:
+                run = self.store.get_run(uuid)
+            except Exception:
+                traceback.print_exc()
+                continue
+            if run is None or run["status"] not in self._INFLIGHT:
+                self._resync_retry.discard(uuid)
+                continue
+            if uuid in self._active or uuid in self._tuners or (
+                    self.reconciler is not None
+                    and self.reconciler.is_tracked(uuid)):
+                self._resync_retry.discard(uuid)
+                continue
+            try:
+                pods = self._cluster_call(
+                    self.cluster.pod_statuses, {"app.polyaxon.com/run": uuid})
+            except Exception:
+                traceback.print_exc()
+                continue  # still unreachable: retry next full pass
+            self._resync_retry.discard(uuid)
+            if not self._resync_inflight(run, pods):
+                self.store.transition(
+                    uuid, V1Statuses.FAILED.value, force=True,
+                    reason="AgentRestart",
+                    message="orphaned by agent restart (local process lost)",
+                )
 
     def _tick_dirty(self, dirty: set) -> None:
         """Event-driven pass, O(dirty): advance exactly the runs the change
@@ -1037,7 +1413,18 @@ class LocalAgent:
         )
 
     def _submit_to_cluster(self, uuid: str, resolved) -> None:
+        # write-ahead launch intent (ISSUE 4 tentpole (b)): commit
+        # {lease_id, token, attempt} to the store — run row's meta.owner +
+        # the intent table — BEFORE the first cluster call, so a crash at
+        # any point leaves enough on disk for the successor to distinguish
+        # "pods never created" (relaunch) from "pods live" (adopt). The
+        # fence rides along: a stale agent cannot even record the intent.
+        self.store.record_launch_intent(
+            uuid, self._lease_id,
+            self.lease["token"] if self.lease else None,
+            lease_name=self.lease_name)
         self.reconciler.apply(self._operation_cr(uuid, resolved))
+        self.store.mark_launched(uuid)
 
     def _do_stop(self, run: dict) -> None:
         uuid = run["uuid"]

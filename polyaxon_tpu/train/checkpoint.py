@@ -5,11 +5,27 @@ sidecar syncs, resume = clone-with-restart; SURVEY.md §5). Here the runtime
 owns it: async Orbax saves off the critical path, `save_interval_steps` from
 the run spec, and auto-resume picks up the latest step after a slice
 restart (failure model: all-or-nothing per ICI slice).
+
+Crash-safety (ISSUE 4 satellite): Orbax already publishes a step atomically
+(write to a tmp-suffixed dir, fsync, rename), but atomic-publish alone
+cannot catch a checkpoint torn AFTER publish — a truncated shard from a
+preempted artifacts sync, filesystem corruption, a partially-copied restore
+dir. So every completed save also gets a per-step **checksum manifest**
+(``manifest-<step>.json`` beside the step dir, itself written tmp + fsync +
+atomic rename + dir fsync): sha256 + size per file. ``restore()`` walks
+steps newest-first and silently skips any step whose manifest check (or
+Orbax read) fails, resuming from the newest COMPLETE step instead of dying
+on — or worse, silently training from — a torn one. The chaos soak proves
+it by truncating the latest step mid-kill and asserting resume from the
+previous one.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import threading
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -32,44 +48,233 @@ class Checkpointer:
 
         self._ocp = ocp
         self.cfg = cfg
+        self.directory = os.path.abspath(cfg.directory)
         os.makedirs(cfg.directory, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
             save_interval_steps=cfg.save_interval_steps,
             max_to_keep=cfg.max_to_keep,
             enable_async_checkpointing=cfg.async_save,
         )
-        self.manager = ocp.CheckpointManager(
-            os.path.abspath(cfg.directory), options=options
-        )
+        self.manager = ocp.CheckpointManager(self.directory, options=options)
+        # serializes manifest flushes: the background flush thread vs the
+        # synchronous flushes in wait()/close()/complete_steps_desc()
+        self._flush_lock = threading.Lock()
+        self._flush_thread: Optional[threading.Thread] = None
 
     def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
         """Save if the interval policy says so. Async: returns immediately."""
-        return self.manager.save(
+        saved = self.manager.save(
             step, args=self._ocp.args.StandardSave(state), force=force
         )
+        self._schedule_flush()
+        return saved
+
+    # -- checksum manifests ------------------------------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"manifest-{step}.json")
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    @staticmethod
+    def _sha256(path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    def _hash_tree(self, step: int) -> dict:
+        root = self._step_dir(step)
+        files: dict = {}
+        for dirpath, _, names in os.walk(root):
+            for n in sorted(names):
+                p = os.path.join(dirpath, n)
+                files[os.path.relpath(p, root)] = {
+                    "sha256": self._sha256(p),
+                    "size": os.path.getsize(p),
+                }
+        return files
+
+    def _write_manifest(self, step: int) -> None:
+        payload = {"step": step, "complete": True,
+                   "files": self._hash_tree(step)}
+        path = self._manifest_path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic publish: readers see all or nothing
+        # fsync the parent dir so the rename itself survives power loss
+        dfd = os.open(self.directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    def _schedule_flush(self) -> None:
+        """Manifest hashing reads + sha256s whole finalized step dirs —
+        with async saves that work stays off the training step path too
+        (a background thread, mirroring Orbax's own async finalize).
+        Sync mode keeps it inline so callers see manifests immediately.
+        A step that finalizes while a flush is mid-run is picked up by
+        the next flush (next save, wait(), close(), or — after a crash —
+        the restarted process's backfill)."""
+        if not self.cfg.async_save:
+            self._flush_manifests()
+            return
+        t = self._flush_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(target=self._flush_manifests,
+                             name="ckpt-manifest", daemon=True)
+        self._flush_thread = t
+        t.start()
+
+    def _flush_manifests(self) -> None:
+        """Write a manifest for every finalized step that lacks one, and
+        GC manifests whose step dir was rotated out by max_to_keep. Driven
+        by the filesystem, not in-memory state: Orbax's atomic rename
+        means the pure-digit dir's presence IS save completion, so a step
+        finalized right before a crash gets its manifest backfilled by
+        the restarted process instead of being mistaken for torn (and
+        purged) just because the old process died pre-flush."""
+        with self._flush_lock:
+            live = set(self.manager.all_steps())
+            for step in sorted(live):
+                if os.path.exists(self._manifest_path(step)):
+                    continue
+                try:
+                    self._write_manifest(step)
+                except OSError:
+                    continue  # retry on the next flush
+            try:
+                for name in os.listdir(self.directory):
+                    if name.startswith("manifest-") and name.endswith(".json"):
+                        step_s = name[len("manifest-"):-len(".json")]
+                        if step_s.isdigit() and int(step_s) not in live:
+                            os.unlink(os.path.join(self.directory, name))
+            except OSError:
+                pass
+
+    def verify_step(self, step: int) -> bool:
+        """True iff the step has a manifest and every file matches it —
+        size first (cheap, catches truncation), then sha256."""
+        try:
+            with open(self._manifest_path(step), encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if not manifest.get("complete"):
+            return False
+        root = self._step_dir(step)
+        for rel, info in (manifest.get("files") or {}).items():
+            p = os.path.join(root, rel)
+            try:
+                if os.path.getsize(p) != info["size"]:
+                    return False
+                if self._sha256(p) != info["sha256"]:
+                    return False
+            except OSError:
+                return False
+        return True
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 
+    def complete_steps_desc(self) -> list[int]:
+        """Restorable steps, newest first. With manifests: only steps that
+        verify. Without any manifest (a pre-manifest checkpoint dir):
+        every step, trusting Orbax's atomic publish — skipping them all
+        would break resume for existing runs."""
+        self._flush_manifests()
+        steps = sorted(self.manager.all_steps(), reverse=True)
+        if not any(os.path.exists(self._manifest_path(s)) for s in steps):
+            return steps
+        return [s for s in steps if self.verify_step(s)]
+
+    def latest_complete_step(self) -> Optional[int]:
+        steps = self.complete_steps_desc()
+        return steps[0] if steps else None
+
     def restore(self, state_like: Any, step: Optional[int] = None) -> tuple[Any, int]:
-        """Restore latest (or given) step. ``state_like`` provides structure +
-        shardings: pass the freshly-initialized (possibly sharded) state."""
-        step = step if step is not None else self.manager.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"No checkpoint under {self.cfg.directory}")
+        """Restore the newest COMPLETE step (or the given one). ``state_like``
+        provides structure + shardings: pass the freshly-initialized
+        (possibly sharded) state. With ``step=None`` a torn/corrupt newest
+        step — checksum mismatch, or an Orbax read error on a step without
+        a manifest — is skipped and the next older complete step restores
+        instead; only when EVERY candidate fails does this raise."""
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
             if hasattr(x, "shape") else x,
             state_like,
         )
-        restored = self.manager.restore(
-            step, args=self._ocp.args.StandardRestore(abstract)
-        )
-        return restored, step
+        candidates = [step] if step is not None else self.complete_steps_desc()
+        if not candidates:
+            # every step failed verification (or the dir is empty): clear
+            # the dead steps — Orbax skips save(step) for any step number
+            # already on disk, so leaving them would silently block the
+            # fresh-start run from ever checkpointing below that step
+            if step is None:
+                self._purge_newer_than(-1)
+            raise FileNotFoundError(
+                f"No complete checkpoint under {self.cfg.directory}")
+        errors: list = []
+        for s in candidates:
+            try:
+                restored = self.manager.restore(
+                    s, args=self._ocp.args.StandardRestore(abstract)
+                )
+            except Exception as e:  # torn step Orbax choked on: fall back
+                if step is not None:
+                    raise
+                errors.append((s, repr(e)))
+                continue
+            if step is None:
+                self._purge_newer_than(s)
+            return restored, s
+        if step is None:  # same fresh-start-can-save guarantee as above
+            self._purge_newer_than(-1)
+        raise FileNotFoundError(
+            f"No restorable checkpoint under {self.cfg.directory}; "
+            f"every candidate failed: {errors}")
+
+    def _purge_newer_than(self, step: int) -> None:
+        """Remove every step NEWER than the one we restored (``-1``:
+        every step — the all-candidates-failed fresh start) — leaving
+        their dirs behind would collide with the resumed run's own save
+        when it reaches those step numbers again. A step PROVEN torn
+        (its manifest fails verification) is deleted outright; one that
+        merely failed the Orbax read while its bytes were never shown
+        bad (possibly a transient I/O error, not corruption) is copied
+        to a ``quarantine-<step>`` dir first, so the run's newest state
+        stays recoverable by hand instead of being irreversibly
+        discarded on a one-off fault."""
+        import shutil
+
+        for bad in [s for s in self.manager.all_steps() if s > step]:
+            proven_torn = (os.path.exists(self._manifest_path(bad))
+                           and not self.verify_step(bad))
+            if not proven_torn:
+                dst = os.path.join(self.directory, f"quarantine-{bad}")
+                shutil.rmtree(dst, ignore_errors=True)
+                try:
+                    shutil.copytree(self._step_dir(bad), dst)
+                except OSError:
+                    pass  # quarantine is best-effort; the removal is not
+            try:
+                self.manager.delete(bad)
+            except Exception:
+                shutil.rmtree(self._step_dir(bad), ignore_errors=True)
+        self._flush_manifests()  # drops the dead steps' manifests too
 
     def wait(self) -> None:
         self.manager.wait_until_finished()
+        self._flush_manifests()
 
     def close(self) -> None:
         self.manager.wait_until_finished()
+        self._flush_manifests()
         self.manager.close()

@@ -192,8 +192,16 @@ class Trainer:
     def restore_or_init(self, seed: int = 0) -> tuple[TrainState, int]:
         state = self.init_state(seed)
         if self.checkpointer and self.checkpointer.latest_step() is not None:
-            state, step = self.checkpointer.restore(state)
-            return state, step
+            try:
+                # skips torn/corrupt steps via the checksum manifests and
+                # restores the newest COMPLETE one (train/checkpoint.py)
+                state, step = self.checkpointer.restore(state)
+                return state, step
+            except FileNotFoundError:
+                # every candidate failed verification: a fresh start beats
+                # training from (or crashing on) a torn checkpoint
+                print("[trainer] no complete checkpoint survived "
+                      "verification; starting from step 0", flush=True)
         return state, 0
 
     # -- the step ----------------------------------------------------------

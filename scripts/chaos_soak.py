@@ -1,28 +1,36 @@
 """Manual chaos soak driver (docs/RESILIENCE.md).
 
-Drives a DAG + a grid matrix sweep through the full agent/operator stack
-while a seed-driven fault schedule injects cluster API 5xx/429/timeouts
-and pod preemptions, then compares every run's terminal status against a
-fault-free oracle pass. Exit code 0 iff the chaotic pass converges to the
-oracle.
+Default mode drives a DAG + a grid matrix sweep through the full
+agent/operator stack while a seed-driven fault schedule injects cluster
+API 5xx/429/timeouts and pod preemptions, then compares every run's
+terminal status against a fault-free oracle pass. Exit code 0 iff the
+chaotic pass converges to the oracle.
+
+``--kill-agent`` switches to the control-plane crash soak (ISSUE 4): a
+wave of cluster jobs while the AGENT itself is SIGKILLed and restarted
+mid-wave (``--kills`` times, seeded timing); ``--split-brain`` adds a
+round where a GC-paused incumbent and a fresh successor are BOTH live.
+Convergence to the oracle plus ZERO duplicate pod launches plus >=1
+exercised fencing rejection are all required for exit 0.
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/chaos_soak.py \
         [--seed 2024] [--fault-rate 0.08] [--timeout-rate 0.02] \
         [--preempt-rate 0.03] [--max-preemptions 2] [--trials 3] \
-        [--rounds 1] [--keep]
+        [--rounds 1] [--keep] \
+        [--kill-agent] [--split-brain] [--kills 2] [--lease-ttl 0.8]
 
 Every knob maps 1:1 onto ChaosConfig; --rounds repeats the chaotic pass
 with seed, seed+1, ... for endurance sweeps. The pytest-integrated proofs
-live in tests/test_chaos_soak.py (slow) and tests/test_resilience.py
-(tier-1 smoke).
-"""
+live in tests/test_chaos_soak.py (slow) and tests/test_resilience.py +
+tests/test_leases.py (tier-1 smoke)."""
 
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import random
 import shutil
 import sys
 import tempfile
@@ -110,6 +118,187 @@ def _pass(workdir: str, trials: int, chaos_cfg=None, timeout: float = 600.0):
         agent.stop()
 
 
+def _wave_specs(n_jobs: int, rng: random.Random):
+    """A wave of cluster jobs with seeded durations + retry budget — the
+    kill-the-agent fixture (pipelines deliberately excluded: a pipeline
+    driver is in-process state and fails loudly on restart by design;
+    pod-launch idempotency is what this soak proves)."""
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    specs = []
+    for i in range(n_jobs):
+        sleep = round(rng.uniform(0.3, 2.0), 2)
+        specs.append(check_polyaxonfile({
+            "kind": "operation",
+            "name": f"wave-{i}",
+            "termination": {"maxRetries": 3},
+            "component": {"kind": "component", "run": {
+                "kind": "job",
+                "container": {"command": [
+                    sys.executable, "-c",
+                    f"import time, json, os; time.sleep({sleep}); "
+                    "json.dump({'ok': 1}, open(os.path.join("
+                    "os.environ['PLX_ARTIFACTS_PATH'], 'outputs.json'), 'w'))",
+                ]}}},
+        }).to_dict())
+    return specs
+
+
+def run_kill_agent_soak(workdir: str, seed: int = 2024, n_jobs: int = 8,
+                        kills: int = 2, split_brain: bool = False,
+                        chaos_cfg=None, lease_ttl: float = 0.8,
+                        timeout: float = 300.0) -> dict:
+    """One kill-the-agent pass: drive a job wave, hard-kill + restart the
+    agent at seeded times (and optionally run a split-brain round), and
+    return statuses + every crash-safety counter. ``kills=0`` and
+    ``split_brain=False`` is the fault-free oracle."""
+    from polyaxon_tpu.api.store import StaleLeaseError, Store
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.resilience import ChaosCluster
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    rng = random.Random(seed)
+    store = Store(":memory:")
+    cluster = FakeCluster(os.path.join(workdir, ".cluster"))
+    if chaos_cfg is not None:
+        cluster = ChaosCluster(cluster, chaos_cfg)
+
+    def new_agent():
+        return LocalAgent(store, workdir, backend="cluster", cluster=cluster,
+                          poll_interval=0.05, lease_ttl=lease_ttl,
+                          max_parallel=4).start()
+
+    agent = new_agent()
+    stale_rejected = 0
+    try:
+        uuids = [store.create_run("p", spec=s, name=s.get("name"))["uuid"]
+                 for s in _wave_specs(n_jobs, rng)]
+        for _ in range(kills):
+            time.sleep(rng.uniform(0.4, 1.2))
+            agent.hard_kill()
+            # a surviving thread of the dead incarnation (an executor
+            # callback mid-flight) tries one write: must be fenced off
+            try:
+                agent.store.transition(rng.choice(uuids), "stopping")
+            except StaleLeaseError:
+                stale_rejected += 1
+            except Exception:
+                pass
+            agent = new_agent()  # standby until the dead lease's TTL runs out
+        if split_brain:
+            time.sleep(rng.uniform(0.3, 0.8))
+            incumbent = agent
+            # the incumbent must genuinely HOLD the lease before the pause
+            # (after a kill round it may still be standing by for the dead
+            # agent's TTL) — a split-brain needs two live claimants
+            deadline = time.monotonic() + 10 * lease_ttl
+            while incumbent.lease is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            incumbent.suspend()          # GC pause: renewals stop
+            stale_token = (incumbent.lease or {}).get("token")
+            time.sleep(lease_ttl * 1.6)  # ...past the TTL
+            agent = new_agent()          # successor acquires
+            incumbent.resume()           # TWO live agents now
+            # a write still carrying the incumbent's pre-pause token (an
+            # in-flight batch from before the pause) must be rejected —
+            # pinned explicitly: the incumbent may already have demoted
+            # itself, and a demoted agent's fence is gone, not stale
+            if stale_token is not None:
+                from polyaxon_tpu.api.store import FencedStore
+
+                stale_store = FencedStore(
+                    store, lambda: ("scheduler", stale_token))
+                try:
+                    stale_store.transition(rng.choice(uuids), "stopping")
+                except StaleLeaseError:
+                    stale_rejected += 1
+            deadline = time.monotonic() + 30
+            while incumbent.lease is not None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            demoted = incumbent.lease is None
+            # drain, not stop: stop() tears down the (SHARED) cluster —
+            # a demoted process exiting must not kill the successor's pods
+            incumbent.drain()
+        else:
+            demoted = None
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = [store.get_run(u) for u in uuids]
+            if all(r["status"] in ("succeeded", "failed", "stopped")
+                   for r in rows):
+                break
+            time.sleep(0.1)
+        statuses = {r["name"]: r["status"]
+                    for r in (store.get_run(u) for u in uuids)}
+        return {
+            "statuses": statuses,
+            "fence_rejections": store.stats["fence_rejections"],
+            "stale_writes_rejected": stale_rejected,
+            "launch_intents": store.stats["launch_intents"],
+            "launch_counts": dict(getattr(cluster, "launch_counts", {})),
+            "duplicate_applies": list(
+                getattr(cluster, "duplicate_applies", [])),
+            "incumbent_demoted": demoted,
+            "injected": len(list(getattr(cluster, "injected", []))),
+        }
+    finally:
+        agent.stop()
+
+
+def _run_kill_agent_mode(args) -> int:
+    from polyaxon_tpu.resilience import ChaosConfig
+
+    root = tempfile.mkdtemp(prefix="plx-kill-agent-soak-")
+    ok = True
+    try:
+        oracle = run_kill_agent_soak(
+            os.path.join(root, "oracle"), seed=args.seed,
+            n_jobs=args.trials * 3, kills=0, timeout=args.timeout)
+        print(json.dumps({"pass": "oracle", "statuses": oracle["statuses"]}))
+        if any(v != "succeeded" for v in oracle["statuses"].values()):
+            print(json.dumps({"error": "oracle pass did not fully succeed"}))
+            return 2
+        for i in range(args.rounds):
+            seed = args.seed + i
+            cfg = None
+            if args.fault_rate or args.timeout_rate:
+                cfg = ChaosConfig(seed=seed, api_fault_rate=args.fault_rate,
+                                  timeout_rate=args.timeout_rate,
+                                  max_api_faults=args.max_api_faults)
+            out = run_kill_agent_soak(
+                os.path.join(root, f"kill-{seed}"), seed=seed,
+                n_jobs=args.trials * 3, kills=args.kills,
+                split_brain=args.split_brain, chaos_cfg=cfg,
+                lease_ttl=args.lease_ttl, timeout=args.timeout)
+            converged = out["statuses"] == oracle["statuses"]
+            no_dups = not out["duplicate_applies"]
+            fenced = out["fence_rejections"] >= 1
+            round_ok = converged and no_dups and fenced
+            if args.split_brain:
+                round_ok = round_ok and out["incumbent_demoted"] is True
+            ok = ok and round_ok
+            print(json.dumps({
+                "pass": f"kill-{seed}", "ok": round_ok,
+                "converged": converged,
+                "fence_rejections": out["fence_rejections"],
+                "duplicate_applies": out["duplicate_applies"],
+                "launch_intents": out["launch_intents"],
+                "incumbent_demoted": out["incumbent_demoted"],
+                "diff": {k: (oracle["statuses"].get(k),
+                             out["statuses"].get(k))
+                         for k in set(oracle["statuses"]) | set(out["statuses"])
+                         if oracle["statuses"].get(k)
+                         != out["statuses"].get(k)},
+            }))
+    finally:
+        if args.keep:
+            print(json.dumps({"workdir": root}))
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser("chaos_soak", description=__doc__)
     p.add_argument("--seed", type=int, default=2024)
@@ -124,7 +313,21 @@ def main() -> int:
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--keep", action="store_true",
                    help="keep the scratch workdir for inspection")
+    p.add_argument("--kill-agent", action="store_true",
+                   help="control-plane crash soak: SIGKILL + restart the "
+                        "agent mid-wave (ISSUE 4)")
+    p.add_argument("--split-brain", action="store_true",
+                   help="with --kill-agent: add a round with a GC-paused "
+                        "incumbent AND a live successor")
+    p.add_argument("--kills", type=int, default=2,
+                   help="agent kills per --kill-agent round")
+    p.add_argument("--lease-ttl", type=float, default=0.8,
+                   help="agent lease TTL for --kill-agent rounds")
     args = p.parse_args()
+
+    if args.kill_agent or args.split_brain:
+        args.kill_agent = True
+        return _run_kill_agent_mode(args)
 
     from polyaxon_tpu.resilience import ChaosConfig
 

@@ -1,4 +1,4 @@
-"""ISSUE 1 capstone proofs (slow; run with `pytest -m slow`):
+"""ISSUE 1 + ISSUE 4 capstone proofs (slow; run with `pytest -m slow`):
 
 1. **Preemption → resume**: a mid-training builtin-runtime tpujob is
    killed by injected preemption; the reconciler's all-or-nothing restart
@@ -14,7 +14,19 @@
    HTTP — every run must converge to the same terminal status as the
    fault-free oracle.
 
-The fast fixed-seed smoke lives in test_resilience.py (tier-1).
+3. **Kill-the-agent soak** (ISSUE 4): the CONTROL PLANE is the victim —
+   the agent is SIGKILLed and restarted mid-wave (plus one split-brain
+   round with two live agents); convergence to the fault-free oracle with
+   ZERO duplicate pod launches and >=1 exercised fencing rejection,
+   asserted via the store's and the cluster's crash-safety counters.
+
+4. **Agent kill + torn checkpoint**: a mid-training agent SIGKILL whose
+   slice also dies, with the newest checkpoint TORN while nobody watched —
+   the restarted attempt must resume from the newest COMPLETE step via the
+   checksum manifests, not step 0 and not the torn step.
+
+The fast fixed-seed smokes live in test_resilience.py and test_leases.py
+(tier-1).
 """
 
 import glob
@@ -30,8 +42,12 @@ from polyaxon_tpu.operator import FakeCluster
 from polyaxon_tpu.polyaxonfile import check_polyaxonfile
 from polyaxon_tpu.resilience import (
     ChaosCluster, ChaosConfig, RetryPolicy, flaky_http_middleware,
+    tear_latest_checkpoint,
 )
 from polyaxon_tpu.scheduler.agent import LocalAgent
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
 
 pytestmark = pytest.mark.slow
 
@@ -309,3 +325,122 @@ class TestChaosSoak:
         # the schedule genuinely fired on both layers
         assert cluster.injected, "cluster chaos never fired"
         assert chaos_mw.injected, "client-path chaos never fired"
+
+
+# ---------------------------------------------------------------------------
+# 3. kill-the-agent soak: the control plane is the victim (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+
+class TestAgentKillSoak:
+    def test_kills_and_split_brain_converge_with_zero_duplicate_launches(
+            self, tmp_path):
+        """Seeded soak: a job wave while the agent is hard-killed twice
+        (restarted each time; successors win by TTL expiry) plus one
+        split-brain round (GC-paused incumbent + live successor). Must
+        converge to the fault-free oracle's terminal statuses with ZERO
+        duplicate pod launches and >=1 fencing rejection, per the store's
+        and the cluster's counters."""
+        from chaos_soak import run_kill_agent_soak
+
+        oracle = run_kill_agent_soak(str(tmp_path / "oracle"), seed=2024,
+                                     n_jobs=8, kills=0)
+        assert all(v == "succeeded" for v in oracle["statuses"].values()), \
+            oracle
+        out = run_kill_agent_soak(str(tmp_path / "kill"), seed=2024,
+                                  n_jobs=8, kills=2, split_brain=True,
+                                  lease_ttl=0.8)
+        assert out["statuses"] == oracle["statuses"], out
+        assert out["duplicate_applies"] == [], out
+        assert out["fence_rejections"] >= 1, out
+        assert out["incumbent_demoted"] is True, out
+        # every run in the wave recorded a write-ahead intent and launched
+        assert out["launch_intents"] >= 8, out
+        assert len(out["launch_counts"]) == 8, out
+        assert all(c >= 1 for c in out["launch_counts"].values()), out
+
+
+# ---------------------------------------------------------------------------
+# 4. agent SIGKILL + slice death + TORN newest checkpoint -> resume from
+#    the newest COMPLETE step (ISSUE 4 acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+class TestKillAgentTornCheckpointResume:
+    def test_restart_skips_torn_step_and_resumes_complete_one(self, tmp_path):
+        from polyaxon_tpu.api.app import run_artifacts_dir
+
+        store = Store(":memory:")
+        chaos = ChaosCluster(FakeCluster(str(tmp_path / ".cluster")),
+                             ChaosConfig(seed=0))
+        agent1 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=chaos, poll_interval=0.05,
+                            lease_ttl=0.8)
+        agent1.start()
+        agent2 = None
+        try:
+            run = store.create_run("p", spec=_train_spec(), name="preemptee")
+            uuid = run["uuid"]
+            ckpt_dir = os.path.join(
+                run_artifacts_dir(str(tmp_path), "p", uuid),
+                "outputs", "checkpoints")
+
+            def _finalized():
+                return sorted(
+                    (int(os.path.basename(d))
+                     for d in glob.glob(os.path.join(ckpt_dir, "*"))
+                     if os.path.basename(d).isdigit()))
+
+            # need TWO complete steps: the newest gets torn, the previous
+            # one is what the restarted attempt must resume from
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                row = store.get_run(uuid)
+                assert row["status"] not in ("failed", "stopped"), \
+                    store.get_statuses(uuid)
+                if row["status"] == "succeeded":
+                    pytest.fail("run finished before the kill landed — "
+                                "raise TRAIN_RUNTIME['steps']")
+                if len(_finalized()) >= 2:
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("fewer than 2 checkpoints within 300s")
+
+            # the control plane dies...
+            agent1.hard_kill()
+            # ...the slice dies with nobody watching...
+            victim = chaos.preempt()
+            assert victim is not None
+            # ...and the newest checkpoint is torn on the way down
+            steps = _finalized()
+            torn_step = steps[-1]
+            expect_resume = steps[-2]
+            assert tear_latest_checkpoint(ckpt_dir) is not None
+
+            agent2 = LocalAgent(store, str(tmp_path), backend="cluster",
+                                cluster=chaos, poll_interval=0.05,
+                                lease_ttl=0.8)
+            agent2.start()  # takes over by TTL, adopts the dead pod set,
+            #                 reconciler restarts the slice
+
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline:
+                row = store.get_run(uuid)
+                if row["status"] in ("succeeded", "failed", "stopped"):
+                    break
+                time.sleep(0.1)
+            assert row["status"] == "succeeded", store.get_statuses(uuid)
+            outputs = row["outputs"] or {}
+            resumed = outputs.get("resumed_from_step")
+            # resumed from the newest COMPLETE step: not 0 (the manifests
+            # found a good one) and not the torn one (they rejected it)
+            assert resumed == expect_resume, (
+                resumed, {"torn": torn_step, "expected": expect_resume},
+                outputs)
+            assert 0 < resumed < torn_step
+            assert chaos.duplicate_applies == []
+        finally:
+            agent1.hard_kill()
+            if agent2 is not None:
+                agent2.stop()

@@ -1,0 +1,735 @@
+"""ISSUE 4 tier-1 units: agent leases + fencing tokens, write-ahead launch
+intents, orphan adoption, cold-start resync, graceful drain, atomic
+checkpoint manifests — and a fast (<30s) agent-kill smoke so the slow
+kill-the-agent soak (tests/test_chaos_soak.py) is not the only guard."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from polyaxon_tpu.api.store import FencedStore, StaleLeaseError, Store
+from polyaxon_tpu.operator import FakeCluster
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+from polyaxon_tpu.resilience import FaultyStore
+from polyaxon_tpu.scheduler.agent import LocalAgent
+
+
+def _job_spec(name, sleep=0.0, max_retries=None):
+    cmd = (f"import time; time.sleep({sleep}); print('done')"
+           if sleep else "print('done')")
+    spec = {"kind": "operation", "name": name,
+            "component": {"kind": "component", "run": {
+                "kind": "job",
+                "container": {"command": [sys.executable, "-c", cmd]}}}}
+    if max_retries is not None:
+        spec["termination"] = {"maxRetries": max_retries}
+    return check_polyaxonfile(spec).to_dict()
+
+
+def _wait(pred, timeout=30.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# lease verbs + fencing in the store
+# ---------------------------------------------------------------------------
+
+
+class TestAgentLeases:
+    def test_acquire_renew_release_roundtrip(self):
+        store = Store(":memory:")
+        lease = store.acquire_lease("scheduler", "a1", ttl=30)
+        assert lease["holder"] == "a1" and lease["token"] == 1
+        # held: a second holder is refused
+        assert store.acquire_lease("scheduler", "a2", ttl=30) is None
+        assert store.renew_lease("scheduler", "a1", lease["token"])
+        # explicit release -> instant successor acquisition, newer token
+        assert store.release_lease("scheduler", "a1", lease["token"])
+        lease2 = store.acquire_lease("scheduler", "a2", ttl=30)
+        assert lease2["holder"] == "a2"
+        assert lease2["token"] > lease["token"]
+
+    def test_ttl_expiry_allows_takeover_and_bumps_token(self):
+        store = Store(":memory:")
+        lease = store.acquire_lease("scheduler", "a1", ttl=0.05)
+        time.sleep(0.1)
+        assert store.get_lease("scheduler")["expired"]
+        lease2 = store.acquire_lease("scheduler", "a2", ttl=30)
+        assert lease2 is not None and lease2["token"] > lease["token"]
+        # the loser's renewal is rejected — it must demote, not limp on
+        assert not store.renew_lease("scheduler", "a1", lease["token"])
+
+    def test_token_monotonic_across_release(self):
+        """A release deletes the row but NOT the counter: a token can
+        never be reissued, so 'row missing' can't launder an old token."""
+        store = Store(":memory:")
+        tokens = []
+        for holder in ("a", "b", "c"):
+            lease = store.acquire_lease("scheduler", holder, ttl=30)
+            tokens.append(lease["token"])
+            store.release_lease("scheduler", holder, lease["token"])
+        assert tokens == sorted(set(tokens))
+
+    def test_self_reacquire_bumps_token(self):
+        store = Store(":memory:")
+        l1 = store.acquire_lease("scheduler", "a1", ttl=30)
+        l2 = store.acquire_lease("scheduler", "a1", ttl=30)
+        assert l2["token"] > l1["token"]
+        # the pre-reacquisition token is dead
+        r = store.create_run("p", spec={}, name="x")
+        with pytest.raises(StaleLeaseError):
+            store.transition(r["uuid"], "compiled",
+                             fence=("scheduler", l1["token"]))
+
+
+class TestFencing:
+    def _takeover(self, store):
+        l1 = store.acquire_lease("scheduler", "a1", ttl=0.01)
+        time.sleep(0.05)
+        l2 = store.acquire_lease("scheduler", "a2", ttl=30)
+        assert l2 is not None
+        return l1, l2
+
+    def test_stale_transition_many_rejected_whole_batch(self):
+        store = Store(":memory:")
+        runs = [store.create_run("p", spec={}, name=f"r{i}")
+                for i in range(3)]
+        l1, l2 = self._takeover(store)
+        events = []
+        store.add_transition_listener(lambda u, s: events.append((u, s)))
+        with pytest.raises(StaleLeaseError):
+            store.transition_many(
+                [(r["uuid"], "compiled") for r in runs],
+                fence=("scheduler", l1["token"]))
+        # nothing moved, no listener fired, the rejection was counted
+        assert all(store.get_run(r["uuid"])["status"] == "created"
+                   for r in runs)
+        assert events == []
+        assert store.stats["fence_rejections"] == 1
+        # the live holder's batch lands
+        store.transition_many([(r["uuid"], "compiled") for r in runs],
+                              fence=("scheduler", l2["token"]))
+        assert all(store.get_run(r["uuid"])["status"] == "compiled"
+                   for r in runs)
+
+    def test_stale_create_runs_and_update_rejected(self):
+        store = Store(":memory:")
+        r = store.create_run("p", spec={}, name="x")
+        l1, _ = self._takeover(store)
+        stale = ("scheduler", l1["token"])
+        with pytest.raises(StaleLeaseError):
+            store.create_runs("p", [dict(spec={}, name="child")], fence=stale)
+        with pytest.raises(StaleLeaseError):
+            store.update_run(r["uuid"], fence=stale, meta={"k": "v"})
+        with pytest.raises(StaleLeaseError):
+            store.record_launch_intent(r["uuid"], "a1", l1["token"],
+                                       fence=stale)
+        assert store.count_runs(project="p") == 1
+        assert store.stats["fence_rejections"] == 3
+
+    def test_fenced_store_proxy_demotes_on_rejection(self):
+        store = Store(":memory:")
+        r = store.create_run("p", spec={}, name="x")
+        l1, _ = self._takeover(store)
+        demoted = []
+        proxy = FencedStore(store, lambda: ("scheduler", l1["token"]),
+                            on_stale=lambda: demoted.append(True))
+        with pytest.raises(StaleLeaseError):
+            proxy.transition(r["uuid"], "compiled")
+        assert demoted == [True]
+        # reads always pass through
+        assert proxy.get_run(r["uuid"])["status"] == "created"
+        # no lease -> unfenced (direct-call test semantics preserved)
+        free = FencedStore(store, lambda: None)
+        run, changed = free.transition(r["uuid"], "compiled")
+        assert changed
+
+
+class TestFileDbFencing:
+    def test_fence_check_atomic_across_connections(self, tmp_path):
+        """Two Store instances on ONE file db (supervisor double-start):
+        the fence check must be atomic with the guarded write — after B's
+        acquisition commits, A's fenced write is rejected even though A
+        read its token before ever touching this connection's
+        transaction (bare SELECTs run in autocommit)."""
+        db = str(tmp_path / "shared.sqlite")
+        a, b = Store(db), Store(db)
+        la = a.acquire_lease("scheduler", "a", ttl=0.01)
+        time.sleep(0.05)
+        lb = b.acquire_lease("scheduler", "b", ttl=30)
+        assert lb["token"] > la["token"]
+        r = b.create_run("p", spec={}, name="x",
+                         fence=("scheduler", lb["token"]))
+        with pytest.raises(StaleLeaseError):
+            a.transition(r["uuid"], "compiled",
+                         fence=("scheduler", la["token"]))
+        # the winner's writes keep landing
+        _, changed = b.transition(r["uuid"], "compiled",
+                                  fence=("scheduler", lb["token"]))
+        assert changed
+        assert b.get_run(r["uuid"])["status"] == "compiled"
+
+
+class TestFaultyStoreLeaseVerbs:
+    def test_lease_verbs_gated_under_sqlite_busy(self):
+        import sqlite3
+
+        store = FaultyStore(Store(":memory:"), seed=3, fault_rate=1.0,
+                            max_faults=3)
+        failures = 0
+        lease = None
+        for _ in range(10):  # the agent's standby loop: retry next wake
+            try:
+                lease = store.acquire_lease("scheduler", "a1", ttl=30)
+                break
+            except sqlite3.OperationalError:
+                failures += 1
+        assert failures == 3 and lease is not None
+        assert "acquire_lease" in store.injected
+        # renewal rides the same gate (budget exhausted -> clean path)
+        assert store.renew_lease("scheduler", "a1", lease["token"])
+
+
+# ---------------------------------------------------------------------------
+# write-ahead launch intents: replay, adoption, slice loss
+# ---------------------------------------------------------------------------
+
+
+class TestLaunchIntents:
+    def _scheduled_cluster_run(self, store, agent, name="j", sleep=0.0,
+                               max_retries=None):
+        """A run compiled by the real compiler, walked to 'scheduled'
+        WITHOUT any cluster call — the state an agent dies in right after
+        committing its launch intent."""
+        run = store.create_run("p", spec=_job_spec(name, sleep=sleep,
+                                                   max_retries=max_retries),
+                               name=name)
+        uuid = run["uuid"]
+        assert agent._compile(store.get_run(uuid)) == "compiled"
+        store.transition_many([(uuid, "queued"), (uuid, "scheduled")])
+        return uuid
+
+    def test_intent_replay_relaunches_without_duplicates(self, tmp_path):
+        """Crash between the intent commit and the cluster accepting the
+        manifests: the successor's resync must relaunch (attempt 2) —
+        exactly one live pod set, run completes."""
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".c"))
+        agent1 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05)
+        uuid = self._scheduled_cluster_run(store, agent1, "replay")
+        # the dead agent got exactly this far: intent on disk, no pods
+        store.record_launch_intent(uuid, "dead-agent", None)
+        assert cluster.launch_counts.get(uuid) is None
+
+        agent2 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05)
+        agent2.cold_start_resync()
+        intent = store.get_launch_intent(uuid)
+        assert intent["state"] == "launched"
+        assert intent["attempt"] == 2  # replay bumped it
+        assert cluster.launch_counts.get(uuid, 0) >= 1
+        assert cluster.duplicate_applies == []
+        try:
+            _wait(lambda: (agent2.tick() or True) and
+                  store.get_run(uuid)["status"] in
+                  ("succeeded", "failed", "stopped"),
+                  timeout=60, interval=0.05, msg="replayed run terminal")
+            assert store.get_run(uuid)["status"] == "succeeded", \
+                store.get_statuses(uuid)
+        finally:
+            agent2.stop()
+
+    def test_adoption_reowns_without_relaunch(self, tmp_path):
+        """Pods alive across the restart: the successor re-tracks and
+        re-owns (meta.owner -> new lease) without ONE extra pod apply."""
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".c"))
+        agent1 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05)
+        uuid = store.create_run("p", spec=_job_spec("adoptee", sleep=3.0),
+                                name="adoptee")["uuid"]
+        _wait(lambda: (agent1.tick() or True)
+              and store.get_run(uuid)["status"] == "running",
+              timeout=30, interval=0.05, msg="run running")
+        applies_before = cluster.launch_counts[uuid]
+        owner_before = store.get_run(uuid)["meta"]["owner"]
+        assert owner_before["lease_id"] == agent1._lease_id
+        assert store.get_launch_intent(uuid)["state"] == "launched"
+
+        agent2 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05)
+        agent2.cold_start_resync()
+        try:
+            assert agent2.reconciler.is_tracked(uuid)
+            assert cluster.launch_counts[uuid] == applies_before  # no re-apply
+            assert cluster.duplicate_applies == []
+            owner = store.get_run(uuid)["meta"]["owner"]
+            assert owner["lease_id"] == agent2._lease_id
+            assert owner["attempt"] == owner_before["attempt"]  # adoption != launch
+            _wait(lambda: (agent2.tick() or True)
+                  and store.get_run(uuid)["status"] == "succeeded",
+                  timeout=60, interval=0.05, msg="adopted run succeeds")
+        finally:
+            agent2.stop()
+
+    def test_launched_but_vanished_routes_through_retry_budget(self, tmp_path):
+        """state='launched' with the pod set gone = slice loss while
+        nobody watched: retrying -> queued while budget remains, and the
+        rerun is a NEW launch attempt (not a duplicate)."""
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".c"))
+        agent1 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05)
+        uuid = store.create_run(
+            "p", spec=_job_spec("lost", sleep=5.0, max_retries=2),
+            name="lost")["uuid"]
+        _wait(lambda: (agent1.tick() or True)
+              and store.get_run(uuid)["status"] == "running",
+              timeout=30, interval=0.05, msg="run running")
+        # the cluster loses the whole pod set while the agent is dead
+        cluster.delete_selected({"app.polyaxon.com/run": uuid})
+
+        agent2 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05)
+        agent2.cold_start_resync()
+        try:
+            types = [c["type"] for c in store.get_statuses(uuid)]
+            assert "retrying" in types, types
+            assert store.get_run(uuid)["status"] == "queued"
+            _wait(lambda: (agent2.tick() or True)
+                  and store.get_run(uuid)["status"] == "succeeded",
+                  timeout=60, interval=0.05, msg="rerun succeeds")
+            assert store.get_launch_intent(uuid)["attempt"] == 2
+            assert cluster.duplicate_applies == []
+        finally:
+            agent2.stop()
+
+    def test_scheduled_but_no_intent_requeues_without_burning_budget(
+            self, tmp_path):
+        """Crash in the window between the 'scheduled' transition and the
+        intent commit: the write-ahead intent precedes the first cluster
+        call, so nothing launched — the successor must re-queue (a normal
+        launch) and NOT classify it as slice loss, which would burn retry
+        budget a zero-maxRetries run doesn't have."""
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".c"))
+        agent1 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05)
+        # the dead agent got exactly this far: scheduled, NO intent row
+        uuid = self._scheduled_cluster_run(store, agent1, "preintent")
+        assert store.get_launch_intent(uuid) is None
+
+        agent2 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05)
+        agent2.cold_start_resync()
+        try:
+            assert store.get_run(uuid)["status"] == "queued"
+            types = [c["type"] for c in store.get_statuses(uuid)]
+            assert "retrying" not in types, types  # no budget burned
+            _wait(lambda: (agent2.tick() or True)
+                  and store.get_run(uuid)["status"] == "succeeded",
+                  timeout=60, interval=0.05, msg="requeued run succeeds")
+            assert store.get_launch_intent(uuid)["attempt"] == 1
+            assert cluster.duplicate_applies == []
+        finally:
+            agent2.stop()
+
+    def test_vanished_without_budget_fails_loudly(self, tmp_path):
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".c"))
+        agent1 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05)
+        uuid = store.create_run("p", spec=_job_spec("doomed", sleep=5.0),
+                                name="doomed")["uuid"]
+        _wait(lambda: (agent1.tick() or True)
+              and store.get_run(uuid)["status"] == "running",
+              timeout=30, interval=0.05, msg="run running")
+        cluster.delete_selected({"app.polyaxon.com/run": uuid})
+        agent2 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05)
+        agent2.cold_start_resync()
+        row = store.get_run(uuid)
+        assert row["status"] == "failed"
+        assert "no retry budget" in store.get_statuses(uuid)[-1]["message"]
+
+
+class TestTerminatingPodsNotAdoptable:
+    def test_adopt_ignores_terminating_pods(self, tmp_path):
+        """On real K8s DELETE returns before etcd removal, so a
+        just-deleted pod set still lists (Terminating). Adoption must not
+        re-track it — those pods die moments later and would read as a
+        phantom slice failure burning a retry attempt. FakeCluster's
+        synchronous delete can't show this window, so stub the listing."""
+        from polyaxon_tpu.operator import (FakeCluster as FC, OperationCR,
+                                           OperationReconciler, PodPhase)
+        from polyaxon_tpu.operator.cluster import PodStatus
+
+        cluster = FC(str(tmp_path / ".c"))
+        dying = [PodStatus("old-0", PodPhase.RUNNING, terminating=True)]
+        real_statuses = cluster.pod_statuses
+        cluster.pod_statuses = (  # Terminating leftovers + whatever is real
+            lambda sel: dying + real_statuses(sel))
+        rec = OperationReconciler(cluster)
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "new-0",
+                            "labels": {"app.polyaxon.com/run": "u9"}},
+               "spec": {"containers": [{
+                   "name": "c", "command": [sys.executable, "-c", "pass"]}]}}
+        adopted = rec.adopt(OperationCR(run_uuid="u9", resources=[pod]))
+        # nothing adoptable -> fell through to a fresh apply
+        assert adopted is False
+        assert rec.is_tracked("u9")
+        assert any(s.name == "new-0" for s in real_statuses(
+            {"app.polyaxon.com/run": "u9"}))
+
+
+# ---------------------------------------------------------------------------
+# cold-start resync: the wait queue comes back in pre-crash order
+# ---------------------------------------------------------------------------
+
+
+class TestColdStartResync:
+    NOOP = {"kind": "operation",
+            "component": {"kind": "component", "name": "noop",
+                          "run": {"kind": "job",
+                                  "container": {"command": ["true"]}}}}
+
+    def test_wait_queue_rebuilt_in_exact_precrash_order(self, tmp_path):
+        store = Store(":memory:")
+        # max_parallel=0: every run parks in the wait queue
+        agent1 = LocalAgent(store, str(tmp_path), max_parallel=0)
+        uuids = [store.create_run("p", spec=self.NOOP, name=f"q{i}")["uuid"]
+                 for i in range(15)]
+        for _ in range(8):
+            with agent1._dirty_lock:
+                dirty, agent1._dirty = agent1._dirty, set()
+            if not dirty:
+                break
+            agent1._tick_dirty(dirty)
+        order_before = [u for u, _ in agent1._pending]
+        assert order_before == uuids
+
+        agent2 = LocalAgent(store, str(tmp_path), max_parallel=0)
+        agent2.cold_start_resync()
+        assert [u for u, _ in agent2._pending] == order_before
+        # chip-demand cache rebuilt too (all plain jobs -> demand 1)
+        assert [d for _, d in agent2._pending] == [1] * len(uuids)
+        # watermark cleared: the first walk recomputes from scratch
+        assert agent2._block_watermark is None
+        assert agent2._pending_fresh
+
+    def test_resync_is_one_scan_plus_one_listing(self, tmp_path):
+        """The rebuild reads O(non-terminal) run rows in ONE paginated
+        created_at ASC scan — not one scan per status bucket."""
+        store = Store(":memory:")
+        agent1 = LocalAgent(store, str(tmp_path), max_parallel=0)
+        for i in range(30):
+            store.create_run("p", spec=self.NOOP, name=f"q{i}")
+        for _ in range(8):
+            with agent1._dirty_lock:
+                dirty, agent1._dirty = agent1._dirty, set()
+            if not dirty:
+                break
+            agent1._tick_dirty(dirty)
+        agent2 = LocalAgent(store, str(tmp_path), max_parallel=0)
+        store.stats["runs_deserialized"] = 0
+        agent2.cold_start_resync()
+        # 30 queued rows, one page; a per-status implementation would
+        # still pass this bound, but a per-run one (N get_run calls on
+        # top) would not
+        assert store.stats["runs_deserialized"] <= 35, store.stats
+
+
+# ---------------------------------------------------------------------------
+# graceful drain + the lease over the API
+# ---------------------------------------------------------------------------
+
+
+class TestGracefulDrain:
+    def test_drain_releases_lease_for_instant_successor(self, tmp_path):
+        store = Store(":memory:")
+        agent1 = LocalAgent(store, str(tmp_path), poll_interval=0.05,
+                            lease_ttl=30.0)
+        agent1.start()
+        try:
+            assert store.get_lease("scheduler")["holder"] == agent1._lease_id
+        finally:
+            agent1.drain()
+        # released, not expired-out: the row is GONE
+        assert store.get_lease("scheduler") is None
+        # successor acquires on start() without waiting out any TTL
+        agent2 = LocalAgent(store, str(tmp_path), poll_interval=0.05,
+                            lease_ttl=30.0)
+        agent2.start()
+        try:
+            assert agent2.lease is not None
+            assert store.get_lease("scheduler")["holder"] == agent2._lease_id
+        finally:
+            agent2.stop()
+
+    def test_lease_visible_over_api(self, tmp_path):
+        from polyaxon_tpu.api.server import ApiServer
+        from polyaxon_tpu.client import AgentClient
+
+        srv = ApiServer(artifacts_root=str(tmp_path / "a"), port=0).start()
+        try:
+            client = AgentClient(host=srv.url)
+            assert client.lease() is None
+            agent = LocalAgent(srv.store, str(tmp_path / "a"),
+                               poll_interval=0.05)
+            agent.start()
+            try:
+                lease = client.lease()
+                assert lease["holder"] == agent._lease_id
+                assert lease["expired"] is False
+            finally:
+                agent.stop()
+            assert client.lease() is None  # released on stop
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the fast agent-kill smoke (tier-1 stand-in for the slow soak)
+# ---------------------------------------------------------------------------
+
+
+class TestAgentKillSmoke:
+    def test_kill_mid_wave_then_successor_converges(self, tmp_path):
+        """Scaled-down kill-the-agent soak: SIGKILL (simulated) mid-wave,
+        a successor takes over by TTL expiry, every run converges, zero
+        duplicate pod launches, and the dead incarnation's late write is
+        fenced off (>=1 rejection exercised)."""
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".c"))
+        agent1 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05,
+                            lease_ttl=0.6)
+        agent1.start()
+        uuids = [store.create_run(
+            "p", spec=_job_spec(f"w{i}", sleep=1.5), name=f"w{i}")["uuid"]
+            for i in range(3)]
+        _wait(lambda: any(store.get_run(u)["status"] == "running"
+                          for u in uuids),
+              timeout=30, msg="wave mid-flight")
+
+        agent1.hard_kill()
+        # a surviving thread of the dead incarnation tries to write (an
+        # executor callback would do exactly this): fenced off
+        with pytest.raises(StaleLeaseError):
+            agent1.store.transition(uuids[0], "stopping")
+        assert store.stats["fence_rejections"] >= 1
+
+        agent2 = LocalAgent(store, str(tmp_path), backend="cluster",
+                            cluster=cluster, poll_interval=0.05,
+                            lease_ttl=0.6)
+        agent2.start()  # standby until agent1's TTL expires, then resync
+        try:
+            _wait(lambda: all(store.get_run(u)["status"] in
+                              ("succeeded", "failed", "stopped")
+                              for u in uuids),
+                  timeout=25, msg="wave terminal after takeover")
+            statuses = {store.get_run(u)["name"]: store.get_run(u)["status"]
+                        for u in uuids}
+            assert statuses == {f"w{i}": "succeeded" for i in range(3)}, (
+                statuses, {u: store.get_statuses(u) for u in uuids})
+            assert cluster.duplicate_applies == []
+            # in-flight pods were adopted or intent-replayed — never
+            # double-launched while live
+            for u in uuids:
+                assert cluster.launch_counts.get(u, 0) >= 1
+        finally:
+            agent2.stop()
+
+    def test_demoted_agent_writes_stay_fenced_not_unfenced(self, tmp_path):
+        """Organic demotion (rejected renewal / fenced-out write) must
+        POISON the fence, not clear it: a cleared fence would downgrade
+        the stale incarnation's surviving threads (executor callbacks,
+        sidecar output merges) to UNFENCED writes that land — the exact
+        mutation fencing exists to keep out."""
+        store = Store(":memory:")
+        agent = LocalAgent(store, str(tmp_path), poll_interval=0.05,
+                           lease_ttl=30.0)
+        assert agent._try_acquire_lease()
+        r = store.create_run("p", spec={}, name="x")
+        agent._on_stale_lease()  # what a StaleLeaseError write triggers
+        assert agent.lease is None
+        assert agent._current_fence() == ("__dead__", -1)
+        with pytest.raises(StaleLeaseError):
+            agent.store.transition(r["uuid"], "compiled")
+        assert store.get_run(r["uuid"])["status"] == "created"
+        # ...but a legitimate RE-acquisition (the standby hot-spare
+        # becoming successor) lifts the poison: writes carry the new token
+        assert agent._try_acquire_lease()
+        _, changed = agent.store.transition(r["uuid"], "compiled")
+        assert changed
+
+    def test_split_brain_loser_demotes(self, tmp_path):
+        """Two LIVE agents (GC-pause split-brain): the paused incumbent
+        resumes after a takeover, its renewal is rejected, and it demotes
+        to standby without having mutated anything."""
+        store = Store(":memory:")
+        agent1 = LocalAgent(store, str(tmp_path / "a1"), poll_interval=0.05,
+                            lease_ttl=0.5)
+        agent1.start()
+        assert agent1.lease is not None
+        agent1.suspend()  # GC pause: renewals stop
+        time.sleep(0.8)   # TTL expires
+
+        agent2 = LocalAgent(store, str(tmp_path / "a2"), poll_interval=0.05,
+                            lease_ttl=0.5)
+        agent2.start()
+        try:
+            _wait(lambda: agent2.lease is not None, timeout=10,
+                  msg="successor acquires expired lease")
+            token2 = agent2.lease["token"]
+            # the incumbent wakes up...
+            agent1.resume()
+            _wait(lambda: agent1.lease is None, timeout=10,
+                  msg="incumbent demotes")
+            # ...and any write it still had in flight is fenced off
+            r = store.create_run("p", spec={}, name="x")
+            stale = FencedStore(store, lambda: ("scheduler", token2 - 1))
+            with pytest.raises(StaleLeaseError):
+                stale.transition(r["uuid"], "compiled")
+            assert store.stats["fence_rejections"] >= 1
+            # the winner still holds an un-bumped lease
+            assert store.get_lease("scheduler")["holder"] == agent2._lease_id
+            assert agent2.lease["token"] == token2
+        finally:
+            agent1.stop()
+            agent2.stop()
+
+
+# ---------------------------------------------------------------------------
+# atomic checkpoints: checksum manifests + torn-step fallback
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointManifests:
+    def _ckpt(self, tmp_path):
+        from polyaxon_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+
+        return Checkpointer(CheckpointConfig(
+            directory=str(tmp_path / "ck"), save_interval_steps=1,
+            max_to_keep=5, async_save=False))
+
+    @staticmethod
+    def _state(step):
+        import jax.numpy as jnp
+
+        return {"w": jnp.arange(8, dtype=jnp.float32) * step,
+                "step": jnp.asarray(step)}
+
+    def _save_steps(self, ck, steps):
+        for s in steps:
+            assert ck.maybe_save(s, self._state(s), force=True)
+        ck.wait()
+
+    @staticmethod
+    def _tear(ck, step):
+        """Truncate the largest payload file of a step — a torn write."""
+        root = ck._step_dir(step)
+        largest, size = None, -1
+        for dirpath, _, names in os.walk(root):
+            for n in names:
+                p = os.path.join(dirpath, n)
+                if os.path.getsize(p) > size:
+                    largest, size = p, os.path.getsize(p)
+        assert largest is not None
+        with open(largest, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        return largest
+
+    def test_every_save_gets_a_verified_manifest(self, tmp_path):
+        ck = self._ckpt(tmp_path)
+        self._save_steps(ck, [1, 2, 3])
+        for s in (1, 2, 3):
+            assert os.path.exists(ck._manifest_path(s))
+            assert ck.verify_step(s)
+        assert ck.latest_complete_step() == 3
+
+    def test_torn_latest_step_falls_back_to_newest_complete(self, tmp_path):
+        ck = self._ckpt(tmp_path)
+        self._save_steps(ck, [1, 2, 3])
+        self._tear(ck, 3)
+        assert not ck.verify_step(3)
+        assert ck.latest_complete_step() == 2
+        restored, step = ck.restore(self._state(0))
+        assert step == 2
+        assert float(restored["w"][1]) == 2.0  # step-2 payload, not garbage
+
+    def test_all_steps_torn_raises_filenotfound(self, tmp_path):
+        ck = self._ckpt(tmp_path)
+        self._save_steps(ck, [1, 2])
+        self._tear(ck, 1)
+        self._tear(ck, 2)
+        with pytest.raises(FileNotFoundError):
+            ck.restore(self._state(0))
+
+    def test_legacy_dir_without_manifests_still_restores(self, tmp_path):
+        ck = self._ckpt(tmp_path)
+        self._save_steps(ck, [1, 2])
+        for s in (1, 2):
+            os.unlink(ck._manifest_path(s))
+        # pre-manifest checkpoints: trust orbax's atomic publish
+        assert ck.complete_steps_desc() == [2, 1]
+        _, step = ck.restore(self._state(0))
+        assert step == 2
+
+    def test_crash_before_manifest_flush_backfills_not_purges(self, tmp_path):
+        """SIGKILL between an async Orbax finalize and the manifest
+        flush: the step dir is complete but unmanifested. The restarted
+        process must backfill the manifest (the dir's presence IS save
+        completion) and resume from it — not mistake it for torn and
+        delete 100 steps of progress."""
+        ck = self._ckpt(tmp_path)
+        self._save_steps(ck, [1, 2, 3])
+        os.unlink(ck._manifest_path(3))  # the crash ate the flush
+
+        ck2 = self._ckpt(tmp_path)  # restarted process, empty in-memory state
+        assert ck2.complete_steps_desc() == [3, 2, 1]
+        assert os.path.exists(ck2._manifest_path(3))  # backfilled
+        restored, step = ck2.restore(self._state(0))
+        assert step == 3
+        assert float(restored["w"][1]) == 3.0
+        assert os.path.isdir(ck2._step_dir(3))  # never purged
+
+    def test_unproven_torn_step_quarantined_not_destroyed(self, tmp_path):
+        """A newer step that fails the Orbax read while its bytes were
+        never shown bad (manifest verifies / backfilled over the fault)
+        is moved aside as quarantine-<step>, not irreversibly deleted —
+        while still clearing the step number for the resumed run."""
+        ck = self._ckpt(tmp_path)
+        self._save_steps(ck, [1, 2])
+        self._tear(ck, 2)
+        os.unlink(ck._manifest_path(2))  # tear predates any manifest
+        ck2 = self._ckpt(tmp_path)
+        # backfill blesses the torn bytes; Orbax is the safety net
+        restored, step = ck2.restore(self._state(0))
+        assert step == 1
+        q = os.path.join(ck2.directory, "quarantine-2")
+        assert os.path.isdir(q)  # bytes preserved for hand recovery
+        assert not os.path.isdir(ck2._step_dir(2))  # step number freed
+        assert ck2.latest_step() == 1
+
+    def test_manifest_gc_follows_max_to_keep(self, tmp_path):
+        from polyaxon_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+
+        ck = Checkpointer(CheckpointConfig(
+            directory=str(tmp_path / "ck"), save_interval_steps=1,
+            max_to_keep=2, async_save=False))
+        self._save_steps(ck, [1, 2, 3, 4])
+        live = sorted(ck.manager.all_steps())
+        manifests = sorted(
+            int(n[len("manifest-"):-len(".json")])
+            for n in os.listdir(ck.directory) if n.startswith("manifest-"))
+        assert manifests == live

@@ -472,11 +472,21 @@ class TestZombieReaper:
         _force_running(store, run["uuid"])
         return run["uuid"]
 
+    @staticmethod
+    def _unthrottle(reaper):
+        """Arm the next pass_once() (bypass the inter-pass throttle)."""
+        reaper._last_pass = float("-inf")
+
     def test_reaps_stale_run_into_retrying(self):
         store = Store(":memory:")
         uuid = self._zombie_run(store, max_retries=1)
         reaper = ZombieReaper(store, owned=set, zombie_after=0.05)
         time.sleep(0.1)
+        # one stale read is a strike, not a verdict (the heartbeat WRITE
+        # may have hit a transient store fault while the sidecar lives)
+        assert reaper.pass_once() == []
+        assert store.get_run(uuid)["status"] == "running"
+        self._unthrottle(reaper)
         assert reaper.pass_once() == [(uuid, "retried")]
         run = store.get_run(uuid)
         assert run["status"] == "queued"
@@ -488,10 +498,33 @@ class TestZombieReaper:
         uuid = self._zombie_run(store)  # no termination -> budget 0
         reaper = ZombieReaper(store, owned=set, zombie_after=0.05)
         time.sleep(0.1)
+        assert reaper.pass_once() == []
+        self._unthrottle(reaper)
         assert reaper.pass_once() == [(uuid, "failed")]
         conds = store.get_statuses(uuid)
         assert conds[-1]["type"] == "failed"
         assert conds[-1]["reason"] == "ZombieReaped"
+
+    def test_fresh_beat_between_passes_clears_the_strike(self):
+        """The exact bug the two-strike rule fixes: a live sidecar whose
+        heartbeat write hit one transient store fault must NOT be reaped
+        off a single stale row read — a beat landing before the second
+        pass resets the count."""
+        store = Store(":memory:")
+        uuid = self._zombie_run(store, max_retries=1)
+        reaper = ZombieReaper(store, owned=set, zombie_after=0.05)
+        time.sleep(0.1)
+        assert reaper.pass_once() == []  # strike one
+        store.heartbeat(uuid)            # the sidecar's next write lands
+        self._unthrottle(reaper)
+        assert reaper.pass_once() == []  # strike cleared, no reap
+        assert store.get_run(uuid)["status"] == "running"
+        # and a run that goes stale AGAIN starts over at strike one
+        time.sleep(0.1)
+        self._unthrottle(reaper)
+        assert reaper.pass_once() == []
+        self._unthrottle(reaper)
+        assert reaper.pass_once() == [(uuid, "retried")]
 
     def test_owned_runs_get_lease_renewed_not_reaped(self):
         store = Store(":memory:")
