@@ -4,6 +4,9 @@ service" rows; §3(e) read path).
 
 Endpoints (all JSON unless noted):
     GET  /healthz
+    GET  /metrics                                   Prometheus text exposition
+    GET  /api/v1/stats                              JSON twin of /metrics + lease
+    GET  /api/v1/{project}/runs/{uuid}/timeline     lifecycle + pod span trace
     GET|POST /api/v1/projects
     GET  /api/v1/projects/{project}
     POST /api/v1/{project}/runs                     create (operation spec body)
@@ -94,7 +97,11 @@ class ApiApp:
         # descriptor sits BEHIND auth (ADVICE r4): it carries no tenant
         # data either, but enumerating every route + summary is
         # reconnaissance surface, and SDK generators already hold a token.
-        if request.path in ("/healthz", "/", "/ui"):
+        # /metrics joins the unauthenticated set deliberately: Prometheus
+        # scrapers don't carry tenant tokens, and the exposition is
+        # aggregate operational data (counters/latencies), never run
+        # payloads (docs/OBSERVABILITY.md "Scraping")
+        if request.path in ("/healthz", "/", "/ui", "/metrics"):
             return await handler(request)
         if not self._auth_enabled():
             return await handler(request)
@@ -157,6 +164,8 @@ class ApiApp:
     def _routes(self) -> None:
         r = self.app.router
         r.add_get("/healthz", self.healthz)
+        r.add_get("/metrics", self.metrics_endpoint)
+        r.add_get("/api/v1/stats", self.get_stats)
         r.add_get("/", self.ui)
         r.add_get("/ui", self.ui)
         r.add_get("/api/v1/openapi.json", self.openapi)
@@ -177,6 +186,7 @@ class ApiApp:
         r.add_post("/api/v1/{project}/runs/{uuid}/heartbeat", self.post_heartbeat)
         r.add_post("/api/v1/{project}/runs/{uuid}/stop", self.stop_run)
         r.add_post("/api/v1/{project}/runs/{uuid}/restart", self.restart_run)
+        r.add_get("/api/v1/{project}/runs/{uuid}/timeline", self.get_timeline)
         r.add_get("/api/v1/{project}/runs/{uuid}/metrics", self.get_metrics)
         r.add_get("/api/v1/{project}/runs/{uuid}/events/{kind}", self.get_events)
         r.add_get("/api/v1/{project}/runs/{uuid}/logs", self.get_logs)
@@ -190,6 +200,49 @@ class ApiApp:
 
     async def healthz(self, request):
         return _json({"status": "ok"})
+
+    async def metrics_endpoint(self, request):
+        """Prometheus text exposition of the control-plane registry
+        (store counters + latency histograms, agent gauges, reaper/chaos
+        counters — docs/OBSERVABILITY.md lists every family)."""
+        reg = getattr(self.store, "metrics", None)
+        text = reg.render() if reg is not None else ""
+        return web.Response(
+            text=text,
+            content_type="text/plain",
+            charset="utf-8",
+            headers={"X-Prometheus-Exposition": "0.0.4"},
+        )
+
+    async def get_stats(self, request):
+        """JSON twin of /metrics: store counters, metric snapshot
+        (histograms as exact p50/p95), and the scheduler lease state."""
+        reg = getattr(self.store, "metrics", None)
+        lease = None
+        try:
+            lease = self.store.get_lease(
+                request.query.get("lease", "scheduler"))
+        except Exception:
+            pass
+        return _json({
+            "store": dict(getattr(self.store, "stats", {}) or {}),
+            "metrics": reg.snapshot() if reg is not None else {},
+            "lease": lease,
+        })
+
+    async def get_timeline(self, request):
+        """The run's merged trace: control-plane lifecycle spans (from the
+        transactionally-stamped status conditions) + pod-side spans logged
+        through tracking — the waterfall the dashboard Timeline tab and
+        `polyaxon timeline` render."""
+        run = self._run(request)
+        if run is None:
+            return _not_found()
+        from ..obs.trace import build_timeline
+
+        rd = self.run_dir(run["project"], run["uuid"])
+        conditions = self.store.get_statuses(run["uuid"])
+        return _json(build_timeline(run, conditions, rd))
 
     async def get_agent_lease(self, request):
         """Who drives the control plane right now (admin-only by scoping:

@@ -9,9 +9,11 @@ import datetime
 import json
 import sqlite3
 import threading
+import time
 import uuid as uuid_mod
 from typing import Any, Optional
 
+from ..resilience.heartbeat import age_seconds
 from ..schemas.statuses import DONE_STATUSES, V1StatusCondition, V1Statuses, can_transition, is_done
 
 _SCHEMA = """
@@ -151,6 +153,32 @@ class Store:
         # agent.py asserts it), so the counters are part of the contract.
         self.stats = {"transactions": 0, "runs_deserialized": 0,
                       "fence_rejections": 0, "launch_intents": 0}
+        # observability (ISSUE 5): the store is the hub every component
+        # already shares, so its registry is the process's one pane of
+        # glass — the agent/reaper/reconciler register their series here
+        # and `GET /metrics` renders it. Counters export the existing
+        # ``stats`` dict via callbacks (no double bookkeeping).
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        for stat, help_txt in (
+            ("transactions", "Store transactions opened"),
+            ("runs_deserialized", "Run rows deserialized from the store"),
+            ("fence_rejections",
+             "Fenced writes rejected for a stale lease token"),
+            ("launch_intents", "Write-ahead launch intents recorded"),
+        ):
+            self.metrics.counter(
+                f"polyaxon_store_{stat}_total", help_txt,
+                value_fn=(lambda s=stat: self.stats[s]))
+        self._h_write = self.metrics.histogram(
+            "polyaxon_store_write_seconds",
+            "Latency of lifecycle write transactions "
+            "(transition batches, run creation)")
+        self._h_sched = self.metrics.histogram(
+            "polyaxon_schedule_latency_seconds",
+            "Run creation to first running transition "
+            "(the sched_bench time-to-running metric)")
         self._memory_conn: Optional[sqlite3.Connection] = None
         if path == ":memory:":
             # a single shared connection (serialized by a lock)
@@ -624,6 +652,7 @@ class Store:
                 run_uuid,
                 json.dumps(V1StatusCondition.get_condition(V1Statuses.CREATED).to_dict()),
             ))
+        t0 = time.perf_counter()
         with self._conn_ctx() as conn:
             try:
                 self._check_fence(conn, fence)
@@ -650,6 +679,7 @@ class Store:
                 # as ghost runs that never fired the change feed
                 conn.rollback()
                 raise
+        self._h_write.observe(time.perf_counter() - t0)
         # creation flows through the same feed as transitions so a
         # subscribed agent learns about new runs without scanning
         self._notify_listeners(
@@ -776,7 +806,19 @@ class Store:
             args += [limit, offset]
         with self._conn_ctx() as conn:
             rows = conn.execute(q, args).fetchall()
-        return [self._row_to_run(r) for r in rows]
+        runs = [self._row_to_run(r) for r in rows]
+        # heartbeat staleness used to be observable only by the reaper
+        # (ISSUE 5 satellite): stamp the age onto in-flight listing rows so
+        # the dashboard can badge zombie-suspect runs without a second
+        # query. Derived (never stored), and only present where it means
+        # something — terminal/queued rows keep their exact shape.
+        for d in runs:
+            if d["status"] in (V1Statuses.STARTING.value,
+                               V1Statuses.RUNNING.value):
+                age = age_seconds(d.get("heartbeat_at") or d.get("started_at"))
+                if age is not None:
+                    d["heartbeat_age_s"] = round(age, 3)
+        return runs
 
     def count_runs(
         self,
@@ -878,11 +920,14 @@ class Store:
         a stale agent's promotion wave cannot land after a takeover."""
         results: list[tuple[Optional[dict], bool]] = []
         applied: list[tuple[str, str]] = []
+        sched_ages: list[float] = []
+        t0 = time.perf_counter()
         with self._transition_lock:
             with self._conn_ctx() as conn:
                 try:
                     self._check_fence(conn, fence)
-                    self._transition_batch(conn, transitions, results, applied)
+                    self._transition_batch(conn, transitions, results, applied,
+                                           sched_ages)
                 except BaseException:
                     # a mid-batch error (bad status string, corrupt row)
                     # must not strand earlier entries' writes uncommitted
@@ -890,7 +935,14 @@ class Store:
                     # would flush them WITHOUT their listeners ever firing
                     conn.rollback()
                     applied.clear()
+                    sched_ages.clear()
                     raise
+        self._h_write.observe(time.perf_counter() - t0)
+        # schedule-latency samples flush only after the batch COMMITS: a
+        # rolled-back batch also rolls back started_at, so the retried
+        # RUNNING edge would otherwise observe the same run twice
+        for age in sched_ages:
+            self._h_sched.observe(age)
         # observers run OUTSIDE the lock (they may read the store) and only
         # for transitions that actually happened — hooks keyed off rejected
         # late reports (a killed process's 'failed' after 'stopped') never
@@ -898,7 +950,8 @@ class Store:
         self._notify_listeners(applied)
         return results
 
-    def _transition_batch(self, conn, transitions, results, applied) -> None:
+    def _transition_batch(self, conn, transitions, results, applied,
+                          sched_ages: Optional[list] = None) -> None:
         for t in transitions:
             uuid, status = t[0], t[1]
             reason = t[2] if len(t) > 2 else None
@@ -921,6 +974,14 @@ class Store:
             if dst == V1Statuses.RUNNING and not run.get("started_at"):
                 sets.append("started_at=?")
                 args.append(now)
+                # schedule latency stamped with the FIRST running edge
+                # (retries don't re-observe: started_at is already set);
+                # the caller observes it only after the batch commits —
+                # the exact created->running interval scripts/
+                # sched_bench.py measures from its listener
+                age = age_seconds(run.get("created_at"))
+                if age is not None and sched_ages is not None:
+                    sched_ages.append(age)
             if is_done(dst):
                 sets.append("finished_at=?")
                 args.append(now)

@@ -423,6 +423,110 @@ def ops_delete(uuid, project, host):
     click.echo("deleted")
 
 
+# -- observability -----------------------------------------------------------
+
+
+def _fmt_dur(seconds: float) -> str:
+    return (f"{seconds * 1000:.1f}ms" if seconds < 1.0 else f"{seconds:.2f}s")
+
+
+@cli.command()
+@click.argument("uuid")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+@click.option("--json", "as_json", is_flag=True, help="emit the raw timeline document")
+def timeline(uuid, project, host, as_json):
+    """Render a run's merged trace as a text waterfall: control-plane
+    lifecycle phases (transactionally stamped at every status transition)
+    and pod-side training spans (restore, first-step compile, train window,
+    checkpoint saves) on one clock — the CLI face of the dashboard's
+    Timeline tab (GET .../runs/{uuid}/timeline)."""
+    rc, local = _ops_client(host, project)
+    if rc:
+        doc = rc.timeline(uuid)
+    else:
+        from ..obs.trace import build_timeline
+
+        store, _proj = local
+        run = store.get_run(uuid)
+        if not run:
+            raise click.ClickException("run not found")
+        rd = os.path.join(".plx", "artifacts", run["project"], uuid)
+        doc = build_timeline(run, store.get_statuses(uuid), rd)
+    if as_json:
+        click.echo(json.dumps(doc, indent=2))
+        return
+    spans = doc.get("spans") or []
+    if not spans:
+        click.echo("no spans yet")
+        return
+    tmin = min(s["start"] for s in spans)
+    tmax = max(max(s["end"] for s in spans), tmin + 1e-9)
+    width = 40
+    click.echo(f"trace {doc['trace_id']}  status={doc.get('status')}  "
+               f"({len(spans)} spans, {_fmt_dur(tmax - tmin)})")
+    for s in spans:
+        x1 = int((s["start"] - tmin) / (tmax - tmin) * width)
+        x2 = max(int((s["end"] - tmin) / (tmax - tmin) * width), x1 + 1)
+        bar = "." * x1 + "#" * (x2 - x1) + "." * (width - x2)
+        proc = "pod" if s["process"] == "pod" else "cp "
+        click.echo(f"  {s['name']:<24.24} {proc} [{bar}] "
+                   f"+{s['start'] - tmin:>7.3f}s {_fmt_dur(s['duration_s'])}")
+
+
+@cli.command()
+@click.option("--host", default=None)
+@click.option("--json", "as_json", is_flag=True, help="emit the raw stats document")
+def status(host, as_json):
+    """Control-plane health: store transaction/fence/intent counters,
+    latency histograms (exact p50/p95), agent gauges, and who holds the
+    scheduler lease — the CLI face of GET /api/v1/stats (the JSON twin of
+    the Prometheus /metrics exposition; docs/OBSERVABILITY.md)."""
+    h = get_host(host)
+    if h:
+        from ..client import AgentClient
+
+        data = AgentClient(h, auth_token=get_token(h)).stats()
+    else:
+        from ..api.store import Store
+
+        db = os.path.join(".plx", "db.sqlite")
+        if not os.path.exists(db):
+            raise click.ClickException(
+                "no server configured and no local .plx state; start one "
+                "with `polyaxon server` or point --host at a deployment")
+        store = Store(db)
+        # counters are per-process: a fresh CLI store reads zeros — the
+        # lease row (and run table) is the durable part of local status
+        data = {"store": dict(store.stats),
+                "metrics": store.metrics.snapshot(),
+                "lease": store.get_lease("scheduler")}
+    if as_json:
+        click.echo(json.dumps(data, indent=2))
+        return
+    lease = data.get("lease")
+    if lease:
+        state = "EXPIRED" if lease.get("expired") else "live"
+        click.echo(f"scheduler lease: {lease.get('holder')} ({state}, "
+                   f"token {lease.get('token')}, ttl {lease.get('ttl')}s)")
+    else:
+        click.echo("scheduler lease: none (no agent has acquired)")
+    store_stats = data.get("store") or {}
+    if store_stats:
+        click.echo("store: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(store_stats.items())))
+    for name, val in sorted((data.get("metrics") or {}).items()):
+        if isinstance(val, dict):  # histogram snapshot
+            p50, p95 = val.get("p50_s"), val.get("p95_s")
+            click.echo(
+                f"{name}: count={val.get('count')} "
+                f"p50={_fmt_dur(p50) if p50 is not None else '-'} "
+                f"p95={_fmt_dur(p95) if p95 is not None else '-'}")
+        else:
+            click.echo(f"{name}: {val:g}" if isinstance(val, float)
+                       else f"{name}: {val}")
+
+
 # -- project ----------------------------------------------------------------
 
 

@@ -97,6 +97,17 @@ class AgentClient(BaseClient):
         return self._json("GET", "/api/v1/agent/lease",
                           params={"name": name}).get("lease")
 
+    def stats(self) -> dict:
+        """The JSON twin of /metrics: {store: counters, metrics: snapshot
+        with exact histogram p50/p95, lease: scheduler lease row} —
+        `polyaxon status` and dashboards read this (docs/OBSERVABILITY.md)."""
+        return self._json("GET", "/api/v1/stats")
+
+    def prometheus(self) -> str:
+        """The raw Prometheus text exposition (GET /metrics) — what a
+        scraper sees; obs.parse_prometheus() parses it back."""
+        return self._req("GET", "/metrics").text
+
 
 class TokenClient(BaseClient):
     """Token administration (RBAC-lite): mint/list/revoke access tokens."""
@@ -265,6 +276,13 @@ class RunClient(BaseClient):
         params = {"names": ",".join(names)} if names else {}
         return self._json("GET", self._rpath(f"/events/{kind}", uuid=uuid),
                           params=params)
+
+    def timeline(self, uuid: Optional[str] = None) -> dict:
+        """The run's merged trace {run_uuid, trace_id, status, processes,
+        spans: [{name, process, start, end, duration_s, meta}]} — control-
+        plane lifecycle phases + pod-side training spans on one clock
+        (the dashboard Timeline tab and `polyaxon timeline` render it)."""
+        return self._json("GET", self._rpath("/timeline", uuid=uuid))
 
     def get_logs(self, offset: int = 0, uuid: Optional[str] = None) -> tuple[str, int]:
         resp = self._req("GET", self._rpath("/logs", uuid=uuid), params={"offset": offset})

@@ -102,6 +102,10 @@ def context_env(ctx: dict[str, Any]) -> dict[str, str]:
         "PLX_RUN_UUID": g["uuid"],
         "PLX_PROJECT": g["project_name"],
         "PLX_ARTIFACTS_PATH": g["run_artifacts_path"],
+        # trace correlation (obs/trace.py): pod-side spans logged through
+        # tracking join the control-plane lifecycle timeline on this id
+        # (= the run uuid, the natural cross-process correlation key)
+        "POLYAXON_TRACE_ID": g["uuid"],
     }
     if g.get("api_host"):
         env["PLX_API_HOST"] = g["api_host"]

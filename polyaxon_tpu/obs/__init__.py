@@ -1,0 +1,26 @@
+"""Observability layer (ISSUE 5): hand-rolled Prometheus-style metrics
+(no client library dependency — the exposition format is a few lines of
+text) and the run-timeline assembler that joins control-plane lifecycle
+spans with pod-side training spans into one trace."""
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    latency_buckets,
+    parse_prometheus,
+)
+from .trace import build_timeline, lifecycle_spans, pod_spans
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "latency_buckets",
+    "parse_prometheus",
+    "build_timeline",
+    "lifecycle_spans",
+    "pod_spans",
+]

@@ -1,0 +1,143 @@
+"""Run-timeline assembly (ISSUE 5 tentpole (a)).
+
+One run = one trace. Two span sources join on the trace id (the run uuid,
+unless ``meta.trace_id`` overrides it):
+
+- **Control-plane lifecycle spans** derived from the run's status
+  conditions. Conditions are inserted INSIDE the same store transaction as
+  the status flip (``Store._transition_batch``), so the span boundaries
+  are transactionally exact — fenced and batched writes stamp them
+  atomically with the transition they describe. Phase ``i`` spans
+  ``[condition[i].ts, condition[i+1].ts)``; the terminal condition is a
+  zero-length marker. Monotonic and non-overlapping by construction.
+- **Pod-side spans** from ``events/span/*.jsonl`` in the run's artifacts
+  dir — the builtin runtime logs restore / first-step-compile / train /
+  checkpoint-save spans through the standard tracking writer, carrying
+  the trace id it received via the ``POLYAXON_TRACE_ID`` env var.
+
+``build_timeline`` is what ``GET /api/v1/{project}/runs/{uuid}/timeline``
+serves and the dashboard waterfall + ``polyaxon timeline`` render.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Any, Optional
+
+# env var the operator/compiler injects into every pod so in-pod tracing
+# joins the control-plane timeline (tracking/run.py reads it)
+ENV_TRACE_ID = "POLYAXON_TRACE_ID"
+
+
+def _epoch(iso: Optional[str]) -> Optional[float]:
+    if not iso:
+        return None
+    try:
+        t = datetime.datetime.fromisoformat(iso)
+    except ValueError:
+        return None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=datetime.timezone.utc)
+    return t.timestamp()
+
+
+def trace_id_for(run: dict) -> str:
+    """A run's trace id: ``meta.trace_id`` when stamped, else the run uuid
+    (the natural correlation key — every pod already carries it)."""
+    return (run.get("meta") or {}).get("trace_id") or run["uuid"]
+
+
+def _span(name: str, start: float, end: float, process: str,
+          meta: Optional[dict] = None) -> dict:
+    return {
+        "name": name,
+        "process": process,
+        "start": start,
+        "end": end,
+        "duration_s": max(end - start, 0.0),
+        "meta": meta or {},
+    }
+
+
+def lifecycle_spans(conditions: list[dict],
+                    now: Optional[float] = None) -> list[dict]:
+    """Phase spans from a run's status-condition history (oldest first,
+    the `Store.get_statuses` order). Each phase ends where the next
+    begins; the open phase of a live run ends at ``now``; a terminal
+    condition is a zero-length marker span."""
+    import time as _time
+
+    now = now if now is not None else _time.time()
+    stamps = []
+    for cond in conditions:
+        # conditions serialize by_alias (camelCase) but accept snake too
+        ts = _epoch(cond.get("lastTransitionTime")
+                    or cond.get("last_transition_time")
+                    or cond.get("lastUpdateTime")
+                    or cond.get("last_update_time"))
+        if ts is None:
+            continue
+        stamps.append((ts, cond))
+    # conditions are insert-ordered (transaction order); clamp any clock
+    # oddity so spans stay monotonic and non-overlapping
+    spans: list[dict] = []
+    prev_ts = None
+    for i, (ts, cond) in enumerate(stamps):
+        if prev_ts is not None and ts < prev_ts:
+            ts = prev_ts
+        end = stamps[i + 1][0] if i + 1 < len(stamps) else now
+        if end < ts:
+            end = ts
+        if i + 1 == len(stamps):
+            from ..schemas.statuses import is_done
+
+            status = cond.get("type")
+            try:
+                terminal = bool(status) and is_done(status)
+            except ValueError:
+                terminal = False
+            if terminal:
+                end = ts  # terminal marker, not an open interval
+        meta = {}
+        if cond.get("reason"):
+            meta["reason"] = cond["reason"]
+        if cond.get("message"):
+            meta["message"] = cond["message"]
+        spans.append(_span(cond.get("type") or "unknown", ts, end,
+                           "control-plane", meta))
+        prev_ts = ts
+    return spans
+
+
+def pod_spans(run_dir: str) -> list[dict]:
+    """Spans the pod-side runtime logged through tracking
+    (``events/span/*.jsonl`` under the run's artifacts dir)."""
+    from ..tracking.writer import list_event_names, read_events
+
+    spans: list[dict] = []
+    if not run_dir or not os.path.isdir(run_dir):
+        return spans
+    for name in list_event_names(run_dir, "span"):
+        for ev in read_events(run_dir, "span", name):
+            sp = ev.span
+            if sp is None or sp.start is None:
+                continue
+            end = sp.end if sp.end is not None else sp.start
+            spans.append(_span(sp.name or name, float(sp.start), float(end),
+                               "pod", dict(sp.meta or {})))
+    return spans
+
+
+def build_timeline(run: dict, conditions: list[dict], run_dir: str,
+                   now: Optional[float] = None) -> dict[str, Any]:
+    """The merged timeline document for one run."""
+    spans = lifecycle_spans(conditions, now=now) + pod_spans(run_dir)
+    spans.sort(key=lambda s: (s["start"], s["end"]))
+    return {
+        "run_uuid": run["uuid"],
+        "trace_id": trace_id_for(run),
+        "status": run.get("status"),
+        "processes": sorted({s["process"] for s in spans}),
+        "spans": spans,
+    }

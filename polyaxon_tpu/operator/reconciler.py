@@ -63,7 +63,7 @@ _REASON_MSG = {
 
 class OperationReconciler:
     def __init__(self, cluster: Cluster, on_status: Optional[StatusFn] = None,
-                 retry=None, on_status_many=None):
+                 retry=None, on_status_many=None, on_retry_exhausted=None):
         from ..resilience.retry import RetryPolicy
 
         self.cluster = cluster
@@ -80,6 +80,10 @@ class OperationReconciler:
         # step edges (restart's 4-transition walk) use it when available.
         self.on_status_many = on_status_many or (
             lambda updates: [self.on_status(*u) for u in updates])
+        # observability hook (ISSUE 5): fired when an op FAILs with a
+        # non-zero backoff budget fully burned — the agent wires the
+        # shared retry-exhaustion counter here
+        self.on_retry_exhausted = on_retry_exhausted or (lambda: None)
         self._ops: dict[str, _OpState] = {}
         self._lock = threading.Lock()
         self._reconcile_lock = threading.Lock()
@@ -269,6 +273,15 @@ class OperationReconciler:
                 updates.append((op.run_uuid, V1Statuses.RUNNING.value, None))
             state.final_status = status.value
             state.finished_at = time.monotonic()
+            if (decision.action == Action.FAIL
+                    and decision.reason == Reason.POD_FAILED
+                    and op.backoff_limit > 0):
+                # exactly-once: final_status latches above, so this FAIL
+                # branch cannot re-fire for the same op
+                try:
+                    self.on_retry_exhausted()
+                except Exception:
+                    traceback.print_exc()
             # report BEFORE any teardown so on_status consumers (agent log
             # scraping) still see the pods; then failure tears them down,
             # success leaves them until TTL (or forever when ttl < 0)

@@ -24,7 +24,11 @@ from ..schemas.statuses import V1Statuses
 _REAPABLE = (V1Statuses.STARTING.value, V1Statuses.RUNNING.value)
 
 
-def _age_seconds(iso: Optional[str]) -> Optional[float]:
+def age_seconds(iso: Optional[str]) -> Optional[float]:
+    """Seconds since an ISO timestamp; naive stamps are assumed UTC.
+    Shared by the reaper's staleness scan and the store's
+    ``heartbeat_age_s`` / schedule-latency stamping — one parsing rule,
+    so the two surfaces can never disagree about the same row."""
     if not iso:
         return None
     try:
@@ -77,12 +81,37 @@ class ZombieReaper:
         owned: Callable[[], Iterable[str]],
         zombie_after: float = 120.0,
         list_runs: Optional[Callable[[str], list]] = None,
+        metrics=None,
     ):
         import time
 
         self.store = store
         self.owned = owned
         self.zombie_after = zombie_after
+        # observability (ISSUE 5): reap actions + the staleness the reaper
+        # actually observed, exported through the shared registry
+        if metrics is None:
+            from ..obs.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+        self.metrics = metrics
+        self._c_reaps = {
+            action: metrics.counter(
+                "polyaxon_reaper_reaps_total",
+                "Zombie runs reaped, by outcome", labels={"action": action})
+            for action in ("retried", "failed")
+        }
+        self._c_exhausted = metrics.counter(
+            "polyaxon_retry_exhaustions_total",
+            "Runs failed with their termination.maxRetries budget exhausted")
+        # max heartbeat age seen among NON-owned in-flight runs on the
+        # last pass (0 when everything is fresh): the "is anything going
+        # stale" needle the dashboard/alerts watch
+        self.last_max_staleness = 0.0
+        metrics.gauge(
+            "polyaxon_heartbeat_staleness_seconds",
+            "Max heartbeat age among unowned in-flight runs (last pass)",
+            value_fn=lambda: self.last_max_staleness)
         # self-throttle: callers (the agent tick) may fire every poll
         # interval, but lease renewal + staleness scans only need to run a
         # few times per zombie_after window — not 20x/second
@@ -107,6 +136,7 @@ class ZombieReaper:
         actions: list[tuple[str, str]] = []
         owned = set(self.owned())
         seen: set = set()
+        max_stale = 0.0
         for status in _REAPABLE:
             for run in self._list_runs(status):
                 uuid = run["uuid"]
@@ -115,9 +145,11 @@ class ZombieReaper:
                     self.store.heartbeat(uuid)
                     self._strikes.pop(uuid, None)
                     continue
-                age = _age_seconds(run.get("heartbeat_at")
+                age = age_seconds(run.get("heartbeat_at")
                                    or run.get("started_at")
                                    or run.get("updated_at"))
+                if age is not None:
+                    max_stale = max(max_stale, age)
                 if age is None or age < self.zombie_after:
                     self._strikes.pop(uuid, None)
                     continue
@@ -132,6 +164,7 @@ class ZombieReaper:
                     actions.append((uuid, self._reap(run)))
         # runs that left the reapable statuses drop their strike state
         self._strikes = {u: s for u, s in self._strikes.items() if u in seen}
+        self.last_max_staleness = max_stale
         self.reaped.extend(actions)
         return actions
 
@@ -150,9 +183,13 @@ class ZombieReaper:
                 message=f"no heartbeat for {self.zombie_after:.0f}s; "
                         f"attempt {retries_done + 2}/{budget + 1}")
             self.store.transition(uuid, V1Statuses.QUEUED.value)
+            self._c_reaps["retried"].inc()
             return "retried"
         self.store.transition(
             uuid, V1Statuses.FAILED.value, force=True, reason="ZombieReaped",
             message=f"stuck in {run['status']} with no heartbeat for "
                     f"{self.zombie_after:.0f}s and no retry budget left")
+        self._c_reaps["failed"].inc()
+        if budget > 0:
+            self._c_exhausted.inc()
         return "failed"

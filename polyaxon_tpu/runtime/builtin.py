@@ -29,6 +29,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 from dataclasses import replace
 from typing import Any
 
@@ -157,12 +158,27 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
         microbatches=int(spec.get("microbatches", 1)),
         accum_dtype=spec.get("accum_dtype"),
     )
+    # Throughput bridge (ISSUE 5 tentpole (c)): on every tracked interval
+    # the ThroughputMeter summary ALSO flows into run outputs, so the
+    # dashboard and `bench.py --orchestrated` read live tokens/s / MFU /
+    # step-time percentiles from the run itself — the same numbers the
+    # terminal summary freezes at the end, not a bench-side recomputation.
+    meter_keys = ("steps", "step_time_ms", "step_time_p50_ms",
+                  "step_time_p95_ms", "tokens_per_sec",
+                  "tokens_per_sec_per_chip", "achieved_tflops_per_chip",
+                  "mfu")
     track = None
     if run is not None:
-        track = lambda step, m: run.log_metrics(step=step, **{  # noqa: E731
-            k: v for k, v in m.items() if isinstance(v, (int, float))
-        })
-    trainer = Trainer(tcfg, task=task, track=track)
+        def track(step, m):
+            run.log_metrics(step=step, **{
+                k: v for k, v in m.items() if isinstance(v, (int, float))
+            })
+            run.log_outputs(**{k: m[k] for k in meter_keys if k in m})
+    # pod-side spans (ISSUE 5 tentpole (a)): first-step compile, train
+    # window, checkpoint saves join the control-plane lifecycle timeline
+    # through the trace id tracking picked up from POLYAXON_TRACE_ID
+    trainer = Trainer(tcfg, task=task, track=track,
+                      on_span=run.log_span if run is not None else None)
 
     data_spec = dict(spec.get("data") or {})
     data_kwargs: dict[str, Any] = {}
@@ -187,7 +203,13 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
     # The data stream must be fast-forwarded to the restored step — without
     # this a resumed run re-consumes batches 0..k and diverges from an
     # uninterrupted run (the chaos parity proof would catch it).
+    t_restore = time.time()
     state, start_step = trainer.restore_or_init()
+    if run is not None:
+        # zero-length-ish on a fresh start; on a resumed attempt this is
+        # the checkpoint-read cost the timeline should surface
+        run.log_span("restore", t_restore, time.time(),
+                     resumed_from_step=int(start_step))
     for _ in range(start_step):
         next(batches)
 

@@ -153,6 +153,61 @@ class LocalAgent:
         self._suspended = threading.Event()  # chaos hook: GC-pause stand-in
         self.store = FencedStore(store, self._current_fence,
                                  on_stale=self._on_stale_lease)
+        # Observability (ISSUE 5): the agent's series live in the STORE's
+        # registry — the store is what the API server and soak harnesses
+        # already hold, so one scrape covers both layers. Get-or-create
+        # semantics: a successor agent re-binds the gauges to ITS
+        # in-memory state and the counters keep counting across
+        # incarnations (a takeover must not reset reap/exhaustion totals).
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics = getattr(store, "metrics", None)
+        if self.metrics is None:
+            self.metrics = MetricsRegistry()
+        self._h_wake = self.metrics.histogram(
+            "polyaxon_agent_wake_latency_seconds",
+            "Store change-feed event to scheduling-pass pickup")
+        self._c_retry_exhausted = self.metrics.counter(
+            "polyaxon_retry_exhaustions_total",
+            "Runs failed with their termination.maxRetries budget exhausted")
+        self.metrics.gauge(
+            "polyaxon_agent_queue_depth",
+            "Runs waiting in the capacity FIFO",
+            value_fn=lambda: len(self._pending))
+        self.metrics.gauge(
+            "polyaxon_agent_chips_in_use",
+            "TPU chips reserved by scheduled runs",
+            value_fn=lambda: sum(self._chips_in_use.values()))
+        self.metrics.gauge(
+            "polyaxon_agent_capacity_chips",
+            "Configured chip budget (0 = run-count scheduling)",
+            value_fn=lambda: self.capacity_chips or 0)
+        self.metrics.gauge(
+            "polyaxon_agent_chip_utilization",
+            "chips_in_use / capacity_chips (0 when chip budgeting is off)",
+            value_fn=lambda: (sum(self._chips_in_use.values())
+                              / self.capacity_chips
+                              if self.capacity_chips else 0.0))
+        self.metrics.gauge(
+            "polyaxon_agent_active_runs",
+            "Runs with a live driver in this agent",
+            value_fn=lambda: (len(self._active) + len(self._tuners)
+                              + (self.reconciler.active_count()
+                                 if self.reconciler is not None else 0)))
+        self.metrics.gauge(
+            "polyaxon_agent_lease_held",
+            "1 when this agent may mutate (lease held or leasing off)",
+            value_fn=lambda: 1.0 if (self.lease_ttl <= 0
+                                     or self.lease is not None) else 0.0)
+        # pass counters cached like every other series: the quiet-wake
+        # fast path must not pay a registry lock + label-key build per tick
+        self._c_passes = {
+            kind: self.metrics.counter(
+                "polyaxon_agent_passes_total", "Scheduling passes by kind",
+                labels={"kind": kind})
+            for kind in ("idle", "full", "dirty")
+        }
+        self._wake_armed_at: Optional[float] = None
         # transient-failure policy for the sidecar's log/artifact sync
         self.retry = retry if retry is not None else DEFAULT_HTTP_RETRY
         # lease-based failure detection (docs/RESILIENCE.md): runs this
@@ -162,7 +217,8 @@ class LocalAgent:
         # writes through the fenced proxy: a stale agent's reaper cannot
         # reap runs the NEW agent is actively driving.
         self.reaper = ZombieReaper(
-            self.store, owned=self._driven_uuids, zombie_after=zombie_after)
+            self.store, owned=self._driven_uuids, zombie_after=zombie_after,
+            metrics=self.metrics)
         self.artifacts_root = os.path.abspath(artifacts_root)
         self.api_host = api_host
         self.api_token = api_token
@@ -192,7 +248,17 @@ class LocalAgent:
             self.cluster = cluster
             self.reconciler = OperationReconciler(
                 cluster, on_status=self._on_status,
-                on_status_many=self._on_status_many)
+                on_status_many=self._on_status_many,
+                on_retry_exhausted=self._c_retry_exhausted.inc)
+            if hasattr(cluster, "injected"):
+                # chaos harness attached: export its injected-fault count
+                # (a Counter with value_fn, same pattern as the Store.stats
+                # exports — the audit log only grows, so rate()/increase()
+                # must see a counter-typed family)
+                self.metrics.counter(
+                    "polyaxon_chaos_injected_total",
+                    "Faults injected by the chaos harness",
+                    value_fn=lambda: len(self.cluster.injected))
         elif backend != "local":
             raise ValueError(f"unknown agent backend {backend!r}")
         self._active: dict[str, LocalExecution] = {}
@@ -636,6 +702,8 @@ class LocalAgent:
                     (uuid, V1Statuses.QUEUED.value),
                 ])
             else:
+                if budget > 0:
+                    self._c_retry_exhausted.inc()
                 self.store.transition(
                     uuid, V1Statuses.FAILED.value, force=True,
                     reason="AgentRestart",
@@ -748,6 +816,10 @@ class LocalAgent:
                 self._dirty.add(run_uuid)
                 if len(self._dirty) > 512:
                     self._dirty = None  # overflow: next tick full-scans
+            if self._wake_armed_at is None:
+                # first un-consumed event arms the wake-latency clock; the
+                # loop observes (and disarms) when it picks the batch up
+                self._wake_armed_at = time.monotonic()
         self._wake.set()
         self._on_hook_event(run_uuid, status)
 
@@ -909,6 +981,9 @@ class LocalAgent:
                 with self._dirty_lock:
                     dirty = self._dirty
                     self._dirty = set()
+                    armed, self._wake_armed_at = self._wake_armed_at, None
+                if armed is not None:
+                    self._h_wake.observe(time.monotonic() - armed)
                 now = time.monotonic()
                 need_full = (dirty is None or self._need_full
                              or now - self._last_full >= self.resync_interval)
@@ -943,6 +1018,7 @@ class LocalAgent:
         have freed — _finalize_run releases chips AFTER its terminal
         transition event, then re-wakes us) and keep pods watched. The
         watermark gate makes this O(1) when nothing actually changed."""
+        self._c_passes["idle"].inc()
         self._schedule_pending()
         if self.reconciler is not None:
             self.reconciler.reconcile_once()
@@ -953,6 +1029,7 @@ class LocalAgent:
         Authoritative: rebuilds the capacity wait queue from the store, so
         it also covers writers outside this process that the in-proc change
         feed never sees."""
+        self._c_passes["full"].inc()
         for run in self.store.list_runs(status=V1Statuses.CREATED.value,
                                         order="asc"):
             self._compile(run)
@@ -1022,6 +1099,7 @@ class LocalAgent:
         queue (``_pending``); scheduling walks that queue under the budget
         watermark instead of rescanning the store's queued list, which is
         what made deep bursts O(events × queued) before r7 (BASELINE r6)."""
+        self._c_passes["dirty"].inc()
         rows = self.store.get_runs(list(dirty))
         # process in creation order so a coalesced burst (N creates in one
         # wake) compiles/queues FIFO — scheduling order must not depend on

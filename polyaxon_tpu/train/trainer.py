@@ -9,6 +9,7 @@ shardings, inputs are donated, and the loop reports traceml-style metrics.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
 
@@ -78,6 +79,7 @@ class Trainer:
         rules: Optional[ShardingRules] = None,
         track: Optional[Callable[[int, dict], None]] = None,
         task: Optional[Task] = None,
+        on_span: Optional[Callable[..., None]] = None,
     ):
         self.cfg = cfg
         if task is None:
@@ -106,6 +108,11 @@ class Trainer:
         self.rules = rules
         self.tx = make_optimizer(cfg.optimizer)
         self.track = track
+        # lifecycle tracing (obs/trace.py): on_span(name, start, end, **meta)
+        # with epoch seconds — the builtin runtime wires Run.log_span here so
+        # pod-side phases (first-step compile, train window, checkpoint
+        # saves) land on the run's one-pane-of-glass timeline
+        self.on_span = on_span
         self.checkpointer = Checkpointer(cfg.checkpoint) if cfg.checkpoint else None
 
         pspecs = task.param_specs(self.rules)
@@ -296,6 +303,8 @@ class Trainer:
                 accelerator=self.cfg.accelerator,
             )
         metrics: dict = {}
+        t_fit = time.time()  # span clock: epoch (joins condition timestamps)
+        t_train: Optional[float] = None
         for i in range(start, num_steps):
             batch = next(batches)
             state, metrics = step_fn(state, batch)
@@ -304,6 +313,9 @@ class Trainer:
                 # platforms (axon) block_until_ready returns before execution
                 # finishes; a device->host copy always waits.
                 float(metrics["loss"])  # excludes compile from timing
+                t_train = time.time()
+                if self.on_span:
+                    self.on_span("first-step-compiled", t_fit, t_train, step=i)
                 meter.start()
             else:
                 if i == num_steps - 1:
@@ -314,10 +326,22 @@ class Trainer:
                 logged.update(meter.summary())
                 self.track(i, logged)
             if self.checkpointer:
-                self.checkpointer.maybe_save(i + 1, state)
+                t_save = time.time()
+                if self.checkpointer.maybe_save(i + 1, state) and self.on_span:
+                    # async mode: the span covers the synchronous handoff
+                    # (device->host fetch + save dispatch), not the flush
+                    self.on_span("checkpoint-save", t_save, time.time(),
+                                 step=i + 1)
+        if t_train is not None and self.on_span:
+            self.on_span("train", t_train, time.time(),
+                         steps=num_steps - start)
         if self.checkpointer:
             if self.checkpointer.latest_step() != num_steps:
-                self.checkpointer.maybe_save(num_steps, state, force=True)
+                t_save = time.time()
+                if self.checkpointer.maybe_save(num_steps, state, force=True) \
+                        and self.on_span:
+                    self.on_span("checkpoint-save", t_save, time.time(),
+                                 step=num_steps)
             self.checkpointer.wait()
         final = {k: float(v) for k, v in metrics.items()}
         final.update(meter.summary())

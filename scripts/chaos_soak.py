@@ -18,7 +18,12 @@ Usage:
         [--seed 2024] [--fault-rate 0.08] [--timeout-rate 0.02] \
         [--preempt-rate 0.03] [--max-preemptions 2] [--trials 3] \
         [--rounds 1] [--keep] \
-        [--kill-agent] [--split-brain] [--kills 2] [--lease-ttl 0.8]
+        [--kill-agent] [--split-brain] [--kills 2] [--lease-ttl 0.8] \
+        [--metrics-dump [PATH]]
+
+``--metrics-dump`` archives the last round's final /metrics scrape
+(validated Prometheus text, docs/OBSERVABILITY.md) into bench_artifacts —
+every soak leaves a machine-readable telemetry artifact.
 
 Every knob maps 1:1 onto ChaosConfig; --rounds repeats the chaotic pass
 with seed, seed+1, ... for endurance sweeps. The pytest-integrated proofs
@@ -113,7 +118,10 @@ def _pass(workdir: str, trials: int, chaos_cfg=None, timeout: float = 600.0):
         for row in store.list_runs(limit=500):
             statuses[row["name"]] = row["status"]
         injected = list(getattr(cluster, "injected", []))
-        return statuses, injected
+        # final Prometheus scrape of the pass's whole control plane (store
+        # counters + agent gauges + reaper/chaos series) — what
+        # --metrics-dump archives into bench_artifacts
+        return statuses, injected, store.metrics.render()
     finally:
         agent.stop()
 
@@ -232,6 +240,7 @@ def run_kill_agent_soak(workdir: str, seed: int = 2024, n_jobs: int = 8,
                     for r in (store.get_run(u) for u in uuids)}
         return {
             "statuses": statuses,
+            "metrics_text": store.metrics.render(),
             "fence_rejections": store.stats["fence_rejections"],
             "stale_writes_rejected": stale_rejected,
             "launch_intents": store.stats["launch_intents"],
@@ -245,15 +254,31 @@ def run_kill_agent_soak(workdir: str, seed: int = 2024, n_jobs: int = 8,
         agent.stop()
 
 
+def _dump_metrics(path: str, text: str) -> None:
+    """Archive the final /metrics scrape of the last round (validated
+    Prometheus text) so every soak leaves a machine-readable telemetry
+    artifact next to its BENCH json (docs/OBSERVABILITY.md)."""
+    from polyaxon_tpu.obs import parse_prometheus
+
+    parse_prometheus(text)  # refuse to archive an invalid exposition
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+    print(json.dumps({"metrics_dump": path,
+                      "families": len(parse_prometheus(text))}))
+
+
 def _run_kill_agent_mode(args) -> int:
     from polyaxon_tpu.resilience import ChaosConfig
 
     root = tempfile.mkdtemp(prefix="plx-kill-agent-soak-")
     ok = True
+    final_scrape = ""
     try:
         oracle = run_kill_agent_soak(
             os.path.join(root, "oracle"), seed=args.seed,
             n_jobs=args.trials * 3, kills=0, timeout=args.timeout)
+        final_scrape = oracle["metrics_text"]
         print(json.dumps({"pass": "oracle", "statuses": oracle["statuses"]}))
         if any(v != "succeeded" for v in oracle["statuses"].values()):
             print(json.dumps({"error": "oracle pass did not fully succeed"}))
@@ -270,6 +295,7 @@ def _run_kill_agent_mode(args) -> int:
                 n_jobs=args.trials * 3, kills=args.kills,
                 split_brain=args.split_brain, chaos_cfg=cfg,
                 lease_ttl=args.lease_ttl, timeout=args.timeout)
+            final_scrape = out["metrics_text"]
             converged = out["statuses"] == oracle["statuses"]
             no_dups = not out["duplicate_applies"]
             fenced = out["fence_rejections"] >= 1
@@ -295,6 +321,8 @@ def _run_kill_agent_mode(args) -> int:
             print(json.dumps({"workdir": root}))
         else:
             shutil.rmtree(root, ignore_errors=True)
+    if args.metrics_dump:
+        _dump_metrics(args.metrics_dump, final_scrape)
     print(json.dumps({"ok": ok}))
     return 0 if ok else 1
 
@@ -323,6 +351,15 @@ def main() -> int:
                    help="agent kills per --kill-agent round")
     p.add_argument("--lease-ttl", type=float, default=0.8,
                    help="agent lease TTL for --kill-agent rounds")
+    p.add_argument("--metrics-dump", nargs="?", metavar="PATH",
+                   const=os.path.join(
+                       os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))),
+                       "bench_artifacts", "chaos_soak_metrics.prom"),
+                   default=None,
+                   help="write the last round's final /metrics scrape "
+                        "(validated Prometheus text) to PATH (default: "
+                        "bench_artifacts/chaos_soak_metrics.prom)")
     args = p.parse_args()
 
     if args.kill_agent or args.split_brain:
@@ -334,8 +371,8 @@ def main() -> int:
     root = tempfile.mkdtemp(prefix="plx-chaos-soak-")
     ok = True
     try:
-        oracle, _ = _pass(os.path.join(root, "oracle"), args.trials,
-                          timeout=args.timeout)
+        oracle, _, final_scrape = _pass(os.path.join(root, "oracle"),
+                                        args.trials, timeout=args.timeout)
         print(json.dumps({"pass": "oracle", "statuses": oracle}))
         if any(v != "succeeded" for v in oracle.values()):
             print(json.dumps({"error": "oracle pass did not fully succeed"}))
@@ -349,7 +386,7 @@ def main() -> int:
                 max_api_faults=args.max_api_faults,
                 max_preemptions=args.max_preemptions,
             )
-            statuses, injected = _pass(
+            statuses, injected, final_scrape = _pass(
                 os.path.join(root, f"chaos-{seed}"), args.trials, cfg,
                 timeout=args.timeout)
             converged = statuses == oracle
@@ -368,6 +405,8 @@ def main() -> int:
             print(json.dumps({"workdir": root}))
         else:
             shutil.rmtree(root, ignore_errors=True)
+    if args.metrics_dump:
+        _dump_metrics(args.metrics_dump, final_scrape)
     print(json.dumps({"ok": ok}))
     return 0 if ok else 1
 

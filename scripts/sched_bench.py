@@ -96,6 +96,14 @@ def run_mode(n: int, mode: str, poll_interval: float, max_parallel: int,
     failed = sum(
         1 for u in created
         if (store.get_run(u) or {}).get("status") != "succeeded")
+    # cross-check against the store's OWN schedule-latency histogram
+    # (polyaxon_schedule_latency_seconds, observed transactionally with
+    # each first `running` edge): the /metrics exposition must tell the
+    # same story as this bench's listener clocks (ISSUE 5 acceptance:
+    # p50 consistent within ±20%)
+    hist = store.metrics.get("polyaxon_schedule_latency_seconds")
+    hist_p50 = hist.quantile(0.50) if hist is not None else None
+    hist_bucket_p50 = hist.bucket_quantile(0.50) if hist is not None else None
     return {
         "mode": mode,
         "runs": n,
@@ -105,6 +113,9 @@ def run_mode(n: int, mode: str, poll_interval: float, max_parallel: int,
         "max_parallel": max_parallel,
         "time_to_running_p50_s": round(_percentile(ttr, 0.50), 4),
         "time_to_running_p95_s": round(_percentile(ttr, 0.95), 4),
+        "metrics_hist_p50_s": round(hist_p50, 4) if hist_p50 is not None else None,
+        "metrics_hist_bucket_p50_s": round(hist_bucket_p50, 4)
+        if hist_bucket_p50 is not None else None,
         "time_to_running_mean_s": round(statistics.fmean(ttr), 4) if ttr else None,
         "wall_s": round(wall, 3),
         "runs_per_min": round(len(done) / wall * 60.0, 1) if wall > 0 else None,

@@ -1,0 +1,560 @@
+"""Observability layer (ISSUE 5): hand-rolled Prometheus exposition,
+lifecycle + pod-side span tracing, the /metrics + /api/v1/stats surfaces,
+curve/confusion event kinds, heartbeat-age badging, and counter integrity
+— asserted the way an operator would see them (scrapes and API documents,
+not internals)."""
+
+import datetime
+import math
+import os
+import sys
+import time
+
+import pytest
+import requests
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from polyaxon_tpu.api import ApiServer  # noqa: E402
+from polyaxon_tpu.api.store import StaleLeaseError, Store  # noqa: E402
+from polyaxon_tpu.client import AgentClient, RunClient  # noqa: E402
+from polyaxon_tpu.obs import (  # noqa: E402
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    latency_buckets,
+    parse_prometheus,
+)
+from polyaxon_tpu.obs.trace import lifecycle_spans  # noqa: E402
+from polyaxon_tpu.resilience import ZombieReaper  # noqa: E402
+from polyaxon_tpu.scheduler.agent import LocalAgent  # noqa: E402
+from polyaxon_tpu.tracking import Run, V1EventKind, read_events  # noqa: E402
+
+UTC = datetime.timezone.utc
+
+# every family the control plane is contracted to export
+# (docs/OBSERVABILITY.md) — the CI scrape check asserts all of them
+EXPECTED_FAMILIES = {
+    "polyaxon_store_transactions_total",
+    "polyaxon_store_runs_deserialized_total",
+    "polyaxon_store_fence_rejections_total",
+    "polyaxon_store_launch_intents_total",
+    "polyaxon_store_write_seconds",
+    "polyaxon_schedule_latency_seconds",
+    "polyaxon_agent_wake_latency_seconds",
+    "polyaxon_agent_queue_depth",
+    "polyaxon_agent_chips_in_use",
+    "polyaxon_agent_capacity_chips",
+    "polyaxon_agent_chip_utilization",
+    "polyaxon_agent_active_runs",
+    "polyaxon_agent_lease_held",
+    "polyaxon_reaper_reaps_total",
+    "polyaxon_retry_exhaustions_total",
+    "polyaxon_heartbeat_staleness_seconds",
+}
+
+
+# -- primitives --------------------------------------------------------------
+
+
+class TestMetricsPrimitives:
+    def test_counter_inc_and_callback_export(self):
+        c = Counter("x_total")
+        c.inc()
+        c.inc(2)
+        assert c.value == 3
+        stats = {"n": 7}
+        cb = Counter("y_total", value_fn=lambda: stats["n"])
+        assert cb.value == 7
+        stats["n"] = 9
+        assert cb.value == 9  # no double bookkeeping: reads the live dict
+
+    def test_gauge_rebind(self):
+        g = Gauge("g", value_fn=lambda: 1.0)
+        assert g.value == 1.0
+        g.set_fn(lambda: 5.0)  # successor re-binds to ITS state
+        assert g.value == 5.0
+
+    def test_histogram_quantiles_and_render(self):
+        h = Histogram("lat_seconds", buckets=[0.1, 1.0, 10.0])
+        for v in (0.05, 0.5, 0.5, 5.0):
+            h.observe(v)
+        assert h.count == 4
+        assert h.quantile(0.50) == 0.5
+        lines = h.render()
+        # cumulative buckets: 1 under 0.1, 3 under 1.0, 4 under 10 and +Inf
+        assert 'lat_seconds_bucket{le="0.1"} 1' in lines
+        assert 'lat_seconds_bucket{le="1"} 3' in lines
+        assert 'lat_seconds_bucket{le="10"} 4' in lines
+        assert 'lat_seconds_bucket{le="+Inf"} 4' in lines
+        assert any(line.startswith("lat_seconds_count") for line in lines)
+
+    def test_bucket_quantile_tracks_exact_within_20pct(self):
+        """The default geometric buckets (factor 1.2) were chosen so a
+        Prometheus histogram_quantile() stays within the ±20% consistency
+        bound the schedule-latency acceptance check uses."""
+        h = Histogram("q_seconds", buckets=latency_buckets())
+        vals = [0.01 * (1.13 ** i) for i in range(60)]  # 10ms .. ~5min span
+        for v in vals:
+            h.observe(v)
+        for q in (0.5, 0.9):
+            exact = h.quantile(q)
+            est = h.bucket_quantile(q)
+            assert abs(est - exact) <= 0.20 * exact, (q, exact, est)
+
+    def test_registry_get_or_create_and_type_guard(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("a_total")
+        c1.inc(3)
+        assert reg.counter("a_total") is c1  # takeover keeps counting
+        with pytest.raises(TypeError):
+            reg.gauge("a_total")
+        # distinct label sets are distinct series of one family
+        reg.counter("b_total", labels={"k": "x"}).inc()
+        reg.counter("b_total", labels={"k": "y"}).inc(2)
+        fams = parse_prometheus(reg.render())
+        assert fams["b_total"]['b_total{k="x"}'] == 1
+        assert fams["b_total"]['b_total{k="y"}'] == 2
+
+    def test_render_is_valid_prometheus_and_parser_rejects_garbage(self):
+        reg = MetricsRegistry()
+        reg.counter("ok_total", "help text").inc()
+        reg.histogram("h_seconds").observe(0.5)
+        fams = parse_prometheus(reg.render())  # strict: raises on bad lines
+        assert fams["ok_total"]["ok_total"] == 1
+        assert fams["h_seconds"]["h_seconds_count"] == 1
+        with pytest.raises(ValueError):
+            parse_prometheus("this is { not a sample\n")
+        # a NaN/Inf-returning gauge callback must render Prometheus
+        # capitalization the strict parser accepts — not Python's 'nan'
+        reg.gauge("weird", value_fn=lambda: float("nan"))
+        reg.gauge("hot", value_fn=lambda: float("-inf"))
+        fams = parse_prometheus(reg.render())
+        assert math.isnan(fams["weird"]["weird"])
+        assert fams["hot"]["hot"] == float("-inf")
+
+
+# -- lifecycle span assembly -------------------------------------------------
+
+
+def _cond(status: str, offset_s: float, base=None) -> dict:
+    base = base or datetime.datetime(2026, 8, 1, tzinfo=UTC)
+    ts = (base + datetime.timedelta(seconds=offset_s)).isoformat()
+    return {"type": status, "last_transition_time": ts}
+
+
+class TestLifecycleSpans:
+    def test_phases_are_monotonic_non_overlapping_terminal_marker(self):
+        conds = [_cond("created", 0), _cond("queued", 1.5),
+                 _cond("running", 2.0), _cond("succeeded", 5.0)]
+        spans = lifecycle_spans(conds)
+        assert [s["name"] for s in spans] == [
+            "created", "queued", "running", "succeeded"]
+        for a, b in zip(spans, spans[1:]):
+            assert a["end"] == b["start"]  # contiguous, no overlap
+            assert a["start"] <= a["end"]
+        assert spans[-1]["duration_s"] == 0.0  # terminal = marker
+        assert spans[1]["duration_s"] == pytest.approx(0.5)
+
+    def test_open_phase_of_live_run_ends_at_now(self):
+        conds = [_cond("created", 0), _cond("running", 1.0)]
+        now = datetime.datetime(2026, 8, 1, tzinfo=UTC).timestamp() + 10.0
+        spans = lifecycle_spans(conds, now=now)
+        assert spans[-1]["name"] == "running"
+        assert spans[-1]["end"] == now  # still open, not zero-length
+
+    def test_clock_skew_is_clamped(self):
+        # a condition stamped BEFORE its predecessor (cross-process clock
+        # oddity) must not produce a negative/overlapping span
+        conds = [_cond("created", 2.0), _cond("running", 1.0),
+                 _cond("succeeded", 5.0)]
+        spans = lifecycle_spans(conds)
+        for a, b in zip(spans, spans[1:]):
+            assert b["start"] >= a["start"]
+            assert a["end"] <= b["start"] or a["duration_s"] == 0.0
+        for s in spans:
+            assert s["duration_s"] >= 0.0
+
+
+# -- store surfaces: heartbeat age, fence/sched histograms -------------------
+
+
+class TestHeartbeatAgeInListing:
+    def test_inflight_rows_carry_age_terminal_rows_do_not(self):
+        store = Store(":memory:")
+        fresh = store.create_run("p", spec={}, name="fresh")["uuid"]
+        live = store.create_run("p", spec={}, name="live")["uuid"]
+        done = store.create_run("p", spec={}, name="done")["uuid"]
+        store.transition(live, "running", force=True)
+        store.heartbeat(live)
+        store.transition(done, "running", force=True)
+        store.transition(done, "succeeded")
+        rows = {r["uuid"]: r for r in store.list_runs(limit=10)}
+        assert rows[live]["heartbeat_age_s"] >= 0.0
+        assert rows[live]["heartbeat_age_s"] < 60.0
+        assert "heartbeat_age_s" not in rows[fresh]  # not in flight yet
+        assert "heartbeat_age_s" not in rows[done]  # terminal: meaningless
+
+    def test_schedule_latency_observed_once_per_run(self):
+        store = Store(":memory:")
+        uuid = store.create_run("p", spec={})["uuid"]
+        store.transition(uuid, "running", force=True)
+        h = store.metrics.get("polyaxon_schedule_latency_seconds")
+        assert h.count == 1
+        # a retry walking back through running must NOT re-observe (the
+        # first-running edge is the schedule latency; started_at latches)
+        store.transition(uuid, "retrying", force=True)
+        store.transition(uuid, "queued")
+        store.transition(uuid, "running", force=True)
+        assert h.count == 1
+
+    def test_rolled_back_batch_does_not_observe_schedule_latency(self):
+        # a mid-batch error rolls back started_at, so the sample must not
+        # flush either — otherwise the retried running edge double-counts
+        store = Store(":memory:")
+        uuid = store.create_run("p", spec={})["uuid"]
+        h = store.metrics.get("polyaxon_schedule_latency_seconds")
+        with pytest.raises(ValueError):
+            store.transition_many([
+                (uuid, "running", None, None, True),
+                (uuid, "not-a-status"),
+            ])
+        assert h.count == 0
+        store.transition(uuid, "running", force=True)
+        assert h.count == 1
+
+
+# -- counter integrity (satellite): exactly-once, asserted via scrape --------
+
+
+class TestCounterIntegrity:
+    FENCE = "polyaxon_store_fence_rejections_total"
+
+    def _fam(self, store, family):
+        return parse_prometheus(store.metrics.render()).get(family, {})
+
+    def test_fence_rejection_bumps_exactly_once_per_event(self):
+        store = Store(":memory:")
+        stale = store.acquire_lease("scheduler", "a", ttl=0.05)
+        time.sleep(0.1)
+        fresh = store.acquire_lease("scheduler", "b", ttl=30.0)
+        assert fresh["token"] > stale["token"]
+        uuid = store.create_run("p", spec={})["uuid"]
+        assert self._fam(store, self.FENCE)[self.FENCE] == 0
+        with pytest.raises(StaleLeaseError):
+            store.transition(uuid, "stopping",
+                             fence=("scheduler", stale["token"]))
+        assert self._fam(store, self.FENCE)[self.FENCE] == 1
+        # scraping is read-only: a second scrape reports the same value
+        assert self._fam(store, self.FENCE)[self.FENCE] == 1
+        with pytest.raises(StaleLeaseError):
+            store.transition(uuid, "stopping",
+                             fence=("scheduler", stale["token"]))
+        assert self._fam(store, self.FENCE)[self.FENCE] == 2
+
+    def test_reap_and_exhaustion_counters_exactly_once(self):
+        store = Store(":memory:")
+        spec = {"kind": "operation", "termination": {"maxRetries": 1},
+                "component": {"kind": "component", "run": {"kind": "job"}}}
+        uuid = store.create_run("p", spec=spec, name="z")["uuid"]
+        store.transition(uuid, "running", force=True)
+        reaper = ZombieReaper(store, owned=set, zombie_after=0.05,
+                              metrics=store.metrics)
+        time.sleep(0.1)
+        reaper.pass_once()  # strike one
+        reaper._last_pass = float("-inf")
+        assert reaper.pass_once() == [(uuid, "retried")]
+        reaps = self._fam(store, "polyaxon_reaper_reaps_total")
+        assert reaps['polyaxon_reaper_reaps_total{action="retried"}'] == 1
+        assert reaps['polyaxon_reaper_reaps_total{action="failed"}'] == 0
+        exh = "polyaxon_retry_exhaustions_total"
+        assert self._fam(store, exh)[exh] == 0  # budget not yet exhausted
+        # the retried run goes zombie again: budget (1) is now burned
+        store.transition(uuid, "running", force=True)
+        time.sleep(0.1)
+        reaper._last_pass = float("-inf")
+        reaper.pass_once()  # strike one
+        reaper._last_pass = float("-inf")
+        assert reaper.pass_once() == [(uuid, "failed")]
+        reaps = self._fam(store, "polyaxon_reaper_reaps_total")
+        assert reaps['polyaxon_reaper_reaps_total{action="retried"}'] == 1
+        assert reaps['polyaxon_reaper_reaps_total{action="failed"}'] == 1
+        assert self._fam(store, exh)[exh] == 1
+        # staleness gauge observed the zombie's age before the reap
+        stale = "polyaxon_heartbeat_staleness_seconds"
+        assert self._fam(store, stale)[stale] >= 0.0
+
+    def test_seeded_kill_agent_soak_scrape_matches_audit(self, tmp_path):
+        """The crash-soak's counters asserted through the SCRAPE (not
+        internals): the archived exposition must tell the same story as
+        the soak's own audit trail — no double counting, no missed
+        fencing rejections."""
+        from chaos_soak import run_kill_agent_soak
+
+        out = run_kill_agent_soak(str(tmp_path), seed=2024, n_jobs=4,
+                                  kills=1, lease_ttl=0.4, timeout=120.0)
+        assert all(v in ("succeeded", "failed", "stopped")
+                   for v in out["statuses"].values()), out["statuses"]
+        fams = parse_prometheus(out["metrics_text"])
+        fence = fams["polyaxon_store_fence_rejections_total"][
+            "polyaxon_store_fence_rejections_total"]
+        assert fence == out["fence_rejections"] >= 1
+        intents = fams["polyaxon_store_launch_intents_total"][
+            "polyaxon_store_launch_intents_total"]
+        assert intents == out["launch_intents"] >= len(out["statuses"])
+        assert out["duplicate_applies"] == []
+
+
+# -- curve / confusion event kinds (satellite, VERDICT weak #7) --------------
+
+
+class TestCurveConfusionEvents:
+    def test_kinds_registered(self):
+        assert V1EventKind.CURVE in V1EventKind.ALL
+        assert V1EventKind.CONFUSION in V1EventKind.ALL
+
+    def test_roundtrip_through_writer(self, tmp_path):
+        run = Run(run_uuid="u1", project="p", artifacts_path=str(tmp_path))
+        run.log_curve("roc", x=[0, 0.5, 1], y=[0, 0.8, 1],
+                      annotation="auc=0.93", step=3)
+        run.log_confusion("val_cm", x=["cat", "dog"], y=["cat", "dog"],
+                          z=[[5, 1], [0, 4]], step=3)
+        run._writer.flush()
+        (ev,) = read_events(str(tmp_path), "curve", "roc")
+        assert ev.kind == "curve"
+        assert ev.curve.x == [0, 0.5, 1]
+        assert ev.curve.y == [0, 0.8, 1]
+        assert ev.curve.annotation == "auc=0.93"
+        assert ev.step == 3
+        (cm,) = read_events(str(tmp_path), "confusion", "val_cm")
+        assert cm.kind == "confusion"
+        assert cm.confusion.x == ["cat", "dog"]
+        assert cm.confusion.z == [[5.0, 1.0], [0.0, 4.0]]
+        run.end()
+
+    def test_served_through_streams_api(self, tmp_path):
+        srv = ApiServer(db_path=":memory:",
+                        artifacts_root=str(tmp_path / "art"), port=0).start()
+        try:
+            rc = RunClient(srv.url, project="p1")
+            created = rc.create(spec={}, name="curvy")
+            rd = os.path.join(str(tmp_path / "art"), "p1", created["uuid"])
+            run = Run(run_uuid=created["uuid"], project="p1",
+                      artifacts_path=rd)
+            run.log_curve("pr", x=[0, 1], y=[1, 0.2], step=1)
+            run.log_confusion("cm", x=["a"], y=["a"], z=[[3]], step=1)
+            run._writer.flush()
+            curves = rc.get_events("curve")
+            assert curves["pr"][0]["curve"]["y"] == [1, 0.2]
+            cms = rc.get_events("confusion")
+            assert cms["cm"][0]["confusion"]["z"] == [[3.0]]
+            run.end()
+        finally:
+            srv.stop()
+
+
+# -- /metrics + /api/v1/stats over HTTP --------------------------------------
+
+
+class TestStatsAndMetricsEndpoints:
+    def test_stats_twin_and_auth_boundary(self, tmp_path):
+        srv = ApiServer(db_path=":memory:",
+                        artifacts_root=str(tmp_path / "a"), port=0,
+                        auth_token="sekret").start()
+        try:
+            # /metrics is deliberately scrapeable without a token
+            # (aggregate operational data, never run payloads) ...
+            resp = requests.get(srv.url + "/metrics", timeout=10)
+            assert resp.status_code == 200
+            parse_prometheus(resp.text)
+            # ... the JSON twin sits behind auth like every /api/v1 route
+            assert requests.get(srv.url + "/api/v1/stats",
+                                timeout=10).status_code in (401, 403)
+            ac = AgentClient(srv.url, auth_token="sekret")
+            data = ac.stats()
+            assert data["store"]["transactions"] >= 0
+            assert "polyaxon_store_transactions_total" in data["metrics"]
+            assert data["lease"] is None
+            srv.store.acquire_lease("scheduler", "agent-1", ttl=30.0)
+            assert ac.stats()["lease"]["holder"] == "agent-1"
+        finally:
+            srv.stop()
+
+    def test_ui_ships_timeline_tab_and_event_renderers(self):
+        from polyaxon_tpu.api import ui
+
+        assert 'data-tab="timeline"' in ui.UI_HTML
+        assert "renderTimeline" in ui.UI_HTML
+        assert "/timeline" in ui.UI_HTML
+        assert "events/curve" in ui.UI_HTML
+        assert "events/confusion" in ui.UI_HTML
+        assert "heartbeat_age_s" in ui.UI_HTML  # zombie-suspect badge
+
+
+# -- the one-pane-of-glass e2e (acceptance + CI scrape satellite) ------------
+
+
+@pytest.fixture(scope="class")
+def obs_stack(tmp_path_factory):
+    """ApiServer + LocalAgent sharing one store, with ONE completed
+    builtin-runtime run driven through the product — the orchestrated
+    local run the acceptance criteria and the CI scrape check are
+    defined against."""
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    tmp = tmp_path_factory.mktemp("obs_e2e")
+    art = str(tmp / "artifacts")
+    srv = ApiServer(db_path=":memory:", artifacts_root=art, port=0).start()
+    # the bench.py --orchestrated chain: store -> agent -> operator pod
+    # subprocess -> builtin runtime (the pod is the second process on the
+    # run's timeline)
+    agent = LocalAgent(srv.store, artifacts_root=art, api_host=srv.url,
+                       backend="cluster", poll_interval=0.05)
+    agent.start()
+    rc = RunClient(srv.url, project="obs")
+    op = check_polyaxonfile({
+        "kind": "operation",
+        "name": "tiny-train",
+        "component": {"kind": "component", "run": {
+            "kind": "tpujob", "accelerator": "v5e", "topology": "1x1",
+            "parallelism": {"data": 1},
+            "runtime": {
+                "model": "llama-tiny", "steps": 2, "batch_size": 8,
+                "seq_len": 16, "platform": "cpu", "log_interval": 1,
+                "checkpoint": {"save_interval_steps": 1,
+                               "async_save": False},
+                "resources": False,
+            }}},
+    })
+    rc.create(operation=op)
+    final = rc.wait(timeout=600.0, poll=0.5)
+    yield srv, agent, rc, final
+    agent.stop()
+    srv.stop()
+
+
+class TestOnePaneOfGlassE2E:
+    def test_run_succeeded_with_throughput_bridge_outputs(self, obs_stack):
+        _, _, _, final = obs_stack
+        assert final["status"] == "succeeded"
+        outputs = final["outputs"] or {}
+        # the ThroughputMeter summary flowed through tracking into run
+        # outputs (tentpole (c)): the dashboard and bench.py --orchestrated
+        # read these same numbers
+        for key in ("mfu", "tokens_per_sec_per_chip", "step_time_ms",
+                    "step_time_p50_ms", "step_time_p95_ms"):
+            assert key in outputs, (key, sorted(outputs))
+
+    def test_timeline_has_cross_process_spans(self, obs_stack):
+        """Acceptance: >= 6 distinct spans spanning >= 2 processes, with
+        monotonic non-overlapping lifecycle phases."""
+        _, _, rc, final = obs_stack
+        doc = rc.timeline()
+        assert doc["run_uuid"] == final["uuid"]
+        assert doc["trace_id"] == final["uuid"]
+        assert set(doc["processes"]) >= {"control-plane", "pod"}
+        names = {s["name"] for s in doc["spans"]}
+        assert len(names) >= 6, sorted(names)
+        # the pod-side training phases joined the control-plane timeline
+        assert {"restore", "first-step-compiled", "train"} <= names
+        assert "checkpoint-save" in names
+        # POLYAXON_TRACE_ID made it through env into the pod subprocess:
+        # its spans carry the run's trace id
+        pod = [s for s in doc["spans"] if s["process"] == "pod"]
+        assert pod and all(
+            s["meta"].get("trace_id") == final["uuid"] for s in pod)
+        # lifecycle phases: monotonic, contiguous, non-overlapping
+        life = [s for s in doc["spans"] if s["process"] == "control-plane"]
+        life_names = [s["name"] for s in life]
+        assert life_names[0] == "created"
+        # the lifecycle walk is on the timeline, in order ("starting" is
+        # optional: the operator may report running directly)
+        walk = [n for n in life_names
+                if n in ("created", "compiled", "queued", "scheduled",
+                         "running")]
+        assert walk == ["created", "compiled", "queued", "scheduled",
+                        "running"], life_names
+        assert life[-1]["name"] == "succeeded"
+        for a, b in zip(life, life[1:]):
+            assert b["start"] >= a["start"]
+            assert a["end"] <= b["start"] + 1e-9
+        # pod spans sit inside the run's lifecycle window
+        t0 = min(s["start"] for s in life)
+        t1 = max(s["end"] for s in life)
+        for s in pod:
+            assert t0 - 1.0 <= s["start"] <= t1 + 1.0
+
+    def test_metrics_scrape_is_valid_and_complete(self, obs_stack):
+        """CI satellite: /metrics scrapes cleanly (strict parse) and every
+        expected family is present on a server with one completed run."""
+        srv, _, _, _ = obs_stack
+        text = requests.get(srv.url + "/metrics", timeout=10).text
+        fams = parse_prometheus(text)  # raises on any malformed line
+        missing = EXPECTED_FAMILIES - set(fams)
+        assert not missing, f"missing families: {sorted(missing)}"
+        assert fams["polyaxon_store_transactions_total"][
+            "polyaxon_store_transactions_total"] > 0
+        assert fams["polyaxon_schedule_latency_seconds"][
+            "polyaxon_schedule_latency_seconds_count"] >= 1
+        assert fams["polyaxon_store_write_seconds"][
+            "polyaxon_store_write_seconds_count"] >= 1
+        # agent gauges answer "is the agent healthy" at a glance
+        assert fams["polyaxon_agent_lease_held"][
+            "polyaxon_agent_lease_held"] == 1
+
+    def test_stats_is_the_json_twin(self, obs_stack):
+        srv, agent, _, _ = obs_stack
+        data = AgentClient(srv.url).stats()
+        # the agent keeps ticking in the background, so the live counters
+        # may have advanced past the HTTP snapshot — same keys, and every
+        # monotonic counter in the snapshot is <= its live value
+        live = dict(srv.store.stats)
+        assert set(data["store"]) == set(live)
+        for key, snap in data["store"].items():
+            assert snap <= live[key], (key, snap, live[key])
+        assert data["lease"] and data["lease"]["holder"]
+        sched = data["metrics"].get("polyaxon_schedule_latency_seconds")
+        assert sched and sched["count"] >= 1
+        assert sched["p50_s"] is not None
+
+    def test_cli_timeline_and_status(self, obs_stack):
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        srv, _, rc, final = obs_stack
+        r = CliRunner().invoke(cli, [
+            "timeline", final["uuid"], "--host", srv.url, "--project", "obs"])
+        assert r.exit_code == 0, r.output
+        assert "first-step-compiled" in r.output
+        assert "succeeded" in r.output
+        r = CliRunner().invoke(cli, ["status", "--host", srv.url])
+        assert r.exit_code == 0, r.output
+        assert "scheduler lease" in r.output
+        assert "polyaxon_schedule_latency_seconds" in r.output
+
+
+# -- schedule-latency consistency (acceptance) --------------------------------
+
+
+class TestScheduleLatencyConsistency:
+    def test_metrics_histogram_p50_matches_bench(self):
+        """Acceptance: the /metrics schedule-latency histogram must tell
+        the same story as scripts/sched_bench.py on the same burst — p50
+        within ±20% (plus a small absolute epsilon for sub-100ms clocks
+        on a loaded box)."""
+        from sched_bench import run_mode
+
+        r = run_mode(n=10, mode="wake", poll_interval=0.2, max_parallel=8)
+        assert r["completed"] == 10
+        bench_p50 = r["time_to_running_p50_s"]
+        hist_p50 = r["metrics_hist_p50_s"]
+        assert hist_p50 is not None
+        tol = 0.20 * bench_p50 + 0.02
+        assert abs(hist_p50 - bench_p50) <= tol, (bench_p50, hist_p50)
+        # the bucket-interpolated estimate (what a real Prometheus query
+        # computes) stays within the same bound of the exact reservoir p50
+        bucket_p50 = r["metrics_hist_bucket_p50_s"]
+        assert abs(bucket_p50 - hist_p50) <= 0.20 * hist_p50 + 0.02
