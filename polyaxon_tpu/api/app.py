@@ -216,7 +216,9 @@ class ApiApp:
 
     async def get_stats(self, request):
         """JSON twin of /metrics: store counters, metric snapshot
-        (histograms as exact p50/p95), and the scheduler lease state."""
+        (histograms as exact p50/p95), the scheduler lease state, and the
+        sharded control plane's ownership table (ISSUE 6): every work
+        lease row plus {holder: [shards]} for the live owners."""
         reg = getattr(self.store, "metrics", None)
         lease = None
         try:
@@ -224,10 +226,19 @@ class ApiApp:
                 request.query.get("lease", "scheduler"))
         except Exception:
             pass
+        shards, owners = [], {}
+        try:
+            from .store import shard_ownership
+
+            shards, owners = shard_ownership(self.store.list_leases())
+        except Exception:
+            pass
         return _json({
             "store": dict(getattr(self.store, "stats", {}) or {}),
             "metrics": reg.snapshot() if reg is not None else {},
             "lease": lease,
+            "shards": shards,
+            "shard_owners": owners,
         })
 
     async def get_timeline(self, request):

@@ -11,6 +11,7 @@ import sqlite3
 import threading
 import time
 import uuid as uuid_mod
+import zlib
 from typing import Any, Optional
 
 from ..resilience.heartbeat import age_seconds
@@ -114,7 +115,48 @@ CREATE TABLE IF NOT EXISTS launch_intents (
     created_at TEXT NOT NULL,
     updated_at TEXT NOT NULL
 );
+-- first-writer-wins control-plane settings the whole fleet must agree
+-- on (num_shards: two agents hashing the run space with different K
+-- would BOTH own some runs under valid fences — duplicate launches the
+-- per-shard fencing cannot catch).
+CREATE TABLE IF NOT EXISTS control_config (
+    key TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
 """
+
+
+SHARD_PREFIX = "shard-"
+AGENT_PREFIX = "agent-"  # presence leases: one per live agent, self-named
+
+
+def shard_index(run_uuid: str, num_shards: int) -> int:
+    """Stable shard assignment for a run: crc32 of the uuid bytes mod K.
+
+    Stability is load-bearing — every agent (and every incarnation of an
+    agent, across processes and restarts) must map a uuid to the SAME
+    shard, because the shard name keys both the lease that authorizes
+    writes to the run and which agent's wait queue it lives in."""
+    return zlib.crc32(run_uuid.encode("utf-8")) % max(int(num_shards), 1)
+
+
+def shard_lease_names(num_shards: int) -> list[str]:
+    """The lease names of a K-shard control plane: shard-0 .. shard-K-1."""
+    return [f"{SHARD_PREFIX}{i}" for i in range(max(int(num_shards), 1))]
+
+
+def shard_ownership(rows: list[dict]) -> tuple[list[dict], dict]:
+    """Split a ``list_leases()`` result into the work-partition view
+    served by ``GET /api/v1/stats`` and ``polyaxon status``: (work lease
+    rows, ``{holder: [lease names]}`` for the live owners). Presence rows
+    (``agent-*``) are fleet membership, not work — excluded; expired rows
+    appear in the list (orphaned, awaiting adoption) but own nothing."""
+    shards = [r for r in rows if not r["name"].startswith(AGENT_PREFIX)]
+    owners: dict = {}
+    for r in shards:
+        if not r["expired"]:
+            owners.setdefault(r["holder"], []).append(r["name"])
+    return shards, owners
 
 
 class StaleLeaseError(RuntimeError):
@@ -401,12 +443,25 @@ class Store:
         """Stamp renewed_at iff (holder, token) still own the lease.
         False means a newer acquisition exists (or the lease was
         released): the caller is stale and must demote itself."""
+        return self.renew_leases([(name, token)], holder)[0]
+
+    def renew_leases(self, renewals: list[tuple], holder: str) -> list[bool]:
+        """Batch renewal: one transaction for every lease this holder
+        keeps alive (a sharded agent renews all its shard leases + its
+        presence row per heartbeat instead of K round-trips). Each entry
+        is ``(name, token)``; returns per-entry success — False means
+        that lease has a newer acquisition (or was released) and the
+        holder must demote itself FOR THAT SHARD ONLY."""
+        out: list[bool] = []
         with self._conn_ctx() as conn:
-            cur = conn.execute(
-                "UPDATE agent_leases SET renewed_at=? "
-                "WHERE name=? AND holder=? AND token=?",
-                (_now(), name, holder, token))
-        return cur.rowcount > 0
+            now = _now()
+            for name, token in renewals:
+                cur = conn.execute(
+                    "UPDATE agent_leases SET renewed_at=? "
+                    "WHERE name=? AND holder=? AND token=?",
+                    (now, name, holder, token))
+                out.append(cur.rowcount > 0)
+        return out
 
     def release_lease(self, name: str, holder: str, token: int) -> bool:
         """Explicit release on graceful shutdown — a successor acquires
@@ -427,6 +482,57 @@ class Store:
             row["expired"] = self._lease_age(row["renewed_at"]) >= row["ttl"]
         return row
 
+    def claim_config(self, key: str, value: str) -> str:
+        """First-writer-wins fleet setting: atomically record ``value``
+        for ``key`` unless some agent already did, and return the WINNING
+        value — every later claimant must conform to it. Backs the
+        num_shards agreement check (a fleet hashing the run space with
+        two different K values double-owns runs under valid fences)."""
+        with self._conn_ctx() as conn:
+            conn.execute(
+                "INSERT OR IGNORE INTO control_config (key, value) "
+                "VALUES (?, ?)", (key, str(value)))
+            row = conn.execute(
+                "SELECT value FROM control_config WHERE key=?",
+                (key,)).fetchone()
+        return row[0]
+
+    def get_config(self, key: str) -> Optional[str]:
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                "SELECT value FROM control_config WHERE key=?",
+                (key,)).fetchone()
+        return row[0] if row else None
+
+    def set_config(self, key: str, value: str) -> None:
+        """Operator override of a pinned fleet setting (e.g. resizing the
+        shard partition): stop the WHOLE fleet first — agents adopt the
+        pinned value only at start(), and a mixed fleet double-owns runs."""
+        with self._conn_ctx() as conn:
+            conn.execute(
+                "INSERT OR REPLACE INTO control_config (key, value) "
+                "VALUES (?, ?)", (key, str(value)))
+
+    def list_leases(self, prefix: Optional[str] = None) -> list[dict]:
+        """Every lease row (optionally name-prefixed: ``shard-`` for the
+        work partition, ``agent-`` for live-agent presence), each with its
+        ``expired`` flag — the input to shard fair-share balancing and the
+        per-agent ownership table in ``/api/v1/stats``."""
+        q = (f"SELECT {','.join(self._LEASE_COLS)} FROM agent_leases")
+        args: list = []
+        if prefix:
+            q += " WHERE name LIKE ?"
+            args.append(prefix.replace("%", "") + "%")
+        q += " ORDER BY name"
+        with self._conn_ctx() as conn:
+            rows = conn.execute(q, args).fetchall()
+        out = []
+        for r in rows:
+            d = dict(zip(self._LEASE_COLS, r))
+            d["expired"] = self._lease_age(d["renewed_at"]) >= d["ttl"]
+            out.append(d)
+        return out
+
     def _check_fence(self, conn, fence) -> None:
         """Reject a fenced write whose token is no longer current. Atomic
         with the write it guards: python sqlite3 only opens the implicit
@@ -446,6 +552,13 @@ class Store:
         current = row[0] if row else None
         if current != token:
             self.stats["fence_rejections"] += 1
+            # per-lease rejection family (lazy get-or-create): the sharded
+            # soak asserts that a specific SHARD's stale owner was fenced,
+            # not just that some rejection happened somewhere
+            self.metrics.counter(
+                "polyaxon_store_fence_rejections_by_lease_total",
+                "Fenced writes rejected for a stale token, by lease name",
+                labels={"lease": name}).inc()
             raise StaleLeaseError(name, token, current)
 
     # -- launch intents (write-ahead pod creation) -------------------------
@@ -1050,30 +1163,115 @@ class FencedStore:
     drivers, the zombie reaper, executor callbacks), so a takeover fences
     out every code path at once instead of each call site remembering to.
 
-    ``on_stale`` fires (once per rejection, outside any store lock) before
-    the :class:`StaleLeaseError` propagates — the agent uses it to demote
-    itself to standby."""
+    Sharded mode (ISSUE 6): ``fence_source`` may return a CALLABLE
+    ``run_uuid -> fence`` instead of a fence tuple. Each write is then
+    stamped with the token of the shard that owns THAT run, so a stale
+    shard owner is write-rejected per-shard, not per-agent:
+
+    - single-run verbs resolve the fence from their uuid argument;
+    - ``create_run(s)`` resolve it from the entries' ``pipeline_uuid`` —
+      the authority to fan out children is ownership of the PARENT
+      pipeline's shard (parentless creations are client-equivalent and
+      go unfenced);
+    - ``transition_many`` splits the batch into per-shard sub-batches
+      BEFORE the transaction: a fence rejection from a concurrent shard
+      owner rejects only that shard's sub-batch (its entries come back
+      as ``(current row, False)``) while every other sub-batch commits.
+
+    ``on_stale`` fires (once per rejection, outside any store lock). With
+    a tuple fence source it is called with no arguments and the
+    :class:`StaleLeaseError` propagates (pre-shard semantics); with a
+    callable source it receives the rejected LEASE NAME so the caller can
+    demote exactly that shard."""
 
     _FENCED = ("create_run", "create_runs", "transition", "transition_many",
                "update_run", "merge_outputs", "record_launch_intent",
                "mark_launched", "adopt_launch")
 
     def __init__(self, inner, fence_source, on_stale=None):
+        import inspect
+
         self._inner = inner
         self._fence_source = fence_source
         self._on_stale = on_stale
+        self._on_stale_takes_name = False
+        if on_stale is not None:
+            try:
+                self._on_stale_takes_name = bool(
+                    inspect.signature(on_stale).parameters)
+            except (TypeError, ValueError):
+                pass
+
+    def _notify_stale(self, lease_name: Optional[str]) -> None:
+        if self._on_stale is None:
+            return
+        if self._on_stale_takes_name:
+            self._on_stale(lease_name)
+        else:
+            self._on_stale()
+
+    def _resolve_fence(self, verb: str, src, a: tuple, kw: dict):
+        """Concrete ``(name, token)`` (or None) for one call under a
+        callable (sharded) fence source."""
+        if verb in ("create_run", "create_runs"):
+            if verb == "create_runs":
+                entries = a[1] if len(a) > 1 else kw.get("runs") or []
+            else:
+                entries = [kw]
+            puid = next((r.get("pipeline_uuid") for r in entries
+                         if r.get("pipeline_uuid")), None)
+            return src(puid) if puid else None
+        uuid = a[0] if a else kw.get("uuid") or kw.get("run_uuid")
+        return src(uuid)
+
+    def transition_many(self, transitions: list[tuple], fence=None,
+                        **kw: Any) -> list[tuple[Optional[dict], bool]]:
+        src = self._fence_source() if fence is None else fence
+        if not callable(src):
+            try:
+                return self._inner.transition_many(transitions, fence=src,
+                                                   **kw)
+            except StaleLeaseError as e:
+                self._notify_stale(e.lease_name)
+                raise
+        # sharded: one sub-batch (one lock hold + one commit) per distinct
+        # shard fence, in first-appearance order; a stale sub-batch is
+        # rejected alone and reported as unapplied
+        groups: dict = {}
+        order: list = []
+        for i, t in enumerate(transitions):
+            f = src(t[0])
+            if f not in groups:
+                groups[f] = []
+                order.append(f)
+            groups[f].append((i, t))
+        results: list = [None] * len(transitions)
+        for f in order:
+            entries = groups[f]
+            try:
+                out = self._inner.transition_many(
+                    [t for _, t in entries], fence=f, **kw)
+            except StaleLeaseError:
+                self._notify_stale(f[0] if f else None)
+                for i, t in entries:
+                    results[i] = (self._inner.get_run(t[0]), False)
+                continue
+            for (i, _), r in zip(entries, out):
+                results[i] = r
+        return results
 
     def __getattr__(self, name: str):
         attr = getattr(self._inner, name)
         if name in self._FENCED and callable(attr):
-            def _fenced(*a: Any, _attr=attr, **kw: Any) -> Any:
+            def _fenced(*a: Any, _attr=attr, _name=name, **kw: Any) -> Any:
                 if "fence" not in kw:
-                    kw["fence"] = self._fence_source()
+                    src = self._fence_source()
+                    kw["fence"] = (self._resolve_fence(_name, src, a, kw)
+                                   if callable(src) else src)
                 try:
                     return _attr(*a, **kw)
-                except StaleLeaseError:
-                    if self._on_stale is not None:
-                        self._on_stale()
+                except StaleLeaseError as e:
+                    self._notify_stale(e.lease_name)
                     raise
 
             return _fenced

@@ -497,10 +497,15 @@ def status(host, as_json):
                 "with `polyaxon server` or point --host at a deployment")
         store = Store(db)
         # counters are per-process: a fresh CLI store reads zeros — the
-        # lease row (and run table) is the durable part of local status
+        # lease rows (and run table) are the durable part of local status
+        from ..api.store import shard_ownership
+
+        shards, owners = shard_ownership(store.list_leases())
         data = {"store": dict(store.stats),
                 "metrics": store.metrics.snapshot(),
-                "lease": store.get_lease("scheduler")}
+                "lease": store.get_lease("scheduler"),
+                "shards": shards,
+                "shard_owners": owners}
     if as_json:
         click.echo(json.dumps(data, indent=2))
         return
@@ -511,6 +516,17 @@ def status(host, as_json):
                    f"token {lease.get('token')}, ttl {lease.get('ttl')}s)")
     else:
         click.echo("scheduler lease: none (no agent has acquired)")
+    # per-agent shard-ownership table (ISSUE 6): which live agent drives
+    # which slice of the run space, and which shards are orphaned
+    owners = data.get("shard_owners") or {}
+    for holder, names in sorted(owners.items()):
+        click.echo(f"agent {holder[:12]}: {len(names)} shard(s) — "
+                   + ", ".join(sorted(names)))
+    orphaned = sorted(r["name"] for r in (data.get("shards") or [])
+                      if r.get("expired"))
+    if orphaned:
+        click.echo("orphaned shards (lease expired, awaiting adoption): "
+                   + ", ".join(orphaned))
     store_stats = data.get("store") or {}
     if store_stats:
         click.echo("store: " + "  ".join(
@@ -737,9 +753,15 @@ def token_revoke(token_id, host):
 @click.option("--agent-config", default=None, type=click.Path(exists=True),
               help="agent config YAML: connections catalog runs may request "
                    "+ which connection is the artifacts store")
+@click.option("--num-shards", default=1, type=int,
+              help="shard the run space into K lease-owned partitions "
+                   "(docs/RESILIENCE.md 'Sharded control plane'): several "
+                   "server processes over ONE --data-dir each adopt their "
+                   "fair share and survive each other's crashes; 1 = the "
+                   "single-active-agent deployment")
 def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_token,
            artifacts_store, kube, kube_host, kube_namespace, kube_token, kube_ca,
-           kube_insecure, agent_config):
+           kube_insecure, agent_config, num_shards):
     """Start the API server + scheduling agent (one process)."""
     from ..api.server import ApiServer
     from ..scheduler.agent import LocalAgent
@@ -775,6 +797,7 @@ def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_tok
         api_host=srv.url, max_parallel=max_parallel, backend=backend,
         capacity_chips=capacity_chips, artifacts_store=artifacts_store,
         api_token=auth_token, cluster=cluster, connections=connections,
+        num_shards=num_shards,
     )
     agent.start()
     click.echo(f"polyaxon_tpu server on {srv.url} (agent: {max_parallel} parallel)")

@@ -170,6 +170,14 @@ class OperationReconciler:
         if state:
             self._c(self.cluster.delete_selected, state.op.label_selector)
 
+    def untrack(self, run_uuid: str) -> None:
+        """Forget an operation WITHOUT touching its pods — shard handoff
+        (ISSUE 6): a demoted shard's runs belong to the new owner, which
+        adopts the live pod set; deleting here would kill it out from
+        under the adopter."""
+        with self._lock:
+            self._ops.pop(run_uuid, None)
+
     def is_tracked(self, run_uuid: str) -> bool:
         with self._lock:
             return run_uuid in self._ops

@@ -268,6 +268,10 @@ class FaultyStore:
         # renewal must not look like a lost lease to the agent
         "acquire_lease", "renew_lease", "release_lease",
         "record_launch_intent", "mark_launched", "adopt_launch",
+        # shard-lease verbs (ISSUE 6): the batched renewal heartbeat and
+        # the fair-share listing behind shard acquisition/rebalance ride
+        # the same gate, so shard adoption itself is chaos-testable
+        "renew_leases", "list_leases",
     )
 
     def __init__(self, inner: Any, seed: int = 0, fault_rate: float = 0.2,

@@ -73,6 +73,14 @@ class ZombieReaper:
     so a stale agent's reaper — woken from a GC pause after a takeover —
     gets its reap transitions rejected instead of yanking runs the new
     agent is actively driving.
+
+    Shard scoping (ISSUE 6): with N concurrently-active agents, every
+    reaper sees every in-flight row — ``owns_run(uuid)`` restricts a pass
+    to the runs whose shard this agent holds, so N agents never race to
+    reap (or double-strike) the same run. The reap writes themselves ride
+    the agent's sharded fence, and the transition's ``changed`` result
+    guards the counters: a reap that lost a race (the run already moved)
+    is counted by nobody — reaps are exactly-once across the fleet.
     """
 
     def __init__(
@@ -82,11 +90,13 @@ class ZombieReaper:
         zombie_after: float = 120.0,
         list_runs: Optional[Callable[[str], list]] = None,
         metrics=None,
+        owns_run: Optional[Callable[[str], bool]] = None,
     ):
         import time
 
         self.store = store
         self.owned = owned
+        self.owns_run = owns_run
         self.zombie_after = zombie_after
         # observability (ISSUE 5): reap actions + the staleness the reaper
         # actually observed, exported through the shared registry
@@ -140,6 +150,8 @@ class ZombieReaper:
         for status in _REAPABLE:
             for run in self._list_runs(status):
                 uuid = run["uuid"]
+                if self.owns_run is not None and not self.owns_run(uuid):
+                    continue  # another shard's owner renews/reaps this one
                 seen.add(uuid)
                 if uuid in owned:
                     self.store.heartbeat(uuid)
@@ -161,14 +173,19 @@ class ZombieReaper:
                 self._strikes[uuid] = strikes
                 if strikes >= 2:
                     self._strikes.pop(uuid, None)
-                    actions.append((uuid, self._reap(run)))
+                    action = self._reap(run)
+                    if action is not None:
+                        actions.append((uuid, action))
         # runs that left the reapable statuses drop their strike state
         self._strikes = {u: s for u, s in self._strikes.items() if u in seen}
         self.last_max_staleness = max_stale
         self.reaped.extend(actions)
         return actions
 
-    def _reap(self, run: dict) -> str:
+    def _reap(self, run: dict) -> Optional[str]:
+        """Reap one zombie; returns the action taken, or None when the
+        reap lost a race (the run moved under us — some other writer got
+        there first) so nothing is counted twice."""
         uuid = run["uuid"]
         retries_done = sum(
             1 for c in self.store.get_statuses(uuid)
@@ -178,17 +195,21 @@ class ZombieReaper:
             # the same path a slice restart takes: retrying -> queued, the
             # scheduler re-runs it (builtin runtimes resume from their
             # latest checkpoint because the artifacts dir is unchanged)
-            self.store.transition(
+            _, changed = self.store.transition(
                 uuid, V1Statuses.RETRYING.value, reason="ZombieReaped",
                 message=f"no heartbeat for {self.zombie_after:.0f}s; "
                         f"attempt {retries_done + 2}/{budget + 1}")
+            if not changed:
+                return None
             self.store.transition(uuid, V1Statuses.QUEUED.value)
             self._c_reaps["retried"].inc()
             return "retried"
-        self.store.transition(
+        _, changed = self.store.transition(
             uuid, V1Statuses.FAILED.value, force=True, reason="ZombieReaped",
             message=f"stuck in {run['status']} with no heartbeat for "
                     f"{self.zombie_after:.0f}s and no retry budget left")
+        if not changed:
+            return None
         self._c_reaps["failed"].inc()
         if budget > 0:
             self._c_exhausted.inc()

@@ -10,6 +10,7 @@ Runs with a ``matrix`` section become pipelines: the agent spawns a tuner
 from __future__ import annotations
 
 import collections
+import math
 import os
 import threading
 import time
@@ -17,7 +18,10 @@ import traceback
 from typing import Optional
 
 from ..api.app import run_artifacts_dir
-from ..api.store import FencedStore, StaleLeaseError, Store
+from ..api.store import (
+    AGENT_PREFIX, FencedStore, StaleLeaseError, Store, shard_index,
+    shard_lease_names,
+)
 from ..compiler.resolver import resolve
 from ..resilience.heartbeat import _max_retries
 from ..runtime.local import LocalExecution, LocalExecutor
@@ -124,34 +128,62 @@ class LocalAgent:
         use_change_feed: bool = True,
         lease_ttl: float = 15.0,
         lease_name: str = "scheduler",
+        num_shards: int = 1,
     ):
         import uuid as uuid_mod
 
         from ..resilience.heartbeat import ZombieReaper
         from ..resilience.retry import DEFAULT_HTTP_RETRY
 
-        # Agent crash-safety (ISSUE 4, docs/RESILIENCE.md "Control-plane
-        # crash matrix"): the agent holds a TTL lease in the store with a
-        # monotonic fencing token; ``self.store`` is a write-fencing proxy
-        # that stamps the CURRENT token onto every lifecycle write this
-        # agent (and everything writing on its behalf: pipeline drivers,
-        # the reaper, executor callbacks) issues. A stale incarnation —
-        # double-start, GC pause past the TTL, supervisor restart racing
-        # the old process — can observe but not mutate. ``lease_ttl<=0``
-        # disables leasing (all writes unfenced, single-agent semantics).
+        # Agent crash-safety (ISSUE 4) generalized to work PARTITIONING
+        # (ISSUE 6, docs/RESILIENCE.md "Sharded control plane"): the run
+        # space is split by stable hash of run uuid into ``num_shards``
+        # shards, each an independent TTL lease with a monotonic fencing
+        # token (``shard-<i>`` rows in ``agent_leases``). An agent holds
+        # as many shard leases as its fair share allows; ``self.store``
+        # is a write-fencing proxy that stamps every lifecycle write with
+        # the token of the shard OWNING that run, so a stale shard owner
+        # — double-start, GC pause past the TTL, supervisor restart
+        # racing the old process — is write-rejected per-shard, not
+        # per-agent. ``num_shards=1`` keeps the single lease named
+        # ``lease_name`` (the pre-shard one-active-agent-with-hot-spares
+        # deployment, byte-compatible with ISSUE 4); ``lease_ttl<=0``
+        # disables leasing entirely (all writes unfenced, single-agent
+        # semantics).
         self.lease_ttl = lease_ttl
         self.lease_name = lease_name
-        self.lease: Optional[dict] = None
+        self.num_shards = max(int(num_shards), 1)
+        self.shards: list[str] = (shard_lease_names(self.num_shards)
+                                  if self.num_shards > 1 else [lease_name])
+        self._shard_set = set(self.shards)
         self._lease_id = uuid_mod.uuid4().hex
-        self._lease_renewed = 0.0
+        self._shard_leases: dict[str, dict] = {}   # shard -> live lease row
+        self._shard_renewed: dict[str, float] = {}
+        # per-shard demotion poison (rejected renewal / fenced-out write):
+        # a demoted shard's SURVIVING threads must stay fenced too —
+        # dropping the lease alone would make their writes unfenced, the
+        # opposite of the guarantee. Cleared only by re-acquiring THAT
+        # shard.
+        self._shard_poison: set[str] = set()
+        # shards demoted from a non-loop thread, awaiting their loop-side
+        # bookkeeping (queue/chip/tracked-state drop) — see _demote_shard
+        self._demoted_dirty: set[str] = set()
         self._dead = False  # set by hard_kill(): poisons every fenced write
-        # set on demotion (rejected renewal / fenced-out write): a demoted
-        # agent's SURVIVING threads must stay fenced too — lease=None alone
-        # would make their writes unfenced, which is the opposite of the
-        # guarantee. Cleared only by a successful re-acquisition.
-        self._fenced_out = False
+        # live-agent presence lease (self-named, nobody competes): lets
+        # every agent count the live fleet and compute its fair share of
+        # shards without a separate membership table
+        self._presence_name = AGENT_PREFIX + self._lease_id
+        self._presence: Optional[dict] = None
+        self._presence_renewed = float("-inf")
+        self._probe_at = 0.0  # next shard acquisition/rebalance probe
+        self._dead_presence: list = []  # expired agent-* rows, GC'd by probe
+        self._last_pass_at = time.monotonic()  # loop liveness stamp
+        # False until start() begins the lease machinery: direct-call
+        # usage (tests/embedders driving tick() without start()) sees the
+        # whole shard space; a STARTED agent owns exactly what it holds
+        self._leasing = False
         self._suspended = threading.Event()  # chaos hook: GC-pause stand-in
-        self.store = FencedStore(store, self._current_fence,
+        self.store = FencedStore(store, lambda: self._fence_for,
                                  on_stale=self._on_stale_lease)
         # Observability (ISSUE 5): the agent's series live in the STORE's
         # registry — the store is what the API server and soak harnesses
@@ -172,8 +204,9 @@ class LocalAgent:
             "Runs failed with their termination.maxRetries budget exhausted")
         self.metrics.gauge(
             "polyaxon_agent_queue_depth",
-            "Runs waiting in the capacity FIFO",
-            value_fn=lambda: len(self._pending))
+            "Runs waiting in the capacity FIFO (all shards)",
+            value_fn=lambda: sum(len(q)
+                                 for q in self._shard_pending.values()))
         self.metrics.gauge(
             "polyaxon_agent_chips_in_use",
             "TPU chips reserved by scheduled runs",
@@ -196,9 +229,9 @@ class LocalAgent:
                                  if self.reconciler is not None else 0)))
         self.metrics.gauge(
             "polyaxon_agent_lease_held",
-            "1 when this agent may mutate (lease held or leasing off)",
+            "1 when this agent may mutate (any shard held or leasing off)",
             value_fn=lambda: 1.0 if (self.lease_ttl <= 0
-                                     or self.lease is not None) else 0.0)
+                                     or self._shard_leases) else 0.0)
         # pass counters cached like every other series: the quiet-wake
         # fast path must not pay a registry lock + label-key build per tick
         self._c_passes = {
@@ -207,6 +240,16 @@ class LocalAgent:
                 labels={"kind": kind})
             for kind in ("idle", "full", "dirty")
         }
+        # per-shard families (ISSUE 6 obs satellite): the shard label keys
+        # lease state, queue depth, reserved chips and pass activity per
+        # work partition. Lease-held reads STORE truth (any agent's scrape
+        # shows the whole partition, including shards it doesn't own);
+        # queue/chips gauges are re-bound to the owning agent's in-memory
+        # state on every acquisition (get-or-create registry semantics).
+        self._store_ref = store
+        self._lease_rows_cache: Optional[tuple] = None
+        self._register_shard_lease_gauges()
+        self._c_shard_passes: dict = {}
         self._wake_armed_at: Optional[float] = None
         # transient-failure policy for the sidecar's log/artifact sync
         self.retry = retry if retry is not None else DEFAULT_HTTP_RETRY
@@ -216,9 +259,12 @@ class LocalAgent:
         # through the retrying/backoff machinery. <=0 disables. The reaper
         # writes through the fenced proxy: a stale agent's reaper cannot
         # reap runs the NEW agent is actively driving.
+        # shard-scoped (ISSUE 6): the reaper renews/reaps only runs whose
+        # shard this agent holds, and writes through the sharded fence —
+        # N agents never double-reap one run
         self.reaper = ZombieReaper(
             self.store, owned=self._driven_uuids, zombie_after=zombie_after,
-            metrics=self.metrics)
+            metrics=self.metrics, owns_run=self._owns_run)
         self.artifacts_root = os.path.abspath(artifacts_root)
         self.api_host = api_host
         self.api_token = api_token
@@ -269,17 +315,24 @@ class LocalAgent:
         self._stop = threading.Event()
         self._wake = threading.Event()  # set by the watch thread
         self._thread: Optional[threading.Thread] = None
+        self._presence_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
-        # capacity wait queue (loop-thread only): queued runs FIFO with
-        # their chip demand cached at enqueue, so a scheduling pass never
-        # rescans the store's queued list. ``_block_watermark`` is the
-        # smallest demand among runs the last walk left blocked — while
-        # free capacity stays below it (and nothing new arrived) a pass
-        # skips the walk entirely: O(dirty) work under a saturated burst.
-        self._pending: "collections.deque[tuple[str, int]]" = collections.deque()
+        # capacity wait queues (loop-thread only), ONE PER SHARD: queued
+        # runs FIFO with their chip demand cached at enqueue, so a
+        # scheduling pass never rescans the store's queued list. Each
+        # shard keeps its own blocked-demand watermark — while that
+        # shard's sub-budget stays below it (and nothing new arrived for
+        # it) the walk skips that shard entirely: still O(dirty) work
+        # under a saturated burst, per shard. With num_shards=1 these
+        # collapse to the r7 single-queue behavior exactly (the legacy
+        # ``_pending``/``_block_watermark``/``_pending_fresh`` attributes
+        # remain readable as views of shard 0).
+        self._shard_pending: dict[str, "collections.deque[tuple[str, int]]"]
+        self._shard_pending = {s: collections.deque() for s in self.shards}
         self._pending_set: set = set()
-        self._block_watermark: Optional[int] = None
-        self._pending_fresh = False
+        self._shard_watermark: dict[str, Optional[int]] = {
+            s: None for s in self.shards}
+        self._shard_fresh: dict[str, bool] = {s: False for s in self.shards}
         self._need_full = False
         # runs whose pod listing failed during resync: classification
         # deferred to the next full pass (never misread as slice loss)
@@ -311,103 +364,544 @@ class LocalAgent:
             self.resync_interval = 0.0  # every poll wake runs a full tick()
             store.add_transition_listener(self._on_hook_event)
 
-    # -- lease lifecycle ---------------------------------------------------
+    # -- shard lease lifecycle ---------------------------------------------
 
-    def _current_fence(self) -> Optional[tuple]:
-        """Fence for the NEXT store write. None = unfenced (leasing off,
-        or direct-call test usage without start()). A hard-killed OR
-        demoted agent returns a poison fence so every late write from its
-        surviving threads (executor callbacks, pipeline drivers, sidecar
-        output merges) is rejected — demotion must not downgrade those
-        writes to UNFENCED, it must keep them out."""
-        if self._dead or self._fenced_out:
-            return ("__dead__", -1)
-        lease = self.lease
+    @property
+    def lease(self) -> Optional[dict]:
+        """Legacy single-lease view: the lease row of shard 0 (the ONLY
+        shard when ``num_shards=1``), or None while it isn't held. The
+        sharded truth lives in ``_shard_leases``."""
+        return self._shard_leases.get(self.shards[0])
+
+    @property
+    def _fenced_out(self) -> bool:
+        return self.shards[0] in self._shard_poison
+
+    # legacy single-queue views of shard 0 (tests and embedders read them;
+    # with num_shards=1 they ARE the whole state)
+    @property
+    def _pending(self) -> "collections.deque":
+        return self._shard_pending[self.shards[0]]
+
+    @property
+    def _block_watermark(self) -> Optional[int]:
+        return self._shard_watermark[self.shards[0]]
+
+    @property
+    def _pending_fresh(self) -> bool:
+        return self._shard_fresh[self.shards[0]]
+
+    def _shard_name(self, run_uuid: str) -> str:
+        """The shard (= lease name) owning a run: stable uuid hash."""
+        return self.shards[shard_index(run_uuid, len(self.shards))]
+
+    def _owned_shards(self) -> list[str]:
+        """Shards this agent may drive. Leasing off => every shard. An
+        agent whose lease machinery never started (``_leasing`` False)
+        likewise sees every shard — that is the legacy direct-call mode
+        (tests and embedders drive ``tick()`` / ``cold_start_resync()``
+        without ``start()``). An agent that IS leasing but holds nothing
+        owns NOTHING — losing the last shard mid-pass must make the rest
+        of the pass a no-op, never flip it to unfenced own-everything."""
+        if self.lease_ttl <= 0 or not self._leasing:
+            return list(self.shards)
+        return [s for s in self.shards if s in self._shard_leases]
+
+    def _owns_run(self, run_uuid: str) -> bool:
+        if self.lease_ttl <= 0 or not self._leasing:
+            return True
+        return self._shard_name(run_uuid) in self._shard_leases
+
+    def _fence_for_shard(self, shard: str) -> Optional[tuple]:
+        """Fence for the next write to a run of ``shard``. None =
+        unfenced (leasing off, direct-call test usage, or a shard this
+        agent never owned — e.g. a pipeline driver's client-equivalent
+        stop request on a child scheduled by another agent). A
+        hard-killed agent — or one demoted from THIS shard — returns a
+        poison fence so every late write from its surviving threads
+        (executor callbacks, pipeline drivers, sidecar output merges) is
+        rejected: demotion must not downgrade those writes to UNFENCED,
+        it must keep them out. The poison fence carries the REAL shard
+        name with an impossible token (tokens start at 1, -1 is never
+        current), so its rejection routes back to the already-demoted
+        shard — an idempotent re-demotion, never a demotion of some
+        healthy shard the name failed to resolve to."""
+        if self._dead:
+            return (shard, -1)
+        if self.lease_ttl <= 0:
+            return None
+        if shard in self._shard_poison:
+            return (shard, -1)
+        lease = self._shard_leases.get(shard)
         if lease is None:
             return None
-        return (self.lease_name, lease["token"])
+        return (shard, lease["token"])
 
-    def _on_stale_lease(self) -> None:
-        """A fenced write was rejected (or renewal found a newer token):
-        demote to standby immediately — the loop keeps polling for
-        re-acquisition (it becomes the successor if the new holder dies),
-        and until then every write this incarnation attempts stays
-        fenced off via the poison fence."""
-        self._fenced_out = True
-        if self.lease is not None:
-            self.lease = None
-            print(f"[agent {self._lease_id[:8]}] lease fenced out — "
-                  "demoting to standby", flush=True)
+    def _fence_for(self, run_uuid: Optional[str]) -> Optional[tuple]:
+        """uuid -> fence, the callable the FencedStore proxy resolves
+        every write through (per-run = per-shard fencing)."""
+        if run_uuid is None:
+            return self._fence_for_shard(self.shards[0])
+        return self._fence_for_shard(self._shard_name(run_uuid))
+
+    def _current_fence(self) -> Optional[tuple]:
+        """Legacy single-lease fence (shard 0) — what ``num_shards=1``
+        writes carry."""
+        return self._fence_for_shard(self.shards[0])
+
+    def _intent_identity(self, run_uuid: str) -> tuple[Optional[int], str]:
+        """(token, lease_name) recorded into a launch intent / adoption:
+        the identity of the SHARD that authorizes this run's launch, so a
+        successor adopting that shard can tell whose intent it reads.
+        Token None = leasing off / direct-call mode (the shard name still
+        identifies the partition)."""
+        shard = self._shard_name(run_uuid)
+        lease = self._shard_leases.get(shard)
+        return (lease["token"] if lease else None), shard
+
+    def _on_stale_lease(self, name: Optional[str] = None) -> None:
+        """A fenced write was rejected (or a renewal found a newer
+        token): demote THAT shard immediately — the loop keeps probing
+        for re-acquisition (this agent becomes the successor if the new
+        holder dies), and until then every write this incarnation
+        attempts for that shard stays fenced off via the poison fence.
+        Called with no name (legacy single-lease paths) it demotes
+        shard 0."""
+        if name is None or name not in self._shard_set:
+            name = self.shards[0]
+        self._demote_shard(name)
+
+    def _demote_shard(self, shard: str) -> None:
+        """Demote one shard. Callable from ANY thread (the FencedStore's
+        on_stale fires on whichever thread's write was rejected —
+        executor callbacks, pipeline drivers, sidecars — possibly while
+        that thread already holds ``self._lock``): the SAFETY property
+        (poison the fence so every further write for this shard is
+        rejected) lands immediately and lock-free; the in-memory
+        bookkeeping (queues, chip reservations, tracked set — loop-thread
+        state) is deferred to the loop thread via ``_demoted_dirty``,
+        which drains it at the top of the next pass. Dropping state late
+        costs at worst a few fenced-off (rejected) writes; dropping it
+        from a foreign thread would race ``_walk_shard`` or self-deadlock
+        on the non-reentrant lock."""
+        had = self._shard_leases.pop(shard, None) is not None
+        self._shard_renewed.pop(shard, None)
+        self._shard_poison.add(shard)
+        self._demoted_dirty.add(shard)
+        if had:
+            print(f"[agent {self._lease_id[:8]}] shard {shard!r} fenced "
+                  "out — demoting it to standby", flush=True)
+
+    def _drain_demotions(self) -> None:
+        """Loop thread only: finish the bookkeeping half of any demotions
+        signalled since the last pass."""
+        while self._demoted_dirty:
+            try:
+                shard = self._demoted_dirty.pop()
+            except KeyError:
+                break
+            self._drop_shard_state(shard, untrack=True)
+
+    def _clear_shard_queue(self, shard: str) -> None:
+        """Reset one shard's wait-queue state (the shared step of a
+        rebuild, a demotion, and a voluntary release)."""
+        for uuid, _ in self._shard_pending[shard]:
+            self._pending_set.discard(uuid)
+        self._shard_pending[shard].clear()
+        self._shard_watermark[shard] = None
+
+    def _drop_shard_state(self, shard: str, untrack: bool = False) -> None:
+        """Forget one shard's in-memory state (demotion or voluntary
+        release): its wait queue, watermark, chip reservations, parked
+        resync classifications — and with ``untrack`` (demotion) stop
+        observing its runs: the new owner adopts the live pods; our
+        reconciler/sidecars must not keep reporting on them (every such
+        write would only bounce off the fence anyway)."""
+        self._clear_shard_queue(shard)
+        self._shard_fresh[shard] = False
+        # a parked classification belongs to the shard's owner: classifying
+        # a handed-off run here would race (or force-fail) the run under
+        # its NEW owner — the acquirer's scoped resync re-parks it if the
+        # listing still fails
+        self._resync_retry -= {u for u in self._resync_retry
+                               if self._shard_name(u) == shard}
+        if not untrack:
+            return
+        lost = [u for u in list(self._chips_in_use)
+                if self._shard_name(u) == shard]
+        with self._lock:
+            for u in lost:
+                self._chips_in_use.pop(u, None)
+                self._active.pop(u, None)
+            for u in [u for u in self._sidecars
+                      if self._shard_name(u) == shard]:
+                self._sidecars.pop(u).stop_evt.set()
+        if self.reconciler is not None:
+            for u in self.reconciler.tracked_uuids():
+                if self._shard_name(u) == shard:
+                    self.reconciler.untrack(u)
+
+    def _on_shard_acquired(self, shard: str, lease: dict) -> None:
+        self._shard_leases[shard] = lease
+        self._shard_renewed[shard] = time.monotonic()
+        # a fresh acquisition of THIS shard lifts its demotion poison:
+        # this incarnation is the legitimate holder again (hard_kill's
+        # _dead never lifts); an undrained demotion flag from the PREVIOUS
+        # ownership must not fire late and drop the state the acquisition
+        # resync is about to rebuild
+        self._shard_poison.discard(shard)
+        self._demoted_dirty.discard(shard)
+        self._bind_shard_gauges(shard)
 
     def _try_acquire_lease(self) -> bool:
+        """Legacy single-shard acquisition (shard 0); the sharded loop
+        acquires through ``_probe_shards``."""
+        s = self.shards[0]
         try:
             lease = self.store.acquire_lease(
-                self.lease_name, self._lease_id, ttl=self.lease_ttl)
+                s, self._lease_id, ttl=self.lease_ttl)
         except Exception:
             return False  # store weather: stay standby, retry next wake
         if lease is None:
             return False
-        self.lease = lease
-        # a fresh acquisition lifts the demotion poison: this incarnation
-        # is the legitimate holder again (hard_kill's _dead never lifts)
-        self._fenced_out = False
-        self._lease_renewed = time.monotonic()
+        self._on_shard_acquired(s, lease)
         return True
+
+    def _presence_loop(self) -> None:
+        """Presence renewals OFF the loop thread: peers gate shard
+        adoption on the presence row (``_fair_share``), so it must stay
+        fresh even while a scheduling pass outlasts the TTL under a
+        burst — exactly when the loop-thread renewal would be late. The
+        thread touches ONLY the presence lease (a liveness hint, never a
+        mutation gate), so it is takeover-safe by construction;
+        ``suspend()`` (the GC-pause chaos hook) freezes it like it
+        freezes the real loop, and ``hard_kill()`` stops it dead."""
+        beat = self.lease_ttl / 3.0
+        while not self._stop.wait(timeout=beat):
+            if self._dead:
+                return
+            if self._suspended.is_set():
+                continue
+            now = time.monotonic()
+            if now - self._last_pass_at > 2.0 * self.lease_ttl:
+                # the loop thread has made no pass in 2x TTL: it is
+                # wedged (hung cluster call, deadlock), not just busy —
+                # stop vouching for it, or the fleet could never adopt
+                # this agent's expired shards (presence gates adoption)
+                continue
+            self._renew_presence(now)
+
+    def _renew_presence(self, now: float) -> None:
+        """Keep this agent's presence lease alive (self-named: nobody
+        competes, acquisition always succeeds) so the fleet can count
+        live agents for fair-share balancing. Best-effort: presence is a
+        balance hint, never a mutation gate."""
+        try:
+            if self._presence is None or not self.store.renew_lease(
+                    self._presence_name, self._lease_id,
+                    self._presence["token"]):
+                self._presence = self.store.acquire_lease(
+                    self._presence_name, self._lease_id, ttl=self.lease_ttl)
+        except Exception:
+            pass
+        self._presence_renewed = now
+
+    def _fair_share(self) -> tuple[int, list[str]]:
+        """(fair share of shards for this agent, shards currently free).
+        One lease-table scan: live holders = distinct holders of live
+        shard leases + live presence rows (+ self); free = shards whose
+        lease is missing, or expired with a DEAD holder. An expired shard
+        lease whose holder's presence row is still live is a busy peer
+        mid-pass (a long scheduling pass can outlast the TTL under a
+        burst), not a dead one — stealing it would fence that agent out
+        of runs it is actively driving. Presence is renewed off the loop
+        thread precisely so it stays fresh through long passes; a truly
+        dead agent loses both leases within one TTL, so the adoption
+        bound is unchanged. ceil(K / holders) guarantees the fleet's
+        shares sum to >= K, so every shard finds an owner."""
+        rows = self.store.list_leases()
+        holders = {self._lease_id}
+        live_presence = {
+            row["holder"] for row in rows
+            if row["name"].startswith(AGENT_PREFIX) and not row["expired"]}
+        # expired presence rows are dead incarnations (crashes/hard kills
+        # never DELETE their self-named row): collect them for the
+        # probe's opportunistic GC, or agent_leases grows by one row per
+        # crashed incarnation forever and every scan pays for it
+        self._dead_presence = [
+            (row["name"], row["holder"], row["token"]) for row in rows
+            if row["name"].startswith(AGENT_PREFIX) and row["expired"]]
+        free = set(self.shards)
+        for row in rows:
+            live = not row["expired"]
+            if row["name"] in self._shard_set:
+                if live:
+                    holders.add(row["holder"])
+                    free.discard(row["name"])
+                elif (row["holder"] in live_presence
+                      and row["holder"] != self._lease_id):
+                    free.discard(row["name"])  # busy peer, not a corpse
+            elif live and row["name"].startswith(AGENT_PREFIX):
+                holders.add(row["holder"])
+        fair = math.ceil(len(self.shards) / max(len(holders), 1))
+        return fair, [s for s in self.shards if s in free]
+
+    def _probe_shards(self) -> list[str]:
+        """One acquisition/rebalance probe: grab free (unheld or expired)
+        shards up to this agent's fair share — a dead agent's shards are
+        adopted by survivors within one probe interval of their TTL
+        expiring — and, when the fleet GREW (fair share shrank), release
+        idle excess shards for the newcomers. Returns newly-acquired
+        shards (the caller resyncs them before scheduling anything)."""
+        try:
+            fair, free = self._fair_share()
+        except Exception:
+            return []  # store weather: probe again next cycle
+        # best-effort GC of dead incarnations' presence rows (capped per
+        # probe; release_lease only deletes on an exact (holder, token)
+        # match, so racing a just-resumed owner's renewal is harmless —
+        # and deleting an EXPIRED row never changes adoption decisions,
+        # which already ignore expired presence)
+        for name, holder, token in self._dead_presence[:8]:
+            try:
+                self.store.release_lease(name, holder, token)
+            except Exception:
+                break
+        if len(self._shard_leases) > fair:
+            self._release_excess(fair)
+            return []
+        acquired: list[str] = []
+        for s in free:
+            if len(self._shard_leases) >= fair:
+                break
+            if s in self._shard_leases:
+                continue
+            try:
+                lease = self.store.acquire_lease(
+                    s, self._lease_id, ttl=self.lease_ttl)
+            except Exception:
+                continue
+            if lease is not None:  # None: another prober won the race
+                self._on_shard_acquired(s, lease)
+                acquired.append(s)
+        if acquired:
+            print(f"[agent {self._lease_id[:8]}] acquired shards "
+                  f"{acquired} (fair share {fair})", flush=True)
+        return acquired
+
+    def _release_excess(self, fair: int) -> None:
+        """Voluntary rebalance: hand shards beyond our fair share to the
+        (grown) fleet. Only shards with NO in-flight runs in this agent
+        are eligible — their queue state is store-backed and the
+        acquirer's scoped resync rebuilds it, so the handoff is free;
+        busy shards wait for their runs to drain and go next cycle.
+
+        Busy = MEMBERSHIP in the driving maps, not thread liveness (what
+        ``_driven_uuids`` checks): a just-finished executor's thread is
+        already dead while its terminal-status callback is still in
+        flight — releasing that shard would let the acquirer's resync
+        read the run as a driverless orphan and fail it, and the
+        callback's fenced write would bounce off the new owner's token."""
+        with self._lock:
+            busy = (set(self._active) | set(self._chips_in_use)
+                    | set(self._tuners) | set(self._sidecars))
+        if self.reconciler is not None:
+            busy |= self.reconciler.tracked_uuids()
+        busy_shards = {self._shard_name(u) for u in busy}
+        excess = len(self._shard_leases) - fair
+        for s in reversed([s for s in self.shards
+                           if s in self._shard_leases]):
+            if excess <= 0:
+                return
+            if s in busy_shards:
+                continue
+            lease = self._shard_leases.pop(s)
+            self._shard_renewed.pop(s, None)
+            self._drop_shard_state(s)
+            try:
+                self.store.release_lease(s, self._lease_id, lease["token"])
+            except Exception:
+                traceback.print_exc()
+            excess -= 1
+            print(f"[agent {self._lease_id[:8]}] released shard {s!r} "
+                  f"(rebalance to fair share {fair})", flush=True)
 
     def _lease_tick(self) -> bool:
-        """Hold-or-acquire, called at the top of every loop pass. Returns
-        True when this agent may mutate (lease held or leasing disabled).
-        Standby agents return False and touch nothing. Renewal failures
-        split two ways: a REJECTED renewal (newer token exists) demotes
-        instantly; a store fault (SQLITE_BUSY burst) keeps the lease and
-        retries next pass — the TTL is sized so transient weather never
-        costs the lease (renew every ttl/3)."""
+        """Hold-or-acquire over the whole shard set, called at the top of
+        every loop pass. Returns True when this agent may mutate (>= 1
+        shard held, or leasing disabled). Standby agents return False and
+        touch nothing. Renewal failures split two ways: a REJECTED
+        renewal (newer token exists) demotes that shard instantly; a
+        store fault (SQLITE_BUSY burst) keeps the lease and retries next
+        pass — the TTL is sized so transient weather never costs a shard
+        (renew every ttl/3). Acquisition probes run on the same ttl/3
+        cadence, so an orphaned shard is re-owned within
+        TTL + ttl/3 + one loop wake < 2x TTL."""
         if self.lease_ttl <= 0:
             return True
-        if self.lease is None:
-            if not self._try_acquire_lease():
-                return False
-            # fresh acquisition: this process's view of the world is stale
-            # by construction — rebuild it before scheduling anything
-            self.cold_start_resync()
-            return True
+        self._drain_demotions()  # bookkeeping for off-thread demotions
         now = time.monotonic()
-        if now - self._lease_renewed >= self.lease_ttl / 3.0:
+        beat = self.lease_ttl / 3.0
+        if now - self._presence_renewed >= beat:
+            self._renew_presence(now)
+        # snapshot: _demote_shard pops this dict from whichever thread's
+        # write was rejected — iterating the live dict would
+        # intermittently die mid-pass with 'changed size during iteration'
+        due = [(s, lease) for s, lease in list(self._shard_leases.items())
+               if now - self._shard_renewed.get(s, 0.0) >= beat]
+        if due:
             try:
-                ok = self.store.renew_lease(
-                    self.lease_name, self._lease_id, self.lease["token"])
+                oks = self.store.renew_leases(
+                    [(s, lease["token"]) for s, lease in due],
+                    self._lease_id)
             except Exception:
-                return True  # transient fault: keep going, retry next pass
-            if ok:
-                self._lease_renewed = now
-            else:
-                self._on_stale_lease()
-                return False
-        return True
+                oks = None  # transient fault: keep going, retry next pass
+            if oks is not None:
+                for (s, _), ok in zip(due, oks):
+                    if ok:
+                        self._shard_renewed[s] = now
+                    else:
+                        self._demote_shard(s)
+            self._drain_demotions()
+        if now >= self._probe_at:
+            self._probe_at = now + beat
+            acquired = self._probe_shards()
+            if acquired:
+                # fresh acquisitions: this process's view of those shards
+                # is stale by construction — rebuild them before
+                # scheduling anything, in ONE scoped scan + pod listing
+                # (adopting a dead peer's shards usually lands several at
+                # once; per-shard resyncs would repeat the full-store
+                # page walk N times)
+                self.cold_start_resync(acquired)
+        return bool(self._shard_leases)
 
     def release_lease(self) -> None:
-        """Explicit release (graceful SIGTERM drain): the successor
-        acquires instantly instead of waiting out the TTL."""
-        lease, self.lease = self.lease, None
-        if lease is None:
-            return
-        try:
-            self.store.release_lease(
-                self.lease_name, self._lease_id, lease["token"])
-        except Exception:
-            traceback.print_exc()
+        """Explicit release of every held lease (graceful SIGTERM drain):
+        successors acquire instantly instead of waiting out the TTLs."""
+        for s in list(self._shard_leases):
+            lease = self._shard_leases.pop(s)
+            self._shard_renewed.pop(s, None)
+            try:
+                self.store.release_lease(s, self._lease_id, lease["token"])
+            except Exception:
+                traceback.print_exc()
+        presence, self._presence = self._presence, None
+        if presence is not None:
+            try:
+                self.store.release_lease(
+                    self._presence_name, self._lease_id, presence["token"])
+            except Exception:
+                pass
+
+    def _register_shard_lease_gauges(self) -> None:
+        for s in self.shards:
+            self.metrics.gauge(
+                "polyaxon_agent_shard_lease_held",
+                "1 when the shard's lease is held by a live agent",
+                labels={"shard": s},
+                value_fn=self._shard_lease_held_fn(s))
+
+    def _adopt_shard_layout(self, num_shards: int) -> None:
+        """Conform to the fleet's agreed shard count (first-writer-wins
+        ``control_config['num_shards']``). Two agents hashing the run
+        space with different K would BOTH own some runs under valid
+        fences — a duplicate launch the per-shard fencing cannot catch —
+        so a mismatched starter adopts the store's K before probing."""
+        self.num_shards = max(int(num_shards), 1)
+        self.shards = (shard_lease_names(self.num_shards)
+                       if self.num_shards > 1 else [self.lease_name])
+        self._shard_set = set(self.shards)
+        self._shard_pending = {s: collections.deque() for s in self.shards}
+        self._pending_set = set()
+        self._shard_watermark = {s: None for s in self.shards}
+        self._shard_fresh = {s: False for s in self.shards}
+        self._register_shard_lease_gauges()
+
+    def _shard_lease_rows(self) -> dict:
+        """{lease name: row} for every work lease, cached for ~1 s: a
+        /metrics scrape evaluates one lease-held value_fn per shard, and
+        K per-series get_lease round-trips per scrape would compete with
+        the agent's own write transactions on the store. Staleness of a
+        second on a liveness gauge is free; a racing duplicate refresh
+        is benign (same store truth)."""
+        now = time.monotonic()
+        cached = self._lease_rows_cache
+        if cached is None or now - cached[0] > 1.0:
+            rows = {r["name"]: r for r in self._store_ref.list_leases()}
+            cached = (now, rows)
+            self._lease_rows_cache = cached
+        return cached[1]
+
+    def _shard_lease_held_fn(self, shard: str):
+        def _held() -> float:
+            if self.lease_ttl <= 0:
+                return 1.0
+            row = self._shard_lease_rows().get(shard)
+            return 1.0 if (row is not None and not row["expired"]) else 0.0
+        return _held
+
+    def _bind_shard_gauges(self, shard: Optional[str] = None) -> None:
+        """(Re-)bind the per-shard queue/chips gauges to THIS agent's
+        in-memory state — on acquisition the new owner re-binds them so
+        the scrape follows ownership (registry get-or-create keeps the
+        series continuous across takeovers)."""
+        for s in (self.shards if shard is None else [shard]):
+            self.metrics.gauge(
+                "polyaxon_agent_shard_queue_depth",
+                "Runs waiting in the shard's capacity FIFO",
+                labels={"shard": s},
+                value_fn=lambda s=s: float(
+                    len(self._shard_pending.get(s, ()))))
+            self.metrics.gauge(
+                "polyaxon_agent_shard_chips_in_use",
+                "Chips reserved by the shard's scheduled runs",
+                labels={"shard": s},
+                value_fn=lambda s=s: float(sum(
+                    d for u, d in list(self._chips_in_use.items())
+                    if self._shard_name(u) == s)))
+
+    def _count_shard_pass(self, shard: str, kind: str) -> None:
+        key = (shard, kind)
+        c = self._c_shard_passes.get(key)
+        if c is None:
+            c = self.metrics.counter(
+                "polyaxon_agent_shard_passes_total",
+                "Scheduling passes that advanced a shard, by kind",
+                labels={"shard": shard, "kind": kind})
+            self._c_shard_passes[key] = c
+        c.inc()
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "LocalAgent":
         if self.lease_ttl <= 0:
             self.cold_start_resync()
-        elif self._try_acquire_lease():
-            self.cold_start_resync()
         else:
-            print(f"[agent {self._lease_id[:8]}] lease "
-                  f"{self.lease_name!r} held elsewhere — standing by",
-                  flush=True)
+            self._leasing = True
+            try:
+                won = int(self.store.claim_config(
+                    "num_shards", str(self.num_shards)))
+            except Exception:
+                won = self.num_shards  # store weather: run with our K
+            if won != self.num_shards:
+                print(f"[agent {self._lease_id[:8]}] fleet num_shards is "
+                      f"{won} (this agent was configured for "
+                      f"{self.num_shards}) — adopting the fleet's layout",
+                      flush=True)
+                self._adopt_shard_layout(won)
+            now = time.monotonic()
+            self._renew_presence(now)
+            self._probe_at = now + self.lease_ttl / 3.0
+            acquired = self._probe_shards()
+            if acquired:
+                self.cold_start_resync(acquired)
+            else:
+                print(f"[agent {self._lease_id[:8]}] no shard of "
+                      f"{self.shards!r} free — standing by", flush=True)
+            self._presence_thread = threading.Thread(
+                target=self._presence_loop, daemon=True)
+            self._presence_thread.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         if self.reconciler is not None and hasattr(self.cluster, "watch_pods"):
@@ -431,6 +925,8 @@ class LocalAgent:
         self._wake.set()  # unblock the poll loop immediately
         if self._thread:
             self._thread.join(timeout=10)
+        if self._presence_thread:
+            self._presence_thread.join(timeout=5)
         with self._lock:
             for ex in self._active.values():
                 ex.stop()
@@ -452,6 +948,8 @@ class LocalAgent:
         self._wake.set()
         if self._thread:
             self._thread.join(timeout=timeout)
+        if self._presence_thread:
+            self._presence_thread.join(timeout=5)
         with self._lock:
             for sc in self._sidecars.values():
                 sc.stop_evt.set()
@@ -472,6 +970,8 @@ class LocalAgent:
         self._wake.set()
         if self._thread:
             self._thread.join(timeout=10)
+        if self._presence_thread:
+            self._presence_thread.join(timeout=5)
         with self._lock:
             for sc in self._sidecars.values():
                 sc.stop_evt.set()
@@ -491,10 +991,18 @@ class LocalAgent:
     _INFLIGHT = (V1Statuses.SCHEDULED.value, V1Statuses.STARTING.value,
                  V1Statuses.RUNNING.value)
 
-    def cold_start_resync(self) -> None:
-        """Rebuild this agent's entire in-memory world from ONE
-        ``created_at ASC`` store scan plus ONE cluster pod listing
-        (SURVEY.md §5 failure detection; ISSUE 4 tentpole (c)).
+    def cold_start_resync(self, shards: Optional[list] = None) -> None:
+        """Rebuild this agent's in-memory world from ONE ``created_at
+        ASC`` store scan plus ONE grouped cluster pod listing (SURVEY.md
+        §5 failure detection; ISSUE 4 tentpole (c)).
+
+        ``shards`` scopes the rebuild to those shards only (ISSUE 6): a
+        newly-acquired shard is resynced without touching the queues of
+        shards this agent already drives — the scan and the pod listing
+        are filtered to runs hashing into the scope, and only the scoped
+        shards' wait queues are rebuilt. ``shards=None`` keeps the legacy
+        full-world semantics (single-agent deployments, direct test
+        callers, leasing-off mode).
 
         Rebuilt state: the capacity wait queue (FIFO, chip demand cached
         at admission — the exact pre-crash order, since both orders are
@@ -517,7 +1025,14 @@ class LocalAgent:
         fail loudly rather than hang in 'running'. Pipelines
         (matrix/dag/schedule) lose their driver thread — failed with a
         clear message; finished children keep their results."""
-        self._resync_retry.clear()
+        scope = None if shards is None else set(shards)
+        scoped = self.shards if scope is None else [
+            s for s in self.shards if s in scope]
+        if scope is None:
+            self._resync_retry.clear()
+        else:
+            self._resync_retry -= {u for u in self._resync_retry
+                                   if self._shard_name(u) in scope}
         scan_statuses = [V1Statuses.QUEUED.value, *self._INFLIGHT,
                          V1Statuses.STOPPING.value]
         runs: list[dict] = []
@@ -529,11 +1044,12 @@ class LocalAgent:
             if len(page) < 500:
                 break
             offset += 500
+        if scope is not None:
+            runs = [r for r in runs if self._shard_name(r["uuid"]) in scope]
         pods_by_run = self._cluster_pods_by_run(
             [r["uuid"] for r in runs if r["status"] in self._INFLIGHT])
-        self._pending.clear()
-        self._pending_set.clear()
-        self._block_watermark = None
+        for s in scoped:
+            self._clear_shard_queue(s)
         for run in runs:  # created_at ASC: FIFO admission order preserved
             uuid = run["uuid"]
             status = run["status"]
@@ -576,7 +1092,8 @@ class LocalAgent:
                     reason="AgentRestart",
                     message="orphaned by agent restart (local process lost)",
                 )
-        self._pending_fresh = True
+        for s in scoped:
+            self._shard_fresh[s] = True
 
     # the pre-ISSUE-4 public name; direct callers (tests, embedding code)
     # keep working
@@ -635,7 +1152,7 @@ class LocalAgent:
             if not self._use_cluster(resolved):
                 return False
             intent = self.store.get_launch_intent(uuid)
-            token = self.lease["token"] if self.lease else None
+            token, intent_lease = self._intent_identity(uuid)
             # a pod already being deleted is not a live slice member —
             # count only pods that will still exist in a moment
             pods = [p for p in pods if not p.terminating]
@@ -651,7 +1168,7 @@ class LocalAgent:
                 self._cluster_call(self.cluster.delete_selected,
                                    {"app.polyaxon.com/run": uuid})
                 self.store.record_launch_intent(
-                    uuid, self._lease_id, token, lease_name=self.lease_name)
+                    uuid, self._lease_id, token, lease_name=intent_lease)
                 self.reconciler.apply(self._operation_cr(uuid, resolved))
                 self.store.mark_launched(uuid)
                 return True
@@ -753,7 +1270,16 @@ class LocalAgent:
     def _on_status(self, run_uuid: str, status: str, message: Optional[str]) -> None:
         if is_done(status):
             self._collect_outputs_safe(run_uuid)
-        self.store.transition(run_uuid, status, message=message)
+        try:
+            self.store.transition(run_uuid, status, message=message)
+        except StaleLeaseError:
+            # this run's shard was taken over mid-flight: the rejection IS
+            # the designed outcome (the new owner adopts/resyncs the run)
+            # and the proxy already demoted the shard — an executor
+            # callback thread must not die over it, only stop reporting
+            if is_done(status):
+                self._finalize_run(run_uuid)
+            return
         if is_done(status):
             self._finalize_run(run_uuid)
 
@@ -764,8 +1290,13 @@ class LocalAgent:
         for uuid, status, _ in updates:
             if is_done(status):
                 self._collect_outputs_safe(uuid)
-        self.store.transition_many(
-            [(uuid, status, None, message) for uuid, status, message in updates])
+        try:
+            self.store.transition_many(
+                [(uuid, status, None, message)
+                 for uuid, status, message in updates])
+        except StaleLeaseError:
+            pass  # takeover mid-edge: same semantics as _on_status — the
+            #       new owner drives these runs now; finalize and go quiet
         for uuid, status, _ in updates:
             if is_done(status):
                 self._finalize_run(uuid)
@@ -964,6 +1495,7 @@ class LocalAgent:
         while True:
             self._wake.wait(timeout=self.poll_interval)
             self._wake.clear()
+            self._last_pass_at = time.monotonic()  # liveness for presence
             if self._stop.is_set():
                 return
             while self._suspended.is_set() and not self._stop.is_set():
@@ -1026,29 +1558,39 @@ class LocalAgent:
 
     def tick(self) -> None:
         """One full reconcile pass (public for deterministic tests).
-        Authoritative: rebuilds the capacity wait queue from the store, so
-        it also covers writers outside this process that the in-proc change
-        feed never sees."""
+        Authoritative: rebuilds the owned shards' capacity wait queues
+        from the store, so it also covers writers outside this process
+        that the in-proc change feed never sees. Sharded (ISSUE 6): every
+        stage advances ONLY runs whose shard this agent holds — with N
+        active agents each full pass drives its own partition and leaves
+        the rest to their owners."""
         self._c_passes["full"].inc()
+        owned = self._owned_shards()
+        for s in owned:
+            self._count_shard_pass(s, "full")
         for run in self.store.list_runs(status=V1Statuses.CREATED.value,
                                         order="asc"):
-            self._compile(run)
-        compiled = self.store.list_runs(status=V1Statuses.COMPILED.value,
-                                        order="asc")
+            if self._owns_run(run["uuid"]):
+                self._compile(run)
+        compiled = [r for r in self.store.list_runs(
+            status=V1Statuses.COMPILED.value, order="asc")
+            if self._owns_run(r["uuid"])]
         if compiled:
             # one transaction for the whole promotion wave, not 3×N commits
             self.store.transition_many(
                 [(r["uuid"], V1Statuses.QUEUED.value) for r in compiled])
-        self._pending.clear()
-        self._pending_set.clear()
-        self._block_watermark = None
+        for s in owned:
+            self._clear_shard_queue(s)
         for run in _list_runs_all(self.store, V1Statuses.QUEUED.value,
                                   order="asc"):
-            self._enqueue_pending(run)
-        self._pending_fresh = True
+            if self._owns_run(run["uuid"]):
+                self._enqueue_pending(run)
+        for s in owned:
+            self._shard_fresh[s] = True
         self._schedule_pending()
         for run in self.store.list_runs(status=V1Statuses.STOPPING.value):
-            self._do_stop(run)
+            if self._owns_run(run["uuid"]):
+                self._do_stop(run)
         if self._resync_retry:
             self._retry_resync_classification()
         if self.reconciler is not None:
@@ -1065,6 +1607,12 @@ class LocalAgent:
         neither failed, relaunched, nor adopted — until a listing for them
         succeeds; an unreachable API defers again to the next full pass."""
         for uuid in list(self._resync_retry):
+            if not self._owns_run(uuid):
+                # shard handed off since the run was parked: its NEW
+                # owner classifies it (force-failing here would kill a
+                # run the legitimate owner is actively driving)
+                self._resync_retry.discard(uuid)
+                continue
             try:
                 run = self.store.get_run(uuid)
             except Exception:
@@ -1101,6 +1649,11 @@ class LocalAgent:
         what made deep bursts O(events × queued) before r7 (BASELINE r6)."""
         self._c_passes["dirty"].inc()
         rows = self.store.get_runs(list(dirty))
+        # sharded (ISSUE 6): another agent's runs wake us too (the change
+        # feed is store-wide) — advance only our own partition
+        rows = [r for r in rows if self._owns_run(r["uuid"])]
+        for s in {self._shard_name(r["uuid"]) for r in rows}:
+            self._count_shard_pass(s, "dirty")
         # process in creation order so a coalesced burst (N creates in one
         # wake) compiles/queues FIFO — scheduling order must not depend on
         # set iteration order
@@ -1139,8 +1692,8 @@ class LocalAgent:
         return self.max_parallel - active
 
     def _enqueue_pending(self, run: dict) -> None:
-        """Admit a queued run to the capacity wait queue (or start it right
-        away when it doesn't compete for capacity)."""
+        """Admit a queued run to its SHARD's capacity wait queue (or start
+        it right away when it doesn't compete for capacity)."""
         uuid = run["uuid"]
         if uuid in self._pending_set:
             return
@@ -1160,47 +1713,95 @@ class LocalAgent:
                 return
         else:
             demand = 1
-        self._pending.append((uuid, demand))
+        shard = self._shard_name(uuid)
+        self._shard_pending[shard].append((uuid, demand))
         self._pending_set.add(uuid)
-        self._pending_fresh = True
+        self._shard_fresh[shard] = True
 
     def _schedule_pending(self) -> None:
-        """Walk the wait queue FIFO, scheduling every run whose demand fits
-        the free budget (smaller runs may backfill past a blocked big one,
-        same as the old full scan). Store reads happen ONLY for runs that
-        fit — blocked entries cost an in-memory comparison. When neither
-        new entries nor enough freed capacity (the watermark) exist, the
-        walk is skipped outright."""
-        if not self._pending:
-            self._block_watermark = None
+        """Walk the owned shards' wait queues FIFO, scheduling every run
+        whose demand fits the free budget (smaller runs may backfill past
+        a blocked big one, same as the old full scan). Store reads happen
+        ONLY for runs that fit — blocked entries cost an in-memory
+        comparison, and a shard with no new entries and not enough freed
+        capacity for its smallest blocked run (its watermark) skips its
+        walk outright: a quiet wake stays O(1) and touches zero store
+        rows, per shard.
+
+        Chip-budget sub-allocation (ISSUE 6 tentpole): with several owned
+        shards competing for one budget, each first walks an equal slice
+        of the free pool, then whatever those walks could not place —
+        idle chips — flows to the hungriest shard (deepest remaining
+        queue) in a second pass. One owned shard (num_shards=1) degrades
+        to the r7 single-queue walk exactly."""
+        runnable: list[str] = []
+        free = None
+        for s in self._owned_shards():
+            if not self._shard_pending[s]:
+                self._shard_watermark[s] = None
+                continue
+            if free is None:
+                free = self._free_capacity()
+            if (not self._shard_fresh[s]
+                    and self._shard_watermark[s] is not None
+                    and free < self._shard_watermark[s]):
+                # conservative gate on the GLOBAL pool: even all the free
+                # chips can't fit this shard's smallest blocked demand
+                continue
+            runnable.append(s)
+        if not runnable or free is None:
             return
-        free = self._free_capacity()
-        if (not self._pending_fresh and self._block_watermark is not None
-                and free < self._block_watermark):
+        if len(runnable) == 1:
+            self._walk_shard(runnable[0], free)
             return
-        self._pending_fresh = False
+        base = free // len(runnable)
+        leftover = free - base * len(runnable)
+        for s in runnable:
+            leftover += base - self._walk_shard(s, base)
+        # rebalance: idle chips flow to the hungriest shard first
+        for s in sorted(runnable,
+                        key=lambda s: -len(self._shard_pending[s])):
+            if leftover <= 0:
+                return
+            if self._shard_pending[s]:
+                leftover -= self._walk_shard(s, leftover)
+
+    def _walk_shard(self, shard: str, budget: int) -> int:
+        """FIFO walk of one shard's wait queue with ``budget`` chips to
+        hand out; returns the chips actually placed and re-arms the
+        shard's blocked-demand watermark."""
+        self._shard_fresh[shard] = False
+        pending = self._shard_pending[shard]
         watermark: Optional[int] = None
         kept: "collections.deque[tuple[str, int]]" = collections.deque()
-        while self._pending:
-            uuid, demand = self._pending.popleft()
-            if demand > max(free, 0):
+        used = 0
+        while pending:
+            uuid, demand = pending.popleft()
+            if demand > max(budget, 0):
                 kept.append((uuid, demand))
-                watermark = demand if watermark is None else min(watermark, demand)
+                watermark = (demand if watermark is None
+                             else min(watermark, demand))
                 continue
             run = self.store.get_run(uuid)
             if run is None or run["status"] != V1Statuses.QUEUED.value:
+                self._pending_set.discard(uuid)
                 continue  # stopped/advanced while waiting
             outcome = self._maybe_schedule(run)
             if outcome == "scheduled":
-                free -= demand
+                budget -= demand
+                used += demand
+                self._pending_set.discard(uuid)
             elif outcome == "blocked":
                 # the authoritative in-lock gate disagreed with our free
                 # snapshot (concurrent scheduling); keep it queued
                 kept.append((uuid, demand))
-                watermark = demand if watermark is None else min(watermark, demand)
-        self._pending = kept
-        self._pending_set = {u for u, _ in kept}
-        self._block_watermark = watermark
+                watermark = (demand if watermark is None
+                             else min(watermark, demand))
+            else:
+                self._pending_set.discard(uuid)
+        self._shard_pending[shard] = kept
+        self._shard_watermark[shard] = watermark
+        return used
 
     # -- stages ------------------------------------------------------------
 
@@ -1497,10 +2098,9 @@ class LocalAgent:
         # any point leaves enough on disk for the successor to distinguish
         # "pods never created" (relaunch) from "pods live" (adopt). The
         # fence rides along: a stale agent cannot even record the intent.
+        token, intent_lease = self._intent_identity(uuid)
         self.store.record_launch_intent(
-            uuid, self._lease_id,
-            self.lease["token"] if self.lease else None,
-            lease_name=self.lease_name)
+            uuid, self._lease_id, token, lease_name=intent_lease)
         self.reconciler.apply(self._operation_cr(uuid, resolved))
         self.store.mark_launched(uuid)
 

@@ -19,7 +19,13 @@ Usage:
         [--preempt-rate 0.03] [--max-preemptions 2] [--trials 3] \
         [--rounds 1] [--keep] \
         [--kill-agent] [--split-brain] [--kills 2] [--lease-ttl 0.8] \
+        [--agents 4] [--num-shards 8] [--rolling-kill] \
         [--metrics-dump [PATH]]
+
+``--agents N`` (ISSUE 6) runs the SHARDED fleet soak: N concurrently-
+active agents split the shard leases over one store; ``--rolling-kill``
+kills victims WITHOUT replacement, so the survivors must adopt every
+orphaned shard within 2x the lease TTL (measured, gates exit 0).
 
 ``--metrics-dump`` archives the last round's final /metrics scrape
 (validated Prometheus text, docs/OBSERVABILITY.md) into bench_artifacts —
@@ -155,11 +161,26 @@ def _wave_specs(n_jobs: int, rng: random.Random):
 def run_kill_agent_soak(workdir: str, seed: int = 2024, n_jobs: int = 8,
                         kills: int = 2, split_brain: bool = False,
                         chaos_cfg=None, lease_ttl: float = 0.8,
-                        timeout: float = 300.0) -> dict:
+                        timeout: float = 300.0, agents: int = 1,
+                        num_shards: int = 8,
+                        rolling_kill: bool = False) -> dict:
     """One kill-the-agent pass: drive a job wave, hard-kill + restart the
     agent at seeded times (and optionally run a split-brain round), and
     return statuses + every crash-safety counter. ``kills=0`` and
-    ``split_brain=False`` is the fault-free oracle."""
+    ``split_brain=False`` is the fault-free oracle.
+
+    ``agents>1`` (ISSUE 6) switches to the SHARDED fleet soak: N
+    concurrently-active agents split ``num_shards`` shard leases over one
+    store; ``rolling_kill`` kills agents WITHOUT replacement (survivors
+    must adopt the orphaned shards within < 2x lease TTL — measured and
+    returned as ``shard_reown_s``), the split-brain round suspends one
+    fleet member past its TTLs and resumes it against the adopters."""
+    if agents > 1:
+        return _sharded_kill_soak(
+            workdir, seed=seed, n_jobs=n_jobs, kills=kills,
+            split_brain=split_brain, chaos_cfg=chaos_cfg,
+            lease_ttl=lease_ttl, timeout=timeout, agents=agents,
+            num_shards=num_shards, rolling_kill=rolling_kill)
     from polyaxon_tpu.api.store import StaleLeaseError, Store
     from polyaxon_tpu.operator import FakeCluster
     from polyaxon_tpu.resilience import ChaosCluster
@@ -254,6 +275,183 @@ def run_kill_agent_soak(workdir: str, seed: int = 2024, n_jobs: int = 8,
         agent.stop()
 
 
+def _sharded_kill_soak(workdir: str, *, seed: int, n_jobs: int, kills: int,
+                       split_brain: bool, chaos_cfg, lease_ttl: float,
+                       timeout: float, agents: int, num_shards: int,
+                       rolling_kill: bool) -> dict:
+    """The ISSUE 6 fleet soak: ``agents`` concurrently-active shard-aware
+    agents over ONE store, seeded kills mid-wave. ``rolling_kill`` kills
+    WITHOUT replacement — the orphaned shards must be adopted by the
+    survivors (measured per kill as ``shard_reown_s``); otherwise each
+    victim is replaced by a fresh standby that joins the fleet. The
+    split-brain round suspends one live member past its TTLs (its shards
+    get adopted) and resumes it: its pre-pause tokens must be fenced off
+    per-shard and the member demoted from exactly those shards."""
+    from polyaxon_tpu.api.store import (
+        SHARD_PREFIX, FencedStore, StaleLeaseError, Store)
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.resilience import ChaosCluster
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    rng = random.Random(seed)
+    store = Store(":memory:")
+    cluster = FakeCluster(os.path.join(workdir, ".cluster"))
+    if chaos_cfg is not None:
+        cluster = ChaosCluster(cluster, chaos_cfg)
+
+    def new_agent():
+        return LocalAgent(store, workdir, backend="cluster", cluster=cluster,
+                          poll_interval=0.05, lease_ttl=lease_ttl,
+                          num_shards=num_shards, max_parallel=4).start()
+
+    fleet = [new_agent() for _ in range(agents)]
+    dead_holders: set = set()
+
+    def _all_reowned() -> bool:
+        """Every shard lease live and held by a non-dead agent."""
+        rows = store.list_leases(SHARD_PREFIX)
+        live = {r["name"] for r in rows
+                if not r["expired"] and r["holder"] not in dead_holders}
+        return len(live) >= num_shards
+
+    def _wait_reowned(budget: float) -> bool:
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            if _all_reowned():
+                return True
+            time.sleep(0.02)
+        return _all_reowned()
+
+    def _stale_shard_write(shard: str, token: int, uuid: str) -> bool:
+        """One write pinned to a superseded (shard, token) — the in-flight
+        batch of a dead/paused owner. Must bounce off THAT shard's fence
+        (the per-lease rejection family the soak asserts on). Returns
+        True iff it was rejected; the shard is only probed after its
+        token moved on, so a False means the fence leaked a stale write."""
+        try:
+            FencedStore(store, lambda: (shard, token)).transition(
+                uuid, "stopping")
+        except StaleLeaseError:
+            return True
+        except Exception:
+            pass
+        return False
+
+    stale_rejected = 0
+    shard_reown_s: list = []
+    demoted = None
+    try:
+        if not _wait_reowned(30.0):
+            raise RuntimeError("fleet never covered the shard space")
+        uuids = [store.create_run("p", spec=s, name=s.get("name"))["uuid"]
+                 for s in _wave_specs(n_jobs, rng)]
+        for _ in range(kills):
+            time.sleep(rng.uniform(0.4, 1.2))
+            live = [a for a in fleet if not a._dead]
+            if len(live) <= 1 and rolling_kill:
+                break  # never kill the whole fleet: nobody left to adopt
+            victim = live[rng.randrange(len(live))]
+            # snapshot (atomic under the GIL): the victim's loop thread
+            # is still acquiring/demoting shards while we read
+            held = {s: lease["token"]
+                    for s, lease in dict(victim._shard_leases).items()}
+            victim.hard_kill()
+            dead_holders.add(victim._lease_id)
+            t_kill = time.monotonic()
+            if not rolling_kill:
+                fleet.append(new_agent())
+            reowned = _wait_reowned(max(6.0 * lease_ttl, 15.0))
+            shard_reown_s.append(
+                round(time.monotonic() - t_kill, 3) if reowned
+                else float("inf"))
+            # all shards re-owned => every held token was superseded: the
+            # dead owner's in-flight write must be fenced off per-shard
+            if held and reowned:
+                shard = sorted(held)[rng.randrange(len(held))]
+                if _stale_shard_write(shard, held[shard],
+                                      uuids[rng.randrange(len(uuids))]):
+                    stale_rejected += 1
+        if split_brain:
+            time.sleep(rng.uniform(0.3, 0.8))
+            live = [a for a in fleet if not a._dead]
+            incumbent = live[rng.randrange(len(live))]
+            deadline = time.monotonic() + 10 * lease_ttl
+            while (not incumbent._shard_leases
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            pinned = {s: lease["token"]
+                      for s, lease in dict(incumbent._shard_leases).items()}
+            incumbent.suspend()          # GC pause: renewals stop
+            time.sleep(lease_ttl * 1.6)  # ...past the TTL
+            incumbent.resume()           # split brain: two claimants live
+            # wait for every pinned shard to move to a NEWER token (the
+            # survivors adopt; acquisition always bumps the counter)
+            deadline = time.monotonic() + max(6.0 * lease_ttl, 15.0)
+            while time.monotonic() < deadline:
+                rows = {r["name"]: r for r in store.list_leases(SHARD_PREFIX)}
+                if all(s in rows and not rows[s]["expired"]
+                       and rows[s]["token"] != tok
+                       for s, tok in pinned.items()):
+                    break
+                time.sleep(0.02)
+            if pinned:
+                shard = sorted(pinned)[rng.randrange(len(pinned))]
+                if _stale_shard_write(shard, pinned[shard],
+                                      uuids[rng.randrange(len(uuids))]):
+                    stale_rejected += 1
+            # the resumed incumbent must demote from exactly the stolen
+            # shards (its next renewal is rejected per-shard); it may
+            # legitimately re-acquire some later — with FRESH tokens
+
+            def _all_repinned() -> bool:
+                # one snapshot + one .get per shard: the incumbent is
+                # actively demoting these exact shards on its own threads
+                snap = dict(incumbent._shard_leases)
+                return all((snap.get(s) or {}).get("token") != tok
+                           for s, tok in pinned.items())
+
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if _all_repinned():
+                    break
+                time.sleep(0.05)
+            demoted = _all_repinned()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = [store.get_run(u) for u in uuids]
+            if all(r["status"] in ("succeeded", "failed", "stopped")
+                   for r in rows):
+                break
+            time.sleep(0.1)
+        statuses = {r["name"]: r["status"]
+                    for r in (store.get_run(u) for u in uuids)}
+        return {
+            "statuses": statuses,
+            "metrics_text": store.metrics.render(),
+            "fence_rejections": store.stats["fence_rejections"],
+            "stale_writes_rejected": stale_rejected,
+            "launch_intents": store.stats["launch_intents"],
+            "launch_counts": dict(getattr(cluster, "launch_counts", {})),
+            "duplicate_applies": list(
+                getattr(cluster, "duplicate_applies", [])),
+            "incumbent_demoted": demoted,
+            "injected": len(list(getattr(cluster, "injected", []))),
+            "agents": agents,
+            "num_shards": num_shards,
+            "lease_ttl": lease_ttl,
+            "shard_reown_s": shard_reown_s,
+        }
+    finally:
+        # drain the fleet, then let exactly ONE member tear down the
+        # shared cluster — stop() shuts it down, which must not race the
+        # still-live peers' loops
+        live = [a for a in fleet if not a._dead]
+        for a in live[:-1]:
+            a.drain()
+        for a in live[-1:]:
+            a.stop()
+
+
 def _dump_metrics(path: str, text: str) -> None:
     """Archive the final /metrics scrape of the last round (validated
     Prometheus text) so every soak leaves a machine-readable telemetry
@@ -294,7 +492,9 @@ def _run_kill_agent_mode(args) -> int:
                 os.path.join(root, f"kill-{seed}"), seed=seed,
                 n_jobs=args.trials * 3, kills=args.kills,
                 split_brain=args.split_brain, chaos_cfg=cfg,
-                lease_ttl=args.lease_ttl, timeout=args.timeout)
+                lease_ttl=args.lease_ttl, timeout=args.timeout,
+                agents=args.agents, num_shards=args.num_shards,
+                rolling_kill=args.rolling_kill)
             final_scrape = out["metrics_text"]
             converged = out["statuses"] == oracle["statuses"]
             no_dups = not out["duplicate_applies"]
@@ -302,6 +502,12 @@ def _run_kill_agent_mode(args) -> int:
             round_ok = converged and no_dups and fenced
             if args.split_brain:
                 round_ok = round_ok and out["incumbent_demoted"] is True
+            if args.agents > 1:
+                # fleet acceptance (ISSUE 6): every orphaned shard
+                # re-owned by a survivor within 2x the lease TTL
+                round_ok = round_ok and all(
+                    t < 2.0 * args.lease_ttl
+                    for t in out.get("shard_reown_s", []))
             ok = ok and round_ok
             print(json.dumps({
                 "pass": f"kill-{seed}", "ok": round_ok,
@@ -310,6 +516,7 @@ def _run_kill_agent_mode(args) -> int:
                 "duplicate_applies": out["duplicate_applies"],
                 "launch_intents": out["launch_intents"],
                 "incumbent_demoted": out["incumbent_demoted"],
+                "shard_reown_s": out.get("shard_reown_s"),
                 "diff": {k: (oracle["statuses"].get(k),
                              out["statuses"].get(k))
                          for k in set(oracle["statuses"]) | set(out["statuses"])
@@ -351,6 +558,16 @@ def main() -> int:
                    help="agent kills per --kill-agent round")
     p.add_argument("--lease-ttl", type=float, default=0.8,
                    help="agent lease TTL for --kill-agent rounds")
+    p.add_argument("--agents", type=int, default=1,
+                   help="with --kill-agent: size of the sharded agent "
+                        "fleet over one store (ISSUE 6); 1 = the legacy "
+                        "single-active-agent soak")
+    p.add_argument("--num-shards", type=int, default=8,
+                   help="work partitions (shard leases) for --agents > 1")
+    p.add_argument("--rolling-kill", action="store_true",
+                   help="with --agents > 1: kill victims WITHOUT "
+                        "replacement — survivors must adopt the orphaned "
+                        "shards within 2x the lease TTL")
     p.add_argument("--metrics-dump", nargs="?", metavar="PATH",
                    const=os.path.join(
                        os.path.dirname(os.path.dirname(
@@ -362,7 +579,8 @@ def main() -> int:
                         "bench_artifacts/chaos_soak_metrics.prom)")
     args = p.parse_args()
 
-    if args.kill_agent or args.split_brain:
+    if (args.kill_agent or args.split_brain or args.rolling_kill
+            or args.agents > 1):
         args.kill_agent = True
         return _run_kill_agent_mode(args)
 

@@ -359,6 +359,54 @@ class TestAgentKillSoak:
         assert len(out["launch_counts"]) == 8, out
         assert all(c >= 1 for c in out["launch_counts"].values()), out
 
+    def test_sharded_rolling_kill_fleet_converges(self, tmp_path):
+        """ISSUE 6 acceptance soak: 4 shard-sharing agents over one store,
+        2 of them killed mid-wave IN SEQUENCE without replacement, plus a
+        split-brain round where a suspended member resumes against the
+        adopters. Must converge to the fault-free oracle with ZERO
+        duplicate pod launches, every orphaned shard re-owned by a
+        survivor within 2x the lease TTL, and >=1 PER-SHARD fencing
+        rejection observed via the /metrics scrape (the
+        ``lease="shard-<i>"`` labeled family, not just the global
+        counter)."""
+        from chaos_soak import run_kill_agent_soak
+
+        from polyaxon_tpu.api.store import SHARD_PREFIX
+        from polyaxon_tpu.obs import parse_prometheus
+
+        lease_ttl = 1.0
+        oracle = run_kill_agent_soak(str(tmp_path / "oracle"), seed=2024,
+                                     n_jobs=8, kills=0)
+        assert all(v == "succeeded" for v in oracle["statuses"].values()), \
+            oracle
+        out = run_kill_agent_soak(str(tmp_path / "kill"), seed=2024,
+                                  n_jobs=8, kills=2, split_brain=True,
+                                  lease_ttl=lease_ttl, agents=4,
+                                  num_shards=8, rolling_kill=True)
+        assert out["statuses"] == oracle["statuses"], out
+        assert out["duplicate_applies"] == [], out
+        assert out["incumbent_demoted"] is True, out
+        # every orphaned shard re-owned by a survivor within 2x TTL,
+        # for BOTH sequential kills
+        assert len(out["shard_reown_s"]) == 2, out
+        assert all(t < 2.0 * lease_ttl for t in out["shard_reown_s"]), out
+        # the fences that did the rejecting are per-SHARD: scrape the
+        # labeled family, not the soak's internal audit trail
+        families = parse_prometheus(out["metrics_text"])
+        by_lease = families.get(
+            "polyaxon_store_fence_rejections_by_lease_total")
+        assert by_lease is not None, sorted(families)
+        shard_rejections = {
+            sample: value for sample, value in by_lease.items()
+            if f'lease="{SHARD_PREFIX}' in sample}
+        assert shard_rejections and sum(shard_rejections.values()) >= 1, \
+            by_lease
+        # the scrape agrees with the store's own counter
+        assert out["fence_rejections"] >= sum(shard_rejections.values()), out
+        # every run launched exactly the pods of one attempt set
+        assert len(out["launch_counts"]) == 8, out
+        assert all(c >= 1 for c in out["launch_counts"].values()), out
+
 
 # ---------------------------------------------------------------------------
 # 4. agent SIGKILL + slice death + TORN newest checkpoint -> resume from

@@ -196,6 +196,38 @@ class TestFaultyStoreLeaseVerbs:
         # renewal rides the same gate (budget exhausted -> clean path)
         assert store.renew_lease("scheduler", "a1", lease["token"])
 
+    def test_shard_lease_verbs_gated_too(self):
+        """ISSUE 6: the batched renewal heartbeat and the fair-share
+        listing behind shard acquisition/rebalance are chaos-testable —
+        both gated, both surviving an injected SQLITE_BUSY burst."""
+        import sqlite3
+
+        store = FaultyStore(Store(":memory:"), seed=5, fault_rate=1.0,
+                            max_faults=2)
+        lease = None
+        for _ in range(10):
+            try:
+                lease = store.acquire_lease("shard-0", "a1", ttl=30)
+                break
+            except sqlite3.OperationalError:
+                pass
+        assert lease is not None
+        for verb, call in (
+            ("renew_leases",
+             lambda: store.renew_leases([("shard-0", lease["token"])], "a1")),
+            ("list_leases", lambda: store.list_leases("shard-")),
+        ):
+            store._max_faults = store._faults + 1  # re-arm: one more fault
+            out = None
+            for _ in range(10):  # the probe's retry-next-cycle path
+                try:
+                    out = call()
+                    break
+                except sqlite3.OperationalError:
+                    pass
+            assert out, (verb, out)
+            assert verb in store.injected
+
 
 # ---------------------------------------------------------------------------
 # write-ahead launch intents: replay, adoption, slice loss
@@ -562,7 +594,10 @@ class TestAgentKillSmoke:
         r = store.create_run("p", spec={}, name="x")
         agent._on_stale_lease()  # what a StaleLeaseError write triggers
         assert agent.lease is None
-        assert agent._current_fence() == ("__dead__", -1)
+        # poison fence: the REAL lease name with an impossible token
+        # (tokens start at 1), so the store rejects it AND the rejection
+        # routes back to the already-demoted lease, never a healthy one
+        assert agent._current_fence() == ("scheduler", -1)
         with pytest.raises(StaleLeaseError):
             agent.store.transition(r["uuid"], "compiled")
         assert store.get_run(r["uuid"])["status"] == "created"
@@ -733,3 +768,345 @@ class TestCheckpointManifests:
             int(n[len("manifest-"):-len(".json")])
             for n in os.listdir(ck.directory) if n.startswith("manifest-"))
         assert manifests == live
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: horizontally sharded control plane — shard hashing, batch lease
+# verbs, per-shard fencing, shard adoption, shard-scoped reaping
+# ---------------------------------------------------------------------------
+
+
+class TestShardHashing:
+    def test_shard_index_stable_and_in_range(self):
+        from polyaxon_tpu.api.store import shard_index, shard_lease_names
+
+        uuids = [f"run-{i:04d}" for i in range(64)]
+        first = [shard_index(u, 8) for u in uuids]
+        # stability is load-bearing: every agent/incarnation must agree
+        assert first == [shard_index(u, 8) for u in uuids]
+        assert all(0 <= s < 8 for s in first)
+        # crc32 spreads: a 64-run burst never collapses onto one shard
+        assert len(set(first)) > 1
+        assert shard_lease_names(3) == ["shard-0", "shard-1", "shard-2"]
+        # degenerate K never divides by zero or escapes range
+        assert shard_index("x", 0) == 0
+        assert shard_lease_names(0) == ["shard-0"]
+
+    def test_shard_index_independent_of_other_shard_count_only(self):
+        from polyaxon_tpu.api.store import shard_index
+
+        # same uuid, same K -> same shard across *processes* (pure fn of
+        # bytes, no per-process salt)
+        assert shard_index("abc", 8) == shard_index("abc", 8)
+
+
+class TestBatchLeaseVerbs:
+    def test_renew_leases_batch_per_entry_result(self):
+        store = Store(":memory:")
+        l0 = store.acquire_lease("shard-0", "a1", ttl=30)
+        l1 = store.acquire_lease("shard-1", "a1", ttl=30)
+        # shard-1 is stolen (release + fresh acquisition bumps its token)
+        store.release_lease("shard-1", "a1", l1["token"])
+        store.acquire_lease("shard-1", "a2", ttl=30)
+        oks = store.renew_leases(
+            [("shard-0", l0["token"]), ("shard-1", l1["token"])], "a1")
+        # per-entry verdict: the stolen shard demotes ALONE
+        assert oks == [True, False]
+
+    def test_list_leases_prefix_and_expired_flag(self):
+        store = Store(":memory:")
+        store.acquire_lease("shard-0", "a1", ttl=30)
+        store.acquire_lease("shard-1", "a1", ttl=0.01)
+        store.acquire_lease("agent-xyz", "a1", ttl=30)
+        time.sleep(0.05)
+        shards = store.list_leases("shard-")
+        assert [r["name"] for r in shards] == ["shard-0", "shard-1"]
+        assert [r["expired"] for r in shards] == [False, True]
+        every = store.list_leases()
+        assert {r["name"] for r in every} == {"shard-0", "shard-1",
+                                              "agent-xyz"}
+
+
+class TestPerShardFencing:
+    """Satellite 5: a fence rejection from a concurrent shard owner must
+    reject only that shard's sub-batch, not abort the whole batch."""
+
+    def _runs_spanning_two_shards(self, store, min_per_shard=2):
+        from polyaxon_tpu.api.store import shard_index
+
+        by_shard = {0: [], 1: []}
+        while (len(by_shard[0]) < min_per_shard
+               or len(by_shard[1]) < min_per_shard):
+            r = store.create_run("p", spec={}, name=f"r{sum(map(len, by_shard.values()))}")
+            by_shard[shard_index(r["uuid"], 2)].append(r["uuid"])
+        return by_shard
+
+    def test_transition_many_rejects_only_the_stale_shards_sub_batch(self):
+        from polyaxon_tpu.api.store import shard_index
+
+        store = Store(":memory:")
+        by_shard = self._runs_spanning_two_shards(store)
+        tokens = {
+            "shard-0": store.acquire_lease("shard-0", "a1", ttl=30)["token"],
+            "shard-1": store.acquire_lease("shard-1", "a1", ttl=30)["token"],
+        }
+        # a concurrent owner steals shard-1: a1's token for it is stale
+        store.release_lease("shard-1", "a1", tokens["shard-1"])
+        store.acquire_lease("shard-1", "a2", ttl=30)
+
+        def _fence_for(uuid):
+            shard = f"shard-{shard_index(uuid, 2)}"
+            return (shard, tokens[shard])
+
+        stale_names = []
+        fenced = FencedStore(store, lambda: _fence_for,
+                             on_stale=stale_names.append)
+        # interleave the shards so the split is by FENCE, not by position
+        batch = []
+        for pair in zip(by_shard[0], by_shard[1]):
+            batch.extend(pair)
+        out = fenced.transition_many([(u, "compiled") for u in batch])
+        assert len(out) == len(batch)
+        for uuid, (row, changed) in zip(batch, out):
+            if shard_index(uuid, 2) == 0:  # healthy shard: committed
+                assert changed is True
+                assert store.get_run(uuid)["status"] == "compiled"
+            else:                          # stolen shard: rejected alone
+                assert changed is False
+                assert store.get_run(uuid)["status"] == "created"
+        # one rejection for the one stale sub-batch, naming its shard
+        assert stale_names == ["shard-1"]
+        assert store.stats["fence_rejections"] == 1
+        # ...and the per-lease labeled family recorded WHICH shard
+        text = store.metrics.render()
+        assert ('polyaxon_store_fence_rejections_by_lease_total'
+                '{lease="shard-1"} 1') in text
+
+    def test_single_run_writes_resolve_their_own_shard_fence(self):
+        from polyaxon_tpu.api.store import shard_index
+
+        store = Store(":memory:")
+        by_shard = self._runs_spanning_two_shards(store, min_per_shard=1)
+        tokens = {
+            "shard-0": store.acquire_lease("shard-0", "a1", ttl=30)["token"],
+            "shard-1": store.acquire_lease("shard-1", "a1", ttl=30)["token"],
+        }
+        store.release_lease("shard-1", "a1", tokens["shard-1"])
+        store.acquire_lease("shard-1", "a2", ttl=30)
+
+        def _fence_for(uuid):
+            shard = f"shard-{shard_index(uuid, 2)}"
+            return (shard, tokens[shard])
+
+        fenced = FencedStore(store, lambda: _fence_for)
+        ok_uuid, stale_uuid = by_shard[0][0], by_shard[1][0]
+        fenced.transition(ok_uuid, "compiled")
+        assert store.get_run(ok_uuid)["status"] == "compiled"
+        with pytest.raises(StaleLeaseError):
+            fenced.transition(stale_uuid, "compiled")
+        assert store.get_run(stale_uuid)["status"] == "created"
+
+
+class TestShardAdoption:
+    def test_fleet_splits_shards_and_survivor_adopts_orphans(self, tmp_path):
+        """Fast tier-1 smoke of the slow rolling-kill soak: two agents
+        split 4 shards fair-share; killing one orphans its shards, which
+        the survivor must adopt (the <2xTTL bound is asserted by the slow
+        soak — here only liveness, load-tolerant)."""
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".cluster"))
+        ttl = 0.5
+        mk = lambda: LocalAgent(
+            store, str(tmp_path), backend="cluster", cluster=cluster,
+            poll_interval=0.05, lease_ttl=ttl, num_shards=4).start()
+        a1, a2 = mk(), mk()
+        try:
+            _wait(lambda: a1._shard_leases and a2._shard_leases,
+                  timeout=15, msg="fleet to split the shard space")
+            held = lambda a: set(a._shard_leases)
+            assert held(a1).isdisjoint(held(a2))
+            _wait(lambda: len(held(a1) | held(a2)) == 4,
+                  timeout=15, msg="all 4 shards owned")
+            a1.hard_kill()
+            orphaned = held(a1)
+            _wait(lambda: orphaned <= held(a2), timeout=15,
+                  msg="survivor to adopt the orphaned shards")
+            rows = {r["name"]: r for r in store.list_leases("shard-")}
+            assert all(rows[s]["holder"] == a2._lease_id
+                       and not rows[s]["expired"] for s in orphaned)
+        finally:
+            a2.stop()
+
+    def test_scheduling_respects_shard_ownership(self, tmp_path):
+        """Each agent drives ONLY runs hashing into its shards: with two
+        agents splitting the space, every run still reaches terminal (no
+        run is orphaned by partitioning) and each launch intent names the
+        shard lease that authorized it."""
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".cluster"))
+        mk = lambda: LocalAgent(
+            store, str(tmp_path), backend="cluster", cluster=cluster,
+            poll_interval=0.05, lease_ttl=1.0, num_shards=4,
+            max_parallel=4).start()
+        a1, a2 = mk(), mk()
+        try:
+            _wait(lambda: a1._shard_leases and a2._shard_leases,
+                  timeout=15, msg="fleet to split the shard space")
+            uuids = [store.create_run("p", spec=_job_spec(f"j{i}"),
+                                      name=f"j{i}")["uuid"]
+                     for i in range(6)]
+            _wait(lambda: all(
+                store.get_run(u)["status"] in ("succeeded", "failed")
+                for u in uuids), timeout=60, msg="wave to finish")
+            assert all(store.get_run(u)["status"] == "succeeded"
+                       for u in uuids)
+            from polyaxon_tpu.api.store import shard_index
+
+            for u in uuids:
+                intent = store.get_launch_intent(u)
+                assert intent is not None
+                assert intent["lease_name"] == f"shard-{shard_index(u, 4)}"
+        finally:
+            a1.drain()
+            a2.stop()
+
+
+class TestShardScopedReaper:
+    def _zombie_run(self, store, name):
+        spec = {"kind": "operation",
+                "component": {"kind": "component",
+                              "run": {"kind": "job", "container": {
+                                  "command": [sys.executable, "-c",
+                                              "pass"]}}}}
+        run = store.create_run("p", spec=spec, name=name)
+        store.transition(run["uuid"], "running", force=True)
+        return run["uuid"]
+
+    def test_two_reapers_reap_disjoint_shards_exactly_once(self):
+        """Satellite 1: N agents never double-reap one run — each reaper
+        only strikes runs of its own shards, and the reap counters sum to
+        exactly one action per zombie across the fleet."""
+        from polyaxon_tpu.api.store import shard_index
+        from polyaxon_tpu.resilience.heartbeat import ZombieReaper
+
+        store = Store(":memory:")
+        uuids = [self._zombie_run(store, f"z{i}") for i in range(4)]
+        reapers = [
+            ZombieReaper(store, owned=set, zombie_after=0.05,
+                         metrics=store.metrics,
+                         owns_run=lambda u, s=s: shard_index(u, 2) == s)
+            for s in (0, 1)
+        ]
+        time.sleep(0.1)
+        for r in reapers:
+            assert r.pass_once() == []  # strike one each, scoped
+            r._last_pass = float("-inf")
+        actions = [r.pass_once() for r in reapers]
+        reaped = [u for acts in actions for u, _ in acts]
+        # exactly-once across the fleet: every zombie reaped by exactly
+        # its shard's owner, none twice
+        assert sorted(reaped) == sorted(uuids)
+        for r, acts in zip(reapers, actions):
+            for u, _ in acts:
+                assert r.owns_run(u)
+        # the shared counter family agrees (scrape == audit trail)
+        text = store.metrics.render()
+        total = sum(
+            float(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("polyaxon_reaper_reaps_total"))
+        assert total == len(uuids)
+
+    def test_racing_reap_counts_nobody(self):
+        """A reap that lost a race (the run moved between the reaper's
+        LISTING and its strike) is counted by NOBODY: the transition's
+        changed=False result guards the counter. The stale listing is
+        pinned via the list_runs hook — exactly what a second agent's
+        concurrent terminal write looks like to a mid-pass reaper."""
+        from polyaxon_tpu.resilience.heartbeat import ZombieReaper
+
+        store = Store(":memory:")
+        uuid = self._zombie_run(store, "z")
+        stale_row = dict(store.get_run(uuid))  # snapshot: still 'running'
+        reaper = ZombieReaper(
+            store, owned=set, zombie_after=0.05, metrics=store.metrics,
+            list_runs=lambda status: (
+                [stale_row] if status == "running" else []))
+        time.sleep(0.1)
+        assert reaper.pass_once() == []  # strike one
+        # another writer (the run's own pod) finishes it first; the
+        # reaper's next pass still sees the stale listing and strikes
+        store.transition(uuid, "succeeded")
+        reaper._last_pass = float("-inf")
+        assert reaper.pass_once() == []  # reap attempted, lost, uncounted
+        assert store.get_run(uuid)["status"] == "succeeded"
+        for line in store.metrics.render().splitlines():
+            if line.startswith("polyaxon_reaper_reaps_total"):
+                assert line.endswith(" 0"), line
+
+
+class TestShardConfigAgreement:
+    def test_mismatched_num_shards_adopts_the_fleets_layout(self, tmp_path):
+        """Two agents hashing the run space with different K would BOTH
+        own some runs under VALID fences — duplicate launches the
+        per-shard fencing cannot catch. The first starter pins K in
+        control_config (first-writer-wins); a mismatched later starter
+        adopts it before probing for shards."""
+        from polyaxon_tpu.api.store import shard_lease_names
+
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".cluster"))
+        a1 = LocalAgent(store, str(tmp_path), backend="cluster",
+                        cluster=cluster, poll_interval=0.05,
+                        lease_ttl=2.0, num_shards=8).start()
+        a2 = LocalAgent(store, str(tmp_path), backend="cluster",
+                        cluster=cluster, poll_interval=0.05,
+                        lease_ttl=2.0, num_shards=16).start()
+        try:
+            assert store.get_config("num_shards") == "8"
+            assert a2.num_shards == 8
+            assert a2.shards == shard_lease_names(8)
+            # and the adopted layout is what it probes/acquires with
+            _wait(lambda: a2._shard_leases, timeout=15,
+                  msg="mismatched starter to join the 8-shard fleet")
+            assert set(a2._shard_leases) <= set(shard_lease_names(8))
+        finally:
+            a1.drain()
+            a2.stop()
+
+    def test_claim_config_is_first_writer_wins(self):
+        store = Store(":memory:")
+        assert store.claim_config("num_shards", "8") == "8"
+        assert store.claim_config("num_shards", "16") == "8"
+        assert store.get_config("num_shards") == "8"
+        assert store.get_config("missing") is None
+        # operator override (whole-fleet restart): set_config re-pins
+        store.set_config("num_shards", "16")
+        assert store.claim_config("num_shards", "4") == "16"
+
+
+class TestPresenceGC:
+    def test_probe_purges_dead_incarnations_presence_rows(self, tmp_path):
+        """Crashed incarnations never DELETE their self-named agent-*
+        presence row; the survivors' probes must GC the expired ones or
+        agent_leases grows by a row per crash forever."""
+        from polyaxon_tpu.api.store import AGENT_PREFIX
+
+        store = Store(":memory:")
+        for i in range(5):  # five crashed incarnations
+            store.acquire_lease(f"{AGENT_PREFIX}dead{i}", f"dead{i}",
+                                ttl=0.01)
+        time.sleep(0.05)
+        cluster = FakeCluster(str(tmp_path / ".cluster"))
+        agent = LocalAgent(store, str(tmp_path), backend="cluster",
+                           cluster=cluster, poll_interval=0.05,
+                           lease_ttl=2.0, num_shards=2).start()
+        try:
+            _wait(lambda: not [
+                r for r in store.list_leases(AGENT_PREFIX)
+                if r["holder"].startswith("dead")],
+                timeout=15, msg="probe to GC dead presence rows")
+            live = store.list_leases(AGENT_PREFIX)
+            assert [r["holder"] for r in live] == [agent._lease_id]
+        finally:
+            agent.stop()

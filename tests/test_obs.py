@@ -558,3 +558,120 @@ class TestScheduleLatencyConsistency:
         # computes) stays within the same bound of the exact reservoir p50
         bucket_p50 = r["metrics_hist_bucket_p50_s"]
         assert abs(bucket_p50 - hist_p50) <= 0.20 * hist_p50 + 0.02
+
+
+# -- per-shard labeled families (ISSUE 6 obs satellite) -----------------------
+
+
+class TestShardLabeledFamilies:
+    def test_sharded_agent_exports_per_shard_families(self, tmp_path):
+        """A sharded agent's scrape gains {shard=...} families — lease
+        state per work partition (store truth), queue depth and reserved
+        chips per owned shard, and pass activity per {shard, kind} — all
+        through the strict parser, like every contracted family."""
+        from polyaxon_tpu.api.store import shard_index
+        from polyaxon_tpu.operator import FakeCluster
+
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".cluster"))
+        agent = LocalAgent(store, str(tmp_path), backend="cluster",
+                           cluster=cluster, poll_interval=0.05,
+                           lease_ttl=5.0, num_shards=4).start()
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if len(agent._shard_leases) == 4:
+                    break
+                time.sleep(0.05)
+            assert len(agent._shard_leases) == 4
+            spec = {"kind": "operation", "name": "obs-shard",
+                    "component": {"kind": "component", "run": {
+                        "kind": "job", "container": {
+                            "command": [sys.executable, "-c", "pass"]}}}}
+            uuid = store.create_run("p", spec=spec, name="obs-shard")["uuid"]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if store.get_run(uuid)["status"] in ("succeeded", "failed"):
+                    break
+                time.sleep(0.05)
+            assert store.get_run(uuid)["status"] == "succeeded"
+            fams = parse_prometheus(store.metrics.render())
+            held = fams["polyaxon_agent_shard_lease_held"]
+            for i in range(4):
+                key = ('polyaxon_agent_shard_lease_held'
+                       f'{{shard="shard-{i}"}}')
+                assert held[key] == 1.0, held
+            # queue/chips gauges exist for every shard (quiet: all zero)
+            assert len(fams["polyaxon_agent_shard_queue_depth"]) == 4
+            assert len(fams["polyaxon_agent_shard_chips_in_use"]) == 4
+            # the run's shard recorded pass activity with a kind label
+            passes = fams["polyaxon_agent_shard_passes_total"]
+            shard = f"shard-{shard_index(uuid, 4)}"
+            assert any(f'shard="{shard}"' in key for key in passes), passes
+            assert all('kind="' in key for key in passes), passes
+        finally:
+            agent.stop()
+
+    def test_shard_lease_held_reads_store_truth_not_local_state(self,
+                                                                tmp_path):
+        """Any agent's scrape shows the WHOLE partition: a shard owned by
+        a different holder still reads 1 (held by a live agent), an
+        expired lease reads 0."""
+        from polyaxon_tpu.operator import FakeCluster
+
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".cluster"))
+        agent = LocalAgent(store, str(tmp_path), backend="cluster",
+                           cluster=cluster, poll_interval=0.2,
+                           lease_ttl=30.0, num_shards=2)
+        # not started: it holds nothing — another holder takes shard-0
+        store.acquire_lease("shard-0", "someone-else", ttl=30.0)
+        store.acquire_lease("shard-1", "flatliner", ttl=0.01)
+        time.sleep(0.05)
+        fams = parse_prometheus(store.metrics.render())
+        held = fams["polyaxon_agent_shard_lease_held"]
+        assert held['polyaxon_agent_shard_lease_held{shard="shard-0"}'] == 1.0
+        assert held['polyaxon_agent_shard_lease_held{shard="shard-1"}'] == 0.0
+
+    def test_stats_endpoint_serves_shard_ownership_table(self, tmp_path):
+        """GET /api/v1/stats grows the per-agent shard-ownership table:
+        every work-lease row plus {holder: [shards]} for the live owners
+        — expired (orphaned) shards appear in the rows but own nothing."""
+        srv = ApiServer(db_path=":memory:",
+                        artifacts_root=str(tmp_path / "a"), port=0,
+                        auth_token="sekret").start()
+        try:
+            srv.store.acquire_lease("shard-0", "agent-a", ttl=30.0)
+            srv.store.acquire_lease("shard-1", "agent-a", ttl=30.0)
+            srv.store.acquire_lease("shard-2", "agent-b", ttl=30.0)
+            srv.store.acquire_lease("shard-3", "gone", ttl=0.01)
+            # live-agent presence rows are fleet membership, not work
+            srv.store.acquire_lease("agent-deadbeef", "agent-a", ttl=30.0)
+            time.sleep(0.05)
+            data = AgentClient(srv.url, auth_token="sekret").stats()
+            names = [r["name"] for r in data["shards"]]
+            assert names == ["shard-0", "shard-1", "shard-2", "shard-3"]
+            assert "agent-deadbeef" not in names
+            owners = {h: sorted(s) for h, s in data["shard_owners"].items()}
+            assert owners == {"agent-a": ["shard-0", "shard-1"],
+                              "agent-b": ["shard-2"]}
+            expired = [r["name"] for r in data["shards"] if r["expired"]]
+            assert expired == ["shard-3"]
+        finally:
+            srv.stop()
+
+    def test_cli_status_prints_shard_ownership(self, tmp_path, monkeypatch):
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        (tmp_path / ".plx").mkdir()
+        store = Store(str(tmp_path / ".plx" / "db.sqlite"))
+        store.acquire_lease("shard-0", "aaaabbbbccccdddd", ttl=30.0)
+        store.acquire_lease("shard-1", "gone", ttl=0.01)
+        time.sleep(0.05)
+        monkeypatch.chdir(tmp_path)
+        r = CliRunner().invoke(cli, ["status"])
+        assert r.exit_code == 0, r.output
+        assert "agent aaaabbbbcccc: 1 shard(s) — shard-0" in r.output
+        assert "orphaned shards" in r.output and "shard-1" in r.output

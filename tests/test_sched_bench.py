@@ -51,6 +51,31 @@ class TestSchedBench:
             f"wake never matched poll throughput+p50 in "
             f"{len(attempts)} attempts: {attempts}")
 
+    def test_two_agents_beat_one_on_saturated_burst(self):
+        """Horizontal scaling smoke (ISSUE 6): the same capacity-saturated
+        wave of real-duration jobs, driven by 1 vs 2 shard-sharing agents
+        over one shared file-backed store. Each agent brings its own
+        executor slots, so the 2-agent fleet must complete more runs/min.
+        Scaled down + best-of-3 like the wake-vs-poll guard (perf smoke
+        on a shared box)."""
+        from sched_bench import run_mode, sleep_spec
+
+        attempts = []
+        for _ in range(3):
+            one = run_mode(16, "wake", 0.1, 3, agents=1, file_store=True,
+                           spec=sleep_spec(0.4), timeout=120)
+            two = run_mode(16, "wake", 0.1, 3, agents=2, file_store=True,
+                           spec=sleep_spec(0.4), timeout=120)
+            for r in (one, two):
+                assert r["completed"] == 16, r
+                assert r["failed"] == 0, r
+            attempts.append((one["runs_per_min"], two["runs_per_min"]))
+            if two["runs_per_min"] > one["runs_per_min"]:
+                return
+        raise AssertionError(
+            f"2 agents never beat 1 on runs/min in {len(attempts)} "
+            f"attempts: {attempts}")
+
     def test_poll_mode_detaches_change_feed(self):
         """use_change_feed=False must detach the SCHEDULING feed — no
         dirty tracking, no loop wakes, full scans every tick
