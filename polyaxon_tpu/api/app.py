@@ -47,8 +47,8 @@ def run_artifacts_dir(artifacts_root: str, project: str, uuid: str) -> str:
     return os.path.join(artifacts_root, project, uuid)
 
 
-def _json(data, status=200):
-    return web.json_response(data, status=status)
+def _json(data, status=200, headers=None):
+    return web.json_response(data, status=status, headers=headers)
 
 
 def _not_found(msg="not found"):
@@ -145,18 +145,30 @@ class ApiApp:
 
     @web.middleware
     async def _conflict_middleware(self, request, handler):
-        """Fencing conflicts surface as HTTP 409 (never retried by the
-        client RetryPolicy — the writer is stale, not the weather). Only
-        reachable when an embedder serves a write-fenced store; the plain
-        API's own writes are unfenced by design (clients are not lease
-        holders)."""
-        from .store import StaleLeaseError
+        """Store-state verdicts become their contracted HTTP answers
+        (docs/RESILIENCE.md "Store crash matrix"):
+
+        - fencing conflict -> 409 (the writer is stale — demote, never
+          retry; only reachable when an embedder serves a write-fenced
+          store: the plain API's own writes are unfenced by design)
+        - epoch fence -> 410 (the ``?since=`` cursor predates a failover —
+          the consumer must full-resync, never re-poll)
+        - read-only / disk-full degraded store -> 503 + Retry-After (the
+          client rotates to the next endpoint or waits; never a crash
+          loop)"""
+        from .store import StaleEpochError, StaleLeaseError, StoreReadOnlyError
 
         try:
             return await handler(request)
         except StaleLeaseError as e:
             return _json({"error": "stale lease", "detail": str(e)},
                          status=409)
+        except StaleEpochError as e:
+            return _json({"error": "stale epoch", "detail": str(e),
+                          "epoch": e.current}, status=410)
+        except StoreReadOnlyError as e:
+            return _json({"error": "store unavailable", "detail": str(e)},
+                         status=503, headers={"Retry-After": "2"})
 
     def run_dir(self, project: str, uuid: str) -> str:
         return run_artifacts_dir(self.artifacts_root, project, uuid)
@@ -176,6 +188,9 @@ class ApiApp:
         r.add_delete("/api/v1/tokens/{token_id}", self.revoke_token)
         r.add_get("/api/v1/projects/{project}", self.get_project)
         r.add_get("/api/v1/agent/lease", self.get_agent_lease)
+        r.add_get("/api/v1/store", self.get_store_status)
+        r.add_get("/api/v1/changelog", self.get_changelog)
+        r.add_get("/api/v1/store/snapshot", self.get_snapshot)
         r.add_post("/api/v1/{project}/runs", self.create_run)
         r.add_get("/api/v1/{project}/runs", self.list_runs)
         r.add_get("/api/v1/{project}/runs/{uuid}", self.get_run)
@@ -239,6 +254,13 @@ class ApiApp:
             "lease": lease,
             "shards": shards,
             "shard_owners": owners,
+            # store survivability state (ISSUE 7): which epoch this
+            # control plane is on and whether it is write-able right now
+            "store_state": {
+                "epoch": self.store.current_epoch(),
+                "read_only": bool(getattr(self.store, "read_only", False)),
+                "degraded": getattr(self.store, "degraded", None),
+            },
         })
 
     async def get_timeline(self, request):
@@ -262,6 +284,86 @@ class ApiApp:
         expired yet (``expired: true`` on the row when it has)."""
         name = request.query.get("name", "scheduler")
         return _json({"lease": self.store.get_lease(name)})
+
+    async def get_store_status(self, request):
+        """Store survivability state: epoch, committed seq, read-only /
+        degraded flags (admin-only by scoping, like /agent/lease)."""
+        span = {}
+        try:
+            span = self.store.changelog_span()
+        except Exception:
+            pass
+        return _json({
+            "epoch": self.store.current_epoch(),
+            "seq": self.store.current_seq(),
+            "changelog_seq": span.get("seq"),
+            "read_only": bool(getattr(self.store, "read_only", False)),
+            "degraded": getattr(self.store, "degraded", None),
+        })
+
+    async def get_changelog(self, request):
+        """Replication tail: commit-ordered changelog rows after ?after=
+        (admin-only — row deltas span every project). A standby server
+        polls this; docs/RESILIENCE.md 'Running a warm standby'."""
+        q = request.rel_url.query
+        from .store import CompactedLogError
+
+        try:
+            after = int(q.get("after", 0))
+            limit = min(int(q.get("limit", 500)), 2000)
+        except ValueError:
+            return _json({"error": "after/limit must be integers"},
+                         status=400)
+        span = self.store.changelog_span()
+        try:
+            rows = self.store.get_changelog(after, limit)
+        except CompactedLogError as e:
+            # the tailer's cursor predates the compaction floor: 410 so
+            # it re-bootstraps from the snapshot instead of silently
+            # skipping the pruned writes
+            return _json({"error": "changelog compacted",
+                          "detail": str(e), "floor": e.floor}, status=410)
+        return _json({"rows": rows,
+                      "seq": span["seq"], "epoch": span["epoch"]})
+
+    async def get_snapshot(self, request):
+        """Crash-consistent store snapshot (standby bootstrap): streams
+        snapshot.db with its sha256/seq/epoch manifest in headers."""
+        import shutil
+        import time as _time
+        import uuid as _uuid
+
+        # per-request dir: two concurrent bootstraps must not race one
+        # shared snapshot.db (headers from one body from the other);
+        # older request dirs are garbage-collected best-effort
+        root = os.path.join(self.artifacts_root, ".snapshots")
+        snap_dir = os.path.join(root, _uuid.uuid4().hex[:12])
+
+        def _make() -> dict:
+            os.makedirs(root, exist_ok=True)
+            for entry in os.listdir(root):
+                p = os.path.join(root, entry)
+                try:
+                    if _time.time() - os.path.getmtime(p) > 3600:
+                        shutil.rmtree(p, ignore_errors=True)
+                except OSError:
+                    pass
+            return self.store.snapshot(snap_dir)
+
+        # off the event loop: the backup+sha256 is O(whole DB), and
+        # stalling the loop for it would silence /api/v1/changelog long
+        # enough to trip an attached standby's promote-on-silence rule
+        manifest = await asyncio.get_event_loop().run_in_executor(
+            None, _make)
+        return web.FileResponse(
+            os.path.join(snap_dir, "snapshot.db"),
+            headers={
+                "X-Snapshot-Sha256": manifest["sha256"],
+                "X-Snapshot-Seq": str(manifest["seq"]),
+                "X-Snapshot-Epoch": str(manifest["epoch"]),
+                "X-Snapshot-Created-At": manifest["created_at"],
+                "Content-Type": "application/octet-stream",
+            })
 
     async def ui(self, request):
         from .ui import UI_HTML
@@ -404,14 +506,19 @@ class ApiApp:
             # consume rows but get no resume token back
             return _json({"error": "cursor and since are mutually "
                                    "exclusive"}, status=400)
-        if since is not None and not since.lstrip("-").isdigit():
+        if since is not None and not re.fullmatch(r"-?\d+(:-?\d+)?", since):
             return _json({"error": f"invalid since token {since!r} "
-                                   "(expected a change_seq int)"}, status=400)
+                                   "(expected a change_seq int, optionally "
+                                   "epoch-qualified as epoch:seq)"},
+                         status=400)
         # bootstrap token: the latest COMMITTED change_seq, read BEFORE the
         # SELECT — an in-flight writer's bump is invisible until its
         # commit, so its rows always sort after this token and the next
-        # delta poll delivers them (loss-free, at worst a duplicate)
-        server_time = str(self.store.current_seq())
+        # delta poll delivers them (loss-free, at worst a duplicate).
+        # Epoch-qualified (ISSUE 7): a token outliving a store failover is
+        # rejected with 410 instead of silently skipping the rows lost in
+        # the replication-lag window.
+        server_time = self.store.feed_token(self.store.current_seq())
         # fetch one extra row to learn whether a further page exists —
         # an exactly-full last page must not hand out a dangling cursor
         rows = self.store.list_runs(

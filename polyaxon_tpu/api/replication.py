@@ -1,0 +1,547 @@
+"""Store survivability (ISSUE 7): warm-standby replication + failover.
+
+The SQLite store behind the API was the control plane's last single point
+of failure — every guarantee (leases, fencing tokens, launch intents, the
+``?since=`` change feed) flowed through one file behind one process. This
+module closes that:
+
+- :class:`ReplicatedStandby` tails a primary's commit-ordered changelog
+  (``Store.get_changelog`` in-process, or :class:`HttpReplicationSource`
+  over the wire) into a read-only target store, optionally bootstrapping
+  from a sha256-manifested snapshot. It promotes the target — bumping the
+  store epoch, which fences out every pre-failover token and feed cursor —
+  either explicitly or on a lease-style liveness rule: the primary vouches
+  for itself by being pollable; ``promote_after`` seconds of silence is a
+  dead primary.
+- :class:`FailoverStore` is the store-verb twin of the client's
+  multi-endpoint rotation: an ordered list of store handles, rotating to
+  the next on :class:`StoreUnavailableError` (the ``kill_store()`` chaos
+  gate raises it; a real deployment's network client would too). The
+  agent plugs it in where a single ``Store`` went; everything above
+  (FencedStore, leases, resync) composes unchanged.
+
+Split-brain honesty (docs/RESILIENCE.md "Store crash matrix"): a
+partitioned-but-alive primary keeps accepting writes after the standby
+promotes. The epoch fence protects every *failed-over* writer (their new
+tokens/cursors bind them to the new primary), and clients reach endpoints
+in ORDER, so traffic converges on whichever endpoint answers first — but
+writes accepted by an isolated old primary after promotion are lost when
+it is retired. The operator contract is the usual one: fence the old host
+(kill it or partition it away from clients) before trusting the new
+history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+import traceback
+from typing import Any, Optional
+
+from .store import CompactedLogError, Store
+
+
+class StoreUnavailableError(ConnectionError):
+    """The store host is unreachable (process dead, network gone) — the
+    failover front rotates to the next endpoint on this, exactly like the
+    HTTP client rotates on a connection refusal."""
+
+
+class TornSnapshotError(ValueError):
+    """snapshot.db does not match its sha256 manifest (torn copy, partial
+    upload, bit rot) — restoring it would silently diverge; callers fall
+    back to an older snapshot or a full changelog tail."""
+
+
+# -- snapshots ---------------------------------------------------------------
+
+
+def verify_snapshot(dirpath: str) -> dict:
+    """Validate ``dirpath``'s snapshot against its manifest and return the
+    manifest. Raises :class:`TornSnapshotError` on any mismatch (missing
+    files count: a manifest without its payload IS a torn snapshot)."""
+    import hashlib
+
+    snap = os.path.join(dirpath, "snapshot.db")
+    man = os.path.join(dirpath, "manifest.json")
+    if not (os.path.isfile(snap) and os.path.isfile(man)):
+        raise TornSnapshotError(f"incomplete snapshot in {dirpath!r}")
+    with open(man, encoding="utf-8") as f:
+        manifest = json.load(f)
+    h = hashlib.sha256()
+    with open(snap, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    if h.hexdigest() != manifest.get("sha256"):
+        raise TornSnapshotError(
+            f"snapshot {snap!r} sha256 mismatch "
+            f"(manifest {manifest.get('sha256')!r}, file {h.hexdigest()!r})")
+    return manifest
+
+
+def restore_snapshot(dirpath: str, store: Store) -> dict:
+    """Load a verified snapshot INTO ``store`` (standby bootstrap) and
+    refresh the store's derived identity (epoch, applied changelog seq).
+    Returns the manifest. The target's prior contents are replaced."""
+    manifest = verify_snapshot(dirpath)
+    src = sqlite3.connect(os.path.join(dirpath, "snapshot.db"))
+    try:
+        with store._conn_ctx() as conn:
+            src.backup(conn)
+    finally:
+        src.close()
+    with store._conn_ctx() as conn:
+        row = conn.execute(
+            "SELECT v FROM counters WHERE k='store_epoch'").fetchone()
+        store._epoch = int(row[0]) if row else 0
+        row = conn.execute("SELECT MAX(seq) FROM changelog").fetchone()
+        store._applied_seq = int(row[0]) if row and row[0] else 0
+    return manifest
+
+
+# -- replication sources -----------------------------------------------------
+
+
+class HttpReplicationSource:
+    """Changelog/snapshot reads from a remote primary's API (``GET
+    /api/v1/changelog``, ``GET /api/v1/store/snapshot``) — what a standby
+    *server* tails when the primary is another host. Connection-level
+    failures surface as :class:`StoreUnavailableError`, which is the
+    standby's promote-on-silence signal."""
+
+    def __init__(self, url: str, auth_token: Optional[str] = None,
+                 timeout: float = 10.0):
+        import requests
+
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self._session = requests.Session()
+        token = auth_token if auth_token is not None \
+            else os.environ.get("PLX_AUTH_TOKEN")
+        if token:
+            self._session.headers["Authorization"] = f"Bearer {token}"
+        self._span = {"seq": 0, "epoch": 0}
+
+    def _get(self, path: str, ok_statuses: tuple = (), **kw: Any):
+        import requests
+
+        try:
+            resp = self._session.get(f"{self.url}{path}",
+                                     timeout=self.timeout, **kw)
+        except (requests.exceptions.ConnectionError,
+                requests.exceptions.Timeout) as e:
+            raise StoreUnavailableError(
+                f"primary {self.url} unreachable: {e}") from e
+        # ANY HTTP answer — 5xx included — is a LIVE primary (a 500 is
+        # SQLITE_BUSY weather behind the handler, not a corpse), so it
+        # must never feed the standby's promote-on-silence rule: only
+        # connection-level failures are. The cost is that a dead primary
+        # hidden behind an LB answering 502s needs a manual promotion —
+        # the safe direction; the alternative is a split brain every time
+        # the primary has a bad burst.
+        if resp.status_code in ok_statuses:
+            return resp
+        resp.raise_for_status()
+        return resp
+
+    def get_changelog(self, after_seq: int = 0,
+                      limit: int = 500) -> list[dict]:
+        resp = self._get("/api/v1/changelog",
+                         params={"after": after_seq, "limit": limit},
+                         ok_statuses=(410,))
+        if resp.status_code == 410:
+            body = resp.json()
+            raise CompactedLogError(int(after_seq),
+                                    int(body.get("floor", 0)))
+        doc = resp.json()
+        self._span = {"seq": doc["seq"], "epoch": doc["epoch"]}
+        return doc["rows"]
+
+    def changelog_span(self) -> dict:
+        return dict(self._span)
+
+    def fetch_snapshot(self, dest_dir: str) -> dict:
+        """Download the primary's snapshot + manifest into ``dest_dir``
+        (bootstrap for an empty standby). Streamed in chunks — the
+        snapshot is the whole DB, and buffering it in memory would OOM
+        exactly the large deployments failover exists for."""
+        resp = self._get("/api/v1/store/snapshot", stream=True)
+        os.makedirs(dest_dir, exist_ok=True)
+        tmp = os.path.join(dest_dir, ".snapshot.tmp")
+        with open(tmp, "wb") as f:
+            for chunk in resp.iter_content(1 << 20):
+                f.write(chunk)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(dest_dir, "snapshot.db"))
+        manifest = {
+            "sha256": resp.headers["X-Snapshot-Sha256"],
+            "seq": int(resp.headers["X-Snapshot-Seq"]),
+            "epoch": int(resp.headers["X-Snapshot-Epoch"]),
+            "created_at": resp.headers.get("X-Snapshot-Created-At"),
+        }
+        with open(os.path.join(dest_dir, "manifest.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(manifest, f)
+        return manifest
+
+
+# -- the warm standby --------------------------------------------------------
+
+
+class ReplicatedStandby:
+    """Tail a primary's changelog into a read-only target store; promote
+    the target when the primary dies.
+
+    ``promote_after`` is the lease-style store-primary rule: every
+    successful poll is a lease renewal by the primary; ``promote_after``
+    seconds without one means the lease expired and the standby takes
+    over. ``None`` keeps promotion manual (operator/harness calls
+    :meth:`promote`). The 2x-lease-TTL takeover bound the agent layer
+    already proves then stacks on top: store promotion at T, agent shard
+    re-acquisition within 2x agent TTL after that.
+    """
+
+    def __init__(self, source, target: Store, poll_interval: float = 0.1,
+                 promote_after: Optional[float] = None,
+                 snapshot_dir: Optional[str] = None, metrics=None):
+        self.source = source
+        self.target = target
+        self.poll_interval = poll_interval
+        self.promote_after = promote_after
+        self.snapshot_dir = snapshot_dir
+        target.set_read_only(True)
+        self.applied_seq = target._applied_seq
+        self.source_seq = self.applied_seq
+        self.healthy = True
+        self.promoted = False
+        self.epoch: Optional[int] = None
+        self._last_ok = time.monotonic()
+        self._compaction_warned = False
+        self._divergence_warned = False
+        self._error_warned = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        reg = metrics if metrics is not None else target.metrics
+        reg.gauge(
+            "polyaxon_store_replication_lag",
+            "Changelog rows the standby is behind the primary "
+            "(0 = caught up; frozen at the last observed span when the "
+            "primary is unreachable)",
+            value_fn=lambda: float(self.lag))
+        reg.gauge(
+            "polyaxon_store_replication_healthy",
+            "1 while the standby's last changelog poll succeeded",
+            value_fn=lambda: 1.0 if self.healthy else 0.0)
+
+    @property
+    def lag(self) -> int:
+        return max(self.source_seq - self.applied_seq, 0)
+
+    def bootstrap(self) -> Optional[dict]:
+        """Restore from ``snapshot_dir`` when the target is empty. A torn
+        snapshot is detected (sha256 manifest) and SKIPPED — the standby
+        falls back to tailing the full changelog from seq 0 rather than
+        restoring silently-divergent state."""
+        if not self.snapshot_dir or self.applied_seq > 0:
+            return None
+        try:
+            manifest = restore_snapshot(self.snapshot_dir, self.target)
+        except TornSnapshotError as e:
+            print(f"[standby] snapshot rejected ({e}); falling back to a "
+                  "full changelog tail", flush=True)
+            return None
+        self.applied_seq = self.target._applied_seq
+        self.source_seq = max(self.source_seq, self.applied_seq)
+        return manifest
+
+    def poll_once(self) -> int:
+        """One tail step: pull changelog rows after our applied watermark
+        and replay them. Returns rows applied; a source failure arms (and
+        eventually fires) the promote-on-silence rule."""
+        if self.promoted:
+            return 0
+        try:
+            total = 0
+            while True:
+                rows = self.source.get_changelog(self.applied_seq, limit=500)
+                # the primary ANSWERED: it is alive — stamp the liveness
+                # clock here, before the local apply, so a transient
+                # SQLITE_BUSY burst on the STANDBY's own write path can
+                # never masquerade as primary silence and self-promote
+                # into a split brain
+                self._last_ok = time.monotonic()
+                if not rows:
+                    break
+                if (min(r["epoch"] for r in rows)
+                        < self.target.current_epoch()):
+                    # the source's history is from an OLDER epoch than
+                    # this store: this store promoted past it (e.g. a
+                    # once-promoted standby re-attached to a rebuilt or
+                    # zombie primary). Their seq spaces have diverged —
+                    # applying would silently interleave two histories.
+                    self.healthy = False
+                    if not self._divergence_warned:
+                        self._divergence_warned = True
+                        print(
+                            "[standby] REFUSING to tail: the source's "
+                            f"changelog is at epoch "
+                            f"{min(r['epoch'] for r in rows)} but this "
+                            f"store is at epoch "
+                            f"{self.target.current_epoch()} — histories "
+                            "diverged; wipe this standby's db to "
+                            "re-attach it", flush=True)
+                    return 0
+                self.target.apply_changelog(rows)
+                self.applied_seq = max(self.applied_seq, rows[-1]["seq"])
+                total += len(rows)
+                if len(rows) < 500:
+                    break
+            span = self.source.changelog_span()
+            self.source_seq = max(span.get("seq", 0), self.applied_seq)
+            self.healthy = True
+            return total
+        except CompactedLogError as e:
+            # the primary is ALIVE but our cursor fell below its
+            # compaction floor: re-bootstrap territory, never promotion
+            # territory — and never a silent skip of the pruned rows
+            self._last_ok = time.monotonic()
+            self.healthy = False
+            if not self._compaction_warned:
+                self._compaction_warned = True
+                print(f"[standby] tail cursor compacted away ({e}); "
+                      "re-bootstrap this standby from the primary's "
+                      "snapshot", flush=True)
+            return 0
+        except ConnectionError:
+            # unreachable (StoreUnavailableError subclasses this): the
+            # ONLY failure class that counts toward primary silence
+            self.healthy = False
+            if (self.promote_after is not None and not self.promoted
+                    and time.monotonic() - self._last_ok
+                    >= self.promote_after):
+                self.promote(reason="primary silent past promote_after")
+            return 0
+        except Exception as e:
+            # the primary ANSWERED (4xx — e.g. a misconfigured auth
+            # token) or the fault is local (standby-side apply weather):
+            # either way the primary is not dead, and promoting off a
+            # config error would be a split brain with a healthy primary.
+            # Loud once: a standby silently replicating zero rows forever
+            # is an operator trap
+            self.healthy = False
+            self._last_ok = time.monotonic()
+            if not self._error_warned:
+                self._error_warned = True
+                print(f"[standby] replication erroring (source is alive, "
+                      f"so NOT promoting): {e!r} — check auth/config; "
+                      "this warning prints once", flush=True)
+            return 0
+
+    def promote(self, reason: str = "manual") -> int:
+        """Promote the target to primary (idempotent): epoch bump + lease
+        wipe in one transaction, read-only lifted, tailing stopped."""
+        with self._lock:
+            if not self.promoted:
+                # epoch bump + read-only lift FIRST: ``promoted`` is the
+                # flag harnesses/operators wait on, so it must only flip
+                # once the target actually serves writes
+                self.epoch = self.target.promote()
+                self.promoted = True
+                print(f"[standby] PROMOTED to primary at epoch "
+                      f"{self.epoch} ({reason}; applied seq "
+                      f"{self.applied_seq}, last known primary seq "
+                      f"{self.source_seq})", flush=True)
+        return self.epoch
+
+    def start(self) -> "ReplicatedStandby":
+        def _loop():
+            while not self._stop.wait(self.poll_interval):
+                if self.promoted:
+                    return
+                try:
+                    self.poll_once()
+                except Exception:
+                    traceback.print_exc()
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="plx-standby-tail")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+
+# -- the failover front ------------------------------------------------------
+
+
+class FailoverStore:
+    """An ordered list of store handles behind one store-shaped surface —
+    the in-process twin of the client's multi-endpoint rotation.
+
+    Every verb goes to the CURRENT handle; :class:`StoreUnavailableError`
+    (or any :class:`ConnectionError`) rotates to the next and retries the
+    call there, once per handle per call. Sticky: the rotation survives
+    the call, so after a failover every caller is already pointed at the
+    survivor. Deliberately NOT rotated on: transient sqlite weather
+    (``database is locked`` — same host, retrying there is correct),
+    fencing 409s / epoch 410s (terminal verdicts — identical on every
+    replica), and a standby's read-only 503 (the primary is dead and the
+    standby hasn't promoted yet: the caller must wait, not bounce).
+
+    Transition listeners register on EVERY handle — the agent's change
+    feed must keep waking it from whichever store is committing."""
+
+    def __init__(self, stores: list, on_failover=None):
+        if not stores:
+            raise ValueError("FailoverStore needs at least one store")
+        self._stores = list(stores)
+        self._idx = 0
+        self._rot_lock = threading.Lock()
+        self._on_failover = on_failover
+
+    @property
+    def current(self):
+        return self._stores[self._idx]
+
+    @property
+    def endpoints(self) -> list:
+        return list(self._stores)
+
+    def add_transition_listener(self, fn) -> None:
+        for s in self._stores:
+            s.add_transition_listener(fn)
+
+    def _rotate(self, from_idx: int) -> None:
+        with self._rot_lock:
+            if self._idx == from_idx:
+                self._idx = (from_idx + 1) % len(self._stores)
+                if self._on_failover is not None:
+                    try:
+                        self._on_failover(self._idx)
+                    except Exception:
+                        traceback.print_exc()
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self.current, name)
+        if not callable(attr):
+            return attr
+
+        def _call(*a: Any, _name=name, **kw: Any) -> Any:
+            last: Optional[BaseException] = None
+            for _ in range(len(self._stores)):
+                idx = self._idx
+                try:
+                    return getattr(self._stores[idx], _name)(*a, **kw)
+                except (StoreUnavailableError, ConnectionError) as e:
+                    last = e
+                    self._rotate(idx)
+            raise last  # every endpoint unreachable: surface the weather
+
+        _call.__name__ = name
+        return _call
+
+
+class ChangelogCompactor:
+    """Periodic snapshot + changelog prune for a long-lived store
+    (``snapshot_to`` on a timer): without it the changelog — one row per
+    write, including heartbeats — grows without bound. Runs in every
+    server deployment by default (``--compact-every``); each cycle also
+    refreshes an on-disk snapshot standbys can bootstrap from. Safe on a
+    demoted standby too (its own changelog mirror grows identically, and
+    nothing tails a standby)."""
+
+    def __init__(self, store: Store, dirpath: str,
+                 interval: float = 900.0, keep: int = 10_000):
+        self.store = store
+        self.dirpath = dirpath
+        self.interval = interval
+        self.keep = keep
+        self.cycles = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def compact_once(self) -> dict:
+        manifest = snapshot_to(self.store, self.dirpath, keep=self.keep)
+        self.cycles += 1
+        return manifest
+
+    def start(self) -> "ChangelogCompactor":
+        def _loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.compact_once()
+                except Exception:
+                    traceback.print_exc()
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="plx-changelog-compactor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+
+def make_standby(source_url: str, store: Store, data_dir: str,
+                 promote_after: Optional[float] = None,
+                 poll_interval: float = 0.5,
+                 auth_token: Optional[str] = None) -> ReplicatedStandby:
+    """One-call wiring for a warm-standby server process (the
+    ``--standby-of`` flag in both ``polyaxon server`` and
+    ``python -m polyaxon_tpu.api``): build the HTTP replication source,
+    bootstrap an EMPTY local store from the primary's snapshot (a torn or
+    unfetchable snapshot degrades to a full changelog tail, loudly), and
+    return the standby — unstarted, so the caller controls the thread."""
+    source = HttpReplicationSource(source_url, auth_token=auth_token)
+    snap_dir = os.path.join(data_dir, ".standby-snapshot")
+    standby = ReplicatedStandby(source, store, poll_interval=poll_interval,
+                                promote_after=promote_after,
+                                snapshot_dir=snap_dir)
+    if store.current_seq() == 0:
+        try:
+            source.fetch_snapshot(snap_dir)
+            standby.bootstrap()
+        except Exception as e:
+            print(f"[standby] snapshot bootstrap skipped ({e}); tailing "
+                  "the full changelog", flush=True)
+    return standby
+
+
+def snapshot_to(store: Store, dirpath: str,
+                keep: int = 10_000) -> dict:
+    """Write a snapshot of ``store`` into ``dirpath`` and prune the
+    changelog below the snapshot's seq minus a ``keep``-row safety margin
+    — the compaction loop a long-lived primary runs so the changelog
+    stays bounded. The pruned floor is RECORDED in the store: a standby
+    whose tail cursor falls below it gets a loud
+    :class:`~polyaxon_tpu.api.store.CompactedLogError` (re-bootstrap from
+    the snapshot) instead of silently skipping the pruned writes. The
+    default margin covers any standby within ~10k rows of the head;
+    ``keep < 0`` disables pruning (snapshot only)."""
+    manifest = store.snapshot(dirpath)
+    if keep >= 0:
+        floor = manifest["seq"] - keep
+        if floor > 0:
+            with store._conn_ctx() as conn:
+                conn.execute("DELETE FROM changelog WHERE seq<=?", (floor,))
+                conn.execute(
+                    "UPDATE counters SET v=MAX(v, ?) "
+                    "WHERE k='changelog_floor'", (floor,))
+    return manifest
+
+
+__all__ = [
+    "ChangelogCompactor", "FailoverStore", "HttpReplicationSource",
+    "ReplicatedStandby", "StoreUnavailableError", "TornSnapshotError",
+    "make_standby", "restore_snapshot", "snapshot_to", "verify_snapshot",
+]

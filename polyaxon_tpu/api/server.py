@@ -94,10 +94,45 @@ def main() -> None:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--db", default=".plx/db.sqlite")
     p.add_argument("--artifacts-root", default=".plx/artifacts")
+    p.add_argument("--standby-of", default=None, metavar="URL",
+                   help="run as a warm standby of the primary API at URL: "
+                        "serve reads while tailing its changelog (writes "
+                        "answer 503), bootstrap from its snapshot when the "
+                        "local db is empty, and promote when the primary "
+                        "goes silent (docs/RESILIENCE.md)")
+    p.add_argument("--promote-after", type=float, default=10.0,
+                   help="with --standby-of: seconds of primary silence "
+                        "before self-promotion; <=0 keeps promotion manual")
+    p.add_argument("--replication-poll", type=float, default=0.5,
+                   help="with --standby-of: changelog tail interval (s)")
+    p.add_argument("--compact-every", type=float, default=900.0,
+                   help="changelog compaction interval (snapshot + prune, "
+                        "keeping a 10k-row tail margin); <=0 disables — "
+                        "the changelog then grows one row per write")
     args = p.parse_args()
+    import os as _os
+
     server = ApiServer(args.db, args.artifacts_root, args.host, args.port)
+    data_dir = _os.path.dirname(args.db) or "."
+    standby = None
+    if args.standby_of:
+        from .replication import make_standby
+
+        standby = make_standby(
+            args.standby_of, server.store, data_dir,
+            promote_after=(args.promote_after
+                           if args.promote_after > 0 else None),
+            poll_interval=args.replication_poll).start()
+    compactor = None
+    if args.compact_every > 0:
+        from .replication import ChangelogCompactor
+
+        compactor = ChangelogCompactor(
+            server.store, _os.path.join(data_dir, ".snapshots"),
+            interval=args.compact_every).start()
     server.start()
-    print(f"polyaxon_tpu API listening on {server.url}")
+    role = (f"warm standby of {args.standby_of}" if standby else "primary")
+    print(f"polyaxon_tpu API listening on {server.url} ({role})")
 
     # graceful SIGTERM (ISSUE 4 satellite): finish in-flight requests via
     # AppRunner.cleanup (aiohttp drains open handlers), then exit 0
@@ -106,13 +141,20 @@ def main() -> None:
 
     drain = _threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: drain.set())
+    def _teardown():
+        if compactor is not None:
+            compactor.stop()
+        if standby is not None:
+            standby.stop()
+        server.stop()
+
     try:
         while not drain.wait(timeout=3600):
             pass
         print("SIGTERM: draining API server")
-        server.stop()
+        _teardown()
     except KeyboardInterrupt:
-        server.stop()
+        _teardown()
 
 
 if __name__ == "__main__":

@@ -6,6 +6,7 @@ scheduler threads)."""
 from __future__ import annotations
 
 import datetime
+import errno
 import json
 import sqlite3
 import threading
@@ -123,6 +124,23 @@ CREATE TABLE IF NOT EXISTS control_config (
     key TEXT PRIMARY KEY,
     value TEXT NOT NULL
 );
+-- store survivability (docs/RESILIENCE.md "Store crash matrix"): a
+-- durable, commit-ordered changelog of every replicated write. seq rides
+-- the SAME change_seq counter the ?since= feed uses (bumped inside the
+-- write transaction under the writer lock), so changelog order == commit
+-- order and a standby tailing it can never observe rows out of order or
+-- lose one to a stamp-before-commit race. agent_leases are deliberately
+-- NOT replicated: promotion bumps the store epoch, which folds into
+-- every fencing token, so pre-failover leases die with the primary.
+CREATE TABLE IF NOT EXISTS changelog (
+    seq INTEGER PRIMARY KEY,
+    epoch INTEGER NOT NULL,
+    op TEXT NOT NULL,
+    payload TEXT NOT NULL,
+    created_at TEXT NOT NULL
+);
+INSERT OR IGNORE INTO counters (k, v) VALUES ('store_epoch', 0);
+INSERT OR IGNORE INTO counters (k, v) VALUES ('changelog_floor', 0);
 """
 
 
@@ -174,6 +192,75 @@ class StaleLeaseError(RuntimeError):
             f"(current: {current})")
 
 
+# Fencing tokens fold the store epoch into their high bits: promotion
+# (store failover) bumps the epoch, so every token minted by the NEW
+# primary is strictly greater than — and can never collide with — any
+# token the dead primary handed out, even ones minted after the last
+# replicated changelog row. Epoch 0 tokens are the bare counter (the
+# pre-failover deployments' values, byte-compatible).
+EPOCH_STRIDE = 1 << 40
+
+
+def token_epoch(token: int) -> int:
+    """The store epoch a fencing token was minted under."""
+    return int(token) // EPOCH_STRIDE
+
+
+class StoreReadOnlyError(RuntimeError):
+    """The store refuses writes: it is a demoted standby (serving reads
+    while it tails the primary's changelog). The API surfaces this as
+    HTTP 503 with Retry-After — clients rotate to the next endpoint."""
+
+    status = 503
+
+
+class StoreDegradedError(StoreReadOnlyError):
+    """The store flipped to read-only degraded mode after a full-disk
+    write failure (SQLITE_FULL / ENOSPC) instead of crash-looping; a
+    rate-limited recovery probe flips it back once writes succeed."""
+
+
+class CompactedLogError(ValueError):
+    """A changelog tail asked for rows at or below the compaction floor
+    (pruned by ``snapshot_to``): the range no longer exists, and serving
+    only the surviving rows would silently skip the pruned writes. The
+    consumer must re-bootstrap from a snapshot."""
+
+    def __init__(self, after_seq: int, floor: int):
+        self.after_seq = after_seq
+        self.floor = floor
+        super().__init__(
+            f"changelog rows after seq {after_seq} were compacted away "
+            f"(floor: {floor}); re-bootstrap from a snapshot")
+
+
+class StaleEpochError(ValueError):
+    """A ``?since=`` feed token (or any epoch-qualified cursor) was
+    minted under an OLDER store epoch — the primary it came from is gone
+    and the consumer's incremental state may silently diverge from the
+    promoted standby (replication lag at the moment of death). Surfaced
+    as HTTP 410: the consumer must full-resync (the same
+    ``cold_start_resync`` path an agent takeover uses)."""
+
+    status = 410
+
+    def __init__(self, token_epoch: int, current: int):
+        self.token_epoch = token_epoch
+        self.current = current
+        super().__init__(
+            f"feed token from store epoch {token_epoch} is stale "
+            f"(current epoch: {current}); full resync required")
+
+
+def _is_disk_full(exc: BaseException) -> bool:
+    """SQLITE_FULL / ENOSPC signature — the one storage failure that is
+    NOT transient weather and must flip degraded mode, not crash-loop."""
+    if isinstance(exc, OSError) and getattr(exc, "errno", None) == errno.ENOSPC:
+        return True
+    return (isinstance(exc, sqlite3.OperationalError)
+            and "disk is full" in str(exc))
+
+
 def _now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
 
@@ -182,7 +269,8 @@ class Store:
     """Thread-safe SQLite store. One connection per thread (sqlite3
     check_same_thread), WAL so readers never block the writer."""
 
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:", metrics=None,
+                 replicate: bool = True):
         self.path = path
         self._local = threading.local()
         # serializes status transitions (read-check-insert-update must be
@@ -194,25 +282,72 @@ class Store:
         # scheduling pass must stay O(dirty) on both (tests/test_runtime_
         # agent.py asserts it), so the counters are part of the contract.
         self.stats = {"transactions": 0, "runs_deserialized": 0,
-                      "fence_rejections": 0, "launch_intents": 0}
+                      "fence_rejections": 0, "launch_intents": 0,
+                      "epoch_fence_rejections": 0}
+        # store survivability (ISSUE 7): ``replicate`` keeps the
+        # commit-ordered changelog every write appends to (a standby tails
+        # it); ``_read_only`` is the demoted-standby write gate;
+        # ``_degraded`` is the disk-full read-only mode with its
+        # rate-limited recovery probe. Replication defaults ON for every
+        # store — including short-lived CLI embedders — because a db file
+        # with changelog GAPS is a trap: a server later opened on the same
+        # .plx db would offer a tail that silently misses the gap's rows.
+        # Growth is bounded by compaction (``snapshot_to`` /
+        # ``ChangelogCompactor``; the server runs it via --compact-every),
+        # and the floor it records turns any pruned-past tail into a loud
+        # 410 instead of divergence. ``replicate=False`` is for stores
+        # whose db will NEVER serve a tail (pure benchmarks).
+        self._replicate = replicate
+        self._read_only = False
+        self._degraded: Optional[str] = None
+        self._degraded_probe_at = 0.0
+        self.degraded_probe_interval = 5.0
+        self._disk_full_injected = 0  # chaos hook budget
+        self._epoch = 0       # re-read from counters after schema init
+        self._applied_seq = 0
         # observability (ISSUE 5): the store is the hub every component
         # already shares, so its registry is the process's one pane of
         # glass — the agent/reaper/reconciler register their series here
         # and `GET /metrics` renders it. Counters export the existing
-        # ``stats`` dict via callbacks (no double bookkeeping).
+        # ``stats`` dict via callbacks (no double bookkeeping). A shared
+        # registry may be passed in (ISSUE 7: primary + standby export one
+        # continuous pane across a failover).
         from ..obs.metrics import MetricsRegistry
 
-        self.metrics = MetricsRegistry()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # every Store sharing this registry contributes to ONE set of
+        # families (counters SUM, epoch/degraded take the max/any view):
+        # with last-writer-wins callbacks the primary's pre-failover
+        # counts would vanish from the scrape the moment the standby
+        # registered — the opposite of "one continuous pane"
+        peers = getattr(self.metrics, "_store_sources", None)
+        if peers is None:
+            peers = []
+            self.metrics._store_sources = peers
+        peers.append(self)
         for stat, help_txt in (
             ("transactions", "Store transactions opened"),
             ("runs_deserialized", "Run rows deserialized from the store"),
             ("fence_rejections",
              "Fenced writes rejected for a stale lease token"),
             ("launch_intents", "Write-ahead launch intents recorded"),
+            ("epoch_fence_rejections",
+             "Fenced writes rejected because their token predates the "
+             "store epoch (a write from before a failover)"),
         ):
             self.metrics.counter(
                 f"polyaxon_store_{stat}_total", help_txt,
-                value_fn=(lambda s=stat: self.stats[s]))
+                value_fn=(lambda s=stat, p=peers:
+                          sum(st.stats[s] for st in p)))
+        self.metrics.gauge(
+            "polyaxon_store_epoch",
+            "Store epoch (bumped by every standby promotion)",
+            value_fn=lambda p=peers: float(max(st._epoch for st in p)))
+        self.metrics.gauge(
+            "polyaxon_store_degraded",
+            "1 while the store is in disk-full read-only degraded mode",
+            value_fn=lambda p=peers: 1.0 if any(
+                st._degraded is not None for st in p) else 0.0)
         self._h_write = self.metrics.histogram(
             "polyaxon_store_write_seconds",
             "Latency of lifecycle write transactions "
@@ -247,6 +382,12 @@ class Store:
                     "WHERE k='change_seq'")
             conn.execute("CREATE INDEX IF NOT EXISTS idx_runs_change_seq "
                          "ON runs (change_seq)")
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                "SELECT v FROM counters WHERE k='store_epoch'").fetchone()
+            self._epoch = int(row[0]) if row else 0
+            row = conn.execute("SELECT MAX(seq) FROM changelog").fetchone()
+            self._applied_seq = int(row[0]) if row and row[0] else 0
 
     # -- connection plumbing ----------------------------------------------
 
@@ -256,6 +397,14 @@ class Store:
         class _Ctx:
             def __enter__(self):
                 store.stats["transactions"] += 1
+                if store._disk_full_injected > 0:
+                    # chaos hook (disk_full()): fail like SQLITE_FULL would,
+                    # through the same detection path a real full disk hits
+                    store._disk_full_injected -= 1
+                    exc = sqlite3.OperationalError(
+                        "database or disk is full (chaos: injected)")
+                    store._mark_degraded(exc)
+                    raise exc
                 if store._memory_conn is not None:
                     store._memory_lock.acquire()
                     return store._memory_conn
@@ -270,35 +419,120 @@ class Store:
                     store._local.conn = conn
                 return conn
 
+            @staticmethod
+            def _commit(conn):
+                try:
+                    conn.commit()
+                except BaseException as e:
+                    # a full disk at COMMIT time flips degraded mode too —
+                    # the commit is the fsync that actually needs the space
+                    if _is_disk_full(e):
+                        store._mark_degraded(e)
+                    try:
+                        conn.rollback()
+                    except Exception:
+                        pass
+                    raise
+
             def __exit__(self, et, ev, tb):
                 # rollback on error, ALWAYS: python sqlite3 leaves the
                 # implicit transaction open otherwise — a half-applied
                 # write would hold the writer lock and get silently flushed
                 # by the next unrelated commit on this connection
+                if ev is not None and _is_disk_full(ev):
+                    # SQLITE_FULL from any statement in the body: degrade
+                    # to read-only instead of crash-looping the API
+                    store._mark_degraded(ev)
                 if store._memory_conn is not None:
                     try:
                         if et is None:
-                            store._memory_conn.commit()
+                            self._commit(store._memory_conn)
                         else:
                             store._memory_conn.rollback()
                     finally:
                         store._memory_lock.release()
                 else:
                     if et is None:
-                        store._local.conn.commit()
+                        self._commit(store._local.conn)
                     else:
                         store._local.conn.rollback()
 
         return _Ctx()
 
+    # -- degraded / read-only write gates (ISSUE 7) ------------------------
+
+    def _mark_degraded(self, exc: BaseException) -> None:
+        self._degraded = str(exc)
+        self._degraded_probe_at = (time.monotonic()
+                                   + self.degraded_probe_interval)
+
+    def _check_writable(self) -> None:
+        """Gate every mutating verb. Degraded (disk full) raises 503 after
+        a rate-limited self-probe; a demoted standby raises 503 until
+        promotion. Reads are never gated — degraded mode is read-ONLY, not
+        down, and a standby serves reads by design."""
+        if self._degraded is not None:
+            if time.monotonic() >= self._degraded_probe_at:
+                self.probe_recovery()
+            if self._degraded is not None:
+                raise StoreDegradedError(
+                    f"store is degraded (read-only): {self._degraded}")
+        if self._read_only:
+            raise StoreReadOnlyError(
+                "store is a demoted standby (read-only); writes resume "
+                "after promotion")
+
+    def probe_recovery(self) -> bool:
+        """One recovery probe out of disk-full degraded mode: attempt a
+        tiny real write; success clears the flag (space was freed), failure
+        re-arms the probe timer. Called automatically (rate-limited) by the
+        write gate, and callable by operators/tests directly."""
+        self._degraded_probe_at = (time.monotonic()
+                                   + self.degraded_probe_interval)
+        try:
+            with self._conn_ctx() as conn:
+                conn.execute(
+                    "INSERT OR REPLACE INTO control_config (key, value) "
+                    "VALUES ('_degraded_probe', ?)", (_now(),))
+        except Exception:
+            return False
+        self._degraded = None
+        return True
+
+    def chaos_disk_full(self, n: int = 1) -> None:
+        """Chaos hook (``disk_full()`` in the soak harness): the next ``n``
+        transactions fail with the SQLITE_FULL signature, exercising the
+        degraded-mode flip end to end."""
+        self._disk_full_injected += int(n)
+
+    def set_read_only(self, flag: bool) -> None:
+        """Demote (True: standby mode — writes 503, reads serve) or lift.
+        :meth:`promote` lifts it too, atomically with the epoch bump."""
+        self._read_only = bool(flag)
+
+    @property
+    def read_only(self) -> bool:
+        return self._read_only
+
+    @property
+    def degraded(self) -> Optional[str]:
+        """The degradation reason while in disk-full read-only mode."""
+        return self._degraded
+
     # -- projects ----------------------------------------------------------
 
     def create_project(self, name: str, description: Optional[str] = None) -> dict:
+        self._check_writable()
         with self._conn_ctx() as conn:
-            conn.execute(
+            now = _now()
+            cur = conn.execute(
                 "INSERT OR IGNORE INTO projects (name, description, created_at) VALUES (?,?,?)",
-                (name, description, _now()),
+                (name, description, now),
             )
+            if cur.rowcount > 0:
+                self._log_change(conn, "project", {
+                    "name": name, "description": description,
+                    "created_at": now})
         return self.get_project(name)
 
     def get_project(self, name: str) -> Optional[dict]:
@@ -333,13 +567,20 @@ class Store:
         import secrets
 
         raw = secrets.token_hex(24)
+        self._check_writable()
         with self._conn_ctx() as conn:
+            now = _now()
             cur = conn.execute(
                 "INSERT INTO tokens (token_hash, project, label, created_at) "
                 "VALUES (?,?,?,?)",
-                (self._token_hash(raw), project, label, _now()),
+                (self._token_hash(raw), project, label, now),
             )
             tid = cur.lastrowid
+            # only the hash replicates — the raw token never lands in the
+            # changelog any more than it lands in the primary's table
+            self._log_change(conn, "token", {
+                "id": tid, "token_hash": self._token_hash(raw),
+                "project": project, "label": label, "created_at": now})
         return {"id": tid, "token": raw, "project": project, "label": label}
 
     def resolve_token(self, raw: str) -> Optional[dict]:
@@ -364,9 +605,12 @@ class Store:
                  "created_at": r[3], "revoked": bool(r[4])} for r in rows]
 
     def revoke_token(self, token_id: int) -> bool:
+        self._check_writable()
         with self._conn_ctx() as conn:
             cur = conn.execute(
                 "UPDATE tokens SET revoked=1 WHERE id=?", (token_id,))
+            if cur.rowcount > 0:
+                self._log_change(conn, "token_revoke", {"id": token_id})
             return cur.rowcount > 0
 
     def has_tokens(self) -> bool:
@@ -411,7 +655,13 @@ class Store:
         monotonic fencing token — including self-reacquisition, so a
         holder that lost track of time gets a NEW token and its old one
         dies. Returns the lease dict, or None while another holder's
-        lease is live."""
+        lease is live.
+
+        Tokens are epoch-strided (``epoch * EPOCH_STRIDE + counter``):
+        a promoted standby mints tokens strictly greater than — and never
+        colliding with — anything the dead primary handed out, including
+        acquisitions its changelog never replicated."""
+        self._check_writable()
         with self._transition_lock:
             with self._conn_ctx() as conn:
                 # liveness check and token bump must be ONE unit across
@@ -431,6 +681,7 @@ class Store:
                 conn.execute("UPDATE counters SET v=v+1 WHERE k=?", (key,))
                 token = conn.execute(
                     "SELECT v FROM counters WHERE k=?", (key,)).fetchone()[0]
+                token += self._epoch * EPOCH_STRIDE
                 now = _now()
                 conn.execute(
                     "INSERT OR REPLACE INTO agent_leases "
@@ -452,6 +703,7 @@ class Store:
         is ``(name, token)``; returns per-entry success — False means
         that lease has a newer acquisition (or was released) and the
         holder must demote itself FOR THAT SHARD ONLY."""
+        self._check_writable()
         out: list[bool] = []
         with self._conn_ctx() as conn:
             now = _now()
@@ -468,6 +720,7 @@ class Store:
         instantly instead of waiting out the TTL. Only the current
         (holder, token) may release; the token counter survives, so the
         next acquisition still gets a strictly newer token."""
+        self._check_writable()
         with self._conn_ctx() as conn:
             cur = conn.execute(
                 "DELETE FROM agent_leases "
@@ -488,13 +741,19 @@ class Store:
         value — every later claimant must conform to it. Backs the
         num_shards agreement check (a fleet hashing the run space with
         two different K values double-owns runs under valid fences)."""
+        self._check_writable()
         with self._conn_ctx() as conn:
-            conn.execute(
+            cur = conn.execute(
                 "INSERT OR IGNORE INTO control_config (key, value) "
                 "VALUES (?, ?)", (key, str(value)))
             row = conn.execute(
                 "SELECT value FROM control_config WHERE key=?",
                 (key,)).fetchone()
+            if cur.rowcount > 0:
+                # only the WINNING claim replicates: the fleet's agreed
+                # value must survive a failover
+                self._log_change(conn, "config",
+                                 {"key": key, "value": row[0]})
         return row[0]
 
     def get_config(self, key: str) -> Optional[str]:
@@ -508,10 +767,12 @@ class Store:
         """Operator override of a pinned fleet setting (e.g. resizing the
         shard partition): stop the WHOLE fleet first — agents adopt the
         pinned value only at start(), and a mixed fleet double-owns runs."""
+        self._check_writable()
         with self._conn_ctx() as conn:
             conn.execute(
                 "INSERT OR REPLACE INTO control_config (key, value) "
                 "VALUES (?, ?)", (key, str(value)))
+            self._log_change(conn, "config", {"key": key, "value": str(value)})
 
     def list_leases(self, prefix: Optional[str] = None) -> list[dict]:
         """Every lease row (optionally name-prefixed: ``shard-`` for the
@@ -552,6 +813,14 @@ class Store:
         current = row[0] if row else None
         if current != token:
             self.stats["fence_rejections"] += 1
+            if (token is not None and token >= 0
+                    and token_epoch(token) < self._epoch):
+                # a real minted token from an OLDER store epoch: a write
+                # from before a failover — the class of rejection the
+                # store-outage soak asserts happened at least once.
+                # (token >= 0 excludes the agents' poison fences, whose
+                # sentinel -1 was never minted by any epoch.)
+                self.stats["epoch_fence_rejections"] += 1
             # per-lease rejection family (lazy get-or-create): the sharded
             # soak asserts that a specific SHARD's stale owner was fenced,
             # not just that some rejection happened somewhere
@@ -574,6 +843,7 @@ class Store:
         state='intent' with no pods: the successor relaunches. A crash
         after :meth:`mark_launched` leaves state='launched': the successor
         adopts the live pods instead of creating a second set."""
+        self._check_writable()
         with self._transition_lock:
             with self._conn_ctx() as conn:
                 self._check_fence(conn, fence)
@@ -588,6 +858,11 @@ class Store:
                     "created_at, updated_at) VALUES (?,?,?,?,?,?,?,?)",
                     (run_uuid, lease_name, lease_holder, token, attempt,
                      "intent", now, now))
+                self._log_change(conn, "intent", {
+                    "run_uuid": run_uuid, "lease_name": lease_name,
+                    "lease_holder": lease_holder, "token": token,
+                    "attempt": attempt, "state": "intent",
+                    "created_at": now, "updated_at": now})
                 self._stamp_owner(conn, run_uuid, lease_holder, token, attempt)
                 self.stats["launch_intents"] += 1
         return {"run_uuid": run_uuid, "attempt": attempt, "state": "intent",
@@ -596,17 +871,20 @@ class Store:
     def mark_launched(self, run_uuid: str, fence=None) -> None:
         """Flip the intent to state='launched' AFTER the cluster accepted
         every manifest — the pods exist now; a successor must adopt."""
+        self._check_writable()
         with self._conn_ctx() as conn:
             self._check_fence(conn, fence)
             conn.execute(
                 "UPDATE launch_intents SET state='launched', updated_at=? "
                 "WHERE run_uuid=?", (_now(), run_uuid))
+            self._log_intent_row(conn, run_uuid)
 
     def adopt_launch(self, run_uuid: str, lease_holder: Optional[str],
                      token: Optional[int], fence=None) -> None:
         """Re-own a live pod set after an agent restart: update the intent
         row and meta.owner to the NEW lease without bumping the attempt
         counter — adoption is not a launch."""
+        self._check_writable()
         with self._transition_lock:
             with self._conn_ctx() as conn:
                 self._check_fence(conn, fence)
@@ -620,7 +898,24 @@ class Store:
                     "lease_name, lease_holder, token, attempt, state, "
                     "created_at, updated_at) VALUES (?,?,?,?,?,'launched',?,?)",
                     (run_uuid, None, lease_holder, token, attempt, now, now))
+                self._log_change(conn, "intent", {
+                    "run_uuid": run_uuid, "lease_name": None,
+                    "lease_holder": lease_holder, "token": token,
+                    "attempt": attempt, "state": "launched",
+                    "created_at": now, "updated_at": now})
                 self._stamp_owner(conn, run_uuid, lease_holder, token, attempt)
+
+    def _log_intent_row(self, conn, run_uuid: str) -> None:
+        """Replicate the launch-intent row as it now stands."""
+        if not self._replicate:
+            return
+        cols = ("run_uuid", "lease_name", "lease_holder", "token",
+                "attempt", "state", "created_at", "updated_at")
+        row = conn.execute(
+            f"SELECT {','.join(cols)} FROM launch_intents WHERE run_uuid=?",
+            (run_uuid,)).fetchone()
+        if row is not None:
+            self._log_change(conn, "intent", dict(zip(cols, row)))
 
     def get_launch_intent(self, run_uuid: str) -> Optional[dict]:
         cols = ("run_uuid", "lease_name", "lease_holder", "token", "attempt",
@@ -640,9 +935,11 @@ class Store:
         meta = json.loads(row[0]) if row[0] else {}
         meta["owner"] = {"lease_id": lease_holder, "token": token,
                          "attempt": attempt}
+        seq = self._bump_seq(conn)
         conn.execute(
             "UPDATE runs SET meta=?, updated_at=?, change_seq=? WHERE uuid=?",
-            (json.dumps(meta), _now(), self._bump_seq(conn), run_uuid))
+            (json.dumps(meta), _now(), seq, run_uuid))
+        self._log_run_row(conn, run_uuid, seq=seq)
 
     # -- runs --------------------------------------------------------------
 
@@ -671,6 +968,283 @@ class Store:
         with self._conn_ctx() as conn:
             return conn.execute(
                 "SELECT v FROM counters WHERE k='change_seq'").fetchone()[0]
+
+    # -- epoch + feed tokens (ISSUE 7) -------------------------------------
+
+    def current_epoch(self) -> int:
+        """The store epoch: 0 at birth, bumped by every :meth:`promote`.
+        Cached in memory — promotion happens in the owning process."""
+        return self._epoch
+
+    def feed_token(self, seq: int) -> str:
+        """Epoch-qualified ``?since=`` token. Epoch 0 emits the bare seq
+        (byte-compatible with pre-failover deployments); a promoted store
+        emits ``"<epoch>:<seq>"`` so a consumer's pre-failover cursor is
+        deterministically rejected (410) instead of silently diverging."""
+        return f"{self._epoch}:{seq}" if self._epoch else str(seq)
+
+    def parse_since(self, token) -> int:
+        """Validate a feed token against the CURRENT epoch and return its
+        seq. Bare ints (internal callers, legacy tokens) are epoch 0.
+        Raises :class:`StaleEpochError` when the token's epoch is not this
+        store's — the consumer's incremental state may have diverged by
+        exactly the replication lag at failover, so the only safe answer
+        is a full resync."""
+        if isinstance(token, int):
+            return token
+        s = str(token)
+        if ":" in s:
+            e_str, _, seq_str = s.partition(":")
+            epoch, seq = int(e_str), int(seq_str)
+        else:
+            epoch, seq = 0, int(s)
+        if epoch != self._epoch:
+            raise StaleEpochError(epoch, self._epoch)
+        return seq
+
+    # -- changelog (replication log; ISSUE 7 tentpole (a)) -----------------
+
+    def _log_change(self, conn, op: str, payload: dict,
+                    seq: Optional[int] = None) -> Optional[int]:
+        """Append one replicated delta INSIDE the current write
+        transaction. ``seq`` reuses the row's already-bumped change_seq
+        (run upserts); None draws a fresh one — either way the seq was
+        assigned under the writer lock, so changelog order is commit
+        order."""
+        if not self._replicate:
+            return seq
+        if seq is None:
+            seq = self._bump_seq(conn)
+        conn.execute(
+            "INSERT OR REPLACE INTO changelog "
+            "(seq, epoch, op, payload, created_at) VALUES (?,?,?,?,?)",
+            (seq, self._epoch, op, json.dumps(payload), _now()))
+        return seq
+
+    def _raw_run_row(self, conn, uuid: str) -> Optional[dict]:
+        """The run row with JSON columns as their stored TEXT — the
+        changelog payload shape (replay re-inserts verbatim; no
+        deserialize/reserialize drift, and no runs_deserialized count)."""
+        row = conn.execute(
+            f"SELECT {','.join(self._RUN_COLS)} FROM runs WHERE uuid=?",
+            (uuid,)).fetchone()
+        return dict(zip(self._RUN_COLS, row)) if row else None
+
+    def _log_run_row(self, conn, uuid: str,
+                     seq: Optional[int] = None) -> None:
+        if not self._replicate:
+            return
+        row = self._raw_run_row(conn, uuid)
+        if row is not None:
+            self._log_change(conn, "run", {"row": row}, seq=seq)
+
+    def get_changelog(self, after_seq: int = 0,
+                      limit: int = 500) -> list[dict]:
+        """Changelog rows strictly after ``after_seq``, seq-ascending —
+        what a warm standby tails (in-process or via GET
+        /api/v1/changelog). A cursor below the compaction floor raises
+        :class:`CompactedLogError`: the pruned rows are gone, and
+        silently serving only the survivors would diverge the tailer."""
+        with self._conn_ctx() as conn:
+            rows = conn.execute(
+                "SELECT seq, epoch, op, payload, created_at FROM changelog "
+                "WHERE seq>? ORDER BY seq LIMIT ?",
+                (int(after_seq), int(limit))).fetchall()
+            # floor check AFTER the rows read: on a file DB both SELECTs
+            # run in autocommit, so a concurrent compaction could prune
+            # BETWEEN a floor-first check and the rows read — handing a
+            # lagging tailer post-gap rows with no error. Checking the
+            # (monotonic) floor afterwards closes that window: if the
+            # cursor is below the floor now, the rows may straddle a
+            # prune and must not be served.
+            row = conn.execute(
+                "SELECT v FROM counters WHERE k='changelog_floor'"
+            ).fetchone()
+            floor = int(row[0]) if row else 0
+            if int(after_seq) < floor:
+                raise CompactedLogError(int(after_seq), floor)
+        return [{"seq": r[0], "epoch": r[1], "op": r[2],
+                 "payload": json.loads(r[3]), "created_at": r[4]}
+                for r in rows]
+
+    def changelog_span(self) -> dict:
+        """{'seq': newest changelog seq, 'epoch': current epoch} — the
+        replication-lag numerator a standby compares its applied seq to."""
+        with self._conn_ctx() as conn:
+            row = conn.execute("SELECT MAX(seq) FROM changelog").fetchone()
+        return {"seq": int(row[0]) if row and row[0] else 0,
+                "epoch": self._epoch}
+
+    def apply_changelog(self, rows: list[dict]) -> int:
+        """Replay replicated changelog rows (standby tail). Idempotent:
+        rows at or below the applied watermark are skipped, so a re-poll
+        after a partial failure never double-applies. Bypasses the
+        read-only gate by design (replication IS the standby's write path)
+        and fires no transition listeners — a standby is passive until
+        promotion, after which agents full-resync anyway."""
+        todo = sorted((r for r in rows if r["seq"] > self._applied_seq),
+                      key=lambda r: r["seq"])
+        if not todo:
+            return 0
+        with self._transition_lock:
+            with self._conn_ctx() as conn:
+                max_epoch = self._epoch
+                for rec in todo:
+                    self._apply_change(conn, rec)
+                    conn.execute(
+                        "INSERT OR REPLACE INTO changelog "
+                        "(seq, epoch, op, payload, created_at) "
+                        "VALUES (?,?,?,?,?)",
+                        (rec["seq"], rec["epoch"], rec["op"],
+                         json.dumps(rec["payload"]), rec["created_at"]))
+                    max_epoch = max(max_epoch, int(rec["epoch"]))
+                # todo is seq-sorted, so the last element IS the
+                # watermark — taking it from an unsorted input would
+                # leave _applied_seq below applied rows and re-apply them
+                # (duplicating plain-INSERT ops) on the next poll
+                last = todo[-1]["seq"]
+                conn.execute(
+                    "UPDATE counters SET v=MAX(v, ?) WHERE k='change_seq'",
+                    (last,))
+                if max_epoch != self._epoch:
+                    conn.execute(
+                        "UPDATE counters SET v=? WHERE k='store_epoch'",
+                        (max_epoch,))
+                    self._epoch = max_epoch
+                self._applied_seq = last
+        return len(todo)
+
+    def _apply_change(self, conn, rec: dict) -> None:
+        op, p = rec["op"], rec["payload"]
+        if op == "run":
+            row = p["row"]
+            conn.execute(
+                f"INSERT OR REPLACE INTO runs ({','.join(self._RUN_COLS)}) "
+                f"VALUES ({','.join('?' * len(self._RUN_COLS))})",
+                [row.get(c) for c in self._RUN_COLS])
+        elif op == "condition":
+            conn.execute(
+                "INSERT INTO status_conditions (run_uuid, condition, "
+                "created_at) VALUES (?,?,?)",
+                (p["run_uuid"], p["condition"], p["created_at"]))
+        elif op == "heartbeat":
+            conn.execute("UPDATE runs SET heartbeat_at=? WHERE uuid=?",
+                         (p["at"], p["uuid"]))
+        elif op == "delete_run":
+            for table, col in (("runs", "uuid"),
+                               ("status_conditions", "run_uuid"),
+                               ("lineage", "run_uuid"),
+                               ("launch_intents", "run_uuid")):
+                conn.execute(f"DELETE FROM {table} WHERE {col}=?",
+                             (p["uuid"],))
+        elif op == "project":
+            conn.execute(
+                "INSERT OR IGNORE INTO projects (name, description, "
+                "created_at) VALUES (?,?,?)",
+                (p["name"], p.get("description"), p["created_at"]))
+        elif op == "token":
+            conn.execute(
+                "INSERT OR REPLACE INTO tokens (id, token_hash, project, "
+                "label, created_at, revoked) VALUES (?,?,?,?,?,?)",
+                (p["id"], p["token_hash"], p.get("project"), p.get("label"),
+                 p["created_at"], p.get("revoked", 0)))
+        elif op == "token_revoke":
+            conn.execute("UPDATE tokens SET revoked=1 WHERE id=?",
+                         (p["id"],))
+        elif op == "lineage":
+            conn.execute(
+                "INSERT INTO lineage (run_uuid, name, artifact) "
+                "VALUES (?,?,?)",
+                (p["run_uuid"], p.get("name"), p["artifact"]))
+        elif op == "config":
+            conn.execute(
+                "INSERT OR REPLACE INTO control_config (key, value) "
+                "VALUES (?,?)", (p["key"], p["value"]))
+        elif op == "intent":
+            cols = ("run_uuid", "lease_name", "lease_holder", "token",
+                    "attempt", "state", "created_at", "updated_at")
+            conn.execute(
+                f"INSERT OR REPLACE INTO launch_intents ({','.join(cols)}) "
+                f"VALUES ({','.join('?' * len(cols))})",
+                [p.get(c) for c in cols])
+        elif op == "promote":
+            pass  # epoch adoption handled by the apply loop's max_epoch
+        # unknown ops are skipped: a newer primary may log kinds an older
+        # standby build doesn't know — it still converges on the ones it
+        # does, and the operator upgrades before promoting
+
+    # -- promotion + snapshots (ISSUE 7) -----------------------------------
+
+    def promote(self) -> int:
+        """Promote this store to primary: bump the store epoch and drop
+        every agent lease — all in ONE transaction, logged to the
+        changelog. Every fencing token minted before this moment dies here
+        (its lease row is gone AND its epoch bits are old), so a write
+        in flight from the dead primary's era gets a deterministic 409,
+        never a silent landing; every ``?since=`` cursor from the old
+        epoch gets a deterministic 410. Lifts read-only standby mode."""
+        with self._transition_lock:
+            with self._conn_ctx() as conn:
+                if not conn.in_transaction:
+                    conn.execute("BEGIN IMMEDIATE")
+                conn.execute(
+                    "UPDATE counters SET v=v+1 WHERE k='store_epoch'")
+                epoch = conn.execute(
+                    "SELECT v FROM counters WHERE k='store_epoch'"
+                ).fetchone()[0]
+                conn.execute("DELETE FROM agent_leases")
+                self._epoch = int(epoch)
+                self._log_change(conn, "promote", {"epoch": self._epoch})
+        self._read_only = False
+        return self._epoch
+
+    def snapshot(self, dirpath: str) -> dict:
+        """Crash-consistent snapshot into ``dirpath``: the whole DB via
+        sqlite's online backup API, written tmp+fsync+rename with a
+        sha256 manifest (the PR-4 checkpoint discipline) — a torn copy is
+        detectable, never silently restored. Returns the manifest."""
+        import hashlib
+        import os
+
+        os.makedirs(dirpath, exist_ok=True)
+        tmp = os.path.join(dirpath,
+                           f".snapshot-{uuid_mod.uuid4().hex[:8]}.tmp")
+        dst = sqlite3.connect(tmp)
+        try:
+            with self._conn_ctx() as conn:
+                conn.backup(dst)
+            dst.commit()
+            seq = dst.execute(
+                "SELECT v FROM counters WHERE k='change_seq'").fetchone()[0]
+            row = dst.execute(
+                "SELECT v FROM counters WHERE k='store_epoch'").fetchone()
+            epoch = int(row[0]) if row else 0
+        finally:
+            dst.close()
+        h = hashlib.sha256()
+        with open(tmp, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+            os.fsync(f.fileno())
+        snap_path = os.path.join(dirpath, "snapshot.db")
+        os.replace(tmp, snap_path)
+        manifest = {"sha256": h.hexdigest(), "seq": int(seq),
+                    "epoch": epoch, "created_at": _now()}
+        mtmp = os.path.join(dirpath, ".manifest.tmp")
+        with open(mtmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(mtmp, os.path.join(dirpath, "manifest.json"))
+        try:
+            dfd = os.open(dirpath, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+        return manifest
 
     def _row_to_run(self, row) -> dict:
         self.stats["runs_deserialized"] += 1
@@ -728,6 +1302,7 @@ class Store:
         rejects the whole batch with :class:`StaleLeaseError` when the
         token is no longer current — a stale agent's pipeline driver must
         not fan out children after a takeover."""
+        self._check_writable()
         self.create_project(project)
         rows, conds = [], []
         uuids: list[str] = []
@@ -785,6 +1360,16 @@ class Store:
                 conn.executemany(
                     "INSERT INTO status_conditions (run_uuid, condition, created_at) VALUES (?,?,?)",
                     [cond + (now,) for cond in conds])
+                if self._replicate:
+                    # row deltas ride the rows' own seqs; each condition
+                    # draws a fresh one — all inside this transaction, so
+                    # the whole batch replicates atomically in commit order
+                    for i, u in enumerate(uuids):
+                        self._log_run_row(conn, u, seq=first + i)
+                    for run_uuid, cond_json in conds:
+                        self._log_change(conn, "condition", {
+                            "run_uuid": run_uuid, "condition": cond_json,
+                            "created_at": now})
             except BaseException:
                 # same hazard transition_many guards against: a mid-batch
                 # failure (e.g. duplicate uuid) must not strand earlier
@@ -866,11 +1451,11 @@ class Store:
         """Opaque keyset-pagination cursor for a listing row."""
         return f"{run['created_at']}|{run['uuid']}"
 
-    @staticmethod
-    def since_token(run: dict) -> str:
+    def since_token(self, run: dict) -> str:
         """Resume token for incremental (``since``) fetches: the row's
-        commit-ordered change_seq."""
-        return str(run["change_seq"])
+        commit-ordered change_seq, epoch-qualified (:meth:`feed_token`) so
+        a cursor can never silently survive a store failover."""
+        return self.feed_token(run["change_seq"])
 
     def list_runs(
         self,
@@ -901,8 +1486,11 @@ class Store:
             pipeline_uuid=pipeline_uuid, created_by=created_by)
         q = f"SELECT {','.join(self._RUN_COLS)} FROM runs" + where
         if since is not None:
+            # epoch-validated: a cursor from before a failover raises
+            # StaleEpochError (HTTP 410) instead of silently missing the
+            # replication-lag window's rows
             q += " AND change_seq>? ORDER BY change_seq ASC LIMIT ? OFFSET ?"
-            args += [int(since), limit, offset]
+            args += [self.parse_since(since), limit, offset]
         else:
             if order not in ("desc", "asc"):
                 raise ValueError(f"bad order {order!r}")
@@ -950,6 +1538,7 @@ class Store:
                 "SELECT COUNT(*) FROM runs" + where, args).fetchone()[0]
 
     def update_run(self, uuid: str, fence=None, **fields: Any) -> Optional[dict]:
+        self._check_writable()
         sets, args = [], []
         for k, v in fields.items():
             if k not in self._RUN_COLS or k in ("uuid", "change_seq"):
@@ -963,9 +1552,11 @@ class Store:
         sets.append("change_seq=?")
         with self._conn_ctx() as conn:
             self._check_fence(conn, fence)
-            args.append(self._bump_seq(conn))
+            seq = self._bump_seq(conn)
+            args.append(seq)
             conn.execute(f"UPDATE runs SET {','.join(sets)} WHERE uuid=?",
                          args + [uuid])
+            self._log_run_row(conn, uuid, seq=seq)
         return self.get_run(uuid)
 
     def merge_outputs(self, uuid: str, outputs: dict,
@@ -982,18 +1573,27 @@ class Store:
 
     def heartbeat(self, uuid: str) -> bool:
         """Renew a run's liveness lease (zombie-reaper input). Cheap direct
-        UPDATE — no listeners fire, no updated_at churn."""
+        UPDATE — no listeners fire, no updated_at churn. Replicated (as a
+        tiny heartbeat delta, not a whole row) so a promoted standby's
+        reaper sees real staleness, not replication-shaped staleness."""
+        self._check_writable()
         with self._conn_ctx() as conn:
+            now = _now()
             cur = conn.execute(
-                "UPDATE runs SET heartbeat_at=? WHERE uuid=?", (_now(), uuid))
+                "UPDATE runs SET heartbeat_at=? WHERE uuid=?", (now, uuid))
+            if cur.rowcount > 0:
+                self._log_change(conn, "heartbeat", {"uuid": uuid, "at": now})
         return cur.rowcount > 0
 
     def delete_run(self, uuid: str) -> bool:
+        self._check_writable()
         with self._conn_ctx() as conn:
             cur = conn.execute("DELETE FROM runs WHERE uuid=?", (uuid,))
             conn.execute("DELETE FROM status_conditions WHERE run_uuid=?", (uuid,))
             conn.execute("DELETE FROM lineage WHERE run_uuid=?", (uuid,))
             conn.execute("DELETE FROM launch_intents WHERE run_uuid=?", (uuid,))
+            if cur.rowcount > 0:
+                self._log_change(conn, "delete_run", {"uuid": uuid})
         return cur.rowcount > 0
 
     # -- statuses ----------------------------------------------------------
@@ -1031,6 +1631,7 @@ class Store:
         ``fence=(lease_name, token)`` rejects the whole batch with
         :class:`StaleLeaseError` when a newer lease acquisition exists —
         a stale agent's promotion wave cannot land after a takeover."""
+        self._check_writable()
         results: list[tuple[Optional[dict], bool]] = []
         applied: list[tuple[str, str]] = []
         sched_ages: list[float] = []
@@ -1082,8 +1683,9 @@ class Store:
             cond = V1StatusCondition.get_condition(
                 dst, reason=reason, message=message)
             now = _now()
+            seq = self._bump_seq(conn)
             sets = ["status=?", "updated_at=?", "change_seq=?"]
-            args: list[Any] = [dst.value, now, self._bump_seq(conn)]
+            args: list[Any] = [dst.value, now, seq]
             if dst == V1Statuses.RUNNING and not run.get("started_at"):
                 sets.append("started_at=?")
                 args.append(now)
@@ -1098,13 +1700,19 @@ class Store:
             if is_done(dst):
                 sets.append("finished_at=?")
                 args.append(now)
+            cond_json = json.dumps(cond.to_dict())
             conn.execute(
                 "INSERT INTO status_conditions (run_uuid, condition, created_at) VALUES (?,?,?)",
-                (uuid, json.dumps(cond.to_dict()), now),
+                (uuid, cond_json, now),
             )
             conn.execute(
                 f"UPDATE runs SET {','.join(sets)} WHERE uuid=?",
                 args + [uuid])
+            self._log_run_row(conn, uuid, seq=seq)
+            if self._replicate:
+                self._log_change(conn, "condition", {
+                    "run_uuid": uuid, "condition": cond_json,
+                    "created_at": now})
             results.append((self._get_run_conn(conn, uuid), True))
             applied.append((uuid, dst.value))
 
@@ -1137,11 +1745,16 @@ class Store:
     # -- lineage -----------------------------------------------------------
 
     def add_lineage(self, uuid: str, artifact: dict) -> None:
+        self._check_writable()
         with self._conn_ctx() as conn:
+            art_json = json.dumps(artifact)
             conn.execute(
                 "INSERT INTO lineage (run_uuid, name, artifact) VALUES (?,?,?)",
-                (uuid, artifact.get("name"), json.dumps(artifact)),
+                (uuid, artifact.get("name"), art_json),
             )
+            self._log_change(conn, "lineage", {
+                "run_uuid": uuid, "name": artifact.get("name"),
+                "artifact": art_json})
 
     def get_lineage(self, uuid: str) -> list[dict]:
         with self._conn_ctx() as conn:

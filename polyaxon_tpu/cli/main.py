@@ -505,7 +505,10 @@ def status(host, as_json):
                 "metrics": store.metrics.snapshot(),
                 "lease": store.get_lease("scheduler"),
                 "shards": shards,
-                "shard_owners": owners}
+                "shard_owners": owners,
+                "store_state": {"epoch": store.current_epoch(),
+                                "read_only": store.read_only,
+                                "degraded": store.degraded}}
     if as_json:
         click.echo(json.dumps(data, indent=2))
         return
@@ -516,6 +519,17 @@ def status(host, as_json):
                    f"token {lease.get('token')}, ttl {lease.get('ttl')}s)")
     else:
         click.echo("scheduler lease: none (no agent has acquired)")
+    # store survivability (ISSUE 7): which epoch this control plane is on
+    # (>0 means at least one failover happened) and whether writes serve
+    state = data.get("store_state") or {}
+    if state:
+        flags = []
+        if state.get("read_only"):
+            flags.append("READ-ONLY standby")
+        if state.get("degraded"):
+            flags.append(f"DEGRADED: {state['degraded']}")
+        click.echo(f"store epoch: {state.get('epoch', 0)}"
+                   + (f" ({'; '.join(flags)})" if flags else ""))
     # per-agent shard-ownership table (ISSUE 6): which live agent drives
     # which slice of the run space, and which shards are orphaned
     owners = data.get("shard_owners") or {}
@@ -759,9 +773,25 @@ def token_revoke(token_id, host):
                    "server processes over ONE --data-dir each adopt their "
                    "fair share and survive each other's crashes; 1 = the "
                    "single-active-agent deployment")
+@click.option("--standby-of", default=None, metavar="URL",
+              help="run this server+agent as a warm STANDBY of the primary "
+                   "control plane at URL (docs/RESILIENCE.md 'Store crash "
+                   "matrix'): the store tails the primary's changelog and "
+                   "serves reads (writes 503); the co-located agent stands "
+                   "by (lease writes bounce off the read-only store) and "
+                   "activates the moment the store promotes — one flag "
+                   "gives the whole control plane a failover twin")
+@click.option("--promote-after", default=10.0, type=float,
+              help="with --standby-of: seconds of primary silence before "
+                   "self-promotion (<=0: promotion stays manual)")
+@click.option("--compact-every", default=900.0, type=float,
+              help="changelog compaction interval in seconds (snapshot + "
+                   "prune with a 10k-row tail margin, so the replication "
+                   "log stays bounded); <=0 disables")
 def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_token,
            artifacts_store, kube, kube_host, kube_namespace, kube_token, kube_ca,
-           kube_insecure, agent_config, num_shards):
+           kube_insecure, agent_config, num_shards, standby_of, promote_after,
+           compact_every):
     """Start the API server + scheduling agent (one process)."""
     from ..api.server import ApiServer
     from ..scheduler.agent import LocalAgent
@@ -772,6 +802,21 @@ def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_tok
         artifacts_root=os.path.join(data_dir, "artifacts"),
         host=host, port=port, auth_token=auth_token,
     )
+    standby = None
+    if standby_of:
+        from ..api.replication import make_standby
+
+        standby = make_standby(
+            standby_of, srv.store, data_dir,
+            promote_after=(promote_after if promote_after > 0 else None),
+            auth_token=auth_token).start()
+    compactor = None
+    if compact_every > 0:
+        from ..api.replication import ChangelogCompactor
+
+        compactor = ChangelogCompactor(
+            srv.store, os.path.join(data_dir, ".snapshots"),
+            interval=compact_every).start()
     srv.start()
     connections = {}
     if agent_config:
@@ -800,7 +845,9 @@ def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_tok
         num_shards=num_shards,
     )
     agent.start()
-    click.echo(f"polyaxon_tpu server on {srv.url} (agent: {max_parallel} parallel)")
+    role = f"warm standby of {standby_of}" if standby_of else "primary"
+    click.echo(f"polyaxon_tpu server on {srv.url} "
+               f"({role}; agent: {max_parallel} parallel)")
 
     # graceful SIGTERM drain (ISSUE 4 satellite): finish the in-flight
     # transition batch, release the scheduler lease explicitly — a
@@ -815,9 +862,17 @@ def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_tok
             pass
         click.echo("SIGTERM: draining agent (lease released for successor)")
         agent.drain()
+        if compactor is not None:
+            compactor.stop()
+        if standby is not None:
+            standby.stop()
         srv.stop()
     except KeyboardInterrupt:
         agent.stop()
+        if compactor is not None:
+            compactor.stop()
+        if standby is not None:
+            standby.stop()
         srv.stop()
 
 

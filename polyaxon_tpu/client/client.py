@@ -24,10 +24,27 @@ class ApiError(RuntimeError):
 
 
 class BaseClient:
-    def __init__(self, host: str = "http://127.0.0.1:8000", timeout: float = 30.0,
+    """``host`` accepts ONE endpoint or an ordered failover list (a
+    python list, or one comma-separated string — the env-var-friendly
+    form pods receive via PLX_API_HOST): the client talks to the current
+    endpoint and rotates to the next on a host-level failure — connection
+    refused/reset, or a 503 (a demoted standby / degraded store answers
+    503 on writes by contract). Sticky: after a failover every later call
+    starts at the endpoint that worked. Fencing 409s and epoch 410s NEVER
+    rotate or retry — they are verdicts about the caller, identical on
+    every replica (ISSUE 7)."""
+
+    def __init__(self, host="http://127.0.0.1:8000", timeout: float = 30.0,
                  auth_token: Optional[str] = None,
                  retry: Optional[RetryPolicy] = None):
-        self.host = host.rstrip("/")
+        if isinstance(host, str):
+            hosts = [h for h in (p.strip() for p in host.split(",")) if h]
+        else:
+            hosts = [str(h).strip() for h in host]
+        self.hosts = [h.rstrip("/") for h in hosts]
+        if not self.hosts:
+            raise ValueError("client needs at least one API endpoint")
+        self._host_idx = 0
         self.timeout = timeout
         # transient 5xx/429/connection failures are retried within a bounded
         # budget (VERDICT r5 Missing #3: no retry policy at all); a policy
@@ -39,16 +56,21 @@ class BaseClient:
         if token:
             self._session.headers["Authorization"] = f"Bearer {token}"
 
+    @property
+    def host(self) -> str:
+        """The endpoint currently in use."""
+        return self.hosts[self._host_idx]
+
     def _req(self, method: str, path: str, **kwargs: Any):
         if method.upper() in ("GET", "HEAD"):
-            return self.retry.call(self._req_once, method, path, **kwargs)
+            return self.retry.call(self._req_sweep, method, path, **kwargs)
         # Mutating verbs: an error AFTER the request was sent is ambiguous —
         # the server may have committed (a re-POST of create/restart would
         # duplicate the run). Retry only failures that are provably
         # pre-commit: an HTTP error response (our handlers raise before or
         # atomically with their write; injected 5xx/429 never reach one) or
         # a connect-phase failure (nothing was sent).
-        return self.retry.call(self._req_once, method, path,
+        return self.retry.call(self._req_sweep, method, path,
                                classify=self._mutation_retryable, **kwargs)
 
     def _mutation_retryable(self, exc: BaseException) -> bool:
@@ -59,6 +81,46 @@ class BaseClient:
                 not isinstance(exc, requests.exceptions.ReadTimeout):
             return True
         return False
+
+    def _rotate_on(self, method: str, exc: BaseException) -> bool:
+        """Should this failure try the NEXT endpoint (same sweep, no
+        backoff burned)? Only host-level failures rotate: the host is
+        down (connection-phase error) or explicitly not serving (503 —
+        demoted standby / degraded store). Any other HTTP answer means
+        the host IS serving and every replica would answer the same —
+        especially the terminal 409/410 verdicts. Mutations additionally
+        require the failure to be provably pre-commit (the same rule as
+        retrying them)."""
+        status = getattr(exc, "status", None)
+        if status is not None:
+            host_level = status == 503
+        else:
+            host_level = isinstance(
+                exc, (requests.exceptions.ConnectTimeout,
+                      requests.exceptions.ConnectionError,
+                      ConnectionError)) and not isinstance(
+                exc, requests.exceptions.ReadTimeout)
+        if not host_level:
+            return False
+        if method.upper() in ("GET", "HEAD"):
+            return True
+        return self._mutation_retryable(exc)
+
+    def _req_sweep(self, method: str, path: str, **kwargs: Any):
+        """One attempt = one sweep across the endpoint list starting at
+        the current one. A sweep that fails everywhere surfaces the last
+        error to the RetryPolicy (which then backs off and re-sweeps)."""
+        last: Optional[BaseException] = None
+        for _ in range(len(self.hosts)):
+            try:
+                return self._req_once(method, path, **kwargs)
+            except BaseException as e:
+                last = e
+                if len(self.hosts) > 1 and self._rotate_on(method, e):
+                    self._host_idx = (self._host_idx + 1) % len(self.hosts)
+                    continue
+                raise
+        raise last
 
     def _req_once(self, method: str, path: str, **kwargs: Any):
         url = f"{self.host}{path}"

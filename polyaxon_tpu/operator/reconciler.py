@@ -48,6 +48,7 @@ class _OpState:
     final_status: Optional[str] = None
     gc_done: bool = False
     applying: bool = True  # manifests not yet fully applied; reconcile must WAIT
+    exhausted_fired: bool = False  # on_retry_exhausted exactly-once latch
 
 
 # status callback: (run_uuid, status, message)
@@ -244,8 +245,13 @@ class OperationReconciler:
         if decision.action == Action.WAIT:
             return
         if decision.action == Action.SET_RUNNING:
-            state.was_running = True
+            # report FIRST: if the store write fails (outage weather),
+            # was_running stays False and the next level-triggered pass
+            # re-emits — otherwise the terminal batch would later skip its
+            # RUNNING prelude and the scheduled->succeeded edge would be
+            # silently rejected by the status machine (ISSUE 7)
             self.on_status(op.run_uuid, V1Statuses.RUNNING.value, None)
+            state.was_running = True
             return
         if decision.action == Action.RESTART:
             # slice-level all-or-nothing: tear down every pod, re-apply all.
@@ -263,7 +269,14 @@ class OperationReconciler:
                 (op.run_uuid, V1Statuses.QUEUED.value, None),
                 (op.run_uuid, V1Statuses.SCHEDULED.value, None),
             ]
-            self.on_status_many(updates)
+            try:
+                self.on_status_many(updates)
+            except Exception:
+                # store outage mid-edge: nothing was deleted/re-applied
+                # yet — give the attempt back so the retry budget pays
+                # for slice failures, never for store weather
+                state.retries_done -= 1
+                raise
             self._c(self.cluster.delete_selected, op.label_selector)
             for manifest in op.resources:
                 self._c(self.cluster.apply, manifest)
@@ -283,9 +296,11 @@ class OperationReconciler:
             state.finished_at = time.monotonic()
             if (decision.action == Action.FAIL
                     and decision.reason == Reason.POD_FAILED
-                    and op.backoff_limit > 0):
-                # exactly-once: final_status latches above, so this FAIL
-                # branch cannot re-fire for the same op
+                    and op.backoff_limit > 0 and not state.exhausted_fired):
+                # exactly-once via its own latch (not final_status: that
+                # one UNLATCHES below when the store write fails, and the
+                # re-emit must not double-count the exhaustion)
+                state.exhausted_fired = True
                 try:
                     self.on_retry_exhausted()
                 except Exception:
@@ -295,7 +310,17 @@ class OperationReconciler:
             # success leaves them until TTL (or forever when ttl < 0)
             updates.append(
                 (op.run_uuid, status.value, _REASON_MSG.get(decision.reason)))
-            self.on_status_many(updates)
+            try:
+                self.on_status_many(updates)
+            except Exception:
+                # the store write failed (outage weather, NOT a fencing
+                # rejection — the agent's callbacks swallow those): UNLATCH
+                # so the next level-triggered pass re-derives this exact
+                # decision from the still-live pods and re-emits. A store
+                # outage must never eat a terminal transition (ISSUE 7).
+                state.final_status = None
+                state.finished_at = None
+                raise
             if decision.action == Action.FAIL or op.ttl_s == 0:
                 self._c(self.cluster.delete_selected, op.label_selector)
                 if op.ttl_s == 0:

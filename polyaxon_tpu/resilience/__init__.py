@@ -18,8 +18,8 @@ See docs/RESILIENCE.md for the failure model and how to run the chaos soak.
 """
 
 from .chaos import (
-    ChaosCluster, ChaosConfig, FaultyStore, flaky_http_middleware,
-    tear_latest_checkpoint,
+    ChaosCluster, ChaosConfig, FaultyStore, OutageStore,
+    flaky_http_middleware, tear_latest_checkpoint, tear_snapshot,
 )
 from .heartbeat import ZombieReaper
 from .retry import DEFAULT_HTTP_RETRY, RetryPolicy
@@ -29,8 +29,10 @@ __all__ = [
     "ChaosConfig",
     "DEFAULT_HTTP_RETRY",
     "FaultyStore",
+    "OutageStore",
     "RetryPolicy",
     "ZombieReaper",
     "flaky_http_middleware",
     "tear_latest_checkpoint",
+    "tear_snapshot",
 ]

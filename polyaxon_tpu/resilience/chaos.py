@@ -272,6 +272,12 @@ class FaultyStore:
         # the fair-share listing behind shard acquisition/rebalance ride
         # the same gate, so shard adoption itself is chaos-testable
         "renew_leases", "list_leases",
+        # replication verbs (ISSUE 7): the standby's tail and the
+        # snapshot/promotion path must ride out SQLITE_BUSY weather too —
+        # a blip during a changelog poll must cost one poll, never the
+        # standby's applied-seq watermark or a double promotion
+        "get_changelog", "apply_changelog", "snapshot", "promote",
+        "changelog_span",
     )
 
     def __init__(self, inner: Any, seed: int = 0, fault_rate: float = 0.2,
@@ -307,6 +313,68 @@ class FaultyStore:
         return attr
 
 
+class OutageStore:
+    """The store-host-death gate (ISSUE 7): wraps a store; after
+    :meth:`kill_store` every verb raises
+    :class:`~polyaxon_tpu.api.replication.StoreUnavailableError` — the
+    in-process stand-in for the host dying mid-wave. The failover front
+    (``FailoverStore``) rotates to the standby on exactly this error.
+    :meth:`revive` models the host coming back (as a zombie primary — its
+    epoch is stale; see the split-brain row of the store crash matrix).
+    :meth:`disk_full` forwards to the wrapped store's SQLITE_FULL
+    injection, exercising degraded mode through the real detection path."""
+
+    def __init__(self, inner: Any):
+        self._inner = inner
+        self._dead = threading.Event()
+        self.kills = 0
+
+    def kill_store(self) -> None:
+        self._dead.set()
+        self.kills += 1
+
+    def revive(self) -> None:
+        self._dead.clear()
+
+    @property
+    def dead(self) -> bool:
+        return self._dead.is_set()
+
+    def disk_full(self, n: int = 1) -> None:
+        self._inner.chaos_disk_full(n)
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._inner, name)
+        if callable(attr):
+            def _guarded(*a: Any, _attr=attr, _name=name, **kw: Any) -> Any:
+                if self._dead.is_set():
+                    from ..api.replication import StoreUnavailableError
+
+                    raise StoreUnavailableError(
+                        f"chaos: store host is down (on {_name})")
+                return _attr(*a, **kw)
+
+            return _guarded
+        return attr
+
+
+def tear_snapshot(snapshot_dir: str) -> Optional[str]:
+    """Chaos hook (ISSUE 7): truncate snapshot.db to half its size — a
+    torn copy, what a host dying mid-upload leaves behind. The sha256
+    manifest must catch it (``verify_snapshot`` raises TornSnapshotError)
+    and the standby bootstrap must fall back to the changelog tail.
+    Returns the torn path (None when no snapshot exists)."""
+    import os
+
+    path = os.path.join(snapshot_dir, "snapshot.db")
+    if not os.path.isfile(path):
+        return None
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    return path
+
+
 def tear_latest_checkpoint(ckpt_dir: str,
                            rng: Optional[random.Random] = None) -> Optional[str]:
     """Chaos hook (ISSUE 4 satellite): truncate the largest payload file
@@ -337,5 +405,6 @@ def tear_latest_checkpoint(ckpt_dir: str,
     return largest
 
 
-__all__ = ["ChaosCluster", "ChaosConfig", "FaultyStore",
-           "flaky_http_middleware", "tear_latest_checkpoint", "PodPhase"]
+__all__ = ["ChaosCluster", "ChaosConfig", "FaultyStore", "OutageStore",
+           "flaky_http_middleware", "tear_latest_checkpoint",
+           "tear_snapshot", "PodPhase"]

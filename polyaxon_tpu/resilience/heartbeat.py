@@ -81,6 +81,16 @@ class ZombieReaper:
     the agent's sharded fence, and the transition's ``changed`` result
     guards the counters: a reap that lost a race (the run already moved)
     is counted by nobody — reaps are exactly-once across the fleet.
+
+    Failover grace (ISSUE 7): a store-epoch bump means the control plane
+    just failed over to a promoted standby — pods that heartbeated
+    through the outage SPOOLED their beats and replay them on reconnect,
+    so the first post-promotion reads show staleness that is failover-
+    shaped, not death-shaped. When the observed epoch changes, every
+    strike is cleared and reaping pauses for ``failover_grace`` seconds
+    (default: the zombie window itself), long enough for spooled
+    heartbeats to land before the two-stale-pass rule can false-positive
+    a healthy pod.
     """
 
     def __init__(
@@ -91,6 +101,7 @@ class ZombieReaper:
         list_runs: Optional[Callable[[str], list]] = None,
         metrics=None,
         owns_run: Optional[Callable[[str], bool]] = None,
+        failover_grace: Optional[float] = None,
     ):
         import time
 
@@ -133,6 +144,12 @@ class ZombieReaper:
         self.reaped: list[tuple[str, str]] = []  # (uuid, action) audit trail
         # uuid -> consecutive passes seen lease-expired; reap needs 2
         self._strikes: dict[str, int] = {}
+        # post-promotion grace (ISSUE 7): epoch observed last pass + the
+        # monotonic deadline before which no reap may fire
+        self.failover_grace = (zombie_after if failover_grace is None
+                               else failover_grace)
+        self._epoch_seen: Optional[int] = None
+        self._grace_until = float("-inf")
 
     def pass_once(self) -> list[tuple[str, str]]:
         """One renewal + reap pass (rate-limited; a call inside the
@@ -143,6 +160,7 @@ class ZombieReaper:
         if now - self._last_pass < self._min_interval:
             return []
         self._last_pass = now
+        in_grace = self._observe_epoch(now)
         actions: list[tuple[str, str]] = []
         owned = set(self.owned())
         seen: set = set()
@@ -165,6 +183,10 @@ class ZombieReaper:
                 if age is None or age < self.zombie_after:
                     self._strikes.pop(uuid, None)
                     continue
+                if in_grace:
+                    # failover grace: spooled heartbeats are still
+                    # replaying — observe the staleness, strike nobody
+                    continue
                 # stale row read: first strike only. A live-but-unlucky
                 # sidecar (heartbeat write lost to a transient store
                 # fault) gets a whole inter-pass window to land a fresh
@@ -181,6 +203,24 @@ class ZombieReaper:
         self.last_max_staleness = max_stale
         self.reaped.extend(actions)
         return actions
+
+    def _observe_epoch(self, now: float) -> bool:
+        """Track the store epoch; an epoch CHANGE (failover) clears every
+        strike and opens the grace window. Returns True while in grace."""
+        epoch = 0
+        epoch_fn = getattr(self.store, "current_epoch", None)
+        if callable(epoch_fn):
+            try:
+                epoch = int(epoch_fn())
+            except Exception:
+                epoch = self._epoch_seen if self._epoch_seen is not None else 0
+        if self._epoch_seen is None:
+            self._epoch_seen = epoch
+        elif epoch != self._epoch_seen:
+            self._epoch_seen = epoch
+            self._strikes.clear()
+            self._grace_until = now + self.failover_grace
+        return now < self._grace_until
 
     def _reap(self, run: dict) -> Optional[str]:
         """Reap one zombie; returns the action taken, or None when the

@@ -20,6 +20,15 @@ from typing import Any, Callable, Iterable, Optional
 # other than 429 means the request itself is wrong — retrying can't help.
 RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
 
+# Statuses that are TERMINAL VERDICTS about the writer/cursor, never
+# weather: 409 = a fencing conflict (the writer's lease token is stale —
+# it must demote, not re-send) and 410 = an epoch fence (the ``?since=``
+# cursor died with a store failover — full resync, not a re-poll).
+# Pinned here so even a custom ``retry_statuses`` set cannot re-admit
+# them: burning retry budget on a verdict delays the demotion/resync the
+# rejection exists to trigger (ISSUE 7 satellite).
+NEVER_RETRY_STATUSES = frozenset({409, 410})
+
 
 def _status_of(exc: BaseException) -> Optional[int]:
     """HTTP status carried by an exception, if any (KubeApiError / ApiError
@@ -38,7 +47,8 @@ def default_classify(exc: BaseException) -> bool:
     or connection-level failure (DNS, refused, reset, broken pipe)."""
     status = _status_of(exc)
     if status is not None:
-        return status in RETRYABLE_STATUSES
+        return (status not in NEVER_RETRY_STATUSES
+                and status in RETRYABLE_STATUSES)
     if isinstance(exc, (TimeoutError, ConnectionError)):
         return True
     # urllib wraps socket errors in URLError (reason carries the cause);
@@ -79,7 +89,9 @@ class RetryPolicy:
     def is_retryable(self, exc: BaseException) -> bool:
         status = _status_of(exc)
         if status is not None:
-            return status in self.retry_statuses
+            # 409/410 are terminal even under a custom retry_statuses set
+            return (status not in NEVER_RETRY_STATUSES
+                    and status in self.retry_statuses)
         return default_classify(exc)
 
     def delay(self, attempt: int, rng: Optional[_random.Random] = None,

@@ -1267,11 +1267,31 @@ class LocalAgent:
             for uuid in [u for u, s in self._sidecars.items() if not s.is_alive()]:
                 del self._sidecars[uuid]
 
+    def _store_weather(self, exc: BaseException) -> bool:
+        """Transient store trouble worth a bounded in-line retry on a
+        lifecycle write: SQLITE_BUSY bursts, a dead primary mid-failover
+        (unavailable / the standby's pre-promotion read-only 503). NEVER
+        a fencing rejection — that is a verdict, and retrying it would
+        delay the demotion it exists to trigger."""
+        if isinstance(exc, StaleLeaseError):
+            return False
+        import sqlite3
+
+        from ..api.store import StoreReadOnlyError
+
+        return isinstance(exc, (sqlite3.OperationalError, ConnectionError,
+                                StoreReadOnlyError, TimeoutError))
+
     def _on_status(self, run_uuid: str, status: str, message: Optional[str]) -> None:
         if is_done(status):
             self._collect_outputs_safe(run_uuid)
         try:
-            self.store.transition(run_uuid, status, message=message)
+            # ride out store weather (ISSUE 7): an executor's terminal
+            # report is not re-emitted, so a transient fault here would
+            # lose it forever — retry within the shared budget before
+            # surfacing. Fencing rejections stay immediate.
+            self.retry.call(self.store.transition, run_uuid, status,
+                            message=message, classify=self._store_weather)
         except StaleLeaseError:
             # this run's shard was taken over mid-flight: the rejection IS
             # the designed outcome (the new owner adopts/resyncs the run)
@@ -1291,9 +1311,14 @@ class LocalAgent:
             if is_done(status):
                 self._collect_outputs_safe(uuid)
         try:
-            self.store.transition_many(
+            # same weather policy as _on_status; a batch that still fails
+            # raises into the reconciler, which UNLATCHES and re-emits on
+            # the next level-triggered pass (operator/reconciler.py)
+            self.retry.call(
+                self.store.transition_many,
                 [(uuid, status, None, message)
-                 for uuid, status, message in updates])
+                 for uuid, status, message in updates],
+                classify=self._store_weather)
         except StaleLeaseError:
             pass  # takeover mid-edge: same semantics as _on_status — the
             #       new owner drives these runs now; finalize and go quiet

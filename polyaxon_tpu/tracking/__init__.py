@@ -14,4 +14,5 @@ from .events import (
 )
 from .resources import ResourceLogger
 from .run import Run, end, get_run, init, log_artifact, log_metrics, log_outputs
+from .spool import EventSpool
 from .writer import EventFileWriter, LogWriter, list_event_names, read_events

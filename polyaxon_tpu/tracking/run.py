@@ -12,6 +12,8 @@ same code runs on a laptop or a TPU host pod (SURVEY.md §3(d))."""
 from __future__ import annotations
 
 import os
+import time
+import traceback
 import uuid as uuid_mod
 from typing import Any, Optional
 
@@ -25,6 +27,7 @@ from .events import (
     V1EventSpan,
     V1RunArtifact,
 )
+from .spool import EventSpool
 from .writer import EventFileWriter, LogWriter
 
 # Env contract injected by the compiler/operator (compiler/converter.py).
@@ -35,6 +38,32 @@ ENV_API_HOST = "PLX_API_HOST"
 # trace correlation (ISSUE 5): pod-side spans join the control plane's run
 # timeline through this id (defaults to the run uuid when absent)
 ENV_TRACE_ID = "POLYAXON_TRACE_ID"
+
+
+def _pod_retry():
+    """The pod-side client's retry: SHORT. A control-plane outage routes
+    writes to the local spool (ISSUE 7) — a long in-line retry would
+    stall the training step loop for the whole backoff budget at every
+    log call, which is exactly the 'outage stalls the run' failure the
+    spool exists to prevent. One quick re-try rides out a blip; anything
+    longer is the spool's job."""
+    from ..resilience.retry import RetryPolicy
+
+    return RetryPolicy(max_attempts=2, base_delay=0.1, max_delay=0.5,
+                       deadline=3.0)
+
+
+def _spoolable(exc: BaseException) -> bool:
+    """Failures the spool absorbs: the API is unreachable or transiently
+    failing (connection errors, timeouts, 5xx/429 after the short retry).
+    Terminal verdicts — fencing 409s, epoch 410s, plain 4xx — are NOT
+    spooled: replaying them later would get the same answer."""
+    status = getattr(exc, "status", None)
+    if status is not None:
+        return status in (429, 500, 502, 503, 504)
+    # requests exceptions subclass OSError; TimeoutError/ConnectionError
+    # cover the in-proc and socket paths
+    return isinstance(exc, (ConnectionError, TimeoutError, OSError))
 
 
 class Run:
@@ -64,8 +93,90 @@ class Run:
         if client is None and api_host:
             from ..client import RunClient
 
-            client = RunClient(host=api_host, project=self.project, run_uuid=self.run_uuid)
+            # api_host may be an ordered, comma-separated endpoint list
+            # (primary + standbys): the client rotates through it (ISSUE 7)
+            client = RunClient(host=api_host, project=self.project,
+                               run_uuid=self.run_uuid, retry=_pod_retry())
         self.client = client
+        # outage-proof API writes (ISSUE 7): when the control plane is
+        # unreachable, statuses/outputs/heartbeats/lineage spool to an
+        # append-only local file and replay in order on reconnect. Only
+        # API-bound runs carry a spool — a client-less (offline) run has
+        # nothing to spool and must not litter its artifacts dir. A
+        # leftover spool from a previous incarnation of this run (pod
+        # crashed mid-outage) is picked up and drained here.
+        self._spool = (EventSpool(self.run_dir)
+                       if self.client is not None else None)
+        self.spool_retry_interval = 5.0
+        self._spool_probe_at = 0.0
+        if self._spool is not None and self._spool.depth:
+            try:
+                self.flush_spool()
+            except Exception:
+                pass
+
+    # -- API writes through the outage spool (ISSUE 7) ---------------------
+
+    @property
+    def spool_depth(self) -> int:
+        """API writes waiting locally for the control plane to come back."""
+        return self._spool.depth if self._spool is not None else 0
+
+    def _api(self, verb: str, /, **kwargs: Any) -> Any:
+        """One API-bound write. While the spool is non-empty every write
+        is APPENDED behind it (emission order is part of the no-gaps
+        contract), with a rate-limited reconnect probe; a fresh failure
+        spools the write instead of raising into the training loop.
+        ``verb`` is positional-only so a user OUTPUT named "verb"
+        (``log_outputs(verb=...)``) cannot collide with it."""
+        if self.client is None:
+            return None
+        if self._spool.depth:
+            if time.monotonic() >= self._spool_probe_at:
+                try:
+                    self.flush_spool()
+                except Exception:
+                    pass
+            if self._spool.depth:
+                self._spool.append(verb, kwargs)
+                return None
+        try:
+            return getattr(self.client, verb)(**kwargs)
+        except Exception as e:
+            if not _spoolable(e):
+                raise
+            self._spool.append(verb, kwargs)
+            self._spool_probe_at = (time.monotonic()
+                                    + self.spool_retry_interval)
+            return None
+
+    def flush_spool(self) -> int:
+        """Replay spooled writes in order. Unreachable-API failures abort
+        the replay (everything undelivered stays spooled, order intact)
+        and re-arm the probe timer; terminal rejections (a late status on
+        a stopped run, a 4xx) are logged and DROPPED — holding the queue
+        hostage to one unreplayable record would gap everything behind
+        it. Returns records delivered (dropped ones count: they are
+        resolved)."""
+        if self.client is None or self._spool is None:
+            return 0
+
+        def _send(rec: dict) -> None:
+            try:
+                getattr(self.client, rec["verb"])(**rec["kwargs"])
+            except Exception as e:
+                if _spoolable(e):
+                    self._spool_probe_at = (time.monotonic()
+                                            + self.spool_retry_interval)
+                    raise
+                traceback.print_exc()  # terminal: drop, keep draining
+
+        return self._spool.replay(_send)
+
+    def heartbeat(self) -> None:
+        """Renew this run's liveness lease (spooled through an outage so
+        the post-failover reaper sees the replayed beats, not a corpse)."""
+        self._api("heartbeat")
 
     # -- logging -----------------------------------------------------------
 
@@ -168,8 +279,7 @@ class Run:
 
     def log_outputs(self, **outputs: Any) -> None:
         self._outputs.update(outputs)
-        if self.client:
-            self.client.log_outputs(**outputs)
+        self._api("log_outputs", **outputs)
 
     def log_artifact(
         self, name: str, path: str, kind: str = "file", is_input: bool = False,
@@ -181,8 +291,9 @@ class Run:
             "artifact", name,
             V1Event.make(artifact=V1EventArtifact(kind=kind, path=path)),
         )
-        if self.client:
-            self.client.log_artifact_lineage(art)
+        # spooled as the dict form (JSON round-trippable); the client
+        # accepts both shapes
+        self._api("log_artifact_lineage", artifact=art.to_dict())
 
     @property
     def outputs_dir(self) -> str:
@@ -193,8 +304,7 @@ class Run:
     # -- lifecycle ---------------------------------------------------------
 
     def log_status(self, status: str, reason: Optional[str] = None, message: Optional[str] = None) -> None:
-        if self.client:
-            self.client.log_status(status, reason=reason, message=message)
+        self._api("log_status", status=status, reason=reason, message=message)
 
     def end(self, status: Optional[str] = None) -> None:
         self._writer.flush()
@@ -205,10 +315,18 @@ class Run:
 
             with open(os.path.join(self.run_dir, "outputs.json"), "w", encoding="utf-8") as f:
                 json.dump(self._outputs, f)
-            if self.client:
-                self.client.log_outputs(**self._outputs)
+            self._api("log_outputs", **self._outputs)
         if status:
             self.log_status(status)
+        if self._spool is not None and self._spool.depth:
+            # last chance to drain before the process exits; whatever
+            # stays is durable on disk — a restarted attempt (same run
+            # dir) picks it up, and the agent's terminal outputs.json
+            # merge covers the outputs either way
+            try:
+                self.flush_spool()
+            except Exception:
+                pass
         self._writer.close()
         self._logger.close()
         global _active

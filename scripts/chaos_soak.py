@@ -20,12 +20,20 @@ Usage:
         [--rounds 1] [--keep] \
         [--kill-agent] [--split-brain] [--kills 2] [--lease-ttl 0.8] \
         [--agents 4] [--num-shards 8] [--rolling-kill] \
-        [--metrics-dump [PATH]]
+        [--store-outage] [--metrics-dump [PATH]]
 
 ``--agents N`` (ISSUE 6) runs the SHARDED fleet soak: N concurrently-
 active agents split the shard leases over one store; ``--rolling-kill``
 kills victims WITHOUT replacement, so the survivors must adopt every
 orphaned shard within 2x the lease TTL (measured, gates exit 0).
+
+``--store-outage`` (ISSUE 7) kills the PRIMARY STORE mid-wave instead of
+an agent: the fleet's store front is [primary, warm standby]; the standby
+tails the changelog, promotes on primary silence (bumping the store
+epoch), and the soak asserts oracle convergence, zero duplicate launches,
+promotion < 2x lease TTL, and that a pre-failover fencing token AND a
+pre-failover ``?since=`` cursor are both deterministically rejected
+(epoch fence 409 / 410) — all via the strict /metrics scrape.
 
 ``--metrics-dump`` archives the last round's final /metrics scrape
 (validated Prometheus text, docs/OBSERVABILITY.md) into bench_artifacts —
@@ -452,6 +460,212 @@ def _sharded_kill_soak(workdir: str, *, seed: int, n_jobs: int, kills: int,
             a.stop()
 
 
+def run_store_outage_soak(workdir: str, seed: int = 2024, n_jobs: int = 12,
+                          agents: int = 4, num_shards: int = 8,
+                          lease_ttl: float = 0.8, timeout: float = 300.0,
+                          kill_store: bool = True, chaos_cfg=None) -> dict:
+    """The ISSUE 7 store-survivability soak: a job wave under ``agents``
+    sharded agents whose store front is [primary, warm standby]; mid-wave
+    the PRIMARY STORE HOST is killed (``OutageStore.kill_store()`` —
+    replication link included). The standby must promote within the
+    lease-style silence bound, every agent must be epoch-fenced off its
+    old tokens and re-acquire on the new primary, and the fleet must
+    converge to the fault-free oracle with zero duplicate launches and
+    zero lost terminal transitions. ``kill_store=False`` is the oracle
+    pass (replication still running — the standby tails the whole wave).
+
+    Returned dict: statuses + the shared /metrics scrape + promotion and
+    shard-re-own timings + the epoch-fence/feed-410 probe results."""
+    from polyaxon_tpu.api.replication import FailoverStore, ReplicatedStandby
+    from polyaxon_tpu.api.store import (
+        SHARD_PREFIX, FencedStore, StaleEpochError, StaleLeaseError, Store)
+    from polyaxon_tpu.obs.metrics import MetricsRegistry
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.resilience import ChaosCluster, OutageStore
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    rng = random.Random(seed)
+    # ONE registry across primary + standby: the scrape is the control
+    # plane's pane of glass and must stay continuous through the failover
+    reg = MetricsRegistry()
+    primary = Store(":memory:", metrics=reg)
+    gate = OutageStore(primary)
+    standby = Store(":memory:", metrics=reg)
+    snap_dir = os.path.join(workdir, "snapshots")
+    primary.snapshot(snap_dir)  # standby bootstraps like a prod replica
+    repl = ReplicatedStandby(
+        gate, standby, poll_interval=0.02,
+        promote_after=(lease_ttl if kill_store else None),
+        snapshot_dir=snap_dir)
+    repl.bootstrap()
+    repl.start()
+    front = FailoverStore([gate, standby])
+    cluster = FakeCluster(os.path.join(workdir, ".cluster"))
+    if chaos_cfg is not None:
+        cluster = ChaosCluster(cluster, chaos_cfg)
+
+    def new_agent():
+        return LocalAgent(front, workdir, backend="cluster", cluster=cluster,
+                          poll_interval=0.05, lease_ttl=lease_ttl,
+                          num_shards=num_shards, max_parallel=4).start()
+
+    fleet = [new_agent() for _ in range(agents)]
+
+    def _covered(store) -> bool:
+        rows = store.list_leases(SHARD_PREFIX)
+        return sum(1 for r in rows if not r["expired"]) >= num_shards
+
+    def _wait(pred, budget: float) -> bool:
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return pred()
+
+    promote_s = reown_s = None
+    epoch_fenced = feed_410 = None
+    try:
+        if not _wait(lambda: _covered(primary), 30.0):
+            raise RuntimeError("fleet never covered the shard space")
+        uuids = [front.create_run("p", spec=s, name=s.get("name"))["uuid"]
+                 for s in _wave_specs(n_jobs, rng)]
+        if kill_store:
+            time.sleep(rng.uniform(0.4, 1.0))  # mid-wave
+            # pin a live shard token + a feed cursor from the old epoch:
+            # the dead primary's in-flight writes and a dashboard's
+            # pre-failover ?since= poller, replayed against the survivor
+            live = [r for r in primary.list_leases(SHARD_PREFIX)
+                    if not r["expired"]]
+            pinned = live[rng.randrange(len(live))] if live else None
+            old_cursor = primary.feed_token(primary.current_seq())
+            gate.kill_store()
+            t_kill = time.monotonic()
+            if not _wait(lambda: repl.promoted, 10.0 * lease_ttl):
+                raise RuntimeError("standby never promoted")
+            promote_s = round(time.monotonic() - t_kill, 3)
+            if pinned is not None:
+                try:
+                    FencedStore(
+                        standby,
+                        lambda: (pinned["name"], pinned["token"])).transition(
+                        uuids[rng.randrange(len(uuids))], "stopping")
+                    epoch_fenced = False
+                except StaleLeaseError:
+                    epoch_fenced = True
+            try:
+                standby.parse_since(old_cursor)
+                feed_410 = False
+            except StaleEpochError:
+                feed_410 = True
+            reowned = _wait(lambda: _covered(standby),
+                            max(6.0 * lease_ttl, 15.0))
+            reown_s = (round(time.monotonic() - t_kill, 3) if reowned
+                       else float("inf"))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = [front.get_run(u) for u in uuids]
+            if all(r["status"] in ("succeeded", "failed", "stopped")
+                   for r in rows):
+                break
+            time.sleep(0.1)
+        statuses = {r["name"]: r["status"]
+                    for r in (front.get_run(u) for u in uuids)}
+        serving = standby if kill_store else primary
+        return {
+            "statuses": statuses,
+            "metrics_text": reg.render(),
+            "epoch": serving.current_epoch(),
+            "promote_s": promote_s,
+            "shard_reown_s": reown_s,
+            "epoch_fenced": epoch_fenced,
+            "feed_410": feed_410,
+            "fence_rejections": serving.stats["fence_rejections"],
+            "epoch_fence_rejections":
+                serving.stats["epoch_fence_rejections"],
+            "replication_lag": repl.lag,
+            "launch_intents": (primary.stats["launch_intents"]
+                               + standby.stats["launch_intents"]),
+            "launch_counts": dict(getattr(cluster, "launch_counts", {})),
+            "duplicate_applies": list(
+                getattr(cluster, "duplicate_applies", [])),
+            "injected": len(list(getattr(cluster, "injected", []))),
+            "agents": agents,
+            "num_shards": num_shards,
+            "lease_ttl": lease_ttl,
+        }
+    finally:
+        repl.stop()
+        live = [a for a in fleet if not a._dead]
+        for a in live[:-1]:
+            a.drain()
+        for a in live[-1:]:
+            a.stop()
+
+
+def _run_store_outage_mode(args) -> int:
+    root = tempfile.mkdtemp(prefix="plx-store-outage-soak-")
+    ok = True
+    final_scrape = ""
+    try:
+        oracle = run_store_outage_soak(
+            os.path.join(root, "oracle"), seed=args.seed,
+            n_jobs=args.trials * 3, agents=args.agents,
+            num_shards=args.num_shards, lease_ttl=args.lease_ttl,
+            timeout=args.timeout, kill_store=False)
+        final_scrape = oracle["metrics_text"]
+        print(json.dumps({"pass": "oracle", "statuses": oracle["statuses"]}))
+        if any(v != "succeeded" for v in oracle["statuses"].values()):
+            print(json.dumps({"error": "oracle pass did not fully succeed"}))
+            return 2
+        for i in range(args.rounds):
+            seed = args.seed + i
+            out = run_store_outage_soak(
+                os.path.join(root, f"outage-{seed}"), seed=seed,
+                n_jobs=args.trials * 3, agents=args.agents,
+                num_shards=args.num_shards, lease_ttl=args.lease_ttl,
+                timeout=args.timeout, kill_store=True)
+            final_scrape = out["metrics_text"]
+            converged = out["statuses"] == oracle["statuses"]
+            round_ok = (
+                converged
+                and not out["duplicate_applies"]
+                and out["epoch"] >= 1
+                and out["epoch_fenced"] is True
+                and out["feed_410"] is True
+                and out["epoch_fence_rejections"] >= 1
+                and out["promote_s"] is not None
+                and out["promote_s"] < 2.0 * args.lease_ttl
+            )
+            ok = ok and round_ok
+            print(json.dumps({
+                "pass": f"store-outage-{seed}", "ok": round_ok,
+                "converged": converged,
+                "promote_s": out["promote_s"],
+                "shard_reown_s": out["shard_reown_s"],
+                "epoch": out["epoch"],
+                "epoch_fenced": out["epoch_fenced"],
+                "feed_410": out["feed_410"],
+                "epoch_fence_rejections": out["epoch_fence_rejections"],
+                "duplicate_applies": out["duplicate_applies"],
+                "diff": {k: (oracle["statuses"].get(k),
+                             out["statuses"].get(k))
+                         for k in set(oracle["statuses"])
+                         | set(out["statuses"])
+                         if oracle["statuses"].get(k)
+                         != out["statuses"].get(k)},
+            }))
+    finally:
+        if args.keep:
+            print(json.dumps({"workdir": root}))
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+    if args.metrics_dump:
+        _dump_metrics(args.metrics_dump, final_scrape)
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
 def _dump_metrics(path: str, text: str) -> None:
     """Archive the final /metrics scrape of the last round (validated
     Prometheus text) so every soak leaves a machine-readable telemetry
@@ -568,6 +782,13 @@ def main() -> int:
                    help="with --agents > 1: kill victims WITHOUT "
                         "replacement — survivors must adopt the orphaned "
                         "shards within 2x the lease TTL")
+    p.add_argument("--store-outage", action="store_true",
+                   help="store-survivability soak (ISSUE 7): kill the "
+                        "PRIMARY STORE mid-wave under a sharded agent "
+                        "fleet; the warm standby must promote, epoch-fence "
+                        "every pre-failover token/cursor, and converge to "
+                        "the fault-free oracle with zero duplicate "
+                        "launches and zero lost terminal transitions")
     p.add_argument("--metrics-dump", nargs="?", metavar="PATH",
                    const=os.path.join(
                        os.path.dirname(os.path.dirname(
@@ -579,6 +800,8 @@ def main() -> int:
                         "bench_artifacts/chaos_soak_metrics.prom)")
     args = p.parse_args()
 
+    if args.store_outage:
+        return _run_store_outage_mode(args)
     if (args.kill_agent or args.split_brain or args.rolling_kill
             or args.agents > 1):
         args.kill_agent = True

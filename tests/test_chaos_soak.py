@@ -408,6 +408,56 @@ class TestAgentKillSoak:
         assert all(c >= 1 for c in out["launch_counts"].values()), out
 
 
+class TestStoreOutageSoak:
+    def test_store_kill_under_sharded_fleet_converges(self, tmp_path):
+        """ISSUE 7 acceptance soak: the PRIMARY STORE HOST is killed
+        mid-wave under 4 sharded agents whose store front is [primary,
+        warm standby]. The standby must promote within 2x the lease TTL,
+        a pre-failover fencing token AND a pre-failover ?since= cursor
+        must both be deterministically rejected (epoch fence 409 / 410),
+        the whole shard space must be re-owned on the new primary, and
+        the fleet must converge to the fault-free oracle with ZERO
+        duplicate pod launches and ZERO lost terminal transitions — all
+        asserted via the strict /metrics scrape of the SHARED registry
+        (one pane of glass across the failover)."""
+        from chaos_soak import run_store_outage_soak
+
+        from polyaxon_tpu.obs import parse_prometheus
+
+        lease_ttl = 0.8
+        oracle = run_store_outage_soak(
+            str(tmp_path / "oracle"), seed=2024, n_jobs=8, agents=4,
+            num_shards=8, lease_ttl=lease_ttl, kill_store=False)
+        assert all(v == "succeeded" for v in oracle["statuses"].values()), \
+            oracle
+        # the oracle pass exercises replication end to end: its standby
+        # tailed the whole wave and finished caught up
+        assert oracle["replication_lag"] == 0, oracle
+        out = run_store_outage_soak(
+            str(tmp_path / "outage"), seed=2024, n_jobs=8, agents=4,
+            num_shards=8, lease_ttl=lease_ttl, kill_store=True)
+        # zero lost terminal transitions == every run reached its oracle
+        # terminal status even though the primary died mid-wave
+        assert out["statuses"] == oracle["statuses"], out
+        assert out["duplicate_applies"] == [], out
+        assert out["epoch"] >= 1, out
+        assert out["promote_s"] < 2.0 * lease_ttl, out
+        assert out["shard_reown_s"] != float("inf"), out
+        assert out["epoch_fenced"] is True, out
+        assert out["feed_410"] is True, out
+        assert out["epoch_fence_rejections"] >= 1, out
+        # strict scrape: the survivability families carry the same story
+        families = parse_prometheus(out["metrics_text"])
+        assert families["polyaxon_store_epoch"][
+            "polyaxon_store_epoch"] >= 1.0
+        assert families["polyaxon_store_epoch_fence_rejections_total"][
+            "polyaxon_store_epoch_fence_rejections_total"] >= 1.0
+        assert "polyaxon_store_replication_lag" in families
+        # every run launched at least one real pod set
+        assert len(out["launch_counts"]) == 8, out
+        assert all(c >= 1 for c in out["launch_counts"].values()), out
+
+
 # ---------------------------------------------------------------------------
 # 4. agent SIGKILL + slice death + TORN newest checkpoint -> resume from
 #    the newest COMPLETE step (ISSUE 4 acceptance criterion)

@@ -228,6 +228,44 @@ class TestFaultyStoreLeaseVerbs:
             assert out, (verb, out)
             assert verb in store.injected
 
+    def test_replication_verbs_gated_too(self, tmp_path):
+        """ISSUE 7: the standby's tail (get_changelog/apply_changelog),
+        the snapshot writer and promotion ride the same SQLITE_BUSY gate
+        — a blip costs one poll, never the applied-seq watermark."""
+        import sqlite3
+
+        inner = Store(":memory:")
+        run = inner.create_run("p", spec={"component": {
+            "run": {"kind": "job", "container": {"command": ["true"]}}}})
+        store = FaultyStore(inner, seed=9, fault_rate=1.0, max_faults=0)
+        standby = Store(":memory:")
+        flaky_standby = FaultyStore(standby, seed=9, fault_rate=1.0,
+                                    max_faults=0)
+        for gated, verb, call in (
+            (store, "get_changelog", lambda: store.get_changelog(0, 100)),
+            (flaky_standby, "apply_changelog",
+             lambda: [flaky_standby.apply_changelog(
+                 inner.get_changelog(0, 100))]),
+            (store, "snapshot", lambda: store.snapshot(str(tmp_path))),
+            (store, "promote", lambda: store.promote()),
+            (store, "changelog_span", lambda: store.changelog_span()),
+        ):
+            gated._max_faults = gated._faults + 1  # re-arm: one fault
+            out = None
+            for _ in range(10):
+                try:
+                    out = call()
+                    break
+                except sqlite3.OperationalError:
+                    pass
+            assert out, (verb, out)
+            assert verb in gated.injected
+        # the retried replay converged despite the weather around it (the
+        # applied-seq watermark absorbed the re-poll — no double apply)
+        assert standby.get_run(run["uuid"]) is not None
+        assert len(standby.get_statuses(run["uuid"])) == \
+            len(inner.get_statuses(run["uuid"]))
+
 
 # ---------------------------------------------------------------------------
 # write-ahead launch intents: replay, adoption, slice loss

@@ -53,6 +53,11 @@ EXPECTED_FAMILIES = {
     "polyaxon_reaper_reaps_total",
     "polyaxon_retry_exhaustions_total",
     "polyaxon_heartbeat_staleness_seconds",
+    # store survivability (ISSUE 7): epoch + failure-mode gauges are part
+    # of every store's scrape from birth
+    "polyaxon_store_epoch",
+    "polyaxon_store_degraded",
+    "polyaxon_store_epoch_fence_rejections_total",
 }
 
 
@@ -675,3 +680,46 @@ class TestShardLabeledFamilies:
         assert r.exit_code == 0, r.output
         assert "agent aaaabbbbcccc: 1 shard(s) — shard-0" in r.output
         assert "orphaned shards" in r.output and "shard-1" in r.output
+
+
+# -- store-survivability families (ISSUE 7 obs satellite) ---------------------
+
+
+class TestStoreSurvivabilityFamilies:
+    def test_replication_and_epoch_families_through_strict_parser(self):
+        """A primary+standby pair sharing one registry exports the
+        survivability families — epoch, degraded flag, replication lag /
+        health, epoch-fence rejections — all strict-parse clean, and the
+        epoch gauge follows a promotion."""
+        from polyaxon_tpu.api.replication import ReplicatedStandby
+        from polyaxon_tpu.api.store import StaleLeaseError
+        from polyaxon_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        primary = Store(":memory:", metrics=reg)
+        standby = Store(":memory:", metrics=reg)
+        repl = ReplicatedStandby(primary, standby, poll_interval=0.01)
+        lease = primary.acquire_lease("scheduler", "a1", ttl=30)
+        run = primary.create_run("p", spec={"component": {"run": {
+            "kind": "job", "container": {"command": ["true"]}}}})
+        repl.poll_once()
+        fams = parse_prometheus(reg.render())
+        for family in ("polyaxon_store_epoch", "polyaxon_store_degraded",
+                       "polyaxon_store_replication_lag",
+                       "polyaxon_store_replication_healthy",
+                       "polyaxon_store_epoch_fence_rejections_total"):
+            assert family in fams, sorted(fams)
+        assert fams["polyaxon_store_replication_lag"][
+            "polyaxon_store_replication_lag"] == 0.0
+        assert fams["polyaxon_store_replication_healthy"][
+            "polyaxon_store_replication_healthy"] == 1.0
+        repl.promote()
+        try:
+            standby.transition(run["uuid"], "compiled",
+                               fence=("scheduler", lease["token"]))
+        except StaleLeaseError:
+            pass
+        fams = parse_prometheus(reg.render())
+        assert fams["polyaxon_store_epoch"]["polyaxon_store_epoch"] == 1.0
+        assert fams["polyaxon_store_epoch_fence_rejections_total"][
+            "polyaxon_store_epoch_fence_rejections_total"] == 1.0

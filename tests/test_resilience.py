@@ -58,6 +58,34 @@ class TestRetryPolicy:
             policy.call(bad, sleep=lambda _t: None)
         assert len(calls) == 1
 
+    def test_fencing_409_and_epoch_410_are_never_retried(self):
+        """ISSUE 7 satellite pin: 409 (stale fence — demote, don't
+        re-send) and 410 (dead epoch — full resync, don't re-poll) are
+        verdicts, not weather. They must not burn retry budget under the
+        default policy, NOR under a custom retry_statuses set that
+        (mistakenly) lists them, nor in default_classify."""
+        from polyaxon_tpu.resilience.retry import default_classify
+
+        default = RetryPolicy(max_attempts=5, base_delay=0.001)
+        custom = RetryPolicy(max_attempts=5, base_delay=0.001,
+                             retry_statuses=frozenset({409, 410, 503}))
+        for status in (409, 410):
+            exc = KubeApiError(status, "verdict")
+            assert default.is_retryable(exc) is False
+            assert custom.is_retryable(exc) is False
+            assert default_classify(exc) is False
+            calls = []
+
+            def verdict():
+                calls.append(1)
+                raise KubeApiError(status, "verdict")
+
+            with pytest.raises(KubeApiError):
+                custom.call(verdict, sleep=lambda _t: None)
+            assert len(calls) == 1
+        # 503 through the same custom policy still retries (control)
+        assert custom.is_retryable(KubeApiError(503, "busy")) is True
+
     def test_budget_exhaustion_raises_last_error(self):
         calls = []
 
@@ -541,6 +569,48 @@ class TestZombieReaper:
         store.heartbeat(uuid)
         reaper = ZombieReaper(store, owned=set, zombie_after=3600.0)
         assert reaper.pass_once() == []
+
+    def test_failover_grace_holds_reaps_until_spooled_beats_land(self):
+        """ISSUE 7 satellite: a store-epoch bump (failover to a promoted
+        standby) must clear strikes and pause reaping for the grace
+        window — pods that heartbeated through the outage are REPLAYING
+        their spooled beats, and the two-stale-pass rule would otherwise
+        false-positive a healthy pod off failover-shaped staleness."""
+        store = Store(":memory:")
+        uuid = self._zombie_run(store, max_retries=1)
+        reaper = ZombieReaper(store, owned=set, zombie_after=0.05,
+                              failover_grace=0.4)
+        time.sleep(0.1)
+        assert reaper.pass_once() == []  # strike one, pre-failover
+        store.promote()                  # the failover happens HERE
+        self._unthrottle(reaper)
+        # would have been strike two -> reap; the epoch change must
+        # clear the strike and open the grace window instead
+        assert reaper.pass_once() == []
+        assert store.get_run(uuid)["status"] == "running"
+        self._unthrottle(reaper)
+        assert reaper.pass_once() == []  # still inside grace: no strikes
+        # the pod's spooled heartbeat replays before grace expires
+        store.heartbeat(uuid)
+        time.sleep(0.45)                 # grace over
+        self._unthrottle(reaper)
+        assert reaper.pass_once() == []  # fresh beat: alive, strike-free
+        assert store.get_run(uuid)["status"] == "running"
+
+    def test_failover_grace_expires_then_real_zombies_still_reap(self):
+        store = Store(":memory:")
+        uuid = self._zombie_run(store, max_retries=1)
+        reaper = ZombieReaper(store, owned=set, zombie_after=0.05,
+                              failover_grace=0.1)
+        assert reaper.pass_once() == []  # observes epoch 0, run fresh
+        store.promote()
+        self._unthrottle(reaper)
+        assert reaper.pass_once() == []  # epoch change: grace opens
+        time.sleep(0.2)                  # grace over, run still silent
+        self._unthrottle(reaper)
+        assert reaper.pass_once() == []  # strike one
+        self._unthrottle(reaper)
+        assert reaper.pass_once() == [(uuid, "retried")]
 
     def test_agent_requeues_and_reruns_zombie(self, tmp_path):
         """E2E: a run stuck in `running` with no driver gets routed through

@@ -1,0 +1,824 @@
+"""Store survivability (ISSUE 7): changelog replication, sha256-manifested
+snapshots, epoch-fenced promotion, read-only/degraded write gates, the
+client's multi-endpoint failover front, the pod-side outage spool, and the
+tier-1 store-kill smoke (the full seeded soak lives in test_chaos_soak.py
+and scripts/chaos_soak.py --store-outage)."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from polyaxon_tpu.api.replication import (
+    FailoverStore, ReplicatedStandby, StoreUnavailableError,
+    TornSnapshotError, restore_snapshot, snapshot_to, verify_snapshot,
+)
+from polyaxon_tpu.api.server import ApiServer
+from polyaxon_tpu.api.store import (
+    FencedStore, StaleEpochError, StaleLeaseError, Store,
+    StoreDegradedError, StoreReadOnlyError, token_epoch,
+)
+from polyaxon_tpu.client import ApiError, RunClient
+from polyaxon_tpu.obs.metrics import MetricsRegistry, parse_prometheus
+from polyaxon_tpu.resilience import OutageStore, tear_snapshot
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+JOB = {"component": {"run": {"kind": "job",
+                             "container": {"command": ["true"]}}}}
+
+
+def _populated_store(**kw):
+    s = Store(":memory:", **kw)
+    r = s.create_run("p", spec=JOB, name="one")
+    s.transition(r["uuid"], "compiled")
+    s.transition(r["uuid"], "queued")
+    s.merge_outputs(r["uuid"], {"k": 1})
+    s.heartbeat(r["uuid"])
+    s.record_launch_intent(r["uuid"], "holder-1", None, lease_name="shard-0")
+    s.mark_launched(r["uuid"])
+    s.add_lineage(r["uuid"], {"name": "m", "kind": "file", "path": "/x"})
+    s.claim_config("num_shards", "4")
+    return s, r["uuid"]
+
+
+def _same_world(a: Store, b: Store, uuid: str):
+    assert b.get_run(uuid) == a.get_run(uuid)
+    assert b.get_statuses(uuid) == a.get_statuses(uuid)
+    assert b.get_launch_intent(uuid) == a.get_launch_intent(uuid)
+    assert b.get_lineage(uuid) == a.get_lineage(uuid)
+    assert b.get_config("num_shards") == a.get_config("num_shards")
+    assert b.list_projects() == a.list_projects()
+
+
+# ---------------------------------------------------------------------------
+# changelog replication
+# ---------------------------------------------------------------------------
+
+
+class TestChangelogReplication:
+    def test_every_write_replays_into_an_identical_world(self):
+        primary, uuid = _populated_store()
+        standby = Store(":memory:")
+        applied = standby.apply_changelog(primary.get_changelog(0, 1000))
+        assert applied > 0
+        _same_world(primary, standby, uuid)
+        # incremental tail: new writes after the first apply
+        primary.transition(uuid, "scheduled")
+        primary.update_run(uuid, name="renamed")
+        rows = primary.get_changelog(standby._applied_seq, 1000)
+        assert rows and standby.apply_changelog(rows) == len(rows)
+        _same_world(primary, standby, uuid)
+
+    def test_apply_handles_unsorted_batches(self):
+        """The watermark must come from the HIGHEST applied seq, not the
+        input order — an unsorted batch would otherwise leave
+        _applied_seq low and the next poll would re-apply rows,
+        duplicating plain-INSERT ops (conditions, lineage)."""
+        primary, uuid = _populated_store()
+        standby = Store(":memory:")
+        rows = primary.get_changelog(0, 1000)
+        shuffled = list(reversed(rows))
+        assert standby.apply_changelog(shuffled) == len(rows)
+        assert standby._applied_seq == max(r["seq"] for r in rows)
+        conds = len(standby.get_statuses(uuid))
+        assert standby.apply_changelog(rows) == 0  # nothing re-applied
+        assert len(standby.get_statuses(uuid)) == conds
+        _same_world(primary, standby, uuid)
+
+    def test_apply_is_idempotent(self):
+        primary, uuid = _populated_store()
+        standby = Store(":memory:")
+        rows = primary.get_changelog(0, 1000)
+        standby.apply_changelog(rows)
+        conds = len(standby.get_statuses(uuid))
+        # a re-poll delivering the same rows must change NOTHING — the
+        # applied-seq watermark absorbs it (a standby re-polls after any
+        # partial failure)
+        assert standby.apply_changelog(rows) == 0
+        assert len(standby.get_statuses(uuid)) == conds
+
+    def test_changelog_order_is_commit_order(self):
+        s = Store(":memory:")
+        uuids = [r["uuid"] for r in s.create_runs(
+            "p", [dict(spec=JOB, name=f"r{i}") for i in range(5)])]
+        s.transition_many([(u, "compiled") for u in uuids])
+        seqs = [r["seq"] for r in s.get_changelog(0, 1000)]
+        assert seqs == sorted(seqs)
+        assert len(seqs) == len(set(seqs))
+
+    def test_delete_replays(self):
+        primary, uuid = _populated_store()
+        standby = Store(":memory:")
+        standby.apply_changelog(primary.get_changelog(0, 1000))
+        primary.delete_run(uuid)
+        standby.apply_changelog(
+            primary.get_changelog(standby._applied_seq, 1000))
+        assert standby.get_run(uuid) is None
+        assert standby.get_launch_intent(uuid) is None
+
+    def test_snapshot_compaction_keeps_tailable_floor(self, tmp_path):
+        from polyaxon_tpu.api.store import CompactedLogError
+
+        primary, uuid = _populated_store()
+        manifest = snapshot_to(primary, str(tmp_path), keep=3)
+        floor = manifest["seq"] - 3
+        seqs = [r["seq"] for r in primary.get_changelog(floor, 1000)]
+        assert seqs and min(seqs) > floor
+        # a cursor BELOW the recorded floor is a loud error, never a
+        # silent skip of the pruned rows
+        with pytest.raises(CompactedLogError):
+            primary.get_changelog(0, 1000)
+        # a standby bootstrapping from THIS snapshot then tailing the
+        # pruned changelog still converges (its cursor starts at the
+        # snapshot seq, above the floor)
+        fresh = Store(":memory:")
+        restore_snapshot(str(tmp_path), fresh)
+        primary.transition(uuid, "scheduled")
+        fresh.apply_changelog(
+            primary.get_changelog(fresh._applied_seq, 1000))
+        assert fresh.get_run(uuid)["status"] == "scheduled"
+
+    def test_compacted_cursor_never_triggers_promotion(self, tmp_path):
+        """A standby whose cursor fell below the compaction floor is in
+        re-bootstrap territory: the primary is ALIVE, so the silence rule
+        must not fire — and no rows may be silently skipped."""
+        primary, _ = _populated_store()
+        snapshot_to(primary, str(tmp_path), keep=0)
+        lagging = Store(":memory:")  # empty: cursor 0, below the floor
+        repl = ReplicatedStandby(primary, lagging, promote_after=0.05)
+        for _ in range(4):
+            repl.poll_once()
+            time.sleep(0.02)
+        assert repl.promoted is False
+        assert repl.healthy is False
+        assert lagging.count_runs() == 0  # nothing half-applied
+
+
+# ---------------------------------------------------------------------------
+# snapshots: manifest, torn detection, bootstrap fallback
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshots:
+    def test_manifest_roundtrip_and_restore(self, tmp_path):
+        primary, uuid = _populated_store()
+        manifest = primary.snapshot(str(tmp_path))
+        assert manifest["seq"] == primary.current_seq()
+        assert verify_snapshot(str(tmp_path))["sha256"] == manifest["sha256"]
+        fresh = Store(":memory:")
+        restore_snapshot(str(tmp_path), fresh)
+        _same_world(primary, fresh, uuid)
+        assert fresh._applied_seq == manifest["seq"]
+
+    def test_torn_snapshot_is_detected_not_restored(self, tmp_path):
+        primary, _ = _populated_store()
+        primary.snapshot(str(tmp_path))
+        assert tear_snapshot(str(tmp_path)) is not None
+        with pytest.raises(TornSnapshotError):
+            verify_snapshot(str(tmp_path))
+
+    def test_standby_bootstrap_falls_back_past_torn_snapshot(self, tmp_path):
+        """A torn snapshot must cost the bootstrap shortcut, never
+        correctness: the standby tails the full changelog instead and
+        still converges to the primary's world."""
+        primary, uuid = _populated_store()
+        primary.snapshot(str(tmp_path))
+        tear_snapshot(str(tmp_path))
+        standby = Store(":memory:")
+        repl = ReplicatedStandby(primary, standby,
+                                 snapshot_dir=str(tmp_path))
+        assert repl.bootstrap() is None  # rejected, not restored
+        repl.poll_once()
+        _same_world(primary, standby, uuid)
+        assert repl.lag == 0
+
+
+# ---------------------------------------------------------------------------
+# promotion: epoch bump, fences, feed tokens
+# ---------------------------------------------------------------------------
+
+
+class TestPromotionEpochFencing:
+    def test_promote_fences_every_prefailover_token(self):
+        primary, uuid = _populated_store()
+        standby = Store(":memory:")
+        standby.apply_changelog(primary.get_changelog(0, 1000))
+        old = primary.acquire_lease("shard-0", "a1", ttl=30)
+        assert token_epoch(old["token"]) == 0
+        epoch = standby.promote()
+        assert epoch == 1 and standby.current_epoch() == 1
+        # the dead primary's in-flight write, replayed against the
+        # survivor: deterministic 409, counted as an EPOCH fence
+        with pytest.raises(StaleLeaseError):
+            standby.transition(uuid, "scheduled",
+                               fence=("shard-0", old["token"]))
+        assert standby.stats["epoch_fence_rejections"] == 1
+        # new tokens are strictly greater and carry the new epoch
+        fresh = standby.acquire_lease("shard-0", "a2", ttl=30)
+        assert fresh["token"] > old["token"]
+        assert token_epoch(fresh["token"]) == 1
+        # ...and a write under the NEW token lands
+        run, changed = standby.transition(
+            uuid, "scheduled", fence=("shard-0", fresh["token"]))
+        assert changed and run["status"] == "scheduled"
+
+    def test_poison_fence_rejection_is_not_an_epoch_fence(self):
+        """The agents' demotion poison fence (sentinel token -1) was
+        never minted by any epoch — its rejections must bump only the
+        plain fence counter, or a routine demotion would read as a store
+        failover on the dashboard."""
+        s, uuid = _populated_store()
+        with pytest.raises(StaleLeaseError):
+            s.transition(uuid, "scheduled", fence=("shard-0", -1))
+        assert s.stats["fence_rejections"] == 1
+        assert s.stats["epoch_fence_rejections"] == 0
+
+    def test_prefailover_feed_cursor_gets_410(self):
+        s, _ = _populated_store()
+        cursor = s.feed_token(s.current_seq())
+        assert ":" not in cursor  # epoch 0: legacy bare form
+        s.promote()
+        with pytest.raises(StaleEpochError):
+            s.parse_since(cursor)
+        with pytest.raises(StaleEpochError):
+            s.list_runs(since=cursor)
+        # post-promotion tokens are epoch-qualified and round-trip
+        tok = s.feed_token(s.current_seq())
+        assert tok.startswith("1:")
+        assert s.parse_since(tok) == s.current_seq()
+        assert s.list_runs(since=tok) == []
+
+    def test_promotion_survives_restart_of_the_promoted_store(self, tmp_path):
+        db = str(tmp_path / "db.sqlite")
+        s = Store(db)
+        s.create_run("p", spec=JOB, name="one")
+        s.promote()
+        s2 = Store(db)
+        assert s2.current_epoch() == 1
+        lease = s2.acquire_lease("scheduler", "a1", ttl=30)
+        assert token_epoch(lease["token"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# read-only standby + disk-full degraded mode
+# ---------------------------------------------------------------------------
+
+
+class TestReadOnlyAndDegraded:
+    def test_standby_serves_reads_refuses_writes(self):
+        primary, uuid = _populated_store()
+        standby = Store(":memory:")
+        standby.apply_changelog(primary.get_changelog(0, 1000))
+        standby.set_read_only(True)
+        assert standby.get_run(uuid)["status"] == "queued"  # reads serve
+        with pytest.raises(StoreReadOnlyError):
+            standby.heartbeat(uuid)
+        with pytest.raises(StoreReadOnlyError):
+            standby.create_run("p", spec=JOB, name="two")
+        # replication is NOT a client write: the tail keeps applying
+        primary.transition(uuid, "scheduled")
+        assert standby.apply_changelog(
+            primary.get_changelog(standby._applied_seq, 1000)) > 0
+        standby.promote()
+        assert standby.heartbeat(uuid)  # promotion lifts the gate
+
+    def test_disk_full_degrades_then_probe_recovers(self):
+        import sqlite3
+
+        s, uuid = _populated_store()
+        s.chaos_disk_full(1)
+        with pytest.raises(sqlite3.OperationalError):
+            s.heartbeat(uuid)
+        assert s.degraded is not None
+        # while degraded: writes answer the 503-shaped error WITHOUT
+        # touching sqlite (no crash loop); reads keep serving
+        before = s.stats["transactions"]
+        with pytest.raises(StoreDegradedError):
+            s.heartbeat(uuid)
+        assert s.stats["transactions"] == before
+        assert s.get_run(uuid) is not None
+        # the recovery probe flips it back (disk freed in this scenario)
+        assert s.probe_recovery() is True
+        assert s.degraded is None
+        assert s.heartbeat(uuid)
+
+    def test_degraded_gauge_in_scrape(self):
+        s, _ = _populated_store()
+        s.chaos_disk_full(1)
+        try:
+            s.heartbeat("nope")
+        except Exception:
+            pass
+        fams = parse_prometheus(s.metrics.render())
+        assert fams["polyaxon_store_degraded"]["polyaxon_store_degraded"] == 1.0
+        s.probe_recovery()
+        fams = parse_prometheus(s.metrics.render())
+        assert fams["polyaxon_store_degraded"]["polyaxon_store_degraded"] == 0.0
+        assert "polyaxon_store_epoch" in fams
+        assert "polyaxon_store_epoch_fence_rejections_total" in fams
+
+
+# ---------------------------------------------------------------------------
+# the failover fronts: in-proc store rotation + HTTP client rotation
+# ---------------------------------------------------------------------------
+
+
+class TestFailoverStore:
+    def test_rotates_on_unavailable_sticky(self):
+        primary, uuid = _populated_store()
+        standby = Store(":memory:")
+        standby.apply_changelog(primary.get_changelog(0, 1000))
+        gate = OutageStore(primary)
+        front = FailoverStore([gate, standby])
+        assert front.get_run(uuid)["name"] == "one"
+        gate.kill_store()
+        standby.promote()
+        assert front.get_run(uuid)["name"] == "one"  # rotated
+        assert front.current is standby  # ...and sticky
+        assert front.heartbeat(uuid)
+
+    def test_does_not_rotate_on_sqlite_weather(self):
+        """'database is locked' is same-host weather — retrying THERE is
+        correct; bouncing to the standby would split reads mid-burst."""
+        import sqlite3
+
+        from polyaxon_tpu.resilience import FaultyStore
+
+        primary, uuid = _populated_store()
+        flaky = FaultyStore(primary, seed=1, fault_rate=1.0, max_faults=1)
+        standby = Store(":memory:")
+        front = FailoverStore([flaky, standby])
+        with pytest.raises(sqlite3.OperationalError):
+            front.get_run(uuid)
+        assert front.current is flaky  # no rotation
+
+    def test_read_only_standby_is_waited_on_not_bounced(self):
+        """Primary dead + standby not yet promoted: a write must surface
+        the 503-shaped error (callers treat it as weather and retry),
+        never spin the rotation ring."""
+        primary, uuid = _populated_store()
+        standby = Store(":memory:")
+        standby.apply_changelog(primary.get_changelog(0, 1000))
+        standby.set_read_only(True)
+        gate = OutageStore(primary)
+        gate.kill_store()
+        front = FailoverStore([gate, standby])
+        assert front.get_run(uuid) is not None  # reads rotate + serve
+        with pytest.raises(StoreReadOnlyError):
+            front.heartbeat(uuid)
+        standby.promote()
+        assert front.heartbeat(uuid)
+
+    def test_all_dead_surfaces_unavailable(self):
+        g1, g2 = OutageStore(Store(":memory:")), OutageStore(Store(":memory:"))
+        g1.kill_store()
+        g2.kill_store()
+        front = FailoverStore([g1, g2])
+        with pytest.raises(StoreUnavailableError):
+            front.list_projects()
+
+
+class TestClientEndpointRotation:
+    def _server(self, store=None, **kw):
+        srv = ApiServer(store=store or Store(":memory:"),
+                        artifacts_root=kw.pop("artifacts_root", ".plx/t"),
+                        port=0, **kw)
+        srv.start()
+        return srv
+
+    def test_rotates_past_dead_endpoint(self, tmp_path):
+        srv = self._server(artifacts_root=str(tmp_path))
+        try:
+            srv.store.create_run("p", spec=JOB, name="one")
+            rc = RunClient(host=f"http://127.0.0.1:1,{srv.url}", project="p")
+            assert len(rc.hosts) == 2
+            assert [r["name"] for r in rc.list()] == ["one"]
+            assert rc.host == srv.url  # sticky after the sweep
+        finally:
+            srv.stop()
+
+    def test_rotates_on_503_from_demoted_standby(self, tmp_path):
+        demoted = Store(":memory:")
+        demoted.set_read_only(True)
+        a = self._server(store=demoted, artifacts_root=str(tmp_path / "a"))
+        b = self._server(artifacts_root=str(tmp_path / "b"))
+        try:
+            rc = RunClient(host=[a.url, b.url], project="p")
+            run = rc.create(spec=JOB, name="routed")
+            assert run["uuid"]
+            assert b.store.get_run(run["uuid"]) is not None
+            assert rc.host == b.url
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_409_is_terminal_one_request_no_rotation(self, tmp_path):
+        """Fencing conflicts must not burn retry budget OR bounce between
+        endpoints — pinned by counting the requests each server saw."""
+        from aiohttp import web
+
+        counts = {"a": 0, "b": 0}
+
+        def counting(key):
+            @web.middleware
+            async def _mw(request, handler):
+                counts[key] += 1
+                return await handler(request)
+
+            return _mw
+
+        fenced = FencedStore(Store(":memory:"), lambda: ("scheduler", 999))
+        run = fenced.create_run("p", spec=JOB, name="one", fence=None)
+        a = self._server(store=fenced, artifacts_root=str(tmp_path / "a"),
+                         extra_middlewares=[counting("a")])
+        b = self._server(artifacts_root=str(tmp_path / "b"),
+                         extra_middlewares=[counting("b")])
+        try:
+            rc = RunClient(host=[a.url, b.url], project="p",
+                           run_uuid=run["uuid"])
+            with pytest.raises(ApiError) as ei:
+                rc.log_status("stopping")
+            assert ei.value.status == 409
+            assert counts == {"a": 1, "b": 0}
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_stale_epoch_since_gets_410_over_http(self, tmp_path):
+        store = Store(":memory:")
+        srv = self._server(store=store, artifacts_root=str(tmp_path))
+        try:
+            store.create_run("p", spec=JOB, name="one")
+            rc = RunClient(host=srv.url, project="p")
+            snap = rc.list_page()
+            store.promote()
+            with pytest.raises(ApiError) as ei:
+                rc.list_since(snap["server_time"])
+            assert ei.value.status == 410
+            # bootstrap again: the fresh token works
+            fresh = rc.list_page()
+            assert fresh["server_time"].startswith("1:")
+            assert rc.list_since(fresh["server_time"])["results"] == []
+        finally:
+            srv.stop()
+
+    def test_read_only_write_gets_503_with_retry_after(self, tmp_path):
+        import requests
+
+        store = Store(":memory:")
+        store.create_run("p", spec=JOB, name="one")
+        store.set_read_only(True)
+        srv = self._server(store=store, artifacts_root=str(tmp_path))
+        try:
+            resp = requests.post(f"{srv.url}/api/v1/p/runs",
+                                 json={"spec": JOB}, timeout=10)
+            assert resp.status_code == 503
+            assert resp.headers.get("Retry-After")
+            # reads still serve from the demoted standby
+            resp = requests.get(f"{srv.url}/api/v1/p/runs", timeout=10)
+            assert resp.status_code == 200 and len(resp.json()) == 1
+        finally:
+            srv.stop()
+
+    def test_http_replication_endpoints(self, tmp_path):
+        """GET /api/v1/changelog + /api/v1/store/snapshot: a standby
+        SERVER can bootstrap and tail a primary over the wire."""
+        from polyaxon_tpu.api.replication import HttpReplicationSource
+
+        store, uuid = _populated_store()
+        srv = self._server(store=store, artifacts_root=str(tmp_path / "a"))
+        try:
+            src = HttpReplicationSource(srv.url)
+            src.fetch_snapshot(str(tmp_path / "snap"))
+            target = Store(":memory:")
+            repl = ReplicatedStandby(src, target,
+                                     snapshot_dir=str(tmp_path / "snap"))
+            assert repl.bootstrap() is not None
+            store.transition(uuid, "scheduled")  # post-snapshot delta
+            repl.poll_once()
+            _same_world(store, target, uuid)
+            assert repl.lag == 0
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# pod-side outage spool
+# ---------------------------------------------------------------------------
+
+
+class TestEventSpool:
+    def test_append_replay_ack_order(self, tmp_path):
+        from polyaxon_tpu.tracking import EventSpool
+
+        spool = EventSpool(str(tmp_path))
+        for i in range(5):
+            spool.append("log_outputs", {"step": i})
+        assert spool.depth == 5
+        sent = []
+
+        def send(rec):
+            if rec["kwargs"]["step"] == 3:
+                raise ConnectionError("down again")
+            sent.append(rec["kwargs"]["step"])
+
+        with pytest.raises(ConnectionError):
+            spool.replay(send)
+        assert sent == [0, 1, 2] and spool.depth == 2
+        # a NEW spool on the same dir (process restart) resumes after the
+        # durable ack cursor — no re-delivery, no gap
+        spool2 = EventSpool(str(tmp_path))
+        assert spool2.depth == 2
+        sent2 = []
+        spool2.replay(lambda rec: sent2.append(rec["kwargs"]["step"]))
+        assert sent2 == [3, 4] and spool2.depth == 0
+
+    def test_torn_tail_is_dropped_and_healed_before_appends(self, tmp_path):
+        from polyaxon_tpu.tracking import EventSpool
+
+        spool = EventSpool(str(tmp_path))
+        spool.append("heartbeat", {})
+        with open(spool.path, "a", encoding="utf-8") as f:
+            f.write('{"key": "torn')  # crash mid-append
+        spool2 = EventSpool(str(tmp_path))
+        assert spool2.depth == 1  # the torn record never happened
+        # the restarted attempt's FIRST append must not weld onto the
+        # torn fragment (that would make it — and everything behind it —
+        # permanently unreplayable): the tail is healed at init
+        spool2.append("log_status", {"status": "succeeded"})
+        recs = spool2.pending()
+        assert [r["verb"] for r in recs] == ["heartbeat", "log_status"]
+        assert EventSpool(str(tmp_path)).depth == 2
+
+    def test_run_survives_api_outage_and_replays_in_order(self, tmp_path):
+        """The ISSUE 7 acceptance slice for pods: kill the API mid-run,
+        keep logging (fast, spooled), bring the API back, flush — every
+        event lands exactly once, in order, no stall longer than the
+        short pod retry."""
+        from polyaxon_tpu.tracking import Run
+
+        store = Store(":memory:")
+        srv = ApiServer(store=store, artifacts_root=str(tmp_path / "api"),
+                        port=0).start()
+        port = srv.port
+        row = store.create_run("p", spec=JOB, name="train")
+        store.transition_many([(row["uuid"], s) for s in
+                               ("compiled", "queued", "scheduled")])
+        run = Run(run_uuid=row["uuid"], project="p",
+                  artifacts_path=str(tmp_path / "run"),
+                  api_host=srv.url)
+        run.log_status("running", reason="PodStarted")
+        assert store.get_run(row["uuid"])["status"] == "running"
+        srv.stop()  # ---- control-plane outage begins ----
+        t0 = time.monotonic()
+        run.log_outputs(step=1)
+        run.heartbeat()
+        run.log_outputs(step=2, loss=0.5)
+        run.log_status("succeeded")
+        stall = time.monotonic() - t0
+        assert run.spool_depth == 4
+        assert stall < 10.0, f"outage stalled the run {stall:.1f}s"
+        # ---- API returns (same store, same port: a restarted server) ----
+        srv2 = ApiServer(store=store, artifacts_root=str(tmp_path / "api"),
+                         host="127.0.0.1", port=port).start()
+        try:
+            assert run.flush_spool() == 4
+            assert run.spool_depth == 0
+            final = store.get_run(row["uuid"])
+            assert final["status"] == "succeeded"
+            assert final["outputs"] == {"step": 2, "loss": 0.5}
+            assert final["heartbeat_at"] is not None
+            conds = [c["type"] for c in store.get_statuses(row["uuid"])]
+            assert conds.count("succeeded") == 1
+            # replaying again is a no-op: no duplicates in the stream
+            assert run.flush_spool() == 0
+            assert [c["type"] for c in store.get_statuses(row["uuid"])] \
+                == conds
+        finally:
+            srv2.stop()
+
+    def test_writes_during_outage_queue_behind_spool(self, tmp_path):
+        """Order is part of the contract: once anything is spooled, later
+        writes append BEHIND it even if the API is briefly probeable."""
+        from polyaxon_tpu.tracking import Run
+
+        run = Run(run_uuid="u1", project="p",
+                  artifacts_path=str(tmp_path / "run"),
+                  api_host="http://127.0.0.1:1")  # never reachable
+        run.log_outputs(a=1)
+        run.log_outputs(b=2)
+        recs = run._spool.pending()
+        assert [r["verb"] for r in recs] == ["log_outputs", "log_outputs"]
+        assert [r["kwargs"] for r in recs] == [{"a": 1}, {"b": 2}]
+
+    def test_output_named_verb_does_not_collide(self, tmp_path):
+        """A user output literally named "verb" must ride through _api's
+        positional-only parameter instead of raising TypeError inside the
+        training loop."""
+        from polyaxon_tpu.tracking import Run
+
+        run = Run(run_uuid="u2", project="p",
+                  artifacts_path=str(tmp_path / "run"),
+                  api_host="http://127.0.0.1:1")
+        run.log_outputs(verb="classification", loss=0.1)
+        rec = run._spool.pending()[-1]
+        assert rec["verb"] == "log_outputs"
+        assert rec["kwargs"] == {"verb": "classification", "loss": 0.1}
+
+
+# ---------------------------------------------------------------------------
+# replication lag regression guard + the tier-1 store-kill smoke
+# ---------------------------------------------------------------------------
+
+
+class TestReplicationLag:
+    def test_lag_bounded_through_a_creation_burst(self):
+        """The sched_bench-shaped guard: a standby tailing through a
+        create/promote burst must drain to lag 0 promptly — replication
+        must never fall persistently behind the write rate the control
+        plane actually sustains."""
+        primary = Store(":memory:")
+        standby = Store(":memory:")
+        repl = ReplicatedStandby(primary, standby,
+                                 poll_interval=0.005).start()
+        try:
+            t0 = time.monotonic()
+            for batch in range(4):
+                runs = primary.create_runs(
+                    "p", [dict(spec=JOB, name=f"b{batch}-{i}")
+                          for i in range(50)])
+                primary.transition_many(
+                    [(r["uuid"], "compiled") for r in runs])
+                primary.transition_many(
+                    [(r["uuid"], "queued") for r in runs])
+            head = primary.changelog_span()["seq"]
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                # compare against the FINAL changelog head, not repl.lag:
+                # mid-poll the lag gauge reads against the previous
+                # poll's span and can transiently show 0 with rows still
+                # in flight (documented gauge semantics)
+                if repl.applied_seq >= head:
+                    break
+                time.sleep(0.02)
+            catch_up = time.monotonic() - t0
+            assert repl.applied_seq >= head, \
+                f"tail stuck at {repl.applied_seq}/{head}"
+            assert repl.lag == 0, f"lag stuck at {repl.lag}"
+            assert standby.count_runs() == 200
+            assert catch_up < 20.0, f"catch-up took {catch_up:.1f}s"
+            by_status = {r["uuid"]: r["status"]
+                         for r in standby.list_runs(limit=500)}
+            assert set(by_status.values()) == {"queued"}
+            fams = parse_prometheus(standby.metrics.render())
+            assert fams["polyaxon_store_replication_lag"][
+                "polyaxon_store_replication_lag"] == 0.0
+        finally:
+            repl.stop()
+
+
+class TestCompactor:
+    def test_compactor_bounds_the_changelog(self, tmp_path):
+        """The server-wired compaction loop: each cycle snapshots and
+        prunes below the keep margin, recording the floor — the changelog
+        stays bounded on a deployment with no standby at all."""
+        from polyaxon_tpu.api.replication import ChangelogCompactor
+        from polyaxon_tpu.api.store import CompactedLogError
+
+        s = Store(":memory:")
+        runs = s.create_runs("p", [dict(spec=JOB, name=f"r{i}")
+                                   for i in range(20)])
+        s.transition_many([(r["uuid"], "compiled") for r in runs])
+        comp = ChangelogCompactor(s, str(tmp_path), keep=5)
+        manifest = comp.compact_once()
+        floor = manifest["seq"] - 5
+        with pytest.raises(CompactedLogError):
+            s.get_changelog(0)
+        tail = s.get_changelog(floor, 1000)
+        assert tail and all(r["seq"] > floor for r in tail)
+        assert verify_snapshot(str(tmp_path))["seq"] == manifest["seq"]
+
+
+class TestSharedRegistryAggregation:
+    def test_primary_counts_survive_standby_registration(self):
+        """One registry across primary + standby must SUM the store
+        counters — the primary's pre-failover fence rejections must not
+        vanish from the pane the moment the standby registers."""
+        from polyaxon_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        primary = Store(":memory:", metrics=reg)
+        run = primary.create_run("p", spec=JOB, name="one")
+        with pytest.raises(StaleLeaseError):
+            primary.transition(run["uuid"], "compiled",
+                               fence=("scheduler", 7))
+        standby = Store(":memory:", metrics=reg)  # registers second
+        fams = parse_prometheus(reg.render())
+        assert fams["polyaxon_store_fence_rejections_total"][
+            "polyaxon_store_fence_rejections_total"] == 1.0
+        # both sides' transactions aggregate
+        assert fams["polyaxon_store_transactions_total"][
+            "polyaxon_store_transactions_total"] == float(
+            primary.stats["transactions"] + standby.stats["transactions"])
+        # epoch is the max across peers: the promoted standby's
+        standby.promote()
+        fams = parse_prometheus(reg.render())
+        assert fams["polyaxon_store_epoch"]["polyaxon_store_epoch"] == 1.0
+
+
+class TestPromoteOnSilence:
+    def test_local_apply_weather_never_self_promotes(self):
+        """The promote-on-silence rule keys on SOURCE reachability: a
+        SQLITE_BUSY burst on the standby's own apply path must not
+        masquerade as a dead primary — that self-promotion would be a
+        split brain with a perfectly healthy primary."""
+        from polyaxon_tpu.resilience import FaultyStore
+
+        primary = Store(":memory:")
+        primary.create_run("p", spec=JOB, name="one")
+        flaky_target = FaultyStore(Store(":memory:"), seed=3,
+                                   fault_rate=1.0, max_faults=1000,
+                                   methods=("apply_changelog",))
+        repl = ReplicatedStandby(primary, flaky_target,
+                                 promote_after=0.05)
+        for _ in range(6):
+            repl.poll_once()
+            time.sleep(0.02)
+        assert repl.promoted is False
+        assert repl.healthy is False  # the weather IS visible
+
+        # an ALIVE primary answering with HTTP errors (e.g. 401 from a
+        # misconfigured auth token) is a config problem, never a death
+        # certificate — promoting on it would split-brain a healthy
+        # primary
+        class _Alive401:
+            def get_changelog(self, *a, **k):
+                raise ValueError("401 Client Error: Unauthorized")
+
+            def changelog_span(self):
+                return {"seq": 0, "epoch": 0}
+
+        repl401 = ReplicatedStandby(_Alive401(), Store(":memory:"),
+                                    promote_after=0.05)
+        for _ in range(4):
+            repl401.poll_once()
+            time.sleep(0.02)
+        assert repl401.promoted is False
+
+        # a genuinely silent primary still promotes
+        gate = OutageStore(primary)
+        repl2 = ReplicatedStandby(gate, Store(":memory:"),
+                                  promote_after=0.05)
+        repl2.poll_once()
+        gate.kill_store()
+        time.sleep(0.08)
+        repl2.poll_once()
+        assert repl2.promoted is True
+
+    def test_promoted_store_refuses_an_older_epoch_source(self):
+        """A once-promoted store re-attached as a standby of an
+        epoch-0 primary (rebuilt host, zombie primary, operator mistake):
+        the seq spaces diverged, so tailing would silently interleave two
+        histories — it must refuse, loudly, and never promote (the source
+        is alive)."""
+        old_primary = Store(":memory:")
+        old_primary.create_run("p", spec=JOB, name="other-history")
+        target = Store(":memory:")
+        target.create_run("p", spec=JOB, name="mine")
+        target.promote()  # this store's history moved past epoch 0
+        repl = ReplicatedStandby(old_primary, target, promote_after=0.01)
+        time.sleep(0.03)
+        assert repl.poll_once() == 0
+        assert repl.healthy is False
+        assert repl.promoted is False
+        assert target.get_run(
+            old_primary.list_runs()[0]["uuid"]) is None  # nothing applied
+
+
+class TestStoreKillSmoke:
+    def test_store_kill_promote_converge_under_30s(self, tmp_path):
+        """Tier-1 smoke of the acceptance soak: ONE agent, in-process
+        standby, primary store killed mid-wave — the standby promotes,
+        the agent is epoch-fenced onto the new primary, and the wave
+        converges with zero duplicate launches."""
+        from chaos_soak import run_store_outage_soak
+
+        out = run_store_outage_soak(
+            str(tmp_path), seed=11, n_jobs=3, agents=1, num_shards=2,
+            lease_ttl=0.5, timeout=90)
+        assert all(v == "succeeded" for v in out["statuses"].values()), out
+        assert out["epoch"] >= 1, out
+        assert out["promote_s"] is not None \
+            and out["promote_s"] < 2.0 * 0.5, out
+        assert out["epoch_fenced"] is True, out
+        assert out["feed_410"] is True, out
+        assert out["epoch_fence_rejections"] >= 1, out
+        assert out["duplicate_applies"] == [], out
+        # the strict scrape carries the survivability families
+        fams = parse_prometheus(out["metrics_text"])
+        assert fams["polyaxon_store_epoch"]["polyaxon_store_epoch"] >= 1.0
+        assert "polyaxon_store_replication_lag" in fams
+        assert "polyaxon_store_epoch_fence_rejections_total" in fams
