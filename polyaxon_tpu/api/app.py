@@ -593,8 +593,39 @@ class ApiApp:
         return _json(run) if run else _not_found()
 
     async def post_heartbeat(self, request):
-        """Renew the run's liveness lease (zombie-reaper input)."""
-        ok = self.store.heartbeat(request.match_info["uuid"])
+        """Renew the run's liveness lease (zombie-reaper input). Optional
+        JSON body {step, anomalies, rollbacks} carries the pod's training
+        progress + cumulative divergence-guard counters (ISSUE 8)."""
+        body = {}
+        try:
+            body = await request.json()
+        except Exception:
+            pass  # bodyless beats stay legal (pre-r9 pods, curl probes)
+        if not isinstance(body, dict):
+            body = {}
+
+        def _int(v):
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                return None
+
+        anomalies = body.get("anomalies")
+        if isinstance(anomalies, dict):
+            anomalies = {str(k): n for k, v in anomalies.items()
+                         if (n := _int(v)) is not None}
+        else:
+            anomalies = None
+        # malformed progress fields degrade to a liveness-only beat — a
+        # buggy client must never get its heartbeat 500'd (and then
+        # zombie-reaped) over a field the beat doesn't even need
+        ok = self.store.heartbeat(
+            request.match_info["uuid"],
+            step=_int(body.get("step")),
+            anomalies=anomalies or None,
+            rollbacks=_int(body.get("rollbacks")),
+            incarnation=(str(body["incarnation"])
+                         if body.get("incarnation") else None))
         return _json({"ok": True}) if ok else _not_found()
 
     async def stop_run(self, request):

@@ -45,6 +45,8 @@ CREATE TABLE IF NOT EXISTS runs (
     started_at TEXT,
     finished_at TEXT,
     heartbeat_at TEXT,
+    heartbeat_step INTEGER,
+    heartbeat_step_at TEXT,
     change_seq INTEGER
 );
 -- monotone change counter: bumped INSIDE every write transaction (the
@@ -283,7 +285,18 @@ class Store:
         # agent.py asserts it), so the counters are part of the contract.
         self.stats = {"transactions": 0, "runs_deserialized": 0,
                       "fence_rejections": 0, "launch_intents": 0,
-                      "epoch_fence_rejections": 0}
+                      "epoch_fence_rejections": 0,
+                      # data-plane self-healing counters (ISSUE 8):
+                      # accumulated by DELTA from the cumulative counts
+                      # pods report in their heartbeats
+                      "train_anomalies_loss": 0, "train_anomalies_grad": 0,
+                      "train_rollbacks": 0}
+        # per-run (incarnation, last-seen cumulative train counters) for
+        # delta accounting; in-memory like the counters themselves —
+        # Prometheus counters are process-local by contract. Bounded by
+        # live run rows: delete_run prunes its entry.
+        self._train_seen: dict[str, tuple] = {}
+        self._train_lock = threading.Lock()
         # store survivability (ISSUE 7): ``replicate`` keeps the
         # commit-ordered changelog every write appends to (a standby tails
         # it); ``_read_only`` is the demoted-standby write gate;
@@ -339,6 +352,21 @@ class Store:
                 f"polyaxon_store_{stat}_total", help_txt,
                 value_fn=(lambda s=stat, p=peers:
                           sum(st.stats[s] for st in p)))
+        # data-plane self-healing families (ISSUE 8; docs/OBSERVABILITY.md):
+        # exported from the stats dict like every other store counter, so
+        # the soak's strict scrape can reconcile them with its audit trail
+        for kind in ("loss", "grad"):
+            self.metrics.counter(
+                "polyaxon_train_anomalies_total",
+                "Non-finite training steps skipped by the divergence guard",
+                labels={"kind": kind},
+                value_fn=(lambda k=kind, p=peers: sum(
+                    st.stats.get(f"train_anomalies_{k}", 0) for st in p)))
+        self.metrics.counter(
+            "polyaxon_train_rollbacks_total",
+            "Divergence rollbacks to the latest complete checkpoint",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("train_rollbacks", 0) for st in p)))
         self.metrics.gauge(
             "polyaxon_store_epoch",
             "Store epoch (bumped by every standby promotion)",
@@ -371,6 +399,15 @@ class Store:
                 conn.execute("ALTER TABLE runs ADD COLUMN created_by TEXT")
             if "heartbeat_at" not in cols:
                 conn.execute("ALTER TABLE runs ADD COLUMN heartbeat_at TEXT")
+            if "heartbeat_step" not in cols:
+                # training-progress heartbeat fields (ISSUE 8): the step
+                # the pod last reported, and when that VALUE last moved —
+                # the stall-aware reaper and the dashboard's progress
+                # column both read the age of the latter
+                conn.execute(
+                    "ALTER TABLE runs ADD COLUMN heartbeat_step INTEGER")
+                conn.execute(
+                    "ALTER TABLE runs ADD COLUMN heartbeat_step_at TEXT")
             if "change_seq" not in cols:
                 # pre-r7: backfill in rowid (≈ insertion) order and point
                 # the counter past the backfill
@@ -947,7 +984,8 @@ class Store:
         "uuid", "project", "name", "kind", "status", "spec", "compiled",
         "inputs", "outputs", "meta", "tags", "original_uuid", "cloning_kind",
         "pipeline_uuid", "created_by", "created_at", "updated_at",
-        "started_at", "finished_at", "heartbeat_at", "change_seq",
+        "started_at", "finished_at", "heartbeat_at", "heartbeat_step",
+        "heartbeat_step_at", "change_seq",
     )
     _JSON_COLS = {"spec", "compiled", "inputs", "outputs", "meta", "tags"}
 
@@ -1128,8 +1166,15 @@ class Store:
                 "created_at) VALUES (?,?,?)",
                 (p["run_uuid"], p["condition"], p["created_at"]))
         elif op == "heartbeat":
-            conn.execute("UPDATE runs SET heartbeat_at=? WHERE uuid=?",
-                         (p["at"], p["uuid"]))
+            if p.get("step") is None:
+                conn.execute("UPDATE runs SET heartbeat_at=? WHERE uuid=?",
+                             (p["at"], p["uuid"]))
+            else:
+                step = int(p["step"])
+                conn.execute(
+                    f"UPDATE runs SET heartbeat_at=?, {self._HB_STEP_SQL} "
+                    "WHERE uuid=?",
+                    (p["at"], step, p["at"], p["at"], step, p["uuid"]))
         elif op == "delete_run":
             for table, col in (("runs", "uuid"),
                                ("status_conditions", "run_uuid"),
@@ -1519,6 +1564,14 @@ class Store:
                 age = age_seconds(d.get("heartbeat_at") or d.get("started_at"))
                 if age is not None:
                     d["heartbeat_age_s"] = round(age, 3)
+                # progress-stall companion (ISSUE 8): how long the
+                # reported training step has been FROZEN — the dashboard
+                # badges step-stalled runs with it, same derived-never-
+                # stored contract as heartbeat_age_s
+                if d.get("heartbeat_step") is not None:
+                    sage = age_seconds(d.get("heartbeat_step_at"))
+                    if sage is not None:
+                        d["heartbeat_step_age_s"] = round(sage, 3)
         return runs
 
     def count_runs(
@@ -1571,22 +1624,88 @@ class Store:
             merged.update(outputs)
             return self.update_run(uuid, fence=fence, outputs=merged)
 
-    def heartbeat(self, uuid: str) -> bool:
+    # the CASE keeps heartbeat_step_at pinned while the reported step
+    # VALUE stays put (backfilling it when NULL) and moves it when the
+    # step advances — its age IS the progress-stall signal, computed by
+    # the store so the reaper and the dashboard can never disagree
+    _HB_STEP_SQL = (
+        "heartbeat_step_at=CASE WHEN heartbeat_step IS ? "
+        "THEN COALESCE(heartbeat_step_at, ?) ELSE ? END, "
+        "heartbeat_step=?")
+
+    def heartbeat(self, uuid: str, step: Optional[int] = None,
+                  anomalies: Optional[dict] = None,
+                  rollbacks: Optional[int] = None,
+                  incarnation: Optional[str] = None) -> bool:
         """Renew a run's liveness lease (zombie-reaper input). Cheap direct
         UPDATE — no listeners fire, no updated_at churn. Replicated (as a
         tiny heartbeat delta, not a whole row) so a promoted standby's
-        reaper sees real staleness, not replication-shaped staleness."""
+        reaper sees real staleness, not replication-shaped staleness.
+
+        ``step`` (ISSUE 8) is the pod's training progress: liveness and
+        PROGRESS are separate signals, so the stall-aware reaper can tell
+        a wedged step (fresh beats, frozen step) from a dead executor.
+        ``anomalies``/``rollbacks`` are cumulative pod counters, folded
+        into the ``polyaxon_train_*`` families by delta."""
         self._check_writable()
         with self._conn_ctx() as conn:
             now = _now()
-            cur = conn.execute(
-                "UPDATE runs SET heartbeat_at=? WHERE uuid=?", (now, uuid))
+            payload: dict[str, Any] = {"uuid": uuid, "at": now}
+            if step is None:
+                cur = conn.execute(
+                    "UPDATE runs SET heartbeat_at=? WHERE uuid=?",
+                    (now, uuid))
+            else:
+                step = int(step)
+                payload["step"] = step
+                cur = conn.execute(
+                    f"UPDATE runs SET heartbeat_at=?, {self._HB_STEP_SQL} "
+                    "WHERE uuid=?",
+                    (now, step, now, now, step, uuid))
             if cur.rowcount > 0:
-                self._log_change(conn, "heartbeat", {"uuid": uuid, "at": now})
+                if anomalies or rollbacks:
+                    self._train_account(uuid, anomalies, rollbacks,
+                                        incarnation)
+                self._log_change(conn, "heartbeat", payload)
         return cur.rowcount > 0
+
+    def _train_account(self, uuid: str, anomalies: Optional[dict],
+                       rollbacks: Optional[int],
+                       incarnation: Optional[str]) -> None:
+        """Cumulative pod counters -> monotonic store counters, by delta.
+
+        The watermark is keyed on the reporting POD INCARNATION: two
+        reporters relay the same pod's cumulatives (the pod's own API
+        beat and the sidecar's progress.json bridge), and a stale lower
+        value arriving late must clamp to zero — NOT read as a restart,
+        which would re-add already-counted anomalies. A new incarnation
+        (restarted attempt) starts a fresh watermark at zero, so its full
+        count lands; reports with no incarnation at all (pre-r9 clients)
+        fall back to the same-incarnation max-clamp, trading restart
+        detection for never over-counting."""
+        with self._train_lock:
+            seen_inc, last = self._train_seen.get(uuid) or (None, {})
+            if incarnation is not None and incarnation != seen_inc:
+                last = {}  # fresh process: cumulatives restarted at zero
+
+            def delta(key: str, new) -> int:
+                if new is None:
+                    return 0
+                new = int(new)
+                old = int(last.get(key, 0))
+                last[key] = max(new, old)
+                return max(new - old, 0)
+
+            for kind in ("loss", "grad"):
+                self.stats[f"train_anomalies_{kind}"] += delta(
+                    f"anomalies_{kind}", (anomalies or {}).get(kind))
+            self.stats["train_rollbacks"] += delta("rollbacks", rollbacks)
+            self._train_seen[uuid] = (incarnation or seen_inc, last)
 
     def delete_run(self, uuid: str) -> bool:
         self._check_writable()
+        with self._train_lock:  # vs a racing heartbeat's re-insert
+            self._train_seen.pop(uuid, None)  # watermark dies with the row
         with self._conn_ctx() as conn:
             cur = conn.execute("DELETE FROM runs WHERE uuid=?", (uuid,))
             conn.execute("DELETE FROM status_conditions WHERE run_uuid=?", (uuid,))
@@ -1686,6 +1805,15 @@ class Store:
             seq = self._bump_seq(conn)
             sets = ["status=?", "updated_at=?", "change_seq=?"]
             args: list[Any] = [dst.value, now, seq]
+            if dst == V1Statuses.RUNNING:
+                # every attempt reports progress from scratch (ISSUE 8):
+                # clearing the step fields on the running edge resets the
+                # stall clocks — a restarted pod's compile/restore window
+                # must never be judged against the DEAD attempt's frozen
+                # progress (a stale step would cascade stall-reaps until
+                # the retry budget burned out)
+                sets.append("heartbeat_step=NULL")
+                sets.append("heartbeat_step_at=NULL")
             if dst == V1Statuses.RUNNING and not run.get("started_at"):
                 sets.append("started_at=?")
                 args.append(now)

@@ -94,7 +94,7 @@ UI_HTML = """<!DOCTYPE html>
     <div id="cmpBar" class="muted">check ≥2 runs to compare
       <button class="small" id="cmpBtn" style="display:none">compare</button></div>
     <table id="runsTable">
-    <thead><tr><th></th><th>name</th><th>kind</th><th>status</th><th>by</th><th>uuid</th></tr></thead>
+    <thead><tr><th></th><th>name</th><th>kind</th><th>status</th><th>progress</th><th>by</th><th>uuid</th></tr></thead>
     <tbody></tbody></table>
     <div id="pageBar" class="muted" style="margin-top:6px">
       <button class="small" id="prevPg" disabled>&laquo; prev</button>
@@ -168,12 +168,25 @@ function addRunRow(tb, r, depth, kids) {
   const stale = typeof r.heartbeat_age_s === "number" && r.heartbeat_age_s > 60
     ? ` <span title="no heartbeat for ${Math.round(r.heartbeat_age_s)}s` +
       ` — zombie suspect" style="cursor:help">&#9888;</span>` : "";
+  // progress column (ISSUE 8): the training step the pod last reported
+  // via its heartbeat, with a stalled badge when the step has been
+  // FROZEN for 2min while heartbeats stayed fresh — the wedged-step
+  // signature the stall-aware reaper acts on
+  const stalled = typeof r.heartbeat_step_age_s === "number"
+    && r.heartbeat_step_age_s > 120
+    && !(typeof r.heartbeat_age_s === "number" && r.heartbeat_age_s > 60)
+    ? ` <span title="step frozen for ${Math.round(r.heartbeat_step_age_s)}s` +
+      ` with fresh heartbeats — stalled suspect" style="cursor:help">` +
+      `&#8987;</span>` : "";
+  const progress = typeof r.heartbeat_step === "number"
+    ? `step ${r.heartbeat_step}${stalled}` : "";
   tr.innerHTML =
     `<td><input type="checkbox" data-u="${r.uuid}"` +
     `${checked.has(r.uuid) ? " checked" : ""}/></td>` +
     `<td ${pad}>${twist}${esc(r.name || "")}${kidNote}</td>` +
     `<td>${esc(r.kind || "")}</td>` +
     `<td>${stBadge(r.status)}${stale}</td>` +
+    `<td class="muted">${progress}</td>` +
     `<td class="muted">${esc(r.created_by || "")}</td>` +
     `<td class="muted">${r.uuid.slice(0,8)}</td>`;
   tr.querySelector("input").onclick = (ev) => {

@@ -226,8 +226,17 @@ def ops_ls(project, host, status, created_by, limit):
                                 created_by=created_by, limit=limit)
     for r in runs:
         by = f" [{r['created_by']}]" if r.get("created_by") else ""
+        # progress column (ISSUE 8): the step the pod last heartbeated,
+        # flagged STALLED when it froze while heartbeats stayed fresh
+        prog = ""
+        if r.get("heartbeat_step") is not None:
+            prog = f" step={r['heartbeat_step']}"
+            if (r.get("heartbeat_step_age_s", 0) > 120
+                    and r.get("heartbeat_age_s", float("inf")) <= 60):
+                prog += f" STALLED({r['heartbeat_step_age_s']:.0f}s)"
         click.echo(f"{r['uuid']}  {r['status']:<12} "
-                   f"{r.get('kind') or '-':<10} {r.get('name') or ''}{by}")
+                   f"{r.get('kind') or '-':<10} {r.get('name') or ''}{by}"
+                   f"{prog}")
 
 
 @ops.command("get")

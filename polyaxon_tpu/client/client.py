@@ -297,10 +297,26 @@ class RunClient(BaseClient):
     def get_statuses(self, uuid: Optional[str] = None) -> dict:
         return self._json("GET", self._rpath("/statuses", uuid=uuid))
 
-    def heartbeat(self, uuid: Optional[str] = None) -> dict:
+    def heartbeat(self, uuid: Optional[str] = None,
+                  step: Optional[int] = None,
+                  anomalies: Optional[dict] = None,
+                  rollbacks: Optional[int] = None,
+                  incarnation: Optional[str] = None) -> dict:
         """Renew the run's liveness lease (see docs/RESILIENCE.md): an
-        executor that stops heartbeating gets zombie-reaped by the agent."""
-        return self._json("POST", self._rpath("/heartbeat", uuid=uuid))
+        executor that stops heartbeating gets zombie-reaped by the agent.
+        ``step`` reports training progress (ISSUE 8) — an executor whose
+        beats stay fresh while ``step`` freezes gets stall-reaped."""
+        body: dict = {}
+        if step is not None:
+            body["step"] = int(step)
+        if anomalies:
+            body["anomalies"] = anomalies
+        if rollbacks:
+            body["rollbacks"] = int(rollbacks)
+        if incarnation:
+            body["incarnation"] = str(incarnation)
+        return self._json("POST", self._rpath("/heartbeat", uuid=uuid),
+                          json=body or None)
 
     def stop(self, uuid: Optional[str] = None) -> dict:
         return self._json("POST", self._rpath("/stop", uuid=uuid))

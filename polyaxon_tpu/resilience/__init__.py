@@ -18,7 +18,7 @@ See docs/RESILIENCE.md for the failure model and how to run the chaos soak.
 """
 
 from .chaos import (
-    ChaosCluster, ChaosConfig, FaultyStore, OutageStore,
+    ChaosCluster, ChaosConfig, FaultyStore, OutageStore, TrainerChaos,
     flaky_http_middleware, tear_latest_checkpoint, tear_snapshot,
 )
 from .heartbeat import ZombieReaper
@@ -31,6 +31,7 @@ __all__ = [
     "FaultyStore",
     "OutageStore",
     "RetryPolicy",
+    "TrainerChaos",
     "ZombieReaper",
     "flaky_http_middleware",
     "tear_latest_checkpoint",

@@ -20,9 +20,12 @@ dice rolls.
 
 from __future__ import annotations
 
+import json
+import os
 import random
 import sqlite3
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -358,14 +361,131 @@ class OutageStore:
         return attr
 
 
+class TrainerChaos:
+    """Trainer-level fault injection (ISSUE 8 tentpole (c)): the failure
+    modes that happen INSIDE a training step rather than around the pod —
+    a step that wedges in a collective (``hang_at_step``), a NaN/Inf
+    burst poisoning the loss and gradients (``nan_at_step`` /
+    ``nan_count``), and a straggler step that is merely slow
+    (``straggler_at_step`` / ``straggler_sleep_s`` — must heal by
+    *waiting*, never by reaping).
+
+    Budgets persist in a marker file under ``state_dir`` (the run's
+    artifacts dir, shared across attempts like the checkpoints): a
+    RESTARTED attempt must not re-fire a spent fault, or the hang proof
+    would hang every attempt until the retry budget burned out instead
+    of proving watchdog -> retry -> resume. Same for the NaN window: the
+    post-rollback replay of the poisoned steps runs clean, which is what
+    lets the healed run converge to exact parity with the oracle.
+
+    All step positions are DATA positions (batch indices), so injection
+    keys on what was consumed, not on how many times the loop ran.
+    """
+
+    _STATE_FILE = "chaos-train.json"
+
+    def __init__(self, hang_at_step: Optional[int] = None,
+                 nan_at_step: Optional[int] = None, nan_count: int = 1,
+                 straggler_at_step: Optional[int] = None,
+                 straggler_sleep_s: float = 0.0,
+                 state_dir: Optional[str] = None,
+                 hang_sleep_s: float = 3600.0):
+        self.hang_at_step = hang_at_step
+        self.nan_at_step = nan_at_step
+        self.nan_count = int(nan_count)
+        self.straggler_at_step = straggler_at_step
+        self.straggler_sleep_s = float(straggler_sleep_s)
+        self.state_dir = state_dir
+        self.hang_sleep_s = float(hang_sleep_s)
+        self.injected: list[tuple[str, int]] = []  # (kind, step) audit
+        self._state = self._load()
+
+    @classmethod
+    def from_spec(cls, spec: Any,
+                  state_dir: Optional[str] = None) -> Optional["TrainerChaos"]:
+        """Build from a builtin-runtime ``chaos:`` spec dict (None when the
+        spec carries no trainer faults)."""
+        if not isinstance(spec, dict):
+            return None
+        keys = ("hang_at_step", "nan_at_step", "nan_count",
+                "straggler_at_step", "straggler_sleep_s", "hang_sleep_s")
+        kw = {k: spec[k] for k in keys if spec.get(k) is not None}
+        if not kw:
+            return None
+        return cls(state_dir=state_dir, **kw)
+
+    # -- cross-attempt budget persistence ------------------------------------
+
+    def _path(self) -> Optional[str]:
+        if not self.state_dir:
+            return None
+        return os.path.join(self.state_dir, self._STATE_FILE)
+
+    def _load(self) -> dict:
+        path = self._path()
+        if path:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                pass
+        return {"hangs": 0, "nans": 0, "stragglers": 0}
+
+    def _save(self) -> None:
+        path = self._path()
+        if not path:
+            return
+        os.makedirs(self.state_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic: a kill mid-save never tears it
+
+    # -- injection points (called by Trainer.fit) ----------------------------
+
+    def pre_step(self, pos: int) -> None:
+        """Host-side faults before the step at data position ``pos`` is
+        dispatched: the one-shot hang (spends its budget BEFORE sleeping
+        so the restarted attempt runs clean) and the straggler sleep."""
+        if (self.straggler_at_step is not None
+                and pos == self.straggler_at_step
+                and self._state.get("stragglers", 0) < 1
+                and self.straggler_sleep_s > 0):
+            self._state["stragglers"] = 1
+            self._save()
+            self.injected.append(("straggler", pos))
+            time.sleep(self.straggler_sleep_s)
+        if (self.hang_at_step is not None and pos == self.hang_at_step
+                and self._state.get("hangs", 0) < 1):
+            self._state["hangs"] = 1
+            self._save()
+            self.injected.append(("hang", pos))
+            time.sleep(self.hang_sleep_s)  # the watchdog ends this process
+
+    def nan_due(self, pos: int) -> bool:
+        """True when the step at data position ``pos`` should compute a
+        non-finite loss/grad (budgeted to ``nan_count`` injections across
+        every attempt and rollback replay)."""
+        if self.nan_at_step is None:
+            return False
+        if not (self.nan_at_step <= pos < self.nan_at_step + self.nan_count):
+            return False
+        if self._state.get("nans", 0) >= self.nan_count:
+            return False
+        self._state["nans"] = self._state.get("nans", 0) + 1
+        self._save()
+        self.injected.append(("nan", pos))
+        return True
+
+
 def tear_snapshot(snapshot_dir: str) -> Optional[str]:
     """Chaos hook (ISSUE 7): truncate snapshot.db to half its size — a
     torn copy, what a host dying mid-upload leaves behind. The sha256
     manifest must catch it (``verify_snapshot`` raises TornSnapshotError)
     and the standby bootstrap must fall back to the changelog tail.
     Returns the torn path (None when no snapshot exists)."""
-    import os
-
     path = os.path.join(snapshot_dir, "snapshot.db")
     if not os.path.isfile(path):
         return None
@@ -383,8 +503,6 @@ def tear_latest_checkpoint(ckpt_dir: str,
     torn file path (None when no finalized step exists). The checksum
     manifests (train/checkpoint.py) must catch it and ``restore()`` must
     fall back to the newest COMPLETE step."""
-    import os
-
     if not os.path.isdir(ckpt_dir):
         return None
     steps = sorted((int(d) for d in os.listdir(ckpt_dir) if d.isdigit()),
@@ -406,5 +524,5 @@ def tear_latest_checkpoint(ckpt_dir: str,
 
 
 __all__ = ["ChaosCluster", "ChaosConfig", "FaultyStore", "OutageStore",
-           "flaky_http_middleware", "tear_latest_checkpoint",
-           "tear_snapshot", "PodPhase"]
+           "TrainerChaos", "flaky_http_middleware",
+           "tear_latest_checkpoint", "tear_snapshot", "PodPhase"]

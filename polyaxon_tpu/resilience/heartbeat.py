@@ -91,6 +91,22 @@ class ZombieReaper:
     (default: the zombie window itself), long enough for spooled
     heartbeats to land before the two-stale-pass rule can false-positive
     a healthy pod.
+
+    Progress-stall rule (ISSUE 8): liveness and PROGRESS are different
+    signals. A pod wedged inside a collective keeps heartbeating through
+    its sidecar forever — ``heartbeat_at`` stays fresh while the
+    ``heartbeat_step`` the pod reports freezes. A run whose step has been
+    frozen for ``stall_grace`` seconds BOTH by the store's own clock
+    (``heartbeat_step_at`` age) and by this reaper's local observation
+    window is reaped as ``stalled``: live-driver runs get their pod set
+    torn down via ``teardown`` so the reconciler's slice-restart path
+    retries them, driverless ones ride the same fenced retrying/backoff
+    transitions as a zombie. The local observation window is what makes
+    an agent TAKEOVER safe: a successor's reaper starts its stall clocks
+    fresh (and a run whose ``meta.owner`` changes resets its clock), so
+    adopted runs get a full ``stall_grace`` before judgment — mirroring
+    the PR-7 failover grace. Runs that report no step at all are never
+    stall-judged (progress reporting is opt-in by runtime).
     """
 
     def __init__(
@@ -102,6 +118,8 @@ class ZombieReaper:
         metrics=None,
         owns_run: Optional[Callable[[str], bool]] = None,
         failover_grace: Optional[float] = None,
+        stall_grace: float = 0.0,
+        teardown: Optional[Callable[[str], None]] = None,
     ):
         import time
 
@@ -109,6 +127,14 @@ class ZombieReaper:
         self.owned = owned
         self.owns_run = owns_run
         self.zombie_after = zombie_after
+        # progress-stall rule (ISSUE 8): <=0 disables; ``teardown(uuid)``
+        # kills a live-but-wedged run's pod set so the reconciler's
+        # slice-restart machinery (and ITS retry budget) takes over
+        self.stall_grace = stall_grace
+        self.teardown = teardown
+        # uuid -> (step, owner, since_monotonic): the local observation
+        # window behind the stall rule (fresh on takeover by design)
+        self._progress: dict[str, tuple] = {}
         # observability (ISSUE 5): reap actions + the staleness the reaper
         # actually observed, exported through the shared registry
         if metrics is None:
@@ -125,6 +151,10 @@ class ZombieReaper:
         self._c_exhausted = metrics.counter(
             "polyaxon_retry_exhaustions_total",
             "Runs failed with their termination.maxRetries budget exhausted")
+        self._c_stalled = metrics.counter(
+            "polyaxon_run_stalled_reaps_total",
+            "Runs reaped for frozen training progress while their "
+            "heartbeats stayed fresh (sidecar-alive-but-step-frozen)")
         # max heartbeat age seen among NON-owned in-flight runs on the
         # last pass (0 when everything is fresh): the "is anything going
         # stale" needle the dashboard/alerts watch
@@ -174,6 +204,12 @@ class ZombieReaper:
                 if uuid in owned:
                     self.store.heartbeat(uuid)
                     self._strikes.pop(uuid, None)
+                    # liveness is vouched for — but a live driver can
+                    # still be wedged: judge PROGRESS separately
+                    if not in_grace and self._stalled(run, now):
+                        action = self._stall_reap(run, alive_driver=True)
+                        if action is not None:
+                            actions.append((uuid, action))
                     continue
                 age = age_seconds(run.get("heartbeat_at")
                                    or run.get("started_at")
@@ -182,6 +218,13 @@ class ZombieReaper:
                     max_stale = max(max_stale, age)
                 if age is None or age < self.zombie_after:
                     self._strikes.pop(uuid, None)
+                    # beats are fresh (an external executor heartbeating
+                    # over the API) — apply the progress-stall rule
+                    if (not in_grace and age is not None
+                            and self._stalled(run, now)):
+                        action = self._stall_reap(run, alive_driver=False)
+                        if action is not None:
+                            actions.append((uuid, action))
                     continue
                 if in_grace:
                     # failover grace: spooled heartbeats are still
@@ -199,10 +242,75 @@ class ZombieReaper:
                     if action is not None:
                         actions.append((uuid, action))
         # runs that left the reapable statuses drop their strike state
+        # (and their stall clocks)
         self._strikes = {u: s for u, s in self._strikes.items() if u in seen}
+        self._progress = {u: p for u, p in self._progress.items()
+                          if u in seen}
         self.last_max_staleness = max_stale
         self.reaped.extend(actions)
         return actions
+
+    # -- progress-stall rule (ISSUE 8) --------------------------------------
+
+    @staticmethod
+    def _owner_of(run: dict) -> Optional[str]:
+        owner = (run.get("meta") or {}).get("owner")
+        if isinstance(owner, dict):
+            return str(owner.get("holder") or owner.get("lease_holder")
+                       or owner)
+        return str(owner) if owner is not None else None
+
+    def _stalled(self, run: dict, now: float) -> bool:
+        """True when the run's reported step has been frozen for
+        ``stall_grace`` by BOTH clocks: the store's ``heartbeat_step_at``
+        age (authoritative across agents) and this reaper's own
+        observation window (which resets on takeover/owner change, so a
+        freshly-adopted run always gets a full grace period). A run
+        reporting no step is never judged; a step that ADVANCES — however
+        slowly — resets everything."""
+        if self.stall_grace <= 0:
+            return False
+        step = run.get("heartbeat_step")
+        if step is None:
+            return False
+        owner = self._owner_of(run)
+        # heartbeat_step_at is part of the freeze IDENTITY, not just the
+        # age source: a restarted attempt re-reporting the same step gets
+        # a NEW step_at (the running edge cleared the fields), so its
+        # observation window starts over instead of inheriting the dead
+        # attempt's
+        ident = (step, owner, run.get("heartbeat_step_at"))
+        rec = self._progress.get(run["uuid"])
+        if rec is None or rec[0] != ident:
+            self._progress[run["uuid"]] = (ident, now)
+            return False
+        if now - rec[1] < self.stall_grace:
+            return False
+        store_age = age_seconds(run.get("heartbeat_step_at"))
+        return store_age is not None and store_age >= self.stall_grace
+
+    def _stall_reap(self, run: dict, alive_driver: bool) -> Optional[str]:
+        """Reap one step-frozen run. With a live local driver the pod set
+        is torn down (``teardown``) so the reconciler's slice-restart
+        path — budget, fenced writes, relaunch — does the retrying; a
+        driverless run rides the same transitions as a zombie. Returns
+        the action, or None when nothing was actually done (teardown
+        hook missing, or the transition lost a race — exactly-once across
+        the sharded fleet)."""
+        uuid = run["uuid"]
+        self._progress.pop(uuid, None)  # one verdict per observed freeze
+        if alive_driver:
+            if self.teardown is None:
+                return None
+            # an explicit False means "nothing to act on" (the driver
+            # vanished between the listing and the teardown): count
+            # nothing — the scrape must record actions taken, not
+            # verdicts reached
+            if self.teardown(uuid) is False:
+                return None
+            self._c_stalled.inc()
+            return "stalled"
+        return self._reap(run, kind="stalled")
 
     def _observe_epoch(self, now: float) -> bool:
         """Track the store epoch; an epoch CHANGE (failover) clears every
@@ -219,14 +327,24 @@ class ZombieReaper:
         elif epoch != self._epoch_seen:
             self._epoch_seen = epoch
             self._strikes.clear()
+            # stall clocks too: spooled progress beats replay after the
+            # failover, and judging the pre-failover freeze would
+            # false-positive every healthy pod at once
+            self._progress.clear()
             self._grace_until = now + self.failover_grace
         return now < self._grace_until
 
-    def _reap(self, run: dict) -> Optional[str]:
-        """Reap one zombie; returns the action taken, or None when the
-        reap lost a race (the run moved under us — some other writer got
-        there first) so nothing is counted twice."""
+    def _reap(self, run: dict, kind: str = "zombie") -> Optional[str]:
+        """Reap one zombie (or ``kind="stalled"`` step-frozen) run;
+        returns the action taken, or None when the reap lost a race (the
+        run moved under us — some other writer got there first) so
+        nothing is counted twice."""
         uuid = run["uuid"]
+        stalled = kind == "stalled"
+        reason = "StallReaped" if stalled else "ZombieReaped"
+        why = (f"training step frozen for {self.stall_grace:.0f}s with "
+               f"fresh heartbeats" if stalled
+               else f"no heartbeat for {self.zombie_after:.0f}s")
         retries_done = sum(
             1 for c in self.store.get_statuses(uuid)
             if c.get("type") == V1Statuses.RETRYING.value)
@@ -236,21 +354,26 @@ class ZombieReaper:
             # scheduler re-runs it (builtin runtimes resume from their
             # latest checkpoint because the artifacts dir is unchanged)
             _, changed = self.store.transition(
-                uuid, V1Statuses.RETRYING.value, reason="ZombieReaped",
-                message=f"no heartbeat for {self.zombie_after:.0f}s; "
-                        f"attempt {retries_done + 2}/{budget + 1}")
+                uuid, V1Statuses.RETRYING.value, reason=reason,
+                message=f"{why}; attempt {retries_done + 2}/{budget + 1}")
             if not changed:
                 return None
             self.store.transition(uuid, V1Statuses.QUEUED.value)
+            if stalled:
+                self._c_stalled.inc()
+                return "stalled"
             self._c_reaps["retried"].inc()
             return "retried"
         _, changed = self.store.transition(
-            uuid, V1Statuses.FAILED.value, force=True, reason="ZombieReaped",
-            message=f"stuck in {run['status']} with no heartbeat for "
-                    f"{self.zombie_after:.0f}s and no retry budget left")
+            uuid, V1Statuses.FAILED.value, force=True, reason=reason,
+            message=f"stuck in {run['status']} with {why} and no retry "
+                    "budget left")
         if not changed:
             return None
-        self._c_reaps["failed"].inc()
+        if stalled:
+            self._c_stalled.inc()
+        else:
+            self._c_reaps["failed"].inc()
         if budget > 0:
             self._c_exhausted.inc()
-        return "failed"
+        return "stalled-failed" if stalled else "failed"

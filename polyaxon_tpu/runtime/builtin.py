@@ -129,6 +129,14 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
     is_primary = jax.process_index() == 0
     run = tracking.get_run() if is_primary else None
     artifacts_dir = run.run_dir if run else os.environ.get("PLX_ARTIFACTS_PATH", os.getcwd())
+    # a leftover progress.json describes a DEAD attempt: drop it before
+    # anything can mistake its frozen step for this attempt's progress
+    # (the agent also drops it on the retrying edge — this covers
+    # restart paths that never pass through this agent)
+    try:
+        os.unlink(os.path.join(artifacts_dir, "progress.json"))
+    except OSError:
+        pass
 
     ckpt_spec = spec.get("checkpoint") or {}
     ckpt = CheckpointConfig(
@@ -138,6 +146,11 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
         async_save=bool(ckpt_spec.get("async_save", True)),
     ) if spec.get("checkpoint", True) is not False else None
 
+    # self-healing knobs (ISSUE 8; docs/RESILIENCE.md "Data-plane crash
+    # matrix"): the watchdog is ON for every pod the runtime owns —
+    # `watchdog: false` disables, `watchdog: {min_s: ..}` tunes
+    wd_spec = spec.get("watchdog", True)
+    wd_kw = wd_spec if isinstance(wd_spec, dict) else {}
     tcfg = TrainerConfig(
         model=mcfg,
         optimizer=OptimizerConfig(
@@ -157,6 +170,12 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
         grad_dtype=spec.get("grad_dtype"),
         microbatches=int(spec.get("microbatches", 1)),
         accum_dtype=spec.get("accum_dtype"),
+        anomaly_skip_budget=int(spec.get("anomaly_skip_budget", 3)),
+        anomaly_rollback_budget=int(spec.get("anomaly_rollback_budget", 2)),
+        watchdog=wd_spec is not False,
+        watchdog_stall_factor=float(wd_kw.get("stall_factor", 10.0)),
+        watchdog_min_s=float(wd_kw.get("min_s", 120.0)),
+        watchdog_compile_grace_s=float(wd_kw.get("compile_grace_s", 1800.0)),
     )
     # Throughput bridge (ISSUE 5 tentpole (c)): on every tracked interval
     # the ThroughputMeter summary ALSO flows into run outputs, so the
@@ -174,11 +193,52 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
                 k: v for k, v in m.items() if isinstance(v, (int, float))
             })
             run.log_outputs(**{k: m[k] for k in meter_keys if k in m})
+    # trainer-level chaos (ISSUE 8 tentpole (c)): hang/NaN/straggler
+    # injection with budgets persisted in the artifacts dir so a
+    # RESTARTED attempt runs clean — the self-healing proof, not a loop
+    from ..resilience.chaos import TrainerChaos
+
+    chaos = TrainerChaos.from_spec(spec.get("chaos"), state_dir=artifacts_dir)
+
+    # per-step progress (ISSUE 8 tentpole (a)): rate-limited
+    # progress.json publish + heartbeat-with-step so the control plane
+    # can tell a slow run from a wedged one
+    on_progress = None
+    on_stalled = None
+    log_line = None
+    if run is not None:
+        progress_interval = float(spec.get("progress_interval", 2.0))
+        last_beat = [0.0]
+
+        def on_progress(step, anomalies, rollbacks):
+            now = time.monotonic()
+            if now - last_beat[0] < progress_interval:
+                return
+            last_beat[0] = now
+            run.report_progress(step, anomalies=dict(anomalies),
+                                rollbacks=rollbacks)
+
+        def on_stalled(step, waited, limit):
+            # structured status condition + durable flush: the watchdog
+            # hard-exits right after this, and the epitaph must survive
+            run.log_status(
+                "running", reason="TrainingStalled",
+                message=f"no step completed for {waited:.1f}s "
+                        f"(limit {limit:.1f}s, last step {step}); "
+                        f"watchdog hard-exit -> retry budget")
+            run.flush()
+
+        def log_line(line):
+            run.log_line(line)
+            print(line, flush=True)
+
     # pod-side spans (ISSUE 5 tentpole (a)): first-step compile, train
     # window, checkpoint saves join the control-plane lifecycle timeline
     # through the trace id tracking picked up from POLYAXON_TRACE_ID
     trainer = Trainer(tcfg, task=task, track=track,
-                      on_span=run.log_span if run is not None else None)
+                      on_span=run.log_span if run is not None else None,
+                      chaos=chaos, on_progress=on_progress,
+                      on_stalled=on_stalled, log_line=log_line)
 
     data_spec = dict(spec.get("data") or {})
     data_kwargs: dict[str, Any] = {}
@@ -202,7 +262,11 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
     # the artifacts dir, so restore_or_init picks up the latest checkpoint.
     # The data stream must be fast-forwarded to the restored step — without
     # this a resumed run re-consumes batches 0..k and diverges from an
-    # uninterrupted run (the chaos parity proof would catch it).
+    # uninterrupted run (the chaos parity proof would catch it). Seekable
+    # sources (train/data.py) make this O(1): a step-100k resume no longer
+    # replays 100k batches before training.
+    from ..train.data import skip_batches
+
     t_restore = time.time()
     state, start_step = trainer.restore_or_init()
     if run is not None:
@@ -210,8 +274,7 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
         # the checkpoint-read cost the timeline should surface
         run.log_span("restore", t_restore, time.time(),
                      resumed_from_step=int(start_step))
-    for _ in range(start_step):
-        next(batches)
+    skip_batches(batches, start_step)
 
     # host/TPU resource telemetry (upstream traceml's ResourceLogger ran in
     # the sidecar by default): metrics land in the run's event files under
@@ -223,6 +286,8 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
         interval = (float(res_spec.get("interval", 10.0))
                     if isinstance(res_spec, dict) else 10.0)
         res_logger = tracking.ResourceLogger(run, interval=interval).start()
+
+    from ..train.trainer import TrainingDivergedError
 
     try:
         profile = spec.get("profile")
@@ -250,6 +315,22 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
                 run.log_artifact("profile", "outputs/profile", kind="profile")
         else:
             state, metrics = trainer.fit(batches, num_steps=steps, state=state)
+    except TrainingDivergedError as e:
+        # fail the run LOUDLY with the anomaly history in outputs (ISSUE 8
+        # tentpole (b)): the budgets are gone, so retrying silently would
+        # just burn chips re-diverging — an operator needs the trail
+        if run is not None:
+            run.log_outputs(
+                diverged=True,
+                train_anomalies_loss=int(e.anomalies.get("loss", 0)),
+                train_anomalies_grad=int(e.anomalies.get("grad", 0)),
+                train_rollbacks=int(e.rollbacks),
+                anomaly_history=e.history,
+                resumed_from_step=int(start_step))
+            run.log_status("failed", reason="TrainingDiverged",
+                           message=str(e))
+            run.end()
+        raise SystemExit(f"training diverged: {e}")
     finally:
         # a failing fit must not leak the telemetry thread (it would keep
         # writing events for a dead run until process exit)
@@ -260,6 +341,13 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
     # preemption->resume proof asserts a restarted attempt reports > 0
     summary["resumed_from_step"] = int(start_step)
     if run is not None:
+        # final progress beat: the store's heartbeat_step lands on the
+        # terminal step and the train_* counter deltas are fully flushed
+        run.report_progress(
+            steps,
+            anomalies={"loss": summary.get("train_anomalies_loss", 0),
+                       "grad": summary.get("train_anomalies_grad", 0)},
+            rollbacks=int(summary.get("train_rollbacks", 0)))
         run.log_outputs(**summary)
         if ckpt:
             run.log_artifact("checkpoints", "outputs/checkpoints", kind="checkpoint")

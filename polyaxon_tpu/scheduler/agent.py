@@ -86,8 +86,18 @@ class _RunSidecar(threading.Thread):
                     return  # terminal scrape in _on_status finishes the job
                 # lease renewal: the sidecar is alive iff the agent is
                 # actively driving this run — exactly what the zombie
-                # reaper wants to know
-                self.agent.store.heartbeat(self.run_uuid)
+                # reaper wants to know. The beat carries the pod's
+                # published progress (step + divergence counters from
+                # progress.json) when there is any (ISSUE 8): liveness
+                # comes from the sidecar, PROGRESS only ever from the
+                # pod — which is exactly what lets the stall rule catch
+                # a wedged step behind a healthy sidecar.
+                prog = self.agent._pod_progress(row) or {}
+                self.agent.store.heartbeat(
+                    self.run_uuid, step=prog.get("step"),
+                    anomalies=prog.get("anomalies"),
+                    rollbacks=prog.get("rollbacks"),
+                    incarnation=prog.get("incarnation"))
                 self.agent.retry.call(
                     self.agent._stream_pod_logs, self.run_uuid, self._offsets,
                     row)
@@ -129,6 +139,7 @@ class LocalAgent:
         lease_ttl: float = 15.0,
         lease_name: str = "scheduler",
         num_shards: int = 1,
+        stall_grace: Optional[float] = None,
     ):
         import uuid as uuid_mod
 
@@ -262,9 +273,18 @@ class LocalAgent:
         # shard-scoped (ISSUE 6): the reaper renews/reaps only runs whose
         # shard this agent holds, and writes through the sharded fence —
         # N agents never double-reap one run
+        # progress-stall rule (ISSUE 8): a run whose heartbeats stay fresh
+        # (live sidecar) while its reported training step freezes for
+        # ``stall_grace`` is wedged, not healthy — its pod set is torn
+        # down so the reconciler's slice-restart path retries it from the
+        # latest checkpoint. Default 2x the zombie window; <=0 disables.
+        self.stall_grace = (2.0 * zombie_after if stall_grace is None
+                            else stall_grace)
         self.reaper = ZombieReaper(
             self.store, owned=self._driven_uuids, zombie_after=zombie_after,
-            metrics=self.metrics, owns_run=self._owns_run)
+            metrics=self.metrics, owns_run=self._owns_run,
+            stall_grace=self.stall_grace,
+            teardown=self._teardown_stalled)
         self.artifacts_root = os.path.abspath(artifacts_root)
         self.api_host = api_host
         self.api_token = api_token
@@ -1267,6 +1287,49 @@ class LocalAgent:
             for uuid in [u for u, s in self._sidecars.items() if not s.is_alive()]:
                 del self._sidecars[uuid]
 
+    def _teardown_stalled(self, run_uuid: str) -> bool:
+        """Stall-reap action for a run with a LIVE driver (ISSUE 8): kill
+        whatever executes it so the normal failure machinery — reconciler
+        slice-restart for cluster runs, the executor's exit path for
+        local ones — retries it from its latest checkpoint with its own
+        budget. The reaper never writes the transitions itself here: the
+        component that owns the run's lifecycle must stay the only one
+        driving it. Returns False when there was nothing to act on (the
+        driver already vanished) so the reaper doesn't count a teardown
+        that never happened."""
+        with self._lock:
+            ex = self._active.get(run_uuid)
+        if ex is not None and ex.proc is not None:
+            ex.proc.kill()  # hard: a wedged step ignores SIGTERM
+            return True
+        if self.reconciler is not None:
+            selector = {"app.polyaxon.com/run": run_uuid}
+            # count only real teardowns: pods may have vanished (slice
+            # death, concurrent stop) between the reaper's listing and
+            # this call — deleting nothing is not an action
+            if not self.retry.call(self.cluster.pod_statuses, selector):
+                return False
+            self.retry.call(self.cluster.delete_selected, selector)
+            return True
+        return False
+
+    def _pod_progress(self, run: dict) -> Optional[dict]:
+        """Read the pod-published progress.json from the run's artifacts
+        dir (tracking.Run.report_progress writes it atomically) — the
+        bridge that gives OFFLINE pods (no API client) a heartbeat
+        ``step``: the sidecar stamps it into the store each tick."""
+        import json
+
+        path = os.path.join(
+            run_artifacts_dir(self.artifacts_root, run["project"],
+                              run["uuid"]),
+            "progress.json")
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     def _store_weather(self, exc: BaseException) -> bool:
         """Transient store trouble worth a bounded in-line retry on a
         lifecycle write: SQLITE_BUSY bursts, a dead primary mid-failover
@@ -1282,9 +1345,32 @@ class LocalAgent:
         return isinstance(exc, (sqlite3.OperationalError, ConnectionError,
                                 StoreReadOnlyError, TimeoutError))
 
+    def _drop_stale_progress(self, run_uuid: str) -> None:
+        """A run heading back through retrying/queued is getting a fresh
+        attempt: its progress.json describes the DEAD attempt, and the
+        sidecar re-stamping it would make the new pod's compile/restore
+        window read as a frozen step — cascading stall-reaps until the
+        retry budget burned out. Delete it before the new pods start."""
+        row = None
+        try:
+            row = self.store.get_run(run_uuid)
+        except Exception:
+            pass
+        if not row:
+            return
+        try:
+            os.unlink(os.path.join(
+                run_artifacts_dir(self.artifacts_root, row["project"],
+                                  run_uuid),
+                "progress.json"))
+        except OSError:
+            pass
+
     def _on_status(self, run_uuid: str, status: str, message: Optional[str]) -> None:
         if is_done(status):
             self._collect_outputs_safe(run_uuid)
+        if status in (V1Statuses.RETRYING.value, V1Statuses.QUEUED.value):
+            self._drop_stale_progress(run_uuid)
         try:
             # ride out store weather (ISSUE 7): an executor's terminal
             # report is not re-emitted, so a transient fault here would
@@ -1310,6 +1396,8 @@ class LocalAgent:
         for uuid, status, _ in updates:
             if is_done(status):
                 self._collect_outputs_safe(uuid)
+            if status == V1Statuses.RETRYING.value:
+                self._drop_stale_progress(uuid)
         try:
             # same weather policy as _on_status; a batch that still fails
             # raises into the reconciler, which UNLATCHES and re-emits on

@@ -84,6 +84,10 @@ class Run:
             base = os.path.join(os.getcwd(), ".plx", "runs", self.run_uuid)
         self.run_dir = base
         self.trace_id = os.environ.get(ENV_TRACE_ID) or self.run_uuid
+        # one id per tracking PROCESS: progress reports carry it so the
+        # store's train-counter delta accounting can tell "restarted
+        # attempt, cumulatives reset" from "stale relay of an old value"
+        self.incarnation = uuid_mod.uuid4().hex[:12]
         os.makedirs(self.run_dir, exist_ok=True)
         self._writer = EventFileWriter(self.run_dir)
         self._logger = LogWriter(self.run_dir)
@@ -173,10 +177,61 @@ class Run:
 
         return self._spool.replay(_send)
 
-    def heartbeat(self) -> None:
+    def heartbeat(self, step: Optional[int] = None,
+                  anomalies: Optional[dict] = None,
+                  rollbacks: Optional[int] = None) -> None:
         """Renew this run's liveness lease (spooled through an outage so
-        the post-failover reaper sees the replayed beats, not a corpse)."""
-        self._api("heartbeat")
+        the post-failover reaper sees the replayed beats, not a corpse).
+
+        ``step`` (ISSUE 8) is the training-progress field the stall-aware
+        reaper watches: a pod whose heartbeats stay fresh while ``step``
+        freezes is wedged, not healthy. ``anomalies``/``rollbacks`` are
+        the pod's CUMULATIVE divergence-guard counters — the store turns
+        them into the ``polyaxon_train_*`` metric families by delta."""
+        kw: dict[str, Any] = {}
+        if step is not None:
+            kw["step"] = int(step)
+        if anomalies:
+            kw["anomalies"] = {k: int(v) for k, v in anomalies.items()}
+        if rollbacks:
+            kw["rollbacks"] = int(rollbacks)
+        if anomalies or rollbacks:
+            kw["incarnation"] = self.incarnation
+        self._api("heartbeat", **kw)
+
+    #: run-dir file the agent-side sidecar reads to bridge pod progress
+    #: into store heartbeats for runs with no API client (offline pods)
+    PROGRESS_FILE = "progress.json"
+
+    def report_progress(self, step: int, anomalies: Optional[dict] = None,
+                        rollbacks: Optional[int] = None) -> None:
+        """Publish training progress: atomically write ``progress.json``
+        into the run dir (tmp + rename — the sidecar never reads a torn
+        file) AND renew the API heartbeat with the ``step`` field. The
+        builtin runtime calls this rate-limited from the training loop."""
+        import json
+
+        payload: dict[str, Any] = {"step": int(step), "at": time.time(),
+                                   "incarnation": self.incarnation}
+        if anomalies:
+            payload["anomalies"] = {k: int(v) for k, v in anomalies.items()}
+        if rollbacks:
+            payload["rollbacks"] = int(rollbacks)
+        tmp = os.path.join(self.run_dir, "." + self.PROGRESS_FILE + ".tmp")
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, os.path.join(self.run_dir, self.PROGRESS_FILE))
+        except OSError:
+            pass  # progress publishing must never fail the training loop
+        self.heartbeat(step=step, anomalies=payload.get("anomalies"),
+                       rollbacks=payload.get("rollbacks"))
+
+    def flush(self) -> None:
+        """Flush buffered events/logs to disk NOW — the watchdog calls
+        this right before a hard exit so the training_stalled span and
+        the stack dump survive the process."""
+        self._writer.flush()
 
     # -- logging -----------------------------------------------------------
 

@@ -205,7 +205,14 @@ class Checkpointer:
         (possibly sharded) state. With ``step=None`` a torn/corrupt newest
         step — checksum mismatch, or an Orbax read error on a step without
         a manifest — is skipped and the next older complete step restores
-        instead; only when EVERY candidate fails does this raise."""
+        instead; only when EVERY candidate fails does this raise.
+
+        EVERY successful restore — explicit ``step=`` included (the
+        divergence rollback targets an older complete step, ISSUE 8) —
+        purges/quarantines the steps NEWER than the restored one: Orbax
+        silently skips ``save()`` at an existing step number, so leaving
+        the newer (possibly poisoned) dirs behind would block the resumed
+        run's own saves at those re-used labels forever."""
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
             if hasattr(x, "shape") else x,
@@ -232,8 +239,7 @@ class Checkpointer:
                     raise
                 errors.append((s, repr(e)))
                 continue
-            if step is None:
-                self._purge_newer_than(s)
+            self._purge_newer_than(s)
             return restored, s
         if step is None:  # same fresh-start-can-save guarantee as above
             self._purge_newer_than(-1)

@@ -65,6 +65,36 @@ class TrainerConfig:
     # set "bfloat16" explicitly to halve accumulator HBM when that is the
     # difference between fitting and OOM.
     accum_dtype: Optional[str] = None  # None = float32
+    # -- self-healing (ISSUE 8) -------------------------------------------
+    # Divergence guard: a step with non-finite loss or grad norm is
+    # SKIPPED inside the jitted step (params/opt state/extra keep their
+    # old values — donated-buffer safe, no host round-trip). After this
+    # many CONSECUTIVE bad steps the trainer rolls back to the latest
+    # complete checkpoint and rewinds the data stream to it.
+    anomaly_skip_budget: int = 3
+    # Rollbacks allowed before fit() fails loudly with the anomaly
+    # history (TrainingDivergedError -> run outputs).
+    anomaly_rollback_budget: int = 2
+    # Step-progress watchdog (train/watchdog.py). Off for library use —
+    # the builtin runtime turns it on for every pod it owns.
+    watchdog: bool = False
+    watchdog_stall_factor: float = 10.0   # x step-time p95
+    watchdog_min_s: float = 120.0         # deadline floor
+    watchdog_compile_grace_s: float = 1800.0  # before the first step
+
+
+class TrainingDivergedError(RuntimeError):
+    """The run burned its anomaly budgets: ``anomaly_skip_budget``
+    consecutive non-finite steps with no rollback left (or no complete
+    checkpoint to roll back to). Carries the anomaly history so the
+    builtin runtime can fail the run loudly with it in outputs."""
+
+    def __init__(self, message: str, history: list, anomalies: dict,
+                 rollbacks: int):
+        super().__init__(message)
+        self.history = history
+        self.anomalies = anomalies
+        self.rollbacks = rollbacks
 
 
 class Trainer:
@@ -80,6 +110,10 @@ class Trainer:
         track: Optional[Callable[[int, dict], None]] = None,
         task: Optional[Task] = None,
         on_span: Optional[Callable[..., None]] = None,
+        chaos: Optional[Any] = None,
+        on_progress: Optional[Callable[[int, dict, int], None]] = None,
+        on_stalled: Optional[Callable[[int, float, float], None]] = None,
+        log_line: Optional[Callable[[str], None]] = None,
     ):
         self.cfg = cfg
         if task is None:
@@ -113,6 +147,15 @@ class Trainer:
         # pod-side phases (first-step compile, train window, checkpoint
         # saves) land on the run's one-pane-of-glass timeline
         self.on_span = on_span
+        # self-healing wiring (ISSUE 8): trainer-level chaos injection
+        # (resilience.TrainerChaos), per-step progress reporting
+        # (on_progress(step, anomaly counts, rollbacks) — the builtin
+        # runtime heartbeats it with the step field), watchdog stall
+        # notification and the log sink stack dumps go to
+        self.chaos = chaos
+        self.on_progress = on_progress
+        self.on_stalled = on_stalled
+        self.log_line = log_line
         self.checkpointer = Checkpointer(cfg.checkpoint) if cfg.checkpoint else None
 
         pspecs = task.param_specs(self.rules)
@@ -213,11 +256,17 @@ class Trainer:
 
     # -- the step ----------------------------------------------------------
 
-    def _loss_fn(self, params, extra, batch):
+    def _loss_fn(self, params, extra, batch, inject):
         loss, metrics, new_extra = self.task.loss(
             params, extra, batch, mesh=self.mesh,
             interpret=jax.default_backend() != "tpu",
         )
+        # chaos injection point (resilience.TrainerChaos): multiplying by
+        # NaN poisons the loss AND every gradient flowing from it — the
+        # same blast radius a real divergence has. ``inject`` is a traced
+        # scalar, so the no-chaos path compiles the same program.
+        loss = loss * jnp.where(inject, jnp.float32(jnp.nan), jnp.float32(1.0))
+        metrics = {**metrics, "loss": loss}
         return loss, (metrics, new_extra)
 
     def make_step(self):
@@ -232,11 +281,12 @@ class Trainer:
                 f"microbatches {k}"
             )
 
-        def _grads(diff_params, extra, batch):
+        def _grads(diff_params, extra, batch, inject):
             return jax.value_and_grad(self._loss_fn, has_aux=True)(
-                diff_params, extra, batch)
+                diff_params, extra, batch, inject)
 
-        def step_fn(state: TrainState, batch) -> tuple[TrainState, dict]:
+        def step_fn(state: TrainState, batch,
+                    inject=False) -> tuple[TrainState, dict]:
             diff_params = state.params
             if gd is not None:
                 diff_params = jax.tree.map(
@@ -246,7 +296,7 @@ class Trainer:
                 )
             if k == 1:
                 (loss, (metrics, new_extra)), grads = _grads(
-                    diff_params, state.extra, batch)
+                    diff_params, state.extra, batch, inject)
             else:
                 micro = jax.tree.map(
                     lambda x: x.reshape((k, x.shape[0] // k) + x.shape[1:]),
@@ -257,7 +307,8 @@ class Trainer:
 
                 def acc_body(carry, mb):
                     g_acc, extra = carry
-                    (_, (m, new_extra)), g = _grads(diff_params, extra, mb)
+                    (_, (m, new_extra)), g = _grads(
+                        diff_params, extra, mb, inject)
                     g_acc = jax.tree.map(
                         lambda a, gi: a + gi.astype(a.dtype), g_acc, g)
                     return (g_acc, new_extra), m
@@ -275,7 +326,33 @@ class Trainer:
                 metrics = jax.tree.map(lambda m: m.mean(), ms)
             updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
-            metrics = {**metrics, "grad_norm": optax.global_norm(grads)}
+            grad_norm = optax.global_norm(grads)
+            # divergence guard (ISSUE 8 tentpole (b)): a non-finite loss or
+            # grad norm means this update would poison the params — select
+            # the OLD values instead. The select runs in-jit on the donated
+            # buffers (jit-cheap: one scalar predicate broadcast), so no
+            # host round-trip decides whether to apply; the fit loop reads
+            # the anomaly flags a step later and drives the skip/rollback
+            # POLICY without ever seeing poisoned state.
+            loss_ok = jnp.isfinite(metrics["loss"])
+            grad_ok = jnp.isfinite(grad_norm)
+            ok = loss_ok & grad_ok
+
+            def _sel(new, old):
+                return jnp.where(ok, new, old)
+
+            params = jax.tree.map(_sel, params, state.params)
+            opt_state = jax.tree.map(_sel, opt_state, state.opt_state)
+            new_extra = jax.tree.map(_sel, new_extra, state.extra)
+            metrics = {
+                **metrics, "grad_norm": grad_norm,
+                "anomaly_loss": (~loss_ok).astype(jnp.float32),
+                "anomaly_grad": (loss_ok & ~grad_ok).astype(jnp.float32),
+            }
+            # step counts ATTEMPTED steps (== batches consumed == the fit
+            # loop index), so checkpoint labels and data-stream positions
+            # stay aligned even across skipped updates; the optimizer's
+            # own count (inside opt_state) is what skips freeze
             return TrainState(params, opt_state, state.step + 1, new_extra), metrics
 
         self._compiled_step = jax.jit(step_fn, donate_argnums=(0,))
@@ -305,33 +382,217 @@ class Trainer:
         metrics: dict = {}
         t_fit = time.time()  # span clock: epoch (joins condition timestamps)
         t_train: Optional[float] = None
-        for i in range(start, num_steps):
-            batch = next(batches)
-            state, metrics = step_fn(state, batch)
-            if i == start:
-                # Sync via scalar fetch, not block_until_ready: on tunneled
-                # platforms (axon) block_until_ready returns before execution
-                # finishes; a device->host copy always waits.
-                float(metrics["loss"])  # excludes compile from timing
-                t_train = time.time()
+        log = self.log_line or (lambda s: print(s, flush=True))
+
+        # -- step-progress watchdog (ISSUE 8 tentpole (a)) ----------------
+        watchdog = None
+        if self.cfg.watchdog:
+            from .watchdog import StepWatchdog
+
+            def _stall(step: int, waited: float, limit: float) -> None:
+                now = time.time()
                 if self.on_span:
-                    self.on_span("first-step-compiled", t_fit, t_train, step=i)
-                meter.start()
+                    # the span covers the silent window itself
+                    self.on_span("training_stalled", now - waited, now,
+                                 step=step, limit_s=round(limit, 3))
+                if self.on_stalled:
+                    self.on_stalled(step, waited, limit)
+
+            watchdog = StepWatchdog(
+                stall_factor=self.cfg.watchdog_stall_factor,
+                min_s=self.cfg.watchdog_min_s,
+                compile_grace_s=self.cfg.watchdog_compile_grace_s,
+                p95_s=lambda: meter._interval_quantile(0.95),
+                on_stall=_stall, log=log)
+            watchdog.start()
+
+        # -- divergence-guard policy state (ISSUE 8 tentpole (b)) ---------
+        skip_budget = max(int(self.cfg.anomaly_skip_budget), 1)
+        anomalies = {"loss": 0, "grad": 0}
+        history: list[dict] = []
+        rollbacks = 0
+        consec = 0
+        # (step index, metrics) of the youngest step whose anomaly flags
+        # are still on device: resolving step i-1's scalars AFTER step i
+        # is dispatched overlaps the fetch with real compute instead of
+        # serializing the loop on a per-step device sync
+        pending: Optional[tuple[int, dict]] = None
+        # absolute batch index the stream will yield next; == the loop
+        # index while the stream is seekable and rollbacks rewind it
+        data_pos = int(getattr(batches, "position", start))
+
+        def _diverged(msg: str) -> TrainingDivergedError:
+            return TrainingDivergedError(
+                f"{msg} (anomalies={anomalies}, rollbacks={rollbacks}, "
+                f"skip_budget={skip_budget})",
+                history[-64:], dict(anomalies), rollbacks)
+
+        def _resolve(entry: Optional[tuple[int, dict]]) -> Optional[int]:
+            """Pull an entry's anomaly flags off device and apply the
+            policy. Returns the step to rewind the loop to when a
+            rollback happened, else None. Raises TrainingDivergedError
+            when the budgets are gone."""
+            nonlocal consec, rollbacks
+            if entry is None:
+                return None
+            at, m = entry
+            a_loss = bool(float(m["anomaly_loss"]))
+            a_grad = bool(float(m["anomaly_grad"]))
+            if not (a_loss or a_grad):
+                consec = 0
+                return None
+            kind = "loss" if a_loss else "grad"
+            anomalies[kind] += 1
+            if len(history) < 256:
+                history.append({"step": at, "kind": kind})
+            consec += 1
+            log(f"[trainer] non-finite {kind} at step {at}: update "
+                f"skipped ({consec}/{skip_budget} consecutive)")
+            if consec < skip_budget:
+                return None
+            if (self.checkpointer is None
+                    or rollbacks >= self.cfg.anomaly_rollback_budget):
+                raise _diverged(
+                    f"{consec} consecutive non-finite steps at step {at} "
+                    "and no rollback budget left")
+            return _rollback(at)
+
+        def _rollback(at_step: int) -> int:
+            """Roll back to the newest COMPLETE checkpoint: restore
+            (purging newer, possibly-poisoned steps so the post-rollback
+            re-save at a re-used label cannot collide), rewind the data
+            stream to the restored step, and return it as the new loop
+            index. The replayed window trains on the same batches the
+            oracle saw — with the fault budget spent, the healed run
+            converges to exact parity."""
+            nonlocal state, consec, rollbacks, pending, data_pos
+            t0 = time.time()
+            if watchdog is not None:
+                watchdog.beat(at_step)  # the restore itself may be slow
+            self.checkpointer.wait()  # settle in-flight async saves
+            try:
+                # current state supplies structure + shardings; its values
+                # are clean (skips never applied) but pre-anomaly drift is
+                # exactly what the rollback discards
+                state, s = self.checkpointer.restore(state)
+            except FileNotFoundError as e:
+                raise _diverged(
+                    f"anomaly streak at step {at_step} but no complete "
+                    f"checkpoint survived verification") from e
+            rollbacks += 1
+            consec = 0
+            pending = None  # flags of discarded dispatches are meaningless
+            seek = getattr(batches, "seek", None)
+            if callable(seek):
+                seek(s)
+                data_pos = s
             else:
-                if i == num_steps - 1:
-                    float(metrics["loss"])  # close the last timed interval
-                meter.step()
-            if self.track and (i % self.cfg.log_interval == 0 or i == num_steps - 1):
-                logged = {k: float(v) for k, v in metrics.items()}
-                logged.update(meter.summary())
-                self.track(i, logged)
-            if self.checkpointer:
-                t_save = time.time()
-                if self.checkpointer.maybe_save(i + 1, state) and self.on_span:
-                    # async mode: the span covers the synchronous handoff
-                    # (device->host fetch + save dispatch), not the flush
-                    self.on_span("checkpoint-save", t_save, time.time(),
-                                 step=i + 1)
+                log("[trainer] data stream is not seekable: resuming "
+                    "forward from the current position — the run heals "
+                    "but without exact oracle parity")
+            log(f"[trainer] rolled back to checkpoint step {s} after "
+                f"anomaly streak at step {at_step} "
+                f"(rollback {rollbacks}/{self.cfg.anomaly_rollback_budget})")
+            if self.on_span:
+                self.on_span("rollback", t0, time.time(), step=s,
+                             from_step=at_step, rollbacks=rollbacks)
+            meter.start()  # the restore pause is not a step interval
+            if watchdog is not None:
+                watchdog.beat(s)
+            return s
+
+        def _dispatch(i: int) -> None:
+            """Chaos hooks + one step dispatch + progress beats."""
+            nonlocal state, metrics, data_pos, pending
+            if self.chaos is not None:
+                self.chaos.pre_step(data_pos)
+            inject = (self.chaos is not None
+                      and self.chaos.nan_due(data_pos))
+            batch = next(batches)
+            data_pos += 1
+            state, metrics = step_fn(state, batch, inject)
+            pending = (i, metrics)
+            if watchdog is not None:
+                watchdog.beat(i)
+            if self.on_progress is not None:
+                self.on_progress(i, anomalies, rollbacks)
+
+        try:
+            i = start
+            while True:
+                while i < num_steps:
+                    prev = pending
+                    _dispatch(i)
+                    if not meter.steps and t_train is None:
+                        # Sync via scalar fetch, not block_until_ready: on
+                        # tunneled platforms (axon) block_until_ready
+                        # returns before execution finishes; a
+                        # device->host copy always waits.
+                        float(metrics["loss"])  # excludes compile
+                        t_train = time.time()
+                        if self.on_span:
+                            self.on_span("first-step-compiled", t_fit,
+                                         t_train, step=i)
+                        meter.start()
+                    else:
+                        if i == num_steps - 1:
+                            float(metrics["loss"])  # close last interval
+                        meter.step()
+                    rewind = _resolve(prev)
+                    if rewind is not None:
+                        i = rewind
+                        continue
+                    if self.track and (i % self.cfg.log_interval == 0
+                                       or i == num_steps - 1):
+                        logged = {k: float(v) for k, v in metrics.items()}
+                        logged.update(meter.summary())
+                        self.track(i, logged)
+                    if self.checkpointer and consec == 0 \
+                            and self._save_due(i + 1):
+                        # the label must only cover RESOLVED-clean steps:
+                        # eagerly settle this step's flags (one sync at a
+                        # save boundary) so a poisoned step can never be
+                        # published under a clean label
+                        rewind = _resolve(pending)
+                        pending = None
+                        if rewind is not None:
+                            i = rewind
+                            continue
+                        if consec:
+                            # the eager resolve just found THIS step
+                            # anomalous (streak starting exactly at the
+                            # boundary): saving would publish a label
+                            # that covers a skipped step — a later
+                            # rollback would restore past it and never
+                            # replay its batch, silently losing the
+                            # update the oracle applied
+                            i += 1
+                            continue
+                        t_save = time.time()
+                        if self.checkpointer.maybe_save(i + 1, state) \
+                                and self.on_span:
+                            # async mode: the span covers the synchronous
+                            # handoff (device->host fetch + save
+                            # dispatch), not the flush
+                            self.on_span("checkpoint-save", t_save,
+                                         time.time(), step=i + 1)
+                        if watchdog is not None:
+                            # a long SYNC save is progress, not a stall:
+                            # without this beat a save outlasting the
+                            # deadline would hard-exit a healthy run at
+                            # every save boundary
+                            watchdog.beat(i)
+                    i += 1
+                # the last dispatched step's flags may still be pending —
+                # a trailing anomaly must not slip out in `final`
+                rewind = _resolve(pending)
+                pending = None
+                if rewind is None:
+                    break
+                i = rewind
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
         if t_train is not None and self.on_span:
             self.on_span("train", t_train, time.time(),
                          steps=num_steps - start)
@@ -345,4 +606,16 @@ class Trainer:
             self.checkpointer.wait()
         final = {k: float(v) for k, v in metrics.items()}
         final.update(meter.summary())
+        final["train_anomalies_loss"] = anomalies["loss"]
+        final["train_anomalies_grad"] = anomalies["grad"]
+        final["train_rollbacks"] = rollbacks
         return state, final
+
+    def _save_due(self, step: int) -> bool:
+        """Would the interval policy save at ``step``? (Checked before the
+        eager anomaly resolve so clean steady-state steps never pay the
+        device sync.)"""
+        try:
+            return bool(self.checkpointer.manager.should_save(step))
+        except Exception:
+            return True  # unknown manager: be safe, resolve + let save decide

@@ -460,6 +460,217 @@ def _sharded_kill_soak(workdir: str, *, seed: int, n_jobs: int, kills: int,
             a.stop()
 
 
+TRAIN_FAULT_STEPS = 48
+
+
+def _train_fault_runtime(seed: int = 2024, **over):
+    """The self-healing training fixture (ISSUE 8): llama-tiny on CPU,
+    sync checkpoints every 4 steps, fast progress beats. ``seed`` drives
+    the data stream — the oracle and every fault round must share it for
+    the parity comparison to mean anything."""
+    rt = {
+        "model": "llama-tiny", "steps": TRAIN_FAULT_STEPS, "batch_size": 8,
+        "seq_len": 32, "learning_rate": 1e-3, "platform": "cpu",
+        "parallelism": {"data": 1},
+        "data": {"kind": "synthetic-lm", "seed": int(seed)},
+        "checkpoint": {"save_interval_steps": 4, "max_to_keep": 4,
+                       "async_save": False},
+        "resources": False,
+        "progress_interval": 0.2,
+        "log_interval": 4,
+    }
+    rt.update(over)
+    return rt
+
+
+def _train_fault_spec(name: str, runtime: dict, max_retries: int = 2):
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": name,
+        "termination": {"maxRetries": max_retries},
+        "component": {
+            "kind": "component",
+            "name": "train",
+            "run": {"kind": "tpujob", "accelerator": "v5e",
+                    "topology": "2x2", "runtime": runtime},
+        },
+    }).to_dict()
+
+
+def _train_oracle(workdir: str, seed: int = 2024) -> dict:
+    """Fault-free reference: the same runtime run in-process."""
+    from polyaxon_tpu import tracking
+    from polyaxon_tpu.runtime.builtin import run_builtin
+
+    os.makedirs(workdir, exist_ok=True)
+    old_env = {k: os.environ.get(k) for k in
+               ("PLX_RUN_UUID", "PLX_PROJECT", "PLX_ARTIFACTS_PATH")}
+    os.environ["PLX_RUN_UUID"] = "oracle"
+    os.environ["PLX_PROJECT"] = "p"
+    os.environ["PLX_ARTIFACTS_PATH"] = workdir
+    try:
+        return run_builtin(_train_fault_runtime(seed, watchdog=False))
+    finally:
+        tracking.end()
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_train_fault_soak(workdir: str, seed: int = 2024,
+                         timeout: float = 600.0) -> dict:
+    """The ISSUE 8 data-plane soak: three builtin-runtime training pods
+    under one agent, each with a different mid-training fault —
+
+    - ``hang-watchdog``: the step wedges at the midpoint; the pod's OWN
+      watchdog must dump stacks, emit the ``training_stalled`` span and
+      hard-exit so the retry budget restarts it from its checkpoint;
+    - ``nan-burst``: 3 consecutive NaN steps; the divergence guard skips
+      them, rolls back to the latest complete checkpoint, rewinds the
+      (seekable) data stream and replays to final-loss PARITY;
+    - ``stall-reap``: the same hang with the watchdog DISABLED — the
+      sidecar keeps heartbeating for the wedged pod, and the agent's
+      stall-aware reaper must catch the frozen ``heartbeat_step`` and
+      tear the pod set down into the slice-restart path.
+
+    Every healed run must land on the fault-free oracle's final loss.
+    Returns statuses/outputs/spans + the strict /metrics scrape."""
+    from polyaxon_tpu.api.app import run_artifacts_dir
+    from polyaxon_tpu.api.store import Store
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+    from polyaxon_tpu.tracking import read_events
+
+    store = Store(":memory:")
+    cluster = FakeCluster(os.path.join(workdir, ".cluster"))
+    # fast failure-detection clocks: sidecars beat every 1s, reaper pass
+    # every zombie_after/4, stall verdict after stall_grace on two
+    # clocks. stall_grace sits well above the watchdog deadline — even
+    # with the 4x-p95 scaling inflated by CPU contention between the
+    # three concurrent trainings — so the pod's OWN watchdog always gets
+    # first verdict on its round; the reaper is the backstop for
+    # watchdog-less pods, not a racer (prod default: 2x zombie_after)
+    agent = LocalAgent(store, workdir, backend="cluster", cluster=cluster,
+                       poll_interval=0.05, zombie_after=8.0,
+                       stall_grace=12.0)
+    agent.start()
+    mid = TRAIN_FAULT_STEPS // 2
+    wd = {"min_s": 3.0, "stall_factor": 4.0, "compile_grace_s": 120.0}
+    rounds = {
+        "hang-watchdog": _train_fault_runtime(
+            seed, chaos={"hang_at_step": mid}, watchdog=wd),
+        "nan-burst": _train_fault_runtime(
+            seed, chaos={"nan_at_step": mid, "nan_count": 3}),
+        "stall-reap": _train_fault_runtime(
+            seed, chaos={"hang_at_step": mid}, watchdog=False),
+    }
+    try:
+        uuids = {name: store.create_run(
+            "p", spec=_train_fault_spec(name, rt))["uuid"]
+            for name, rt in rounds.items()}
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = [store.get_run(u) for u in uuids.values()]
+            if all(r["status"] in ("succeeded", "failed", "stopped")
+                   for r in rows):
+                break
+            time.sleep(0.2)
+        out: dict = {"statuses": {}, "outputs": {}, "spans": {},
+                     "conditions": {}}
+        for name, uuid in uuids.items():
+            row = store.get_run(uuid)
+            out["statuses"][name] = row["status"]
+            out["outputs"][name] = row.get("outputs") or {}
+            out["conditions"][name] = [
+                (c.get("type"), c.get("reason"))
+                for c in store.get_statuses(uuid)]
+            run_dir = run_artifacts_dir(workdir, "p", uuid)
+            out["spans"][name] = sorted({
+                (e.span.name if e.span else None)
+                for kind in ("training_stalled", "rollback")
+                for e in read_events(run_dir, "span", kind)
+            } - {None})
+        out["stalled_reaps"] = [r for r in agent.reaper.reaped
+                                if r[1].startswith("stalled")]
+        out["metrics_text"] = store.metrics.render()
+        out["launch_counts"] = dict(getattr(cluster, "launch_counts", {}))
+        out["duplicate_applies"] = list(
+            getattr(cluster, "duplicate_applies", []))
+        return out
+    finally:
+        agent.stop()
+
+
+def _run_train_faults_mode(args) -> int:
+    from polyaxon_tpu.obs import parse_prometheus
+
+    root = tempfile.mkdtemp(prefix="plx-train-fault-soak-")
+    ok = True
+    final_scrape = ""
+    try:
+        oracle = _train_oracle(os.path.join(root, "oracle"), seed=args.seed)
+        print(json.dumps({"pass": "oracle", "loss": oracle["loss"]}))
+        out = run_train_fault_soak(os.path.join(root, "faults"),
+                                   seed=args.seed, timeout=args.timeout)
+        final_scrape = out["metrics_text"]
+        fams = parse_prometheus(final_scrape)
+        anomalies = fams.get("polyaxon_train_anomalies_total", {})
+        rollbacks = fams.get("polyaxon_train_rollbacks_total", {})
+        stalled = fams.get("polyaxon_run_stalled_reaps_total", {})
+        checks = {
+            "all_succeeded": all(v == "succeeded"
+                                 for v in out["statuses"].values()),
+            "hang_resumed": out["outputs"]["hang-watchdog"].get(
+                "resumed_from_step", 0) > 0,
+            "hang_stalled_span": "training_stalled"
+                in out["spans"]["hang-watchdog"],
+            "nan_rolled_back": out["outputs"]["nan-burst"].get(
+                "train_rollbacks", 0) >= 1,
+            "nan_rollback_span": "rollback" in out["spans"]["nan-burst"],
+            "stall_reaped": len(out["stalled_reaps"]) >= 1,
+            "stall_resumed": out["outputs"]["stall-reap"].get(
+                "resumed_from_step", 0) > 0,
+            "no_duplicate_applies": not out["duplicate_applies"],
+            # the scrape tells the same story as the soak's audit trail
+            "scrape_anomalies": sum(anomalies.values()) == float(
+                out["outputs"]["nan-burst"].get("train_anomalies_loss", 0)
+                + out["outputs"]["nan-burst"].get("train_anomalies_grad", 0)),
+            "scrape_rollbacks": sum(rollbacks.values()) == float(
+                out["outputs"]["nan-burst"].get("train_rollbacks", 0)),
+            "scrape_stalled": sum(stalled.values()) == float(
+                len(out["stalled_reaps"])),
+        }
+        parity = {}
+        for name in out["statuses"]:
+            loss = out["outputs"][name].get("loss")
+            parity[name] = (None if loss is None else
+                            abs(loss - oracle["loss"]))
+            checks[f"parity_{name}"] = (
+                loss is not None
+                and abs(loss - oracle["loss"]) <= 1e-2 * abs(oracle["loss"]))
+        ok = all(checks.values())
+        print(json.dumps({
+            "pass": "train-faults", "ok": ok, "checks": checks,
+            "statuses": out["statuses"], "parity_abs": parity,
+            "stalled_reaps": out["stalled_reaps"],
+            "train_anomalies": anomalies, "train_rollbacks": rollbacks,
+            "stalled_reaps_total": stalled,
+        }))
+    finally:
+        if args.keep:
+            print(json.dumps({"workdir": root}))
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+    if args.metrics_dump:
+        _dump_metrics(args.metrics_dump, final_scrape)
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
 def run_store_outage_soak(workdir: str, seed: int = 2024, n_jobs: int = 12,
                           agents: int = 4, num_shards: int = 8,
                           lease_ttl: float = 0.8, timeout: float = 300.0,
@@ -782,6 +993,15 @@ def main() -> int:
                    help="with --agents > 1: kill victims WITHOUT "
                         "replacement — survivors must adopt the orphaned "
                         "shards within 2x the lease TTL")
+    p.add_argument("--train-faults", action="store_true",
+                   help="data-plane self-healing soak (ISSUE 8): a "
+                        "mid-training hang (watchdog fires -> restart "
+                        "resumes), a NaN burst (skip -> rollback -> "
+                        "converge) and a watchdog-less hang (stall-aware "
+                        "reaper) must all self-heal to final-loss parity "
+                        "with the uninterrupted oracle, with the "
+                        "polyaxon_train_*/stalled-reap families matching "
+                        "the audit trail via the strict /metrics scrape")
     p.add_argument("--store-outage", action="store_true",
                    help="store-survivability soak (ISSUE 7): kill the "
                         "PRIMARY STORE mid-wave under a sharded agent "
@@ -800,6 +1020,8 @@ def main() -> int:
                         "bench_artifacts/chaos_soak_metrics.prom)")
     args = p.parse_args()
 
+    if args.train_faults:
+        return _run_train_faults_mode(args)
     if args.store_outage:
         return _run_store_outage_mode(args)
     if (args.kill_agent or args.split_brain or args.rolling_kill

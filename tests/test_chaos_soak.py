@@ -459,6 +459,75 @@ class TestStoreOutageSoak:
 
 
 # ---------------------------------------------------------------------------
+# 5. self-healing training pods (ISSUE 8): hang -> watchdog -> resume,
+#    NaN burst -> skip -> rollback -> parity, watchdog-less hang ->
+#    stall-aware reap -> slice restart — all to oracle final-loss parity
+# ---------------------------------------------------------------------------
+
+
+class TestTrainFaultSoak:
+    def test_hang_nan_and_stall_all_self_heal_to_oracle_parity(
+            self, tmp_path):
+        """ISSUE 8 acceptance soak: three builtin-runtime training pods
+        under one agent, each with a different mid-training fault —
+
+        - a wedged step whose pod-local watchdog dumps stacks, emits the
+          ``training_stalled`` span and hard-exits into the retry budget
+          (restart resumes from checkpoint, NOT step 0);
+        - a 3-step NaN burst the divergence guard skips, rolls back from
+          and replays (the ``rollback`` span lands on the timeline);
+        - the same wedge with the watchdog DISABLED: the sidecar keeps
+          heartbeating for the corpse, and the agent's stall-aware
+          reaper must catch the frozen heartbeat_step and tear the pod
+          set into the slice-restart path.
+
+        All three must reach the fault-free oracle's final loss with
+        zero human intervention, and the polyaxon_train_anomalies_total /
+        polyaxon_train_rollbacks_total / polyaxon_run_stalled_reaps_total
+        families must match the soak's audit trail via the strict
+        /metrics scrape."""
+        from chaos_soak import _train_oracle, run_train_fault_soak
+
+        from polyaxon_tpu.obs import parse_prometheus
+
+        oracle = _train_oracle(str(tmp_path / "oracle"))
+        out = run_train_fault_soak(str(tmp_path / "faults"), timeout=420)
+
+        assert all(v == "succeeded" for v in out["statuses"].values()), out
+        # hang round: the watchdog (not a human) ended the wedged attempt
+        assert "training_stalled" in out["spans"]["hang-watchdog"], out
+        assert out["outputs"]["hang-watchdog"]["resumed_from_step"] > 0, out
+        assert any(t == "retrying"
+                   for t, _ in out["conditions"]["hang-watchdog"]), \
+            out["conditions"]["hang-watchdog"]
+        # nan round: skip -> rollback -> replay, with the span to prove it
+        nan_out = out["outputs"]["nan-burst"]
+        assert nan_out["train_anomalies_loss"] == 3, nan_out
+        assert nan_out["train_rollbacks"] >= 1, nan_out
+        assert "rollback" in out["spans"]["nan-burst"], out
+        # stall round: reaped as stalled (exactly the wedged run), resumed
+        assert len(out["stalled_reaps"]) >= 1, out
+        assert out["outputs"]["stall-reap"]["resumed_from_step"] > 0, out
+        assert out["duplicate_applies"] == [], out
+        # final-loss parity with the uninterrupted oracle, all rounds
+        for name, o in out["outputs"].items():
+            assert o["loss"] == pytest.approx(oracle["loss"], rel=1e-2), (
+                name, o["loss"], oracle["loss"])
+        # the strict scrape tells the same story as the audit trail
+        fams = parse_prometheus(out["metrics_text"])
+        anoms = fams["polyaxon_train_anomalies_total"]
+        assert sum(anoms.values()) == float(
+            nan_out["train_anomalies_loss"]
+            + nan_out.get("train_anomalies_grad", 0)), (anoms, nan_out)
+        assert fams["polyaxon_train_rollbacks_total"][
+            "polyaxon_train_rollbacks_total"] == float(
+            nan_out["train_rollbacks"])
+        assert fams["polyaxon_run_stalled_reaps_total"][
+            "polyaxon_run_stalled_reaps_total"] == float(
+            len(out["stalled_reaps"]))
+
+
+# ---------------------------------------------------------------------------
 # 4. agent SIGKILL + slice death + TORN newest checkpoint -> resume from
 #    the newest COMPLETE step (ISSUE 4 acceptance criterion)
 # ---------------------------------------------------------------------------

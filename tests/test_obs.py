@@ -58,6 +58,11 @@ EXPECTED_FAMILIES = {
     "polyaxon_store_epoch",
     "polyaxon_store_degraded",
     "polyaxon_store_epoch_fence_rejections_total",
+    # data-plane self-healing (ISSUE 8): divergence-guard skips/rollbacks
+    # bridged from pod heartbeats, and the reaper's stall-reap count
+    "polyaxon_train_anomalies_total",
+    "polyaxon_train_rollbacks_total",
+    "polyaxon_run_stalled_reaps_total",
 }
 
 

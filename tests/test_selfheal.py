@@ -1,0 +1,649 @@
+"""Self-healing training pods (ISSUE 8) — tier-1 units and smokes:
+
+- StepWatchdog: fires on step silence (stack dump + on_stall + hard
+  exit), stays quiet while steps beat, scales its deadline with the
+  observed step-time p95.
+- Divergence guard: non-finite steps are skipped in-jit (params stay
+  finite), a streak rolls back to the latest complete checkpoint and
+  replays to EXACT parity with an uninterrupted oracle, and exhausted
+  budgets fail loudly with the anomaly history.
+- Seekable data streams: skip(n)/seek(pos) are O(1) and equivalent to
+  generate-and-discard, through the prefetch wrapper too.
+- Checkpointer.restore(step=): restoring an OLDER complete step purges/
+  quarantines the newer (poisoned) ones so the post-rollback re-save at
+  a re-used label cannot collide.
+- Heartbeat ``step``: store column + step_at freeze/advance semantics,
+  delta accounting into the polyaxon_train_* families, POST /heartbeat
+  payload, tracking progress.json publication.
+- Stall-aware reaper: sidecar-alive-but-step-frozen runs are reaped as
+  ``stalled`` (store path and live-driver teardown path), slow-but-
+  progressing runs never are, clocks reset on owner change, and the reap
+  is exactly-once across a 4-agent sharded fleet.
+
+The end-to-end soak (hang -> watchdog -> resume, NaN burst -> rollback
+-> parity, watchdog-less hang -> stall reap) is the slow
+tests/test_chaos_soak.py::TestTrainFaultSoak.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from polyaxon_tpu.api.store import Store, shard_index
+from polyaxon_tpu.obs import MetricsRegistry, parse_prometheus
+from polyaxon_tpu.resilience import TrainerChaos, ZombieReaper
+from polyaxon_tpu.train.data import (
+    DataConfig, PrefetchedStream, make_batches, skip_batches,
+    synthetic_lm_batches, token_file_batches,
+)
+from polyaxon_tpu.train.watchdog import WATCHDOG_EXIT_CODE, StepWatchdog
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestStepWatchdog:
+    def _fired(self, wd, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not wd.fired:
+            time.sleep(0.01)
+        return wd.fired
+
+    def test_fires_on_step_silence_with_stack_dump_and_exit(self):
+        lines, stalls, exits = [], [], []
+        done = threading.Event()
+
+        def exit_fn(code):
+            exits.append(code)
+            done.set()
+
+        wd = StepWatchdog(min_s=0.15, compile_grace_s=0.15,
+                          stall_factor=2.0, p95_s=lambda: 0.0,
+                          on_stall=lambda *a: stalls.append(a),
+                          log=lines.append, exit_fn=exit_fn)
+        wd.start()
+        wd.beat(7)
+        assert done.wait(10.0), "watchdog never fired"
+        assert wd.fired
+        assert exits == [WATCHDOG_EXIT_CODE]
+        step, waited, limit = stalls[0]
+        assert step == 7 and waited >= limit >= 0.15
+        # the post-mortem: every thread's stack went through the log sink
+        text = "\n".join(lines)
+        assert "--- thread" in text and "test_selfheal" in text
+
+    def test_stays_quiet_while_steps_beat(self):
+        exits = []
+        wd = StepWatchdog(min_s=0.15, compile_grace_s=0.15,
+                          exit_fn=exits.append)
+        wd.start()
+        try:
+            for i in range(8):
+                wd.beat(i)
+                time.sleep(0.05)
+            assert not wd.fired and exits == []
+        finally:
+            wd.stop()
+
+    def test_deadline_scales_with_observed_p95(self):
+        """A 10s-p95 run must not be judged on the floor: stall_factor x
+        p95 wins over min_s, so the silence below it never fires."""
+        exits = []
+        wd = StepWatchdog(min_s=0.05, compile_grace_s=0.05,
+                          stall_factor=4.0, p95_s=lambda: 10.0,
+                          exit_fn=exits.append)
+        wd.start()
+        try:
+            wd.beat(0)
+            time.sleep(0.4)  # way past min_s, far under 4 x 10s
+            assert not wd.fired and exits == []
+        finally:
+            wd.stop()
+
+    def test_compile_grace_applies_before_first_beat(self):
+        exits = []
+        wd = StepWatchdog(min_s=0.05, compile_grace_s=30.0,
+                          exit_fn=exits.append)
+        wd.start()
+        try:
+            time.sleep(0.3)  # past min_s; no beat yet -> grace holds
+            assert not wd.fired
+        finally:
+            wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# seekable data streams (O(1) resume fast-forward / rollback rewind)
+# ---------------------------------------------------------------------------
+
+
+class TestSeekableStreams:
+    CFG = DataConfig(kind="synthetic-lm", batch_size=4, seq_len=8,
+                     vocab_size=64, seed=11)
+
+    def test_skip_equals_generate_and_discard(self):
+        a = synthetic_lm_batches(self.CFG)
+        b = synthetic_lm_batches(self.CFG)
+        for _ in range(5):
+            next(a)
+        b.skip(5)
+        np.testing.assert_array_equal(np.asarray(next(a)["inputs"]),
+                                      np.asarray(next(b)["inputs"]))
+
+    def test_seek_rewinds_to_absolute_position(self):
+        s = synthetic_lm_batches(self.CFG)
+        batches = [np.asarray(next(s)["inputs"]) for _ in range(7)]
+        s.seek(3)
+        np.testing.assert_array_equal(np.asarray(next(s)["inputs"]),
+                                      batches[3])
+        assert s.position == 4
+
+    def test_prefetched_tokens_file_skip_and_seek(self, tmp_path):
+        rng = np.random.default_rng(42)
+        p = tmp_path / "corpus.npy"
+        np.save(p, rng.integers(0, 64, 10_000, dtype=np.uint16))
+        cfg = DataConfig(kind="tokens-file", path=str(p), batch_size=2,
+                         seq_len=8, vocab_size=64, seed=3)
+        plain = token_file_batches(cfg)
+        plain.skip(4)
+        want = np.asarray(next(plain)["inputs"])
+        pf = make_batches(cfg)
+        assert isinstance(pf, PrefetchedStream)
+        pf.skip(4)  # before first pull: no worker restart
+        np.testing.assert_array_equal(np.asarray(next(pf)["inputs"]), want)
+        # seek AFTER consumption: worker restarts from the new cursor
+        pf.seek(4)
+        np.testing.assert_array_equal(np.asarray(next(pf)["inputs"]), want)
+        pf.close()
+
+    def test_skip_batches_falls_back_for_plain_iterators(self):
+        it = iter(range(10))
+        skip_batches(it, 4)
+        assert next(it) == 4
+        s = synthetic_lm_batches(self.CFG)
+        skip_batches(s, 6)
+        assert s.position == 6
+
+
+# ---------------------------------------------------------------------------
+# divergence guard: in-jit skip, rollback-to-parity, loud failure
+# ---------------------------------------------------------------------------
+
+
+def _trainer(ckpt_dir=None, chaos=None, skip_budget=3, rollback_budget=2,
+             steps=12):
+    from polyaxon_tpu.models import llama
+    from polyaxon_tpu.train import (
+        CheckpointConfig, OptimizerConfig, Trainer, TrainerConfig,
+    )
+
+    cfg = TrainerConfig(
+        model=llama.LLAMA_TINY,
+        optimizer=OptimizerConfig(learning_rate=1e-3, warmup_steps=0,
+                                  schedule="constant", total_steps=steps),
+        batch_size=8, seq_len=32, parallelism={"data": 1},
+        checkpoint=(CheckpointConfig(directory=ckpt_dir,
+                                     save_interval_steps=3, max_to_keep=5,
+                                     async_save=False)
+                    if ckpt_dir else None),
+        anomaly_skip_budget=skip_budget,
+        anomaly_rollback_budget=rollback_budget,
+    )
+    return Trainer(cfg, chaos=chaos)
+
+
+def _lm_data():
+    return make_batches(DataConfig(kind="synthetic-lm", batch_size=8,
+                                   seq_len=32, vocab_size=256, seed=7))
+
+
+class TestDivergenceGuard:
+    STEPS = 12
+
+    @pytest.fixture(scope="class")
+    def oracle(self):
+        _, m = _trainer(steps=self.STEPS).fit(_lm_data(),
+                                              num_steps=self.STEPS)
+        return m
+
+    def test_nan_burst_rolls_back_and_replays_to_exact_parity(
+            self, tmp_path, oracle):
+        """The tentpole (b) acceptance in miniature: a 2-step NaN burst
+        is skipped in-jit (no poisoned update is ever applied), the
+        streak trips a rollback to the latest complete checkpoint, the
+        SEEKABLE stream rewinds, and the replay — fault budget spent —
+        lands on the uninterrupted oracle's final loss EXACTLY."""
+        chaos = TrainerChaos(nan_at_step=7, nan_count=2,
+                             state_dir=str(tmp_path))
+        tr = _trainer(ckpt_dir=str(tmp_path / "ck"), chaos=chaos,
+                      skip_budget=2, steps=self.STEPS)
+        spans = []
+        tr.on_span = lambda name, *a, **kw: spans.append(name)
+        _, m = tr.fit(_lm_data(), num_steps=self.STEPS)
+        assert m["train_anomalies_loss"] == 2
+        assert m["train_rollbacks"] == 1
+        assert "rollback" in spans
+        assert np.isfinite(m["loss"])
+        assert m["loss"] == pytest.approx(oracle["loss"], rel=1e-6, abs=0)
+
+    def test_isolated_anomaly_skipped_without_rollback(self, oracle):
+        """One bad step under the budget: update skipped, params stay
+        finite, training continues — no rollback, loss lands near (not
+        exactly on) the oracle since one update is missing."""
+        chaos = TrainerChaos(nan_at_step=5, nan_count=1)
+        tr = _trainer(chaos=chaos, skip_budget=3, steps=self.STEPS)
+        _, m = tr.fit(_lm_data(), num_steps=self.STEPS)
+        assert m["train_anomalies_loss"] == 1
+        assert m["train_rollbacks"] == 0
+        assert np.isfinite(m["loss"])
+        assert m["loss"] == pytest.approx(oracle["loss"], rel=0.05)
+
+    def test_exhausted_budgets_fail_loudly_with_history(self):
+        """No checkpointer and a streak past the skip budget: the fit
+        raises TrainingDivergedError carrying the anomaly history the
+        builtin runtime writes into outputs."""
+        from polyaxon_tpu.train.trainer import TrainingDivergedError
+
+        chaos = TrainerChaos(nan_at_step=4, nan_count=8)
+        tr = _trainer(chaos=chaos, skip_budget=2, steps=self.STEPS)
+        with pytest.raises(TrainingDivergedError) as exc:
+            tr.fit(_lm_data(), num_steps=self.STEPS)
+        err = exc.value
+        assert err.anomalies["loss"] >= 2
+        assert [h["step"] for h in err.history][:2] == [4, 5]
+
+
+# ---------------------------------------------------------------------------
+# rollback-targeted restore: explicit older step purges the newer ones
+# ---------------------------------------------------------------------------
+
+
+class TestExplicitRestorePurgesNewer:
+    def _ckpt(self, tmp_path):
+        from polyaxon_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer,
+        )
+
+        return Checkpointer(CheckpointConfig(
+            directory=str(tmp_path / "ck"), save_interval_steps=1,
+            max_to_keep=8, async_save=False))
+
+    @staticmethod
+    def _state(step):
+        import jax.numpy as jnp
+
+        return {"w": jnp.arange(8, dtype=jnp.float32) * step,
+                "step": jnp.asarray(step)}
+
+    def test_restore_older_step_quarantines_newer_and_frees_labels(
+            self, tmp_path):
+        """ISSUE 8 satellite (extends the PR-4 torn/quarantine units):
+        a rollback restores an OLDER complete step by explicit
+        ``step=`` — the newer steps (poisoned, but their bytes were
+        never proven bad) must be quarantined out of the way so the
+        post-rollback save at a re-used step number isn't silently
+        skipped by Orbax."""
+        ck = self._ckpt(tmp_path)
+        for s in (2, 4, 6):
+            assert ck.maybe_save(s, self._state(s), force=True)
+        ck.wait()
+        restored, step = ck.restore(self._state(0), step=2)
+        assert step == 2 and float(restored["w"][1]) == 2.0
+        assert ck.manager.all_steps() == [2] or list(
+            ck.manager.all_steps()) == [2]
+        for bad in (4, 6):
+            assert not os.path.isdir(ck._step_dir(bad))
+            # bytes were never proven torn -> preserved for hand recovery
+            assert os.path.isdir(
+                os.path.join(ck.directory, f"quarantine-{bad}"))
+        # the freed labels accept the replay's saves again
+        assert ck.maybe_save(4, self._state(4), force=True)
+        ck.wait()
+        assert ck.verify_step(4)
+
+    def test_restore_proven_torn_newer_step_is_deleted_outright(
+            self, tmp_path):
+        ck = self._ckpt(tmp_path)
+        for s in (2, 4):
+            assert ck.maybe_save(s, self._state(s), force=True)
+        ck.wait()
+        # tear step 4 so its manifest PROVES corruption
+        root = ck._step_dir(4)
+        largest, size = None, -1
+        for dirpath, _, names in os.walk(root):
+            for n in names:
+                p = os.path.join(dirpath, n)
+                if os.path.getsize(p) > size:
+                    largest, size = p, os.path.getsize(p)
+        with open(largest, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        _, step = ck.restore(self._state(0), step=2)
+        assert step == 2
+        assert not os.path.isdir(ck._step_dir(4))
+        assert not os.path.isdir(os.path.join(ck.directory, "quarantine-4"))
+
+
+# ---------------------------------------------------------------------------
+# heartbeat step: store semantics, delta accounting, API payload, tracking
+# ---------------------------------------------------------------------------
+
+
+class TestHeartbeatStep:
+    def _running(self, store, max_retries=None):
+        spec = {"kind": "operation",
+                "component": {"kind": "component",
+                              "run": {"kind": "job", "container": {
+                                  "command": [sys.executable, "-c", "pass"]}}}}
+        if max_retries is not None:
+            spec["termination"] = {"maxRetries": max_retries}
+        run = store.create_run("p", spec=spec, name="t")
+        store.transition(run["uuid"], "running", force=True)
+        return run["uuid"]
+
+    def test_step_at_freezes_while_step_repeats_and_moves_on_advance(self):
+        store = Store(":memory:")
+        uuid = self._running(store)
+        store.heartbeat(uuid, step=5)
+        first = store.get_run(uuid)["heartbeat_step_at"]
+        assert first is not None
+        time.sleep(0.01)
+        store.heartbeat(uuid, step=5)  # frozen step: the clock must hold
+        assert store.get_run(uuid)["heartbeat_step_at"] == first
+        store.heartbeat(uuid, step=6)  # progress: the clock moves
+        row = store.get_run(uuid)
+        assert row["heartbeat_step"] == 6
+        assert row["heartbeat_step_at"] != first
+        # bodiless beats renew liveness without touching progress
+        store.heartbeat(uuid)
+        row = store.get_run(uuid)
+        assert row["heartbeat_step"] == 6
+
+    def test_listing_stamps_step_and_step_age(self):
+        store = Store(":memory:")
+        uuid = self._running(store)
+        store.heartbeat(uuid, step=9)
+        time.sleep(0.02)
+        row = [r for r in store.list_runs(limit=10)
+               if r["uuid"] == uuid][0]
+        assert row["heartbeat_step"] == 9
+        assert row["heartbeat_age_s"] >= 0
+        assert row["heartbeat_step_age_s"] >= 0.01
+
+    def test_train_counter_delta_accounting_and_scrape(self):
+        store = Store(":memory:")
+        uuid = self._running(store)
+        store.heartbeat(uuid, step=1, anomalies={"loss": 2, "grad": 1},
+                        rollbacks=1, incarnation="a")
+        store.heartbeat(uuid, step=2, anomalies={"loss": 3, "grad": 1},
+                        rollbacks=1, incarnation="a")
+        # a stale relay of an OLD cumulative (the sidecar's progress.json
+        # bridge racing the pod's own beat) clamps to zero — it must not
+        # be misread as a restart and re-add already-counted anomalies
+        store.heartbeat(uuid, step=1, anomalies={"loss": 2, "grad": 1},
+                        rollbacks=1, incarnation="a")
+        # a RESTARTED attempt (new incarnation) starts a fresh watermark:
+        # its full count lands, nothing old is double-counted
+        store.heartbeat(uuid, step=0, anomalies={"loss": 1},
+                        incarnation="b")
+        fams = parse_prometheus(store.metrics.render())
+        anoms = fams["polyaxon_train_anomalies_total"]
+        assert anoms['polyaxon_train_anomalies_total{kind="loss"}'] == 4.0
+        assert anoms['polyaxon_train_anomalies_total{kind="grad"}'] == 1.0
+        assert fams["polyaxon_train_rollbacks_total"][
+            "polyaxon_train_rollbacks_total"] == 1.0
+        # pruned with the row: the watermark table is bounded by live runs
+        store.delete_run(uuid)
+        assert uuid not in store._train_seen
+
+    def test_heartbeat_step_replicates_to_standby(self):
+        from polyaxon_tpu.api.replication import ReplicatedStandby
+
+        primary = Store(":memory:")
+        standby = Store(":memory:")
+        uuid = self._running(primary)
+        primary.heartbeat(uuid, step=17)
+        repl = ReplicatedStandby(primary, standby, poll_interval=0.01)
+        repl.bootstrap()
+        repl.poll_once()
+        row = standby.get_run(uuid)
+        assert row["heartbeat_step"] == 17
+        assert row["heartbeat_step_at"] is not None
+
+    def test_post_heartbeat_payload_over_http(self, tmp_path):
+        from polyaxon_tpu.api.server import ApiServer
+        from polyaxon_tpu.client import RunClient
+
+        srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+        try:
+            uuid = self._running(srv.store)
+            client = RunClient(host=srv.url, project="p", run_uuid=uuid)
+            assert client.heartbeat()["ok"] is True  # bodyless stays legal
+            assert client.heartbeat(
+                step=23, anomalies={"loss": 1}, rollbacks=1)["ok"] is True
+            row = srv.store.get_run(uuid)
+            assert row["heartbeat_step"] == 23
+            assert srv.store.stats["train_anomalies_loss"] == 1
+            assert srv.store.stats["train_rollbacks"] == 1
+        finally:
+            srv.stop()
+
+    def test_tracking_report_progress_publishes_progress_json(self, tmp_path):
+        from polyaxon_tpu.tracking import Run
+
+        run = Run(run_uuid="r1", artifacts_path=str(tmp_path / "r1"))
+        assert run.client is None  # offline: file only, no crash
+        run.report_progress(41, anomalies={"loss": 2}, rollbacks=1)
+        import json
+
+        with open(os.path.join(run.run_dir, "progress.json")) as f:
+            prog = json.load(f)
+        assert prog["step"] == 41
+        assert prog["anomalies"] == {"loss": 2}
+        assert prog["rollbacks"] == 1
+        run.end()
+
+
+# ---------------------------------------------------------------------------
+# stall-aware reaper
+# ---------------------------------------------------------------------------
+
+
+def _unthrottle(reaper):
+    reaper._last_pass = float("-inf")
+
+
+class TestStallReaper:
+    def _running(self, store, max_retries=None, name="s"):
+        spec = {"kind": "operation",
+                "component": {"kind": "component",
+                              "run": {"kind": "job", "container": {
+                                  "command": [sys.executable, "-c", "pass"]}}}}
+        if max_retries is not None:
+            spec["termination"] = {"maxRetries": max_retries}
+        run = store.create_run("p", spec=spec, name=name)
+        store.transition(run["uuid"], "running", force=True)
+        return run["uuid"]
+
+    def test_fresh_heartbeats_frozen_step_reaped_as_stalled(self):
+        """The data-plane gap in one unit: the sidecar keeps the
+        heartbeat fresh forever while the pod's step never moves — the
+        two-stale-pass zombie rule can never fire, the stall rule
+        must."""
+        store = Store(":memory:")
+        uuid = self._running(store, max_retries=1)
+        reaper = ZombieReaper(store, owned=set, zombie_after=3600.0,
+                              stall_grace=0.05)
+        store.heartbeat(uuid, step=40)
+        assert reaper.pass_once() == []   # first observation arms the clock
+        time.sleep(0.08)
+        store.heartbeat(uuid, step=40)    # beat lands, step frozen
+        _unthrottle(reaper)
+        assert reaper.pass_once() == [(uuid, "stalled")]
+        run = store.get_run(uuid)
+        assert run["status"] == "queued"  # routed through retrying
+        conds = store.get_statuses(uuid)
+        assert any(c["reason"] == "StallReaped" for c in conds
+                   if c.get("reason"))
+        fams = parse_prometheus(reaper.metrics.render())
+        assert fams["polyaxon_run_stalled_reaps_total"][
+            "polyaxon_run_stalled_reaps_total"] == 1.0
+
+    def test_slow_but_progressing_run_is_never_reaped(self):
+        """A straggler advancing its step just inside stall_grace must
+        heal by WAITING: progress resets both clocks every pass."""
+        store = Store(":memory:")
+        uuid = self._running(store, max_retries=1)
+        reaper = ZombieReaper(store, owned=set, zombie_after=3600.0,
+                              stall_grace=0.08)
+        step = 10
+        for _ in range(5):
+            store.heartbeat(uuid, step=step)
+            _unthrottle(reaper)
+            assert reaper.pass_once() == []
+            time.sleep(0.05)  # inside stall_grace
+            step += 1         # ...and the step advances
+        assert store.get_run(uuid)["status"] == "running"
+
+    def test_no_step_reported_is_never_stall_judged(self):
+        store = Store(":memory:")
+        uuid = self._running(store, max_retries=1)
+        reaper = ZombieReaper(store, owned=set, zombie_after=3600.0,
+                              stall_grace=0.01)
+        for _ in range(3):
+            store.heartbeat(uuid)  # liveness only; no progress reporting
+            _unthrottle(reaper)
+            assert reaper.pass_once() == []
+            time.sleep(0.02)
+        assert store.get_run(uuid)["status"] == "running"
+
+    def test_live_driver_stall_tears_down_instead_of_transitioning(self):
+        """An OWNED wedged run: the reaper must not write transitions
+        under the component driving it — it kills the pod set and lets
+        the reconciler's slice-restart machinery retry."""
+        store = Store(":memory:")
+        uuid = self._running(store, max_retries=1)
+        torn = []
+        reaper = ZombieReaper(store, owned=lambda: {uuid},
+                              zombie_after=3600.0, stall_grace=0.05,
+                              teardown=torn.append)
+        store.heartbeat(uuid, step=40)
+        assert reaper.pass_once() == []
+        time.sleep(0.08)
+        _unthrottle(reaper)
+        assert reaper.pass_once() == [(uuid, "stalled")]
+        assert torn == [uuid]
+        # the run's lifecycle was left to the reconciler
+        assert store.get_run(uuid)["status"] == "running"
+        # one verdict per observed freeze: the clock re-arms
+        _unthrottle(reaper)
+        assert reaper.pass_once() == []
+
+    def test_owner_change_resets_the_stall_clock(self):
+        """Shard handoff mid-freeze (mirrors the PR-7 failover grace):
+        when meta.owner changes, the new observation window starts over
+        — an adopted run gets a full stall_grace before judgment."""
+        store = Store(":memory:")
+        uuid = self._running(store, max_retries=1)
+        reaper = ZombieReaper(store, owned=set, zombie_after=3600.0,
+                              stall_grace=0.06)
+        store.update_run(uuid, meta={"owner": {"holder": "agent-a"}})
+        store.heartbeat(uuid, step=40)
+        assert reaper.pass_once() == []
+        time.sleep(0.08)
+        # the takeover lands between passes
+        store.update_run(uuid, meta={"owner": {"holder": "agent-b"}})
+        store.heartbeat(uuid, step=40)
+        _unthrottle(reaper)
+        assert reaper.pass_once() == []  # clock reset, not a reap
+        assert store.get_run(uuid)["status"] == "running"
+        time.sleep(0.08)
+        store.heartbeat(uuid, step=40)
+        _unthrottle(reaper)
+        # same owner all along now: the freeze is real
+        assert reaper.pass_once() == [(uuid, "stalled")]
+
+    def test_epoch_failover_clears_stall_clocks(self):
+        store = Store(":memory:")
+        uuid = self._running(store, max_retries=1)
+        reaper = ZombieReaper(store, owned=set, zombie_after=3600.0,
+                              stall_grace=0.05, failover_grace=0.2)
+        store.heartbeat(uuid, step=40)
+        assert reaper.pass_once() == []
+        time.sleep(0.08)
+        store.heartbeat(uuid, step=40)
+        store.promote()  # failover: spooled progress beats are replaying
+        _unthrottle(reaper)
+        assert reaper.pass_once() == []  # grace, not a reap
+        assert store.get_run(uuid)["status"] == "running"
+
+    def test_stall_reap_exactly_once_across_sharded_fleet(self):
+        """ISSUE 8 acceptance: 4 agents' reapers over one store, the
+        frozen run's shard owned by exactly one — only that one may act,
+        and the shared counter family records exactly one reap."""
+        num_shards = 8
+        store = Store(":memory:")
+        reg = MetricsRegistry()
+        uuid = self._running(store, max_retries=1)
+        shard = shard_index(uuid, num_shards)
+        owners = [
+            # agent i owns shards {i, i+4}: one of the four owns `shard`
+            {i, i + 4} for i in range(4)
+        ]
+        reapers = [
+            ZombieReaper(store, owned=set, zombie_after=3600.0,
+                         stall_grace=0.05, metrics=reg,
+                         owns_run=(lambda u, o=owned_set:
+                                   shard_index(u, num_shards) in o))
+            for owned_set in owners
+        ]
+        store.heartbeat(uuid, step=40)
+        for r in reapers:
+            assert r.pass_once() == []
+        time.sleep(0.08)
+        store.heartbeat(uuid, step=40)
+        actions = []
+        for r in reapers:
+            _unthrottle(r)
+            actions += r.pass_once()
+        assert actions == [(uuid, "stalled")]
+        # a second sweep right after reaps nobody (the run moved on)
+        for r in reapers:
+            _unthrottle(r)
+            actions += r.pass_once()
+        assert len(actions) == 1
+        fams = parse_prometheus(reg.render())
+        assert fams["polyaxon_run_stalled_reaps_total"][
+            "polyaxon_run_stalled_reaps_total"] == 1.0
+        # sanity: the owning shard really was unique
+        assert sum(1 for o in owners if shard in o) == 1
+
+    def test_unsharded_race_counts_exactly_once_via_changed_guard(self):
+        """Two legacy unsharded reapers racing the same frozen run: the
+        store transition's ``changed`` result elects the winner — the
+        loser counts nothing."""
+        store = Store(":memory:")
+        reg = MetricsRegistry()
+        uuid = self._running(store, max_retries=1)
+        r1 = ZombieReaper(store, owned=set, zombie_after=3600.0,
+                          stall_grace=0.05, metrics=reg)
+        r2 = ZombieReaper(store, owned=set, zombie_after=3600.0,
+                          stall_grace=0.05, metrics=reg)
+        store.heartbeat(uuid, step=40)
+        assert r1.pass_once() == [] and r2.pass_once() == []
+        time.sleep(0.08)
+        store.heartbeat(uuid, step=40)
+        _unthrottle(r1)
+        _unthrottle(r2)
+        first = r1.pass_once()
+        second = r2.pass_once()
+        assert first == [(uuid, "stalled")]
+        assert second == []  # lost the race: run already left running
+        fams = parse_prometheus(reg.render())
+        assert fams["polyaxon_run_stalled_reaps_total"][
+            "polyaxon_run_stalled_reaps_total"] == 1.0
