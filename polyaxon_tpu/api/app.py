@@ -619,13 +619,17 @@ class ApiApp:
         # malformed progress fields degrade to a liveness-only beat — a
         # buggy client must never get its heartbeat 500'd (and then
         # zombie-reaped) over a field the beat doesn't even need
+        serve = body.get("serve")
+        if not isinstance(serve, dict):
+            serve = None  # malformed -> liveness-only, same as the rest
         ok = self.store.heartbeat(
             request.match_info["uuid"],
             step=_int(body.get("step")),
             anomalies=anomalies or None,
             rollbacks=_int(body.get("rollbacks")),
             incarnation=(str(body["incarnation"])
-                         if body.get("incarnation") else None))
+                         if body.get("incarnation") else None),
+            serve=serve)
         return _json({"ok": True}) if ok else _not_found()
 
     async def stop_run(self, request):
@@ -726,10 +730,13 @@ class ApiApp:
         declared = {int(svc["port"])}
         declared.update(int(p) for p in (svc.get("ports") or []))
         if port not in declared:
+            # 404, not 403: from the caller's view an undeclared port
+            # simply does not exist on this service — and the distinction
+            # leaks nothing about what IS listening on the agent host
             return _json(
                 {"error": f"port {port} is not a declared port of this "
                           f"service (declared: {sorted(declared)})"},
-                status=403)
+                status=404)
         ws = web.WebSocketResponse(max_msg_size=1 << 22)
         await ws.prepare(request)
         try:

@@ -290,13 +290,31 @@ class Store:
                       # accumulated by DELTA from the cumulative counts
                       # pods report in their heartbeats
                       "train_anomalies_loss": 0, "train_anomalies_grad": 0,
-                      "train_rollbacks": 0}
+                      "train_rollbacks": 0,
+                      # serving traffic counters (ISSUE 9): same
+                      # delta-from-cumulative contract, reported by serve
+                      # pods in their heartbeats' `serve` payload
+                      "serve_requests": 0, "serve_tokens": 0}
         # per-run (incarnation, last-seen cumulative train counters) for
         # delta accounting; in-memory like the counters themselves —
         # Prometheus counters are process-local by contract. Bounded by
         # live run rows: delete_run prunes its entry.
         self._train_seen: dict[str, tuple] = {}
         self._train_lock = threading.Lock()
+        # serving traffic (ISSUE 9): last-reported gauges + counter
+        # watermarks per (run, reporter incarnation) — each REPLICA of a
+        # service run is its own reporter, so gauges SUM across fresh
+        # incarnations and counters delta per incarnation (a replica
+        # restart resets its cumulatives without double-counting). Gauges
+        # age OUT of serve_traffic() after serve_fresh_s; the records
+        # themselves are pruned at 10x that horizon (counter watermarks
+        # must survive a beat gap) and on delete_run.
+        self._serve_seen: dict[str, dict] = {}
+        self.serve_fresh_s = 15.0
+        # per-scrape aggregate cache: the three serve gauges would
+        # otherwise each take _train_lock and walk every reporter record
+        # per /metrics render, contending with the heartbeat hot path
+        self._serve_scrape_cache: tuple = (float("-inf"), None)
         # store survivability (ISSUE 7): ``replicate`` keeps the
         # commit-ordered changelog every write appends to (a standby tails
         # it); ``_read_only`` is the demoted-standby write gate;
@@ -367,6 +385,46 @@ class Store:
             "Divergence rollbacks to the latest complete checkpoint",
             value_fn=(lambda p=peers: sum(
                 st.stats.get("train_rollbacks", 0) for st in p)))
+        # serving traffic families (ISSUE 9; docs/OBSERVABILITY.md): the
+        # control signal the agent's autoscaler consumes, exported from the
+        # same heartbeat-fed state `serve_traffic()` reads — one source of
+        # truth for the scrape and the scaler. Histograms observe the RAW
+        # TTFT / inter-token samples pods drain into their beats (not a
+        # lossy re-aggregation of pod-side percentiles).
+        self.metrics.counter(
+            "polyaxon_serve_requests_total",
+            "Generate requests completed by serve pods",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("serve_requests", 0) for st in p)))
+        self.metrics.counter(
+            "polyaxon_serve_generated_tokens_total",
+            "Tokens generated by serve pods",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("serve_tokens", 0) for st in p)))
+        self.metrics.gauge(
+            "polyaxon_serve_running_requests",
+            "In-flight generate requests holding a decode slot (fresh "
+            "reporters, all service runs)",
+            value_fn=(lambda p=peers: float(sum(
+                st._serve_traffic_for_scrape()["running"] for st in p))))
+        self.metrics.gauge(
+            "polyaxon_serve_waiting_requests",
+            "Generate requests queued for admission (fresh reporters)",
+            value_fn=(lambda p=peers: float(sum(
+                st._serve_traffic_for_scrape()["waiting"] for st in p))))
+        self.metrics.gauge(
+            "polyaxon_serve_kv_block_utilization",
+            "Reserved fraction of serve pods' KV cache blocks (fresh "
+            "reporters, pooled)",
+            value_fn=(lambda p=peers: max(
+                (st._serve_traffic_for_scrape()["kv_utilization"]
+                 for st in p), default=0.0)))
+        self._h_serve_ttft = self.metrics.histogram(
+            "polyaxon_serve_ttft_seconds",
+            "Request arrival to first generated token (serve pods)")
+        self._h_serve_itl = self.metrics.histogram(
+            "polyaxon_serve_intertoken_seconds",
+            "Interval between consecutive generated tokens (serve pods)")
         self.metrics.gauge(
             "polyaxon_store_epoch",
             "Store epoch (bumped by every standby promotion)",
@@ -1636,7 +1694,8 @@ class Store:
     def heartbeat(self, uuid: str, step: Optional[int] = None,
                   anomalies: Optional[dict] = None,
                   rollbacks: Optional[int] = None,
-                  incarnation: Optional[str] = None) -> bool:
+                  incarnation: Optional[str] = None,
+                  serve: Optional[dict] = None) -> bool:
         """Renew a run's liveness lease (zombie-reaper input). Cheap direct
         UPDATE — no listeners fire, no updated_at churn. Replicated (as a
         tiny heartbeat delta, not a whole row) so a promoted standby's
@@ -1666,6 +1725,8 @@ class Store:
                 if anomalies or rollbacks:
                     self._train_account(uuid, anomalies, rollbacks,
                                         incarnation)
+                if serve is not None:
+                    self._serve_account(uuid, serve, incarnation)
                 self._log_change(conn, "heartbeat", payload)
         return cur.rowcount > 0
 
@@ -1702,10 +1763,114 @@ class Store:
             self.stats["train_rollbacks"] += delta("rollbacks", rollbacks)
             self._train_seen[uuid] = (incarnation or seen_inc, last)
 
+    def _serve_account(self, uuid: str, serve: dict,
+                       incarnation: Optional[str]) -> None:
+        """Serve-pod heartbeat payload -> traffic state + counters.
+
+        Gauges (running/waiting/kv) are last-write-per-REPORTER: each
+        replica is one reporter (keyed by tracking incarnation), and
+        ``serve_traffic`` sums across reporters still fresh within
+        ``serve_fresh_s`` — a dead replica ages out instead of pinning the
+        scaler's signal. Cumulative counters delta with the same
+        incarnation-keyed max-clamp as the train counters. Raw TTFT /
+        inter-token observation lists (drained by the pod since its last
+        beat) feed the store histograms directly, bounded per beat."""
+        if not isinstance(serve, dict):
+            return
+        key = str(incarnation or serve.get("incarnation") or "-")
+
+        def _num(v, default=0):
+            try:
+                return max(int(v), 0)
+            except (TypeError, ValueError):
+                return default
+
+        with self._train_lock:
+            per_run = self._serve_seen.setdefault(uuid, {})
+            rec = per_run.setdefault(key, {"counters": {}})
+            rec["at"] = time.time()
+            # prune sibling reporters stale past a generous multiple of
+            # the freshness window: replica-restart churn mints a new
+            # incarnation per process, and the records would otherwise
+            # grow without bound until delete_run. The trade: a reporter
+            # silent past the horizon that RETURNS re-adds its full
+            # cumulative count — the outage spool replays beats well
+            # inside it.
+            horizon = rec["at"] - 10 * self.serve_fresh_s
+            for stale in [k for k, r in per_run.items()
+                          if k != key and r.get("at", 0) < horizon]:
+                per_run.pop(stale)
+            rec["running"] = _num(serve.get("running"))
+            rec["waiting"] = _num(serve.get("waiting"))
+            rec["kv_used"] = _num(serve.get("kv_blocks_used"))
+            rec["kv_total"] = _num(serve.get("kv_blocks_total"))
+            last = rec["counters"]
+
+            def delta(key_: str, new) -> int:
+                if new is None:
+                    return 0
+                new = _num(new)
+                old = int(last.get(key_, 0))
+                last[key_] = max(new, old)
+                return max(new - old, 0)
+
+            self.stats["serve_requests"] += delta(
+                "requests", serve.get("requests_total"))
+            self.stats["serve_tokens"] += delta(
+                "tokens", serve.get("tokens_total"))
+        for field_, hist in (("ttft", self._h_serve_ttft),
+                             ("itl", self._h_serve_itl)):
+            obs = serve.get(field_)
+            if isinstance(obs, (list, tuple)):
+                for v in obs[:512]:
+                    try:
+                        hist.observe(float(v))
+                    except (TypeError, ValueError):
+                        pass
+
+    def _serve_traffic_for_scrape(self) -> dict:
+        """One aggregate snapshot per scrape window (1s TTL): the three
+        gauge callbacks share it instead of walking the reporter records
+        three times per /metrics render. The autoscaler keeps calling
+        :meth:`serve_traffic` directly (always fresh)."""
+        now = time.monotonic()
+        ts, snap = self._serve_scrape_cache
+        if snap is None or now - ts > 1.0:
+            snap = self.serve_traffic()
+            self._serve_scrape_cache = (now, snap)
+        return snap
+
+    def serve_traffic(self, uuid: Optional[str] = None) -> dict:
+        """Aggregated live traffic across fresh reporters — the agent's
+        autoscale input and the gauge families' source. ``uuid`` scopes to
+        one service run; None aggregates every run."""
+        now = time.time()
+        running = waiting = kv_used = kv_total = reporters = 0
+        with self._train_lock:
+            runs = ([uuid] if uuid is not None
+                    else list(self._serve_seen))
+            for u in runs:
+                per_run = self._serve_seen.get(u) or {}
+                for key, rec in list(per_run.items()):
+                    if now - rec.get("at", 0) > self.serve_fresh_s:
+                        # counters watermark must survive a beat gap; only
+                        # the GAUGE contribution ages out
+                        continue
+                    reporters += 1
+                    running += rec.get("running", 0)
+                    waiting += rec.get("waiting", 0)
+                    kv_used += rec.get("kv_used", 0)
+                    kv_total += rec.get("kv_total", 0)
+        return {"running": running, "waiting": waiting,
+                "reporters": reporters, "kv_used": kv_used,
+                "kv_total": kv_total,
+                "kv_utilization": (kv_used / kv_total if kv_total else 0.0)}
+
     def delete_run(self, uuid: str) -> bool:
         self._check_writable()
         with self._train_lock:  # vs a racing heartbeat's re-insert
             self._train_seen.pop(uuid, None)  # watermark dies with the row
+            self._serve_seen.pop(uuid, None)
         with self._conn_ctx() as conn:
             cur = conn.execute("DELETE FROM runs WHERE uuid=?", (uuid,))
             conn.execute("DELETE FROM status_conditions WHERE run_uuid=?", (uuid,))

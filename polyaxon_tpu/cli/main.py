@@ -885,6 +885,40 @@ def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_tok
         srv.stop()
 
 
+@cli.command()
+@click.option("--model", "-m", default="llama-tiny",
+              help="model zoo name (causal LM families only)")
+@click.option("--checkpoint", default=None,
+              help="checkpoint dir (a run's outputs/checkpoints); "
+                   "restored read-only. Absent: random init")
+@click.option("--port", default=8000, type=int)
+@click.option("--bind", default="127.0.0.1")
+@click.option("--max-slots", default=8, type=int,
+              help="continuous-batching decode slots")
+@click.option("--block-size", default=16, type=int,
+              help="KV cache block size (tokens)")
+@click.option("--max-seq-len", default=None, type=int)
+@click.option("--prefill-chunk", default=64, type=int)
+@click.option("--platform", default=None,
+              help="force a jax platform (e.g. cpu)")
+def serve(model, checkpoint, port, bind, max_slots, block_size,
+          max_seq_len, prefill_chunk, platform):
+    """Run the online inference runtime locally (dev loop for the
+    `kind: service` runtime — same engine, no control plane)."""
+    from ..serve.runtime import run_serve
+
+    spec = {"model": model, "port": port, "bind": bind,
+            "max_slots": max_slots, "block_size": block_size,
+            "prefill_chunk": prefill_chunk}
+    if checkpoint:
+        spec["checkpoint"] = checkpoint
+    if max_seq_len:
+        spec["max_seq_len"] = max_seq_len
+    if platform:
+        spec["platform"] = platform
+    run_serve(spec)
+
+
 def main():
     cli()
 

@@ -301,7 +301,8 @@ class RunClient(BaseClient):
                   step: Optional[int] = None,
                   anomalies: Optional[dict] = None,
                   rollbacks: Optional[int] = None,
-                  incarnation: Optional[str] = None) -> dict:
+                  incarnation: Optional[str] = None,
+                  serve: Optional[dict] = None) -> dict:
         """Renew the run's liveness lease (see docs/RESILIENCE.md): an
         executor that stops heartbeating gets zombie-reaped by the agent.
         ``step`` reports training progress (ISSUE 8) — an executor whose
@@ -315,6 +316,8 @@ class RunClient(BaseClient):
             body["rollbacks"] = int(rollbacks)
         if incarnation:
             body["incarnation"] = str(incarnation)
+        if serve is not None:
+            body["serve"] = serve
         return self._json("POST", self._rpath("/heartbeat", uuid=uuid),
                           json=body or None)
 

@@ -43,6 +43,7 @@ class LocalPayload:
     artifacts_path: str = ""
     init: list[dict] = field(default_factory=list)
     builtin: Optional[dict] = None  # `runtime:` shortcut -> in-proc Trainer
+    serve: Optional[dict] = None    # service `runtime:` -> inference engine
     max_retries: int = 0
     timeout: Optional[float] = None
 
@@ -88,6 +89,49 @@ def _apply_builtin_to_pod(cm: dict, builtin: Optional[dict], ctx: dict) -> None:
             cm["workingDir"] = ctx["globals"]["run_artifacts_path"]
 
 
+def _apply_serve_to_pod(cm: dict, serve: Optional[dict], ctx: dict) -> None:
+    """Make a rendered service pod run the built-in inference runtime
+    (serve/runtime.py): spec env + default command. One definition for the
+    local and K8s paths."""
+    if serve is None:
+        return
+    cm["env"] = (cm.get("env") or []) + [
+        {"name": "PLX_SERVE_SPEC", "value": json.dumps(serve)},
+    ]
+    if not cm.get("command"):
+        cm["command"] = ["python", "-m", "polyaxon_tpu.serve.runtime"]
+        if not cm.get("workingDir"):
+            cm["workingDir"] = ctx["globals"]["run_artifacts_path"]
+
+
+def _render_serve(run: Any, ctx: dict) -> Optional[dict]:
+    """Render a `kind: service` run's serving-runtime spec."""
+    runtime = getattr(run, "runtime", None)
+    if not runtime:
+        return None
+    return dict(render_value(runtime, ctx))
+
+
+def service_replica_floor(autoscale: Optional[dict],
+                          replicas: Optional[int]) -> int:
+    """ONE definition of a service's initial replica count — the
+    autoscaler's floor when autoscale is on, else the declared replicas —
+    shared by pod rendering here and chip reservation in the agent (two
+    copies would let the budget desynchronize from the rendered set)."""
+    auto = autoscale or {}
+    if auto:
+        return max(int(auto.get("min_replicas", 1) or 1), 1)
+    return max(int(replicas or 1), 1)
+
+
+def service_replica_count(run: Any, override: Optional[int] = None) -> int:
+    """Initial (or overridden) replica count for a service run object."""
+    if override is not None:
+        return max(int(override), 1)
+    return service_replica_floor(getattr(run, "autoscale", None),
+                                 getattr(run, "replicas", None))
+
+
 def _render_builtin(run: Any, ctx: dict) -> Optional[dict]:
     """Render the `runtime:` builtin-trainer spec (shared by the local and
     K8s paths so they can never diverge). Available on tpujob/jaxjob and all
@@ -116,6 +160,10 @@ def to_local_payload(
     for i in getattr(run, "init", None) or []:
         init_steps.append(render_value(i.to_dict(), ctx))
     builtin = _render_builtin(run, ctx)
+    serve = None
+    if compiled.get_run_kind() == V1RunKind.SERVICE:
+        serve = _render_serve(run, ctx)
+        builtin = None  # a service's runtime dict is a SERVE spec
     term = compiled.termination
     return LocalPayload(
         run_uuid=run_uuid,
@@ -126,6 +174,7 @@ def to_local_payload(
         artifacts_path=ctx["globals"]["run_artifacts_path"],
         init=init_steps,
         builtin=builtin,
+        serve=serve,
         max_retries=(term.max_retries if term and term.max_retries else 0),
         timeout=(term.timeout if term and term.timeout else None),
     )
@@ -173,13 +222,16 @@ def to_k8s_resources(
     ctx: dict,
     run_uuid: str,
     project: str,
+    service_replicas: Optional[int] = None,
 ) -> list[dict]:
     """Render the pod manifests for this run.
 
     tpujob/jaxjob -> one pod per TPU host of the slice with rendezvous env;
-    job/service -> a single pod; Kubeflow-style kinds -> one pod per replica
-    with the same rendezvous env (their collectives ride ICI when placed on
-    TPU, so replicas are just processes of one SPMD program).
+    job -> a single pod; service -> ``replicas`` pods behind one Service
+    (``service_replicas`` overrides — the agent's autoscaler re-renders at
+    its current target, ISSUE 9); Kubeflow-style kinds -> one pod per
+    replica with the same rendezvous env (their collectives ride ICI when
+    placed on TPU, so replicas are just processes of one SPMD program).
     """
     kind = compiled.get_run_kind()
     run = compiled.run
@@ -360,18 +412,40 @@ def to_k8s_resources(
         return [headless] + pods
 
     if kind == V1RunKind.SERVICE:
-        cm = _container_manifest(run.container, ctx, base_env)
-        p = pod(f"plx-{run_uuid[:12]}", cm)
+        serve = _render_serve(run, ctx)
+        replicas = service_replica_count(run, service_replicas)
+        ports = run.ports or ([serve.get("port", 8000)] if serve else [80])
         svc = {
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {"name": f"plx-{run_uuid[:12]}", "labels": dict(labels)},
             "spec": {
                 "selector": {"app.polyaxon.com/run": run_uuid},
-                "ports": [{"port": p_} for p_ in (run.ports or [80])],
+                "ports": [{"port": int(p_)} for p_ in ports],
             },
         }
-        return [p, svc]
+        if serve is None and replicas == 1 and not getattr(
+                run, "autoscale", None):
+            # legacy single-pod service (tensorboard-style user container):
+            # keep the historical pod name. Autoscaled services ALWAYS use
+            # replica-indexed names, even at 1 — otherwise every scale
+            # transition through 1 would switch naming schemes and churn
+            # (or briefly zero out) the live pod set
+            cm = _container_manifest(run.container, ctx, base_env)
+            return [pod(f"plx-{run_uuid[:12]}", cm), svc]
+        pods = []
+        for r in range(replicas):
+            env = dict(base_env)
+            env["PLX_REPLICA_ROLE"] = "serve"
+            env["PLX_REPLICA_INDEX"] = str(r)
+            cm = _container_manifest(run.container, ctx, env)
+            _apply_serve_to_pod(cm, serve, ctx)
+            # stable, replica-indexed names: the autoscaler diffs desired
+            # vs live pod sets BY NAME, so scale-up applies exactly the
+            # missing replicas and a successor's re-render at the stored
+            # target matches the live set (zero duplicate applies)
+            pods.append(pod(f"plx-{run_uuid[:12]}-r{r}", cm))
+        return pods + [svc]
 
     cm = _container_manifest(getattr(run, "container", None), ctx, base_env)
     return [pod(f"plx-{run_uuid[:12]}", cm)]

@@ -20,8 +20,9 @@ class ResolvedRun:
     context: dict[str, Any]
     payload: LocalPayload
 
-    def k8s_resources(self) -> list[dict]:
-        return to_k8s_resources(self.compiled, self.context, self.run_uuid, self.project)
+    def k8s_resources(self, service_replicas: "int | None" = None) -> list[dict]:
+        return to_k8s_resources(self.compiled, self.context, self.run_uuid,
+                                self.project, service_replicas=service_replicas)
 
 
 def compile_operation(

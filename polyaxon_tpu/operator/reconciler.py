@@ -164,6 +164,46 @@ class OperationReconciler:
             self._ops[op.run_uuid] = state
         return True
 
+    def scale(self, run_uuid: str, resources: list[dict]) -> tuple[int, int]:
+        """Converge a tracked operation's pod set onto ``resources``
+        (service replica autoscale, ISSUE 9): diff DESIRED pod names
+        against the LIVE set — apply the missing, delete the surplus —
+        and swap the op's resources so restarts re-apply the new target.
+
+        Diffing against live pods (not the previously-recorded resources)
+        makes the verb self-healing: surplus pods left by a crash mid-
+        scale-down are deleted by the next scale call, and a pod name
+        already live is never re-applied (zero duplicate launches — a
+        duplicate apply would 409 like a real apiserver). Returns
+        (applied, deleted)."""
+        with self._lock:
+            state = self._ops.get(run_uuid)
+        if state is None:
+            raise KeyError(f"operation {run_uuid} is not tracked")
+        if state.final_status is not None:
+            return (0, 0)
+        # serialize with reconcile passes: an observe between our deletes
+        # and applies must not misread the half-converged set
+        with self._reconcile_lock:
+            desired = {m["metadata"]["name"]: m for m in resources
+                       if m.get("kind") == "Pod"}
+            live = {}
+            for s in self._c(self.cluster.pod_statuses,
+                             state.op.label_selector):
+                live[s.name] = s
+            applied = deleted = 0
+            for name, st in live.items():
+                if name not in desired and not st.terminating:
+                    self._c(self.cluster.delete, "Pod", name)
+                    deleted += 1
+            for name, manifest in desired.items():
+                if name in live:
+                    continue  # already live (or Terminating: next pass)
+                self._c(self.cluster.apply, manifest)
+                applied += 1
+            state.op.resources = resources
+        return applied, deleted
+
     def delete(self, run_uuid: str) -> None:
         """Stop tracking and tear down resources (stop / user delete)."""
         with self._lock:

@@ -5,6 +5,7 @@ the reference orchestrates but never owns these)."""
 from .attention import attention, dense_attention, repeat_kv
 from .flash_attention import flash_attention_bhsd
 from .gating import gated
+from .paged_attention import dense_decode_attention, paged_attention
 from .layers import apply_rope, gelu, layer_norm, rms_norm, rope_frequencies, swiglu
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
@@ -15,6 +16,8 @@ __all__ = [
     "dense_attention",
     "repeat_kv",
     "flash_attention_bhsd",
+    "paged_attention",
+    "dense_decode_attention",
     "ring_attention",
     "ulysses_attention",
     "apply_rope",

@@ -1,0 +1,216 @@
+"""Paged (blocked-KV) decode attention over a block pool (ISSUE 9).
+
+Serving traffic is decode-dominated: one query token per sequence against a
+ragged, growing KV history. Storing each sequence's history contiguously
+wastes HBM on max-length padding and forces O(max_seq) copies on admission;
+the vLLM answer is a **paged** cache — the pool is a flat array of fixed-size
+blocks, each sequence owns an ordered *block table* of pool indices, and
+attention walks the table instead of a contiguous axis.
+
+Two implementations behind one signature:
+
+- ``impl="gather"`` — XLA gathers the table's blocks into the contiguous
+  layout and runs exactly the same masked dense math as
+  :func:`dense_decode_attention`. This is the parity-bearing path: given
+  identical cached values it is **bit-exact** with the dense decode oracle
+  by construction (the gather feeds the oracle itself), which is what the
+  tier-1 parity suite pins (eviction garbage in freed blocks included — the
+  length mask runs before the softmax max, so stale bytes never reach a
+  live lane).
+- ``impl="flash"`` — a pallas kernel in the flash-attention mold
+  (ops/flash_attention.py): ``PrefetchScalarGridSpec`` with the block table
+  and sequence lengths as scalar-prefetch operands, so the **index map
+  itself** resolves pool blocks — and clamps steps past a sequence's last
+  live block to the last live block, which makes Pallas's pipeline emitter
+  elide their HBM→VMEM DMA exactly like the causal dead-block skip in the
+  training kernels. A ragged batch pays HBM bandwidth for the tokens it
+  actually holds, not for ``max_blocks_per_seq``; compute for dead steps is
+  skipped with ``pl.when``. Online-softmax accumulation order differs from
+  the dense oracle, so this path is allclose-level, not bit-exact — the
+  kernel parity test pins the tolerance.
+
+Shapes (G = query heads per KV head, GQA):
+    q           [B, KVH, G, D]    one decode token per sequence
+    k/v pool    [N, bs, KVH, D]   the shared block pool
+    block_tables[B, T] int32      pool indices, row-padded with 0
+    lengths     [B]   int32       live tokens per sequence (0 = idle slot)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import DEFAULT_MASK_VALUE
+
+
+def dense_decode_attention(
+    q: jax.Array,            # [B, KVH, G, D]
+    k_cache: jax.Array,      # [B, C, KVH, D]
+    v_cache: jax.Array,      # [B, C, KVH, D]
+    lengths: jax.Array,      # [B] int32
+    *,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Decode attention over a *contiguous* per-sequence cache — the
+    numerics oracle the paged gather path feeds. f32 math regardless of
+    storage dtype; fully-masked rows (length 0) come back as zeros."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bhgd,bchd->bhgc", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) * sm_scale
+    k_ids = jnp.arange(k_cache.shape[1])
+    mask = k_ids[None, :] < lengths[:, None]              # [B, C]
+    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)       # idle slots
+    return jnp.einsum(
+        "bhgc,bchd->bhgd", probs, v_cache.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
+def gather_blocks(pool: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """[N, bs, KVH, D] pool + [B, T] tables -> [B, T*bs, KVH, D]."""
+    b, t = block_tables.shape
+    _, bs, kvh, d = pool.shape
+    return pool[block_tables].reshape(b, t * bs, kvh, d)
+
+
+# ---------------------------------------------------------------------------
+# Flash path: block-table-driven index maps, dead-block DMA skip
+# ---------------------------------------------------------------------------
+
+
+def _pool_clamp(b, s, tbl_ref, len_ref, *, block_size, max_blocks):
+    """Pool index for grid step ``s`` of sequence ``b``: the table entry,
+    with steps past the sequence's last live block clamped TO the last
+    live block — consecutive grid steps then map to the same pool block
+    and Pallas elides their copy (the flash-attention causal-clamp trick,
+    keyed on the table instead of the diagonal)."""
+    last = jnp.clip((len_ref[b] - 1) // block_size, 0, max_blocks - 1)
+    return tbl_ref[b * max_blocks + jnp.minimum(s, last)]
+
+
+def _decode_kernel(
+    tbl_ref, len_ref,        # scalar prefetch
+    q_ref, k_ref, v_ref,     # VMEM blocks
+    o_ref,                   # output
+    acc_ref, m_ref, l_ref,   # VMEM scratch, persists across pool steps
+    *, sm_scale: float, block_size: int, num_steps: int,
+):
+    b = pl.program_id(0)
+    s = pl.program_id(2)
+
+    @pl.when(s == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+    run = s * block_size < length
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]                       # [G, D]
+        k = k_ref[0, :, 0, :]                 # [bs, D]
+        v = v_ref[0, :, 0, :]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                          # [G, bs]
+        k_ids = s * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (block_size,), 0)
+        live = k_ids < length
+        scores = jnp.where(live[None, :], scores, DEFAULT_MASK_VALUE)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        safe_m = jnp.where(m_new == -jnp.inf, 0.0, m_new)
+        alpha = jnp.where(m_prev == -jnp.inf, 0.0, jnp.exp(m_prev - safe_m))
+        p = jnp.exp(scores - safe_m)
+        p = jnp.where(live[None, :], p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(s == num_steps - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_flash(q, k_pool, v_pool, block_tables, lengths, *, sm_scale,
+                 interpret):
+    b, kvh, g, d = q.shape
+    n, bs, pool_kvh, _ = k_pool.shape
+    assert pool_kvh == kvh, (pool_kvh, kvh)
+    t = block_tables.shape[1]
+
+    tbl = block_tables.astype(jnp.int32).reshape(b * t)
+    ln = lengths.astype(jnp.int32)
+    clamp = functools.partial(_pool_clamp, block_size=bs, max_blocks=t)
+    q_map = lambda b_, h, s, tbl_, ln_: (b_, h, 0, 0)
+    kv_map = lambda b_, h, s, tbl_, ln_: (clamp(b_, s, tbl_, ln_), 0, h, 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kvh, t),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), q_map),
+            pl.BlockSpec((1, bs, 1, d), kv_map),
+            pl.BlockSpec((1, bs, 1, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+            pltpu.VMEM((g, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=sm_scale, block_size=bs, num_steps=t)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        interpret=interpret,
+    )(tbl, ln, q, k_pool, v_pool)
+
+
+def paged_attention(
+    q: jax.Array,             # [B, KVH, G, D]
+    k_pool: jax.Array,        # [N, bs, KVH, D]
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [B, T] int32
+    lengths: jax.Array,       # [B] int32
+    *,
+    sm_scale: Optional[float] = None,
+    impl: str = "gather",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Decode attention over a paged KV pool. Returns [B, KVH, G, D]."""
+    if sm_scale is None:
+        sm_scale = float(q.shape[-1] ** -0.5)
+    if impl == "gather":
+        k = gather_blocks(k_pool, block_tables)
+        v = gather_blocks(v_pool, block_tables)
+        return dense_decode_attention(q, k, v, lengths, sm_scale=sm_scale)
+    if impl == "flash":
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        return _paged_flash(q, k_pool, v_pool, block_tables, lengths,
+                            sm_scale=float(sm_scale), interpret=interpret)
+    raise ValueError(f"unknown paged attention impl {impl!r}; "
+                     f"valid: gather|flash")

@@ -281,6 +281,10 @@ class FaultyStore:
         # standby's applied-seq watermark or a double promotion
         "get_changelog", "apply_changelog", "snapshot", "promote",
         "changelog_span",
+        # serve-traffic read (ISSUE 9): the autoscaler polls it every
+        # pass — a SQLITE-weather blip must cost one scale decision,
+        # never the agent loop
+        "serve_traffic",
     )
 
     def __init__(self, inner: Any, seed: int = 0, fault_rate: float = 0.2,

@@ -153,6 +153,8 @@ class LocalExecutor:
         self.on_status(uuid, status, msg)
 
     def _run_main(self, payload: LocalPayload, execution: LocalExecution, log: LogWriter) -> int:
+        if payload.serve is not None:
+            return self._run_serve(payload, execution, log)
         if payload.builtin is not None:
             return self._run_builtin(payload, execution, log)
         if not payload.argv:
@@ -163,6 +165,18 @@ class LocalExecutor:
         if not os.path.isdir(workdir):
             workdir = payload.artifacts_path
         return self._spawn_and_pump(payload, execution, log, payload.argv, env, workdir)
+
+    def _run_serve(self, payload: LocalPayload, execution: LocalExecution, log: LogWriter) -> int:
+        """Service `runtime:` shortcut — the built-in inference engine
+        (serve/runtime.py) in a subprocess, same isolation contract as the
+        trainer."""
+        import json
+
+        env = _with_pythonpath({**pod_base_env(), **payload.env})
+        env["PLX_SERVE_SPEC"] = json.dumps(dict(payload.serve or {}))
+        env.setdefault("PLX_REPLICA_INDEX", "0")
+        argv = [sys.executable, "-m", "polyaxon_tpu.serve.runtime"]
+        return self._spawn_and_pump(payload, execution, log, argv, env, payload.artifacts_path)
 
     def _run_builtin(self, payload: LocalPayload, execution: LocalExecution, log: LogWriter) -> int:
         """`runtime:` shortcut — run the built-in trainer in a subprocess so

@@ -327,6 +327,32 @@ class LocalAgent:
                     value_fn=lambda: len(self.cluster.injected))
         elif backend != "local":
             raise ValueError(f"unknown agent backend {backend!r}")
+        # -- service autoscale (ISSUE 9) -----------------------------------
+        # The first consumer of the obs layer as a CONTROL signal: every
+        # ``autoscale_interval`` the agent reads each owned service run's
+        # heartbeat-fed traffic aggregate (Store.serve_traffic — the same
+        # state behind the polyaxon_serve_* gauges) and converges the
+        # replica count onto demand/target_per_replica, clamped to
+        # [min_replicas, max_replicas] AND the free chip budget. Scale-up
+        # is immediate (queued users are waiting); scale-down waits for
+        # ``scale_down_after_s`` of sustained low traffic (hysteresis).
+        # Every resize commits the new target to run meta (fenced) before
+        # touching the cluster and rides the launch-intent machinery, so
+        # a mid-scale agent kill converges with zero duplicate launches.
+        self.autoscale_interval = 1.0
+        self._autoscale_last = 0.0
+        # uuid -> {auto, resolved, replicas, low_since} (invalidated on
+        # untrack/handoff; rebuilt lazily from the store)
+        self._svc_scale: dict[str, dict] = {}
+        self.metrics.gauge(
+            "polyaxon_serve_target_replicas",
+            "Summed autoscale replica target across owned service runs",
+            value_fn=lambda: float(sum(
+                i.get("replicas", 0) for i in self._svc_scale.values()
+                if i.get("auto") is not None)))
+        self._c_scale_events = self.metrics.counter(
+            "polyaxon_autoscale_events_total",
+            "Service replica resizes applied by the autoscaler")
         self._active: dict[str, LocalExecution] = {}
         self._chips_in_use: dict[str, int] = {}
         self._tuners: dict[str, threading.Thread] = {}
@@ -1189,7 +1215,8 @@ class LocalAgent:
                                    {"app.polyaxon.com/run": uuid})
                 self.store.record_launch_intent(
                     uuid, self._lease_id, token, lease_name=intent_lease)
-                self.reconciler.apply(self._operation_cr(uuid, resolved))
+                self.reconciler.apply(self._operation_cr(
+                    uuid, resolved, run.get("meta")))
                 self.store.mark_launched(uuid)
                 return True
             if pods:
@@ -1207,7 +1234,7 @@ class LocalAgent:
                     1 for c in self.store.get_statuses(uuid)
                     if c.get("type") == V1Statuses.RETRYING.value)
                 self.reconciler.adopt(
-                    self._operation_cr(uuid, resolved),
+                    self._operation_cr(uuid, resolved, run.get("meta")),
                     elapsed_s=elapsed, retries_done=retries)
                 self.store.adopt_launch(uuid, self._lease_id, token)
                 return True
@@ -1286,6 +1313,176 @@ class LocalAgent:
                     sc.start()
             for uuid in [u for u, s in self._sidecars.items() if not s.is_alive()]:
                 del self._sidecars[uuid]
+
+    # -- service autoscale (ISSUE 9) ----------------------------------------
+
+    def _autoscale_pass(self) -> None:
+        """Rate-limited traffic->replica control loop over owned service
+        runs (see __init__ for the policy). Runs inside the scheduling
+        pass's StaleLeaseError envelope: a fenced-out write demotes the
+        shard like any other, never kills the loop thread."""
+        if self.reconciler is None:
+            return
+        now = time.monotonic()
+        if now - self._autoscale_last < self.autoscale_interval:
+            return
+        self._autoscale_last = now
+        for uuid in list(self.reconciler.tracked_uuids()):
+            if not self._owns_run(uuid):
+                self._svc_scale.pop(uuid, None)  # handed off: new owner scales
+                continue
+            try:
+                self._autoscale_run(uuid, now)
+            except StaleLeaseError:
+                raise
+            except Exception:
+                traceback.print_exc()
+        for uuid in list(self._svc_scale):
+            if not self.reconciler.is_tracked(uuid):
+                self._svc_scale.pop(uuid, None)
+
+    def _autoscale_run(self, uuid: str, now: float) -> None:
+        info = self._svc_scale.get(uuid)
+        if info is None:
+            info = self._autoscale_register(uuid)
+            if info is None:
+                return
+        if info.get("auto") is None:
+            return  # not an autoscaled service; cached negative
+        traffic = self.store.serve_traffic(uuid)
+        demand = traffic["running"] + traffic["waiting"]
+        auto = info["auto"]
+        min_r = max(int(auto.get("min_replicas", 1) or 1), 1)
+        max_r = max(int(auto.get("max_replicas", min_r) or min_r), min_r)
+        desired = -(-demand // info["per"]) if demand > 0 else min_r
+        desired = max(min_r, min(max_r, desired))
+        cur = int(info["replicas"])
+        if desired > cur:
+            info["low_since"] = None
+            if self.capacity_chips is not None:
+                # chip-budget-aware: never reserve past the free pool
+                # (each replica costs one chip)
+                free = self._free_capacity()
+                desired = min(desired, cur + max(free, 0))
+            if desired > cur:
+                self._scale_service(uuid, info, desired)
+        elif desired < cur:
+            # hysteresis: a traffic dip must be SUSTAINED before replicas
+            # drain (flapping burns launch churn, not chips)
+            delay = float(auto.get("scale_down_after_s", 10.0))
+            if info.get("low_since") is None:
+                info["low_since"] = now
+            elif now - info["low_since"] >= delay:
+                info["low_since"] = None
+                self._scale_service(uuid, info, desired)
+        else:
+            info["low_since"] = None
+
+    def _autoscale_register(self, uuid: str) -> Optional[dict]:
+        """Lazily classify a tracked run for autoscale (cached)."""
+        run = self.store.get_run(uuid)
+        if run is None:
+            return None
+        spec = run["compiled"] or run.get("spec") or {}
+        r = ((spec.get("component") or {}).get("run")
+             or spec.get("run") or {})
+        if r.get("kind") != "service" or not r.get("autoscale"):
+            info = {"auto": None}
+            self._svc_scale[uuid] = info
+            return info
+        try:
+            resolved = resolve(
+                run["compiled"] or run.get("spec") or {}, run_uuid=uuid,
+                project=run["project"],
+                artifacts_path=run_artifacts_dir(
+                    self.artifacts_root, run["project"], uuid),
+                api_host=self.api_host, api_token=self.api_token,
+                connections=self.connections,
+            )
+        except Exception:
+            traceback.print_exc()
+            return None
+        from ..compiler.converter import service_replica_count
+
+        auto = dict(r["autoscale"])
+        stored = ((run.get("meta") or {}).get("autoscale") or {})
+        cur = stored.get("replicas")
+        if cur is None:
+            cur = service_replica_count(resolved.compiled.run)
+        per = auto.get("target_per_replica")
+        if per is None:
+            # match the engine's ACTUAL default decode width (serve/
+            # runtime.py build_engine max_slots=8) — a lower fallback
+            # would systematically over-provision replicas
+            per = (r.get("runtime") or {}).get("max_slots", 8)
+        info = {"auto": auto, "resolved": resolved,
+                "replicas": int(cur), "per": max(int(per or 1), 1),
+                "low_since": None}
+        self._svc_scale[uuid] = info
+        # a successor adopting a SCALED service must reserve chips at the
+        # live target, not the spec floor cold_start_resync computed —
+        # otherwise _free_capacity() over-reports and admission/scale-up
+        # can overcommit the physical budget
+        with self._lock:
+            if int(cur) > self._chips_in_use.get(uuid, 0):
+                self._chips_in_use[uuid] = int(cur)
+        # crash-window convergence: a kill between the meta target commit
+        # and the scale apply leaves live != stored target, and steady
+        # traffic never re-triggers the resize (desired == stored). Diff
+        # once at registration — scale() no-ops when already converged.
+        try:
+            live = [s for s in self._cluster_call(
+                self.cluster.pod_statuses, {"app.polyaxon.com/run": uuid})
+                if not s.terminating]
+        except Exception:
+            live = None
+        if (live is not None and len(live) != info["replicas"]
+                and self.reconciler.is_tracked(uuid)):
+            try:
+                self._apply_scale(uuid, info, info["replicas"],
+                                  scale_up=len(live) < info["replicas"])
+            except StaleLeaseError:
+                raise
+            except Exception:
+                traceback.print_exc()
+        return info
+
+    def _scale_service(self, uuid: str, info: dict, n: int) -> None:
+        """Converge one service onto ``n`` replicas: commit the target to
+        run meta (fenced) FIRST — a successor resyncs/restarts at the
+        stored target — then ride the write-ahead intent for scale-ups
+        (new pods are a launch; a kill mid-apply must be classified, not
+        double-launched) and let the reconciler diff desired-vs-live."""
+        n = int(n)
+        run = self.store.get_run(uuid)
+        if run is None or run["status"] not in self._INFLIGHT:
+            return
+        meta = dict(run.get("meta") or {})
+        meta["autoscale"] = {"replicas": n, "from": int(info["replicas"]),
+                             "at": time.time()}
+        self.store.update_run(uuid, meta=meta)
+        self._apply_scale(uuid, info, n, scale_up=n > int(info["replicas"]))
+
+    def _apply_scale(self, uuid: str, info: dict, n: int,
+                     scale_up: bool) -> None:
+        """Converge the cluster onto ``n`` replicas (target already in run
+        meta): scale-ups ride the write-ahead launch intent, the
+        reconciler diffs desired-vs-live by name."""
+        resources = info["resolved"].k8s_resources(service_replicas=n)
+        if scale_up:
+            token, intent_lease = self._intent_identity(uuid)
+            self.store.record_launch_intent(
+                uuid, self._lease_id, token, lease_name=intent_lease)
+        self.reconciler.scale(uuid, resources)
+        if scale_up:
+            self.store.mark_launched(uuid)
+        info["replicas"] = n
+        with self._lock:
+            # unconditional: an adopted service may have no reservation
+            # row yet, and a missing entry would make the budget blind to
+            # its live replicas
+            self._chips_in_use[uuid] = n
+        self._c_scale_events.inc()
 
     def _teardown_stalled(self, run_uuid: str) -> bool:
         """Stall-reap action for a run with a LIVE driver (ISSUE 8): kill
@@ -1668,6 +1865,7 @@ class LocalAgent:
         if self.reconciler is not None:
             self.reconciler.reconcile_once()
             self._reconcile_sidecars()
+            self._autoscale_pass()
 
     def tick(self) -> None:
         """One full reconcile pass (public for deterministic tests).
@@ -1709,6 +1907,7 @@ class LocalAgent:
         if self.reconciler is not None:
             self.reconciler.reconcile_once()
             self._reconcile_sidecars()
+            self._autoscale_pass()
         try:
             self.reaper.pass_once()
         except Exception:
@@ -1794,6 +1993,7 @@ class LocalAgent:
         if self.reconciler is not None:
             self.reconciler.reconcile_once()
             self._reconcile_sidecars()
+            self._autoscale_pass()
 
     def _free_capacity(self) -> int:
         with self._lock:
@@ -1973,6 +2173,13 @@ class LocalAgent:
         that had been through the compiler (r7 fix)."""
         r = ((spec.get("component") or {}).get("run")
              or spec.get("run") or {})
+        if r.get("kind") == "service":
+            # one chip per replica at the INITIAL count; the autoscaler
+            # re-reserves as it scales (ISSUE 9), bounded by max_replicas
+            from ..compiler.converter import service_replica_floor
+
+            return service_replica_floor(r.get("autoscale"),
+                                         r.get("replicas"))
         if r.get("kind") not in ("tpujob", "jaxjob"):
             return 1
         try:
@@ -2167,7 +2374,13 @@ class LocalAgent:
 
         if resolved.compiled.get_run_kind() != V1RunKind.SERVICE:
             return
-        ports = getattr(resolved.compiled.run, "ports", None) or [80]
+        svc_run = resolved.compiled.run
+        default_port = 80
+        if getattr(svc_run, "runtime", None):
+            # built-in serving runtime (ISSUE 9): its declared port
+            default_port = int(
+                (svc_run.runtime or {}).get("port", 8000) or 8000)
+        ports = getattr(svc_run, "ports", None) or [default_port]
         host = "127.0.0.1"
         if self._use_cluster(resolved):
             host = self.cluster.service_host(f"plx-{uuid[:12]}")
@@ -2192,13 +2405,21 @@ class LocalAgent:
         return resolved.compiled.get_run_kind() in V1RunKind.DISTRIBUTED
 
     @staticmethod
-    def _operation_cr(uuid: str, resolved):
+    def _operation_cr(uuid: str, resolved, run_meta: Optional[dict] = None):
         from ..operator import OperationCR
 
         term = resolved.compiled.termination
+        # a service run scaled past its spec default carries the CURRENT
+        # replica target in meta.autoscale (committed fenced BEFORE the
+        # scale's intent/apply) — a successor's resync/restart must render
+        # the live target, not the spec floor, or adoption would mismatch
+        # the live pod set (ISSUE 9)
+        replicas = None
+        if run_meta:
+            replicas = (run_meta.get("autoscale") or {}).get("replicas")
         return OperationCR(
             run_uuid=uuid,
-            resources=resolved.k8s_resources(),
+            resources=resolved.k8s_resources(service_replicas=replicas),
             backoff_limit=(term.max_retries if term and term.max_retries else 0),
             active_deadline_s=(term.timeout if term and term.timeout else 0.0),
             ttl_s=(term.ttl if term and term.ttl is not None else -1.0),

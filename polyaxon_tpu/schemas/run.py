@@ -84,7 +84,21 @@ class V1Job(_BaseRun):
 
 
 class V1Service(_BaseRun):
-    """Long-running service with exposed ports (upstream ``V1Service``)."""
+    """Long-running service with exposed ports (upstream ``V1Service``).
+
+    ``runtime`` (ISSUE 9) is the serving twin of the tpujob training
+    shortcut: instead of a user container, replicas run the built-in
+    online-inference runtime (paged KV cache + continuous batching +
+    ``/generate``; serve/runtime.py) with this dict as its spec —
+    {model, checkpoint, max_slots, block_size, prefill_chunk, port, ...}.
+
+    ``autoscale`` closes the traffic loop: the agent scales the replica
+    count from the run's own heartbeat-fed traffic gauges —
+    {min_replicas, max_replicas, target_per_replica (concurrent
+    running+waiting requests one replica should absorb, default
+    max_slots), scale_down_after_s (sustained-low-traffic hysteresis,
+    default 10)} — chip-budget-aware, through the launch-intent
+    machinery."""
 
     kind: Literal["service"] = "service"
     init: Optional[list[V1Init]] = None
@@ -94,6 +108,10 @@ class V1Service(_BaseRun):
     rewrite_path: Optional[bool] = None
     is_external: Optional[bool] = None
     replicas: Optional[int] = None
+    # Serving-runtime shortcut: run the built-in inference engine
+    runtime: Optional[dict[str, Any]] = None
+    # Traffic-driven replica autoscaling (agent-side control loop)
+    autoscale: Optional[dict[str, Any]] = None
 
 
 class V1KFReplica(BaseSchema):

@@ -1,0 +1,21 @@
+"""Online inference runtime (ISSUE 9): paged KV cache, continuous batching,
+HTTP serving for `kind: service` runs, and the traffic meters the agent's
+autoscaler consumes.
+
+Layering (mirrors train/):
+
+- :mod:`kv_cache`  — the block pool + free-list allocator + per-sequence
+  block tables (host-side bookkeeping, device-side storage).
+- :mod:`model`     — decode-mode transformer: chunked prefill and
+  single-token decode over the paged cache, logit-parity with the dense
+  training forward.
+- :mod:`engine`    — Orca-style iteration-level (continuous) batching:
+  admission between decode steps, prefill/decode interleave, per-request
+  sampling, completion recycling blocks without a global pause.
+- :mod:`runtime`   — the pod entrypoint a `kind: service` polyaxonfile
+  launches (``PLX_SERVE_SPEC``): weight restore (read-only), the aiohttp
+  ``/generate`` endpoint, and the tracking/heartbeat traffic bridge.
+"""
+
+from .engine import GenRequest, SamplingParams, ServeEngine  # noqa: F401
+from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401

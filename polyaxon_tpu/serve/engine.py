@@ -1,0 +1,439 @@
+"""Continuous (iteration-level) batching engine — ISSUE 9 tentpole (2).
+
+Orca-style scheduling: the unit of work is one *decode iteration* over the
+running batch, and the request set is re-evaluated between iterations —
+new requests admit the moment a slot and blocks are free, finished requests
+release their blocks the same iteration they complete, and a long prompt
+prefills in bounded chunks interleaved with decode so it can never stall
+the running batch for more than one chunk's worth of compute. No global
+pause anywhere: the batch keeps decoding while membership churns.
+
+Block accounting is worst-case at admission (prompt + max_new_tokens): a
+request that admits can always finish, so there is no mid-flight
+out-of-blocks preemption path to get wrong. The trade is utilization
+(reserved-but-unwritten tail blocks), surfaced honestly by the KV gauge
+(docs/PERFORMANCE.md "Serving" discusses sizing).
+
+Timing meters ride the emit path: TTFT (arrival -> first token out) and
+inter-token latency per request feed both the pod-local Prometheus
+families (``polyaxon_serve_*``) and a drain buffer the runtime ships to
+the control plane in heartbeats.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+from .kv_cache import OutOfBlocksError, SequenceBlocks
+from .model import decode_step, init_cache, prefill_chunk
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs (vLLM's SamplingParams, trimmed)."""
+
+    max_new_tokens: int = 64
+    temperature: float = 0.0      # 0 = greedy
+    top_k: int = 0                # 0 = full vocab
+    seed: Optional[int] = None
+    stop_token: Optional[int] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "SamplingParams":
+        d = d or {}
+        return cls(
+            max_new_tokens=int(d.get("max_new_tokens", 64)),
+            temperature=float(d.get("temperature", 0.0)),
+            top_k=int(d.get("top_k", 0)),
+            seed=(int(d["seed"]) if d.get("seed") is not None else None),
+            stop_token=(int(d["stop_token"])
+                        if d.get("stop_token") is not None else None),
+        )
+
+
+# request lifecycle: waiting -> prefill -> running -> done|failed
+@dataclass
+class GenRequest:
+    id: int
+    prompt: list[int]
+    sampling: SamplingParams
+    created_at: float = field(default_factory=time.monotonic)
+    state: str = "waiting"
+    seq: SequenceBlocks = field(default_factory=SequenceBlocks)
+    prefilled: int = 0
+    next_token: Optional[int] = None    # sampled, not yet cache-written
+    out_tokens: list[int] = field(default_factory=list)
+    stream: "queue.SimpleQueue" = field(default_factory=queue.SimpleQueue)
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    error: Optional[str] = None
+    _rng: Optional[np.random.Generator] = None
+
+    @property
+    def rng(self) -> np.random.Generator:
+        if self._rng is None:
+            seed = self.sampling.seed
+            self._rng = np.random.default_rng(
+                self.id if seed is None else seed)
+        return self._rng
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.created_at
+
+
+def sample_token(logits: np.ndarray, sp: SamplingParams,
+                 rng: np.random.Generator) -> int:
+    """Host-side sampling: greedy at temperature 0, else softmax with
+    optional top-k, per-request PRNG (deterministic under a seed)."""
+    if sp.temperature <= 0.0:
+        return int(np.argmax(logits))
+    x = logits.astype(np.float64) / sp.temperature
+    if sp.top_k and sp.top_k < x.shape[-1]:
+        kth = np.partition(x, -sp.top_k)[-sp.top_k]
+        x = np.where(x >= kth, x, -np.inf)
+    x = x - x.max()
+    p = np.exp(x)
+    p /= p.sum()
+    return int(rng.choice(x.shape[-1], p=p))
+
+
+class ServeEngine:
+    """Paged-KV continuous-batching engine over a fixed slot count.
+
+    ``step()`` is one scheduling iteration (admission + at most one prefill
+    chunk + one batched decode); ``start()`` runs it on a daemon thread.
+    ``submit()``/``generate()`` are thread-safe.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: TransformerConfig,
+        *,
+        max_slots: int = 8,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        prefill_chunk: int = 64,
+        max_seq_len: Optional[int] = None,
+        attn_impl: str = "gather",
+        metrics=None,
+    ):
+        from ..obs.metrics import MetricsRegistry
+
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = int(max_slots)
+        self.block_size = int(block_size)
+        self.max_seq_len = int(max_seq_len or cfg.max_seq)
+        self.max_blocks_per_seq = -(-self.max_seq_len // self.block_size)
+        if num_blocks is None:
+            # enough for every slot to hold a worst-case sequence
+            num_blocks = self.max_slots * self.max_blocks_per_seq
+        self.prefill_chunk = int(prefill_chunk)
+        self.attn_impl = attn_impl
+        self.cache = init_cache(cfg, num_blocks=int(num_blocks),
+                                block_size=self.block_size)
+        self._slots: list[Optional[GenRequest]] = [None] * self.max_slots
+        self._waiting: collections.deque[GenRequest] = collections.deque()
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        # -- meters ----------------------------------------------------------
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._h_ttft = self.metrics.histogram(
+            "polyaxon_serve_ttft_seconds",
+            "Request arrival to first generated token")
+        self._h_itl = self.metrics.histogram(
+            "polyaxon_serve_intertoken_seconds",
+            "Interval between consecutive generated tokens of one request")
+        self._c_requests = self.metrics.counter(
+            "polyaxon_serve_requests_total", "Generate requests completed")
+        self._c_tokens = self.metrics.counter(
+            "polyaxon_serve_generated_tokens_total", "Tokens generated")
+        self.metrics.gauge(
+            "polyaxon_serve_running_requests",
+            "Requests holding a decode slot",
+            value_fn=lambda: float(self.running_count))
+        self.metrics.gauge(
+            "polyaxon_serve_waiting_requests",
+            "Requests queued for admission",
+            value_fn=lambda: float(self.waiting_count))
+        self.metrics.gauge(
+            "polyaxon_serve_kv_block_utilization",
+            "Fraction of KV cache blocks reserved",
+            value_fn=lambda: self.cache.utilization)
+        # drained into heartbeats by the runtime (bounded: a beat outage
+        # keeps the newest window, not an unbounded backlog)
+        self._obs_lock = threading.Lock()
+        self._ttft_obs: collections.deque = collections.deque(maxlen=512)
+        self._itl_obs: collections.deque = collections.deque(maxlen=2048)
+        self._decode_steps = 0
+        self._started_at = time.monotonic()
+
+    # -- public surface ------------------------------------------------------
+
+    @property
+    def running_count(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def waiting_count(self) -> int:
+        return len(self._waiting)
+
+    def submit(self, prompt: list[int],
+               sampling: Optional[SamplingParams] = None) -> GenRequest:
+        sampling = sampling or SamplingParams()
+        vocab = self.cfg.vocab_size
+        prompt = [int(t) % vocab for t in prompt]
+        req = GenRequest(id=next(self._ids), prompt=prompt,
+                         sampling=sampling)
+        if not prompt:
+            req.state = "failed"
+            req.error = "empty prompt"
+            req.finished_at = time.monotonic()
+            req.stream.put(None)
+            return req
+        total = len(prompt) + sampling.max_new_tokens
+        if total > self.max_seq_len:
+            req.state = "failed"
+            req.error = (f"prompt+max_new_tokens {total} exceeds "
+                         f"max_seq_len {self.max_seq_len}")
+            req.finished_at = time.monotonic()
+            req.stream.put(None)
+            return req
+        with self._lock:
+            self._waiting.append(req)
+        self._work.set()
+        return req
+
+    def generate(self, prompt: list[int],
+                 sampling: Optional[SamplingParams] = None,
+                 timeout: float = 120.0) -> GenRequest:
+        """Blocking helper: submit and drain the stream to completion."""
+        req = self.submit(prompt, sampling)
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"generate timed out after {timeout}s")
+            try:
+                tok = req.stream.get(timeout=min(remaining, 1.0))
+            except queue.Empty:
+                continue
+            if tok is None:
+                return req
+
+    def start(self) -> "ServeEngine":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serve-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _admit(self) -> None:
+        """Move waiting requests into free slots while blocks last —
+        between iterations, never mid-iteration (Orca admission rule)."""
+        for i in range(self.max_slots):
+            if not self._waiting or self._slots[i] is not None:
+                continue
+            req = self._waiting[0]
+            total = len(req.prompt) + req.sampling.max_new_tokens
+            try:
+                self.cache.ensure(req.seq, total)
+            except OutOfBlocksError:
+                return  # strict FIFO: no small-request overtake starvation
+            self._waiting.popleft()
+            req.state = "prefill"
+            self._slots[i] = req
+
+    def _prefill_one(self) -> None:
+        """Advance the first mid-prefill request by one bounded chunk."""
+        req = next((r for r in self._slots
+                    if r is not None and r.state == "prefill"), None)
+        if req is None:
+            return
+        import jax.numpy as jnp
+
+        c = self.prefill_chunk
+        chunk = req.prompt[req.prefilled:req.prefilled + c]
+        padded = chunk + [0] * (c - len(chunk))
+        tables = jnp.asarray(self.cache.block_table_array(
+            [req.seq], self.max_blocks_per_seq))
+        logits, self.cache.k, self.cache.v = prefill_chunk(
+            self.params, jnp.asarray([padded], jnp.int32),
+            jnp.asarray(req.prefilled, jnp.int32),
+            jnp.asarray(len(chunk), jnp.int32),
+            self.cache.k, self.cache.v, tables, cfg=self.cfg)
+        req.prefilled += len(chunk)
+        req.seq.length = req.prefilled
+        if req.prefilled >= len(req.prompt):
+            tok = sample_token(np.asarray(logits[0]), req.sampling, req.rng)
+            req.state = "running"
+            req.next_token = tok
+            self._emit(req, tok)
+
+    def _decode_batch(self) -> int:
+        """One decode iteration over every running slot. Returns tokens
+        emitted."""
+        running = [(i, r) for i, r in enumerate(self._slots)
+                   if r is not None and r.state == "running"]
+        if not running:
+            return 0
+        import jax.numpy as jnp
+
+        b = self.max_slots
+        tokens = np.zeros(b, np.int32)
+        positions = np.zeros(b, np.int32)
+        active = np.zeros(b, bool)
+        for i, r in running:
+            tokens[i] = r.next_token
+            positions[i] = r.seq.length
+            active[i] = True
+        seqs: list[Optional[SequenceBlocks]] = [
+            r.seq if r is not None else None for r in self._slots]
+        tables = jnp.asarray(self.cache.block_table_array(
+            seqs, self.max_blocks_per_seq))
+        logits, self.cache.k, self.cache.v = decode_step(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            self.cache.k, self.cache.v, tables, jnp.asarray(active),
+            cfg=self.cfg, impl=self.attn_impl)
+        logits_np = np.asarray(logits)
+        self._decode_steps += 1
+        emitted = 0
+        for i, r in running:
+            r.seq.length += 1  # the input token's K/V just landed
+            sp = r.sampling
+            done = len(r.out_tokens) >= sp.max_new_tokens or (
+                sp.stop_token is not None
+                and r.out_tokens and r.out_tokens[-1] == sp.stop_token)
+            if done:
+                self._finish(i, r)
+                continue
+            tok = sample_token(logits_np[i], sp, r.rng)
+            r.next_token = tok
+            self._emit(r, tok)
+            emitted += 1
+            if len(r.out_tokens) >= sp.max_new_tokens or (
+                    sp.stop_token is not None and tok == sp.stop_token):
+                self._finish(i, r)
+        return emitted
+
+    def _emit(self, req: GenRequest, tok: int) -> None:
+        now = time.monotonic()
+        req.out_tokens.append(tok)
+        if req.first_token_at is None:
+            req.first_token_at = now
+            ttft = now - req.created_at
+            self._h_ttft.observe(ttft)
+            with self._obs_lock:
+                self._ttft_obs.append(round(ttft, 6))
+        else:
+            itl = now - req.last_token_at
+            self._h_itl.observe(itl)
+            with self._obs_lock:
+                self._itl_obs.append(round(itl, 6))
+        req.last_token_at = now
+        self._c_tokens.inc()
+        req.stream.put(tok)
+
+    def _finish(self, slot: int, req: GenRequest) -> None:
+        """Completion recycles blocks the same iteration — the freed slot
+        admits a waiting request on the NEXT step, no global pause."""
+        req.state = "done"
+        req.finished_at = time.monotonic()
+        self.cache.release(req.seq)
+        self._slots[slot] = None
+        self._c_requests.inc()
+        req.stream.put(None)
+
+    def step(self) -> int:
+        """One scheduling iteration; returns tokens emitted."""
+        with self._lock:
+            self._admit()
+            self._prefill_one()
+            emitted = self._decode_batch()
+            self._admit()  # freed slots admit without waiting a full step
+            if (self._waiting
+                    or any(r is not None for r in self._slots)):
+                self._work.set()
+        return emitted
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._work.wait(timeout=0.5):
+                continue
+            self._work.clear()
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — fail requests loudly
+                import traceback
+
+                traceback.print_exc()
+                with self._lock:
+                    for i, r in enumerate(self._slots):
+                        if r is not None:
+                            r.state = "failed"
+                            r.error = repr(e)
+                            r.finished_at = time.monotonic()
+                            self.cache.release(r.seq)
+                            self._slots[i] = None
+                            r.stream.put(None)
+
+    # -- traffic snapshot (heartbeat payload / outputs bridge) ---------------
+
+    def snapshot(self) -> dict:
+        """Cumulative counters + instantaneous gauges; the runtime ships
+        this (plus drained observations) to the control plane."""
+        elapsed = max(time.monotonic() - self._started_at, 1e-9)
+        return {
+            "running": self.running_count,
+            "waiting": self.waiting_count,
+            "kv_blocks_used": self.cache.allocator.used_count,
+            "kv_blocks_total": self.cache.allocator.num_blocks,
+            "requests_total": int(self._c_requests.value),
+            "tokens_total": int(self._c_tokens.value),
+            "decode_steps": self._decode_steps,
+            "tokens_per_sec": self._c_tokens.value / elapsed,
+            "ttft_p50_ms": _ms(self._h_ttft.quantile(0.50)),
+            "ttft_p95_ms": _ms(self._h_ttft.quantile(0.95)),
+            "intertoken_p50_ms": _ms(self._h_itl.quantile(0.50)),
+            "intertoken_p95_ms": _ms(self._h_itl.quantile(0.95)),
+        }
+
+    def drain_observations(self, max_each: int = 256) -> dict:
+        """Raw TTFT / inter-token samples since the last drain (bounded):
+        the heartbeat ships them so the STORE-side histograms observe real
+        values, not a lossy re-aggregation."""
+        with self._obs_lock:
+            ttft = [self._ttft_obs.popleft()
+                    for _ in range(min(max_each, len(self._ttft_obs)))]
+            itl = [self._itl_obs.popleft()
+                   for _ in range(min(max_each, len(self._itl_obs)))]
+        return {"ttft": ttft, "itl": itl}
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v * 1e3, 3)

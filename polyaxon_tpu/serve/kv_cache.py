@@ -1,0 +1,144 @@
+"""Paged KV cache: fixed-size blocks, free-list allocator, block tables.
+
+The pool is one device array per K/V with a leading ``[layers, num_blocks]``
+prefix; a *block* is the allocation quantum (``block_size`` token slots for
+every layer at once — sequences grow in lockstep across layers, so per-layer
+allocators would only multiply bookkeeping). The allocator itself is plain
+host Python: serving admission/eviction decisions happen between decode
+iterations on the host anyway, and a LIFO free list keeps recently-freed
+(cache-warm) blocks in circulation first.
+
+Freed blocks are NOT zeroed — the attention length mask already makes stale
+bytes unreachable, and the tier-1 parity suite pins exactly that (eviction +
+reuse garbage never perturbs a live sequence's logits).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+
+class OutOfBlocksError(RuntimeError):
+    """The pool cannot satisfy an allocation; callers queue, not crash."""
+
+
+class BlockAllocator:
+    """LIFO free-list over ``num_blocks`` block ids."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: list[int] = list(range(num_blocks - 1, -1, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def utilization(self) -> float:
+        return self.used_count / self.num_blocks
+
+    def can_alloc(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def alloc(self, n: int = 1) -> list[int]:
+        if len(self._free) < n:
+            raise OutOfBlocksError(
+                f"need {n} blocks, {len(self._free)}/{self.num_blocks} free")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, block_ids: list[int]) -> None:
+        for b in block_ids:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(f"block id {b} out of range")
+        self._free.extend(block_ids)
+        if len(self._free) > self.num_blocks:
+            raise RuntimeError("double free: free list exceeds pool size")
+
+
+@dataclass
+class SequenceBlocks:
+    """One sequence's slice of the pool: its ordered block table and live
+    token count. ``capacity`` is table length x block size."""
+
+    block_ids: list[int] = field(default_factory=list)
+    length: int = 0
+
+    def capacity(self, block_size: int) -> int:
+        return len(self.block_ids) * block_size
+
+
+class PagedKVCache:
+    """Device storage + host allocator for the paged KV pool.
+
+    K/V arrays are ``[L, N, bs, KVH, D]``; model code updates them
+    functionally (the decode step donates and returns them). ``ensure``
+    grows a sequence's table to cover a target length, ``release`` recycles
+    its blocks on completion/eviction.
+    """
+
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 kv_heads: int, head_dim: int, dtype: Any = jnp.float32):
+        self.num_layers = num_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        # one extra TRASH block (index num_blocks): batch padding rows and
+        # masked chunk positions direct their cache writes there, so a
+        # static-shape scatter never corrupts a live sequence's block. The
+        # allocator never hands it out and block tables never reference it.
+        self.trash_block = num_blocks
+        shape = (num_layers, num_blocks + 1, block_size, kv_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.allocator = BlockAllocator(num_blocks)
+
+    # -- per-sequence table management --------------------------------------
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size) if num_tokens > 0 else 0
+
+    def ensure(self, seq: SequenceBlocks, target_len: int) -> None:
+        """Grow ``seq``'s block table to cover ``target_len`` tokens.
+        Raises :class:`OutOfBlocksError` (allocating nothing) when the pool
+        can't cover it — admission control queues the request instead."""
+        need = self.blocks_for(target_len) - len(seq.block_ids)
+        if need > 0:
+            seq.block_ids.extend(self.allocator.alloc(need))
+
+    def release(self, seq: SequenceBlocks) -> None:
+        if seq.block_ids:
+            self.allocator.free(seq.block_ids)
+        seq.block_ids = []
+        seq.length = 0
+
+    # -- batch views ---------------------------------------------------------
+
+    def block_table_array(self, seqs: list[Optional[SequenceBlocks]],
+                          max_blocks: int):
+        """[B, max_blocks] int32 table (idle/short rows padded with 0 —
+        the length mask keeps padded entries unreachable)."""
+        import numpy as np
+
+        b = len(seqs)
+        out = np.zeros((b, max_blocks), np.int32)
+        for i, s in enumerate(seqs):
+            if s is None:
+                continue
+            ids = s.block_ids[:max_blocks]
+            out[i, :len(ids)] = ids
+        return out
+
+    @property
+    def utilization(self) -> float:
+        return self.allocator.utilization

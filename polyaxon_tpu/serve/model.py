@@ -1,0 +1,297 @@
+"""Decode-mode transformer over the paged KV cache (ISSUE 9 tentpole (1)).
+
+The training trunk (models/transformer.py) computes full self-attention over
+a whole sequence; serving needs the *incremental* form — write this step's
+K/V into the sequence's cache blocks, attend over everything cached so far.
+Two entry points, both pure functions over ``(params, pools)`` so the engine
+can jit them with donated cache buffers:
+
+- :func:`prefill_chunk` — a chunk of one request's prompt: writes the
+  chunk's K/V into pre-allocated blocks and attends causally over the
+  cached prefix + the chunk itself. Chunked so a long prompt is admitted
+  incrementally and never stalls the decode batch (Orca/vLLM-style
+  iteration-level scheduling).
+- :func:`decode_step` — one token for every running slot, batched: cache
+  write + paged attention (``impl="gather"`` exact path or the ``"flash"``
+  pallas kernel whose block-table index maps skip dead-block DMA).
+
+Numerics: computation follows the training forward exactly (same norm /
+projection / rope / activation order, f32 softmax); the tier-1 parity suite
+pins paged decode bit-exact against the contiguous dense-cache decode and
+allclose against the full training forward.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import TransformerConfig, _norm, head_weights
+from ..ops import apply_rope, rope_frequencies
+from ..ops.paged_attention import (
+    dense_decode_attention, gather_blocks, paged_attention,
+)
+from .kv_cache import PagedKVCache
+
+
+def init_cache(cfg: TransformerConfig, num_blocks: int, block_size: int,
+               dtype=None) -> PagedKVCache:
+    return PagedKVCache(
+        num_layers=cfg.num_layers, num_blocks=num_blocks,
+        block_size=block_size, kv_heads=cfg.kv_heads, head_dim=cfg.hd,
+        dtype=dtype or cfg.dtype)
+
+
+def _layer_qkv(x, lp, cfg: TransformerConfig, rope_tables, positions):
+    """Projections + rope for a [B, S, h] slice at per-row ``positions``
+    [B, S] — the same math as the training layer body, with the position
+    table lookups made batch-ragged."""
+    dt = cfg.dtype
+    ap = lp["attn"]
+    y = _norm(x, lp["attn_norm"], cfg)
+    q = jnp.einsum("bsh,hnd->bnsd", y, ap["wq"].astype(dt))
+    k = jnp.einsum("bsh,hnd->bnsd", y, ap["wk"].astype(dt))
+    v = jnp.einsum("bsh,hnd->bnsd", y, ap["wv"].astype(dt))
+    if cfg.use_bias:
+        q = q + ap["bq"].astype(dt)[None, :, None, :]
+        k = k + ap["bk"].astype(dt)[None, :, None, :]
+        v = v + ap["bv"].astype(dt)[None, :, None, :]
+    if cfg.pos == "rope":
+        cos, sin = rope_tables
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
+    return q, k, v
+
+
+def _layer_mlp(x, o, lp, cfg: TransformerConfig):
+    """Residual + MLP half of the layer (identical to the training body)."""
+    from ..ops import gelu, swiglu
+
+    dt = cfg.dtype
+    ap, mp = lp["attn"], lp["mlp"]
+    b, s, h = x.shape
+    o = jnp.einsum("bse,eh->bsh", o, ap["wo"].astype(dt).reshape(-1, h))
+    if cfg.use_bias:
+        o = o + ap["bo"].astype(dt)
+    x = x + o
+    y = _norm(x, lp["mlp_norm"], cfg)
+    if cfg.act == "swiglu":
+        hidden = swiglu(
+            jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt)),
+            jnp.einsum("bsh,hm->bsm", y, mp["wg"].astype(dt)),
+        )
+    else:
+        hidden = jnp.einsum("bsh,hm->bsm", y, mp["wi"].astype(dt))
+        if cfg.use_bias:
+            hidden = hidden + mp["bi"].astype(dt)
+        hidden = gelu(hidden)
+    out = jnp.einsum("bsm,mh->bsh", hidden, mp["wo"].astype(dt))
+    if cfg.use_bias:
+        out = out + mp["bo"].astype(dt)
+    return x + out
+
+
+def _write_kv(pool_l, vals, blk, slot):
+    """Scatter [B, S] token rows into the pool: ``pool_l[blk, slot] <-
+    vals``. ``blk`` already routes masked rows to the trash block, so live
+    indices are unique by construction (sequences own disjoint blocks)."""
+    b, s, kvh, d = vals.shape
+    return pool_l.at[blk.reshape(-1), slot.reshape(-1)].set(
+        vals.reshape(b * s, kvh, d))
+
+
+def _write_coords(cache_positions, block_tables, block_size, write_mask,
+                  trash_block):
+    """(block id, slot) for each [B, S] cache position; masked positions
+    go to the trash block."""
+    blk_idx = cache_positions // block_size                 # [B, S]
+    blk_idx = jnp.clip(blk_idx, 0, block_tables.shape[1] - 1)
+    blk = jnp.take_along_axis(block_tables, blk_idx, axis=1)
+    blk = jnp.where(write_mask, blk, trash_block)
+    slot = cache_positions % block_size
+    return blk, slot
+
+
+def _regroup(q, kv_heads):
+    """[B, H, S, D] -> [B, KVH, G, S, D] (query heads grouped per KV head,
+    matching the paged-attention GQA layout)."""
+    b, h, s, d = q.shape
+    return q.reshape(b, kv_heads, h // kv_heads, s, d)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "impl"),
+    donate_argnames=("k_pool", "v_pool"),
+)
+def decode_step(
+    params: dict,
+    tokens: jax.Array,        # [B] int32 — this step's input token per slot
+    positions: jax.Array,     # [B] int32 — cache position to write (= #cached)
+    k_pool: jax.Array,        # [L, N+1, bs, KVH, D]
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [B, T] int32
+    active: jax.Array,        # [B] bool
+    *,
+    cfg: TransformerConfig,
+    impl: str = "gather",
+):
+    """One batched decode iteration. Returns (logits [B, V] f32, k_pool,
+    v_pool). Inactive slots write to the trash block and come back with
+    garbage logits the engine never reads."""
+    dt = cfg.dtype
+    block_size = k_pool.shape[2]
+    x = params["embed"]["tokens"].astype(dt)[tokens][:, None, :]  # [B,1,h]
+    rope_tables = None
+    if cfg.pos == "rope":
+        cos, sin = rope_frequencies(cfg.hd, cfg.max_seq, cfg.rope_theta)
+        rope_tables = (cos, sin)
+    pos_safe = jnp.clip(positions, 0, cfg.max_seq - 1)[:, None]   # [B,1]
+    if cfg.pos == "learned":
+        x = x + params["embed"]["pos"].astype(dt)[pos_safe[:, 0]][:, None, :]
+    lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+    blk, slot = _write_coords(
+        pos_safe, block_tables, block_size, active[:, None],
+        k_pool.shape[1] - 1)
+
+    def layer(x, xs):
+        lp, k_l, v_l = xs
+        q, k, v = _layer_qkv(x, lp, cfg, rope_tables, pos_safe)
+        k_l = _write_kv(k_l, k.transpose(0, 2, 1, 3), blk, slot)
+        v_l = _write_kv(v_l, v.transpose(0, 2, 1, 3), blk, slot)
+        qg = _regroup(q, cfg.kv_heads)[:, :, :, 0, :]       # [B,KVH,G,D]
+        o = paged_attention(qg, k_l, v_l, block_tables, lengths, impl=impl)
+        b, kvh, g, d = o.shape
+        o = o.reshape(b, kvh * g, 1, d).transpose(0, 2, 1, 3)  # [B,1,H,D]
+        o = o.reshape(b, 1, kvh * g * d).astype(dt)
+        x = _layer_mlp(x, o, lp, cfg)
+        return x, (k_l, v_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        layer, x, (params["layers"], k_pool, v_pool))
+    hidden = _norm(x, params["final_norm"], cfg)[:, 0, :]   # [B, h]
+    w, vocab_major = head_weights(params, cfg)
+    eq = "bh,vh->bv" if vocab_major else "bh,hv->bv"
+    logits = jnp.einsum(eq, hidden, w.astype(dt)).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg",),
+    donate_argnames=("k_pool", "v_pool"),
+)
+def prefill_chunk(
+    params: dict,
+    tokens: jax.Array,        # [1, C] int32 — chunk of ONE request's prompt
+    start: jax.Array,         # [] int32 — cache position of tokens[0, 0]
+    chunk_len: jax.Array,     # [] int32 — live tokens in this chunk
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [1, T] int32
+    *,
+    cfg: TransformerConfig,
+):
+    """Prefill one chunk of a prompt: write its K/V and attend causally
+    over cached prefix + chunk. Returns (last_logits [1, V] f32, k_pool,
+    v_pool) — last_logits is the next-token distribution after the final
+    LIVE chunk position (only meaningful on the prompt's last chunk)."""
+    dt = cfg.dtype
+    block_size = k_pool.shape[2]
+    c = tokens.shape[1]
+    offs = jnp.arange(c, dtype=jnp.int32)
+    positions = start + offs[None, :]                        # [1, C]
+    live = offs[None, :] < chunk_len                         # [1, C]
+    pos_safe = jnp.where(live, positions, 0)
+    pos_safe = jnp.clip(pos_safe, 0, cfg.max_seq - 1)
+    x = params["embed"]["tokens"].astype(dt)[tokens]
+    if cfg.pos == "learned":
+        x = x + params["embed"]["pos"].astype(dt)[pos_safe[0]][None]
+    rope_tables = None
+    if cfg.pos == "rope":
+        cos, sin = rope_frequencies(cfg.hd, cfg.max_seq, cfg.rope_theta)
+        rope_tables = (cos, sin)
+    blk, slot = _write_coords(
+        pos_safe, block_tables, block_size, live, k_pool.shape[1] - 1)
+    capacity = block_tables.shape[1] * block_size
+    k_ids = jnp.arange(capacity)
+
+    def layer(x, xs):
+        lp, k_l, v_l = xs
+        q, k, v = _layer_qkv(x, lp, cfg, rope_tables, pos_safe)
+        k_l = _write_kv(k_l, k.transpose(0, 2, 1, 3), blk, slot)
+        v_l = _write_kv(v_l, v.transpose(0, 2, 1, 3), blk, slot)
+        kc = gather_blocks(k_l, block_tables)                # [1, C_cap, KVH, D]
+        vc = gather_blocks(v_l, block_tables)
+        qg = _regroup(q, cfg.kv_heads)                       # [1,KVH,G,C,D]
+        scores = jnp.einsum(
+            "bhgsd,bchd->bhgsc", qg.astype(jnp.float32),
+            kc.astype(jnp.float32)) * (cfg.hd ** -0.5)
+        mask = k_ids[None, :] <= positions[..., None]        # [1, C, C_cap]
+        scores = jnp.where(mask[:, None, None, :, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+        o = jnp.einsum("bhgsc,bchd->bhgsd", probs,
+                       vc.astype(jnp.float32)).astype(dt)
+        b, kvh, g, s, d = o.shape
+        o = o.reshape(b, kvh * g, s, d).transpose(0, 2, 1, 3).reshape(
+            b, s, kvh * g * d)
+        x = _layer_mlp(x, o, lp, cfg)
+        return x, (k_l, v_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        layer, x, (params["layers"], k_pool, v_pool))
+    hidden = _norm(x, params["final_norm"], cfg)             # [1, C, h]
+    last = jnp.clip(chunk_len - 1, 0, c - 1)
+    hidden_last = hidden[:, last, :]                         # [1, h]
+    w, vocab_major = head_weights(params, cfg)
+    eq = "bh,vh->bv" if vocab_major else "bh,hv->bv"
+    logits = jnp.einsum(eq, hidden_last, w.astype(dt)).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
+def dense_reference_decode(params, cfg: TransformerConfig, prompts,
+                           max_new_tokens: int, sample_fn=None):
+    """Contiguous-cache decode oracle for the parity suite: the same layer
+    math over a per-sequence dense [C] cache (no paging). Greedy by
+    default. Returns list[list[int]] generated tokens per prompt.
+
+    Deliberately built from the SAME primitives as the paged path (one
+    degenerate block spanning the whole capacity), so 'dense decode' is a
+    specialization, not a second implementation that could drift."""
+    import numpy as np
+
+    from .kv_cache import SequenceBlocks
+
+    max_len = max(len(p) for p in prompts) + max_new_tokens
+    bs = max_len  # one block spans the whole capacity: contiguous layout
+    outs = []
+    for prompt in prompts:
+        cache = init_cache(cfg, num_blocks=1, block_size=bs)
+        seq = SequenceBlocks()
+        cache.ensure(seq, len(prompt) + max_new_tokens)
+        tables = jnp.asarray(cache.block_table_array([seq], 1))
+        k_pool, v_pool = cache.k, cache.v
+        logits, k_pool, v_pool = prefill_chunk(
+            params, jnp.asarray([prompt], jnp.int32),
+            jnp.asarray(0, jnp.int32),
+            jnp.asarray(len(prompt), jnp.int32),
+            k_pool, v_pool, tables, cfg=cfg)
+        gen = []
+        pos = len(prompt)
+        for _ in range(max_new_tokens):
+            arr = np.asarray(logits[0])
+            tok = int(np.argmax(arr)) if sample_fn is None else sample_fn(arr)
+            gen.append(tok)
+            if len(gen) == max_new_tokens:
+                break
+            logits, k_pool, v_pool = decode_step(
+                params, jnp.asarray([tok], jnp.int32),
+                jnp.asarray([pos], jnp.int32), k_pool, v_pool, tables,
+                jnp.asarray([True]), cfg=cfg)
+            pos += 1
+        outs.append(gen)
+    return outs

@@ -1,0 +1,277 @@
+"""Serve-pod entrypoint (`runtime:` section of `kind: service` specs).
+
+The serving twin of runtime/builtin.py: a `kind: service` polyaxonfile with
+a ``runtime:`` block launches this module in the pod (``PLX_SERVE_SPEC``
+JSON in env), which restores weights, spins the continuous-batching engine,
+serves ``/generate`` behind the portforward/service-meta plumbing, and
+bridges its traffic meters into the control plane — run outputs (tokens/s,
+TTFT/inter-token percentiles) on every report interval plus heartbeat
+``serve`` payloads feeding the ``polyaxon_serve_*`` families and the
+agent's autoscaler.
+
+Spec keys:
+    model: registry name (default "llama-tiny")
+    checkpoint: checkpoint dir (a training run's outputs/checkpoints) or
+        {path, step}; restored READ-ONLY via the PR-4 sha256 manifests —
+        N replicas restoring the same manifest have zero side effects.
+        Absent: random init from ``init_seed`` (benchmarks/tests).
+    max_seq_len, block_size, num_blocks, max_slots, prefill_chunk,
+    attn_impl ("gather" | "flash"), port (default 8000), bind,
+    platform / num_cpu_devices (same semantics as the builtin trainer),
+    report_interval (outputs/heartbeat cadence seconds, default 2)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+from typing import Any, Optional
+
+DEFAULT_SERVE_PORT = 8000
+
+#: outputs keys the report loop maintains (read by the e2e smoke,
+#: serve_bench --from-run, and the dashboard)
+OUTPUT_KEYS = (
+    "serve_requests_total", "serve_tokens_total", "serve_tokens_per_sec",
+    "serve_ttft_p50_ms", "serve_ttft_p95_ms", "serve_intertoken_p50_ms",
+    "serve_intertoken_p95_ms", "serve_running", "serve_waiting",
+    "serve_kv_block_utilization", "serve_port", "serve_replica",
+)
+
+
+def load_params(spec: dict, cfg) -> tuple[Any, dict]:
+    """Weights for the engine: read-only checkpoint restore when the spec
+    names one (torn newest steps fall back per the manifest walk), random
+    init otherwise. Returns (params, provenance dict for outputs)."""
+    ckpt = spec.get("checkpoint")
+    if ckpt:
+        from ..train.checkpoint import CheckpointConfig, Checkpointer
+
+        path = ckpt if isinstance(ckpt, str) else ckpt.get("path")
+        step = None if isinstance(ckpt, str) else ckpt.get("step")
+        ro = Checkpointer(CheckpointConfig(directory=path), read_only=True)
+        raw, restored_step = ro.restore_raw(
+            step=int(step) if step is not None else None)
+        params = raw["params"] if isinstance(raw, dict) else raw.params
+        return params, {"restored_from": path,
+                        "restored_step": int(restored_step)}
+    import jax
+
+    from ..models import transformer
+
+    seed = int(spec.get("init_seed", 0))
+    return transformer.init(jax.random.PRNGKey(seed), cfg), {
+        "restored_step": -1}
+
+
+def build_engine(spec: dict):
+    """REGISTRY model + overrides -> a ready (not yet started) engine."""
+    from dataclasses import replace
+
+    from ..models import REGISTRY
+    from .engine import ServeEngine
+
+    name = spec.get("model", "llama-tiny")
+    if name not in REGISTRY:
+        raise SystemExit(
+            f"Unknown model {name!r}; available: {sorted(REGISTRY)}")
+    family, cfg = REGISTRY[name]
+    if family != "lm":
+        raise SystemExit(f"serve runtime needs a causal-LM model; "
+                         f"{name!r} is {family!r}")
+    max_seq = int(spec.get("max_seq_len", min(cfg.max_seq, 2048)))
+    if max_seq > cfg.max_seq:
+        cfg = replace(cfg, max_seq=max_seq)
+    params, provenance = load_params(spec, cfg)
+    engine = ServeEngine(
+        params, cfg,
+        max_slots=int(spec.get("max_slots", 8)),
+        block_size=int(spec.get("block_size", 16)),
+        num_blocks=(int(spec["num_blocks"])
+                    if spec.get("num_blocks") is not None else None),
+        prefill_chunk=int(spec.get("prefill_chunk", 64)),
+        max_seq_len=max_seq,
+        attn_impl=spec.get("attn_impl", "gather"),
+    )
+    engine.provenance = provenance
+    engine.model_name = name
+    return engine
+
+
+def _bind_port(host: str, port: int) -> socket.socket:
+    """Bind the declared port, falling back to an ephemeral one when it's
+    taken — replicas of one service share a loopback host under the
+    FakeCluster (a real cluster gives each pod its own IP), so replica 0
+    owns the declared (portforward-stamped) port and the rest publish
+    their actual port through the endpoint file + run outputs."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        s.bind((host, port))
+    except OSError:
+        s.bind((host, 0))
+    return s
+
+
+class ServeReporter(threading.Thread):
+    """Ships engine traffic to the control plane every ``interval``:
+    heartbeat ``serve`` payload (always) + run outputs (replica 0, so
+    concurrent replicas don't clobber each other's keys)."""
+
+    def __init__(self, run, engine, *, interval: float = 2.0,
+                 replica: int = 0, port: int = 0):
+        super().__init__(daemon=True, name="serve-reporter")
+        self.tracked = run
+        self.engine = engine
+        self.interval = interval
+        self.replica = replica
+        self.port = port
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.report_once()  # final flush
+
+    def report_once(self) -> None:
+        snap = self.engine.snapshot()
+        obs = self.engine.drain_observations()
+        payload = {**snap, **obs, "replica": self.replica}
+        try:
+            self.tracked.heartbeat(serve=payload)
+        except Exception:
+            pass  # spool/retry live inside tracking; never kill serving
+        if self.replica == 0:
+            outputs = {
+                "serve_requests_total": snap["requests_total"],
+                "serve_tokens_total": snap["tokens_total"],
+                "serve_tokens_per_sec": round(snap["tokens_per_sec"], 3),
+                "serve_ttft_p50_ms": snap["ttft_p50_ms"],
+                "serve_ttft_p95_ms": snap["ttft_p95_ms"],
+                "serve_intertoken_p50_ms": snap["intertoken_p50_ms"],
+                "serve_intertoken_p95_ms": snap["intertoken_p95_ms"],
+                "serve_running": snap["running"],
+                "serve_waiting": snap["waiting"],
+                "serve_kv_block_utilization": round(
+                    snap["kv_blocks_used"]
+                    / max(snap["kv_blocks_total"], 1), 4),
+                "serve_port": self.port,
+                "serve_replica": self.replica,
+            }
+            try:
+                self.tracked.log_outputs(**{
+                    k: v for k, v in outputs.items() if v is not None})
+            except Exception:
+                pass
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.report_once()
+
+
+def run_serve(spec: dict[str, Any]) -> None:
+    platform = spec.get("platform")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+        if spec.get("num_cpu_devices"):
+            try:
+                jax.config.update(
+                    "jax_num_cpu_devices", int(spec["num_cpu_devices"]))
+            except AttributeError:
+                flags = os.environ.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    os.environ["XLA_FLAGS"] = (
+                        flags + " --xla_force_host_platform_device_count="
+                        f"{int(spec['num_cpu_devices'])}").strip()
+
+    import asyncio
+
+    from aiohttp import web
+
+    from .. import tracking
+    from .server import build_app
+
+    engine = build_engine(spec)
+    engine.start()
+
+    replica = int(os.environ.get("PLX_REPLICA_INDEX", "0"))
+    run = tracking.get_run() if os.environ.get("PLX_RUN_UUID") else None
+
+    bind = spec.get("bind", "127.0.0.1")
+    port = int(spec.get("port", DEFAULT_SERVE_PORT))
+    sock = _bind_port(bind, port)
+    actual_port = sock.getsockname()[1]
+    app = build_app(engine, model_name=engine.model_name)
+
+    # publish the actual endpoint (replicas past 0 land on ephemeral
+    # ports under the FakeCluster's shared loopback)
+    if run is not None:
+        endpoint = {"replica": replica, "port": actual_port,
+                    "pid": os.getpid(), "at": time.time()}
+        path = os.path.join(run.run_dir, f"serve-endpoint-{replica}.json")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(endpoint, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    reporter = None
+    if run is not None:
+        run.log_status("running", reason="Serving",
+                       message=f"replica {replica} on port {actual_port}")
+        reporter = ServeReporter(
+            run, engine, interval=float(spec.get("report_interval", 2.0)),
+            replica=replica, port=actual_port)
+        reporter.start()
+
+    stop_event = threading.Event()
+
+    def _graceful(_sig, _frm):
+        stop_event.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+    print(json.dumps({"serving": {"model": engine.model_name,
+                                  "replica": replica,
+                                  "port": actual_port,
+                                  **getattr(engine, "provenance", {})}}),
+          flush=True)
+
+    async def _serve():
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.SockSite(runner, sock)
+        await site.start()
+        while not stop_event.is_set():
+            await asyncio.sleep(0.2)
+        await runner.cleanup()
+
+    asyncio.run(_serve())
+    engine.stop()
+    if reporter is not None:
+        reporter.stop()  # final traffic flush
+    if run is not None:
+        # flush telemetry but do NOT drive the run's lifecycle: the run is
+        # shared by every replica, and this SIGTERM may be one replica
+        # being scaled down — a terminal status from here would tear down
+        # the surviving replicas. The control plane owns run lifecycle.
+        run.flush()
+
+
+def main() -> None:
+    raw = os.environ.get("PLX_SERVE_SPEC")
+    if not raw:
+        raise SystemExit("PLX_SERVE_SPEC not set")
+    run_serve(json.loads(raw))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""aiohttp front for a :class:`ServeEngine` — the pod-side `/generate`
+endpoint (ISSUE 9 tentpole (3)).
+
+Routes:
+    POST /generate   {"prompt": "text"} or {"tokens": [ints]}, plus
+                     per-request sampling params (max_new_tokens,
+                     temperature, top_k, seed, stop_token) and
+                     "stream": true for NDJSON token streaming.
+    GET  /healthz    liveness + engine gauges
+    GET  /stats      engine traffic snapshot (JSON twin of /metrics)
+    GET  /metrics    pod-local Prometheus families (polyaxon_serve_*)
+
+Tokenization: the model zoo has no external tokenizer; byte-vocab models
+(vocab_size == 256, llama-tiny's serving config) treat prompt text as its
+UTF-8 bytes and detokenize generated ids back through latin-1. Larger
+vocabs accept/return raw token ids only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from .engine import SamplingParams, ServeEngine
+
+
+def encode_prompt(body: dict, vocab_size: int) -> list[int]:
+    if body.get("tokens") is not None:
+        return [int(t) for t in body["tokens"]]
+    prompt = body.get("prompt")
+    if prompt is None:
+        raise ValueError("body needs 'prompt' (text) or 'tokens' (ids)")
+    return [b % vocab_size for b in str(prompt).encode("utf-8")]
+
+
+def decode_tokens(tokens: list[int], vocab_size: int) -> Optional[str]:
+    if vocab_size != 256:
+        return None
+    return bytes(t % 256 for t in tokens).decode("latin-1")
+
+
+def _request_stats(req) -> dict:
+    total_s = ((req.finished_at or time.monotonic()) - req.created_at)
+    decode_s = None
+    if req.first_token_at is not None and req.last_token_at is not None:
+        decode_s = req.last_token_at - req.first_token_at
+    n = len(req.out_tokens)
+    return {
+        "num_tokens": n,
+        "ttft_ms": (round(req.ttft_s * 1e3, 3)
+                    if req.ttft_s is not None else None),
+        "total_ms": round(total_s * 1e3, 3),
+        # steady-state decode rate (first token excluded: it pays prefill)
+        "tokens_per_sec": (round((n - 1) / decode_s, 3)
+                           if decode_s and n > 1 else None),
+    }
+
+
+def build_app(engine: ServeEngine, *, metrics=None,
+              model_name: str = "") -> web.Application:
+    registry = metrics if metrics is not None else engine.metrics
+    vocab = engine.cfg.vocab_size
+
+    async def generate(request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON body"},
+                                     status=400)
+        if not isinstance(body, dict):
+            return web.json_response({"error": "body must be an object"},
+                                     status=400)
+        try:
+            tokens = encode_prompt(body, vocab)
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        sp = SamplingParams.from_dict(body)
+        req = engine.submit(tokens, sp)
+        if req.state == "failed":
+            return web.json_response({"error": req.error}, status=400)
+        loop = asyncio.get_running_loop()
+
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "application/x-ndjson"})
+            await resp.prepare(request)
+            while True:
+                tok = await loop.run_in_executor(None, req.stream.get)
+                if tok is None:
+                    break
+                await resp.write(
+                    (json.dumps({"token": tok}) + "\n").encode())
+            final = {"done": True, "tokens": req.out_tokens,
+                     **_request_stats(req)}
+            text = decode_tokens(req.out_tokens, vocab)
+            if text is not None:
+                final["text"] = text
+            if req.error:
+                final["error"] = req.error
+            await resp.write((json.dumps(final) + "\n").encode())
+            await resp.write_eof()
+            return resp
+
+        # non-streaming: drain off the event loop
+        def _drain():
+            while req.stream.get() is not None:
+                pass
+
+        await loop.run_in_executor(None, _drain)
+        if req.error:
+            return web.json_response({"error": req.error}, status=500)
+        out = {"tokens": req.out_tokens, **_request_stats(req)}
+        text = decode_tokens(req.out_tokens, vocab)
+        if text is not None:
+            out["text"] = text
+        return web.json_response(out)
+
+    async def healthz(_request) -> web.Response:
+        return web.json_response({
+            "ok": True, "model": model_name,
+            "running": engine.running_count,
+            "waiting": engine.waiting_count,
+        })
+
+    async def stats(_request) -> web.Response:
+        return web.json_response(engine.snapshot())
+
+    async def metrics_endpoint(_request) -> web.Response:
+        return web.Response(text=registry.render(),
+                            content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_post("/generate", generate)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/stats", stats)
+    app.router.add_get("/metrics", metrics_endpoint)
+    return app

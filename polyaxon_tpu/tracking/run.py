@@ -179,7 +179,8 @@ class Run:
 
     def heartbeat(self, step: Optional[int] = None,
                   anomalies: Optional[dict] = None,
-                  rollbacks: Optional[int] = None) -> None:
+                  rollbacks: Optional[int] = None,
+                  serve: Optional[dict] = None) -> None:
         """Renew this run's liveness lease (spooled through an outage so
         the post-failover reaper sees the replayed beats, not a corpse).
 
@@ -195,7 +196,12 @@ class Run:
             kw["anomalies"] = {k: int(v) for k, v in anomalies.items()}
         if rollbacks:
             kw["rollbacks"] = int(rollbacks)
-        if anomalies or rollbacks:
+        if serve is not None:
+            # serve traffic snapshot (ISSUE 9): cumulative counters +
+            # instantaneous gauges + drained TTFT/inter-token samples; the
+            # store deltas/aggregates per reporter incarnation
+            kw["serve"] = dict(serve)
+        if anomalies or rollbacks or serve is not None:
             kw["incarnation"] = self.incarnation
         self._api("heartbeat", **kw)
 

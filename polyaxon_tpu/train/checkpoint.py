@@ -41,19 +41,34 @@ class CheckpointConfig:
 
 
 class Checkpointer:
-    """Thin wrapper over orbax CheckpointManager for train-state pytrees."""
+    """Thin wrapper over orbax CheckpointManager for train-state pytrees.
 
-    def __init__(self, cfg: CheckpointConfig):
+    ``read_only=True`` is the SERVING mode (ISSUE 9 satellite): N inference
+    replicas restoring the same manifest concurrently must be pure readers —
+    no manifest backfill, no torn-step purge, no quarantine copy, no
+    max_to_keep GC. A training pod owns its checkpoint dir and may heal it;
+    a serving pod merely borrows it (possibly while the training run is
+    still writing), so every side-effecting verb either no-ops or raises.
+    """
+
+    def __init__(self, cfg: CheckpointConfig, read_only: bool = False):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.cfg = cfg
+        self.read_only = read_only
         self.directory = os.path.abspath(cfg.directory)
-        os.makedirs(cfg.directory, exist_ok=True)
+        if not read_only:
+            os.makedirs(cfg.directory, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
             save_interval_steps=cfg.save_interval_steps,
-            max_to_keep=cfg.max_to_keep,
+            # a reader must never rotate the writer's steps out
+            max_to_keep=None if read_only else cfg.max_to_keep,
             enable_async_checkpointing=cfg.async_save,
+            # ...nor mkdir a tree it doesn't own (orbax defaults to
+            # create=True; a typo'd serve path must fail loudly, not
+            # materialize an empty dir on shared storage)
+            create=not read_only,
         )
         self.manager = ocp.CheckpointManager(self.directory, options=options)
         # serializes manifest flushes: the background flush thread vs the
@@ -63,6 +78,8 @@ class Checkpointer:
 
     def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
         """Save if the interval policy says so. Async: returns immediately."""
+        if self.read_only:
+            raise RuntimeError("read-only Checkpointer cannot save")
         saved = self.manager.save(
             step, args=self._ocp.args.StandardSave(state), force=force
         )
@@ -122,6 +139,8 @@ class Checkpointer:
         A step that finalizes while a flush is mid-run is picked up by
         the next flush (next save, wait(), close(), or — after a crash —
         the restarted process's backfill)."""
+        if self.read_only:
+            return
         if not self.cfg.async_save:
             self._flush_manifests()
             return
@@ -140,7 +159,12 @@ class Checkpointer:
         means the pure-digit dir's presence IS save completion, so a step
         finalized right before a crash gets its manifest backfilled by
         the restarted process instead of being mistaken for torn (and
-        purged) just because the old process died pre-flush."""
+        purged) just because the old process died pre-flush.
+
+        Read-only (serving) mode: no-op — a reader may not write manifests
+        into (or GC manifests out of) a directory it doesn't own."""
+        if self.read_only:
+            return
         with self._flush_lock:
             live = set(self.manager.all_steps())
             for step in sorted(live):
@@ -247,6 +271,32 @@ class Checkpointer:
             f"No restorable checkpoint under {self.cfg.directory}; "
             f"every candidate failed: {errors}")
 
+    def restore_raw(self, step: Optional[int] = None) -> tuple[Any, int]:
+        """Restore the newest COMPLETE step (or the given one) WITHOUT an
+        abstract target: arrays come back as saved (host layout). The
+        serving path uses this — an inference replica wants ``params`` and
+        has no optimizer with which to rebuild the TrainState structure an
+        abstract restore would demand. Same torn-step fallback walk as
+        :meth:`restore`; combined with ``read_only=True`` it is entirely
+        side-effect free."""
+        candidates = [step] if step is not None else self.complete_steps_desc()
+        if not candidates:
+            raise FileNotFoundError(
+                f"No complete checkpoint under {self.cfg.directory}")
+        errors: list = []
+        for s in candidates:
+            try:
+                restored = self.manager.restore(
+                    s, args=self._ocp.args.StandardRestore())
+                return restored, s
+            except Exception as e:
+                if step is not None:
+                    raise
+                errors.append((s, repr(e)))
+        raise FileNotFoundError(
+            f"No restorable checkpoint under {self.cfg.directory}; "
+            f"every candidate failed: {errors}")
+
     def _purge_newer_than(self, step: int) -> None:
         """Remove every step NEWER than the one we restored (``-1``:
         every step — the all-candidates-failed fresh start) — leaving
@@ -257,7 +307,13 @@ class Checkpointer:
         bad (possibly a transient I/O error, not corruption) is copied
         to a ``quarantine-<step>`` dir first, so the run's newest state
         stays recoverable by hand instead of being irreversibly
-        discarded on a one-off fault."""
+        discarded on a one-off fault.
+
+        Read-only (serving) mode: no-op — a reader restoring an older step
+        must not delete the training run's newer steps out from under it;
+        the purge is a WRITER's save-collision guard."""
+        if self.read_only:
+            return
         import shutil
 
         for bad in [s for s in self.manager.all_steps() if s > step]:

@@ -877,6 +877,182 @@ def _run_store_outage_mode(args) -> int:
     return 0 if ok else 1
 
 
+def _serve_autoscale_spec(min_r: int, max_r: int, per: int,
+                          down_after: float) -> dict:
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "serve-soak",
+        "component": {"kind": "component", "run": {
+            "kind": "service",
+            "ports": [18099],
+            "container": {
+                "name": "main", "image": "python:3.12",
+                "command": [sys.executable, "-c",
+                            "import time; time.sleep(600)"],
+            },
+            "autoscale": {"min_replicas": min_r, "max_replicas": max_r,
+                          "target_per_replica": per,
+                          "scale_down_after_s": down_after},
+        }},
+    }).to_dict()
+
+
+def run_serve_traffic_soak(workdir: str, seed: int = 2024,
+                           lease_ttl: float = 0.8,
+                           capacity_chips: int = 3,
+                           kill_mid_ramp: bool = True,
+                           timeout: float = 120.0) -> dict:
+    """Traffic-driven autoscale soak (ISSUE 9): one `kind: service` run
+    with ``autoscale {min 1, max 4, target_per_replica 2}`` under a
+    synthetic traffic ramp 0 -> 4 -> 8 -> 0 concurrent requests, injected
+    as serve heartbeats (the exact payload real serve pods emit). The
+    replica count must follow the ramp in BOTH directions, the chip
+    budget (3) must clamp the peak (demand asks for 4 replicas, budget
+    allows 3 — never exceeded), and a hard agent kill mid-ramp must
+    converge through the successor's resync with ZERO duplicate pod
+    launches. Timeline + audit counters returned for the caller to gate
+    on. ``timeout`` scales every internal budget (launch wait, per-phase
+    convergence) — raise it on slow machines."""
+    from polyaxon_tpu.api.store import Store
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    rng = random.Random(seed)
+    store = Store(":memory:")
+    store.create_project("p")
+    cluster = FakeCluster(os.path.join(workdir, ".cluster"))
+
+    def new_agent():
+        a = LocalAgent(store, workdir, backend="cluster", cluster=cluster,
+                       poll_interval=0.05, lease_ttl=lease_ttl,
+                       capacity_chips=capacity_chips, max_parallel=8)
+        a.autoscale_interval = 0.1
+        return a.start()
+
+    def pods() -> int:
+        return len([s for s in cluster.pod_statuses(
+            {"app.polyaxon.com/run": uuid}) if not s.terminating])
+
+    def chips_of_live_pods() -> int:
+        return pods()  # one chip per service replica
+
+    agent = new_agent()
+    timeline: list[dict] = []
+    max_pods_seen = 0
+    try:
+        spec = _serve_autoscale_spec(1, 4, 2, down_after=1.0)
+        uuid = store.create_run("p", spec=spec, name="serve-soak")["uuid"]
+        deadline = time.monotonic() + timeout / 4
+        while time.monotonic() < deadline:
+            if store.get_run(uuid)["status"] == "running" and pods() >= 1:
+                break
+            time.sleep(0.1)
+        assert pods() == 1, f"service never launched: {pods()} pods"
+
+        def drive(level: int, expect: int, budget: float,
+                  kill_at: "float | None" = None) -> bool:
+            """Beat traffic at ``level`` until the replica count reaches
+            ``expect`` (or budget runs out); optionally hard-kill the
+            agent partway through."""
+            nonlocal agent, max_pods_seen
+            t_end = time.monotonic() + budget
+            killed = kill_at is None
+            t_kill = time.monotonic() + (kill_at or 0)
+            while time.monotonic() < t_end:
+                store.heartbeat(uuid, serve={
+                    "running": level, "waiting": 0,
+                    "kv_blocks_used": level, "kv_blocks_total": 32,
+                    "requests_total": 0, "tokens_total": 0,
+                }, incarnation="soak-traffic")
+                if not killed and time.monotonic() >= t_kill:
+                    killed = True
+                    agent.hard_kill()
+                    agent = new_agent()  # standby -> TTL -> takeover
+                n = pods()
+                max_pods_seen = max(max_pods_seen, n)
+                timeline.append({"t": round(time.monotonic(), 3),
+                                 "level": level, "pods": n})
+                if n == expect and killed:
+                    return True
+                time.sleep(0.1)
+            return pods() == expect
+
+        ramp_ok = []
+        # ramp up: 4 concurrent -> 2 replicas
+        ramp_ok.append(("up-4", drive(4, 2, timeout / 6)))
+        # mid-ramp kill while pushing to peak: 8 concurrent wants 4
+        # replicas, the 3-chip budget clamps at 3
+        ramp_ok.append(("up-8-clamped+kill", drive(
+            8, 3, timeout / 2, kill_at=rng.uniform(0.2, 0.8)
+            if kill_mid_ramp else None)))
+        # ramp down: sustained zero traffic drains to min
+        ramp_ok.append(("down-0", drive(0, 1, timeout / 4)))
+
+        meta = (store.get_run(uuid).get("meta") or {})
+        return {
+            "ramp": ramp_ok,
+            "converged": all(ok for _, ok in ramp_ok),
+            "max_pods_seen": max_pods_seen,
+            "budget_exceeded": max_pods_seen > capacity_chips,
+            "final_replicas": pods(),
+            "stored_target": (meta.get("autoscale") or {}).get("replicas"),
+            "duplicate_applies": list(cluster.duplicate_applies),
+            "launch_counts": dict(cluster.launch_counts),
+            "fence_rejections": store.stats["fence_rejections"],
+            "timeline": timeline[-50:],
+            "metrics_text": store.metrics.render(),
+        }
+    finally:
+        try:
+            store.transition(uuid, "stopping")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and store.get_run(
+                    uuid)["status"] != "stopped":
+                time.sleep(0.1)
+        except Exception:
+            pass
+        agent.stop()
+        cluster.shutdown()
+
+
+def _run_serve_traffic_mode(args) -> int:
+    from polyaxon_tpu.obs.metrics import parse_prometheus
+
+    root = tempfile.mkdtemp(prefix="plx-serve-soak-")
+    ok = True
+    final_scrape = ""
+    try:
+        for i in range(args.rounds):
+            out = run_serve_traffic_soak(
+                os.path.join(root, f"round-{i}"), seed=args.seed + i,
+                lease_ttl=args.lease_ttl, timeout=args.timeout)
+            final_scrape = out.pop("metrics_text")
+            fams = parse_prometheus(final_scrape)  # validates strictly
+            round_ok = (out["converged"]
+                        and not out["budget_exceeded"]
+                        and out["final_replicas"] == 1
+                        and not out["duplicate_applies"])
+            ok = ok and round_ok
+            print(json.dumps({
+                "round": i, "ok": round_ok,
+                **{k: v for k, v in out.items() if k != "timeline"},
+                "autoscale_events": fams.get(
+                    "polyaxon_autoscale_events_total", {}).get(
+                    "polyaxon_autoscale_events_total"),
+            }))
+    finally:
+        if args.keep:
+            print(json.dumps({"workdir": root}))
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+    if args.metrics_dump:
+        _dump_metrics(args.metrics_dump, final_scrape)
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
 def _dump_metrics(path: str, text: str) -> None:
     """Archive the final /metrics scrape of the last round (validated
     Prometheus text) so every soak leaves a machine-readable telemetry
@@ -1002,6 +1178,12 @@ def main() -> int:
                         "with the uninterrupted oracle, with the "
                         "polyaxon_train_*/stalled-reap families matching "
                         "the audit trail via the strict /metrics scrape")
+    p.add_argument("--serve-traffic", action="store_true",
+                   help="autoscale soak (ISSUE 9): a `kind: service` run "
+                        "under a synthetic traffic ramp — replicas must "
+                        "follow the ramp both directions within the chip "
+                        "budget, surviving a mid-ramp agent kill with "
+                        "zero duplicate launches")
     p.add_argument("--store-outage", action="store_true",
                    help="store-survivability soak (ISSUE 7): kill the "
                         "PRIMARY STORE mid-wave under a sharded agent "
@@ -1022,6 +1204,8 @@ def main() -> int:
 
     if args.train_faults:
         return _run_train_faults_mode(args)
+    if args.serve_traffic:
+        return _run_serve_traffic_mode(args)
     if args.store_outage:
         return _run_store_outage_mode(args)
     if (args.kill_agent or args.split_brain or args.rolling_kill

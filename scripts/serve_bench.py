@@ -1,0 +1,256 @@
+"""Online-inference load generator (ISSUE 9 CI satellite).
+
+Two layers, one JSON artifact (bench_artifacts/serve_bench_rXX.json):
+
+- **Engine sweep** (default): llama-tiny on CPU, a concurrency sweep over
+  the continuous-batching engine — for each width C: ``requests`` prompts
+  admitted at once against ``max_slots=C``, measuring decode tokens/s,
+  TTFT p50/p95, and per-request wall time. C=1 is the *sequential*
+  baseline (one request holds the engine end-to-end), so
+  ``batched_vs_sequential`` is the honest iteration-level-batching win:
+  same engine, same kernels, only the batch width changes.
+- **Orchestrated probe** (``--orchestrated``): the same numbers read from
+  a REAL `kind: service` run's own outputs and the control plane's
+  ``/metrics`` scrape — store → agent → operator pod → serve runtime →
+  HTTP load → heartbeat traffic bridge. Proves the meters flowing through
+  the product match the bench-side measurement.
+
+Usage:
+    python scripts/serve_bench.py [--requests N] [--max-new M]
+        [--prompt-len P] [--sweep 1,2,4,8] [--orchestrated] [--out PATH]
+
+Importable: ``run_engine_bench(...)`` / ``run_sweep(...)`` return the same
+dicts — the tier-1 smoke runs a scaled-down sweep through them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _quant(vals, q):
+    if not vals:
+        return None
+    vs = sorted(vals)
+    return vs[min(int(round(q * (len(vs) - 1))), len(vs) - 1)]
+
+
+def run_engine_bench(concurrency: int, *, requests: int = 16,
+                     prompt_len: int = 24, max_new: int = 32,
+                     block_size: int = 16, seed: int = 0,
+                     params=None, cfg=None, warmup: int = 2) -> dict:
+    """One sweep point: ``requests`` prompts against a width-
+    ``concurrency`` engine. Decode throughput excludes the warmup
+    requests (jit compile) but includes queueing — that's what a user
+    sees."""
+    import jax
+    import numpy as np
+
+    from polyaxon_tpu.models import REGISTRY, transformer as T
+    from polyaxon_tpu.serve.engine import SamplingParams, ServeEngine
+
+    if cfg is None:
+        _, cfg = REGISTRY["llama-tiny"]
+    if params is None:
+        params = T.init(jax.random.PRNGKey(seed), cfg)
+    rng = np.random.default_rng(seed)
+    max_seq = prompt_len + max_new + 8
+    engine = ServeEngine(params, cfg, max_slots=concurrency,
+                         block_size=block_size,
+                         prefill_chunk=min(prompt_len, 32),
+                         max_seq_len=max_seq)
+    sp = SamplingParams(max_new_tokens=max_new)
+
+    def _drive(reqs):
+        while not all(r.state in ("done", "failed") for r in reqs):
+            engine.step()
+
+    # warmup: compile prefill + decode shapes
+    _drive([engine.submit(
+        [int(t) for t in rng.integers(1, cfg.vocab_size, prompt_len)], sp)
+        for _ in range(min(warmup, concurrency) or 1)])
+
+    prompts = [[int(t) for t in rng.integers(1, cfg.vocab_size, prompt_len)]
+               for _ in range(requests)]
+    t0 = time.perf_counter()
+    reqs = [engine.submit(p, sp) for p in prompts]
+    _drive(reqs)
+    wall = time.perf_counter() - t0
+    assert all(r.state == "done" for r in reqs)
+    total_tokens = sum(len(r.out_tokens) for r in reqs)
+    ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+    per_req_wall = [r.finished_at - r.created_at for r in reqs]
+    return {
+        "concurrency": concurrency,
+        "requests": requests,
+        "prompt_len": prompt_len,
+        "max_new": max_new,
+        "wall_s": round(wall, 4),
+        "tokens": total_tokens,
+        "tokens_per_sec": round(total_tokens / wall, 2),
+        "ttft_p50_ms": round(_quant(ttfts, 0.5) * 1e3, 2),
+        "ttft_p95_ms": round(_quant(ttfts, 0.95) * 1e3, 2),
+        "req_wall_p50_s": round(_quant(per_req_wall, 0.5), 4),
+    }
+
+
+def run_sweep(widths=(1, 2, 4, 8), **kw) -> dict:
+    """Full sweep sharing one set of weights; adds the batched-vs-
+    sequential ratio (widest point over the width-1 baseline)."""
+    import jax
+
+    from polyaxon_tpu.models import REGISTRY, transformer as T
+
+    _, cfg = REGISTRY["llama-tiny"]
+    params = T.init(jax.random.PRNGKey(kw.get("seed", 0)), cfg)
+    rows = [run_engine_bench(c, params=params, cfg=cfg, **kw)
+            for c in widths]
+    base = rows[0]["tokens_per_sec"]
+    widest = rows[-1]["tokens_per_sec"]
+    return {
+        "kind": "serve_bench",
+        "model": "llama-tiny",
+        "platform": "cpu",
+        "rows": rows,
+        "batched_vs_sequential": round(widest / base, 2) if base else None,
+    }
+
+
+def run_orchestrated_probe(requests: int = 8, max_new: int = 16,
+                           timeout: float = 300.0) -> dict:
+    """Launch a real `kind: service` run and read the SAME meters back
+    from the run's outputs and the control plane's /metrics scrape."""
+    import socket
+    import tempfile
+    import threading
+
+    import requests as rq
+
+    from polyaxon_tpu.api.server import ApiServer
+    from polyaxon_tpu.client import RunClient
+    from polyaxon_tpu.obs.metrics import parse_prometheus
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    tmp = tempfile.mkdtemp(prefix="serve_bench_")
+    srv = ApiServer(db_path=":memory:", artifacts_root=tmp, port=0).start()
+    agent = LocalAgent(srv.store, artifacts_root=tmp, api_host=srv.url,
+                       backend="cluster", poll_interval=0.05)
+    agent.start()
+    rc = RunClient(srv.url, project="serve-bench")
+    op = check_polyaxonfile({
+        "kind": "operation", "name": "serve-bench",
+        "component": {"kind": "component", "run": {
+            "kind": "service", "ports": [port],
+            "runtime": {"model": "llama-tiny", "platform": "cpu",
+                        "port": port, "max_slots": 8, "block_size": 16,
+                        "max_seq_len": 128, "prefill_chunk": 32,
+                        "report_interval": 0.5}}},
+    })
+    run = rc.create(operation=op)
+    url = f"http://127.0.0.1:{port}"
+    try:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                if rq.get(f"{url}/healthz", timeout=1).ok:
+                    break
+            except rq.RequestException:
+                time.sleep(0.5)
+        else:
+            raise RuntimeError("serve pod never came up")
+        latencies = []
+
+        def _one(i):
+            t0 = time.perf_counter()
+            r = rq.post(f"{url}/generate", json={
+                "tokens": list(range(2, 26)),
+                "max_new_tokens": max_new}, timeout=timeout)
+            r.raise_for_status()
+            latencies.append((time.perf_counter() - t0, r.json()))
+
+        threads = [threading.Thread(target=_one, args=(i,))
+                   for i in range(requests)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+        wall = time.perf_counter() - t0
+        # wait for the traffic bridge to flush into outputs
+        outputs = {}
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            outputs = srv.store.get_run(run["uuid"]).get("outputs") or {}
+            if outputs.get("serve_requests_total", 0) >= requests:
+                break
+            time.sleep(0.5)
+        fams = parse_prometheus(rq.get(srv.url + "/metrics", timeout=5).text)
+        return {
+            "requests": requests,
+            "wall_s": round(wall, 3),
+            "client_tokens_per_sec": round(
+                sum(len(r["tokens"]) for _, r in latencies) / wall, 2),
+            "outputs": {k: outputs.get(k) for k in (
+                "serve_requests_total", "serve_tokens_total",
+                "serve_tokens_per_sec", "serve_ttft_p50_ms",
+                "serve_ttft_p95_ms")},
+            "metrics_scrape": {
+                "requests_total": fams["polyaxon_serve_requests_total"][
+                    "polyaxon_serve_requests_total"],
+                "tokens_total": fams[
+                    "polyaxon_serve_generated_tokens_total"][
+                    "polyaxon_serve_generated_tokens_total"],
+                "ttft_count": fams["polyaxon_serve_ttft_seconds"][
+                    "polyaxon_serve_ttft_seconds_count"],
+            },
+        }
+    finally:
+        try:
+            rc.stop(run["uuid"])
+            time.sleep(1.0)
+        except Exception:
+            pass
+        agent.stop()
+        srv.stop()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--prompt-len", type=int, default=24)
+    p.add_argument("--sweep", default="1,2,4,8")
+    p.add_argument("--orchestrated", action="store_true",
+                   help="also probe a real service run (outputs + scrape)")
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    widths = tuple(int(w) for w in args.sweep.split(","))
+    out = run_sweep(widths, requests=args.requests,
+                    prompt_len=args.prompt_len, max_new=args.max_new)
+    if args.orchestrated:
+        out["orchestrated"] = run_orchestrated_probe(
+            requests=min(args.requests, 8), max_new=args.max_new)
+    out["host"] = {"cpus": os.cpu_count()}
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -408,6 +408,33 @@ class TestAgentKillSoak:
         assert all(c >= 1 for c in out["launch_counts"].values()), out
 
 
+class TestServeTrafficSoak:
+    def test_autoscale_tracks_ramp_through_agent_kill(self, tmp_path):
+        """ISSUE 9 acceptance soak: a `kind: service` run with autoscale
+        {min 1, max 4, target 2/replica} under a synthetic traffic ramp
+        0 -> 4 -> 8 -> 0 (injected as the serve-heartbeat payloads real
+        pods emit). Replica count must track the ramp BOTH directions,
+        the 3-chip budget must clamp the peak (demand asks 4), and a
+        hard agent kill mid-ramp must converge through the successor's
+        resync with zero duplicate pod launches."""
+        from chaos_soak import run_serve_traffic_soak
+
+        out = run_serve_traffic_soak(str(tmp_path / "serve"), seed=2024,
+                                     lease_ttl=0.8, capacity_chips=3)
+        assert out["converged"], out["ramp"]
+        assert out["max_pods_seen"] == 3, out  # clamped peak, reached
+        assert not out["budget_exceeded"], out
+        assert out["final_replicas"] == 1, out
+        assert out["stored_target"] == 1, out
+        assert out["duplicate_applies"] == [], out
+        # the scrape validates strictly and carries the scale events
+        from polyaxon_tpu.obs.metrics import parse_prometheus
+
+        fams = parse_prometheus(out["metrics_text"])
+        assert fams["polyaxon_autoscale_events_total"][
+            "polyaxon_autoscale_events_total"] >= 3
+
+
 class TestStoreOutageSoak:
     def test_store_kill_under_sharded_fleet_converges(self, tmp_path):
         """ISSUE 7 acceptance soak: the PRIMARY STORE HOST is killed

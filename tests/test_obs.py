@@ -63,6 +63,17 @@ EXPECTED_FAMILIES = {
     "polyaxon_train_anomalies_total",
     "polyaxon_train_rollbacks_total",
     "polyaxon_run_stalled_reaps_total",
+    # online serving (ISSUE 9): heartbeat-fed traffic families — the
+    # autoscaler's control signal — plus the agent's target gauge
+    "polyaxon_serve_requests_total",
+    "polyaxon_serve_generated_tokens_total",
+    "polyaxon_serve_running_requests",
+    "polyaxon_serve_waiting_requests",
+    "polyaxon_serve_kv_block_utilization",
+    "polyaxon_serve_ttft_seconds",
+    "polyaxon_serve_intertoken_seconds",
+    "polyaxon_serve_target_replicas",
+    "polyaxon_autoscale_events_total",
 }
 
 

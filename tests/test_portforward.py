@@ -208,7 +208,9 @@ def test_portforward_restricts_to_declared_ports(tmp_path):
         r = requests.get(
             srv.url + f"/api/v1/p/runs/{run['uuid']}/portforward?port=22",
             timeout=5)
-        assert r.status_code == 403
+        # 404 (ISSUE 9 satellite): an undeclared port "does not exist" on
+        # this service — no hint about what IS listening on the agent host
+        assert r.status_code == 404
         assert "declared" in r.json()["error"]
     finally:
         srv.stop()
@@ -250,7 +252,7 @@ def test_portforward_ignores_spec_declared_ports(tmp_path):
         r = requests.get(
             srv.url + f"/api/v1/p/runs/{run['uuid']}/portforward?port=22",
             timeout=5)
-        assert r.status_code == 403
+        assert r.status_code == 404
     finally:
         srv.stop()
 

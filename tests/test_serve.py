@@ -1,0 +1,1058 @@
+"""Online inference runtime (ISSUE 9): paged-KV parity, continuous
+batching, serve HTTP, traffic accounting, and the autoscale control loop.
+
+The acceptance-bearing suite is :class:`TestPagedDecodeParity` — paged-KV
+decode logits must be BIT-EXACT against the dense (contiguous-cache)
+decode path, including block-boundary sequence lengths, eviction + block
+reuse, and ragged batches — plus the CPU e2e smoke
+(:class:`TestServeServiceE2E`): a `kind: service` run launches through
+store → agent → operator pod, serves two concurrent ``/generate``
+requests, and its outputs carry tokens/s + TTFT.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from polyaxon_tpu.models import REGISTRY
+from polyaxon_tpu.models import transformer as T
+from polyaxon_tpu.ops.paged_attention import (
+    dense_decode_attention, gather_blocks, paged_attention,
+)
+from polyaxon_tpu.serve.engine import SamplingParams, ServeEngine, sample_token
+from polyaxon_tpu.serve.kv_cache import (
+    BlockAllocator, OutOfBlocksError, PagedKVCache, SequenceBlocks,
+)
+from polyaxon_tpu.serve.model import decode_step, init_cache, prefill_chunk
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    _, cfg = REGISTRY["llama-tiny"]
+    params = T.init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+# -- allocator ---------------------------------------------------------------
+
+
+class TestBlockAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = BlockAllocator(4)
+        ids = a.alloc(3)
+        assert len(set(ids)) == 3 and a.free_count == 1
+        a.free(ids)
+        assert a.free_count == 4 and a.used_count == 0
+
+    def test_lifo_reuse(self):
+        a = BlockAllocator(4)
+        first = a.alloc(2)
+        a.free(first)
+        again = a.alloc(2)
+        # recently-freed blocks circulate first (cache-warm reuse)
+        assert set(again) == set(first)
+
+    def test_out_of_blocks_allocates_nothing(self):
+        a = BlockAllocator(2)
+        a.alloc(1)
+        with pytest.raises(OutOfBlocksError):
+            a.alloc(2)
+        assert a.free_count == 1  # the failed alloc took nothing
+
+    def test_cache_ensure_and_release(self):
+        cache = PagedKVCache(num_layers=2, num_blocks=4, block_size=4,
+                             kv_heads=2, head_dim=8)
+        seq = SequenceBlocks()
+        cache.ensure(seq, 9)   # 3 blocks
+        assert len(seq.block_ids) == 3
+        cache.ensure(seq, 11)  # still 3
+        assert len(seq.block_ids) == 3
+        cache.release(seq)
+        assert cache.allocator.used_count == 0 and seq.block_ids == []
+
+    def test_trash_block_never_allocated(self):
+        cache = PagedKVCache(num_layers=1, num_blocks=3, block_size=2,
+                             kv_heads=1, head_dim=4)
+        seq = SequenceBlocks()
+        cache.ensure(seq, 6)
+        assert cache.trash_block not in seq.block_ids
+        assert cache.k.shape[1] == 4  # pool carries the trash block
+
+
+# -- the op ------------------------------------------------------------------
+
+
+class TestPagedAttentionOp:
+    def _mk(self, seed=0, b=4, kvh=2, g=3, d=16, n=24, bs=8, t=5):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.normal(size=(b, kvh, g, d)), jnp.float32)
+        kp = jnp.asarray(rng.normal(size=(n, bs, kvh, d)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(n, bs, kvh, d)), jnp.float32)
+        tables = jnp.asarray(
+            rng.permutation(n)[:b * t].reshape(b, t), jnp.int32)
+        return q, kp, vp, tables
+
+    def test_gather_is_bitexact_with_dense_oracle(self):
+        q, kp, vp, tables = self._mk()
+        lengths = jnp.asarray([0, 3, 17, 40], jnp.int32)
+        out = paged_attention(q, kp, vp, tables, lengths, impl="gather")
+        kc = gather_blocks(kp, tables)
+        vc = gather_blocks(vp, tables)
+        oracle = dense_decode_attention(q, kc, vc, lengths)
+        assert np.array_equal(np.asarray(out), np.asarray(oracle))
+
+    def test_flash_kernel_matches_gather(self):
+        q, kp, vp, tables = self._mk(seed=7)
+        # ragged lengths incl. 0, block-boundary (8, 16) and mid-block
+        lengths = jnp.asarray([0, 8, 21, 40], jnp.int32)
+        og = paged_attention(q, kp, vp, tables, lengths, impl="gather")
+        of = paged_attention(q, kp, vp, tables, lengths, impl="flash")
+        np.testing.assert_allclose(
+            np.asarray(og), np.asarray(of), atol=1e-5, rtol=1e-5)
+
+    def test_zero_length_rows_are_zero(self):
+        q, kp, vp, tables = self._mk(seed=3)
+        lengths = jnp.zeros(4, jnp.int32)
+        for impl in ("gather", "flash"):
+            out = paged_attention(q, kp, vp, tables, lengths, impl=impl)
+            assert float(jnp.abs(out).max()) == 0.0, impl
+
+    def test_unknown_impl_raises(self):
+        q, kp, vp, tables = self._mk()
+        with pytest.raises(ValueError, match="impl"):
+            paged_attention(q, kp, vp, tables,
+                            jnp.ones(4, jnp.int32), impl="nope")
+
+
+# -- tier-1 parity suite (acceptance) ----------------------------------------
+
+
+def _paged_greedy_decode(params, cfg, prompts, max_new, *, block_size,
+                         impl="gather", cache=None, collect_logits=False):
+    """Greedy decode over a paged cache, one prompt at a time (so a dirty
+    cache can be reused across calls to exercise eviction + reuse).
+    Returns (tokens per prompt, logits per prompt per step)."""
+    own = cache is None
+    capacity = max(len(p) for p in prompts) + max_new
+    if own:
+        cache = init_cache(
+            cfg, num_blocks=-(-capacity // block_size) * len(prompts) + 2,
+            block_size=block_size)
+    t = -(-capacity // cache.block_size)
+    outs, logit_trace = [], []
+    for prompt in prompts:
+        seq = SequenceBlocks()
+        cache.ensure(seq, len(prompt) + max_new)
+        tables = jnp.asarray(cache.block_table_array([seq], t))
+        logits, cache.k, cache.v = prefill_chunk(
+            params, jnp.asarray([prompt], jnp.int32),
+            jnp.asarray(0, jnp.int32), jnp.asarray(len(prompt), jnp.int32),
+            cache.k, cache.v, tables, cfg=cfg)
+        gen, trace = [], [np.asarray(logits[0])]
+        pos = len(prompt)
+        for _ in range(max_new):
+            tok = int(np.argmax(trace[-1]))
+            gen.append(tok)
+            if len(gen) == max_new:
+                break
+            logits, cache.k, cache.v = decode_step(
+                params, jnp.asarray([tok], jnp.int32),
+                jnp.asarray([pos], jnp.int32), cache.k, cache.v, tables,
+                jnp.asarray([True]), cfg=cfg, impl=impl)
+            trace.append(np.asarray(logits[0]))
+            pos += 1
+        cache.release(seq)
+        outs.append(gen)
+        logit_trace.append(trace)
+    return (outs, logit_trace) if collect_logits else outs
+
+
+class TestPagedDecodeParity:
+    """Paged-KV decode must be BIT-EXACT with the dense decode path: the
+    dense path is the degenerate paged cache whose single block spans the
+    whole capacity (a contiguous [C] cache, no paging) — same math, so
+    any divergence is a paging bug, not numerics weather."""
+
+    # lengths straddle block boundaries for block_size=8: 7 (under),
+    # 8 (exact), 9 (over), and generation crosses further boundaries
+    PROMPTS = [list(range(2, 2 + n)) for n in (7, 8, 9, 19)]
+    MAX_NEW = 9
+
+    def _dense_trace(self, params, cfg):
+        # contiguous layout: ONE block spanning the whole (block-aligned)
+        # capacity — same padded extent as the bs=8 paged cache, so the
+        # only difference under test is the paging indirection itself
+        capacity = max(len(p) for p in self.PROMPTS) + self.MAX_NEW
+        span = -(-capacity // 8) * 8
+        return _paged_greedy_decode(
+            params, cfg, self.PROMPTS, self.MAX_NEW,
+            block_size=span, collect_logits=True)
+
+    def test_block_boundary_lengths_bitexact(self, tiny):
+        params, cfg = tiny
+        dense_toks, dense_logits = self._dense_trace(params, cfg)
+        paged_toks, paged_logits = _paged_greedy_decode(
+            params, cfg, self.PROMPTS, self.MAX_NEW, block_size=8,
+            collect_logits=True)
+        assert paged_toks == dense_toks
+        for dl, pl in zip(dense_logits, paged_logits):
+            for a, b in zip(dl, pl):
+                assert np.array_equal(a, b), "logit mismatch vs dense path"
+
+    def test_eviction_and_block_reuse_bitexact(self, tiny):
+        """A dirty cache (blocks freed by earlier sequences, garbage left
+        in place) must produce the same logits as a fresh one."""
+        params, cfg = tiny
+        capacity = max(len(p) for p in self.PROMPTS) + self.MAX_NEW
+        cache = init_cache(
+            cfg, num_blocks=-(-capacity // 8) + 1, block_size=8)
+        # tight pool: every prompt recycles the previous prompt's blocks
+        dirty_toks, dirty_logits = _paged_greedy_decode(
+            params, cfg, self.PROMPTS, self.MAX_NEW, block_size=8,
+            cache=cache, collect_logits=True)
+        assert cache.allocator.used_count == 0  # everything recycled
+        dense_toks, dense_logits = self._dense_trace(params, cfg)
+        assert dirty_toks == dense_toks
+        for dl, pl in zip(dense_logits, dirty_logits):
+            for a, b in zip(dl, pl):
+                assert np.array_equal(a, b), "reused-block logits diverged"
+
+    def _batched_decode_trace(self, params, cfg, prompts, block_size):
+        """Prefill each row, then decode the whole ragged batch together;
+        returns the per-row decode-step logit trace."""
+        b = len(prompts)
+        capacity = max(len(p) for p in prompts) + self.MAX_NEW
+        t = -(-(-(-capacity // 8) * 8) // block_size)
+        cache = init_cache(cfg, num_blocks=b * t + 1, block_size=block_size)
+        seqs = []
+        for p in prompts:
+            s = SequenceBlocks()
+            cache.ensure(s, len(p) + self.MAX_NEW)
+            seqs.append(s)
+        next_tok = []
+        for i, p in enumerate(prompts):
+            tables_1 = jnp.asarray(cache.block_table_array([seqs[i]], t))
+            logits, cache.k, cache.v = prefill_chunk(
+                params, jnp.asarray([p], jnp.int32),
+                jnp.asarray(0, jnp.int32), jnp.asarray(len(p), jnp.int32),
+                cache.k, cache.v, tables_1, cfg=cfg)
+            next_tok.append(int(np.argmax(np.asarray(logits[0]))))
+            seqs[i].length = len(p)
+        tables = jnp.asarray(cache.block_table_array(seqs, t))
+        trace = [[] for _ in range(b)]
+        toks = list(next_tok)
+        positions = [len(p) for p in prompts]
+        for _ in range(self.MAX_NEW - 1):
+            logits, cache.k, cache.v = decode_step(
+                params, jnp.asarray(toks, jnp.int32),
+                jnp.asarray(positions, jnp.int32), cache.k, cache.v,
+                tables, jnp.ones(b, bool), cfg=cfg)
+            arr = np.asarray(logits)
+            for i in range(b):
+                trace[i].append(arr[i].copy())
+                toks[i] = int(np.argmax(arr[i]))
+                positions[i] += 1
+        return trace
+
+    def test_ragged_batch_bitexact_per_row(self, tiny):
+        """Batched decode with ragged lengths: paged (bs=8, interleaved
+        block ownership) bit-equal, row for row and step for step, to the
+        dense contiguous-cache batch (one whole-capacity block per row)."""
+        params, cfg = tiny
+        capacity = max(len(p) for p in self.PROMPTS) + self.MAX_NEW
+        span = -(-capacity // 8) * 8
+        paged = self._batched_decode_trace(params, cfg, self.PROMPTS, 8)
+        dense = self._batched_decode_trace(params, cfg, self.PROMPTS, span)
+        for i in range(len(self.PROMPTS)):
+            for step, (a, b_) in enumerate(zip(paged[i], dense[i])):
+                assert np.array_equal(a, b_), (
+                    f"row {i} step {step} diverged from dense decode")
+
+    def test_paged_decode_matches_full_forward(self, tiny):
+        """Incremental paged decode tracks the full training forward
+        (non-incremental attention over the whole sequence) to fp32
+        tolerance — systematic-drift guard on top of the bit-exact
+        dense-decode pin."""
+        params, cfg = tiny
+        prompt = self.PROMPTS[-1]
+        toks = _paged_greedy_decode(
+            params, cfg, [prompt], 5, block_size=8)[0]
+        seq = list(prompt)
+        for expect in toks:
+            logits = T.apply(params, jnp.asarray([seq], jnp.int32), cfg)
+            assert int(np.argmax(np.asarray(logits[0, -1]))) == expect
+            seq.append(expect)
+
+    def test_flash_impl_decode_matches_gather(self, tiny):
+        params, cfg = tiny
+        g = _paged_greedy_decode(
+            params, cfg, self.PROMPTS[:2], 6, block_size=8, impl="gather")
+        f = _paged_greedy_decode(
+            params, cfg, self.PROMPTS[:2], 6, block_size=8, impl="flash")
+        assert g == f
+
+    def test_chunked_prefill_matches_one_shot(self, tiny):
+        """A prompt prefilled in 4-token chunks must land the same logits
+        as a single whole-prompt prefill (chunk boundaries are purely a
+        scheduling artifact)."""
+        params, cfg = tiny
+        prompt = list(range(5, 26))  # 21 tokens
+        capacity = len(prompt) + 4
+        t = -(-capacity // 8)
+        # one-shot
+        c1 = init_cache(cfg, num_blocks=t + 1, block_size=8)
+        s1 = SequenceBlocks()
+        c1.ensure(s1, capacity)
+        tb1 = jnp.asarray(c1.block_table_array([s1], t))
+        one, c1.k, c1.v = prefill_chunk(
+            params, jnp.asarray([prompt], jnp.int32),
+            jnp.asarray(0, jnp.int32), jnp.asarray(len(prompt), jnp.int32),
+            c1.k, c1.v, tb1, cfg=cfg)
+        # chunked (4 at a time, padded fixed shape like the engine)
+        c2 = init_cache(cfg, num_blocks=t + 1, block_size=8)
+        s2 = SequenceBlocks()
+        c2.ensure(s2, capacity)
+        tb2 = jnp.asarray(c2.block_table_array([s2], t))
+        chunked = None
+        for lo in range(0, len(prompt), 4):
+            chunk = prompt[lo:lo + 4]
+            padded = chunk + [0] * (4 - len(chunk))
+            chunked, c2.k, c2.v = prefill_chunk(
+                params, jnp.asarray([padded], jnp.int32),
+                jnp.asarray(lo, jnp.int32),
+                jnp.asarray(len(chunk), jnp.int32),
+                c2.k, c2.v, tb2, cfg=cfg)
+        np.testing.assert_allclose(
+            np.asarray(one), np.asarray(chunked), atol=1e-5, rtol=1e-5)
+
+
+# -- engine ------------------------------------------------------------------
+
+
+def _drive(engine, reqs, max_steps=4000):
+    for _ in range(max_steps):
+        if all(r.state in ("done", "failed") for r in reqs):
+            return
+        engine.step()
+    raise AssertionError(
+        f"engine did not finish: {[r.state for r in reqs]}")
+
+
+class TestServeEngine:
+    PROMPTS = [list(range(3, 3 + n)) for n in (5, 12, 17, 33, 8, 21)]
+
+    def test_continuous_equals_sequential(self, tiny):
+        """Iteration-level batching must not change outputs: a width-6
+        continuous batch produces exactly the sequential (width-1)
+        tokens."""
+        params, cfg = tiny
+        sp = SamplingParams(max_new_tokens=8)
+        wide = ServeEngine(params, cfg, max_slots=6, block_size=8,
+                           prefill_chunk=16, max_seq_len=64)
+        reqs = [wide.submit(p, sp) for p in self.PROMPTS]
+        _drive(wide, reqs)
+        narrow = ServeEngine(params, cfg, max_slots=1, block_size=8,
+                             prefill_chunk=16, max_seq_len=64)
+        reqs1 = [narrow.submit(p, sp) for p in self.PROMPTS]
+        _drive(narrow, reqs1)
+        assert [r.out_tokens for r in reqs] == [r.out_tokens for r in reqs1]
+        assert wide.cache.allocator.used_count == 0
+
+    def test_admission_beyond_slots_and_recycling(self, tiny):
+        """More requests than slots: the overflow waits, admits as slots
+        free (no global pause), and every request completes."""
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                          prefill_chunk=16, max_seq_len=64)
+        sp = SamplingParams(max_new_tokens=5)
+        reqs = [eng.submit(p, sp) for p in self.PROMPTS]
+        assert eng.waiting_count >= len(self.PROMPTS) - 2
+        _drive(eng, reqs)
+        assert all(len(r.out_tokens) == 5 for r in reqs)
+        snap = eng.snapshot()
+        assert snap["requests_total"] == len(self.PROMPTS)
+        assert snap["tokens_total"] == 5 * len(self.PROMPTS)
+        assert snap["ttft_p50_ms"] is not None
+
+    def test_per_request_sampling_params(self, tiny):
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=4, block_size=8,
+                          prefill_chunk=16, max_seq_len=64)
+        prompt = list(range(4, 14))
+        a = eng.submit(prompt, SamplingParams(
+            max_new_tokens=6, temperature=0.9, seed=7))
+        b = eng.submit(prompt, SamplingParams(
+            max_new_tokens=6, temperature=0.9, seed=7))
+        c = eng.submit(prompt, SamplingParams(max_new_tokens=3))
+        _drive(eng, [a, b, c])
+        assert a.out_tokens == b.out_tokens  # same seed -> same draw
+        assert len(c.out_tokens) == 3        # per-request max_new honored
+
+    def test_stop_token_and_stream(self, tiny):
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                          prefill_chunk=16, max_seq_len=64)
+        # greedy first token is deterministic: use it as the stop token
+        probe = eng.submit(list(range(6, 16)), SamplingParams(max_new_tokens=1))
+        _drive(eng, [probe])
+        stop = probe.out_tokens[0]
+        req = eng.submit(list(range(6, 16)), SamplingParams(
+            max_new_tokens=50, stop_token=stop))
+        _drive(eng, [req])
+        assert req.out_tokens[-1] == stop and len(req.out_tokens) < 50
+        # the stream queue carries every token then the None sentinel
+        drained = []
+        while True:
+            t = req.stream.get_nowait()
+            if t is None:
+                break
+            drained.append(t)
+        assert drained == req.out_tokens
+
+    def test_oversized_request_fails_loudly(self, tiny):
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=1, block_size=8,
+                          max_seq_len=32)
+        r = eng.submit(list(range(30)), SamplingParams(max_new_tokens=10))
+        assert r.state == "failed" and "max_seq_len" in r.error
+        assert r.stream.get_nowait() is None
+
+    def test_sample_token_greedy_and_topk(self):
+        rng = np.random.default_rng(0)
+        logits = np.asarray([0.1, 3.0, -1.0, 2.9])
+        assert sample_token(logits, SamplingParams(), rng) == 1
+        for _ in range(20):
+            t = sample_token(logits, SamplingParams(
+                temperature=1.0, top_k=2), rng)
+            assert t in (1, 3)  # top-2 only
+
+
+# -- serve HTTP --------------------------------------------------------------
+
+
+class _EngineServer:
+    """Threaded aiohttp runner for tests (ApiServer pattern)."""
+
+    def __init__(self, engine):
+        import asyncio
+
+        from aiohttp import web
+
+        from polyaxon_tpu.serve.server import build_app
+
+        self.app = build_app(engine, model_name="llama-tiny")
+        self.port = None
+        self._started = threading.Event()
+        self._stop = None
+        self._loop = None
+
+        def _run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            async def main():
+                runner = web.AppRunner(self.app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                self.port = site._server.sockets[0].getsockname()[1]
+                self._stop = loop.create_future()
+                self._started.set()
+                await self._stop
+                await runner.cleanup()
+
+            loop.run_until_complete(main())
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(15)
+
+    def stop(self):
+        if self._loop and self._stop:
+            self._loop.call_soon_threadsafe(
+                lambda: self._stop.done() or self._stop.set_result(None))
+        self._thread.join(timeout=10)
+
+
+class TestServeHTTP:
+    @pytest.fixture()
+    def served(self, tiny):
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=4, block_size=8,
+                          prefill_chunk=16, max_seq_len=64).start()
+        srv = _EngineServer(eng)
+        yield srv, eng
+        srv.stop()
+        eng.stop()
+
+    def test_generate_roundtrip_and_meters(self, served):
+        import requests
+
+        srv, eng = served
+        url = f"http://127.0.0.1:{srv.port}"
+        r = requests.post(f"{url}/generate", json={
+            "prompt": "hello serving", "max_new_tokens": 6}, timeout=120)
+        assert r.status_code == 200
+        out = r.json()
+        assert len(out["tokens"]) == 6
+        assert out["ttft_ms"] is not None and out["num_tokens"] == 6
+        assert isinstance(out["text"], str)
+        # byte-vocab determinism: same prompt, greedy -> same tokens
+        r2 = requests.post(f"{url}/generate", json={
+            "prompt": "hello serving", "max_new_tokens": 6}, timeout=120)
+        assert r2.json()["tokens"] == out["tokens"]
+
+    def test_streaming_ndjson(self, served):
+        import requests
+
+        srv, _ = served
+        r = requests.post(
+            f"http://127.0.0.1:{srv.port}/generate",
+            json={"prompt": "abc", "max_new_tokens": 4, "stream": True},
+            timeout=120, stream=True)
+        lines = [json.loads(l) for l in r.iter_lines() if l]
+        assert [l["token"] for l in lines[:-1]] == lines[-1]["tokens"]
+        assert lines[-1]["done"] is True and lines[-1]["num_tokens"] == 4
+
+    def test_health_stats_metrics(self, served):
+        import requests
+
+        from polyaxon_tpu.obs.metrics import parse_prometheus
+
+        srv, _ = served
+        url = f"http://127.0.0.1:{srv.port}"
+        requests.post(f"{url}/generate", json={
+            "tokens": [1, 2, 3], "max_new_tokens": 2}, timeout=120)
+        assert requests.get(f"{url}/healthz", timeout=10).json()["ok"]
+        snap = requests.get(f"{url}/stats", timeout=10).json()
+        assert snap["requests_total"] >= 1 and snap["tokens_total"] >= 2
+        fams = parse_prometheus(
+            requests.get(f"{url}/metrics", timeout=10).text)
+        for fam in ("polyaxon_serve_ttft_seconds",
+                    "polyaxon_serve_generated_tokens_total",
+                    "polyaxon_serve_running_requests",
+                    "polyaxon_serve_kv_block_utilization"):
+            assert fam in fams, fam
+
+    def test_bad_requests_are_4xx(self, served):
+        import requests
+
+        srv, _ = served
+        url = f"http://127.0.0.1:{srv.port}"
+        assert requests.post(f"{url}/generate", data=b"not json",
+                             timeout=10).status_code == 400
+        assert requests.post(f"{url}/generate", json={},
+                             timeout=10).status_code == 400
+        r = requests.post(f"{url}/generate", json={
+            "tokens": list(range(100)), "max_new_tokens": 50}, timeout=10)
+        assert r.status_code == 400  # exceeds max_seq_len
+
+
+# -- store traffic accounting ------------------------------------------------
+
+
+class TestStoreServeAccounting:
+    @pytest.fixture()
+    def store(self):
+        from polyaxon_tpu.api.store import Store
+
+        s = Store(":memory:")
+        s.create_project("p")
+        return s
+
+    def _svc_run(self, store):
+        return store.create_run(
+            "p", spec={"component": {"run": {"kind": "service"}}})
+
+    def test_counters_delta_and_incarnation_restart(self, store):
+        u = self._svc_run(store)["uuid"]
+        store.heartbeat(u, serve={"requests_total": 5, "tokens_total": 100},
+                        incarnation="a")
+        store.heartbeat(u, serve={"requests_total": 7, "tokens_total": 150},
+                        incarnation="a")
+        assert store.stats["serve_requests"] == 7
+        assert store.stats["serve_tokens"] == 150
+        # restarted replica: cumulatives reset, full count lands
+        store.heartbeat(u, serve={"requests_total": 2, "tokens_total": 10},
+                        incarnation="b")
+        assert store.stats["serve_requests"] == 9
+        # stale lower relay of incarnation a: clamped, never re-added
+        store.heartbeat(u, serve={"requests_total": 3, "tokens_total": 50},
+                        incarnation="a")
+        assert store.stats["serve_requests"] == 9
+
+    def test_gauges_sum_fresh_reporters_and_age_out(self, store):
+        u = self._svc_run(store)["uuid"]
+        store.serve_fresh_s = 0.3
+        store.heartbeat(u, serve={"running": 2, "waiting": 1,
+                                  "kv_blocks_used": 5,
+                                  "kv_blocks_total": 10}, incarnation="r0")
+        store.heartbeat(u, serve={"running": 3, "waiting": 0,
+                                  "kv_blocks_used": 2,
+                                  "kv_blocks_total": 10}, incarnation="r1")
+        t = store.serve_traffic(u)
+        assert t["running"] == 5 and t["waiting"] == 1
+        assert t["reporters"] == 2 and t["kv_utilization"] == 0.35
+        time.sleep(0.4)
+        t = store.serve_traffic(u)
+        assert t["reporters"] == 0 and t["running"] == 0
+
+    def test_observations_feed_store_histograms(self, store):
+        from polyaxon_tpu.obs.metrics import parse_prometheus
+
+        u = self._svc_run(store)["uuid"]
+        store.heartbeat(u, serve={"ttft": [0.05, 0.1], "itl": [0.01, 0.02]},
+                        incarnation="x")
+        fams = parse_prometheus(store.metrics.render())
+        assert fams["polyaxon_serve_ttft_seconds"][
+            "polyaxon_serve_ttft_seconds_count"] == 2
+        assert fams["polyaxon_serve_intertoken_seconds"][
+            "polyaxon_serve_intertoken_seconds_count"] == 2
+
+    def test_malformed_serve_payload_never_breaks_the_beat(self, store):
+        u = self._svc_run(store)["uuid"]
+        assert store.heartbeat(u, serve={"running": "garbage",
+                                         "ttft": "nope",
+                                         "requests_total": None})
+        assert store.serve_traffic(u)["running"] == 0
+
+    def test_families_present_from_birth(self, store):
+        from polyaxon_tpu.obs.metrics import parse_prometheus
+
+        fams = parse_prometheus(store.metrics.render())
+        for fam in ("polyaxon_serve_requests_total",
+                    "polyaxon_serve_generated_tokens_total",
+                    "polyaxon_serve_running_requests",
+                    "polyaxon_serve_waiting_requests",
+                    "polyaxon_serve_kv_block_utilization",
+                    "polyaxon_serve_ttft_seconds",
+                    "polyaxon_serve_intertoken_seconds"):
+            assert fam in fams, fam
+
+    def test_delete_run_prunes_serve_state(self, store):
+        u = self._svc_run(store)["uuid"]
+        store.heartbeat(u, serve={"running": 2}, incarnation="a")
+        store.delete_run(u)
+        assert u not in store._serve_seen
+
+    def test_stale_reporter_records_pruned(self, store):
+        """Replica-restart churn mints a new incarnation per process; the
+        per-run records must not grow unboundedly — siblings stale past
+        10x the freshness window are dropped."""
+        u = self._svc_run(store)["uuid"]
+        store.serve_fresh_s = 0.01
+        for i in range(5):
+            store.heartbeat(u, serve={"running": 1}, incarnation=f"r{i}")
+        time.sleep(0.15)  # > 10 * serve_fresh_s
+        store.heartbeat(u, serve={"running": 1}, incarnation="fresh")
+        assert set(store._serve_seen[u]) == {"fresh"}
+
+    def test_heartbeat_serve_over_http(self, tmp_path):
+        import requests
+
+        from polyaxon_tpu.api.server import ApiServer
+
+        srv = ApiServer(artifacts_root=str(tmp_path), port=0).start()
+        try:
+            run = srv.store.create_run(
+                "p", spec={"component": {"run": {"kind": "service"}}})
+            r = requests.post(
+                srv.url + f"/api/v1/p/runs/{run['uuid']}/heartbeat",
+                json={"serve": {"running": 4, "requests_total": 3},
+                      "incarnation": "web"}, timeout=5)
+            assert r.status_code == 200
+            assert srv.store.serve_traffic(run["uuid"])["running"] == 4
+            assert srv.store.stats["serve_requests"] == 3
+            # malformed serve -> liveness-only beat, never a 500
+            r = requests.post(
+                srv.url + f"/api/v1/p/runs/{run['uuid']}/heartbeat",
+                json={"serve": "not-a-dict"}, timeout=5)
+            assert r.status_code == 200
+        finally:
+            srv.stop()
+
+
+# -- read-only checkpoint restore (satellite) --------------------------------
+
+
+class TestReadOnlyCheckpointer:
+    def _save_one(self, tmp_path):
+        from polyaxon_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer,
+        )
+
+        cfg = CheckpointConfig(directory=str(tmp_path / "ck"),
+                               save_interval_steps=1, async_save=False)
+        ck = Checkpointer(cfg)
+        state = {"params": {"w": jnp.arange(4.0)},
+                 "opt_state": {"m": jnp.zeros(4)},
+                 "step": jnp.asarray(2, jnp.int32)}
+        ck.maybe_save(2, state, force=True)
+        ck.maybe_save(5, state, force=True)
+        ck.wait()
+        return cfg, ck
+
+    def test_restore_raw_params_only(self, tmp_path):
+        from polyaxon_tpu.train.checkpoint import Checkpointer
+
+        cfg, _ = self._save_one(tmp_path)
+        ro = Checkpointer(cfg, read_only=True)
+        raw, step = ro.restore_raw()
+        assert step == 5
+        assert np.allclose(np.asarray(raw["params"]["w"]), np.arange(4.0))
+
+    def test_read_only_has_no_side_effects(self, tmp_path):
+        import glob
+        import os
+
+        from polyaxon_tpu.train.checkpoint import Checkpointer
+
+        cfg, writer = self._save_one(tmp_path)
+        # drop the manifests: a read-only opener must NOT backfill them
+        for m in glob.glob(os.path.join(writer.directory, "manifest-*")):
+            os.unlink(m)
+        ro = Checkpointer(cfg, read_only=True)
+        assert sorted(ro.complete_steps_desc(), reverse=True) == [5, 2]
+        # explicit older restore must NOT purge/quarantine newer steps
+        _, step = ro.restore_raw(step=2)
+        assert step == 2
+        assert sorted(writer.manager.all_steps()) == [2, 5]
+        assert glob.glob(os.path.join(writer.directory, "manifest-*")) == []
+        assert glob.glob(os.path.join(writer.directory, "quarantine-*")) == []
+        with pytest.raises(RuntimeError, match="read-only"):
+            ro.maybe_save(9, {"x": jnp.zeros(1)}, force=True)
+
+    def test_concurrent_readers(self, tmp_path):
+        from polyaxon_tpu.train.checkpoint import Checkpointer
+
+        cfg, _ = self._save_one(tmp_path)
+        results = []
+
+        def _read():
+            ro = Checkpointer(cfg, read_only=True)
+            raw, step = ro.restore_raw()
+            results.append((step, float(np.asarray(raw["params"]["w"]).sum())))
+
+        threads = [threading.Thread(target=_read) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert results == [(5, 6.0)] * 3
+
+
+class TestServeRuntimeWeights:
+    def test_build_engine_restores_checkpoint_params(self, tiny, tmp_path):
+        """The serve spec's `checkpoint:` restores the TRAINED params
+        (read-only, through the sha256 manifests) — generation must use
+        them, not a fresh init."""
+        from polyaxon_tpu.serve.runtime import build_engine
+        from polyaxon_tpu.train.checkpoint import (
+            CheckpointConfig, Checkpointer,
+        )
+
+        params, cfg = tiny
+        ck = Checkpointer(CheckpointConfig(
+            directory=str(tmp_path / "ck"), save_interval_steps=1,
+            async_save=False))
+        state = {"params": params, "opt_state": {},
+                 "step": jnp.asarray(7, jnp.int32)}
+        ck.maybe_save(7, state, force=True)
+        ck.wait()
+        engine = build_engine({
+            "model": "llama-tiny", "checkpoint": str(tmp_path / "ck"),
+            "max_slots": 2, "block_size": 8, "max_seq_len": 64,
+        })
+        assert engine.provenance["restored_step"] == 7
+        got = jax.tree.leaves(engine.params)
+        want = jax.tree.leaves(params)
+        assert all(np.array_equal(np.asarray(a), np.asarray(b))
+                   for a, b in zip(got, want))
+        # and the restored engine generates (end-to-end sanity)
+        req = engine.submit(list(range(4, 12)),
+                            SamplingParams(max_new_tokens=3))
+        _drive(engine, [req])
+        assert len(req.out_tokens) == 3
+
+
+# -- autoscale control loop --------------------------------------------------
+
+
+def _service_autoscale_spec(min_r=1, max_r=3, per=2, down_after=0.2):
+    return {
+        "kind": "operation",
+        "name": "svc",
+        "component": {"kind": "component", "run": {
+            "kind": "service",
+            "ports": [18080],
+            "container": {
+                "name": "main", "image": "python:3.12",
+                "command": ["python", "-c",
+                            "import time; time.sleep(600)"],
+            },
+            "autoscale": {"min_replicas": min_r, "max_replicas": max_r,
+                          "target_per_replica": per,
+                          "scale_down_after_s": down_after},
+        }},
+    }
+
+
+class TestAutoscaler:
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        from polyaxon_tpu.api.store import Store
+        from polyaxon_tpu.scheduler.agent import LocalAgent
+
+        store = Store(":memory:")
+        store.create_project("p")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path),
+                           backend="cluster", poll_interval=0.05,
+                           capacity_chips=8)
+        agent.autoscale_interval = 0.0  # every pass in tests
+        yield store, agent
+        agent.cluster.shutdown()
+
+    def _launch(self, store, agent, spec):
+        run = store.create_run("p", spec=spec)
+        for _ in range(10):
+            agent.tick()
+            if store.get_run(run["uuid"])["status"] == "running":
+                break
+            time.sleep(0.05)
+        return run["uuid"]
+
+    def _pods(self, agent, uuid):
+        return [s.name for s in agent.cluster.pod_statuses(
+            {"app.polyaxon.com/run": uuid})]
+
+    def test_replicas_follow_traffic_both_ways(self, stack):
+        store, agent = stack
+        uuid = self._launch(store, agent, _service_autoscale_spec())
+        assert len(self._pods(agent, uuid)) == 1
+        # ramp: 6 concurrent requests at target 2/replica -> 3 replicas
+        store.heartbeat(uuid, serve={"running": 4, "waiting": 2},
+                        incarnation="r0")
+        agent.tick()
+        assert len(self._pods(agent, uuid)) == 3
+        meta = (store.get_run(uuid).get("meta") or {})
+        assert meta["autoscale"]["replicas"] == 3
+        # ramp down: sustained low traffic drains back to min
+        store.heartbeat(uuid, serve={"running": 0, "waiting": 0},
+                        incarnation="r0")
+        agent.tick()  # hysteresis arms
+        assert len(self._pods(agent, uuid)) == 3
+        time.sleep(0.3)
+        store.heartbeat(uuid, serve={"running": 0, "waiting": 0},
+                        incarnation="r0")
+        agent.tick()
+        assert len(self._pods(agent, uuid)) == 1
+        # zero duplicate applies through the whole dance
+        assert agent.cluster.duplicate_applies == []
+
+    def test_scale_up_clamped_by_chip_budget(self, stack):
+        store, agent = stack
+        agent.capacity_chips = 2
+        uuid = self._launch(store, agent, _service_autoscale_spec(max_r=5))
+        store.heartbeat(uuid, serve={"running": 10}, incarnation="r0")
+        agent.tick()
+        # 1 held + 1 free chip -> at most 2 replicas despite demand for 5
+        assert len(self._pods(agent, uuid)) == 2
+
+    def test_autoscaled_service_names_are_replica_indexed_at_min(self, stack):
+        """Even at 1 replica an autoscaled service uses the r-indexed pod
+        name: a legacy-name branch would switch naming schemes on every
+        scale transition through 1 and churn (or briefly zero out) the
+        live set."""
+        store, agent = stack
+        uuid = self._launch(store, agent, _service_autoscale_spec())
+        names = self._pods(agent, uuid)
+        assert names == [f"plx-{uuid[:12]}-r0"], names
+
+    def test_non_autoscale_service_untouched(self, stack):
+        store, agent = stack
+        spec = _service_autoscale_spec()
+        del spec["component"]["run"]["autoscale"]
+        spec["component"]["run"]["replicas"] = 2
+        uuid = self._launch(store, agent, spec)
+        pods = self._pods(agent, uuid)
+        assert len(pods) == 2
+        store.heartbeat(uuid, serve={"running": 50}, incarnation="r0")
+        agent.tick()
+        assert len(self._pods(agent, uuid)) == 2  # no autoscale block
+
+    def test_successor_resyncs_at_stored_target(self, stack, tmp_path):
+        """Agent dies after a scale-up; the successor adopts the LIVE
+        3-replica set (rendered from meta.autoscale) without a single
+        duplicate apply."""
+        from polyaxon_tpu.scheduler.agent import LocalAgent
+
+        store, agent = stack
+        uuid = self._launch(store, agent, _service_autoscale_spec())
+        store.heartbeat(uuid, serve={"running": 6}, incarnation="r0")
+        agent.tick()
+        assert len(self._pods(agent, uuid)) == 3
+        agent.hard_kill()
+        successor = LocalAgent(store, artifacts_root=str(tmp_path),
+                               backend="cluster", cluster=agent.cluster,
+                               poll_interval=0.05, capacity_chips=8)
+        successor.cold_start_resync()
+        successor.tick()
+        assert len(self._pods(agent, uuid)) == 3
+        assert agent.cluster.duplicate_applies == []
+        assert successor.reconciler.is_tracked(uuid)
+
+
+# -- bench regression smoke --------------------------------------------------
+
+
+class TestServeBenchSmoke:
+    def test_continuous_batching_beats_sequential(self, tiny):
+        """Scaled-down serve_bench sweep: iteration-level batching must
+        beat the width-1 sequential baseline on decode throughput (the
+        full acceptance run — concurrency 8, >=3x — lives in
+        bench_artifacts/serve_bench_r09.json; this guards the mechanism,
+        best-of-3 against 2-CPU CI noise)."""
+        import os as _os
+        import sys as _sys
+
+        _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "scripts"))
+        from serve_bench import run_engine_bench
+
+        params, cfg = tiny
+        best = 0.0
+        for _ in range(3):
+            seq = run_engine_bench(1, requests=6, prompt_len=16, max_new=12,
+                                   params=params, cfg=cfg)
+            bat = run_engine_bench(4, requests=6, prompt_len=16, max_new=12,
+                                   params=params, cfg=cfg)
+            ratio = bat["tokens_per_sec"] / max(seq["tokens_per_sec"], 1e-9)
+            best = max(best, ratio)
+            if best >= 1.5:
+                break
+        assert best >= 1.5, f"continuous/sequential ratio {best:.2f}"
+
+
+# -- e2e smoke (satellite 3) -------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestServeServiceE2E:
+    def test_service_run_serves_concurrent_generates(self, tmp_path):
+        """store -> agent -> operator pod -> serve runtime: a `kind:
+        service` polyaxonfile launches, serves 2 concurrent /generate
+        requests, and the run's own outputs carry tokens/s + TTFT."""
+        import requests
+
+        from polyaxon_tpu.api.server import ApiServer
+        from polyaxon_tpu.client import RunClient
+        from polyaxon_tpu.obs.metrics import parse_prometheus
+        from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+        from polyaxon_tpu.scheduler.agent import LocalAgent
+
+        art = str(tmp_path / "artifacts")
+        srv = ApiServer(db_path=":memory:", artifacts_root=art,
+                        port=0).start()
+        agent = LocalAgent(srv.store, artifacts_root=art, api_host=srv.url,
+                           backend="cluster", poll_interval=0.05)
+        agent.start()
+        port = _free_port()
+        rc = RunClient(srv.url, project="serve")
+        op = check_polyaxonfile({
+            "kind": "operation",
+            "name": "tiny-serve",
+            "component": {"kind": "component", "run": {
+                "kind": "service",
+                "ports": [port],
+                "runtime": {
+                    "model": "llama-tiny", "platform": "cpu",
+                    "port": port, "max_slots": 4, "block_size": 8,
+                    "max_seq_len": 64, "prefill_chunk": 16,
+                    "report_interval": 0.5,
+                }}},
+        })
+        run = rc.create(operation=op)
+        uuid = run["uuid"]
+        try:
+            # wait for the pod to come up and stamp its endpoint
+            deadline = time.time() + 180
+            url = f"http://127.0.0.1:{port}"
+            while time.time() < deadline:
+                try:
+                    if requests.get(f"{url}/healthz", timeout=1).ok:
+                        break
+                except requests.RequestException:
+                    time.sleep(0.5)
+            else:
+                raise AssertionError(
+                    "serve pod never came up; logs:\n"
+                    + "\n".join(agent.cluster.pod_logs(n)
+                                for n in agent.cluster.pods))
+            # the agent stamped the service endpoint (all declared ports)
+            meta = (srv.store.get_run(uuid).get("meta") or {})
+            assert meta["service"]["ports"] == [port]
+
+            results = []
+
+            def _gen(prompt):
+                r = requests.post(f"{url}/generate", json={
+                    "prompt": prompt, "max_new_tokens": 8}, timeout=120)
+                results.append(r.json())
+
+            threads = [threading.Thread(target=_gen, args=(p,))
+                       for p in ("one concurrent", "two concurrent")]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert len(results) == 2
+            assert all(len(r["tokens"]) == 8 for r in results)
+            assert all(r["ttft_ms"] is not None for r in results)
+
+            # the traffic bridge: outputs carry tokens/s + TTFT, and the
+            # control plane's /metrics grew the serve families
+            deadline = time.time() + 60
+            outputs = {}
+            while time.time() < deadline:
+                outputs = srv.store.get_run(uuid).get("outputs") or {}
+                if outputs.get("serve_requests_total", 0) >= 2 and \
+                        outputs.get("serve_ttft_p50_ms") is not None:
+                    break
+                time.sleep(0.5)
+            assert outputs.get("serve_requests_total", 0) >= 2, outputs
+            assert outputs.get("serve_tokens_total", 0) >= 16
+            assert outputs.get("serve_ttft_p50_ms") is not None
+            assert outputs.get("serve_tokens_per_sec") is not None
+            fams = parse_prometheus(
+                requests.get(srv.url + "/metrics", timeout=5).text)
+            assert fams["polyaxon_serve_requests_total"][
+                "polyaxon_serve_requests_total"] >= 2
+            assert fams["polyaxon_serve_ttft_seconds"][
+                "polyaxon_serve_ttft_seconds_count"] >= 2
+        finally:
+            try:
+                rc.stop(uuid)
+                deadline = time.time() + 30
+                while time.time() < deadline and srv.store.get_run(
+                        uuid)["status"] not in ("stopped", "failed"):
+                    time.sleep(0.2)
+            finally:
+                agent.stop()
+                srv.stop()
