@@ -1,0 +1,17 @@
+"""Concurrency-invariant analysis (ISSUE 11).
+
+Static half: ``python -m polyaxon_tpu.analysis`` runs the AST rule
+suite over the live tree (see docs/ANALYSIS.md for the rule catalog and
+suppression syntax). Runtime half: :class:`LockWitness` records actual
+cross-thread lock-acquisition orders during the chaos soaks
+(``scripts/chaos_soak.py --lock-witness``).
+"""
+
+from .engine import (Finding, Project, Report, Rule, run_analysis,
+                     load_project, repo_root)
+from .lockwitness import LockWitness, WitnessedLock
+
+__all__ = [
+    "Finding", "Project", "Report", "Rule", "run_analysis",
+    "load_project", "repo_root", "LockWitness", "WitnessedLock",
+]

@@ -1,0 +1,64 @@
+"""CLI: ``python -m polyaxon_tpu.analysis [--json] [--root DIR]
+[--rule NAME ...] [TARGET ...]``.
+
+Exit code 0 iff the analyzed tree has no unsuppressed findings (the
+contract scripts/ci.sh and the tier-1 tree-clean test gate on).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import DEFAULT_TARGETS, default_rules, run_analysis
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "polyaxon_tpu.analysis",
+        description="concurrency-invariant static analyzer "
+                    "(rule catalog: docs/ANALYSIS.md)")
+    p.add_argument("targets", nargs="*",
+                   help=f"files/dirs relative to --root "
+                        f"(default: {' '.join(DEFAULT_TARGETS)})")
+    p.add_argument("--root", default=None,
+                   help="analysis root (default: the repo root)")
+    p.add_argument("--rule", action="append", default=None,
+                   help="run only this rule (repeatable)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON on stdout")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:12s} {r.title}")
+        return 0
+    if args.rule:
+        known = {r.name for r in rules}
+        unknown = set(args.rule) - known
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in set(args.rule)]
+
+    report = run_analysis(root=args.root, targets=args.targets or None,
+                          rules=rules)
+    if report.files_analyzed == 0:
+        # a typo'd --root/target must not read as "clean" to a CI gate
+        print(f"no Python files found under {report.root!r} "
+              f"(targets: {args.targets or list(DEFAULT_TARGETS)})",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+    else:
+        print(report.render_text())
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

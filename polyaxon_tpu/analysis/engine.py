@@ -1,0 +1,359 @@
+"""Concurrency-invariant analyzer engine (ISSUE 11 tentpole).
+
+A custom AST-based static analyzer — stdlib ``ast`` only, mirroring the
+hand-rolled-Prometheus philosophy of the obs layer — whose rules encode
+the bug classes the control plane's post-review hardening rounds kept
+re-discovering by hand (unfenced store writes, writer-thread
+self-deadlocks, blocking calls wedging the event loop, wall-clock lease
+arithmetic, metrics contract drift, donated-buffer reuse). Each rule
+module under :mod:`polyaxon_tpu.analysis.rules` documents which PR's
+hardening round it encodes; docs/ANALYSIS.md is the catalog.
+
+The engine owns everything rule-agnostic:
+
+- file discovery + parsing into a :class:`Project` of :class:`SourceFile`
+  objects rules can walk;
+- suppressions: ``# plx: allow(<rule>): <justification>`` on the flagged
+  line (or the line directly above) marks a finding suppressed. The
+  justification text is MANDATORY — an allow() without one is itself a
+  finding (rule ``suppression``) and cannot be suppressed;
+- machine-readable JSON (schema pinned by tests/test_analysis.py) and
+  human output;
+- the exit-code contract: 0 iff the tree has no unsuppressed findings.
+
+Static analysis proposes, the chaos soak witnesses: the runtime
+complement for the lock-order rule lives in
+:mod:`polyaxon_tpu.analysis.lockwitness`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, Optional
+
+#: suppression comments: ``# plx: allow(rule)`` or ``# plx: allow(a,b)``,
+#: with the mandatory justification after a colon
+_ALLOW_RE = re.compile(
+    r"#\s*plx:\s*allow\(\s*([a-z0-9_,\s-]+?)\s*\)\s*(?::\s*(.*\S))?\s*$")
+
+#: analyzer targets relative to the repo root — the LIVE tree the tier-1
+#: tree-clean test gates on. tests/ stays out on purpose: the regression
+#: corpus under tests/analysis_corpus/ reproduces each rule's historical
+#: bug class and must keep flagging.
+DEFAULT_TARGETS = ("polyaxon_tpu", "scripts")
+
+JSON_SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # root-relative, '/'-separated
+    line: int
+    message: str
+    col: int = 0
+    suppressed: bool = False
+    justification: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "justification": self.justification,
+        }
+
+    def render(self) -> str:
+        sup = "  (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{sup}"
+
+
+class SourceFile:
+    """One parsed source file: text, lines, AST, and its suppression
+    comments keyed by line number."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as e:  # surfaced as a finding by the engine
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        # line -> (set of rule names, justification or None)
+        self.suppressions: dict[int, tuple[set, Optional[str]]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(line)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            self.suppressions[i] = (rules, m.group(2))
+
+    def suppression_for(self, rule: str, line: int):
+        """The allow() covering ``rule`` at ``line``: same line, or a
+        comment on the line directly above the flagged one."""
+        for ln in (line, line - 1):
+            entry = self.suppressions.get(ln)
+            if entry is not None and rule in entry[0]:
+                return ln, entry[1]
+        return None
+
+
+class Project:
+    """Every analyzed file plus cross-file indexes rules share."""
+
+    def __init__(self, files: list[SourceFile], root: str):
+        self.files = files
+        self.root = root
+        # class name -> (SourceFile, ClassDef); single namespace is fine
+        # for this codebase (names are unique enough, collisions only
+        # cost rule precision, never correctness of the build)
+        self.classes: dict[str, tuple[SourceFile, ast.ClassDef]] = {}
+        for sf in files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    self.classes.setdefault(node.name, (sf, node))
+
+    def read_rootfile(self, *rel) -> Optional[str]:
+        """Text of a file under the analysis root (None when absent) —
+        how the metrics rule reaches tests/test_obs.py + docs/."""
+        p = os.path.join(self.root, *rel)
+        try:
+            with open(p, encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``title`` and implement
+    :meth:`check`."""
+
+    name = "rule"
+    title = ""
+
+    def check(self, project: Project) -> list[Finding]:
+        raise NotImplementedError
+
+
+def default_rules() -> list[Rule]:
+    from .rules import ALL_RULES
+
+    return [cls() for cls in ALL_RULES]
+
+
+def _discover(root: str, targets: Iterable[str]) -> list[str]:
+    out = []
+    for target in targets:
+        p = os.path.join(root, target)
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+@dataclasses.dataclass
+class Report:
+    root: str
+    files_analyzed: int
+    rules: list[str]
+    findings: list[Finding]
+
+    @property
+    def active(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.active else 0
+
+    def by_rule(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.active:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "version": JSON_SCHEMA_VERSION,
+            "root": self.root,
+            "files_analyzed": self.files_analyzed,
+            "rules": list(self.rules),
+            "findings": [f.to_dict() for f in sorted(
+                self.findings, key=lambda f: (f.path, f.line, f.rule))],
+            "summary": {
+                "total": len(self.findings),
+                "active": len(self.active),
+                "suppressed": len(self.suppressed),
+                "by_rule": self.by_rule(),
+            },
+        }
+
+    def render_text(self) -> str:
+        lines = []
+        for f in sorted(self.findings,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            lines.append(f.render())
+        lines.append(
+            f"analysis: {self.files_analyzed} files, "
+            f"{len(self.active)} finding(s), "
+            f"{len(self.suppressed)} suppressed")
+        if self.active:
+            lines.append("by rule: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(self.by_rule().items())))
+        return "\n".join(lines)
+
+
+def repo_root() -> str:
+    """The repository root: the directory holding the polyaxon_tpu
+    package this module was imported from."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_project(root: Optional[str] = None,
+                 targets: Optional[Iterable[str]] = None) -> Project:
+    root = os.path.abspath(root or repo_root())
+    if targets is None:
+        found = [t for t in DEFAULT_TARGETS
+                 if os.path.exists(os.path.join(root, t))]
+        targets = found or ["."]
+    files = []
+    for path in _discover(root, targets):
+        rel = os.path.relpath(path, root)
+        with open(path, encoding="utf-8") as fh:
+            files.append(SourceFile(path, rel, fh.read()))
+    return Project(files, root)
+
+
+def run_analysis(root: Optional[str] = None,
+                 targets: Optional[Iterable[str]] = None,
+                 rules: Optional[list[Rule]] = None) -> Report:
+    """Analyze ``targets`` under ``root`` with ``rules`` (default: all).
+
+    Suppression + justification processing happens here so rules stay
+    pure detectors."""
+    project = load_project(root, targets)
+    rules = rules if rules is not None else default_rules()
+    findings: list[Finding] = []
+    for sf in project.files:
+        if sf.parse_error is not None:
+            findings.append(Finding(
+                rule="parse", path=sf.rel, line=1,
+                message=f"file does not parse: {sf.parse_error}"))
+    for rule in rules:
+        findings.extend(rule.check(project))
+    by_rel = {sf.rel: sf for sf in project.files}
+    out: list[Finding] = []
+    for f in findings:
+        sf = by_rel.get(f.path)
+        entry = (sf.suppression_for(f.rule, f.line)
+                 if sf is not None and f.rule != "suppression" else None)
+        if entry is not None:
+            ln, justification = entry
+            if justification:
+                f.suppressed = True
+                f.justification = justification
+            else:
+                # an allow() with no written justification suppresses
+                # nothing — and is itself reported, unsuppressibly
+                out.append(Finding(
+                    rule="suppression", path=f.path, line=ln,
+                    message=f"plx: allow({f.rule}) needs a justification "
+                            "(`# plx: allow(rule): why this is safe`)"))
+        out.append(f)
+    return Report(
+        root=project.root,
+        files_analyzed=len(project.files),
+        rules=[r.name for r in rules],
+        findings=out,
+    )
+
+
+def find_cycles(graph: dict, max_len: int = 8) -> list[list]:
+    """Distinct elementary cycles in a small digraph ``{node: {succ}}``,
+    each returned as a closed trail ``[a, b, ..., a]``. Shared between
+    the static lockorder rule and the runtime LockWitness so the two
+    verdicts can never drift. Cycles are deduped by node SET — adequate
+    for lock graphs (any cycle at all is a finding), not a general
+    elementary-circuit enumerator."""
+    seen: set = set()
+    out: list[list] = []
+
+    def dfs(start, node, trail):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                key = frozenset(trail)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(trail + [start])
+            elif nxt not in trail and len(trail) < max_len:
+                dfs(start, nxt, trail + [nxt])
+
+    for n in sorted(graph):
+        dfs(n, n, [n])
+    return out
+
+
+# -- shared AST helpers (used by several rules) ------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_aliases(tree: ast.AST) -> dict[str, str]:
+    """{local name: dotted module/object} from import statements —
+    ``import time as _time`` maps ``_time -> time``; ``from time import
+    sleep`` maps ``sleep -> time.sleep``."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def call_target(call: ast.Call, aliases: dict[str, str]) -> Optional[str]:
+    """The dotted call target with import aliases resolved:
+    ``_time.time()`` -> ``time.time``."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    resolved = aliases.get(head)
+    if resolved:
+        return f"{resolved}.{rest}" if rest else resolved
+    return name

@@ -1,0 +1,200 @@
+"""Runtime lock-order witness (lockdep-style) — the dynamic complement
+to the static ``lockorder`` rule.
+
+The static rule can't see through dynamic dispatch (FencedStore's
+``__getattr__`` proxying, callbacks stored in variables), so its graph
+is an under-approximation. :class:`LockWitness` wraps the control
+plane's real locks and records every cross-thread acquisition ORDER
+actually taken while the chaos soaks run: acquiring ``B`` while holding
+``A`` adds the edge ``A -> B``. A cycle in the witnessed graph is a
+latent deadlock the soak merely got lucky on — ``chaos_soak.py
+--lock-witness`` fails the soak on one, and dumps the witnessed orders
+into ``bench_artifacts/`` next to the metrics scrapes.
+
+Locks are witnessed by ROLE (``LocalAgent._lock``), not by instance:
+lock-order discipline is a property of the code paths, so two agents'
+loop locks share a node and a fleet soak accumulates one class-level
+graph. Reentrant re-acquisition of the same role by the same thread is
+not an edge (RLocks are legal to re-take).
+
+Overhead is one thread-local list append plus, for new edges only, a
+short critical section — negligible next to the soak's sleeps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import traceback
+from typing import Optional
+
+
+def _site(skip_frames: int = 3) -> str:
+    """Compact "file:line (func)" of the acquiring frame, skipping the
+    witness's own frames."""
+    for frame in reversed(traceback.extract_stack()[:-skip_frames]):
+        fn = frame.filename.replace("\\", "/")
+        if "/analysis/lockwitness" in fn:
+            continue
+        short = "/".join(fn.rsplit("/", 2)[-2:])
+        return f"{short}:{frame.lineno} ({frame.name})"
+    return "?"
+
+
+class WitnessedLock:
+    """Duck-typed stand-in for threading.Lock/RLock that reports every
+    acquisition order to its witness."""
+
+    def __init__(self, inner, name: str, witness: "LockWitness"):
+        self._inner = inner
+        self._name = name
+        self._witness = witness
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._witness._acquired(self._name)
+        return got
+
+    def release(self) -> None:
+        self._witness._released(self._name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked() if hasattr(self._inner, "locked") \
+            else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class LockWitness:
+    """Cross-thread lock-acquisition-order recorder + cycle detector."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._meta = threading.Lock()
+        # (held, acquired) -> {"count": n, "site": first-site}
+        self._edges: dict[tuple, dict] = {}
+        self._names: set = set()
+
+    # -- recording ---------------------------------------------------------
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _acquired(self, name: str) -> None:
+        held = self._held()
+        is_new = name not in self._names or any(
+            h != name and (h, name) not in self._edges for h in held)
+        site = _site() if is_new else None
+        with self._meta:
+            self._names.add(name)
+            for h in held:
+                if h == name:
+                    continue  # reentrant re-take of the same role
+                entry = self._edges.setdefault(
+                    (h, name), {"count": 0, "site": site or _site()})
+                entry["count"] += 1
+        held.append(name)
+
+    def _released(self, name: str) -> None:
+        held = self._held()
+        # release the most recent hold of this role (locks nest)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                break
+
+    # -- instrumentation ---------------------------------------------------
+
+    def wrap(self, lock, name: str) -> WitnessedLock:
+        if isinstance(lock, WitnessedLock):
+            return lock  # idempotent across agent restarts in one soak
+        return WitnessedLock(lock, name, self)
+
+    def instrument(self, obj, role: Optional[str] = None,
+                   attrs: Optional[list] = None) -> None:
+        """Replace ``obj``'s lock attributes with witnessed wrappers.
+        Default attrs: every ``_*lock*`` attribute holding an acquirable
+        object. Must run before the object's threads start."""
+        role = role or type(obj).__name__
+        names = attrs if attrs is not None else [
+            a for a in vars(obj)
+            if "lock" in a.lower() and hasattr(getattr(obj, a), "acquire")]
+        for attr in names:
+            lock = getattr(obj, attr, None)
+            if lock is None or not hasattr(lock, "acquire"):
+                continue
+            setattr(obj, attr, self.wrap(lock, f"{role}.{attr}"))
+
+    def instrument_control_plane(self, *, store=None, agent=None) -> None:
+        """The curated control-plane lock set the soaks witness: the
+        store's writer + heartbeat-fold locks, the agent's loop + dirty
+        locks, and the reconciler's tracking + reconcile locks."""
+        if store is not None:
+            self.instrument(
+                store, role="Store",
+                attrs=["_transition_lock", "_train_lock", "_memory_lock"])
+        if agent is not None:
+            self.instrument(agent, role="LocalAgent",
+                            attrs=["_lock", "_dirty_lock"])
+            rec = getattr(agent, "reconciler", None)
+            if rec is not None:
+                self.instrument(
+                    rec, role="OperationReconciler",
+                    attrs=["_lock", "_reconcile_lock"])
+
+    # -- verdicts ----------------------------------------------------------
+
+    def edges(self) -> list[dict]:
+        with self._meta:
+            return [
+                {"from": a, "to": b, "count": e["count"],
+                 "first_site": e["site"]}
+                for (a, b), e in sorted(self._edges.items())]
+
+    def cycles(self) -> list[list]:
+        """Every distinct cycle in the witnessed order graph (each a
+        closed [a, b, ..., a] node list)."""
+        from .engine import find_cycles
+
+        with self._meta:
+            graph: dict[str, set] = {}
+            for a, b in self._edges:
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        return find_cycles(graph)
+
+    def report(self) -> dict:
+        cycles = self.cycles()
+        return {
+            "locks": sorted(self._names),
+            "edges": self.edges(),
+            "cycles": cycles,
+            "ok": not cycles,
+        }
+
+    def assert_no_cycles(self) -> None:
+        cycles = self.cycles()
+        if cycles:
+            raise AssertionError(
+                "witnessed lock-order cycle(s): "
+                + "; ".join(" -> ".join(c) for c in cycles))
+
+    def dump(self, path: str) -> dict:
+        report = self.report()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        return report

@@ -1,0 +1,21 @@
+"""Rule registry — one module per bug class (docs/ANALYSIS.md is the
+catalog with per-rule provenance)."""
+
+from .blocking_async import BlockingAsyncRule
+from .clock import ClockRule
+from .donation import DonationRule
+from .fence import FenceRule
+from .lockorder import LockOrderRule
+from .metrics_contract import MetricsContractRule
+
+ALL_RULES = (
+    FenceRule,          # R1 — unfenced store writes (PR 4/6)
+    LockOrderRule,      # R2 — lock-order cycles / self-deadlock (PR 6)
+    BlockingAsyncRule,  # R3 — blocking the event loop (PR 7)
+    ClockRule,          # R4 — wall clock in lease arithmetic (PR 1/4)
+    MetricsContractRule,  # R5 — metrics contract drift (PR 5/7)
+    DonationRule,       # R6 — donated-buffer reuse (PR 8)
+)
+
+__all__ = ["ALL_RULES", "FenceRule", "LockOrderRule", "BlockingAsyncRule",
+           "ClockRule", "MetricsContractRule", "DonationRule"]

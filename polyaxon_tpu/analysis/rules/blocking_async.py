@@ -1,0 +1,112 @@
+"""R3 — blocking calls on the event loop.
+
+Bug-class provenance (PR 7 hardening, "GET /store/snapshot wedged the
+loop"): ``Store.snapshot`` is O(whole database); running it inline in an
+async handler silenced ``/api/v1/changelog`` long enough to trip an
+attached standby's promote-on-silence rule — a false failover caused by
+a blocked event loop, not a dead primary. The fix routed it through
+``run_in_executor``; this rule keeps the class extinct.
+
+The rule flags calls from a contracted *blocking set* made directly in
+``async def`` bodies, anywhere in the tree (api/app.py and
+serve/server.py are where the loop lives today, but the discipline is
+universal). Code inside a nested **sync** ``def`` or ``lambda`` is
+exempt: that is exactly the executor-shipping idiom
+(``run_in_executor(None, _make)``) the fix introduced — the nested
+function runs on a worker thread, not the loop.
+
+The blocking set is deliberately contracted (sleep / subprocess /
+sqlite / fsync / sync-HTTP / store snapshot-class calls), not "anything
+that touches a file": flagging every small artifact read would bury the
+O(database) findings this rule exists for. Extend ``BLOCKING_CALLS``
+when a new class bites.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import (Finding, Project, Rule, call_target, dotted_name,
+                      import_aliases)
+
+#: resolved dotted call targets that block the calling thread
+BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "os.fsync", "os.sync",
+    "sqlite3.connect",
+    "socket.create_connection",
+    "shutil.rmtree", "shutil.copy", "shutil.copy2", "shutil.copyfile",
+    "shutil.copytree", "shutil.move",
+})
+
+#: any call into these modules blocks (sync HTTP, child processes)
+BLOCKING_MODULES = ("subprocess", "requests", "urllib.request")
+
+#: store verbs that are O(whole database): blocking on any receiver
+#: whose dotted name mentions a store
+STORE_HEAVY_VERBS = ("snapshot", "snapshot_to", "compact_changelog")
+
+
+def _blocking_reason(call: ast.Call, aliases: dict) -> str | None:
+    target = call_target(call, aliases)
+    if target is not None:
+        if target in BLOCKING_CALLS:
+            return f"{target}() blocks the event loop"
+        head = target.split(".")[0]
+        if head in BLOCKING_MODULES or target.rsplit(".", 1)[0] in \
+                BLOCKING_MODULES:
+            return f"{target}() is synchronous ({head}) and blocks the loop"
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in STORE_HEAVY_VERBS:
+        recv = dotted_name(call.func.value) or ""
+        if "store" in recv.lower():
+            return (f"{recv}.{call.func.attr}() is O(whole database) — "
+                    "the PR-7 blocked-loop false-promotion class")
+    return None
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """Walk one async def's own body: nested sync defs/lambdas are the
+    executor idiom and are skipped; nested async defs are visited as
+    loop code too."""
+
+    def __init__(self, rule, sf, aliases, out):
+        self.rule, self.sf, self.aliases, self.out = rule, sf, aliases, out
+
+    def visit_FunctionDef(self, node):
+        return  # sync nested def: shipped to an executor, off the loop
+
+    def visit_AsyncFunctionDef(self, node):
+        return  # visited by the module-level walk in its own right
+
+    def visit_Lambda(self, node):
+        return
+
+    def visit_Call(self, node: ast.Call):
+        reason = _blocking_reason(node, self.aliases)
+        if reason is not None:
+            self.out.append(Finding(
+                rule=self.rule.name, path=self.sf.rel, line=node.lineno,
+                col=node.col_offset,
+                message=(f"blocking call in async def: {reason}; route it "
+                         "through run_in_executor"),
+            ))
+        self.generic_visit(node)
+
+
+class BlockingAsyncRule(Rule):
+    name = "asyncblock"
+    title = "no blocking calls directly on the event loop"
+
+    def check(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            aliases = import_aliases(sf.tree)
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.AsyncFunctionDef):
+                    v = _AsyncBodyVisitor(self, sf, aliases, out)
+                    for stmt in node.body:
+                        v.visit(stmt)
+        return out

@@ -1,0 +1,75 @@
+"""R4 — clock discipline: wall clock is forbidden in control-plane code.
+
+Bug-class provenance (PR 1's de-flaking round onward, re-audited every
+PR since leases landed in PR 4): lease TTLs, renewal deadlines, zombie
+windows, watchdog stalls and freshness horizons are all *durations on
+one machine* — ``time.time()`` arithmetic there is wrong twice over
+(NTP steps move it backwards; leap smearing stretches it), and the
+failure is a false demotion or a false zombie reap under exactly the
+conditions a chaos soak creates. ``time.monotonic()`` is the contract.
+
+The rule inverts the usual lint default: inside the control-plane
+modules (``api/``, ``scheduler/``, ``operator/``, ``resilience/``, plus
+the serve engine and the train watchdog — the module set where every
+timestamp is lease/TTL/deadline-adjacent) EVERY ``time.time()`` /
+``datetime.now()`` call is a finding unless it carries a written
+justification. Legitimate wall-clock uses exist — timestamps persisted
+for humans (run meta, span clocks correlated across machines, file
+mtimes) — and each one is exactly what the suppression syntax is for:
+
+    meta["at"] = time.time()  # plx: allow(clock): persisted for humans
+
+so the exemption is visible, justified, and reviewed at the call site
+instead of silently ambient.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Project, Rule, call_target, import_aliases
+
+#: control-plane scope: path prefixes (after stripping the package dir)
+SCOPE_PREFIXES = ("api/", "scheduler/", "operator/", "resilience/")
+#: plus individual clock-sensitive modules outside those trees
+SCOPE_FILES = ("train/watchdog.py", "serve/engine.py", "serve/kv_cache.py")
+
+#: resolved call targets that read the wall clock
+WALL_CLOCK = frozenset({
+    "time.time",
+    "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+})
+
+
+def _in_scope(rel: str) -> bool:
+    rel = rel.split("polyaxon_tpu/", 1)[-1]
+    return rel.startswith(SCOPE_PREFIXES) or rel in SCOPE_FILES
+
+
+class ClockRule(Rule):
+    name = "clock"
+    title = "monotonic clocks in lease/TTL/deadline arithmetic"
+
+    def check(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in project.files:
+            if sf.tree is None or not _in_scope(sf.rel):
+                continue
+            aliases = import_aliases(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = call_target(node, aliases)
+                if target in WALL_CLOCK:
+                    out.append(Finding(
+                        rule=self.name, path=sf.rel, line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"wall clock ({target}()) in a control-plane "
+                            "module: lease/TTL/deadline arithmetic must "
+                            "use time.monotonic(); persisted human-facing "
+                            "timestamps need an inline justification "
+                            "(`# plx: allow(clock): ...`)"),
+                    ))
+        return out

@@ -1,0 +1,289 @@
+"""R6 — donation safety: a donated buffer is dead after the call.
+
+Bug-class provenance (PR 8's trainer rollback work): the train step is
+``jax.jit(step_fn, donate_argnums=(0,))`` — the old ``TrainState``'s
+buffers are donated to the new one, and on TPU reading the donated
+array afterwards returns garbage (or raises under
+``jax_debug_nans``-style configs) — CPU tests pass because XLA:CPU may
+decline the donation, which is what makes the class survive review.
+The divergence-rollback path had to be written carefully so the
+pre-step state needed for the in-jit select lives INSIDE the jitted
+function; this rule keeps anyone from re-introducing a host-side read
+of the donated argument.
+
+Detection, project-wide:
+
+- donating callables: ``X = jax.jit(f, donate_argnums=...)`` records
+  both ``X`` and ``f``; a ``@partial(jax.jit, donate_argnums=...)``
+  decorator records the decorated function's name (donated indices from
+  the literal int/tuple);
+- at every call of a recorded name: for each donated positional arg
+  that is a plain variable, any later *read* of that variable in the
+  same function body — before a rebinding — is a finding. A call whose
+  own assignment rebinds the variable (``state, m = step(state, b)``)
+  is the sanctioned idiom and starts the name clean.
+
+Names are matched per terminal identifier (``self._compiled_step(...)``
+matches a recorded ``_compiled_step``), which is deliberately
+conservative-in-scope: a same-named non-donating function elsewhere
+would need an inline ``plx: allow(donation)`` — cheap, explicit, and
+much better than missing a real donation bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..engine import Finding, Project, Rule, dotted_name
+
+
+def _donate_indices(call: ast.Call) -> Optional[tuple]:
+    """The literal donate_argnums of a jax.jit(...) call, else None."""
+    fn = dotted_name(call.func) or ""
+    if fn.rsplit(".", 1)[-1] != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                v = ast.literal_eval(kw.value)
+            except ValueError:
+                return None
+            if isinstance(v, int):
+                return (v,)
+            if isinstance(v, (tuple, list)):
+                return tuple(int(i) for i in v)
+    return None
+
+
+def _partial_jit_donations(deco: ast.AST,
+                           fn_node: ast.AST) -> Optional[tuple]:
+    """Donated positional indices from a ``partial(jax.jit,
+    donate_argnums=...)`` / ``donate_argnames=...`` decorator —
+    argnames are resolved against the decorated function's signature
+    (the serve decode/prefill form)."""
+    if not isinstance(deco, ast.Call):
+        return None
+    fn = dotted_name(deco.func) or ""
+    if fn.rsplit(".", 1)[-1] != "partial":
+        return None
+    if not deco.args:
+        return None
+    head = dotted_name(deco.args[0]) or ""
+    if head.rsplit(".", 1)[-1] != "jit":
+        return None
+    for kw in deco.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                v = ast.literal_eval(kw.value)
+            except ValueError:
+                return None
+            return (v,) if isinstance(v, int) else tuple(v)
+        if kw.arg == "donate_argnames":
+            try:
+                names = ast.literal_eval(kw.value)
+            except ValueError:
+                return None
+            if isinstance(names, str):
+                names = (names,)
+            params = [a.arg for a in (fn_node.args.posonlyargs
+                                      + fn_node.args.args)]
+            return tuple(params.index(n) for n in names
+                         if n in params) or None
+    return None
+
+
+def _collect_donating_names(project: Project) -> dict[str, tuple]:
+    """terminal identifier -> donated positional indices."""
+    out: dict[str, tuple] = {}
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                idx = _donate_indices(node.value)
+                if idx is None:
+                    continue
+                for t in node.targets:
+                    name = dotted_name(t)
+                    if name is not None:
+                        out[name.rsplit(".", 1)[-1]] = idx
+                # the wrapped function is donating too (it may be called
+                # under its own name after being jitted in place)
+                if node.value.args:
+                    wrapped = dotted_name(node.value.args[0])
+                    if wrapped is not None:
+                        out[wrapped.rsplit(".", 1)[-1]] = idx
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    idx = _partial_jit_donations(deco, node)
+                    if idx is not None:
+                        out[node.name] = idx
+    return out
+
+
+def _pos(node: ast.AST) -> tuple:
+    return (node.lineno, node.col_offset)
+
+
+def _stmt_chain(fn: ast.AST, target: ast.AST):
+    """The chain of (stmt_list, index) locating the statement containing
+    ``target`` at every nesting level of ``fn``'s body — the structural
+    'what executes after this call' input. None when not found."""
+    chain: list = []
+
+    def contains(n) -> bool:
+        return any(sub is target for sub in ast.walk(n))
+
+    def blocks_of(stmt):
+        for _, value in ast.iter_fields(stmt):
+            if isinstance(value, list) and value:
+                if isinstance(value[0], ast.stmt):
+                    yield value
+                elif isinstance(value[0], ast.excepthandler):
+                    for h in value:
+                        yield h.body
+
+    def descend(body) -> bool:
+        for i, stmt in enumerate(body):
+            if not contains(stmt):
+                continue
+            chain.append((body, i, stmt))
+            for blk in blocks_of(stmt):
+                if descend(blk):
+                    break
+            return True
+        return False
+
+    return chain if descend(list(fn.body)) else None
+
+
+class _NameUse(ast.NodeVisitor):
+    """All (position, ctx) uses of one variable name in a function."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.loads: list[tuple] = []
+        self.stores: list[tuple] = []
+
+    def visit_Name(self, node: ast.Name):
+        if node.id == self.name:
+            if isinstance(node.ctx, ast.Load):
+                self.loads.append(_pos(node))
+            else:
+                self.stores.append(_pos(node))
+
+    def visit_FunctionDef(self, node):
+        return  # a nested scope's name is a different variable
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+class DonationRule(Rule):
+    name = "donation"
+    title = "donated jit buffers must not be read after the call"
+
+    def check(self, project: Project) -> list[Finding]:
+        donating = _collect_donating_names(project)
+        if not donating:
+            return []
+        out: list[Finding] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for fn in ast.walk(sf.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._check_function(sf, fn, donating, out)
+        return out
+
+    def _check_function(self, sf, fn, donating, out) -> None:
+        # names rebound by the statement that CONTAINS each call — the
+        # sanctioned `state, m = step(state, b)` idiom rebinds the donated
+        # name at the call itself and starts it clean
+        calls = []
+        for stmt in ast.walk(fn):
+            if not isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign, ast.Expr)):
+                continue
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            rebound = set()
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        rebound.add(sub.id)
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                idx = donating.get(name.rsplit(".", 1)[-1])
+                if idx is not None:
+                    calls.append((node, name, idx, rebound))
+        # donating calls outside assignment/expression statements
+        # (return / if / while headers): no rebinding at the call
+        seen = {id(c) for c, *_ in calls}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and id(node) not in seen:
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                idx = donating.get(name.rsplit(".", 1)[-1])
+                if idx is not None:
+                    calls.append((node, name, idx, set()))
+        for call, cname, indices, rebound_at_call in calls:
+            chain = _stmt_chain(fn, call)
+            if chain is None:
+                continue
+            # a call under return/raise has no same-path code after it —
+            # sibling statements that FOLLOW textually run only on paths
+            # that never executed the donation
+            if any(isinstance(stmt, (ast.Return, ast.Raise))
+                   for _, _, stmt in chain):
+                continue
+            # statements that structurally execute after the call: the
+            # suffix of every enclosing block. A read in a MUTUALLY
+            # EXCLUSIVE branch (the else of the call's if) is not after
+            # the call and must not be flagged.
+            following = [s for body, i, _ in chain for s in body[i + 1:]]
+            for i in indices:
+                if i >= len(call.args):
+                    continue
+                arg = call.args[i]
+                if not isinstance(arg, ast.Name):
+                    continue
+                if arg.id in rebound_at_call:
+                    continue  # rebound by the call's own assignment
+                uses = _NameUse(arg.id)
+                for stmt in following:
+                    uses.visit(stmt)
+                # plus the tail of the call's own statement (an
+                # expression reading the name after the call inline)
+                intra = _NameUse(arg.id)
+                intra.visit(chain[-1][2])
+                call_end = (call.end_lineno, call.end_col_offset)
+                uses.loads.extend(p for p in intra.loads if p > call_end)
+                rebinds = [p for p in uses.stores if p > call_end]
+                horizon = min(rebinds) if rebinds else None
+                for load in sorted(set(uses.loads)):
+                    if load <= call_end:
+                        continue
+                    if horizon is not None and load > horizon:
+                        break
+                    out.append(Finding(
+                        rule=self.name, path=sf.rel, line=load[0],
+                        col=load[1],
+                        message=(
+                            f"use of {arg.id!r} after it was donated to "
+                            f"{cname}() (donate_argnums includes {i}): "
+                            "the buffer is invalidated by XLA donation — "
+                            "read it before the call or thread it through "
+                            "the jitted function"),
+                    ))
+        return

@@ -1,0 +1,160 @@
+"""R1 — fence-bypass: unfenced store writes from control-plane drivers.
+
+Bug-class provenance (PR 4/6 hardening rounds): every lifecycle write a
+scheduling component makes must carry the writer's CURRENT lease fence,
+or a stale incarnation keeps mutating runs a successor already owns. The
+repo's design answer is the :class:`FencedStore` proxy — the agent wraps
+the raw store once and hands THAT down to everything writing on its
+behalf (reaper, pipeline drivers, executor callbacks), under the
+canonical attribute name ``store``.
+
+The rule enforces the discipline statically, in the driver modules
+(``scheduler/``, ``operator/``, ``resilience/heartbeat.py``): a store
+write verb may be called only
+
+- on a receiver whose provenance is a ``FencedStore(...)`` construction
+  (tracked through ``self.X = FencedStore(...)`` and local assignments),
+- on the canonical handle (``self.store`` / bare ``store``) — the name
+  the fenced proxy travels under; a class that binds ``self.store``
+  directly from a raw ``Store(...)`` construction loses the exemption,
+- or with an explicit ``fence=`` argument.
+
+Writing through anything else — a raw ``Store(...)`` value, an
+``_inner`` access that reaches around the proxy, a stashed raw reference
+like ``_store_ref`` — is the historical bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Project, Rule, dotted_name
+
+#: must stay a superset of FencedStore._FENCED (asserted in
+#: tests/test_analysis.py so the two lists cannot drift apart)
+WRITE_VERBS = frozenset({
+    "create_run", "create_runs", "transition", "transition_many",
+    "update_run", "merge_outputs", "record_launch_intent",
+    "mark_launched", "adopt_launch",
+})
+
+#: root-relative path prefixes where the discipline applies — the
+#: modules that drive run lifecycles on an agent's behalf
+SCOPE_PREFIXES = ("scheduler/", "operator/", "resilience/heartbeat.py")
+
+#: receivers trusted by convention: the fenced proxy's canonical names
+CANONICAL = ("self.store", "store")
+
+
+def _in_scope(rel: str) -> bool:
+    # both the package layout (polyaxon_tpu/scheduler/...) and the
+    # corpus layout (scheduler/...) must match
+    rel = rel.split("polyaxon_tpu/", 1)[-1]
+    return rel.startswith(SCOPE_PREFIXES)
+
+
+class _ClassInfo(ast.NodeVisitor):
+    """Provenance of ``self.X`` attributes and locals within one class:
+    which names hold a FencedStore, which hold a raw Store."""
+
+    def __init__(self):
+        self.fenced: set[str] = set()   # "self.x" / "x"
+        self.raw: set[str] = set()
+
+    def classify(self, target: str, value: ast.AST) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        ctor = dotted_name(value.func) or ""
+        tail = ctor.rsplit(".", 1)[-1]
+        if tail == "FencedStore":
+            self.fenced.add(target)
+        elif tail in ("Store", "FaultyStore", "OutageStore"):
+            self.raw.add(target)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            name = dotted_name(t)
+            if name is not None:
+                self.classify(name, node.value)
+        self.generic_visit(node)
+
+
+def _walk_pruning_classes(node):
+    """ast.walk that does NOT descend into nested ClassDefs — each class
+    is analyzed with its own _ClassInfo; re-walking its body from the
+    module scope would double-report and lose the class's provenance."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, ast.ClassDef):
+            continue
+        yield child
+        yield from _walk_pruning_classes(child)
+
+
+class FenceRule(Rule):
+    name = "fence"
+    title = "store writes from driver modules must be fenced"
+
+    def check(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in project.files:
+            if sf.tree is None or not _in_scope(sf.rel):
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    info = _ClassInfo()
+                    info.visit(node)
+                    self._check_scope(sf, node, info, out)
+            # module-level / function-level code outside classes (class
+            # bodies pruned: they were just checked with their own info)
+            info = _ClassInfo()
+            for node in sf.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    info.visit(node)
+            self._check_scope(sf, sf.tree, info, out, skip_classes=True)
+        return out
+
+    def _check_scope(self, sf, scope, info: _ClassInfo,
+                     out: list[Finding], skip_classes: bool = False) -> None:
+        # prune nested ClassDefs in BOTH passes: every class is checked
+        # exactly once, with its own provenance info
+        for node in _walk_pruning_classes(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in WRITE_VERBS:
+                continue
+            if any(kw.arg == "fence" for kw in node.keywords):
+                continue
+            recv = dotted_name(func.value)
+            if recv is not None:
+                if recv in info.fenced:
+                    continue
+                if "_inner" in recv.split("."):
+                    pass  # reaching around the proxy: always flagged
+                elif recv in info.raw:
+                    pass  # raw Store provenance: flagged
+                elif recv in CANONICAL:
+                    continue  # the fenced handle's canonical name
+                elif recv.startswith("self.") and skip_classes:
+                    continue  # free function on an unknown object
+            else:
+                # chained construction: Store(...).transition(...)
+                inner = func.value
+                ctor = (dotted_name(inner.func)
+                        if isinstance(inner, ast.Call) else None)
+                if ctor is None or not ctor.endswith("Store"):
+                    continue
+                if ctor.rsplit(".", 1)[-1] == "FencedStore":
+                    continue
+                recv = ctor + "(...)"
+            out.append(Finding(
+                rule=self.name, path=sf.rel, line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"unfenced store write: {recv}.{func.attr}(...) in a "
+                    "driver module bypasses the FencedStore proxy — write "
+                    "through the agent's fenced `store` handle or pass "
+                    "fence= explicitly"),
+            ))

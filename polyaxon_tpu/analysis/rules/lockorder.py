@@ -1,0 +1,356 @@
+"""R2 — lock-order cycles and self-deadlocks in the static lock graph.
+
+Bug-class provenance (PR 6 hardening, "demotion self-deadlock"): a
+FencedStore ``on_stale`` callback fired on a writer thread that already
+held the agent lock, and the demotion bookkeeping tried to take the same
+non-reentrant lock again — a self-deadlock only reachable under a
+takeover race. The fix (two-phase demotion) is exactly the discipline
+this rule checks: never *acquire* a lock on a path that may already hold
+it, and never acquire two locks in opposite orders on two paths.
+
+Construction of the graph, per class (plus module-level locks):
+
+- lock attributes are ``self.X = threading.Lock()/RLock()/Condition()``
+  assignments (module-level: ``X = threading.Lock()``);
+- every ``with self.X:`` block contributes edges ``X -> Y`` for each
+  lock ``Y`` acquired inside the block — directly, or transitively
+  through calls the block makes (``self.m()`` same-class methods,
+  ``self.attr.m()`` where ``self.attr = SomeClass(...)`` resolves to an
+  analyzed class, and module-level functions);
+- a non-reentrant lock reachable from inside its own hold is a
+  self-deadlock finding; a cycle among distinct locks is a lock-order
+  finding (reported once per cycle, at its first edge's site);
+- ``KNOWN_BAD_ORDERS`` pins orders that are forbidden even without the
+  reverse edge in today's tree — the PR-6 class (store writer lock held
+  while reaching for the agent loop lock) must never come back.
+
+Known blind spot (why the runtime witness exists): calls that cross the
+``FencedStore`` proxy's dynamic ``__getattr__`` dispatch, callbacks
+stored in variables, and cross-process lock interactions are invisible
+statically. ``analysis.lockwitness.LockWitness`` records the ACTUAL
+cross-thread acquisition orders during the chaos soaks and fails them on
+a cycle — static analysis proposes, the soak witnesses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..engine import Finding, Project, Rule, dotted_name
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+
+#: (holding, acquiring) qualified-name suffix pairs that are findings
+#: even without a reverse edge — each encodes a historical deadlock
+KNOWN_BAD_ORDERS = (
+    # PR-6 demotion class: the store's writer lock is held across every
+    # transition batch; reaching for the agent's loop lock from inside it
+    # (e.g. a transition listener taking agent state) inverts the only
+    # sanctioned order (agent lock -> store write) and deadlocks with any
+    # pass that writes while holding the agent lock.
+    ("Store._transition_lock", "LocalAgent._lock"),
+)
+
+
+class _ClassGraph:
+    """Locks, methods, and attr->class typing for one class (or the
+    module pseudo-class for top-level functions/locks)."""
+
+    def __init__(self, qual: str):
+        self.qual = qual
+        self.locks: dict[str, str] = {}       # attr/name -> kind
+        self.methods: dict[str, ast.AST] = {}
+        self.attr_types: dict[str, str] = {}  # attr -> class name
+
+
+def _scan_class(qual: str, body: list, is_module: bool) -> _ClassGraph:
+    g = _ClassGraph(qual)
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            g.methods[node.name] = node
+            if not is_module:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        _classify_assign(g, sub, "self.")
+        elif isinstance(node, ast.Assign):
+            _classify_assign(g, node, "" if is_module else "self.")
+    return g
+
+
+def _classify_assign(g: _ClassGraph, node: ast.Assign, prefix: str) -> None:
+    if not isinstance(node.value, ast.Call):
+        return
+    ctor = dotted_name(node.value.func) or ""
+    tail = ctor.rsplit(".", 1)[-1]
+    for t in node.targets:
+        name = dotted_name(t)
+        if name is None:
+            continue
+        if prefix and not name.startswith(prefix):
+            continue
+        short = name[len(prefix):]
+        if "." in short:
+            continue
+        if tail in _LOCK_CTORS and ("threading" in ctor
+                                    or ctor == tail):
+            g.locks[short] = _LOCK_CTORS[tail]
+        elif tail and tail[0].isupper():
+            g.attr_types[short] = tail
+
+
+def _lock_of(expr: ast.AST, g: _ClassGraph) -> Optional[str]:
+    """The lock attr name when ``expr`` is ``self.X``/(module) ``X`` for
+    a known lock of this scope, else None."""
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    if name.startswith("self."):
+        name = name[5:]
+    return name if name in g.locks else None
+
+
+def _walk_same_context(node):
+    """``node`` and its descendants, EXCLUDING nested function/lambda/
+    class bodies: a closure built under a lock runs later (typically on
+    another thread after release) — treating its acquisitions as
+    happening inside the hold fabricates self-deadlocks. Deferred
+    closures are the runtime witness's territory."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda, ast.ClassDef)):
+        return  # a def statement only BINDS the closure; nothing runs
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_same_context(child)
+
+
+def _explicit_acquire(call: ast.Call, g: _ClassGraph) -> Optional[str]:
+    """``self.X.acquire()`` on a known lock — an acquisition point for
+    edge purposes (held-state past the call is not tracked; the runtime
+    witness owns acquire/release flow)."""
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "acquire":
+        return _lock_of(call.func.value, g)
+    return None
+
+
+class _Site:
+    __slots__ = ("rel", "line", "path")
+
+    def __init__(self, rel: str, line: int, path: list[str]):
+        self.rel, self.line, self.path = rel, line, path
+
+
+def _render(node: tuple) -> str:
+    """(file, class, lock) -> "Class.lock" for human messages."""
+    return f"{node[1]}.{node[2]}"
+
+
+class LockOrderRule(Rule):
+    name = "lockorder"
+    title = "static lock-acquisition graph: cycles / self-deadlocks"
+
+    def check(self, project: Project) -> list[Finding]:
+        # graphs are keyed by (file, class) — same-named classes in two
+        # files must not merge (their edges would fabricate cycles)
+        graphs: dict[tuple, _ClassGraph] = {}
+        name_index: dict[str, list] = {}
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            mod = sf.rel.rsplit("/", 1)[-1][:-3]
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    key = (sf.rel, node.name)
+                    graphs[key] = _scan_class(
+                        node.name, node.body, is_module=False)
+                    name_index.setdefault(node.name, []).append(key)
+            g = _scan_class(mod, sf.tree.body, is_module=True)
+            if g.locks or g.methods:
+                key = (sf.rel, mod)
+                graphs.setdefault(key, g)
+                name_index.setdefault(mod, []).append(key)
+
+        self._graphs = graphs
+        self._name_index = name_index
+        self._memo: dict[tuple, dict] = {}
+
+        # edges: (held_node, acquired_node) -> _Site; nodes are
+        # (file, class, lock) tuples rendered as "Class.lock"
+        edges: dict[tuple, _Site] = {}
+        findings: list[Finding] = []
+        for key, g in graphs.items():
+            rel, cls = key
+            for mname, mnode in g.methods.items():
+                for w in ast.walk(mnode):
+                    if not isinstance(w, ast.With):
+                        continue
+                    held = [_lock_of(item.context_expr, g)
+                            for item in w.items]
+                    held = [h for h in held if h is not None]
+                    if not held:
+                        continue
+                    # multi-item with: left acquires before right
+                    for i in range(len(held) - 1):
+                        edges.setdefault(
+                            (key + (held[i],), key + (held[i + 1],)),
+                            _Site(rel, w.lineno, []))
+                    inner = self._reachable_in_body(
+                        key, w.body, [f"{cls}.{mname}"])
+                    for h in held:
+                        hq = key + (h,)
+                        for acq, (line, path) in inner.items():
+                            if acq == hq:
+                                if g.locks[h] != "lock":
+                                    continue  # reentrant: safe to re-take
+                                findings.append(Finding(
+                                    rule=self.name, path=rel, line=w.lineno,
+                                    message=(
+                                        f"self-deadlock: non-reentrant "
+                                        f"{_render(hq)} is re-acquired "
+                                        f"while held "
+                                        f"(via {' -> '.join(path)})"),
+                                ))
+                                continue
+                            edges.setdefault(
+                                (hq, acq), _Site(rel, line, path))
+
+        findings.extend(self._known_bad(edges))
+        findings.extend(self._cycles(edges))
+        return findings
+
+    # -- reachability ------------------------------------------------------
+
+    def _reachable_in_body(self, key: tuple, body: list,
+                           path: list[str]) -> dict:
+        """Locks acquired anywhere inside ``body`` (a with-block), keyed
+        by lock node (file, class, lock) -> (line, call path)."""
+        out: dict[tuple, tuple] = {}
+        g = self._graphs[key]
+        for node in body:
+            for sub in _walk_same_context(node):
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        lk = _lock_of(item.context_expr, g)
+                        if lk is not None:
+                            out.setdefault(
+                                key + (lk,), (sub.lineno, list(path)))
+                elif isinstance(sub, ast.Call):
+                    lk = _explicit_acquire(sub, g)
+                    if lk is not None:
+                        out.setdefault(
+                            key + (lk,), (sub.lineno, list(path)))
+                    for tgt in self._resolve_call(key, sub):
+                        for acq, pp in self._method_locks(*tgt).items():
+                            out.setdefault(
+                                acq, (sub.lineno, list(path) + pp))
+        return out
+
+    def _resolve_key(self, name: str, near: tuple) -> Optional[tuple]:
+        """A class name -> graph key, preferring the same file as
+        ``near`` (same-named classes in other files stay distinct)."""
+        keys = self._name_index.get(name)
+        if not keys:
+            return None
+        for k in keys:
+            if k[0] == near[0]:
+                return k
+        return keys[0]
+
+    def _resolve_call(self, key: tuple, call: ast.Call) -> list[tuple]:
+        """Resolve a call inside graph ``key`` to [(key, method)]."""
+        name = dotted_name(call.func)
+        if name is None:
+            return []
+        g = self._graphs[key]
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            if parts[1] in g.methods:
+                return [(key, parts[1])]
+            return []
+        if parts[0] == "self" and len(parts) == 3:
+            tk = self._resolve_key(g.attr_types.get(parts[1], ""), key)
+            if tk is not None and parts[2] in self._graphs[tk].methods:
+                return [(tk, parts[2])]
+            return []
+        if len(parts) == 1 and parts[0] in g.methods:
+            # module-level function calling a sibling module function
+            return [(key, parts[0])]
+        return []
+
+    def _method_locks(self, key: tuple, method: str,
+                      _stack: Optional[frozenset] = None) -> dict:
+        """Every lock acquired anywhere in (key, method), transitively
+        through resolvable calls: lock node -> call path (frames)."""
+        mkey = (key, method)
+        if mkey in self._memo:
+            return self._memo[mkey]
+        stack = _stack or frozenset()
+        if mkey in stack:
+            return {}
+        stack = stack | {mkey}
+        g = self._graphs[key]
+        node = g.methods[method]
+        frame = f"{key[1]}.{method}"
+        out: dict[tuple, list] = {}
+        # walk the method's own execution context only: a nested def's
+        # acquisitions happen when IT runs, not when this method does
+        for stmt in node.body:
+            self._scan_exec_context(stmt, key, g, frame, out, stack)
+        if _stack is None:
+            self._memo[mkey] = out
+        return out
+
+    def _scan_exec_context(self, root, key, g, frame, out, stack) -> None:
+        for sub in _walk_same_context(root):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    lk = _lock_of(item.context_expr, g)
+                    if lk is not None:
+                        out.setdefault(key + (lk,), [frame])
+            elif isinstance(sub, ast.Call):
+                lk = _explicit_acquire(sub, g)
+                if lk is not None:
+                    out.setdefault(key + (lk,), [frame])
+                for tk, tm in self._resolve_call(key, sub):
+                    sub_locks = self._method_locks(tk, tm, stack)
+                    for acq, pp in sub_locks.items():
+                        out.setdefault(acq, [frame] + pp)
+
+    # -- graph verdicts ----------------------------------------------------
+
+    def _known_bad(self, edges: dict) -> list[Finding]:
+        out = []
+        for (a, b), site in sorted(edges.items()):
+            for bad_a, bad_b in KNOWN_BAD_ORDERS:
+                if _render(a) == bad_a and _render(b) == bad_b:
+                    out.append(Finding(
+                        rule=self.name, path=site.rel, line=site.line,
+                        message=(
+                            f"forbidden lock order: {_render(a)} held "
+                            f"while acquiring {_render(b)} "
+                            f"(via {' -> '.join(site.path) or 'direct'}) — "
+                            "the PR-6 demotion-deadlock class"),
+                    ))
+        return out
+
+    def _cycles(self, edges: dict) -> list[Finding]:
+        from ..engine import find_cycles
+
+        graph: dict[tuple, set] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        out = []
+        for trail in find_cycles(graph):
+            if len(trail) <= 2:
+                continue  # self-loops are the self-deadlock finding
+            first = min(
+                (e for e in zip(trail, trail[1:]) if e in edges),
+                key=lambda e: (edges[e].rel, edges[e].line))
+            site = edges[first]
+            out.append(Finding(
+                rule=self.name, path=site.rel, line=site.line,
+                message=("lock-order cycle: "
+                         + " -> ".join(_render(n) for n in trail)),
+            ))
+        return out

@@ -1,0 +1,186 @@
+"""R5 — metrics contract: naming, typing, and drift against the tests.
+
+Bug-class provenance (PR 5/7 hardening rounds): the chaos-injected
+counter was registered as a Gauge (monotonic + ``_total`` but not
+counter-typed — ``rate()`` over it is wrong), and later PRs kept
+catching families that landed in code but never in
+``test_obs.EXPECTED_FAMILIES`` or docs/OBSERVABILITY.md — contract
+drift a reviewer has to notice by reading three files at once. This
+rule reads all three.
+
+Per registration site (any ``.counter("polyaxon_...")`` /
+``.gauge(...)`` / ``.histogram(...)`` call whose family-name literal
+starts with ``polyaxon_``):
+
+- names are snake_case;
+- a family ending ``_total`` must be a Counter, and a Counter must end
+  ``_total`` (the Prometheus monotonicity convention ``rate()`` relies
+  on);
+- histograms carry a unit suffix (``_seconds`` today);
+- live-tree only (when tests/test_obs.py + docs/OBSERVABILITY.md exist
+  under the analysis root): every literal family must appear in
+  docs/OBSERVABILITY.md, and every family contracted in
+  ``EXPECTED_FAMILIES`` must still be registered somewhere (a renamed
+  family with a stale test contract is exactly the drift PR 7 shipped).
+  f-string registrations (the store's ``stats`` export loop) are
+  checked on their literal parts and matched as wildcards.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from ..engine import Finding, Project, Rule
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+_REGISTER_ATTRS = {"counter": "counter", "gauge": "gauge",
+                   "histogram": "histogram"}
+_HIST_UNITS = ("_seconds", "_bytes", "_ratio")
+
+
+def _name_parts(node: ast.AST) -> Optional[list]:
+    """The family-name argument as [literal or None, ...] pieces; None
+    for non-string args."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.append(v.value)
+            else:
+                out.append(None)
+        return out
+    return None
+
+
+class _Registration:
+    def __init__(self, sf, node, mtype, parts):
+        self.sf, self.node, self.mtype, self.parts = sf, node, mtype, parts
+        self.literal = ("".join(parts) if None not in parts else None)
+
+    @property
+    def display(self) -> str:
+        return self.literal or "".join(
+            p if p is not None else "{…}" for p in self.parts)
+
+    def matches(self, family: str) -> bool:
+        """Whether this registration can produce ``family`` (wildcard
+        match for f-strings)."""
+        if self.literal is not None:
+            return self.literal == family
+        pat = "".join(re.escape(p) if p is not None else ".+"
+                      for p in self.parts)
+        return re.fullmatch(pat, family) is not None
+
+
+class MetricsContractRule(Rule):
+    name = "metrics"
+    title = "Prometheus family naming/typing/contract consistency"
+
+    def check(self, project: Project) -> list[Finding]:
+        regs: list[_Registration] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _REGISTER_ATTRS
+                        and node.args):
+                    continue
+                parts = _name_parts(node.args[0])
+                if parts is None:
+                    continue
+                head = next((p for p in parts if p is not None), "")
+                if not head.startswith("polyaxon_"):
+                    continue
+                regs.append(_Registration(
+                    sf, node, _REGISTER_ATTRS[node.func.attr], parts))
+
+        out: list[Finding] = []
+        for r in regs:
+            out.extend(self._check_shape(r))
+        out.extend(self._check_drift(project, regs))
+        return out
+
+    def _check_shape(self, r: _Registration) -> list[Finding]:
+        out = []
+        name = r.literal
+        if name is not None and not _SNAKE.match(name):
+            out.append(self._f(r, f"family {name!r} is not snake_case"))
+        # suffix/type contract works on the literal TAIL even for
+        # f-strings (the store loop's `_total` suffix is literal)
+        tail = r.parts[-1] if r.parts[-1] is not None else ""
+        head_known = r.literal is not None
+        if tail.endswith("_total") and r.mtype != "counter":
+            out.append(self._f(
+                r, f"family {r.display!r} ends _total (monotonic by "
+                   f"convention) but is registered as a {r.mtype} — "
+                   "rate()/increase() need a counter-typed family"))
+        if head_known and r.mtype == "counter" \
+                and not name.endswith("_total"):
+            out.append(self._f(
+                r, f"counter family {name!r} must end _total"))
+        if head_known and r.mtype == "histogram" \
+                and not name.endswith(_HIST_UNITS):
+            out.append(self._f(
+                r, f"histogram family {name!r} carries no unit suffix "
+                   f"(expected one of {', '.join(_HIST_UNITS)})"))
+        return out
+
+    def _check_drift(self, project: Project,
+                     regs: list[_Registration]) -> list[Finding]:
+        """Cross-file contract checks — live tree only."""
+        out: list[Finding] = []
+        docs = project.read_rootfile("docs", "OBSERVABILITY.md")
+        test_obs = project.read_rootfile("tests", "test_obs.py")
+        if docs is not None:
+            for r in regs:
+                if "/analysis_corpus/" in r.sf.path:
+                    continue
+                if r.literal is not None and r.literal not in docs:
+                    out.append(self._f(
+                        r, f"family {r.literal!r} is registered but not "
+                           "documented in docs/OBSERVABILITY.md"))
+        expected = _parse_expected_families(test_obs)
+        if expected:
+            for family in sorted(expected):
+                if not any(r.matches(family) for r in regs):
+                    out.append(Finding(
+                        rule=self.name, path="tests/test_obs.py", line=1,
+                        message=(
+                            f"EXPECTED_FAMILIES contracts {family!r} but "
+                            "no registration produces it — the family was "
+                            "renamed or removed without updating the "
+                            "contract"),
+                    ))
+        return out
+
+    def _f(self, r: _Registration, msg: str) -> Finding:
+        return Finding(rule=self.name, path=r.sf.rel,
+                       line=r.node.lineno, col=r.node.col_offset,
+                       message=msg)
+
+
+def _parse_expected_families(text: Optional[str]) -> set:
+    """The EXPECTED_FAMILIES set literal out of tests/test_obs.py."""
+    if text is None:
+        return set()
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "EXPECTED_FAMILIES"
+                for t in node.targets):
+            try:
+                value = ast.literal_eval(node.value)
+            except ValueError:
+                return set()
+            if isinstance(value, (set, list, tuple)):
+                return {v for v in value if isinstance(v, str)}
+    return set()

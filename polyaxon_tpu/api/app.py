@@ -344,6 +344,7 @@ class ApiApp:
             for entry in os.listdir(root):
                 p = os.path.join(root, entry)
                 try:
+                    # plx: allow(clock): compared against file MTIMES, which are wall-clock by definition
                     if _time.time() - os.path.getmtime(p) > 3600:
                         shutil.rmtree(p, ignore_errors=True)
                 except OSError:

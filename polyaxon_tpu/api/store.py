@@ -264,6 +264,7 @@ def _is_disk_full(exc: BaseException) -> bool:
 
 
 def _now() -> str:
+    # plx: allow(clock): persisted ISO row timestamps (created_at, lease renewed_at) are read cross-process and by humans — wall clock is the contract
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
 
 
@@ -734,6 +735,7 @@ class Store:
         t = datetime.datetime.fromisoformat(renewed_at)
         if t.tzinfo is None:
             t = t.replace(tzinfo=datetime.timezone.utc)
+        # plx: allow(clock): renewed_at is a PERSISTED wall timestamp (file DBs survive restarts, leases span processes) — monotonic cannot compare across processes; the TTL grace absorbs NTP slew
         return (datetime.datetime.now(datetime.timezone.utc)
                 - t).total_seconds()
 
@@ -1788,7 +1790,10 @@ class Store:
         with self._train_lock:
             per_run = self._serve_seen.setdefault(uuid, {})
             rec = per_run.setdefault(key, {"counters": {}})
-            rec["at"] = time.time()
+            # monotonic: reporter freshness is a same-process duration —
+            # an NTP step during a soak must not age every replica out of
+            # (or back into) the autoscaler's signal at once
+            rec["at"] = time.monotonic()
             # prune sibling reporters stale past a generous multiple of
             # the freshness window: replica-restart churn mints a new
             # incarnation per process, and the records would otherwise
@@ -1844,7 +1849,7 @@ class Store:
         """Aggregated live traffic across fresh reporters — the agent's
         autoscale input and the gauge families' source. ``uuid`` scopes to
         one service run; None aggregates every run."""
-        now = time.time()
+        now = time.monotonic()  # same clock as rec["at"] freshness stamps
         running = waiting = kv_used = kv_total = reporters = 0
         with self._train_lock:
             runs = ([uuid] if uuid is not None

@@ -37,6 +37,7 @@ def age_seconds(iso: Optional[str]) -> Optional[float]:
         return None
     if t.tzinfo is None:
         t = t.replace(tzinfo=datetime.timezone.utc)
+    # plx: allow(clock): heartbeat_at is a PERSISTED wall timestamp written by another process — the reaper's two-stale-pass rule absorbs clock slew
     return (datetime.datetime.now(datetime.timezone.utc) - t).total_seconds()
 
 

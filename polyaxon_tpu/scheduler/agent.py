@@ -1227,6 +1227,7 @@ class LocalAgent:
                     from datetime import datetime, timezone
 
                     elapsed = max(
+                        # plx: allow(clock): started_at is a persisted wall timestamp from a possibly-dead incarnation; max(..., 0) floors a backwards step
                         (datetime.now(timezone.utc)
                          - datetime.fromisoformat(run["started_at"])
                          ).total_seconds(), 0.0)
@@ -1459,6 +1460,7 @@ class LocalAgent:
             return
         meta = dict(run.get("meta") or {})
         meta["autoscale"] = {"replicas": n, "from": int(info["replicas"]),
+                             # plx: allow(clock): persisted into run meta for humans/successors — wall clock is the contract
                              "at": time.time()}
         self.store.update_run(uuid, meta=meta)
         self._apply_scale(uuid, info, n, scale_up=n > int(info["replicas"]))
@@ -2273,6 +2275,7 @@ class LocalAgent:
         if hit is not None and hit["uuid"] == uuid:
             hit = None
         if hit is not None and cache_cfg.ttl:
+            # plx: allow(clock): cache TTL against a persisted created_at wall timestamp (may predate this process by days)
             age = (datetime.now(timezone.utc)
                    - datetime.fromisoformat(hit["created_at"])).total_seconds()
             if age > cache_cfg.ttl:

@@ -125,6 +125,7 @@ class ScheduleRunner:
     def run(self, now_fn=None) -> dict[str, Any]:
         from ..schemas.statuses import V1Statuses, is_done
 
+        # plx: allow(clock): cron/interval schedules are CALENDAR time by definition (fire at 03:00 means wall 03:00)
         now_fn = now_fn or (lambda: datetime.now(timezone.utc))
         fired = 0
         children: list[str] = []

@@ -171,7 +171,8 @@ def run_kill_agent_soak(workdir: str, seed: int = 2024, n_jobs: int = 8,
                         chaos_cfg=None, lease_ttl: float = 0.8,
                         timeout: float = 300.0, agents: int = 1,
                         num_shards: int = 8,
-                        rolling_kill: bool = False) -> dict:
+                        rolling_kill: bool = False,
+                        lock_witness=None) -> dict:
     """One kill-the-agent pass: drive a job wave, hard-kill + restart the
     agent at seeded times (and optionally run a split-brain round), and
     return statuses + every crash-safety counter. ``kills=0`` and
@@ -182,13 +183,20 @@ def run_kill_agent_soak(workdir: str, seed: int = 2024, n_jobs: int = 8,
     store; ``rolling_kill`` kills agents WITHOUT replacement (survivors
     must adopt the orphaned shards within < 2x lease TTL — measured and
     returned as ``shard_reown_s``), the split-brain round suspends one
-    fleet member past its TTLs and resumes it against the adopters."""
+    fleet member past its TTLs and resumes it against the adopters.
+
+    ``lock_witness`` (ISSUE 11): an ``analysis.LockWitness`` gets the
+    control-plane locks (store writer/fold locks, every agent
+    incarnation's loop/dirty locks, reconciler locks) wrapped so the soak
+    records the ACTUAL cross-thread acquisition orders the kill/takeover
+    races exercise; the caller fails the soak on a witnessed cycle."""
     if agents > 1:
         return _sharded_kill_soak(
             workdir, seed=seed, n_jobs=n_jobs, kills=kills,
             split_brain=split_brain, chaos_cfg=chaos_cfg,
             lease_ttl=lease_ttl, timeout=timeout, agents=agents,
-            num_shards=num_shards, rolling_kill=rolling_kill)
+            num_shards=num_shards, rolling_kill=rolling_kill,
+            lock_witness=lock_witness)
     from polyaxon_tpu.api.store import StaleLeaseError, Store
     from polyaxon_tpu.operator import FakeCluster
     from polyaxon_tpu.resilience import ChaosCluster
@@ -196,14 +204,21 @@ def run_kill_agent_soak(workdir: str, seed: int = 2024, n_jobs: int = 8,
 
     rng = random.Random(seed)
     store = Store(":memory:")
+    if lock_witness is not None:
+        lock_witness.instrument_control_plane(store=store)
     cluster = FakeCluster(os.path.join(workdir, ".cluster"))
     if chaos_cfg is not None:
         cluster = ChaosCluster(cluster, chaos_cfg)
 
     def new_agent():
-        return LocalAgent(store, workdir, backend="cluster", cluster=cluster,
-                          poll_interval=0.05, lease_ttl=lease_ttl,
-                          max_parallel=4).start()
+        agent = LocalAgent(store, workdir, backend="cluster",
+                           cluster=cluster, poll_interval=0.05,
+                           lease_ttl=lease_ttl, max_parallel=4)
+        if lock_witness is not None:
+            # before start(): the loop/presence threads must only ever
+            # see the witnessed locks
+            lock_witness.instrument_control_plane(agent=agent)
+        return agent.start()
 
     agent = new_agent()
     stale_rejected = 0
@@ -286,7 +301,7 @@ def run_kill_agent_soak(workdir: str, seed: int = 2024, n_jobs: int = 8,
 def _sharded_kill_soak(workdir: str, *, seed: int, n_jobs: int, kills: int,
                        split_brain: bool, chaos_cfg, lease_ttl: float,
                        timeout: float, agents: int, num_shards: int,
-                       rolling_kill: bool) -> dict:
+                       rolling_kill: bool, lock_witness=None) -> dict:
     """The ISSUE 6 fleet soak: ``agents`` concurrently-active shard-aware
     agents over ONE store, seeded kills mid-wave. ``rolling_kill`` kills
     WITHOUT replacement — the orphaned shards must be adopted by the
@@ -303,14 +318,20 @@ def _sharded_kill_soak(workdir: str, *, seed: int, n_jobs: int, kills: int,
 
     rng = random.Random(seed)
     store = Store(":memory:")
+    if lock_witness is not None:
+        lock_witness.instrument_control_plane(store=store)
     cluster = FakeCluster(os.path.join(workdir, ".cluster"))
     if chaos_cfg is not None:
         cluster = ChaosCluster(cluster, chaos_cfg)
 
     def new_agent():
-        return LocalAgent(store, workdir, backend="cluster", cluster=cluster,
-                          poll_interval=0.05, lease_ttl=lease_ttl,
-                          num_shards=num_shards, max_parallel=4).start()
+        agent = LocalAgent(store, workdir, backend="cluster",
+                           cluster=cluster, poll_interval=0.05,
+                           lease_ttl=lease_ttl, num_shards=num_shards,
+                           max_parallel=4)
+        if lock_witness is not None:
+            lock_witness.instrument_control_plane(agent=agent)
+        return agent.start()
 
     fleet = [new_agent() for _ in range(agents)]
     dead_holders: set = set()
@@ -1070,13 +1091,19 @@ def _dump_metrics(path: str, text: str) -> None:
 def _run_kill_agent_mode(args) -> int:
     from polyaxon_tpu.resilience import ChaosConfig
 
+    witness = None
+    if args.lock_witness:
+        from polyaxon_tpu.analysis import LockWitness
+
+        witness = LockWitness()
     root = tempfile.mkdtemp(prefix="plx-kill-agent-soak-")
     ok = True
     final_scrape = ""
     try:
         oracle = run_kill_agent_soak(
             os.path.join(root, "oracle"), seed=args.seed,
-            n_jobs=args.trials * 3, kills=0, timeout=args.timeout)
+            n_jobs=args.trials * 3, kills=0, timeout=args.timeout,
+            lock_witness=witness)
         final_scrape = oracle["metrics_text"]
         print(json.dumps({"pass": "oracle", "statuses": oracle["statuses"]}))
         if any(v != "succeeded" for v in oracle["statuses"].values()):
@@ -1095,7 +1122,7 @@ def _run_kill_agent_mode(args) -> int:
                 split_brain=args.split_brain, chaos_cfg=cfg,
                 lease_ttl=args.lease_ttl, timeout=args.timeout,
                 agents=args.agents, num_shards=args.num_shards,
-                rolling_kill=args.rolling_kill)
+                rolling_kill=args.rolling_kill, lock_witness=witness)
             final_scrape = out["metrics_text"]
             converged = out["statuses"] == oracle["statuses"]
             no_dups = not out["duplicate_applies"]
@@ -1131,8 +1158,26 @@ def _run_kill_agent_mode(args) -> int:
             shutil.rmtree(root, ignore_errors=True)
     if args.metrics_dump:
         _dump_metrics(args.metrics_dump, final_scrape)
+    if witness is not None:
+        # witnessed acquisition orders land next to the metrics scrapes;
+        # a cycle in them is a latent deadlock the soak got lucky on
+        report = witness.dump(args.lock_witness)
+        print(json.dumps({
+            "lock_witness": args.lock_witness,
+            "witnessed_locks": len(report["locks"]),
+            "witnessed_edges": len(report["edges"]),
+            "cycles": report["cycles"],
+        }))
+        ok = ok and report["ok"]
     print(json.dumps({"ok": ok}))
     return 0 if ok else 1
+
+
+def _artifact_path(name: str) -> str:
+    """Default archive location: the repo's bench_artifacts/ dir."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_artifacts", name)
 
 
 def main() -> int:
@@ -1191,17 +1236,32 @@ def main() -> int:
                         "every pre-failover token/cursor, and converge to "
                         "the fault-free oracle with zero duplicate "
                         "launches and zero lost terminal transitions")
+    p.add_argument("--lock-witness", nargs="?", metavar="PATH",
+                   const=_artifact_path("lock_witness.json"),
+                   default=None,
+                   help="with --kill-agent: wrap the control-plane locks "
+                        "in an analysis.LockWitness, dump the witnessed "
+                        "cross-thread acquisition orders to PATH (default: "
+                        "bench_artifacts/lock_witness.json) and FAIL the "
+                        "soak on a witnessed lock-order cycle (ISSUE 11)")
     p.add_argument("--metrics-dump", nargs="?", metavar="PATH",
-                   const=os.path.join(
-                       os.path.dirname(os.path.dirname(
-                           os.path.abspath(__file__))),
-                       "bench_artifacts", "chaos_soak_metrics.prom"),
+                   const=_artifact_path("chaos_soak_metrics.prom"),
                    default=None,
                    help="write the last round's final /metrics scrape "
                         "(validated Prometheus text) to PATH (default: "
                         "bench_artifacts/chaos_soak_metrics.prom)")
     args = p.parse_args()
 
+    if args.lock_witness and (args.train_faults or args.serve_traffic
+                              or args.store_outage):
+        # refuse rather than silently run unwitnessed: an operator who
+        # asked for the witness must not read a lucky exit 0 as
+        # "cycle-free" when no locks were instrumented
+        print("--lock-witness is wired into the kill-agent soaks only "
+              "(--kill-agent / --agents N / --rolling-kill); it does not "
+              "instrument --train-faults / --serve-traffic / "
+              "--store-outage", file=sys.stderr)
+        return 2
     if args.train_faults:
         return _run_train_faults_mode(args)
     if args.serve_traffic:
@@ -1209,7 +1269,7 @@ def main() -> int:
     if args.store_outage:
         return _run_store_outage_mode(args)
     if (args.kill_agent or args.split_brain or args.rolling_kill
-            or args.agents > 1):
+            or args.agents > 1 or args.lock_witness):
         args.kill_agent = True
         return _run_kill_agent_mode(args)
 

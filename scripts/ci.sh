@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Single-entry local CI gate (ISSUE 11 satellite): the concurrency
+# analyzer, then the tier-1 pytest suite — exactly what ROADMAP.md's
+# "Tier-1 verify" runs, so one command answers "is the tree shippable".
+#
+# Usage:
+#   scripts/ci.sh            # analyzer + tier-1 tests
+#   scripts/ci.sh --fast     # analyzer only (seconds, no pytest)
+#
+# Exit code: non-zero iff either gate fails. Caveat for slow boxes: on a
+# 2-CPU container the tier-1 suite can exceed the 870s window by design
+# (the driver's bar there is DOTS_PASSED, not the exit code). When the
+# run is killed by the timeout (rc 124), set CI_DOTS_FLOOR=<n> to accept
+# DOTS_PASSED >= n as a pass; otherwise 124 propagates with a warning.
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== gate 1/2: concurrency invariant analyzer =="
+python -m polyaxon_tpu.analysis || exit 1
+
+if [ "$1" = "--fast" ]; then
+    echo "== --fast: skipping tier-1 pytest =="
+    exit 0
+fi
+
+echo "== gate 2/2: tier-1 tests (ROADMAP.md verify) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+echo "DOTS_PASSED=$dots"
+if [ "$rc" = 124 ]; then
+    if [ -n "$CI_DOTS_FLOOR" ] && [ "$dots" -ge "$CI_DOTS_FLOOR" ]; then
+        echo "tier-1 hit the 870s window (expected on slow boxes);" \
+             "DOTS_PASSED=$dots >= CI_DOTS_FLOOR=$CI_DOTS_FLOOR -> pass"
+        exit 0
+    fi
+    echo "tier-1 hit the 870s window before finishing; the driver's bar" \
+         "on slow boxes is DOTS_PASSED (set CI_DOTS_FLOOR to gate on it)"
+fi
+exit $rc

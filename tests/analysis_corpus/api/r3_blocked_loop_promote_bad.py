@@ -1,0 +1,22 @@
+"""R3 reproducer — the PR-7 blocked-loop false-promotion class: an
+async handler runs the O(whole database) snapshot INLINE on the event
+loop. While it runs, /api/v1/changelog goes silent, and an attached
+standby's promote-on-silence rule reads the silence as primary death —
+a false failover caused by a wedged loop, not a dead store."""
+
+import subprocess
+import time
+
+
+class Api:
+    def __init__(self, store):
+        self.store = store
+
+    async def get_snapshot(self, request):
+        manifest = self.store.snapshot("/tmp/snap")  # BAD: O(db) on loop
+        return manifest
+
+    async def debug_probe(self, request):
+        time.sleep(0.5)  # BAD: wedges every other request
+        out = subprocess.run(["df", "-h"], capture_output=True)  # BAD
+        return out

@@ -1,0 +1,23 @@
+"""R3 clean twin — the PR-7 fix shape: heavy work ships to an executor
+via a nested sync def; loop-friendly waits use asyncio.sleep."""
+
+import asyncio
+
+
+class Api:
+    def __init__(self, store):
+        self.store = store
+
+    async def get_snapshot(self, request):
+        loop = asyncio.get_event_loop()
+
+        def _make():
+            # runs on a worker thread, not the loop
+            return self.store.snapshot("/tmp/snap")
+
+        manifest = await loop.run_in_executor(None, _make)
+        return manifest
+
+    async def debug_probe(self, request):
+        await asyncio.sleep(0.5)
+        return {"ok": True}

@@ -1,0 +1,25 @@
+"""R2 reproducer — the PR-6 demotion self-deadlock: a FencedStore
+``on_stale`` callback fires on a writer thread that already holds the
+agent's loop lock, and the demotion bookkeeping takes the same
+non-reentrant lock again. Only reachable under a takeover race — which
+is exactly when it fired."""
+
+import threading
+
+
+class Agent:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._chips_in_use = {}
+        self._shards = {}
+
+    def _on_status(self, uuid: str) -> None:
+        # executor callback: holds the loop lock for bookkeeping...
+        with self._lock:
+            self._chips_in_use.pop(uuid, None)
+            # ...and a fence rejection mid-callback demotes INLINE
+            self._demote("shard-0")  # BAD: self-deadlock
+
+    def _demote(self, shard: str) -> None:
+        with self._lock:  # non-reentrant, already held by the caller
+            self._shards.pop(shard, None)

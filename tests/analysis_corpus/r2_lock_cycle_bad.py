@@ -1,0 +1,34 @@
+"""R2 reproducer — classic AB/BA lock-order cycle between an agent-side
+lock and a store-side lock (two components, two threads, opposite
+orders: a listener fired inside the writer lock reaches back for the
+loop lock while a scheduling pass writes under it)."""
+
+import threading
+
+
+class MiniAgent:
+    def __init__(self):
+        self._loop_lock = threading.Lock()
+        self.store = MiniStore()
+
+    def pass_once(self, uuid: str) -> None:
+        with self._loop_lock:
+            self.store.write(uuid)  # loop lock -> writer lock
+
+    def notify(self, uuid: str) -> None:
+        with self._loop_lock:
+            pass
+
+
+class MiniStore:
+    def __init__(self):
+        self._writer_lock = threading.Lock()
+        self.agent = MiniAgent()
+        self.rows = {}
+
+    def write(self, uuid: str) -> None:
+        with self._writer_lock:
+            self.rows[uuid] = "x"
+            # listener fired INSIDE the writer lock: writer lock ->
+            # loop lock, closing the cycle
+            self.agent.notify(uuid)

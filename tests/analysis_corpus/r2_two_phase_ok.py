@@ -1,0 +1,44 @@
+"""R2 clean twin — the PR-6 fix shape: demotion is two-phase. The
+safety half (poison flag) is lock-free and callable from any thread;
+the bookkeeping half runs on the loop thread, which takes the lock
+fresh. Listeners fire OUTSIDE the writer lock."""
+
+import threading
+
+
+class Agent:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._chips_in_use = {}
+        self._shards = {}
+        self._demoted_dirty = set()
+
+    def _on_status(self, uuid: str) -> None:
+        with self._lock:
+            self._chips_in_use.pop(uuid, None)
+        # demote AFTER releasing: the poison half is lock-free anyway
+        self._demote("shard-0")
+
+    def _demote(self, shard: str) -> None:
+        # safety lands immediately, without any lock
+        self._demoted_dirty.add(shard)
+
+    def _drain_demotions(self) -> None:
+        # loop thread: bookkeeping under the lock, never nested
+        while self._demoted_dirty:
+            shard = self._demoted_dirty.pop()
+            with self._lock:
+                self._shards.pop(shard, None)
+
+
+class MiniStore:
+    def __init__(self):
+        self._writer_lock = threading.Lock()
+        self.agent = Agent()
+        self.rows = {}
+
+    def write(self, uuid: str) -> None:
+        with self._writer_lock:
+            self.rows[uuid] = "x"
+        # listener fires AFTER the writer lock is released
+        self.agent._on_status(uuid)

@@ -1,0 +1,19 @@
+"""R5 clean twin — the contracted shapes: counters end ``_total``,
+gauges don't, histograms carry a unit, names are snake_case. f-string
+registrations are checked on their literal parts."""
+
+
+class Obs:
+    def __init__(self, registry, stats):
+        self.injected = registry.counter(
+            "polyaxon_chaos_injected_total",
+            "Faults injected by the chaos harness",
+            value_fn=lambda: stats["injected"])
+        self.depth = registry.gauge(
+            "polyaxon_agent_queue_depth", "Runs waiting in the FIFO")
+        self.lat = registry.histogram(
+            "polyaxon_store_write_seconds", "Write latency")
+        for stat in ("transactions", "launch_intents"):
+            registry.counter(
+                f"polyaxon_store_{stat}_total", "Store stats export",
+                value_fn=lambda s=stat: stats[s])

@@ -1,0 +1,21 @@
+"""R5 reproducer — the PR-5 hardening class: a monotonic ``_total``
+family registered as a Gauge. ``rate()``/``increase()`` over a
+gauge-typed family silently return garbage on counter resets — the
+scrape parses, the dashboards lie."""
+
+
+class Obs:
+    def __init__(self, registry):
+        # monotonic audit-log length exported as a Gauge: BAD
+        self.injected = registry.gauge(
+            "polyaxon_chaos_injected_total",
+            "Faults injected by the chaos harness")
+        # counter without the _total suffix: BAD
+        self.reaps = registry.counter(
+            "polyaxon_reaper_reaps", "Zombie reaps")
+        # not snake_case: BAD
+        self.camel = registry.counter(
+            "polyaxon_storeWrites_total", "Writes")
+        # histogram without a unit suffix: BAD
+        self.lat = registry.histogram(
+            "polyaxon_store_write_latency", "Write latency")

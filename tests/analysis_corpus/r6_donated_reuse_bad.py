@@ -1,0 +1,29 @@
+"""R6 reproducer — the PR-8 trainer-rollback class: reading a buffer
+after donating it to a jitted step. XLA:CPU may decline the donation
+(tests pass); on TPU the read returns garbage or raises — which is how
+the class survives review."""
+
+import jax
+
+
+def train(step_fn, state, batches):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    history = []
+    for batch in batches:
+        new_state, metrics = step(state, batch)
+        # BAD: `state`'s buffers were donated to the call above — this
+        # host-side read is use-after-free on TPU
+        history.append(state.loss)
+        state = new_state
+    return state, history
+
+
+def decorated_form(params, pools, tokens):
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def decode_step(p, pool, tok):
+        return pool, tok
+
+    new_pools, out = decode_step(params, pools, tokens)
+    return pools, out  # BAD: donated pools read after the call

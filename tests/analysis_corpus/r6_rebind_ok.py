@@ -1,0 +1,24 @@
+"""R6 clean twin — the sanctioned idioms: the donated name is rebound
+by the call's own assignment, or read only BEFORE the call."""
+
+import jax
+
+
+def train(step_fn, state, batches):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    losses = []
+    for batch in batches:
+        losses.append(state.loss)  # read BEFORE donation: fine
+        state, metrics = step(state, batch)  # rebound at the call
+    return state, losses
+
+
+def decorated_form(params, pools, tokens):
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def decode_step(p, pool, tok):
+        return pool, tok
+
+    pools, out = decode_step(params, pools, tokens)  # rebound
+    return pools, out

@@ -1,0 +1,29 @@
+"""R1 clean twin — the sanctioned shapes: writes ride the FencedStore
+proxy under the canonical ``store`` name, or carry an explicit
+``fence=``."""
+
+from polyaxon_tpu.api.store import FencedStore
+
+
+class GoodReaper:
+    def __init__(self, store):
+        self.store = FencedStore(store, lambda: self._fence)
+        self._fence = None
+
+    def reap(self, uuid: str) -> None:
+        self.store.transition(uuid, "failed", reason="ZombieRun")  # fenced
+
+    def reap_many(self, uuids: list) -> None:
+        self.store.transition_many([(u, "failed") for u in uuids])
+
+
+class ExplicitFence:
+    def late_report(self, raw_store, uuid: str, token: int) -> None:
+        raw_store.transition(uuid, "failed",
+                             fence=("scheduler", token))  # explicit
+
+
+def driver_body(store, uuid: str) -> None:
+    # bare `store` is the canonical handle the agent passes down — the
+    # agent hands its FencedStore under this name
+    store.update_run(uuid, outputs={"done": True})

@@ -1,0 +1,34 @@
+"""R1 reproducer — the PR-4/6 unfenced-write class: a driver mutating
+run lifecycles through a RAW store handle. A stale incarnation of this
+driver would keep writing after a successor took over."""
+
+import threading
+
+from polyaxon_tpu.api.store import Store
+
+
+class BadReaper:
+    def __init__(self, path: str):
+        self._lock = threading.Lock()
+        # raw store stashed under a non-canonical name: every write
+        # through it bypasses the lease fence
+        self.raw = Store(path)
+
+    def reap(self, uuid: str) -> None:
+        self.raw.transition(uuid, "failed", reason="ZombieRun")  # BAD
+
+    def reap_many(self, uuids: list) -> None:
+        self.raw.transition_many([(u, "failed") for u in uuids])  # BAD
+
+
+class ProxyPiercer:
+    def __init__(self, fenced):
+        self.store = fenced
+
+    def late_report(self, uuid: str) -> None:
+        # reaching around the proxy to skip the fence check
+        self.store._inner.update_run(uuid, outputs={"late": True})  # BAD
+
+
+def one_off(uuid: str) -> None:
+    Store(":memory:").merge_outputs(uuid, {"x": 1})  # BAD

@@ -1,0 +1,20 @@
+"""R4 clean twin — durations ride the monotonic clock; the one
+persisted human-facing stamp carries its written justification."""
+
+import time
+
+
+class LeaseLoop:
+    def __init__(self, ttl: float):
+        self.ttl = ttl
+        self._renew_deadline = 0.0
+
+    def arm(self) -> None:
+        self._renew_deadline = time.monotonic() + self.ttl
+
+    def expired(self) -> bool:
+        return time.monotonic() > self._renew_deadline
+
+    def stamp_meta(self, meta: dict) -> None:
+        # plx: allow(clock): persisted into run meta for humans — wall clock is the contract
+        meta["renewed_at"] = time.time()

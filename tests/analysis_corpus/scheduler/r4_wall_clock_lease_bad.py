@@ -1,0 +1,18 @@
+"""R4 reproducer — wall-clock lease arithmetic: an NTP step during the
+renewal window moves ``time.time()`` backwards (lease never expires —
+dead agent holds its shards forever) or forwards (live agent demoted
+mid-pass). The chaos soaks create exactly the timing this breaks."""
+
+import time
+
+
+class LeaseLoop:
+    def __init__(self, ttl: float):
+        self.ttl = ttl
+        self._renew_deadline = 0.0
+
+    def arm(self) -> None:
+        self._renew_deadline = time.time() + self.ttl  # BAD
+
+    def expired(self) -> bool:
+        return time.time() > self._renew_deadline  # BAD

@@ -1,0 +1,308 @@
+"""Tier-1 suite for the concurrency-invariant analyzer (ISSUE 11).
+
+Three layers:
+
+- the LIVE TREE is clean: ``python -m polyaxon_tpu.analysis`` exits 0,
+  and every suppression in the tree carries a written justification;
+- the regression corpus (tests/analysis_corpus/) is the proof the rules
+  encode the repo's own bug history: each historical-bug-class
+  reproducer is flagged by its rule, and each clean twin produces zero
+  active findings (false-positive guard);
+- engine units: suppression parsing, JSON schema stability, the
+  fence-verb contract against FencedStore._FENCED, and the runtime
+  LockWitness (edge recording, cycle detection, reentrancy).
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from polyaxon_tpu.analysis import LockWitness, run_analysis
+from polyaxon_tpu.analysis.__main__ import main as analysis_main
+from polyaxon_tpu.analysis.engine import repo_root
+
+CORPUS = os.path.join(os.path.dirname(__file__), "analysis_corpus")
+
+
+def _corpus_report():
+    return run_analysis(root=CORPUS)
+
+
+# -- live tree ---------------------------------------------------------------
+
+
+class TestLiveTree:
+    @pytest.fixture(scope="class")
+    def live_report(self):
+        # one full-repo analysis shared by the class (each run re-parses
+        # ~117 files; tripling that per tier-1 run buys nothing)
+        return run_analysis(root=repo_root())
+
+    def test_live_tree_is_clean(self, live_report):
+        """The acceptance gate: the analyzer exits 0 on the repo."""
+        assert live_report.files_analyzed > 50  # really scanned the tree
+        assert live_report.active == [], "\n" + "\n".join(
+            f.render() for f in live_report.active)
+
+    def test_every_suppression_carries_a_justification(self, live_report):
+        assert live_report.suppressed, \
+            "the tree documents its wall-clock sites"
+        for f in live_report.suppressed:
+            assert f.justification and len(f.justification) > 10, f.render()
+
+    def test_cli_json_exit_zero(self, capsys):
+        rc = analysis_main(["--json"])
+        assert rc == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["summary"]["active"] == 0
+
+    def test_cli_list_rules(self, capsys):
+        assert analysis_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("fence", "lockorder", "asyncblock", "clock",
+                     "metrics", "donation"):
+            assert rule in out
+
+    def test_cli_rejects_unknown_rule(self):
+        assert analysis_main(["--rule", "nope"]) == 2
+
+
+# -- regression corpus -------------------------------------------------------
+
+
+# (rule, reproducer file, minimum findings) — one entry per historical
+# bug class named in ISSUE 11
+BAD_CASES = [
+    ("fence", "scheduler/r1_unfenced_write_bad.py", 4),
+    ("lockorder", "r2_demotion_deadlock_bad.py", 1),
+    ("lockorder", "r2_lock_cycle_bad.py", 1),
+    ("asyncblock", "api/r3_blocked_loop_promote_bad.py", 3),
+    ("clock", "scheduler/r4_wall_clock_lease_bad.py", 2),
+    ("metrics", "r5_counter_as_gauge_bad.py", 4),
+    ("donation", "r6_donated_reuse_bad.py", 2),
+]
+
+OK_TWINS = [
+    "scheduler/r1_fenced_ok.py",
+    "r2_two_phase_ok.py",
+    "api/r3_executor_ok.py",
+    "scheduler/r4_monotonic_ok.py",
+    "r5_contract_ok.py",
+    "r6_rebind_ok.py",
+]
+
+
+class TestCorpus:
+    @pytest.fixture(scope="class")
+    def corpus(self):
+        return _corpus_report()
+
+    @pytest.mark.parametrize("rule,path,min_hits", BAD_CASES)
+    def test_historical_bug_class_is_flagged(self, corpus, rule, path,
+                                             min_hits):
+        hits = [f for f in corpus.active
+                if f.path == path and f.rule == rule]
+        assert len(hits) >= min_hits, (
+            f"{rule} missed its reproducer {path}; findings there: "
+            + "; ".join(f.render() for f in corpus.findings
+                        if f.path == path))
+
+    @pytest.mark.parametrize("path", OK_TWINS)
+    def test_clean_twin_is_not_flagged(self, corpus, path):
+        hits = [f for f in corpus.active if f.path == path]
+        assert hits == [], "\n".join(f.render() for f in hits)
+
+    def test_demotion_deadlock_names_the_lock_and_path(self, corpus):
+        (f,) = [f for f in corpus.active
+                if f.path == "r2_demotion_deadlock_bad.py"]
+        assert "self-deadlock" in f.message
+        assert "Agent._lock" in f.message
+        assert "_demote" in f.message  # the call chain is in the report
+
+    def test_lock_cycle_names_both_locks(self, corpus):
+        msgs = [f.message for f in corpus.active
+                if f.path == "r2_lock_cycle_bad.py"]
+        assert any("MiniAgent._loop_lock" in m and
+                   "MiniStore._writer_lock" in m for m in msgs), msgs
+
+    def test_counter_as_gauge_is_the_typed_finding(self, corpus):
+        msgs = [f.message for f in corpus.active
+                if f.path == "r5_counter_as_gauge_bad.py"]
+        assert any("_total" in m and "gauge" in m for m in msgs), msgs
+
+    def test_suppressed_wall_clock_in_ok_twin_counts_as_suppressed(
+            self, corpus):
+        sups = [f for f in corpus.suppressed
+                if f.path == "scheduler/r4_monotonic_ok.py"]
+        assert len(sups) == 1 and sups[0].rule == "clock"
+
+
+# -- engine units ------------------------------------------------------------
+
+
+class TestEngine:
+    def _run_snippet(self, tmp_path, name, text):
+        (tmp_path / name).write_text(text)
+        return run_analysis(root=str(tmp_path), targets=[name])
+
+    def test_allow_without_justification_is_itself_a_finding(
+            self, tmp_path):
+        # scheduler/ prefix puts the snippet in the clock rule's scope
+        os.makedirs(tmp_path / "scheduler", exist_ok=True)
+        (tmp_path / "scheduler" / "x.py").write_text(
+            "import time\n\n\ndef renew():\n"
+            "    return time.time()  # plx: allow(clock)\n")
+        report = run_analysis(root=str(tmp_path),
+                              targets=["scheduler/x.py"])
+        rules = {f.rule for f in report.active}
+        assert "suppression" in rules  # bare allow() reported
+        assert "clock" in rules        # and it suppressed NOTHING
+        assert report.exit_code == 1
+
+    def test_allow_with_justification_suppresses(self, tmp_path):
+        os.makedirs(tmp_path / "scheduler", exist_ok=True)
+        (tmp_path / "scheduler" / "x.py").write_text(
+            "import time\n\n\ndef renew(meta):\n"
+            "    # plx: allow(clock): persisted for humans in run meta\n"
+            "    meta['at'] = time.time()\n")
+        report = run_analysis(root=str(tmp_path),
+                              targets=["scheduler/x.py"])
+        assert report.exit_code == 0
+        assert len(report.suppressed) == 1
+        assert report.suppressed[0].justification == \
+            "persisted for humans in run meta"
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        report = self._run_snippet(tmp_path, "broken.py", "def f(:\n")
+        assert [f.rule for f in report.active] == ["parse"]
+
+    def test_json_schema_is_stable(self, tmp_path):
+        (tmp_path / "empty.py").write_text("x = 1\n")
+        data = run_analysis(root=str(tmp_path),
+                            targets=["empty.py"]).to_json()
+        assert data["version"] == 1
+        assert set(data) == {"version", "root", "files_analyzed", "rules",
+                             "findings", "summary"}
+        assert set(data["summary"]) == {"total", "active", "suppressed",
+                                        "by_rule"}
+        assert set(data["rules"]) == {"fence", "lockorder", "asyncblock",
+                                      "clock", "metrics", "donation"}
+
+    def test_fence_verbs_cover_the_fenced_store_contract(self):
+        """The rule's verb list and FencedStore._FENCED must not drift:
+        a new fenced verb that the rule doesn't know is a silent hole."""
+        from polyaxon_tpu.analysis.rules.fence import WRITE_VERBS
+        from polyaxon_tpu.api.store import FencedStore
+
+        assert set(FencedStore._FENCED) <= set(WRITE_VERBS)
+
+    def test_expected_families_drift_is_flagged(self, tmp_path):
+        """A family contracted in EXPECTED_FAMILIES but registered
+        nowhere is the rename-without-contract-update drift."""
+        os.makedirs(tmp_path / "tests", exist_ok=True)
+        os.makedirs(tmp_path / "docs", exist_ok=True)
+        (tmp_path / "tests" / "test_obs.py").write_text(
+            "EXPECTED_FAMILIES = {'polyaxon_gone_total'}\n")
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+            "`polyaxon_live_total`\n")
+        (tmp_path / "obs.py").write_text(
+            "def setup(reg):\n"
+            "    reg.counter('polyaxon_live_total', 'x')\n")
+        report = run_analysis(root=str(tmp_path), targets=["obs.py"])
+        msgs = [f.message for f in report.active if f.rule == "metrics"]
+        assert any("polyaxon_gone_total" in m for m in msgs), msgs
+
+    def test_undocumented_family_is_flagged(self, tmp_path):
+        os.makedirs(tmp_path / "docs", exist_ok=True)
+        (tmp_path / "docs" / "OBSERVABILITY.md").write_text("nothing\n")
+        (tmp_path / "obs.py").write_text(
+            "def setup(reg):\n"
+            "    reg.counter('polyaxon_new_thing_total', 'x')\n")
+        report = run_analysis(root=str(tmp_path), targets=["obs.py"])
+        msgs = [f.message for f in report.active if f.rule == "metrics"]
+        assert any("not documented" in m for m in msgs), msgs
+
+
+# -- runtime lock witness ----------------------------------------------------
+
+
+class TestLockWitness:
+    def test_orders_and_cycle_detection(self):
+        w = LockWitness()
+        a = w.wrap(threading.Lock(), "A")
+        b = w.wrap(threading.Lock(), "B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=ab)
+        t2 = threading.Thread(target=ba)
+        t1.start(); t1.join()
+        t2.start(); t2.join()
+        report = w.report()
+        assert {(e["from"], e["to"]) for e in report["edges"]} == \
+            {("A", "B"), ("B", "A")}
+        assert report["cycles"] and not report["ok"]
+        with pytest.raises(AssertionError, match="lock-order cycle"):
+            w.assert_no_cycles()
+
+    def test_consistent_order_is_clean(self):
+        w = LockWitness()
+        a = w.wrap(threading.Lock(), "A")
+        b = w.wrap(threading.Lock(), "B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert w.cycles() == []
+        w.assert_no_cycles()
+        (edge,) = w.edges()
+        assert edge["count"] >= 2 and "first_site" in edge
+
+    def test_reentrant_reacquire_is_not_an_edge(self):
+        w = LockWitness()
+        r = w.wrap(threading.RLock(), "R")
+        with r:
+            with r:
+                pass
+        assert w.edges() == []
+        assert w.cycles() == []
+
+    def test_wrap_is_idempotent(self):
+        w = LockWitness()
+        lk = w.wrap(threading.Lock(), "X")
+        assert w.wrap(lk, "X") is lk
+
+    def test_instrument_control_plane_store_and_agent_shapes(self):
+        from polyaxon_tpu.analysis.lockwitness import WitnessedLock
+        from polyaxon_tpu.api.store import Store
+
+        w = LockWitness()
+        store = Store(":memory:")
+        w.instrument_control_plane(store=store)
+        assert isinstance(store._transition_lock, WitnessedLock)
+        assert isinstance(store._train_lock, WitnessedLock)
+        # the witnessed locks keep working end to end
+        store.create_run("p", spec={"run": {"kind": "job"}})
+        store.heartbeat(store.list_runs(project="p")[0]["uuid"], step=1)
+        # the :memory: conn lock acquires inside _conn_ctx.__enter__ —
+        # invisible statically, witnessed here: the edge set is sane
+        assert w.cycles() == []
+
+    def test_dump_writes_report_json(self, tmp_path):
+        w = LockWitness()
+        with w.wrap(threading.Lock(), "A"):
+            pass
+        out = w.dump(str(tmp_path / "witness.json"))
+        data = json.loads((tmp_path / "witness.json").read_text())
+        assert data == out
+        assert data["ok"] is True and data["locks"] == ["A"]

@@ -340,16 +340,21 @@ class TestAgentKillSoak:
         split-brain round (GC-paused incumbent + live successor). Must
         converge to the fault-free oracle's terminal statuses with ZERO
         duplicate pod launches and >=1 fencing rejection, per the store's
-        and the cluster's counters."""
+        and the cluster's counters — and (ISSUE 11) with ZERO witnessed
+        lock-order cycles across the kill/takeover races, the witnessed
+        acquisition orders archived into bench_artifacts/."""
         from chaos_soak import run_kill_agent_soak
 
+        from polyaxon_tpu.analysis import LockWitness
+
+        witness = LockWitness()
         oracle = run_kill_agent_soak(str(tmp_path / "oracle"), seed=2024,
                                      n_jobs=8, kills=0)
         assert all(v == "succeeded" for v in oracle["statuses"].values()), \
             oracle
         out = run_kill_agent_soak(str(tmp_path / "kill"), seed=2024,
                                   n_jobs=8, kills=2, split_brain=True,
-                                  lease_ttl=0.8)
+                                  lease_ttl=0.8, lock_witness=witness)
         assert out["statuses"] == oracle["statuses"], out
         assert out["duplicate_applies"] == [], out
         assert out["fence_rejections"] >= 1, out
@@ -358,6 +363,13 @@ class TestAgentKillSoak:
         assert out["launch_intents"] >= 8, out
         assert len(out["launch_counts"]) == 8, out
         assert all(c >= 1 for c in out["launch_counts"].values()), out
+        # runtime complement of the static lockorder rule: the soak's
+        # real cross-thread acquisition orders must be cycle-free
+        report = witness.dump(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench_artifacts", "lock_witness.json"))
+        assert report["edges"], "witness saw no cross-thread orders"
+        witness.assert_no_cycles()
 
     def test_sharded_rolling_kill_fleet_converges(self, tmp_path):
         """ISSUE 6 acceptance soak: 4 shard-sharing agents over one store,
@@ -371,10 +383,12 @@ class TestAgentKillSoak:
         counter)."""
         from chaos_soak import run_kill_agent_soak
 
+        from polyaxon_tpu.analysis import LockWitness
         from polyaxon_tpu.api.store import SHARD_PREFIX
         from polyaxon_tpu.obs import parse_prometheus
 
         lease_ttl = 1.0
+        witness = LockWitness()
         oracle = run_kill_agent_soak(str(tmp_path / "oracle"), seed=2024,
                                      n_jobs=8, kills=0)
         assert all(v == "succeeded" for v in oracle["statuses"].values()), \
@@ -382,7 +396,8 @@ class TestAgentKillSoak:
         out = run_kill_agent_soak(str(tmp_path / "kill"), seed=2024,
                                   n_jobs=8, kills=2, split_brain=True,
                                   lease_ttl=lease_ttl, agents=4,
-                                  num_shards=8, rolling_kill=True)
+                                  num_shards=8, rolling_kill=True,
+                                  lock_witness=witness)
         assert out["statuses"] == oracle["statuses"], out
         assert out["duplicate_applies"] == [], out
         assert out["incumbent_demoted"] is True, out
@@ -406,6 +421,9 @@ class TestAgentKillSoak:
         # every run launched exactly the pods of one attempt set
         assert len(out["launch_counts"]) == 8, out
         assert all(c >= 1 for c in out["launch_counts"].values()), out
+        # the fleet's real cross-thread lock orders stayed acyclic
+        # through rolling kills, adoption resyncs and the split brain
+        witness.assert_no_cycles()
 
 
 class TestServeTrafficSoak:
