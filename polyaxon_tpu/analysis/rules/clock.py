@@ -29,10 +29,14 @@ import ast
 
 from ..engine import Finding, Project, Rule, call_target, import_aliases
 
-#: control-plane scope: path prefixes (after stripping the package dir)
-SCOPE_PREFIXES = ("api/", "scheduler/", "operator/", "resilience/")
+#: control-plane scope: path prefixes (after stripping the package dir).
+#: serve/ joined in ISSUE 12: decode deadlines, drain windows, Retry-After
+#: derivations and watchdog stalls are all duration arithmetic — an NTP
+#: step must not cancel a request early or fire a serving stall.
+SCOPE_PREFIXES = ("api/", "scheduler/", "operator/", "resilience/",
+                  "serve/")
 #: plus individual clock-sensitive modules outside those trees
-SCOPE_FILES = ("train/watchdog.py", "serve/engine.py", "serve/kv_cache.py")
+SCOPE_FILES = ("train/watchdog.py",)
 
 #: resolved call targets that read the wall clock
 WALL_CLOCK = frozenset({

@@ -295,7 +295,14 @@ class Store:
                       # serving traffic counters (ISSUE 9): same
                       # delta-from-cumulative contract, reported by serve
                       # pods in their heartbeats' `serve` payload
-                      "serve_requests": 0, "serve_tokens": 0}
+                      "serve_requests": 0, "serve_tokens": 0,
+                      # request-path fault tolerance (ISSUE 12): shed
+                      # admissions + KV-pressure preemptions bridge from
+                      # the same payload; request retries are counted by
+                      # the serve FRONT (wire count_serve_retries as its
+                      # on_retry hook)
+                      "serve_rejected": 0, "serve_preemptions": 0,
+                      "serve_request_retries": 0}
         # per-run (incarnation, last-seen cumulative train counters) for
         # delta accounting; in-memory like the counters themselves —
         # Prometheus counters are process-local by contract. Bounded by
@@ -426,6 +433,30 @@ class Store:
         self._h_serve_itl = self.metrics.histogram(
             "polyaxon_serve_intertoken_seconds",
             "Interval between consecutive generated tokens (serve pods)")
+        # request-path fault tolerance (ISSUE 12): overload shedding,
+        # KV-pressure preemptions and replica drain state, bridged from
+        # the same heartbeat payload; retries come from the serve front
+        self.metrics.counter(
+            "polyaxon_serve_rejected_total",
+            "Generate requests shed at admission by serve pods (429)",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("serve_rejected", 0) for st in p)))
+        self.metrics.counter(
+            "polyaxon_serve_preemptions_total",
+            "Running sequences evicted back to waiting under KV pressure",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("serve_preemptions", 0) for st in p)))
+        self.metrics.counter(
+            "polyaxon_serve_request_retries_total",
+            "Generate requests retried against another replica by the "
+            "serve front (connect failures / 503s)",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("serve_request_retries", 0) for st in p)))
+        self.metrics.gauge(
+            "polyaxon_serve_draining",
+            "Serve replicas currently draining (fresh reporters)",
+            value_fn=(lambda p=peers: float(sum(
+                st._serve_traffic_for_scrape()["draining"] for st in p))))
         self.metrics.gauge(
             "polyaxon_store_epoch",
             "Store epoch (bumped by every standby promotion)",
@@ -1809,6 +1840,16 @@ class Store:
             rec["waiting"] = _num(serve.get("waiting"))
             rec["kv_used"] = _num(serve.get("kv_blocks_used"))
             rec["kv_total"] = _num(serve.get("kv_blocks_total"))
+            # drain state (ISSUE 12): last-write-per-reporter like the
+            # gauges — the agent's scale-down gate reads it per replica
+            rec["draining"] = bool(serve.get("draining"))
+            rec["drained"] = bool(serve.get("drained"))
+            try:
+                rec["replica"] = (int(serve["replica"])
+                                  if serve.get("replica") is not None
+                                  else None)
+            except (TypeError, ValueError):
+                rec["replica"] = None
             last = rec["counters"]
 
             def delta(key_: str, new) -> int:
@@ -1823,6 +1864,10 @@ class Store:
                 "requests", serve.get("requests_total"))
             self.stats["serve_tokens"] += delta(
                 "tokens", serve.get("tokens_total"))
+            self.stats["serve_rejected"] += delta(
+                "rejected", serve.get("rejected_total"))
+            self.stats["serve_preemptions"] += delta(
+                "preempted", serve.get("preemptions_total"))
         for field_, hist in (("ttft", self._h_serve_ttft),
                              ("itl", self._h_serve_itl)):
             obs = serve.get(field_)
@@ -1850,7 +1895,7 @@ class Store:
         autoscale input and the gauge families' source. ``uuid`` scopes to
         one service run; None aggregates every run."""
         now = time.monotonic()  # same clock as rec["at"] freshness stamps
-        running = waiting = kv_used = kv_total = reporters = 0
+        running = waiting = kv_used = kv_total = reporters = draining = 0
         with self._train_lock:
             runs = ([uuid] if uuid is not None
                     else list(self._serve_seen))
@@ -1866,10 +1911,63 @@ class Store:
                     waiting += rec.get("waiting", 0)
                     kv_used += rec.get("kv_used", 0)
                     kv_total += rec.get("kv_total", 0)
+                    draining += 1 if rec.get("draining") else 0
         return {"running": running, "waiting": waiting,
                 "reporters": reporters, "kv_used": kv_used,
-                "kv_total": kv_total,
+                "kv_total": kv_total, "draining": draining,
                 "kv_utilization": (kv_used / kv_total if kv_total else 0.0)}
+
+    def serve_replica_drain(self, uuid: str) -> dict:
+        """Per-replica drain/traffic state for one service run — the
+        agent's scale-down gate: a surplus pod is deleted only once its
+        replica reports drained (or the drain deadline passes). Keyed by
+        the replica index the pod stamps into its serve payload; the
+        freshest reporter per replica wins (a restarted replica mints a
+        new incarnation)."""
+        now = time.monotonic()
+        out: dict[int, dict] = {}
+        with self._train_lock:
+            for rec in (self._serve_seen.get(uuid) or {}).values():
+                rep = rec.get("replica")
+                if rep is None:
+                    continue
+                age = now - rec.get("at", 0)
+                cur = out.get(rep)
+                if cur is None or age < cur["age"]:
+                    out[rep] = {
+                        "age": age,
+                        "draining": bool(rec.get("draining")),
+                        "drained": bool(rec.get("drained")),
+                        "running": rec.get("running", 0),
+                        "waiting": rec.get("waiting", 0),
+                    }
+        return out
+
+    def serve_progress(self, uuid: str) -> Optional[dict]:
+        """Liveness-vs-progress split for serve replicas (ISSUE 12,
+        mirroring heartbeat_step for trainers): cumulative completed
+        requests (per-reporter counter watermarks, beat-gap proof) plus
+        the currently-waiting depth across fresh reporters. The reaper's
+        serving stall rule reaps a run whose ``requests_total`` freezes
+        while ``waiting > 0`` — alive beats, dead engine. None when the
+        run never reported serve traffic."""
+        now = time.monotonic()
+        with self._train_lock:
+            per_run = self._serve_seen.get(uuid)
+            if not per_run:
+                return None
+            total = sum(int(rec.get("counters", {}).get("requests", 0))
+                        for rec in per_run.values())
+            waiting = sum(rec.get("waiting", 0) for rec in per_run.values()
+                          if now - rec.get("at", 0) <= self.serve_fresh_s)
+        return {"requests_total": total, "waiting": waiting}
+
+    def count_serve_retries(self, n: int = 1) -> None:
+        """Bump the request-retry counter (ISSUE 12) — wire it as a
+        ServeFront's ``on_retry``; pods can't see client-side retries,
+        and the family's value_fn reads this stat."""
+        with self._train_lock:
+            self.stats["serve_request_retries"] += int(n)
 
     def delete_run(self, uuid: str) -> bool:
         self._check_writable()

@@ -24,8 +24,18 @@ from typing import Callable, Optional
 
 def start_tcp_proxy(
     target_host: str, target_port: int, local_port: int = 0,
+    fallback_targets: Optional[list] = None,
 ) -> tuple[int, Callable[[], None]]:
-    """Listen on 127.0.0.1:local_port, pipe each connection to the target."""
+    """Listen on 127.0.0.1:local_port, pipe each connection to the target.
+
+    ``fallback_targets`` (ISSUE 12): ordered ``(host, port)`` alternates
+    — replica endpoints of the same service. A connection whose dial
+    fails tries the next target in the same accept (sticky: later
+    connections start at the endpoint that worked), so a replica kill
+    costs the client one reconnect, not a dead tunnel."""
+    targets = [(target_host, int(target_port))]
+    targets += [(h, int(p)) for h, p in (fallback_targets or [])]
+    cur = [0]  # sticky index, shared across accepts
     lsock = socket.socket()
     lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     lsock.bind(("127.0.0.1", local_port))
@@ -73,10 +83,15 @@ def start_tcp_proxy(
                 conn, _ = lsock.accept()
             except OSError:
                 return  # listener closed
-            try:
-                tgt = socket.create_connection(
-                    (target_host, target_port), timeout=10)
-            except OSError:
+            tgt = None
+            for _ in range(len(targets)):
+                try:
+                    tgt = socket.create_connection(
+                        targets[cur[0] % len(targets)], timeout=10)
+                    break
+                except OSError:
+                    cur[0] += 1  # dead replica: rotate, stay sticky after
+            if tgt is None:
                 conn.close()
                 continue
             bridge(conn, tgt)

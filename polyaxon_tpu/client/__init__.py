@@ -1,5 +1,8 @@
-"""API clients (upstream RunClient/ProjectClient equivalents)."""
+"""API clients (upstream RunClient/ProjectClient equivalents), plus the
+serve front — the request-path failover client for `kind: service`
+replica fleets (ISSUE 12)."""
 
 from .client import (
     AgentClient, ApiError, BaseClient, ProjectClient, RunClient, TokenClient,
 )
+from .serve import ServeFront, ServeUnavailableError  # noqa: F401

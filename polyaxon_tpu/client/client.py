@@ -380,6 +380,53 @@ class RunClient(BaseClient):
             f.write(resp.content)
         return dest
 
+    # -- serving (ISSUE 12) ------------------------------------------------
+
+    def serve_endpoints(self, uuid: Optional[str] = None) -> list[str]:
+        """Live replica endpoints of a `kind: service` run: the
+        ``serve-endpoint-<replica>.json`` files replicas publish into the
+        run's artifacts (replica 0 owns the declared port; the rest land
+        on ephemeral ones), against the agent-stamped service host.
+        Falls back to the stamped meta.service port when no endpoint
+        file exists yet."""
+        import json as _json
+
+        run = self.refresh(uuid)
+        svc = ((run.get("meta") or {}).get("service") or {})
+        host = svc.get("host", "127.0.0.1")
+        eps: list[tuple[int, str]] = []
+        try:
+            tree = self._json("GET", self._rpath("/artifacts/tree",
+                                                 uuid=uuid))
+            names = [f["name"] for f in tree.get("files", [])
+                     if f["name"].startswith("serve-endpoint-")]
+        except ApiError:
+            names = []
+        for name in names:
+            try:
+                resp = self._req("GET",
+                                 self._rpath("/artifacts/file", uuid=uuid),
+                                 params={"path": name})
+                d = _json.loads(resp.content)
+                eps.append((int(d["replica"]),
+                            f"http://{host}:{int(d['port'])}"))
+            except (ApiError, ValueError, KeyError, TypeError):
+                continue
+        if not eps and svc.get("port"):
+            eps.append((0, f"http://{host}:{int(svc['port'])}"))
+        return [url for _, url in sorted(eps)]
+
+    def serve_front(self, uuid: Optional[str] = None, **kwargs: Any):
+        """A request-path failover :class:`~polyaxon_tpu.client.serve.
+        ServeFront` over this service run's replicas — endpoints
+        re-discovered per attempt, so replica churn (kills, restarts,
+        autoscale) is survived mid-conversation."""
+        from .serve import ServeFront
+
+        uuid = uuid or self.run_uuid
+        return ServeFront(
+            endpoints_fn=lambda: self.serve_endpoints(uuid), **kwargs)
+
     def log_artifact_lineage(self, artifact: Any, uuid: Optional[str] = None) -> dict:
         body = artifact.to_dict() if hasattr(artifact, "to_dict") else dict(artifact)
         return self._json("POST", self._rpath("/lineage", uuid=uuid), json=body)

@@ -1,0 +1,283 @@
+"""Serve front: request-path failover over a service run's replicas
+(ISSUE 12).
+
+The control plane already survives replica churn; this is the piece that
+makes a single *request* survive it. One :class:`ServeFront` holds an
+ordered endpoint list (static, or a discovery callable refreshed per
+attempt — e.g. the ``serve-endpoint-*.json`` files replicas publish into
+the run dir) and applies the BaseClient failover doctrine to the
+``/generate`` path:
+
+- requests **round-robin across replicas** (a front that pins one
+  replica starves the rest and melts under its own hot spot);
+  **connect failures and 503s retry elsewhere** — a dead pod or a
+  draining replica is a host-level verdict, the endpoint is skipped for
+  ``dead_for_s`` before re-probing, and the request carries an
+  idempotency id so the retry can never generate twice on one replica
+  (the engine's completed-request cache answers).
+- **429s back off** by the server's Retry-After (overload is
+  service-wide: rotating doesn't help, waiting does) and count against
+  the attempt budget.
+- **a partially-streamed body is NEVER blindly re-POSTed**: a
+  mid-stream disconnect resumes by id (``GET /result/{request_id}``) —
+  the finished result comes from the completed-request cache of
+  whichever replica ran it; only when no replica knows the id (the
+  owner died before finishing) is the request re-submitted, which is
+  safe exactly because it never completed anywhere.
+
+Retries feed ``polyaxon_serve_request_retries_total`` in the front's OWN
+registry; to land them on the control plane's pane of glass wire
+``on_retry=store.count_serve_retries`` — do NOT pass the store's
+registry as ``metrics``: the store already registered that family with a
+``value_fn`` over its stats dict, which would shadow the front's
+increments at scrape time.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid as _uuid
+from typing import Any, Callable, Optional
+
+import requests
+
+
+class ServeUnavailableError(RuntimeError):
+    """No replica accepted the request within the attempt budget."""
+
+
+class _HostLevel(Exception):
+    """Internal: a pre-body 503 on the stream path — retry elsewhere."""
+
+
+class _Rejected(Exception):
+    """Internal: a pre-body 429 on the stream path — back off, retry."""
+
+    def __init__(self, retry_after):
+        super().__init__("overloaded")
+        self.retry_after = retry_after
+
+
+class ServeFront:
+    def __init__(
+        self,
+        endpoints: Optional[list] = None,
+        endpoints_fn: Optional[Callable[[], list]] = None,
+        *,
+        timeout: float = 60.0,
+        max_attempts: int = 8,
+        backoff_s: float = 0.2,
+        retry_after_cap_s: float = 10.0,
+        metrics=None,
+        on_retry: Optional[Callable[[int], None]] = None,
+    ):
+        if not endpoints and endpoints_fn is None:
+            raise ValueError("ServeFront needs endpoints or endpoints_fn")
+        self._static = [e.rstrip("/") for e in (endpoints or [])]
+        self._endpoints_fn = endpoints_fn
+        self.timeout = float(timeout)
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.retry_after_cap_s = float(retry_after_cap_s)
+        #: seconds a replica that answered with a host-level failure
+        #: (connect error / 503) is skipped before being re-probed
+        self.dead_for_s = 2.0
+        self._rr = 0                      # round-robin start cursor
+        self._dead: dict = {}             # endpoint -> monotonic re-probe time
+        self._session = requests.Session()
+        self.on_retry = on_retry
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._c_retries = self.metrics.counter(
+            "polyaxon_serve_request_retries_total",
+            "Generate requests retried against another replica by the "
+            "serve front (connect failures / 503s)")
+        #: audit: every 429's Retry-After header value (None = missing —
+        #: a contract violation the fault soak asserts never happens)
+        self.rejections: list = []
+
+    # -- endpoint rotation ---------------------------------------------------
+
+    def _endpoints(self) -> list:
+        eps = self._static
+        if self._endpoints_fn is not None:
+            try:
+                eps = [e.rstrip("/") for e in self._endpoints_fn()] or eps
+            except Exception:
+                pass
+        return eps or self._static
+
+    def _pick(self) -> Optional[str]:
+        """Round-robin across replicas (spread, not sticky-to-one),
+        skipping endpoints recently seen host-level dead — unless every
+        endpoint is marked dead, in which case probe anyway. None when
+        discovery found nothing (the caller backs off and re-discovers
+        next attempt)."""
+        eps = self._endpoints()
+        if not eps:
+            return None
+        now = time.monotonic()
+        for _ in range(len(eps)):
+            ep = eps[self._rr % len(eps)]
+            self._rr += 1
+            if self._dead.get(ep, 0) <= now:
+                return ep
+        return eps[self._rr % len(eps)]
+
+    def _mark_dead(self, ep: str) -> None:
+        self._dead[ep] = time.monotonic() + self.dead_for_s
+
+    def _count_retry(self) -> None:
+        self._c_retries.inc()
+        if self.on_retry is not None:
+            try:
+                self.on_retry(1)
+            except Exception:
+                pass
+
+    # -- the request path ----------------------------------------------------
+
+    def generate(self, prompt: Optional[str] = None,
+                 tokens: Optional[list] = None,
+                 request_id: Optional[str] = None,
+                 stream: bool = False,
+                 deadline_s: Optional[float] = None,
+                 **sampling: Any) -> dict:
+        """One exactly-once generate against the replica fleet; returns
+        the final result dict (with ``request_id``). Raises
+        :class:`ServeUnavailableError` after the attempt budget."""
+        rid = request_id or _uuid.uuid4().hex
+        body: dict = {"request_id": rid, **sampling}
+        if tokens is not None:
+            body["tokens"] = list(tokens)
+        else:
+            body["prompt"] = prompt
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
+        if stream:
+            body["stream"] = True
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            ep = self._pick()
+            if ep is None:
+                # discovery found nothing (replicas not published yet):
+                # back off and re-discover on the next attempt
+                last = ServeUnavailableError("no endpoints discovered")
+                time.sleep(min(self.backoff_s * (2 ** min(attempt, 4)),
+                               2.0))
+                continue
+            try:
+                if stream:
+                    return self._generate_stream(ep, body, rid)
+                r = self._session.post(f"{ep}/generate", json=body,
+                                       timeout=self.timeout)
+            except (requests.ConnectionError, requests.Timeout) as e:
+                # host-level: dead/wedged replica. The id makes the
+                # retry idempotent; nothing was delivered.
+                last = e
+                self._retry_elsewhere(ep, attempt)
+                continue
+            except _HostLevel as e:
+                last = ServeUnavailableError(str(e))
+                self._retry_elsewhere(ep, attempt)
+                continue
+            except _Rejected as e:
+                last = ServeUnavailableError("overloaded")
+                self._sleep_retry_after(e.retry_after)
+                continue
+            if r.status_code == 503:
+                # draining / not-ready: explicit "route elsewhere"
+                last = ServeUnavailableError(r.text[:200])
+                self._retry_elsewhere(ep, attempt)
+                continue
+            if r.status_code == 429:
+                # overload is service-wide: wait the server's hint, do
+                # NOT mark the replica dead (it is serving, just full)
+                last = ServeUnavailableError(f"overloaded: {r.text[:200]}")
+                self._sleep_retry_after(r.headers.get("Retry-After"))
+                continue
+            r.raise_for_status()
+            out = r.json()
+            out.setdefault("request_id", rid)
+            return out
+        raise ServeUnavailableError(
+            f"no replica served request {rid} in "
+            f"{self.max_attempts} attempts") from last
+
+    def _sleep_retry_after(self, ra) -> None:
+        self.rejections.append(ra)
+        try:
+            wait = min(float(ra), self.retry_after_cap_s)
+        except (TypeError, ValueError):
+            wait = self.backoff_s
+        time.sleep(wait)
+
+    def _retry_elsewhere(self, ep: str, attempt: int) -> None:
+        self._mark_dead(ep)
+        self._count_retry()
+        time.sleep(min(self.backoff_s * (2 ** min(attempt, 4)), 2.0))
+
+    def _generate_stream(self, ep: str, body: dict, rid: str) -> dict:
+        """NDJSON streaming with the no-blind-re-POST rule: a disconnect
+        mid-body resumes by id instead of re-submitting. Pre-body 503s
+        and 429s surface as the internal retry signals (nothing was
+        streamed, so the non-stream failover rules apply unchanged)."""
+        started = False
+        try:
+            r = self._session.post(f"{ep}/generate", json=body,
+                                   timeout=self.timeout, stream=True)
+            if r.status_code == 503:
+                raise _HostLevel(r.text[:200])
+            if r.status_code == 429:
+                raise _Rejected(r.headers.get("Retry-After"))
+            r.raise_for_status()
+            final = None
+            for line in r.iter_lines():
+                if not line:
+                    continue
+                started = True
+                final = json.loads(line)
+            if final is not None and final.get("done"):
+                final.setdefault("request_id", rid)
+                return final
+            raise requests.ConnectionError("stream ended without a result")
+        except (requests.ConnectionError, requests.Timeout,
+                requests.exceptions.ChunkedEncodingError) as e:
+            if not started:
+                raise
+            # partial body: NEVER re-POST — resume by id
+            self._count_retry()
+            result = self.resume(rid)
+            if result is not None:
+                return result
+            raise ServeUnavailableError(
+                f"stream for {rid} broke and no replica holds its "
+                "result") from e
+
+    def resume(self, request_id: str,
+               poll_timeout_s: float = 30.0) -> Optional[dict]:
+        """Resume-by-id across the fleet: poll ``/result/{id}`` on every
+        replica until one returns the finished result (202 = still
+        generating → keep polling the owner). None when no replica knows
+        the id."""
+        deadline = time.monotonic() + poll_timeout_s
+        while time.monotonic() < deadline:
+            in_flight = False
+            for ep in self._endpoints():
+                try:
+                    r = self._session.get(f"{ep}/result/{request_id}",
+                                          timeout=self.timeout)
+                except (requests.ConnectionError, requests.Timeout):
+                    continue
+                if r.status_code == 200:
+                    out = r.json()
+                    out.setdefault("request_id", request_id)
+                    return out
+                if r.status_code == 202:
+                    in_flight = True
+            if not in_flight:
+                return None
+            time.sleep(0.1)
+        return None

@@ -32,6 +32,12 @@ class OperationCR:
     backoff_limit: int = 0
     active_deadline_s: float = 0.0  # <=0: none
     ttl_s: float = -1.0             # <0: keep resources after finish
+    # per-pod restart (ISSUE 12): replicated services replace ONLY the
+    # failed replica pod (the survivors keep serving their in-flight
+    # requests) instead of the slice-level all-or-nothing teardown a
+    # collective training job needs. Budget still comes from
+    # backoff_limit; past it the kernel's FAIL path takes over.
+    per_pod_restart: bool = False
 
     @property
     def label_selector(self) -> dict[str, str]:
@@ -164,7 +170,8 @@ class OperationReconciler:
             self._ops[op.run_uuid] = state
         return True
 
-    def scale(self, run_uuid: str, resources: list[dict]) -> tuple[int, int]:
+    def scale(self, run_uuid: str, resources: list[dict],
+              keep: Optional[set] = None) -> tuple[int, int]:
         """Converge a tracked operation's pod set onto ``resources``
         (service replica autoscale, ISSUE 9): diff DESIRED pod names
         against the LIVE set — apply the missing, delete the surplus —
@@ -175,7 +182,14 @@ class OperationReconciler:
         scale-down are deleted by the next scale call, and a pod name
         already live is never re-applied (zero duplicate launches — a
         duplicate apply would 409 like a real apiserver). Returns
-        (applied, deleted)."""
+        (applied, deleted).
+
+        ``keep`` (ISSUE 12, graceful drain): surplus pod names that are
+        still DRAINING — they stay off the desired set (restarts won't
+        re-apply them) but are NOT deleted this pass; the agent calls
+        scale again without ``keep`` once their drain completed or timed
+        out, so a surplus pod is only ever deleted after its in-flight
+        requests finished (or the drain deadline passed)."""
         with self._lock:
             state = self._ops.get(run_uuid)
         if state is None:
@@ -192,8 +206,10 @@ class OperationReconciler:
                              state.op.label_selector):
                 live[s.name] = s
             applied = deleted = 0
+            protected = set(keep or ())
             for name, st in live.items():
-                if name not in desired and not st.terminating:
+                if (name not in desired and not st.terminating
+                        and name not in protected):
                     self._c(self.cluster.delete, "Pod", name)
                     deleted += 1
             for name, manifest in desired.items():
@@ -277,9 +293,41 @@ class OperationReconciler:
             ttl_s=state.op.ttl_s,
         )
 
+    def _replace_failed_pods(self, state: _OpState) -> bool:
+        """Per-pod restart (ISSUE 12): a replicated service replaces ONLY
+        its failed replica pods — deleting the whole set would abort the
+        surviving replicas' in-flight requests to heal one. Each
+        replacement round burns one backoff attempt (same budget as a
+        slice restart); once the budget is gone the kernel's POD_FAILED
+        path fails the op as usual. Run status is untouched: the service
+        is still running through its surviving replicas — replica churn
+        is a pod-level event, not a run transition."""
+        statuses = self._c(self.cluster.pod_statuses,
+                           state.op.label_selector)
+        failed = [s for s in statuses
+                  if s.phase == PodPhase.FAILED and not s.terminating]
+        if not failed:
+            return False
+        if state.retries_done >= state.op.backoff_limit:
+            return False  # budget gone: the kernel fails the op
+        state.retries_done += 1
+        desired = {m["metadata"]["name"]: m for m in state.op.resources
+                   if m.get("kind") == "Pod"}
+        for s in failed:
+            self._c(self.cluster.delete, "Pod", s.name)
+            manifest = desired.get(s.name)
+            if manifest is not None:
+                self._c(self.cluster.apply, manifest)
+            # a failed pod no longer in the desired set (died mid-drain)
+            # is simply cleaned up, never resurrected
+        return True
+
     def _reconcile_op(self, state: _OpState) -> None:
         if state.gc_done or state.applying:
             return
+        if state.op.per_pod_restart and state.final_status is None:
+            if self._replace_failed_pods(state):
+                return
         decision: Decision = reconcile(self._observe(state))
         op = state.op
         if decision.action == Action.WAIT:

@@ -18,8 +18,9 @@ See docs/RESILIENCE.md for the failure model and how to run the chaos soak.
 """
 
 from .chaos import (
-    ChaosCluster, ChaosConfig, FaultyStore, OutageStore, TrainerChaos,
-    flaky_http_middleware, tear_latest_checkpoint, tear_snapshot,
+    ChaosCluster, ChaosConfig, FaultyStore, OutageStore, ServeChaos,
+    TrainerChaos, flaky_http_middleware, tear_latest_checkpoint,
+    tear_snapshot,
 )
 from .heartbeat import ZombieReaper
 from .retry import DEFAULT_HTTP_RETRY, RetryPolicy
@@ -31,6 +32,7 @@ __all__ = [
     "FaultyStore",
     "OutageStore",
     "RetryPolicy",
+    "ServeChaos",
     "TrainerChaos",
     "ZombieReaper",
     "flaky_http_middleware",

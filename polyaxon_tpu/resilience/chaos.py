@@ -484,6 +484,88 @@ class TrainerChaos:
         return True
 
 
+class ServeChaos:
+    """Serve-engine fault injection (ISSUE 12): wedge one replica's
+    decode loop mid-traffic — ``hang_after_requests`` sleeps "forever"
+    once the replica has COMPLETED that many requests, outside the
+    scheduling lock so the replica keeps accepting (and shedding)
+    requests exactly like a decode stuck inside an XLA dispatch. The
+    pod's watchdog must end the process; the budget marker persisted in
+    ``state_dir`` (the run dir, shared across attempts) keeps the
+    RESTARTED replica clean, so the soak proves watchdog -> retry ->
+    fresh replica instead of hanging every attempt. ``replica`` scopes
+    the fault to one replica index (every replica shares the spec)."""
+
+    _STATE_FILE = "chaos-serve.json"
+
+    def __init__(self, hang_after_requests: Optional[int] = None,
+                 replica: int = 0, hang_sleep_s: float = 3600.0,
+                 state_dir: Optional[str] = None):
+        self.hang_after_requests = hang_after_requests
+        self.replica = int(replica)
+        self.hang_sleep_s = float(hang_sleep_s)
+        self.state_dir = state_dir
+        self.injected: list[tuple[str, int]] = []
+        self._state = self._load()
+
+    @classmethod
+    def from_spec(cls, spec: Any, replica: int = 0,
+                  state_dir: Optional[str] = None) -> Optional["ServeChaos"]:
+        if not isinstance(spec, dict):
+            return None
+        if spec.get("hang_after_requests") is None:
+            return None
+        if int(spec.get("replica", 0)) != int(replica):
+            return None
+        return cls(hang_after_requests=int(spec["hang_after_requests"]),
+                   replica=replica,
+                   hang_sleep_s=float(spec.get("hang_sleep_s", 3600.0)),
+                   state_dir=state_dir)
+
+    def _path(self) -> Optional[str]:
+        if not self.state_dir:
+            return None
+        return os.path.join(self.state_dir,
+                            f"{self._STATE_FILE}-r{self.replica}")
+
+    def _load(self) -> dict:
+        path = self._path()
+        if path:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                pass
+        return {"hangs": 0}
+
+    def _save(self) -> None:
+        path = self._path()
+        if not path:
+            return
+        os.makedirs(self.state_dir, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def maybe_hang(self, requests_done: int) -> None:
+        """Called by the engine loop between iterations."""
+        if self.hang_after_requests is None:
+            return
+        if requests_done < self.hang_after_requests:
+            return
+        if self._state.get("hangs", 0) >= 1:
+            return
+        # spend the budget BEFORE sleeping: the watchdog hard-exits this
+        # process, and the restarted attempt must run clean
+        self._state["hangs"] = 1
+        self._save()
+        self.injected.append(("hang", requests_done))
+        time.sleep(self.hang_sleep_s)
+
+
 def tear_snapshot(snapshot_dir: str) -> Optional[str]:
     """Chaos hook (ISSUE 7): truncate snapshot.db to half its size — a
     torn copy, what a host dying mid-upload leaves behind. The sha256
@@ -528,5 +610,5 @@ def tear_latest_checkpoint(ckpt_dir: str,
 
 
 __all__ = ["ChaosCluster", "ChaosConfig", "FaultyStore", "OutageStore",
-           "TrainerChaos", "flaky_http_middleware",
+           "ServeChaos", "TrainerChaos", "flaky_http_middleware",
            "tear_latest_checkpoint", "tear_snapshot", "PodPhase"]

@@ -108,6 +108,20 @@ class ZombieReaper:
     adopted runs get a full ``stall_grace`` before judgment — mirroring
     the PR-7 failover grace. Runs that report no step at all are never
     stall-judged (progress reporting is opt-in by runtime).
+
+    Serving stall rule (ISSUE 12): the same split for serve replicas —
+    a wedged decode loop keeps beating through its reporter thread while
+    its cumulative ``requests_total`` freezes with ``waiting > 0``
+    (accepted requests starving behind a dead engine). The store's
+    ``serve_progress(uuid)`` feeds the rule; judgment uses the reaper's
+    local observation window like the train rule, and a run with zero
+    waiting (or no serve traffic at all) is never judged — an idle
+    replica completes nothing, honestly. This backstops replicas whose
+    OWN watchdog is disabled, mirroring the train stall-reap round.
+    Run-level honesty: the totals SUM across replicas, so one healthy
+    replica advancing the count vouches for the run — the per-replica
+    watchdog is the per-replica guard; this rule catches the whole
+    serving plane wedging.
     """
 
     def __init__(
@@ -136,6 +150,8 @@ class ZombieReaper:
         # uuid -> (step, owner, since_monotonic): the local observation
         # window behind the stall rule (fresh on takeover by design)
         self._progress: dict[str, tuple] = {}
+        # uuid -> ((requests_total, owner), since): the serving twin
+        self._serve_progress: dict[str, tuple] = {}
         # observability (ISSUE 5): reap actions + the staleness the reaper
         # actually observed, exported through the shared registry
         if metrics is None:
@@ -247,6 +263,8 @@ class ZombieReaper:
         self._strikes = {u: s for u, s in self._strikes.items() if u in seen}
         self._progress = {u: p for u, p in self._progress.items()
                           if u in seen}
+        self._serve_progress = {u: p for u, p in self._serve_progress.items()
+                                if u in seen}
         self.last_max_staleness = max_stale
         self.reaped.extend(actions)
         return actions
@@ -262,6 +280,38 @@ class ZombieReaper:
         return str(owner) if owner is not None else None
 
     def _stalled(self, run: dict, now: float) -> bool:
+        """True when the run's reported progress has been frozen for
+        ``stall_grace``: training-step freeze (ISSUE 8) or serving
+        requests_total-frozen-while-waiting (ISSUE 12)."""
+        if self.stall_grace <= 0:
+            return False
+        if self._train_stalled(run, now):
+            return True
+        return self._serve_stalled(run, now)
+
+    def _serve_stalled(self, run: dict, now: float) -> bool:
+        """Serving twin of the step-freeze rule: completed-request total
+        frozen while accepted requests wait. Judged on this reaper's own
+        observation window (fresh on takeover); a waiting depth of zero
+        clears the clock — nothing owed, nothing stalled."""
+        prog_fn = getattr(self.store, "serve_progress", None)
+        if not callable(prog_fn):
+            return False
+        try:
+            prog = prog_fn(run["uuid"])
+        except Exception:
+            return False
+        if not prog or prog.get("waiting", 0) <= 0:
+            self._serve_progress.pop(run["uuid"], None)
+            return False
+        ident = (prog["requests_total"], self._owner_of(run))
+        rec = self._serve_progress.get(run["uuid"])
+        if rec is None or rec[0] != ident:
+            self._serve_progress[run["uuid"]] = (ident, now)
+            return False
+        return now - rec[1] >= self.stall_grace
+
+    def _train_stalled(self, run: dict, now: float) -> bool:
         """True when the run's reported step has been frozen for
         ``stall_grace`` by BOTH clocks: the store's ``heartbeat_step_at``
         age (authoritative across agents) and this reaper's own
@@ -269,8 +319,6 @@ class ZombieReaper:
         freshly-adopted run always gets a full grace period). A run
         reporting no step is never judged; a step that ADVANCES — however
         slowly — resets everything."""
-        if self.stall_grace <= 0:
-            return False
         step = run.get("heartbeat_step")
         if step is None:
             return False
@@ -300,6 +348,7 @@ class ZombieReaper:
         the sharded fleet)."""
         uuid = run["uuid"]
         self._progress.pop(uuid, None)  # one verdict per observed freeze
+        self._serve_progress.pop(uuid, None)
         if alive_driver:
             if self.teardown is None:
                 return None
@@ -332,6 +381,7 @@ class ZombieReaper:
             # failover, and judging the pre-failover freeze would
             # false-positive every healthy pod at once
             self._progress.clear()
+            self._serve_progress.clear()
             self._grace_until = now + self.failover_grace
         return now < self._grace_until
 

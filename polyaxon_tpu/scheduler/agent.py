@@ -341,9 +341,18 @@ class LocalAgent:
         # a mid-scale agent kill converges with zero duplicate launches.
         self.autoscale_interval = 1.0
         self._autoscale_last = 0.0
-        # uuid -> {auto, resolved, replicas, low_since} (invalidated on
-        # untrack/handoff; rebuilt lazily from the store)
+        # uuid -> {auto, resolved, replicas, low_since, drain} (invalidated
+        # on untrack/handoff; rebuilt lazily from the store)
         self._svc_scale: dict[str, dict] = {}
+        # graceful drain (ISSUE 12): a scale-down first marks the surplus
+        # replicas draining (marker file in the run dir; the replica
+        # closes admission, finishes in-flight work and reports drain
+        # state in its serve heartbeats) and only deletes a surplus pod
+        # once its drain completed — or this deadline passed
+        self.serve_drain_timeout = 30.0
+        #: audit trail for soaks/tests: (uuid, [replica, ...], outcome)
+        #: with outcome "drained" (in-flight completed) or "timeout"
+        self.autoscale_drains: list[tuple] = []
         self.metrics.gauge(
             "polyaxon_serve_target_replicas",
             "Summed autoscale replica target across owned service runs",
@@ -1358,6 +1367,9 @@ class LocalAgent:
         desired = -(-demand // info["per"]) if demand > 0 else min_r
         desired = max(min_r, min(max_r, desired))
         cur = int(info["replicas"])
+        if info.get("drain") is not None:
+            self._drive_drain(uuid, info, desired, now)
+            return
         if desired > cur:
             info["low_since"] = None
             if self.capacity_chips is not None:
@@ -1375,9 +1387,105 @@ class LocalAgent:
                 info["low_since"] = now
             elif now - info["low_since"] >= delay:
                 info["low_since"] = None
-                self._scale_service(uuid, info, desired)
+                self._start_drain(uuid, info, desired, now)
         else:
             info["low_since"] = None
+
+    # -- graceful scale-down drain (ISSUE 12) -------------------------------
+
+    def _drain_marker_dir(self, uuid: str) -> Optional[str]:
+        run = self.store.get_run(uuid)
+        if run is None:
+            return None
+        return run_artifacts_dir(self.artifacts_root, run["project"], uuid)
+
+    def _start_drain(self, uuid: str, info: dict, target: int,
+                     now: float) -> None:
+        """Flip the surplus replicas to draining instead of deleting them:
+        marker files in the run dir tell the replicas to close admission
+        (healthz 503) and finish in-flight work; their drain state rides
+        the serve heartbeats back. Pods are deleted by ``_drive_drain``
+        once drained — or when ``serve_drain_timeout`` passes."""
+        import json as _json
+
+        cur = int(info["replicas"])
+        surplus = list(range(int(target), cur))
+        marker_dir = self._drain_marker_dir(uuid)
+        if marker_dir is None:
+            return
+        os.makedirs(marker_dir, exist_ok=True)
+        for i in surplus:
+            path = os.path.join(marker_dir, f"serve-drain-{i}.json")
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    _json.dump({
+                        "replica": i, "reason": "scale-down",
+                        # orphan horizon: an agent crash must not pin the
+                        # replica draining forever
+                        # plx: allow(clock): cross-process marker expiry read by the pod — wall clock is the shared medium
+                        "expires_at": time.time()
+                        + 3 * self.serve_drain_timeout,
+                    }, f)
+                os.replace(tmp, path)
+            except OSError:
+                traceback.print_exc()
+        info["drain"] = {"target": int(target), "replicas": surplus,
+                         "deadline": now + self.serve_drain_timeout,
+                         "dir": marker_dir}
+        # drive once inline: surplus replicas with no serve reporter at
+        # all (plain-container services, or an already-dead pod) have
+        # nothing in flight to protect — they scale down this pass, same
+        # as before drains existed
+        self._drive_drain(uuid, info, int(target), now)
+
+    def _remove_drain_markers(self, marker_dir: str, replicas: list) -> None:
+        for i in replicas:
+            try:
+                os.unlink(os.path.join(marker_dir, f"serve-drain-{i}.json"))
+            except OSError:
+                pass
+
+    def _drive_drain(self, uuid: str, info: dict, desired: int,
+                     now: float) -> None:
+        """One pass of the drain state machine: cancel on a traffic
+        rebound, otherwise delete the surplus pods once every draining
+        replica reports empty (or the deadline passes)."""
+        drain = info["drain"]
+        if desired > drain["target"]:
+            # traffic rebounded above the drain target: cancel — markers
+            # vanish, the replicas reopen admission on their next beat
+            self._remove_drain_markers(drain["dir"], drain["replicas"])
+            info.pop("drain", None)
+            info["low_since"] = None
+            return
+        state = {}
+        try:
+            state = self.store.serve_replica_drain(uuid)
+        except Exception:
+            traceback.print_exc()
+        fresh_s = getattr(self.store, "serve_fresh_s", 15.0)
+
+        def _replica_done(i: int) -> bool:
+            st = state.get(i)
+            if st is None or st["age"] > fresh_s:
+                # no (fresh) reporter: a plain-container replica with no
+                # drain protocol, or a pod already dead — nothing in
+                # flight to protect, vacuously drained
+                return True
+            return bool(st["drained"] or (st["draining"]
+                                          and st["running"] == 0
+                                          and st["waiting"] == 0))
+
+        done = all(_replica_done(i) for i in drain["replicas"])
+        if not done and now < drain["deadline"]:
+            return  # in-flight work still finishing: delete nothing yet
+        outcome = "drained" if done else "timeout"
+        self._remove_drain_markers(drain["dir"], drain["replicas"])
+        info.pop("drain", None)
+        self.autoscale_drains.append((uuid, list(drain["replicas"]),
+                                      outcome))
+        self._scale_service(uuid, info, drain["target"])
 
     def _autoscale_register(self, uuid: str) -> Optional[dict]:
         """Lazily classify a tracked run for autoscale (cached)."""
@@ -2420,12 +2528,20 @@ class LocalAgent:
         replicas = None
         if run_meta:
             replicas = (run_meta.get("autoscale") or {}).get("replicas")
+        from ..schemas.run import V1RunKind
+
         return OperationCR(
             run_uuid=uuid,
             resources=resolved.k8s_resources(service_replicas=replicas),
             backoff_limit=(term.max_retries if term and term.max_retries else 0),
             active_deadline_s=(term.timeout if term and term.timeout else 0.0),
             ttl_s=(term.ttl if term and term.ttl is not None else -1.0),
+            # replicated services replace only the failed replica pod
+            # (ISSUE 12) — a replica kill must not abort the survivors'
+            # in-flight requests the way a collective job's slice
+            # restart has to
+            per_pod_restart=(
+                resolved.compiled.get_run_kind() == V1RunKind.SERVICE),
         )
 
     def _submit_to_cluster(self, uuid: str, resolved) -> None:

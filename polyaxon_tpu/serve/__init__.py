@@ -17,5 +17,8 @@ Layering (mirrors train/):
   ``/generate`` endpoint, and the tracking/heartbeat traffic bridge.
 """
 
-from .engine import GenRequest, SamplingParams, ServeEngine  # noqa: F401
+from .engine import (  # noqa: F401
+    EngineDrainingError, EngineOverloadedError, GenRequest, SamplingParams,
+    ServeEngine,
+)
 from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401

@@ -9,15 +9,42 @@ the running batch for more than one chunk's worth of compute. No global
 pause anywhere: the batch keeps decoding while membership churns.
 
 Block accounting is worst-case at admission (prompt + max_new_tokens): a
-request that admits can always finish, so there is no mid-flight
-out-of-blocks preemption path to get wrong. The trade is utilization
+request that admits can always finish, so a running sequence can never
+hit out-of-blocks mid-flight. The trade is utilization
 (reserved-but-unwritten tail blocks), surfaced honestly by the KV gauge
-(docs/PERFORMANCE.md "Serving" discusses sizing).
+(docs/PERFORMANCE.md "Serving" discusses sizing) — and relieved, when
+it starves the admission head, by the KV-pressure preemption below.
 
 Timing meters ride the emit path: TTFT (arrival -> first token out) and
 inter-token latency per request feed both the pod-local Prometheus
 families (``polyaxon_serve_*``) and a drain buffer the runtime ships to
 the control plane in heartbeats.
+
+Request-path fault tolerance (ISSUE 12):
+
+- **Idempotency ids**: a client-supplied ``request_id`` dedupes
+  submissions — a retry of an id already in flight attaches to the live
+  request, and an id already finished answers from a bounded
+  completed-request cache (exactly-once generation per id on a replica).
+- **Deadlines + cancel**: per-request deadlines (and ``generate``'s
+  client timeout) cancel the request SERVER-side — blocks recycle and
+  the slot frees immediately instead of decoding for an absent caller.
+- **Overload shedding**: the waiting queue is bounded; past it
+  :class:`EngineOverloadedError` carries a Retry-After hint derived from
+  observed throughput (the server answers 429). A request whose
+  worst-case reservation exceeds the whole pool fails loudly at submit.
+- **KV-pressure preemption**: when the head-of-line waiting request
+  stays block-starved past a grace window while a free slot exists, the
+  NEWEST running sequence is evicted back to ``waiting``
+  (recompute-on-readmit: its prefix re-prefills on admission) so
+  admission can never deadlock behind reserved-but-idle tails.
+- **Drain**: ``begin_drain()`` stops admission (submits raise
+  :class:`EngineDrainingError`) while accepted work runs to completion;
+  ``drained`` flips once the engine is empty.
+- **Watchdog beats**: the engine loop beats an attached
+  :class:`~polyaxon_tpu.train.watchdog.StepWatchdog` after every
+  iteration (and while idle), so a decode wedged inside XLA is detected
+  by step silence against the engine's own step-time p95.
 """
 
 from __future__ import annotations
@@ -35,6 +62,21 @@ import numpy as np
 from ..models.transformer import TransformerConfig
 from .kv_cache import OutOfBlocksError, SequenceBlocks
 from .model import decode_step, init_cache, prefill_chunk
+
+
+class EngineOverloadedError(RuntimeError):
+    """The bounded waiting queue is full — shed, don't queue unboundedly.
+    ``retry_after_s`` is the throughput-derived backoff hint the server
+    forwards as a 429 Retry-After header."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class EngineDrainingError(RuntimeError):
+    """The engine is draining: admission is closed (the server answers
+    503 so probes/fronts route elsewhere); accepted work still finishes."""
 
 
 @dataclass(frozen=True)
@@ -61,6 +103,7 @@ class SamplingParams:
 
 
 # request lifecycle: waiting -> prefill -> running -> done|failed
+# (a KV-pressure preemption moves running/prefill back to waiting)
 @dataclass
 class GenRequest:
     id: int
@@ -77,6 +120,19 @@ class GenRequest:
     last_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     error: Optional[str] = None
+    # client idempotency id (ISSUE 12): dedupes retried submissions and
+    # keys the completed-request cache for resume-by-id
+    request_id: Optional[str] = None
+    # absolute monotonic deadline; past it the engine cancels the request
+    # server-side and recycles its blocks the same step
+    deadline: Optional[float] = None
+    preemptions: int = 0
+    # terminal-state latch: resumed/attached waiters block on this instead
+    # of splitting the (single-consumer) token stream queue
+    done: "threading.Event" = field(default_factory=threading.Event)
+    # prefix to re-prefill after a preemption (prompt + emitted tokens
+    # minus the pending next_token); None for a first admission
+    _resume_prefix: Optional[list] = None
     _rng: Optional[np.random.Generator] = None
 
     @property
@@ -129,6 +185,9 @@ class ServeEngine:
         prefill_chunk: int = 64,
         max_seq_len: Optional[int] = None,
         attn_impl: str = "gather",
+        max_waiting: int = 128,
+        preempt_grace_s: float = 2.0,
+        completed_cache: int = 256,
         metrics=None,
     ):
         from ..obs.metrics import MetricsRegistry
@@ -154,6 +213,29 @@ class ServeEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+        # -- request-path fault tolerance (ISSUE 12) -------------------------
+        self.max_waiting = int(max_waiting)
+        self.preempt_grace_s = float(preempt_grace_s)
+        self.completed_cache = int(completed_cache)
+        self._by_rid: dict[str, GenRequest] = {}   # in-flight + done
+        self._rid_done: collections.deque = collections.deque()
+        self._draining = False
+        self._ready = threading.Event()    # first successful step done
+        self._blocked_since: Optional[float] = None  # head-of-line starving
+        # decode-iteration durations feeding the watchdog's p95-scaled
+        # stall deadline (engine's own distribution, not a global
+        # constant). The first two worked steps pay XLA compilation
+        # (prefill jit, decode jit) and are excluded — one 15 s compile
+        # sample would inflate the p95 (and the stall deadline) for the
+        # replica's whole life
+        self._worked_steps = 0
+        self._step_durations: collections.deque = collections.deque(maxlen=256)
+        #: optional train.watchdog.StepWatchdog the loop beats; attach
+        #: before start()
+        self.watchdog = None
+        #: optional resilience.ServeChaos hook (soak fault injection)
+        self.chaos = None
+
         # -- meters ----------------------------------------------------------
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._h_ttft = self.metrics.histogram(
@@ -178,6 +260,16 @@ class ServeEngine:
             "polyaxon_serve_kv_block_utilization",
             "Fraction of KV cache blocks reserved",
             value_fn=lambda: self.cache.utilization)
+        self._c_rejected = self.metrics.counter(
+            "polyaxon_serve_rejected_total",
+            "Generate requests shed at admission (bounded queue, 429)")
+        self._c_preempted = self.metrics.counter(
+            "polyaxon_serve_preemptions_total",
+            "Running sequences evicted back to waiting under KV pressure")
+        self.metrics.gauge(
+            "polyaxon_serve_draining",
+            "1 while this replica is draining (admission closed)",
+            value_fn=lambda: 1.0 if self._draining else 0.0)
         # drained into heartbeats by the runtime (bounded: a beat outage
         # keeps the newest window, not an unbounded backlog)
         self._obs_lock = threading.Lock()
@@ -196,41 +288,170 @@ class ServeEngine:
     def waiting_count(self) -> int:
         return len(self._waiting)
 
-    def submit(self, prompt: list[int],
-               sampling: Optional[SamplingParams] = None) -> GenRequest:
+    @property
+    def ready(self) -> bool:
+        """True once the engine completed its first successful step that
+        processed work — the /healthz readiness signal (a replica still
+        compiling must not receive routed traffic)."""
+        return self._ready.is_set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """Draining AND empty: every accepted request finished."""
+        with self._lock:
+            return (self._draining and not self._waiting
+                    and all(r is None for r in self._slots))
+
+    def begin_drain(self) -> None:
+        """Close admission; accepted requests run to completion."""
+        with self._lock:
+            self._draining = True
+        self._work.set()
+
+    def end_drain(self) -> None:
+        """Reopen admission (a cancelled scale-down)."""
+        with self._lock:
+            self._draining = False
+
+    def await_drain(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.drained:
+                return True
+            time.sleep(0.05)
+        return self.drained
+
+    def lookup(self, request_id: Optional[str]) -> Optional[GenRequest]:
+        """The live or cached request for an idempotency id (resume-by-id)."""
+        if not request_id:
+            return None
+        with self._lock:
+            return self._by_rid.get(request_id)
+
+    def _fail_new(self, req: GenRequest, error: str) -> GenRequest:
+        req.state = "failed"
+        req.error = error
+        req.finished_at = time.monotonic()
+        req.stream.put(None)
+        req.done.set()
+        return req
+
+    def submit_request(
+        self, prompt: list[int],
+        sampling: Optional[SamplingParams] = None,
+        *,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> tuple[GenRequest, bool]:
+        """Admit (or dedupe) one request. Returns ``(req, created)`` —
+        ``created`` is False when ``request_id`` matched a live or cached
+        request (the caller must then wait on ``req.done``, never drain
+        the stream it doesn't own). Raises
+        :class:`EngineDrainingError` / :class:`EngineOverloadedError`."""
         sampling = sampling or SamplingParams()
         vocab = self.cfg.vocab_size
         prompt = [int(t) % vocab for t in prompt]
         req = GenRequest(id=next(self._ids), prompt=prompt,
-                         sampling=sampling)
+                         sampling=sampling,
+                         request_id=request_id,
+                         deadline=(time.monotonic() + float(deadline_s)
+                                   if deadline_s else None))
         if not prompt:
-            req.state = "failed"
-            req.error = "empty prompt"
-            req.finished_at = time.monotonic()
-            req.stream.put(None)
-            return req
+            return self._fail_new(req, "empty prompt"), True
         total = len(prompt) + sampling.max_new_tokens
         if total > self.max_seq_len:
-            req.state = "failed"
-            req.error = (f"prompt+max_new_tokens {total} exceeds "
-                         f"max_seq_len {self.max_seq_len}")
-            req.finished_at = time.monotonic()
-            req.stream.put(None)
-            return req
+            return self._fail_new(
+                req, f"prompt+max_new_tokens {total} exceeds "
+                     f"max_seq_len {self.max_seq_len}"), True
+        if not self.cache.allocator.can_ever_alloc(
+                self.cache.blocks_for(total)):
+            # can NEVER admit even with the whole pool free: fail loudly
+            # instead of deadlocking the head of the queue forever
+            return self._fail_new(
+                req, f"worst-case reservation "
+                     f"{self.cache.blocks_for(total)} blocks exceeds the "
+                     f"pool ({self.cache.allocator.num_blocks})"), True
         with self._lock:
+            if request_id:
+                existing = self._by_rid.get(request_id)
+                if existing is not None:
+                    return existing, False
+            if self._draining:
+                raise EngineDrainingError(
+                    "replica is draining; admission closed")
+            if len(self._waiting) >= self.max_waiting:
+                self._c_rejected.inc()
+                raise EngineOverloadedError(
+                    f"waiting queue full ({self.max_waiting})",
+                    retry_after_s=self._retry_after_locked())
             self._waiting.append(req)
+            if request_id:
+                self._by_rid[request_id] = req
         self._work.set()
-        return req
+        return req, True
+
+    def submit(self, prompt: list[int],
+               sampling: Optional[SamplingParams] = None,
+               *,
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> GenRequest:
+        return self.submit_request(prompt, sampling, request_id=request_id,
+                                   deadline_s=deadline_s)[0]
+
+    def cancel(self, req: GenRequest, reason: str = "cancelled") -> bool:
+        """Cancel a live request SERVER-side: recycle its blocks and free
+        its slot immediately (an abandoned client must not keep decoding).
+        Returns False when the request already finished."""
+        with self._lock:
+            return self._cancel_locked(req, reason)
+
+    def _cancel_locked(self, req: GenRequest, reason: str) -> bool:
+        if req.state in ("done", "failed"):
+            return False
+        try:
+            self._waiting.remove(req)
+        except ValueError:
+            pass
+        for i, r in enumerate(self._slots):
+            if r is req:
+                self._slots[i] = None
+        self.cache.release(req.seq)
+        req.state = "failed"
+        req.error = reason
+        req.finished_at = time.monotonic()
+        req.stream.put(None)
+        req.done.set()
+        self._note_done_locked(req)
+        return True
 
     def generate(self, prompt: list[int],
                  sampling: Optional[SamplingParams] = None,
-                 timeout: float = 120.0) -> GenRequest:
-        """Blocking helper: submit and drain the stream to completion."""
-        req = self.submit(prompt, sampling)
+                 timeout: float = 120.0,
+                 request_id: Optional[str] = None) -> GenRequest:
+        """Blocking helper: submit and drain the stream to completion.
+        A timeout CANCELS the request server-side — blocks and slot are
+        recycled, not held until the abandoned request completes. A
+        ``request_id`` matching a live/cached request ATTACHES (waits on
+        the terminal latch — the original submitter owns the stream, and
+        an attached waiter must neither split it nor cancel the shared
+        request on its own timeout)."""
+        req, created = self.submit_request(prompt, sampling,
+                                           request_id=request_id)
+        if not created:
+            if not req.done.wait(timeout):
+                raise TimeoutError(
+                    f"attached request {request_id} still running after "
+                    f"{timeout}s")
+            return req
         deadline = time.monotonic() + timeout
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                self.cancel(req, f"generate timed out after {timeout}s")
                 raise TimeoutError(f"generate timed out after {timeout}s")
             try:
                 tok = req.stream.get(timeout=min(remaining, 1.0))
@@ -267,18 +488,101 @@ class ServeEngine:
                 return  # strict FIFO: no small-request overtake starvation
             self._waiting.popleft()
             req.state = "prefill"
+            # a preempted request re-prefills its whole emitted prefix
+            # (recompute-on-readmit) minus the pending next_token, whose
+            # K/V the first post-resume decode step writes — the exact
+            # invariant an unpreempted request maintains
+            req._resume_prefix = (req.prompt + req.out_tokens[:-1]
+                                  if req.out_tokens else None)
+            self._blocked_since = None
             self._slots[i] = req
 
-    def _prefill_one(self) -> None:
-        """Advance the first mid-prefill request by one bounded chunk."""
+    def _expire_deadlines(self, now: float) -> None:
+        """Cancel every request past its deadline — waiting or holding a
+        slot — recycling blocks the same iteration."""
+        expired = [r for r in list(self._waiting) + list(self._slots)
+                   if r is not None and r.deadline is not None
+                   and now > r.deadline]
+        for r in expired:
+            self._cancel_locked(r, "deadline exceeded")
+
+    def _maybe_preempt(self, now: float) -> None:
+        """KV-pressure relief: the head-of-line waiting request has a free
+        slot but no blocks — every running sequence holds its worst-case
+        reservation, mostly unwritten tail. If that starvation persists
+        past ``preempt_grace_s``, evict the NEWEST running sequence back
+        to ``waiting`` BEHIND the starving head (demotion is the price of
+        being newest; recompute-on-readmit re-prefills its prefix). The
+        eviction fires only when the victim's blocks actually make the
+        head admissible, and a request is evicted at most once in its
+        lifetime — bounded churn, no preempt/readmit livelock."""
+        if not self._waiting:
+            self._blocked_since = None
+            return
+        head = self._waiting[0]
+        if not any(s is None for s in self._slots):
+            self._blocked_since = None  # slot-starved, not block-starved
+            return
+        total = len(head.prompt) + head.sampling.max_new_tokens
+        short = self.cache.blocks_short(head.seq, total)
+        if self.cache.allocator.can_alloc(short):
+            self._blocked_since = None
+            return
+        if self._blocked_since is None:
+            self._blocked_since = now
+            return
+        if now - self._blocked_since < self.preempt_grace_s:
+            return
+        if any(w.preemptions > 0 for w in self._waiting):
+            # one outstanding eviction at a time: the demoted victim is
+            # itself a starving head now — cascading evictions would just
+            # rotate the whole batch through the queue
+            return
+        victims = [(i, r) for i, r in enumerate(self._slots)
+                   if r is not None and r.preemptions == 0
+                   and self.cache.allocator.free_count
+                   + len(r.seq.block_ids) >= short]
+        if not victims:
+            return
+        i, victim = max(victims, key=lambda t: t[1].id)
+        self._preempt_locked(i, victim)
+        self._blocked_since = now  # fresh grace before the next eviction
+
+    def _preempt_locked(self, slot: int, req: GenRequest) -> None:
+        self.cache.release(req.seq)   # blocks back to the pool; length 0
+        req.prefilled = 0
+        req.state = "waiting"
+        req.preemptions += 1
+        self._slots[slot] = None
+        # BEHIND the starving head (it takes the freed blocks), ahead of
+        # everything that arrived after the starvation was observed
+        self._waiting.insert(min(1, len(self._waiting)), req)
+        self._c_preempted.inc()
+
+    def _retry_after_locked(self) -> float:
+        """429 Retry-After hint: outstanding worst-case decode work over
+        the observed token throughput, clamped to a sane window."""
+        outstanding = sum(
+            r.sampling.max_new_tokens - len(r.out_tokens)
+            for r in list(self._waiting) + list(self._slots)
+            if r is not None)
+        elapsed = max(time.monotonic() - self._started_at, 1e-9)
+        tps = self._c_tokens.value / elapsed
+        return min(max(outstanding / max(tps, 1.0), 1.0), 60.0)
+
+    def _prefill_one(self) -> bool:
+        """Advance the first mid-prefill request by one bounded chunk.
+        Returns True when it advanced one."""
         req = next((r for r in self._slots
                     if r is not None and r.state == "prefill"), None)
         if req is None:
-            return
+            return False
         import jax.numpy as jnp
 
+        src = (req._resume_prefix if req._resume_prefix is not None
+               else req.prompt)
         c = self.prefill_chunk
-        chunk = req.prompt[req.prefilled:req.prefilled + c]
+        chunk = src[req.prefilled:req.prefilled + c]
         padded = chunk + [0] * (c - len(chunk))
         tables = jnp.asarray(self.cache.block_table_array(
             [req.seq], self.max_blocks_per_seq))
@@ -289,11 +593,20 @@ class ServeEngine:
             self.cache.k, self.cache.v, tables, cfg=self.cfg)
         req.prefilled += len(chunk)
         req.seq.length = req.prefilled
-        if req.prefilled >= len(req.prompt):
-            tok = sample_token(np.asarray(logits[0]), req.sampling, req.rng)
+        if req.prefilled >= len(src):
+            if req.out_tokens:
+                # resumed after a preemption: every emitted token already
+                # left through the stream — rearm the pending next_token
+                # and decode on, emitting nothing twice
+                req.next_token = req.out_tokens[-1]
+            else:
+                tok = sample_token(np.asarray(logits[0]), req.sampling,
+                                   req.rng)
+                req.next_token = tok
+                self._emit(req, tok)
             req.state = "running"
-            req.next_token = tok
-            self._emit(req, tok)
+            req._resume_prefix = None
+        return True
 
     def _decode_batch(self) -> int:
         """One decode iteration over every running slot. Returns tokens
@@ -359,6 +672,20 @@ class ServeEngine:
         self._c_tokens.inc()
         req.stream.put(tok)
 
+    def _note_done_locked(self, req: GenRequest) -> None:
+        """Bound the completed-request cache: finished ids stay resumable
+        until ``completed_cache`` newer completions push them out."""
+        if not req.request_id:
+            return
+        if self._by_rid.get(req.request_id) is not req:
+            return
+        self._rid_done.append(req.request_id)
+        while len(self._rid_done) > self.completed_cache:
+            old = self._rid_done.popleft()
+            stale = self._by_rid.get(old)
+            if stale is not None and stale.state in ("done", "failed"):
+                self._by_rid.pop(old, None)
+
     def _finish(self, slot: int, req: GenRequest) -> None:
         """Completion recycles blocks the same iteration — the freed slot
         admits a waiting request on the NEXT step, no global pause."""
@@ -368,22 +695,61 @@ class ServeEngine:
         self._slots[slot] = None
         self._c_requests.inc()
         req.stream.put(None)
+        req.done.set()
+        self._note_done_locked(req)
 
     def step(self) -> int:
         """One scheduling iteration; returns tokens emitted."""
+        t0 = time.monotonic()
         with self._lock:
+            self._expire_deadlines(t0)
             self._admit()
-            self._prefill_one()
+            self._maybe_preempt(t0)
+            prefilled = self._prefill_one()
             emitted = self._decode_batch()
             self._admit()  # freed slots admit without waiting a full step
             if (self._waiting
                     or any(r is not None for r in self._slots)):
                 self._work.set()
+            if prefilled or emitted:
+                # the engine proved it can push work through the model:
+                # readiness for /healthz, and a step-time sample for the
+                # watchdog's p95-scaled stall deadline (compile steps
+                # excluded — see __init__)
+                self._worked_steps += 1
+                if self._worked_steps > 2:
+                    self._step_durations.append(time.monotonic() - t0)
+                self._ready.set()
         return emitted
+
+    def step_p95_s(self) -> float:
+        """p95 of recent working-step durations (0 while empty) — the
+        watchdog's scaling input."""
+        if not self._step_durations:
+            return 0.0
+        return float(np.percentile(np.asarray(self._step_durations), 95))
+
+    def _beat_watchdog(self) -> None:
+        # beats start only once the engine is READY: before the first
+        # worked step the watchdog's compile_grace_s window applies (the
+        # first request pays XLA compilation), and an early idle beat
+        # would close that window and misread the compile as a stall
+        if self.watchdog is None:
+            return
+        if self._ready.is_set():
+            self.watchdog.beat(self._decode_steps)
+        else:
+            # idle before any traffic (warmup disabled): refresh the
+            # silence clock but keep the compile window armed — an idle
+            # replica must not be hard-exited after compile_grace_s of
+            # legitimate quiet, and its FIRST request still deserves the
+            # full compile grace
+            self.watchdog.touch()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
             if not self._work.wait(timeout=0.5):
+                self._beat_watchdog()  # idle is not a stall
                 continue
             self._work.clear()
             try:
@@ -401,6 +767,14 @@ class ServeEngine:
                             self.cache.release(r.seq)
                             self._slots[i] = None
                             r.stream.put(None)
+                            r.done.set()
+                            self._note_done_locked(r)
+            if self.chaos is not None:
+                # outside the scheduling lock: a wedged decode loop still
+                # ACCEPTS requests (they pile into the bounded queue and
+                # shed), exactly what a stuck XLA dispatch looks like
+                self.chaos.maybe_hang(int(self._c_requests.value))
+            self._beat_watchdog()
 
     # -- traffic snapshot (heartbeat payload / outputs bridge) ---------------
 
@@ -421,6 +795,14 @@ class ServeEngine:
             "ttft_p95_ms": _ms(self._h_ttft.quantile(0.95)),
             "intertoken_p50_ms": _ms(self._h_itl.quantile(0.50)),
             "intertoken_p95_ms": _ms(self._h_itl.quantile(0.95)),
+            # request-path fault-tolerance state (ISSUE 12): rides the
+            # heartbeat so the control plane's drain gate and the
+            # rejected/preempted store families see it
+            "rejected_total": int(self._c_rejected.value),
+            "preemptions_total": int(self._c_preempted.value),
+            "draining": bool(self._draining),
+            "drained": bool(self.drained) if self._draining else False,
+            "ready": self.ready,
         }
 
     def drain_observations(self, max_each: int = 256) -> dict:

@@ -49,6 +49,14 @@ class BlockAllocator:
     def can_alloc(self, n: int) -> bool:
         return len(self._free) >= n
 
+    def can_ever_alloc(self, n: int) -> bool:
+        """Could ``n`` blocks EVER be satisfied, even with the whole pool
+        free? A request whose worst-case reservation fails this can never
+        admit — admission control must reject it loudly at submit instead
+        of queueing it forever (the head-of-line deadlock the preemption
+        path must otherwise break)."""
+        return n <= self.num_blocks
+
     def alloc(self, n: int = 1) -> list[int]:
         if len(self._free) < n:
             raise OutOfBlocksError(
@@ -115,6 +123,12 @@ class PagedKVCache:
         need = self.blocks_for(target_len) - len(seq.block_ids)
         if need > 0:
             seq.block_ids.extend(self.allocator.alloc(need))
+
+    def blocks_short(self, seq: SequenceBlocks, target_len: int) -> int:
+        """How many blocks ``seq`` still needs to cover ``target_len`` —
+        the admission-pressure signal the engine's preemption path reads
+        without mutating the allocator."""
+        return max(self.blocks_for(target_len) - len(seq.block_ids), 0)
 
     def release(self, seq: SequenceBlocks) -> None:
         if seq.block_ids:

@@ -19,6 +19,28 @@ Spec keys:
     attn_impl ("gather" | "flash"), port (default 8000), bind,
     platform / num_cpu_devices (same semantics as the builtin trainer),
     report_interval (outputs/heartbeat cadence seconds, default 2)
+
+Fault-tolerance spec keys (ISSUE 12, docs/RESILIENCE.md serving matrix):
+    max_waiting: admission queue bound (beyond it: 429 + Retry-After)
+    preempt_grace_s: head-of-line block starvation before a KV-pressure
+        preemption evicts the newest running sequence
+    drain_timeout_s: SIGTERM / drain-marker graceful window (default 30;
+        0 disables graceful drain — SIGTERM stops immediately)
+    warmup: generate a tiny request at startup so /healthz flips ready
+        only once the model REALLY generates (default true)
+    watchdog: false to disable, or {min_s, stall_factor,
+        compile_grace_s} — the decode-iteration watchdog (PR 8 pattern):
+        step silence past a p95-scaled deadline dumps stacks, emits a
+        ``ServingStalled`` condition and hard-exits nonzero into the
+        pod's retry budget
+    chaos: {hang_after_requests, replica, hang_sleep_s} — seeded fault
+        injection for the serve fault soak (resilience.ServeChaos)
+
+The runtime also polls the run dir for agent-written drain markers
+(``serve-drain-<replica>.json``): scale-down flips this replica to
+draining (healthz 503, admission closed), in-flight requests finish, and
+the drain state rides the serve heartbeat payload so the agent deletes
+the pod only after the drain completed (or its deadline passed).
 """
 
 from __future__ import annotations
@@ -96,6 +118,8 @@ def build_engine(spec: dict):
         prefill_chunk=int(spec.get("prefill_chunk", 64)),
         max_seq_len=max_seq,
         attn_impl=spec.get("attn_impl", "gather"),
+        max_waiting=int(spec.get("max_waiting", 128)),
+        preempt_grace_s=float(spec.get("preempt_grace_s", 2.0)),
     )
     engine.provenance = provenance
     engine.model_name = name
@@ -120,7 +144,14 @@ def _bind_port(host: str, port: int) -> socket.socket:
 class ServeReporter(threading.Thread):
     """Ships engine traffic to the control plane every ``interval``:
     heartbeat ``serve`` payload (always) + run outputs (replica 0, so
-    concurrent replicas don't clobber each other's keys)."""
+    concurrent replicas don't clobber each other's keys).
+
+    Drain markers (ISSUE 12): the agent signals a scale-down drain by
+    writing ``serve-drain-<replica>.json`` into the run dir; each report
+    pass honors it (begin drain) or its removal (cancelled scale-down →
+    reopen admission). Markers carry a wall-clock ``expires_at`` so a
+    marker orphaned by an agent crash cannot pin a replica draining
+    forever."""
 
     def __init__(self, run, engine, *, interval: float = 2.0,
                  replica: int = 0, port: int = 0):
@@ -131,12 +162,43 @@ class ServeReporter(threading.Thread):
         self.replica = replica
         self.port = port
         self._stop = threading.Event()
+        self._marker_drain = False
 
     def stop(self) -> None:
         self._stop.set()
         self.report_once()  # final flush
 
+    def _drain_marker_path(self) -> str:
+        return os.path.join(self.tracked.run_dir,
+                            f"serve-drain-{self.replica}.json")
+
+    def _check_drain_marker(self) -> None:
+        try:
+            with open(self._drain_marker_path(), encoding="utf-8") as f:
+                marker = json.load(f)
+        except (OSError, ValueError):
+            marker = None
+        expired = (marker is not None
+                   and marker.get("expires_at") is not None
+                   # plx: allow(clock): expires_at is a cross-process wall timestamp the agent persisted; same host, generous horizon
+                   and time.time() > float(marker["expires_at"]))
+        if marker is not None and not expired:
+            if not self._marker_drain and not self.engine.draining:
+                self.engine.begin_drain()
+            self._marker_drain = True
+        elif self._marker_drain:
+            # marker gone (cancelled scale-down) or orphaned past its
+            # horizon: reopen admission — only for drains WE initiated
+            # (a SIGTERM drain is never cancelled from outside)
+            self._marker_drain = False
+            if self.engine.draining:
+                self.engine.end_drain()
+
     def report_once(self) -> None:
+        try:
+            self._check_drain_marker()
+        except Exception:
+            pass
         snap = self.engine.snapshot()
         obs = self.engine.drain_observations()
         payload = {**snap, **obs, "replica": self.replica}
@@ -197,10 +259,83 @@ def run_serve(spec: dict[str, Any]) -> None:
     from .server import build_app
 
     engine = build_engine(spec)
-    engine.start()
 
     replica = int(os.environ.get("PLX_REPLICA_INDEX", "0"))
     run = tracking.get_run() if os.environ.get("PLX_RUN_UUID") else None
+
+    # seeded fault injection (ISSUE 12): the serve fault soak wedges one
+    # replica's decode loop mid-ramp; the budget marker in the run dir
+    # keeps the RESTARTED replica clean
+    from ..resilience import ServeChaos
+
+    engine.chaos = ServeChaos.from_spec(
+        spec.get("chaos"), replica=replica,
+        state_dir=run.run_dir if run is not None else None)
+
+    # decode-iteration watchdog (ISSUE 12, PR 8's pattern): step silence
+    # past a p95-scaled deadline dumps stacks, emits a ServingStalled
+    # condition and hard-exits nonzero into the pod's retry budget
+    wd_spec = spec.get("watchdog", True)
+    watchdog = None
+    if wd_spec is not False:
+        from ..train.watchdog import StepWatchdog
+
+        wd_kw = wd_spec if isinstance(wd_spec, dict) else {}
+
+        def _wd_log(line: str) -> None:
+            if run is not None:
+                try:
+                    run.log_line(line)
+                except Exception:
+                    pass
+            print(line, flush=True)
+
+        def _on_stall(step: int, waited: float, limit: float) -> None:
+            if run is None:
+                return
+            try:
+                # the span covers the silent window itself (the durable
+                # serving_stalled evidence — a running->running status
+                # write is a no-change the store rejects); the status
+                # call still lands the reason in the run logs/spool
+                # plx: allow(clock): span clocks are wall time correlated across machines (obs/trace.py contract)
+                now = time.time()
+                run.log_span("serving_stalled", now - waited, now,
+                             step=step, limit_s=round(limit, 3))
+                run.log_status(
+                    "running", reason="ServingStalled",
+                    message=f"no decode iteration for {waited:.1f}s "
+                            f"(limit {limit:.1f}s, step {step}); "
+                            f"watchdog hard-exit -> retry budget")
+                run.flush()
+            except Exception:
+                pass
+
+        watchdog = StepWatchdog(
+            stall_factor=float(wd_kw.get("stall_factor", 10.0)),
+            min_s=float(wd_kw.get("min_s", 60.0)),
+            compile_grace_s=float(wd_kw.get("compile_grace_s", 600.0)),
+            p95_s=engine.step_p95_s, on_stall=_on_stall, log=_wd_log)
+        engine.watchdog = watchdog
+        watchdog.start()
+
+    engine.start()
+
+    if spec.get("warmup", True):
+        # background warmup: /healthz keeps answering 503 (not-ready)
+        # until the model genuinely generated once — probes and the
+        # failover front never route to a still-compiling replica
+        def _warmup() -> None:
+            from .engine import SamplingParams
+
+            try:
+                engine.generate([1, 2, 3], SamplingParams(max_new_tokens=2),
+                                timeout=600.0)
+            except Exception as e:  # noqa: BLE001 — visible, non-fatal
+                print(f"[serve] warmup failed: {e!r}", flush=True)
+
+        threading.Thread(target=_warmup, daemon=True,
+                         name="serve-warmup").start()
 
     bind = spec.get("bind", "127.0.0.1")
     port = int(spec.get("port", DEFAULT_SERVE_PORT))
@@ -212,6 +347,7 @@ def run_serve(spec: dict[str, Any]) -> None:
     # ports under the FakeCluster's shared loopback)
     if run is not None:
         endpoint = {"replica": replica, "port": actual_port,
+                    # plx: allow(clock): persisted endpoint stamp read by humans and cross-process clients
                     "pid": os.getpid(), "at": time.time()}
         path = os.path.join(run.run_dir, f"serve-endpoint-{replica}.json")
         tmp = path + ".tmp"
@@ -232,9 +368,26 @@ def run_serve(spec: dict[str, Any]) -> None:
         reporter.start()
 
     stop_event = threading.Event()
+    drain_timeout = float(spec.get("drain_timeout_s", 30.0))
 
     def _graceful(_sig, _frm):
-        stop_event.set()
+        # first signal: graceful drain — admission closes (healthz 503),
+        # in-flight requests finish within the drain deadline, then the
+        # server stops. A second signal (or drain_timeout_s <= 0) stops
+        # immediately.
+        if drain_timeout <= 0 or engine.draining or stop_event.is_set():
+            stop_event.set()
+            return
+        engine.begin_drain()
+        if reporter is not None:
+            reporter.report_once()  # drain state reaches the beat NOW
+
+        def _await_drain():
+            engine.await_drain(timeout=drain_timeout)
+            stop_event.set()
+
+        threading.Thread(target=_await_drain, daemon=True,
+                         name="serve-drain").start()
 
     signal.signal(signal.SIGTERM, _graceful)
     signal.signal(signal.SIGINT, _graceful)
@@ -255,6 +408,8 @@ def run_serve(spec: dict[str, Any]) -> None:
         await runner.cleanup()
 
     asyncio.run(_serve())
+    if watchdog is not None:
+        watchdog.stop()  # a clean shutdown must not read as a stall
     engine.stop()
     if reporter is not None:
         reporter.stop()  # final traffic flush

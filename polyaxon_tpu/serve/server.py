@@ -4,9 +4,21 @@ endpoint (ISSUE 9 tentpole (3)).
 Routes:
     POST /generate   {"prompt": "text"} or {"tokens": [ints]}, plus
                      per-request sampling params (max_new_tokens,
-                     temperature, top_k, seed, stop_token) and
-                     "stream": true for NDJSON token streaming.
-    GET  /healthz    liveness + engine gauges
+                     temperature, top_k, seed, stop_token),
+                     "stream": true for NDJSON token streaming,
+                     "request_id" (client idempotency id — a retried id
+                     attaches to the live request or answers from the
+                     completed cache, never generating twice) and
+                     "deadline_s" (server-side cancel + KV recycle).
+                     Answers 503 while draining/not-ready and 429 with a
+                     throughput-derived Retry-After when the bounded
+                     admission queue is full (ISSUE 12).
+    GET  /result/{request_id}   resume-by-id: the finished result from
+                     the bounded completed-request cache (202 while the
+                     id is still generating, 404 when unknown).
+    GET  /healthz    readiness: 200 only when the engine completed a
+                     first successful step AND is not draining — probes
+                     and the failover front stop routing otherwise (503)
     GET  /stats      engine traffic snapshot (JSON twin of /metrics)
     GET  /metrics    pod-local Prometheus families (polyaxon_serve_*)
 
@@ -25,7 +37,9 @@ from typing import Optional
 
 from aiohttp import web
 
-from .engine import SamplingParams, ServeEngine
+from .engine import (
+    EngineDrainingError, EngineOverloadedError, SamplingParams, ServeEngine,
+)
 
 
 def encode_prompt(body: dict, vocab_size: int) -> list[int]:
@@ -60,10 +74,35 @@ def _request_stats(req) -> dict:
     }
 
 
+def _result_body(req, vocab: int, cached: bool = False) -> dict:
+    out = {"tokens": req.out_tokens, **_request_stats(req)}
+    if req.request_id:
+        out["request_id"] = req.request_id
+    if cached:
+        out["cached"] = True
+    text = decode_tokens(req.out_tokens, vocab)
+    if text is not None:
+        out["text"] = text
+    return out
+
+
 def build_app(engine: ServeEngine, *, metrics=None,
               model_name: str = "") -> web.Application:
     registry = metrics if metrics is not None else engine.metrics
     vocab = engine.cfg.vocab_size
+
+    async def _await_done(req) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, req.done.wait)
+
+    async def _finished_response(req, cached: bool) -> web.Response:
+        await _await_done(req)
+        if req.error:
+            return web.json_response(
+                {"error": req.error,
+                 **({"request_id": req.request_id}
+                    if req.request_id else {})}, status=500)
+        return web.json_response(_result_body(req, vocab, cached=cached))
 
     async def generate(request: web.Request) -> web.StreamResponse:
         try:
@@ -79,7 +118,28 @@ def build_app(engine: ServeEngine, *, metrics=None,
         except (ValueError, TypeError) as e:
             return web.json_response({"error": str(e)}, status=400)
         sp = SamplingParams.from_dict(body)
-        req = engine.submit(tokens, sp)
+        rid = body.get("request_id")
+        rid = str(rid) if rid is not None else None
+        deadline_s = body.get("deadline_s")
+        try:
+            req, created = engine.submit_request(
+                tokens, sp, request_id=rid,
+                deadline_s=(float(deadline_s) if deadline_s else None))
+        except EngineDrainingError as e:
+            return web.json_response({"error": str(e), "draining": True},
+                                     status=503)
+        except EngineOverloadedError as e:
+            # shed with an honest backoff hint, never an unbounded queue
+            return web.json_response(
+                {"error": str(e), "retry_after_s": e.retry_after_s},
+                status=429,
+                headers={"Retry-After":
+                         str(max(int(-(-e.retry_after_s // 1)), 1))})
+        if not created:
+            # idempotent retry of a live or finished id: wait on the
+            # terminal latch — the ORIGINAL submitter owns the stream, a
+            # second drainer would split it
+            return await _finished_response(req, cached=True)
         if req.state == "failed":
             return web.json_response({"error": req.error}, status=400)
         loop = asyncio.get_running_loop()
@@ -94,37 +154,44 @@ def build_app(engine: ServeEngine, *, metrics=None,
                     break
                 await resp.write(
                     (json.dumps({"token": tok}) + "\n").encode())
-            final = {"done": True, "tokens": req.out_tokens,
-                     **_request_stats(req)}
-            text = decode_tokens(req.out_tokens, vocab)
-            if text is not None:
-                final["text"] = text
+            final = {"done": True, **_result_body(req, vocab)}
             if req.error:
                 final["error"] = req.error
             await resp.write((json.dumps(final) + "\n").encode())
             await resp.write_eof()
             return resp
 
-        # non-streaming: drain off the event loop
-        def _drain():
-            while req.stream.get() is not None:
-                pass
+        return await _finished_response(req, cached=False)
 
-        await loop.run_in_executor(None, _drain)
+    async def result(request: web.Request) -> web.Response:
+        """Resume-by-id: the finished result from the completed-request
+        cache. 202 while still generating (the client should poll or
+        wait), 404 for an unknown/evicted id."""
+        req = engine.lookup(request.match_info["request_id"])
+        if req is None:
+            return web.json_response({"error": "unknown request_id"},
+                                     status=404)
+        if req.state not in ("done", "failed"):
+            return web.json_response(
+                {"state": req.state, "done": False,
+                 "request_id": req.request_id}, status=202)
         if req.error:
-            return web.json_response({"error": req.error}, status=500)
-        out = {"tokens": req.out_tokens, **_request_stats(req)}
-        text = decode_tokens(req.out_tokens, vocab)
-        if text is not None:
-            out["text"] = text
-        return web.json_response(out)
+            return web.json_response(
+                {"error": req.error, "request_id": req.request_id},
+                status=500)
+        return web.json_response(_result_body(req, vocab, cached=True))
 
     async def healthz(_request) -> web.Response:
+        # 503 while draining or before the first successful engine step:
+        # probes and the failover front must not route here (ISSUE 12)
+        ok = engine.ready and not engine.draining
         return web.json_response({
-            "ok": True, "model": model_name,
+            "ok": ok, "model": model_name,
+            "ready": engine.ready,
+            "draining": engine.draining,
             "running": engine.running_count,
             "waiting": engine.waiting_count,
-        })
+        }, status=200 if ok else 503)
 
     async def stats(_request) -> web.Response:
         return web.json_response(engine.snapshot())
@@ -135,6 +202,7 @@ def build_app(engine: ServeEngine, *, metrics=None,
 
     app = web.Application()
     app.router.add_post("/generate", generate)
+    app.router.add_get("/result/{request_id}", result)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/stats", stats)
     app.router.add_get("/metrics", metrics_endpoint)

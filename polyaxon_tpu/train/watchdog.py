@@ -96,6 +96,15 @@ class StepWatchdog(threading.Thread):
             self._last_step = int(step)
             self._last_t = time.monotonic()
 
+    def touch(self) -> None:
+        """Refresh the silence clock WITHOUT closing the compile window:
+        an engine that is idle with no work pending (ISSUE 12 serving,
+        ``warmup: false``) is neither compiling nor stalled — but its
+        first real request must still get the full ``compile_grace_s``,
+        which a ``beat`` here would forfeit."""
+        with self._lock:
+            self._last_t = time.monotonic()
+
     def stop(self) -> None:
         self._stop.set()
 

@@ -20,7 +20,7 @@ Usage:
         [--rounds 1] [--keep] \
         [--kill-agent] [--split-brain] [--kills 2] [--lease-ttl 0.8] \
         [--agents 4] [--num-shards 8] [--rolling-kill] \
-        [--store-outage] [--metrics-dump [PATH]]
+        [--store-outage] [--serve-faults] [--metrics-dump [PATH]]
 
 ``--agents N`` (ISSUE 6) runs the SHARDED fleet soak: N concurrently-
 active agents split the shard leases over one store; ``--rolling-kill``
@@ -34,6 +34,15 @@ epoch), and the soak asserts oracle convergence, zero duplicate launches,
 promotion < 2x lease TTL, and that a pre-failover fencing token AND a
 pre-failover ``?since=`` cursor are both deterministically rejected
 (epoch fence 409 / 410) — all via the strict /metrics scrape.
+
+``--serve-faults`` (ISSUE 12) runs the serving fault soak: REAL serve
+pods under a traffic ramp driven through the request-path failover
+front — 2 rolling replica kills, an overload burst past the bounded
+admission queue, 1 injected engine hang (watchdog hard-exit into the
+retry budget), and a drain-gated cooldown scale-down. Exit 0 requires
+zero lost accepted requests, exactly-once generation per request id,
+every 429 carrying Retry-After, and drains completing before deletion —
+reconciled against the strict /metrics scrape.
 
 ``--metrics-dump`` archives the last round's final /metrics scrape
 (validated Prometheus text, docs/OBSERVABILITY.md) into bench_artifacts —
@@ -1038,6 +1047,355 @@ def run_serve_traffic_soak(workdir: str, seed: int = 2024,
         cluster.shutdown()
 
 
+def run_serve_fault_soak(workdir: str, seed: int = 2024,
+                         timeout: float = 480.0) -> dict:
+    """The ISSUE 12 serving fault soak: a REAL `kind: service` run (store
+    -> agent -> operator pods running the serve runtime on CPU) under a
+    traffic ramp driven through the request-path failover front, with
+
+    - 2 rolling replica kills mid-ramp (per-pod restart must replace only
+      the victim; in-flight requests retry against the survivors),
+    - an overload burst past the bounded admission queue (429s, every one
+      carrying Retry-After),
+    - 1 injected engine hang on replica 1 (the decode-iteration watchdog
+      must dump stacks, emit ``ServingStalled`` and hard-exit into the
+      pod's retry budget),
+    - a cooldown scale-down whose surplus replicas DRAIN before deletion
+      (in-flight tail requests finish; the agent's audit records
+      ``drained``, not ``timeout``),
+    - an exactly-once probe (same request_id re-POSTed to the same
+      replica answers from the completed cache, token-identical).
+
+    Exit contract: zero lost accepted requests, exactly-once per id,
+    every 429 with Retry-After, drains completed, all reconciled against
+    the strict /metrics scrape. Returns the checks + scrape."""
+    import glob
+    import threading
+
+    import requests as _requests
+
+    from polyaxon_tpu.api.app import run_artifacts_dir
+    from polyaxon_tpu.api.server import ApiServer
+    from polyaxon_tpu.client import RunClient
+    from polyaxon_tpu.client.serve import ServeFront, ServeUnavailableError
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    rng = random.Random(seed)
+    art = os.path.join(workdir, "artifacts")
+    srv = ApiServer(db_path=":memory:", artifacts_root=art, port=0).start()
+    store = srv.store
+    agent = LocalAgent(store, artifacts_root=art, api_host=srv.url,
+                       backend="cluster", poll_interval=0.05,
+                       capacity_chips=4, max_parallel=8)
+    agent.autoscale_interval = 0.2
+    agent.serve_drain_timeout = 25.0
+    agent.start()
+
+    def _free_port() -> int:
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    port = _free_port()
+    rc = RunClient(srv.url, project="p")
+    op = check_polyaxonfile({
+        "kind": "operation",
+        "name": "serve-faults",
+        "termination": {"maxRetries": 6},
+        "component": {"kind": "component", "run": {
+            "kind": "service",
+            "ports": [port],
+            # a tiny CPU model drains its queue between autoscaler beat
+            # samples: the long hysteresis keeps the fleet stable through
+            # the fault phases (no flap-drain deleting a replica before
+            # its watchdog can judge it) — only the cooldown scales down
+            "autoscale": {"min_replicas": 1, "max_replicas": 3,
+                          "target_per_replica": 2,
+                          "scale_down_after_s": 30.0},
+            "runtime": {
+                "model": "llama-tiny", "platform": "cpu",
+                "port": port, "max_slots": 2, "block_size": 8,
+                "max_seq_len": 64, "prefill_chunk": 16,
+                "report_interval": 0.3, "max_waiting": 2,
+                "drain_timeout_s": 15.0,
+                "watchdog": {"min_s": 5.0, "stall_factor": 2.0,
+                             "compile_grace_s": 300.0},
+                # the injected engine hang: replica 1 wedges after its
+                # 4th completed request; budgeted once in the run dir so
+                # the restarted replica runs clean
+                "chaos": {"hang_after_requests": 4, "replica": 1},
+            }}},
+    })
+    run = rc.create(operation=op)
+    uuid = run["uuid"]
+    run_dir = run_artifacts_dir(art, "p", uuid)
+
+    def endpoints() -> list:
+        eps = []
+        for path in glob.glob(os.path.join(run_dir,
+                                           "serve-endpoint-*.json")):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    d = json.load(f)
+                eps.append((int(d["replica"]),
+                            f"http://127.0.0.1:{int(d['port'])}"))
+            except (OSError, ValueError, KeyError):
+                continue
+        return [u for _, u in sorted(eps)] or [f"http://127.0.0.1:{port}"]
+
+    front = ServeFront(endpoints_fn=endpoints, timeout=30.0,
+                       max_attempts=12, backoff_s=0.2,
+                       on_retry=store.count_serve_retries)
+
+    results: dict[str, dict] = {}
+    failures: dict[str, str] = {}
+    submitted: list[str] = []
+    res_lock = threading.Lock()
+    stop_traffic = threading.Event()
+    ramp_stop = threading.Event()
+
+    def worker(name: str, count: int, max_new: int = 6,
+               until: "threading.Event | None" = None) -> None:
+        """Issue ``count`` requests (or keep issuing until ``until``
+        fires); every SUBMITTED id must resolve — the front's failover
+        plus this outer retry loop is the zero-lost-requests contract."""
+        wrng = random.Random(f"{seed}-{name}")
+        n = 0
+        while (n < count) if until is None else (not until.is_set()):
+            rid = f"{name}-{n}"
+            n += 1
+            tokens = [wrng.randrange(4, 200)
+                      for _ in range(wrng.randrange(5, 11))]
+            with res_lock:
+                submitted.append(rid)
+            deadline = time.monotonic() + 120.0
+            while not stop_traffic.is_set():
+                try:
+                    out = front.generate(tokens=tokens, request_id=rid,
+                                         max_new_tokens=max_new)
+                    with res_lock:
+                        results[rid] = out
+                    break
+                except (ServeUnavailableError,
+                        _requests.RequestException) as e:
+                    if time.monotonic() > deadline:
+                        with res_lock:
+                            failures[rid] = repr(e)
+                        break
+                    time.sleep(0.3)
+            else:
+                with res_lock:
+                    failures.setdefault(rid, "aborted by soak teardown")
+
+    def live_serve_pods() -> list:
+        return [name for name, p in list(agent.cluster.pods.items())
+                if name.startswith(f"plx-{uuid[:12]}")
+                and p.proc is not None and p.proc.poll() is None]
+
+    kills: list = []
+    try:
+        # -- wait for replica 0 to come up and pass readiness -------------
+        deadline = time.monotonic() + timeout / 2
+        url0 = f"http://127.0.0.1:{port}"
+        while time.monotonic() < deadline:
+            try:
+                if _requests.get(f"{url0}/healthz", timeout=1).ok:
+                    break
+            except _requests.RequestException:
+                pass
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("serve pod never became ready; logs:\n"
+                               + "\n".join(agent.cluster.pod_logs(n)
+                                           for n in agent.cluster.pods))
+
+        # -- traffic ramp: 6 sustained workers (until ramp_stop) push
+        # demand across the replica fleet; the front round-robins, so
+        # replica 1 serves real traffic and its injected hang arms
+        ramp = [threading.Thread(target=worker,
+                                 args=(f"ramp{i}", 0, 6, ramp_stop),
+                                 daemon=True) for i in range(6)]
+        for t in ramp:
+            t.start()
+        deadline = time.monotonic() + timeout / 3
+        while time.monotonic() < deadline and len(live_serve_pods()) < 2:
+            time.sleep(0.3)
+
+        # -- 2 rolling replica kills at seeded times, under live traffic -
+        for _ in range(2):
+            time.sleep(rng.uniform(1.5, 4.0))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                live = live_serve_pods()
+                if len(live) >= 2:
+                    break  # never kill the last replica mid-ramp
+                time.sleep(0.3)
+            else:
+                continue
+            victim = live[rng.randrange(len(live))]
+            pod = agent.cluster.pods.get(victim)
+            if pod is not None and pod.proc is not None:
+                pod.proc.kill()
+                kills.append(victim)
+
+        # -- overload burst past the bounded queue ------------------------
+        burst = [threading.Thread(target=worker, args=(f"burst{i}", 3),
+                                  daemon=True) for i in range(14)]
+        for t in burst:
+            t.start()
+        for t in burst:
+            t.join(timeout=timeout / 2)
+
+        # the engine hang fires organically once replica 1 completed its
+        # 4th request; give the watchdog + per-pod restart time to land.
+        # The durable evidence is the `serving_stalled` span the watchdog
+        # writes before its hard-exit (a running->running status write is
+        # a no-change edge the store rejects, same as the train soak).
+        from polyaxon_tpu.tracking import read_events
+
+        def _stalled_span() -> bool:
+            try:
+                return any(
+                    e.span is not None and e.span.name == "serving_stalled"
+                    for e in read_events(run_dir, "span",
+                                         "serving_stalled"))
+            except Exception:
+                return False
+
+        deadline = time.monotonic() + timeout / 3
+        while time.monotonic() < deadline:
+            if _stalled_span():
+                break
+            time.sleep(0.5)
+        ramp_stop.set()
+        for t in ramp:
+            t.join(timeout=timeout / 4)
+
+        # -- exactly-once probe: same id, same replica, cached answer -----
+        probe = {"tokens": [9, 8, 7, 6, 5], "max_new_tokens": 4,
+                 "request_id": "probe-cache"}
+        exactly_once = False
+        for probe_ep in endpoints():
+            try:
+                r1 = _requests.post(f"{probe_ep}/generate", json=probe,
+                                    timeout=60)
+                if r1.status_code != 200:
+                    continue
+                first = r1.json()
+                second = _requests.post(f"{probe_ep}/generate", json=probe,
+                                        timeout=60).json()
+                exactly_once = (second.get("cached") is True
+                                and second.get("tokens")
+                                == first.get("tokens"))
+                break
+            except _requests.RequestException:
+                continue
+
+        # -- cooldown: tail requests in flight while the drain begins -----
+        tails = [threading.Thread(target=worker,
+                                  args=(f"tail{i}", 1, 40), daemon=True)
+                 for i in range(2)]
+        for t in tails:
+            t.start()
+        for t in tails:
+            t.join(timeout=timeout / 4)
+        stop_traffic.set()
+        deadline = time.monotonic() + timeout / 2
+        while time.monotonic() < deadline:
+            if len(live_serve_pods()) == 1 and agent.autoscale_drains:
+                break
+            time.sleep(0.5)
+
+        scrape = store.metrics.render()
+        from polyaxon_tpu.obs.metrics import parse_prometheus
+
+        fams = parse_prometheus(scrape)  # validates strictly
+
+        def fam(name: str) -> float:
+            return fams.get(name, {}).get(name, 0.0)
+
+        accepted = set(results)
+        checks = {
+            "zero_lost_accepted": not failures,
+            "all_requests_resolved": len(accepted) == len(set(submitted)),
+            "exactly_once_resume": exactly_once,
+            "every_429_has_retry_after":
+                all(ra is not None for ra in front.rejections),
+            "overload_shed_observed": len(front.rejections) >= 1,
+            "scrape_rejected": fam("polyaxon_serve_rejected_total") >= 1,
+            "two_kills_landed": len(kills) == 2,
+            "watchdog_fired": _stalled_span(),
+            "front_retried": fam(
+                "polyaxon_serve_request_retries_total") >= 1,
+            "drains_completed": bool(agent.autoscale_drains) and all(
+                outcome == "drained"
+                for _, _, outcome in agent.autoscale_drains),
+            "converged_to_min": len(live_serve_pods()) == 1,
+            # completions counted by the store's heartbeat bridge; each
+            # kill (and the watchdog hard-exit) eats up to one
+            # report-interval window of counts, which at tiny-model
+            # throughput is a few percent — the client-side zero-lost /
+            # exactly-once checks above are the hard contract, this floor
+            # pins the bridge's order of magnitude
+            "scrape_requests_consistent": fam(
+                "polyaxon_serve_requests_total")
+                >= max(int(0.9 * len(accepted)), 1),
+            "no_duplicate_applies":
+                not agent.cluster.duplicate_applies,
+        }
+        return {
+            "ok": all(checks.values()),
+            "checks": checks,
+            "accepted": len(accepted),
+            "failures": failures,
+            "rejections_429": len(front.rejections),
+            "kills": kills,
+            "drains": list(agent.autoscale_drains),
+            "launch_counts": dict(agent.cluster.launch_counts),
+            "metrics_text": scrape,
+        }
+    finally:
+        stop_traffic.set()
+        try:
+            rc.stop(uuid)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and store.get_run(
+                    uuid)["status"] not in ("stopped", "failed"):
+                time.sleep(0.2)
+        except Exception:
+            pass
+        agent.stop()
+        srv.stop()
+
+
+def _run_serve_faults_mode(args) -> int:
+    root = tempfile.mkdtemp(prefix="plx-serve-fault-soak-")
+    ok = True
+    final_scrape = ""
+    try:
+        for i in range(args.rounds):
+            out = run_serve_fault_soak(
+                os.path.join(root, f"round-{i}"), seed=args.seed + i,
+                timeout=args.timeout)
+            final_scrape = out.pop("metrics_text")
+            ok = ok and out["ok"]
+            print(json.dumps({"round": i, **out}))
+    finally:
+        if args.keep:
+            print(json.dumps({"workdir": root}))
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+    if args.metrics_dump:
+        _dump_metrics(args.metrics_dump, final_scrape)
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
 def _run_serve_traffic_mode(args) -> int:
     from polyaxon_tpu.obs.metrics import parse_prometheus
 
@@ -1229,6 +1587,15 @@ def main() -> int:
                         "follow the ramp both directions within the chip "
                         "budget, surviving a mid-ramp agent kill with "
                         "zero duplicate launches")
+    p.add_argument("--serve-faults", action="store_true",
+                   help="serving fault soak (ISSUE 12): REAL serve pods "
+                        "under a traffic ramp with 2 rolling replica "
+                        "kills, an overload burst past the bounded "
+                        "queue, and 1 injected engine hang — zero lost "
+                        "accepted requests, exactly-once per request "
+                        "id, every 429 with Retry-After, drained pods "
+                        "deleted only after in-flight completion, all "
+                        "via the strict /metrics scrape")
     p.add_argument("--store-outage", action="store_true",
                    help="store-survivability soak (ISSUE 7): kill the "
                         "PRIMARY STORE mid-wave under a sharded agent "
@@ -1253,17 +1620,19 @@ def main() -> int:
     args = p.parse_args()
 
     if args.lock_witness and (args.train_faults or args.serve_traffic
-                              or args.store_outage):
+                              or args.serve_faults or args.store_outage):
         # refuse rather than silently run unwitnessed: an operator who
         # asked for the witness must not read a lucky exit 0 as
         # "cycle-free" when no locks were instrumented
         print("--lock-witness is wired into the kill-agent soaks only "
               "(--kill-agent / --agents N / --rolling-kill); it does not "
               "instrument --train-faults / --serve-traffic / "
-              "--store-outage", file=sys.stderr)
+              "--serve-faults / --store-outage", file=sys.stderr)
         return 2
     if args.train_faults:
         return _run_train_faults_mode(args)
+    if args.serve_faults:
+        return _run_serve_faults_mode(args)
     if args.serve_traffic:
         return _run_serve_traffic_mode(args)
     if args.store_outage:

@@ -1,0 +1,28 @@
+"""Clean twin of r12_wall_clock_decode_deadline_bad.py: every serve
+deadline is monotonic-clock arithmetic; the one legitimately wall-clock
+value (a cross-process marker horizon persisted for another process to
+read) carries the written justification the suppression syntax exists
+for."""
+
+import time
+
+
+class GoodServeDeadlines:
+    def __init__(self, drain_timeout_s: float = 30.0):
+        self.drain_timeout_s = drain_timeout_s
+        self.drain_deadline = None
+
+    def submit(self, req, deadline_s: float):
+        req.deadline = time.monotonic() + deadline_s
+
+    def expired(self, req) -> bool:
+        return req.deadline is not None and time.monotonic() > req.deadline
+
+    def begin_drain(self):
+        self.drain_deadline = time.monotonic() + self.drain_timeout_s
+
+    def write_marker(self) -> dict:
+        return {
+            # plx: allow(clock): cross-process marker horizon persisted for the pod to read — wall clock is the shared medium
+            "expires_at": time.time() + 3 * self.drain_timeout_s,
+        }

@@ -1,0 +1,26 @@
+"""R4/ISSUE 12 reproducer: wall-clock decode deadlines in the serve
+engine. Per-request deadlines, the drain window and the watchdog's stall
+silence are all DURATIONS on one machine — ``time.time()`` arithmetic
+there cancels requests early (NTP step back) or never (step forward),
+and fires/starves the serving watchdog under exactly the clock weather a
+chaos soak creates. The clean twin is r12_monotonic_decode_ok.py."""
+
+import time
+
+
+class BadServeDeadlines:
+    def __init__(self, drain_timeout_s: float = 30.0):
+        self.drain_timeout_s = drain_timeout_s
+        self.drain_deadline = None
+
+    def submit(self, req, deadline_s: float):
+        # BUG: wall-clock request deadline — an NTP step cancels every
+        # in-flight request at once (or none, ever)
+        req.deadline = time.time() + deadline_s
+
+    def expired(self, req) -> bool:
+        return req.deadline is not None and time.time() > req.deadline
+
+    def begin_drain(self):
+        # BUG: wall-clock drain window
+        self.drain_deadline = time.time() + self.drain_timeout_s

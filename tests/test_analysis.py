@@ -82,6 +82,8 @@ BAD_CASES = [
     ("clock", "scheduler/r4_wall_clock_lease_bad.py", 2),
     ("metrics", "r5_counter_as_gauge_bad.py", 4),
     ("donation", "r6_donated_reuse_bad.py", 2),
+    # serve decode deadlines joined the clock rule's scope in ISSUE 12
+    ("clock", "serve/r12_wall_clock_decode_deadline_bad.py", 3),
 ]
 
 OK_TWINS = [
@@ -91,6 +93,7 @@ OK_TWINS = [
     "scheduler/r4_monotonic_ok.py",
     "r5_contract_ok.py",
     "r6_rebind_ok.py",
+    "serve/r12_monotonic_decode_ok.py",
 ]
 
 

@@ -74,6 +74,12 @@ EXPECTED_FAMILIES = {
     "polyaxon_serve_intertoken_seconds",
     "polyaxon_serve_target_replicas",
     "polyaxon_autoscale_events_total",
+    # request-path fault tolerance (ISSUE 12): overload shedding,
+    # KV-pressure preemptions, replica drain state and front retries
+    "polyaxon_serve_rejected_total",
+    "polyaxon_serve_preemptions_total",
+    "polyaxon_serve_draining",
+    "polyaxon_serve_request_retries_total",
 }
 
 

@@ -212,6 +212,65 @@ def test_reconciler_deadline(tmp_path):
     assert cluster.pod_statuses({"app.polyaxon.com/run": "u3"}) == []
 
 
+def test_reconciler_scale_keep_protects_draining_pods(tmp_path):
+    """ISSUE 12: scale(keep=) leaves a surplus pod that is still DRAINING
+    alive while swapping the desired resources; the follow-up scale call
+    without keep (drain complete / timed out) deletes it."""
+    cluster = FakeCluster(str(tmp_path))
+    r = OperationReconciler(cluster)
+    labels = {"app.polyaxon.com/run": "u-drain"}
+    mk = lambda name: _pod(  # noqa: E731
+        name, [sys.executable, "-c", "import time; time.sleep(60)"],
+        labels=labels)
+    r.apply(OperationCR(run_uuid="u-drain",
+                        resources=[mk("r0"), mk("r1")]))
+    live = lambda: sorted(  # noqa: E731
+        s.name for s in cluster.pod_statuses(labels))
+    assert live() == ["r0", "r1"]
+    # scale 2 -> 1 with r1 still draining: protected, resources swapped
+    applied, deleted = r.scale("u-drain", [mk("r0")], keep={"r1"})
+    assert (applied, deleted) == (0, 0)
+    assert live() == ["r0", "r1"]
+    # drain finished: the same diff without keep deletes the surplus
+    applied, deleted = r.scale("u-drain", [mk("r0")])
+    assert (applied, deleted) == (0, 1)
+    assert live() == ["r0"]
+    r.delete("u-drain")
+
+
+def test_reconciler_per_pod_restart_replaces_only_the_victim(tmp_path):
+    """ISSUE 12: a replicated service replaces ONLY its failed replica
+    pod — the survivor keeps running (its in-flight requests live) —
+    and the backoff budget still bounds the replacement rounds."""
+    cluster = FakeCluster(str(tmp_path))
+    rec = _Recorder()
+    r = OperationReconciler(cluster, on_status=rec)
+    labels = {"app.polyaxon.com/run": "u-svc"}
+    survivor = _pod("r0", [sys.executable, "-c",
+                           "import time; time.sleep(60)"], labels=labels)
+    victim = _pod("r1", [sys.executable, "-c", "raise SystemExit(9)"],
+                  labels=labels)
+    r.apply(OperationCR(run_uuid="u-svc", resources=[survivor, victim],
+                        backoff_limit=1, per_pod_restart=True))
+
+    def _phases():
+        return {s.name: s.phase
+                for s in cluster.pod_statuses(labels)}
+
+    # no reconcile ticks yet: observe the raw failure first
+    assert _wait(lambda: _phases().get("r1") == PodPhase.FAILED)
+    survivor_proc = cluster.pods["r0"].proc
+    # one reconcile pass replaces r1 in place; r0's PROCESS is untouched
+    r.reconcile_once()
+    assert sorted(_phases()) == ["r0", "r1"]
+    assert cluster.pods["r0"].proc is survivor_proc
+    assert r.final_status("u-svc") is None  # the op never failed
+    # the replacement also fails -> budget (1) exhausted -> kernel FAIL
+    assert _wait(lambda: r.final_status("u-svc") == "failed",
+                 tick=r.reconcile_once)
+    assert cluster.pod_statuses(labels) == []
+
+
 def test_reconciler_ttl_gc(tmp_path):
     cluster = FakeCluster(str(tmp_path))
     r = OperationReconciler(cluster)

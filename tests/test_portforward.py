@@ -92,6 +92,31 @@ def test_port_forward_service_run(tmp_path, backend):
         agent.stop()
 
 
+def test_tcp_proxy_fails_over_to_fallback_targets():
+    """ISSUE 12: a connection whose primary dial fails tries the next
+    replica endpoint in the same accept, and later connections start at
+    the endpoint that worked (sticky)."""
+    import http.server
+    import threading
+
+    alive = http.server.ThreadingHTTPServer(
+        ("127.0.0.1", 0), http.server.SimpleHTTPRequestHandler)
+    threading.Thread(target=alive.serve_forever, daemon=True).start()
+    dead_port = _free_port()
+    try:
+        lp, stop = start_tcp_proxy(
+            "127.0.0.1", dead_port,
+            fallback_targets=[("127.0.0.1", alive.server_port)])
+        try:
+            for _ in range(2):  # second hit rides the sticky index
+                r = _wait_http(f"http://127.0.0.1:{lp}/", timeout=30)
+                assert r.status_code == 200
+        finally:
+            stop()
+    finally:
+        alive.shutdown()
+
+
 def test_port_forward_over_websocket(tmp_path):
     """Remote mode: bytes bridge local socket -> ws -> API server -> the
     service, with auth enforced on the endpoint."""

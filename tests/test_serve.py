@@ -28,7 +28,10 @@ from polyaxon_tpu.models import transformer as T
 from polyaxon_tpu.ops.paged_attention import (
     dense_decode_attention, gather_blocks, paged_attention,
 )
-from polyaxon_tpu.serve.engine import SamplingParams, ServeEngine, sample_token
+from polyaxon_tpu.serve.engine import (
+    EngineDrainingError, EngineOverloadedError, SamplingParams, ServeEngine,
+    sample_token,
+)
 from polyaxon_tpu.serve.kv_cache import (
     BlockAllocator, OutOfBlocksError, PagedKVCache, SequenceBlocks,
 )
@@ -434,6 +437,445 @@ class TestServeEngine:
             t = sample_token(logits, SamplingParams(
                 temperature=1.0, top_k=2), rng)
             assert t in (1, 3)  # top-2 only
+
+
+# -- request-path fault tolerance (ISSUE 12) ---------------------------------
+
+
+class TestServeFaults:
+    def test_drain_refuses_admission_but_finishes_inflight(self, tiny):
+        """begin_drain closes admission (submits raise) while accepted
+        work — including the already-waiting overflow — runs to
+        completion; drained flips only when the engine is empty."""
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                          prefill_chunk=16, max_seq_len=64)
+        sp = SamplingParams(max_new_tokens=4)
+        reqs = [eng.submit(list(range(3, 3 + n)), sp) for n in (5, 7, 9)]
+        eng.begin_drain()
+        assert eng.draining and not eng.drained
+        with pytest.raises(EngineDrainingError):
+            eng.submit(list(range(5)), sp)
+        _drive(eng, reqs)
+        assert all(r.state == "done" and len(r.out_tokens) == 4
+                   for r in reqs)
+        assert eng.drained
+        assert eng.cache.allocator.used_count == 0
+        eng.end_drain()
+        assert not eng.draining
+        r = eng.submit(list(range(5)), sp)  # admission reopened
+        _drive(eng, [r])
+        assert r.state == "done"
+
+    def test_overload_sheds_with_retry_after(self, tiny):
+        """Past max_waiting the engine sheds with a throughput-derived
+        Retry-After hint instead of queueing unboundedly."""
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=1, block_size=8,
+                          prefill_chunk=16, max_seq_len=64, max_waiting=1)
+        sp = SamplingParams(max_new_tokens=4)
+        eng.submit(list(range(3, 8)), sp)   # fills the queue (no steps)
+        with pytest.raises(EngineOverloadedError) as ei:
+            eng.submit(list(range(3, 8)), sp)
+        assert ei.value.retry_after_s >= 1.0
+        assert eng.snapshot()["rejected_total"] == 1
+
+    def test_infeasible_reservation_fails_loudly(self, tiny):
+        """A worst-case reservation larger than the whole pool can never
+        admit — loud failure at submit, not a head-of-line deadlock."""
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=1, block_size=8,
+                          num_blocks=2, max_seq_len=64)
+        r = eng.submit(list(range(3, 23)),
+                       SamplingParams(max_new_tokens=10))
+        assert r.state == "failed" and "exceeds the pool" in r.error
+
+    def test_generate_timeout_cancels_and_recycles_blocks(self, tiny):
+        """Satellite 2: a generate() timeout must cancel the request
+        SERVER-side — blocks released, slot freed — not abandon it to
+        keep decoding for an absent caller."""
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=1, block_size=8,
+                          prefill_chunk=16, max_seq_len=64)
+        # never stepped: the request would "run" forever
+        with pytest.raises(TimeoutError):
+            eng.generate(list(range(3, 10)),
+                         SamplingParams(max_new_tokens=50), timeout=0.2)
+        assert eng.cache.allocator.used_count == 0
+        assert eng.running_count == 0 and eng.waiting_count == 0
+
+    def test_deadline_cancels_server_side(self, tiny):
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=1, block_size=8,
+                          prefill_chunk=16, max_seq_len=64)
+        req = eng.submit(list(range(3, 10)),
+                         SamplingParams(max_new_tokens=50),
+                         deadline_s=0.01)
+        time.sleep(0.05)
+        eng.step()
+        assert req.state == "failed" and "deadline" in req.error
+        assert req.done.is_set()
+        assert eng.cache.allocator.used_count == 0
+
+    def test_preemption_readmit_token_parity(self, tiny):
+        """KV-pressure preemption: the newest running sequence is evicted
+        behind the starving head, re-prefills its prefix on readmission,
+        and finishes with the EXACT tokens of an unpreempted oracle."""
+        params, cfg = tiny
+        sp = SamplingParams(max_new_tokens=8)
+        pa, pb, pc = (list(range(3, 11)), list(range(20, 28)),
+                      list(range(40, 48)))
+        # oracle: ample blocks, no preemption possible
+        oracle = ServeEngine(params, cfg, max_slots=3, block_size=8,
+                             prefill_chunk=16, max_seq_len=64)
+        oreqs = [oracle.submit(p, sp) for p in (pa, pb, pc)]
+        _drive(oracle, oreqs)
+        assert all(r.preemptions == 0 for r in oreqs)
+        # tight pool: A and B fill it; C starves until B (newest) is
+        # evicted behind C
+        eng = ServeEngine(params, cfg, max_slots=3, block_size=8,
+                          prefill_chunk=16, max_seq_len=64,
+                          num_blocks=4, preempt_grace_s=0.0)
+        a = eng.submit(pa, sp)      # 2 blocks
+        b = eng.submit(pb, sp)      # 2 blocks -> pool full
+        for _ in range(3):
+            eng.step()              # admit + start decoding both
+        c = eng.submit(pc, sp)      # starving head
+        reqs = [a, b, c]
+        _drive(eng, reqs)
+        assert b.preemptions == 1, "newest running must have been evicted"
+        assert eng.snapshot()["preemptions_total"] == 1
+        assert [r.out_tokens for r in reqs] == [r.out_tokens for r in oreqs]
+        assert eng.cache.allocator.used_count == 0
+
+    def test_resume_by_id_exactly_once(self, tiny):
+        """A retried request_id attaches to the live request or answers
+        from the completed cache — the engine generates exactly once."""
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                          prefill_chunk=16, max_seq_len=64)
+        sp = SamplingParams(max_new_tokens=4)
+        req, created = eng.submit_request(list(range(3, 10)), sp,
+                                          request_id="r-1")
+        assert created
+        again, created2 = eng.submit_request(list(range(3, 10)), sp,
+                                             request_id="r-1")
+        assert again is req and not created2   # attached, not duplicated
+        _drive(eng, [req])
+        done, created3 = eng.submit_request(list(range(3, 10)), sp,
+                                            request_id="r-1")
+        assert done is req and not created3    # served from the cache
+        assert eng.snapshot()["requests_total"] == 1
+        assert eng.lookup("r-1") is req and eng.lookup("nope") is None
+
+    def test_completed_cache_is_bounded(self, tiny):
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=1, block_size=8,
+                          prefill_chunk=16, max_seq_len=64,
+                          completed_cache=2)
+        sp = SamplingParams(max_new_tokens=2)
+        reqs = [eng.submit([3, 4, 5], sp, request_id=f"id-{i}")
+                for i in range(4)]
+        _drive(eng, reqs)
+        assert eng.lookup("id-0") is None      # evicted
+        assert eng.lookup("id-3") is not None  # newest retained
+
+    def test_watchdog_fires_on_wedged_step_and_not_on_idle(self, tiny):
+        """The engine loop beats an attached StepWatchdog; a wedged step
+        silences the beats and fires it — idle periods never do."""
+        from polyaxon_tpu.train.watchdog import StepWatchdog
+
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=1, block_size=8,
+                          prefill_chunk=16, max_seq_len=64)
+        exits = []
+        # compile_grace covers the first request's XLA compilation (no
+        # beats until the engine is ready); after that the deadline is
+        # p95-scaled with a small floor
+        # min_s must sit well above the engine's 0.5 s idle-beat cadence
+        # or a quiet period reads as silence
+        wd = StepWatchdog(min_s=2.0, stall_factor=1.5, compile_grace_s=90.0,
+                          p95_s=eng.step_p95_s,
+                          exit_fn=lambda code: exits.append(code),
+                          log=lambda line: None)
+        eng.watchdog = wd
+        wd.start()
+        eng.start()
+        # healthy traffic + idle: the beats keep it quiet
+        eng.generate([3, 4, 5], SamplingParams(max_new_tokens=3),
+                     timeout=60)
+        time.sleep(0.8)
+        assert not wd.fired and not exits
+        # wedge the scheduler: step() blocks forever -> beats stop
+        wedge = threading.Event()
+        eng.step_orig = eng.step
+        eng.step = lambda: wedge.wait(60) or 0
+        eng.submit([3, 4, 5], SamplingParams(max_new_tokens=3))
+        deadline = time.monotonic() + 30
+        while not wd.fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert wd.fired and exits, "watchdog must fire on step silence"
+        wedge.set()
+        eng.step = eng.step_orig
+        eng.stop()
+
+    def test_watchdog_spares_idle_unready_replica(self, tiny):
+        """`warmup: false` + no traffic: the engine never becomes ready,
+        but legitimate quiet must NOT burn the compile window — the loop
+        touches the silence clock while keeping the first real request's
+        full compile grace armed."""
+        from polyaxon_tpu.train.watchdog import StepWatchdog
+
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=1, block_size=8,
+                          prefill_chunk=16, max_seq_len=64)
+        exits = []
+        wd = StepWatchdog(min_s=2.0, stall_factor=1.5, compile_grace_s=1.5,
+                          p95_s=eng.step_p95_s,
+                          exit_fn=lambda code: exits.append(code),
+                          log=lambda line: None)
+        eng.watchdog = wd
+        wd.start()
+        eng.start()
+        time.sleep(2.6)  # > the whole unready limit, zero traffic
+        assert not wd.fired and not exits
+        eng.generate([3, 4, 5], SamplingParams(max_new_tokens=2),
+                     timeout=60)
+        assert not wd.fired
+        eng.stop()
+        wd.stop()
+
+    def test_reaper_serve_stall_rule(self):
+        """ZombieReaper's serving twin of the step-freeze rule: fresh
+        beats + frozen requests_total + waiting>0 reaps as stalled; an
+        advancing total (or an empty queue) never does."""
+        from polyaxon_tpu.api.store import Store
+        from polyaxon_tpu.resilience.heartbeat import ZombieReaper
+
+        store = Store(":memory:")
+        store.create_project("p")
+        u = store.create_run(
+            "p", spec={"component": {"run": {"kind": "service"}},
+                       "termination": {"maxRetries": 2}})["uuid"]
+        store.transition(u, "running", force=True)
+        reaper = ZombieReaper(store, owned=lambda: [], zombie_after=30.0,
+                              stall_grace=0.4)
+        reaper._min_interval = 0.0
+
+        def beat(requests_total, waiting):
+            store.heartbeat(u, serve={"requests_total": requests_total,
+                                      "waiting": waiting},
+                            incarnation="r0")
+
+        # progress advancing: never judged (each new total restarts the
+        # observation window)
+        beat(1, 3)
+        assert reaper.pass_once() == []
+        time.sleep(0.25)
+        beat(2, 3)
+        assert reaper.pass_once() == []  # total moved: window restarts
+        # frozen total with waiting>0: stalled once the freeze has been
+        # OBSERVED for stall_grace (the clock started when 2 was first
+        # seen, at the pass above)
+        time.sleep(0.25)
+        beat(2, 3)
+        assert reaper.pass_once() == []  # 0.25 s frozen < 0.4 s grace
+        time.sleep(0.3)
+        beat(2, 3)
+        actions = reaper.pass_once()
+        assert actions == [(u, "stalled")]
+        assert store.get_run(u)["status"] == "queued"  # retrying path
+        # waiting==0 clears the clock: an idle replica is never stalled
+        store.transition(u, "running", force=True)
+        beat(2, 0)
+        assert reaper.pass_once() == []
+        time.sleep(0.5)
+        beat(2, 0)
+        assert reaper.pass_once() == []
+
+
+class TestServeFaultHTTP:
+    @pytest.fixture()
+    def served(self, tiny):
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                          prefill_chunk=16, max_seq_len=64,
+                          max_waiting=0).start()
+        srv = _EngineServer(eng)
+        yield srv, eng
+        srv.stop()
+        eng.stop()
+
+    def test_healthz_503_until_ready_and_while_draining(self, tiny):
+        import requests
+
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                          prefill_chunk=16, max_seq_len=64).start()
+        srv = _EngineServer(eng)
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            r = requests.get(f"{url}/healthz", timeout=10)
+            assert r.status_code == 503 and r.json()["ready"] is False
+            requests.post(f"{url}/generate", json={
+                "tokens": [1, 2, 3], "max_new_tokens": 2}, timeout=120)
+            r = requests.get(f"{url}/healthz", timeout=10)
+            assert r.status_code == 200 and r.json()["ok"]
+            eng.begin_drain()
+            r = requests.get(f"{url}/healthz", timeout=10)
+            assert r.status_code == 503 and r.json()["draining"] is True
+            # admission refused over HTTP too
+            r = requests.post(f"{url}/generate", json={
+                "tokens": [1, 2, 3], "max_new_tokens": 2}, timeout=10)
+            assert r.status_code == 503
+        finally:
+            srv.stop()
+            eng.stop()
+
+    def test_429_shape_carries_retry_after(self, served):
+        import requests
+
+        srv, _ = served
+        r = requests.post(f"http://127.0.0.1:{srv.port}/generate", json={
+            "tokens": [1, 2, 3], "max_new_tokens": 2}, timeout=10)
+        assert r.status_code == 429
+        ra = r.headers.get("Retry-After")
+        assert ra is not None and int(ra) >= 1
+        assert r.json()["retry_after_s"] >= 1.0
+
+    def test_resume_by_id_over_http(self, tiny):
+        """Same request_id re-POSTed answers from the completed cache
+        (cached: true, identical tokens, no second generation); /result
+        resumes a finished id and 404s an unknown one."""
+        import requests
+
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                          prefill_chunk=16, max_seq_len=64).start()
+        srv = _EngineServer(eng)
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            body = {"tokens": [5, 6, 7], "max_new_tokens": 3,
+                    "request_id": "abc"}
+            first = requests.post(f"{url}/generate", json=body,
+                                  timeout=120).json()
+            assert first["request_id"] == "abc"
+            second = requests.post(f"{url}/generate", json=body,
+                                   timeout=120).json()
+            assert second["cached"] is True
+            assert second["tokens"] == first["tokens"]
+            assert eng.snapshot()["requests_total"] == 1
+            res = requests.get(f"{url}/result/abc", timeout=10)
+            assert res.status_code == 200
+            assert res.json()["tokens"] == first["tokens"]
+            assert requests.get(f"{url}/result/zzz",
+                                timeout=10).status_code == 404
+        finally:
+            srv.stop()
+            eng.stop()
+
+
+class TestServeFront:
+    def test_front_retries_connect_failures_and_503s(self, tiny):
+        """The failover front rotates past dead endpoints and draining
+        replicas, counting each retry."""
+        import requests as _requests  # noqa: F401
+
+        from polyaxon_tpu.client.serve import ServeFront
+
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                          prefill_chunk=16, max_seq_len=64).start()
+        srv = _EngineServer(eng)
+        dead = _free_port()
+        draining_eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                                   prefill_chunk=16, max_seq_len=64)
+        draining_eng.begin_drain()
+        drain_srv = _EngineServer(draining_eng)
+        retried = []
+        try:
+            front = ServeFront(
+                endpoints=[f"http://127.0.0.1:{dead}",          # dead
+                           f"http://127.0.0.1:{drain_srv.port}",  # 503
+                           f"http://127.0.0.1:{srv.port}"],       # live
+                timeout=60, max_attempts=6, backoff_s=0.01,
+                on_retry=lambda n: retried.append(n))
+            out = front.generate(tokens=[4, 5, 6], max_new_tokens=3,
+                                 request_id="front-1")
+            assert len(out["tokens"]) == 3
+            assert out["request_id"] == "front-1"
+            assert len(retried) >= 2  # dead + draining both rotated past
+            assert front._c_retries.value >= 2
+            # sticky: the next call lands on the live endpoint directly
+            out2 = front.generate(tokens=[4, 5, 6], max_new_tokens=3)
+            assert len(out2["tokens"]) == 3
+            assert len(retried) == 2
+        finally:
+            srv.stop()
+            eng.stop()
+            drain_srv.stop()
+            draining_eng.stop()
+
+    def test_front_streaming_fails_over_pre_body_503(self, tiny):
+        """A streamed request that hits a draining replica BEFORE any
+        body was sent must fail over like a non-streamed one (nothing to
+        resume; the no-re-POST rule only protects partial bodies)."""
+        from polyaxon_tpu.client.serve import ServeFront
+
+        params, cfg = tiny
+        draining_eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                                   prefill_chunk=16, max_seq_len=64)
+        draining_eng.begin_drain()
+        drain_srv = _EngineServer(draining_eng)
+        eng = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                          prefill_chunk=16, max_seq_len=64).start()
+        srv = _EngineServer(eng)
+        try:
+            front = ServeFront(
+                endpoints=[f"http://127.0.0.1:{drain_srv.port}",
+                           f"http://127.0.0.1:{srv.port}"],
+                timeout=60, max_attempts=4, backoff_s=0.01)
+            out = front.generate(tokens=[4, 5, 6], max_new_tokens=3,
+                                 stream=True, request_id="s-1")
+            assert out["done"] and len(out["tokens"]) == 3
+            assert front._c_retries.value >= 1
+        finally:
+            srv.stop()
+            eng.stop()
+            drain_srv.stop()
+            draining_eng.stop()
+
+    def test_front_empty_discovery_degrades_to_unavailable(self):
+        from polyaxon_tpu.client.serve import (
+            ServeFront, ServeUnavailableError,
+        )
+
+        front = ServeFront(endpoints_fn=lambda: [], max_attempts=2,
+                           backoff_s=0.01)
+        with pytest.raises(ServeUnavailableError, match="no replica"):
+            front.generate(tokens=[1, 2], max_new_tokens=1)
+
+    def test_front_backs_off_429_and_collects_retry_after(self, tiny):
+        from polyaxon_tpu.client.serve import (
+            ServeFront, ServeUnavailableError,
+        )
+
+        params, cfg = tiny
+        eng = ServeEngine(params, cfg, max_slots=1, block_size=8,
+                          prefill_chunk=16, max_seq_len=64,
+                          max_waiting=0).start()
+        srv = _EngineServer(eng)
+        try:
+            front = ServeFront(endpoints=[f"http://127.0.0.1:{srv.port}"],
+                               timeout=30, max_attempts=2,
+                               retry_after_cap_s=0.05)
+            with pytest.raises(ServeUnavailableError):
+                front.generate(tokens=[1, 2, 3], max_new_tokens=2)
+            assert front.rejections
+            assert all(ra is not None for ra in front.rejections)
+        finally:
+            srv.stop()
+            eng.stop()
 
 
 # -- serve HTTP --------------------------------------------------------------
@@ -890,6 +1332,92 @@ class TestAutoscaler:
         agent.tick()
         assert len(self._pods(agent, uuid)) == 2  # no autoscale block
 
+    def test_scale_down_waits_for_replica_drain(self, stack):
+        """ISSUE 12 drain gate: a surplus replica reporting in-flight
+        work is marked draining (marker file in the run dir) but NOT
+        deleted; the pod goes only after its replica reports empty —
+        and the audit records `drained`, not `timeout`."""
+        import os as _os
+
+        from polyaxon_tpu.api.app import run_artifacts_dir
+
+        store, agent = stack
+        uuid = self._launch(store, agent, _service_autoscale_spec(
+            max_r=2, down_after=0.2))
+        # ramp to 2 replicas (replica-indexed serve reporters)
+        store.heartbeat(uuid, serve={"running": 2, "replica": 0},
+                        incarnation="r0")
+        store.heartbeat(uuid, serve={"running": 2, "replica": 1},
+                        incarnation="r1")
+        agent.tick()
+        assert len(self._pods(agent, uuid)) == 2
+        # traffic drops, but replica 1 still has one request in flight
+        store.heartbeat(uuid, serve={"running": 0, "replica": 0},
+                        incarnation="r0")
+        store.heartbeat(uuid, serve={"running": 1, "replica": 1},
+                        incarnation="r1")
+        agent.tick()   # hysteresis arms
+        time.sleep(0.3)
+        store.heartbeat(uuid, serve={"running": 1, "replica": 1},
+                        incarnation="r1")
+        agent.tick()   # drain starts: marker written, pod NOT deleted
+        run = store.get_run(uuid)
+        marker = _os.path.join(
+            run_artifacts_dir(agent.artifacts_root, run["project"], uuid),
+            "serve-drain-1.json")
+        assert _os.path.exists(marker)
+        assert len(self._pods(agent, uuid)) == 2
+        # replica acknowledges but still busy: still protected
+        store.heartbeat(uuid, serve={"running": 1, "replica": 1,
+                                     "draining": True}, incarnation="r1")
+        agent.tick()
+        assert len(self._pods(agent, uuid)) == 2
+        # in-flight work finished: NOW the pod is deleted
+        store.heartbeat(uuid, serve={"running": 0, "waiting": 0,
+                                     "replica": 1, "draining": True,
+                                     "drained": True}, incarnation="r1")
+        agent.tick()
+        assert len(self._pods(agent, uuid)) == 1
+        assert not _os.path.exists(marker)  # marker cleaned up
+        assert agent.autoscale_drains == [(uuid, [1], "drained")]
+        assert agent.cluster.duplicate_applies == []
+
+    def test_drain_cancelled_by_traffic_rebound(self, stack):
+        """A traffic rebound mid-drain removes the markers (the replica
+        reopens admission on its next beat) and keeps every pod."""
+        import os as _os
+
+        from polyaxon_tpu.api.app import run_artifacts_dir
+
+        store, agent = stack
+        uuid = self._launch(store, agent, _service_autoscale_spec(
+            max_r=2, down_after=0.2))
+        store.heartbeat(uuid, serve={"running": 3, "replica": 0},
+                        incarnation="r0")
+        agent.tick()
+        assert len(self._pods(agent, uuid)) == 2
+        store.heartbeat(uuid, serve={"running": 1, "replica": 0},
+                        incarnation="r0")
+        store.heartbeat(uuid, serve={"running": 1, "replica": 1},
+                        incarnation="r1")
+        agent.tick()
+        time.sleep(0.3)
+        agent.tick()   # drain starts (replica 1 busy -> protected)
+        run = store.get_run(uuid)
+        marker = _os.path.join(
+            run_artifacts_dir(agent.artifacts_root, run["project"], uuid),
+            "serve-drain-1.json")
+        assert _os.path.exists(marker)
+        # rebound: demand needs both replicas again
+        store.heartbeat(uuid, serve={"running": 3, "replica": 0},
+                        incarnation="r0")
+        store.heartbeat(uuid, serve={"running": 1, "replica": 1},
+                        incarnation="r1")
+        agent.tick()
+        assert not _os.path.exists(marker)
+        assert len(self._pods(agent, uuid)) == 2
+        assert agent.autoscale_drains == []
+
     def test_successor_resyncs_at_stored_target(self, stack, tmp_path):
         """Agent dies after a scale-up; the successor adopts the LIVE
         3-replica set (rendered from meta.autoscale) without a single
@@ -952,6 +1480,40 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+@pytest.mark.slow
+class TestServeFaultSoak:
+    def test_serve_faults_converge_with_zero_lost_requests(self, tmp_path):
+        """ISSUE 12 acceptance soak (mirrors TestServeTrafficSoak, but
+        with REAL serve pods): a traffic ramp through the failover front
+        under 2 rolling replica kills + an overload burst + 1 injected
+        engine hang — zero lost accepted requests, exactly-once per
+        request id, every 429 with Retry-After, drains completing before
+        deletion, all reconciled against the strict /metrics scrape."""
+        import os as _os
+        import sys as _sys
+
+        _sys.path.insert(0, _os.path.join(_os.path.dirname(
+            _os.path.dirname(_os.path.abspath(__file__))), "scripts"))
+        from chaos_soak import run_serve_fault_soak
+
+        out = run_serve_fault_soak(str(tmp_path / "serve-faults"),
+                                   seed=2024)
+        assert out["ok"], out["checks"]
+        assert not out["failures"], out["failures"]
+        assert out["rejections_429"] >= 1
+        assert len(out["kills"]) == 2
+        assert out["drains"] and all(o == "drained"
+                                     for _, _, o in out["drains"])
+        from polyaxon_tpu.obs.metrics import parse_prometheus
+
+        fams = parse_prometheus(out["metrics_text"])
+        assert fams["polyaxon_serve_rejected_total"][
+            "polyaxon_serve_rejected_total"] >= 1
+        assert fams["polyaxon_serve_request_retries_total"][
+            "polyaxon_serve_request_retries_total"] >= 1
+        assert "polyaxon_serve_draining" in fams
 
 
 class TestServeServiceE2E:
