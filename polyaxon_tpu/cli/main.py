@@ -42,6 +42,20 @@ def get_host(explicit: Optional[str]) -> Optional[str]:
     return explicit or os.environ.get("PLX_API_HOST") or load_config().get("host")
 
 
+def parse_cli_params(params) -> dict:
+    """-P name=value bindings -> dict (values YAML-parsed). One definition
+    for every command that takes -P (run / check / partition plan)."""
+    import yaml
+
+    parsed = {}
+    for p in params:
+        if "=" not in p:
+            raise click.BadParameter(f"-P expects name=value, got {p!r}")
+        k, _, v = p.partition("=")
+        parsed[k] = yaml.safe_load(v)
+    return parsed
+
+
 def get_token(host: Optional[str] = None) -> Optional[str]:
     """Env wins; then the per-host context (`config --host H --token T`);
     then the global token."""
@@ -100,12 +114,7 @@ def run(files, params, set_overrides, presets, project, name, host, local, watch
 
     from ..polyaxonfile import check_polyaxonfile
 
-    parsed_params = {}
-    for p in params:
-        if "=" not in p:
-            raise click.BadParameter(f"-P expects name=value, got {p!r}")
-        k, _, v = p.partition("=")
-        parsed_params[k] = yaml.safe_load(v)
+    parsed_params = parse_cli_params(params)
 
     op = check_polyaxonfile(
         list(files), params=parsed_params, presets=list(presets) or None,
@@ -179,15 +188,104 @@ def check(files, params, set_overrides):
     from ..compiler import compile_operation
     from ..polyaxonfile import check_polyaxonfile
 
-    parsed = {}
-    for p in params:
-        k, _, v = p.partition("=")
-        parsed[k] = yaml.safe_load(v)
-    op = check_polyaxonfile(list(files), params=parsed,
+    op = check_polyaxonfile(list(files), params=parse_cli_params(params),
                             set_overrides=list(set_overrides) or None)
     compiled = compile_operation(op) if op.has_component() else None
+    if compiled is not None:
+        # partition/lora/import blocks validate at check time too (the
+        # resolver re-validates at schedule time): bad regexes / no-match
+        # rules / unknown axes must not wait for a launch to surface
+        runtime = getattr(compiled.run, "runtime", None)
+        if isinstance(runtime, dict):
+            builtin = dict(runtime)
+            rules = getattr(compiled.run, "partition_rules", None)
+            if rules and "partition_rules" not in builtin:
+                builtin["partition_rules"] = rules
+            from ..partition import needs_validation, validate_builtin_spec
+
+            if needs_validation(builtin) and "{{" not in json.dumps(builtin):
+                try:
+                    validate_builtin_spec(builtin)
+                except Exception as e:
+                    raise click.ClickException(f"partition validation: {e}")
     click.echo(yaml.safe_dump(compiled.to_dict() if compiled else op.to_dict(),
                               sort_keys=False))
+
+
+# -- partition --------------------------------------------------------------
+
+
+@cli.group()
+def partition():
+    """Partition-rule engine tools (docs/PARTITIONING.md)."""
+
+
+@partition.command("plan")
+@click.option("-f", "--file", "files", multiple=True, required=True,
+              type=click.Path(exists=True))
+@click.option("-P", "--param", "params", multiple=True)
+@click.option("--set", "set_overrides", multiple=True)
+@click.option("--json", "as_json", is_flag=True,
+              help="emit the plan as JSON instead of a table")
+def partition_plan(files, params, set_overrides, as_json):
+    """Print the resolved param -> PartitionSpec table + per-device bytes
+    for a polyaxonfile's builtin runtime, BEFORE launching anything (the
+    same summary the run mirrors into its outputs)."""
+    from ..compiler import compile_operation
+    from ..partition import RuleSyntaxError, build_plan, format_plan
+    from ..polyaxonfile import check_polyaxonfile
+
+    op = check_polyaxonfile(list(files), params=parse_cli_params(params),
+                            set_overrides=list(set_overrides) or None)
+    compiled = compile_operation(op)
+    run_obj = compiled.run
+    runtime = getattr(run_obj, "runtime", None)
+    if not runtime or not isinstance(runtime, dict):
+        raise click.ClickException(
+            "partition plan needs a `runtime:` builtin-trainer block "
+            "(user containers own their own sharding)")
+    rules = runtime.get("partition_rules") \
+        or getattr(run_obj, "partition_rules", None)
+    parallelism = runtime.get("parallelism")
+    if parallelism is None and getattr(run_obj, "parallelism", None):
+        parallelism = run_obj.parallelism.to_dict()
+    num_devices = None
+    num_slices = 1
+    if hasattr(run_obj, "get_slice") and (
+            getattr(run_obj, "topology", None)
+            or getattr(run_obj, "slice_alias", None)):
+        topo = run_obj.get_slice()
+        num_devices = topo.num_chips
+        num_slices = topo.num_slices
+    if runtime.get("num_slices") is not None:
+        # mirror run_builtin's precedence: the runtime dict wins over the
+        # topology (hand-built specs set it directly)
+        num_slices = int(runtime["num_slices"])
+    try:
+        plan = build_plan(
+            runtime.get("model", "llama-tiny"),
+            parallelism=parallelism,
+            num_devices=num_devices,
+            num_slices=num_slices,
+            partition_rules=rules,
+            lora=runtime.get("lora"),
+        )
+    except (RuleSyntaxError, KeyError) as e:
+        raise click.ClickException(str(e))
+    if as_json:
+        click.echo(json.dumps(plan, indent=2))
+    else:
+        click.echo(format_plan(plan))
+
+
+@partition.command("audit")
+@click.argument("models", nargs=-1)
+def partition_audit(models):
+    """Assert every built-in model's param tree is fully covered by its
+    shipped rule set (the scripts/ci.sh gate, as a CLI verb)."""
+    from ..partition.__main__ import main as audit_main
+
+    sys.exit(audit_main(list(models)))
 
 
 # -- ops --------------------------------------------------------------------

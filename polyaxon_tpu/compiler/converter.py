@@ -135,7 +135,14 @@ def service_replica_count(run: Any, override: Optional[int] = None) -> int:
 def _render_builtin(run: Any, ctx: dict) -> Optional[dict]:
     """Render the `runtime:` builtin-trainer spec (shared by the local and
     K8s paths so they can never diverge). Available on tpujob/jaxjob and all
-    Kubeflow-style kinds."""
+    Kubeflow-style kinds.
+
+    Partition-engine blocks (ISSUE 13): a run-level ``partitionRules:``
+    list merges in (the runtime dict's own key wins), multislice jobs get
+    ``num_slices`` from their topology, and any partition/lora/import
+    block is VALIDATED here — rule-syntax errors and unmatched rules
+    surface at compile time with the offending regex and nearest param
+    paths, not as a mid-init traceback in the pod."""
     runtime = getattr(run, "runtime", None)
     if not runtime:
         return None
@@ -143,6 +150,15 @@ def _render_builtin(run: Any, ctx: dict) -> Optional[dict]:
     parallelism = getattr(run, "parallelism", None)
     if parallelism:
         builtin.setdefault("parallelism", parallelism.to_dict())
+    rules = getattr(run, "partition_rules", None)
+    if rules and "partition_rules" not in builtin:
+        builtin["partition_rules"] = render_value(rules, ctx)
+    if isinstance(run, V1TPUJob) and (run.topology or run.slice_alias):
+        builtin.setdefault("num_slices", run.get_slice().num_slices)
+    from ..partition import needs_validation, validate_builtin_spec
+
+    if needs_validation(builtin):
+        validate_builtin_spec(builtin)
     return builtin
 
 

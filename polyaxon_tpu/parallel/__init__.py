@@ -10,6 +10,7 @@ from .mesh import (
     DEFAULT_RULES,
     ShardingRules,
     build_mesh,
+    device_slice_ids,
     logical_sharding,
     mesh_axis_size,
     normalize_axis_sizes,
@@ -31,6 +32,7 @@ __all__ = [
     "DEFAULT_RULES",
     "ShardingRules",
     "build_mesh",
+    "device_slice_ids",
     "logical_sharding",
     "mesh_axis_size",
     "normalize_axis_sizes",

@@ -1,0 +1,39 @@
+"""Rule-coverage audit gate: ``python -m polyaxon_tpu.partition``.
+
+Exit 0 iff every built-in model's full param tree is matched by its
+shipped rule set AND the engine reproduces the legacy logical-axis specs
+exactly — wired into scripts/ci.sh so a model edit can't silently fall
+back to replicated sharding (ISSUE 13 satellite)."""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv: list[str]) -> int:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (CLI re-entry): audit is
+        # shape-level math, any platform works
+    from . import audit
+    from .rules import UnmatchedParamError
+
+    models = argv or None
+    try:
+        report = audit(models)
+    except (UnmatchedParamError, AssertionError, KeyError) as e:
+        print(f"partition audit FAILED: {e}", file=sys.stderr)
+        return 1
+    for name, row in report.items():
+        print(f"  {name:<16} {row['params']:>3} tensors  "
+              f"{row['rules']:>2} rules  {row['status']}")
+    print(f"partition audit OK: {len(report)} models, full rule coverage, "
+          f"legacy-spec parity")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,432 @@
+"""Arbitrary-checkpoint import/export through the partition-rule engine.
+
+A foreign checkpoint is a flat ``name -> array`` mapping in some container
+(directory of ``.npy`` files, one ``.npz``, or a ``.safetensors`` file) and
+some *layout* (our native flat paths, or HF-style llama keys). Import never
+materializes the model unsharded on one host: every target parameter is
+built with ``jax.make_array_from_callback`` so each host reads exactly its
+shard slices from the (memory-mapped where the container allows) source —
+the peak transient is one per-layer matrix for stacked HF weights, never
+the stacked tensor and never the whole tree.
+
+Layouts:
+
+- ``flat``: source keys are the native /-joined param paths; optional
+  ``key_map`` (regex -> replacement rename) and ``transpose`` (regex ->
+  axis permutation) adapt near-native trees.
+- ``hf-llama``: HuggingFace ``LlamaForCausalLM`` state-dict keys and
+  matrix layouts (fused ``[out, in]`` projections, per-layer weights);
+  mapped onto our scan-stacked ``[L, ...]`` einsum-layout tree.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from .builtins import rules_for_config
+from .rules import match_partition_rules, path_str, tree_paths
+
+
+class ImportError_(ValueError):
+    """A checkpoint import cannot proceed: missing source keys, layout
+    mismatch, or shape disagreement. Lists every problem at once."""
+
+
+# ---------------------------------------------------------------------------
+# Containers: name -> lazy array-like
+# ---------------------------------------------------------------------------
+
+
+class NpyDirSource:
+    """Directory tree of ``.npy`` files; key = relative path without the
+    extension (``/`` in native paths becomes real directories, HF dotted
+    keys are plain file names). Arrays open with ``mmap_mode='r'`` so
+    slicing reads only the bytes a shard needs."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._keys: dict[str, str] = {}
+        for root, _, files in os.walk(path):
+            for f in files:
+                if f.endswith(".npy"):
+                    full = os.path.join(root, f)
+                    rel = os.path.relpath(full, path)[: -len(".npy")]
+                    self._keys[rel.replace(os.sep, "/")] = full
+
+    def keys(self) -> list[str]:
+        return sorted(self._keys)
+
+    def get(self, name: str) -> np.ndarray:
+        return np.load(self._keys[name], mmap_mode="r")
+
+
+class NpzSource:
+    """One ``.npz``: lazy per-key (each array loads whole on first access —
+    fine for per-layer HF weights, documented fallback for giant stacked
+    native trees where the npy-dir container is the right choice)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._z = np.load(path)
+
+    def keys(self) -> list[str]:
+        return sorted(self._z.files)
+
+    def get(self, name: str) -> np.ndarray:
+        return self._z[name]
+
+
+class SafetensorsSource:
+    """``.safetensors`` via ``safe_open`` slicing (lazy per-slice)."""
+
+    def __init__(self, path: str):
+        try:
+            from safetensors import safe_open  # type: ignore
+        except Exception as e:  # pragma: no cover - env without safetensors
+            raise ImportError_(
+                "safetensors is not installed in this image; re-save the "
+                "checkpoint as an npy-dir or npz container") from e
+        self.path = path
+        self._f = safe_open(path, framework="numpy")
+
+    def keys(self) -> list[str]:
+        return sorted(self._f.keys())
+
+    def get(self, name: str) -> np.ndarray:
+        # get_tensor is eager; the per-layer granularity keeps it bounded
+        return self._f.get_tensor(name)
+
+
+def open_source(path: str) -> Any:
+    if os.path.isdir(path):
+        return NpyDirSource(path)
+    if path.endswith(".npz"):
+        return NpzSource(path)
+    if path.endswith(".safetensors"):
+        return SafetensorsSource(path)
+    raise ImportError_(
+        f"cannot open checkpoint source {path!r}: expected a directory of "
+        f".npy files, an .npz, or a .safetensors file")
+
+
+# ---------------------------------------------------------------------------
+# Readers: target path -> shard slices of the (transformed) source
+# ---------------------------------------------------------------------------
+
+
+def _expand_idx(idx: Any, ndim: int) -> tuple:
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    return tuple(idx) + (slice(None),) * (ndim - len(idx))
+
+
+class DirectReader:
+    """Target == one source array, optionally transposed (a view on mmap
+    containers, so the shard slice is the only materialized data)."""
+
+    def __init__(self, source: Any, key: str, shape: tuple,
+                 transpose: Optional[Sequence[int]] = None):
+        self.source, self.key, self.shape = source, key, tuple(shape)
+        self.transpose = tuple(transpose) if transpose is not None else None
+
+    def read(self, idx: Any) -> np.ndarray:
+        arr = self.source.get(self.key)
+        if self.transpose is not None:
+            arr = arr.transpose(self.transpose)
+        if tuple(arr.shape) != self.shape:
+            raise ImportError_(
+                f"source key {self.key!r} has shape {tuple(arr.shape)}, "
+                f"target wants {self.shape}")
+        return np.asarray(arr[_expand_idx(idx, len(self.shape))])
+
+
+class StackedReader:
+    """Target dim 0 stacks per-layer source arrays (the HF -> scan-stacked
+    mapping): the shard's layer range is read layer by layer, each layer
+    transformed (transpose/reshape — views or one per-layer copy) then
+    sliced, so the transient is ONE layer's matrix, never the stack."""
+
+    def __init__(self, per_layer: Sequence[Callable[[], np.ndarray]],
+                 shape: tuple):
+        self.per_layer = list(per_layer)
+        self.shape = tuple(shape)
+
+    def read(self, idx: Any) -> np.ndarray:
+        idx = _expand_idx(idx, len(self.shape))
+        lsl = idx[0] if isinstance(idx[0], slice) else slice(idx[0], idx[0] + 1)
+        layers = range(*lsl.indices(self.shape[0]))
+        parts = [np.asarray(self.per_layer[i]()[idx[1:]]) for i in layers]
+        return np.stack(parts, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Layouts
+# ---------------------------------------------------------------------------
+
+
+def flat_entries(
+    source: Any,
+    abstract: Any,
+    *,
+    key_map: Optional[Sequence[tuple[str, str]]] = None,
+    transpose: Optional[Sequence[tuple[str, Sequence[int]]]] = None,
+) -> dict[str, Any]:
+    """Native flat layout: target path -> source key via optional regex
+    renames, with optional per-key transposes."""
+    key_rules = [(re.compile(p), r) for p, r in (key_map or [])]
+    t_rules = [(re.compile(p), tuple(ax)) for p, ax in (transpose or [])]
+    available = set(source.keys())
+    entries: dict[str, Any] = {}
+    missing: list[str] = []
+    for path, leaf in tree_paths(abstract):
+        key = path
+        for rx, repl in key_rules:
+            if rx.search(key):
+                key = rx.sub(repl, key)
+                break
+        if key not in available:
+            missing.append(f"{path} (source key {key!r})")
+            continue
+        axes = None
+        for rx, perm in t_rules:
+            if rx.search(path):
+                axes = perm
+                break
+        entries[path] = DirectReader(source, key, leaf.shape, transpose=axes)
+    if missing:
+        raise ImportError_(
+            f"{len(missing)} parameter(s) have no source key:\n"
+            + "\n".join(f"  - {m}" for m in missing)
+            + f"\n(source has {len(available)} keys)")
+    return entries
+
+
+def _hf_llama_check(cfg: Any) -> None:
+    problems = []
+    if cfg.norm != "rms":
+        problems.append(f"norm={cfg.norm!r} (HF llama uses rms)")
+    if cfg.act != "swiglu":
+        problems.append(f"act={cfg.act!r} (HF llama uses swiglu)")
+    if cfg.pos != "rope":
+        problems.append(f"pos={cfg.pos!r} (HF llama uses rope)")
+    if cfg.use_bias:
+        problems.append("use_bias=True (HF llama has no biases)")
+    if cfg.tie_embeddings:
+        problems.append("tie_embeddings=True (HF llama has a separate lm_head)")
+    if getattr(cfg, "num_experts", 0):
+        problems.append("num_experts>0 (use the flat layout for MoE trees)")
+    if problems:
+        raise ImportError_(
+            "model config is not HF-llama-shaped: " + "; ".join(problems))
+
+
+def hf_llama_entries(source: Any, cfg: Any, abstract: Any) -> dict[str, Any]:
+    """HF ``LlamaForCausalLM`` layout -> our tree.
+
+    HF stores per-layer fused ``[out_features, in_features]`` projection
+    matrices under ``model.layers.{i}.*``; ours are scan-stacked einsum
+    layouts (``wq: [L, h, nh, hd]`` etc.). RoPE convention note: this
+    runtime rotates half-dim pairs the same way HF's ``rotate_half`` does,
+    so q/k need no head-interleave permutation — layout transforms only.
+    """
+    _hf_llama_check(cfg)
+    h, nh, kvh, hd = cfg.hidden, cfg.num_heads, cfg.kv_heads, cfg.hd
+    L, m = cfg.num_layers, cfg.mlp_dim
+    available = set(source.keys())
+
+    def layer_reader(fmt: str, transform: Callable[[np.ndarray], np.ndarray],
+                     shape: tuple) -> StackedReader:
+        return StackedReader(
+            [(lambda i=i: transform(np.asarray(source.get(fmt.format(i=i)))))
+             for i in range(L)],
+            (L,) + tuple(shape))
+
+    entries: dict[str, Any] = {
+        "embed/tokens": DirectReader(
+            source, "model.embed_tokens.weight", (cfg.vocab_size, h)),
+        "lm_head/w": DirectReader(
+            source, "lm_head.weight", (h, cfg.vocab_size), transpose=(1, 0)),
+        "final_norm/scale": DirectReader(source, "model.norm.weight", (h,)),
+        "layers/attn_norm/scale": layer_reader(
+            "model.layers.{i}.input_layernorm.weight", lambda a: a, (h,)),
+        "layers/mlp_norm/scale": layer_reader(
+            "model.layers.{i}.post_attention_layernorm.weight",
+            lambda a: a, (h,)),
+        "layers/attn/wq": layer_reader(
+            "model.layers.{i}.self_attn.q_proj.weight",
+            lambda a: a.T.reshape(h, nh, hd), (h, nh, hd)),
+        "layers/attn/wk": layer_reader(
+            "model.layers.{i}.self_attn.k_proj.weight",
+            lambda a: a.T.reshape(h, kvh, hd), (h, kvh, hd)),
+        "layers/attn/wv": layer_reader(
+            "model.layers.{i}.self_attn.v_proj.weight",
+            lambda a: a.T.reshape(h, kvh, hd), (h, kvh, hd)),
+        "layers/attn/wo": layer_reader(
+            "model.layers.{i}.self_attn.o_proj.weight",
+            lambda a: a.T.reshape(nh, hd, h), (nh, hd, h)),
+        "layers/mlp/wi": layer_reader(
+            "model.layers.{i}.mlp.up_proj.weight", lambda a: a.T, (h, m)),
+        "layers/mlp/wg": layer_reader(
+            "model.layers.{i}.mlp.gate_proj.weight", lambda a: a.T, (h, m)),
+        "layers/mlp/wo": layer_reader(
+            "model.layers.{i}.mlp.down_proj.weight", lambda a: a.T, (m, h)),
+    }
+    target_paths = {p for p, _ in tree_paths(abstract)}
+    if target_paths != set(entries):
+        extra = sorted(set(entries) - target_paths)
+        miss = sorted(target_paths - set(entries))
+        raise ImportError_(
+            f"hf-llama layout does not cover this tree (missing {miss}, "
+            f"unexpected {extra})")
+    needed = {"model.embed_tokens.weight", "lm_head.weight",
+              "model.norm.weight"}
+    for i in range(L):
+        for k in ("input_layernorm.weight", "post_attention_layernorm.weight",
+                  "self_attn.q_proj.weight", "self_attn.k_proj.weight",
+                  "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+                  "mlp.up_proj.weight", "mlp.gate_proj.weight",
+                  "mlp.down_proj.weight"):
+            needed.add(f"model.layers.{i}.{k}")
+    missing = sorted(needed - available)
+    if missing:
+        raise ImportError_(
+            f"{len(missing)} HF llama key(s) missing from the source "
+            f"(first few): {missing[:8]}")
+    return entries
+
+
+def detect_layout(source: Any) -> str:
+    keys = source.keys()
+    if any(k.startswith("model.embed_tokens") for k in keys):
+        return "hf-llama"
+    return "flat"
+
+
+# ---------------------------------------------------------------------------
+# Import / export
+# ---------------------------------------------------------------------------
+
+
+def import_params(
+    source: Any,
+    cfg: Any,
+    mesh: Mesh,
+    *,
+    layout: str = "auto",
+    rules: Optional[Sequence[tuple[str, Any]]] = None,
+    shardings: Optional[Any] = None,
+    dtype: Optional[Any] = None,
+    key_map: Optional[Sequence[tuple[str, str]]] = None,
+    transpose: Optional[Sequence[tuple[str, Sequence[int]]]] = None,
+) -> Any:
+    """Ingest a foreign param source directly into sharded device buffers.
+
+    ``shardings`` (a NamedSharding pytree matching the target tree) wins
+    when given — the Trainer hands its resolved (user-rule-overlaid)
+    shardings here; otherwise specs come from ``rules`` (default: the
+    model's built-in set) through the rule engine. ``dtype`` casts every
+    floating leaf per-shard (bf16 serving imports of f32 checkpoints).
+    """
+    if isinstance(source, str):
+        source = open_source(source)
+    from ..models.transformer import TransformerConfig
+
+    if not isinstance(cfg, TransformerConfig):
+        raise ImportError_(
+            f"import targets transformer-family models; got "
+            f"{type(cfg).__name__}")
+    from .builtins import abstract_params_for_config
+
+    abstract = abstract_params_for_config("lm", cfg)
+    if dtype is not None:
+        dtype = np.dtype(jax.numpy.dtype(dtype))
+        abstract = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(
+                l.shape,
+                dtype if np.issubdtype(l.dtype, np.floating) else l.dtype),
+            abstract)
+    if shardings is None:
+        rules = rules if rules is not None else rules_for_config("lm", cfg)
+        specs = match_partition_rules(rules, abstract)
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+
+    if layout == "auto":
+        layout = detect_layout(source)
+    if layout == "hf-llama":
+        entries = hf_llama_entries(source, cfg, abstract)
+    elif layout == "flat":
+        entries = flat_entries(source, abstract, key_map=key_map,
+                               transpose=transpose)
+    else:
+        raise ImportError_(
+            f"unknown import layout {layout!r}; valid: flat | hf-llama")
+
+    def _materialize(path, leaf, sharding):
+        reader = entries[path_str(path)]
+        dt = leaf.dtype
+
+        def cb(idx):
+            return np.asarray(reader.read(idx)).astype(dt)
+
+        return jax.make_array_from_callback(leaf.shape, sharding, cb)
+
+    return jax.tree_util.tree_map_with_path(_materialize, abstract, shardings)
+
+
+def save_flat(tree_or_dict: Any, path: str) -> list[str]:
+    """Write a param tree (or flat name->array dict) as an npy-dir
+    container. Native '/'-joined paths become subdirectories; HF dotted
+    keys are plain filenames. Returns the keys written."""
+    if isinstance(tree_or_dict, dict) and all(
+            not isinstance(v, dict) for v in tree_or_dict.values()):
+        flat = dict(tree_or_dict)
+    else:
+        flat = {p: leaf for p, leaf in tree_paths(tree_or_dict)}
+    written = []
+    for key, arr in flat.items():
+        full = os.path.join(path, *key.split("/")) + ".npy"
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        np.save(full, np.asarray(arr))
+        written.append(key)
+    return sorted(written)
+
+
+def export_hf_llama(params: Any, cfg: Any, path: str) -> list[str]:
+    """Inverse of the hf-llama import mapping: write this runtime's param
+    tree as an HF ``LlamaForCausalLM``-layout npy-dir (per-layer fused
+    ``[out, in]`` matrices, HF key names). The round trip through
+    :func:`import_params` is identity (tested to fp32 tolerance)."""
+    _hf_llama_check(cfg)
+    h, nh, kvh, hd = cfg.hidden, cfg.num_heads, cfg.kv_heads, cfg.hd
+    L, m = cfg.num_layers, cfg.mlp_dim
+    p = jax.tree.map(np.asarray, params)
+    out: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": p["embed"]["tokens"],
+        "lm_head.weight": p["lm_head"]["w"].T,
+        "model.norm.weight": p["final_norm"]["scale"],
+    }
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        att, mlp = p["layers"]["attn"], p["layers"]["mlp"]
+        out[pre + "input_layernorm.weight"] = \
+            p["layers"]["attn_norm"]["scale"][i]
+        out[pre + "post_attention_layernorm.weight"] = \
+            p["layers"]["mlp_norm"]["scale"][i]
+        out[pre + "self_attn.q_proj.weight"] = \
+            att["wq"][i].reshape(h, nh * hd).T
+        out[pre + "self_attn.k_proj.weight"] = \
+            att["wk"][i].reshape(h, kvh * hd).T
+        out[pre + "self_attn.v_proj.weight"] = \
+            att["wv"][i].reshape(h, kvh * hd).T
+        out[pre + "self_attn.o_proj.weight"] = \
+            att["wo"][i].reshape(nh * hd, h).T
+        out[pre + "mlp.up_proj.weight"] = mlp["wi"][i].T
+        out[pre + "mlp.gate_proj.weight"] = mlp["wg"][i].T
+        out[pre + "mlp.down_proj.weight"] = mlp["wo"][i].T
+    return save_flat(out, path)

@@ -1,0 +1,256 @@
+"""LoRA adapters — the first new-workload consumer of the rule engine.
+
+A ``lora:`` spec block (``{rank, alpha, target}``) adds low-rank adapter
+pairs next to a frozen base tree: ``params = {"base": ..., "lora": ...}``
+where each targeted weight ``w`` (selected by the ``target`` regex over the
+same /-joined paths the partition rules match) gets ``a: [L?, fan_in, r]``
+and ``b: [L?, r, fan_out]`` with the effective weight
+``w + (alpha/rank) * (a @ b).reshape(w.shape)``. ``b`` initializes to zero
+so step 0 is exactly the base model.
+
+Only the adapters train: :func:`frozen_base_optimizer` wraps any optax
+transformation with ``multi_transform`` so the base subtree gets
+``set_to_zero`` (and no optimizer moments). The adapters ride the partition
+engine under the ``lora/`` path prefix (replicated by default —
+``builtins.LORA_RULES`` — user ``partition_rules`` can re-shard them).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..train.tasks import Task
+from .rules import tree_paths
+
+DEFAULT_TARGET = r"attn/(wq|wk|wv|wo)$"
+
+# How a matched weight's dims split into (fan_in, fan_out), AFTER an
+# optional leading scan-stacked layers dim: n_in trailing-side split point.
+# Table-driven (not "last dim is out") because attention weights keep their
+# einsum layouts: wq is [L, in=h, out=(heads, hd)], wo is [L, in=(heads,
+# hd), out=h].
+_SPLIT_TABLE: tuple[tuple[str, int], ...] = (
+    (r"attn/w[qkv]$", 1),
+    (r"attn/wo$", 2),
+    (r"mlp/(wi|wg)$", 1),
+    (r"mlp/wo$", 1),
+    (r"(lm_head|head)/w$", 1),
+)
+_LEAD_RX = re.compile(r"(^|/)layers/")
+
+
+class LoRATargetError(ValueError):
+    """The ``target`` regex selects a weight LoRA cannot factor (no
+    fan-in/fan-out split is defined for it) or selects nothing."""
+
+
+@dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    target: str = DEFAULT_TARGET
+    init_scale: float = 0.02  # stddev of the `a` init; `b` starts at zero
+
+    @classmethod
+    def from_spec(cls, spec: Any) -> "LoRAConfig":
+        if spec is True:
+            return cls()
+        if not isinstance(spec, dict):
+            raise LoRATargetError(
+                f"lora spec must be a mapping (rank/alpha/target), got "
+                f"{spec!r}")
+        return cls(
+            rank=int(spec.get("rank", 8)),
+            alpha=float(spec.get("alpha", 16.0)),
+            target=str(spec.get("target", DEFAULT_TARGET)),
+            init_scale=float(spec.get("init_scale", 0.02)),
+        )
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / max(self.rank, 1)
+
+
+def _split_point(path: str) -> Optional[int]:
+    for pattern, n_in in _SPLIT_TABLE:
+        if re.search(pattern, path):
+            return n_in
+    return None
+
+
+def target_paths(base_tree: Any, cfg: LoRAConfig) -> list[tuple[str, int, int]]:
+    """``[(path, lead, n_in)]`` for every base leaf the target regex
+    selects. Raises loudly when the regex matches nothing or matches a
+    weight with no known factorization (satellite: errors carry the paths,
+    not a mid-init shape explosion)."""
+    try:
+        rx = re.compile(cfg.target)
+    except re.error as e:
+        raise LoRATargetError(
+            f"lora target regex {cfg.target!r} does not compile: {e}") from e
+    out: list[tuple[str, int, int]] = []
+    unsupported: list[str] = []
+    for path, leaf in tree_paths(base_tree):
+        if not rx.search(path):
+            continue
+        n_in = _split_point(path)
+        if n_in is None:
+            unsupported.append(path)
+            continue
+        lead = 1 if _LEAD_RX.search(path) else 0
+        if len(leaf.shape) <= lead + n_in:
+            unsupported.append(path)
+            continue
+        out.append((path, lead, n_in))
+    if unsupported:
+        raise LoRATargetError(
+            f"lora target {cfg.target!r} selects weight(s) with no known "
+            f"fan-in/fan-out factorization: {unsupported}")
+    if not out:
+        paths = [p for p, _ in tree_paths(base_tree)]
+        from .rules import nearest_paths
+
+        raise LoRATargetError(
+            f"lora target {cfg.target!r} matches no parameter; nearest "
+            f"param paths: {nearest_paths(cfg.target, paths)}")
+    return out
+
+
+def _fan_shapes(shape: tuple, lead: int, n_in: int,
+                rank: int) -> tuple[tuple, tuple]:
+    lead_dims = shape[:lead]
+    fan_in = 1
+    for d in shape[lead:lead + n_in]:
+        fan_in *= d
+    fan_out = 1
+    for d in shape[lead + n_in:]:
+        fan_out *= d
+    return lead_dims + (fan_in, rank), lead_dims + (rank, fan_out)
+
+
+def _set_path(tree: dict, path: str, value: Any) -> None:
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = value
+
+
+def _get_path(tree: Any, path: str) -> Any:
+    node = tree
+    for part in path.split("/"):
+        node = node[part]
+    return node
+
+
+def init_lora(key: jax.Array, base_tree: Any, cfg: LoRAConfig,
+              dtype: Any = jnp.float32) -> dict:
+    """Adapter tree mirroring the targeted base leaves: for base path
+    ``layers/attn/wq`` the adapters live at ``layers/attn/wq/a`` and
+    ``.../b`` (under the task's ``lora`` branch, so the full param paths
+    are ``lora/layers/attn/wq/a`` — matched by ``builtins.LORA_RULES``)."""
+    targets = target_paths(base_tree, cfg)
+    keys = jax.random.split(key, max(len(targets), 1))
+    out: dict = {}
+    for k, (path, lead, n_in) in zip(keys, targets):
+        shape = tuple(_get_path(base_tree, path).shape)
+        a_shape, b_shape = _fan_shapes(shape, lead, n_in, cfg.rank)
+        a = jax.random.truncated_normal(
+            k, -2, 2, a_shape, jnp.float32) * cfg.init_scale
+        _set_path(out, path, {
+            "a": a.astype(dtype),
+            "b": jnp.zeros(b_shape, dtype),
+        })
+    return out
+
+
+def merge_lora(base: Any, lora: dict, cfg: LoRAConfig) -> Any:
+    """Functionally apply the adapter deltas onto the base tree (base is
+    never mutated — the optimizer keeps it frozen; merge happens per step
+    inside jit, where XLA fuses the rank-r outer product into the consumer
+    matmul)."""
+    flat = dict(tree_paths(lora))
+    # tree_map rebuilds every container node, so mutating the copy's dicts
+    # never aliases the caller's base tree
+    merged = jax.tree_util.tree_map(lambda x: x, base)
+    adapters = {p.rsplit("/", 1)[0] for p in flat}
+    for parent in sorted(adapters):
+        a, b = flat[parent + "/a"], flat[parent + "/b"]
+        w = _get_path(base, parent)
+        if a.ndim == 3:
+            delta = jnp.einsum("lir,lro->lio", a, b)
+        else:
+            delta = a @ b
+        new_w = w + (cfg.scaling * delta).reshape(w.shape).astype(w.dtype)
+        _set_path(merged, parent, new_w)
+    return merged
+
+
+def frozen_base_optimizer(inner: optax.GradientTransformation
+                          ) -> optax.GradientTransformation:
+    """Train only the ``lora`` subtree: the base gets ``set_to_zero`` (and,
+    via multi_transform's masking, no optimizer moments — a 7B base costs
+    zero optimizer HBM)."""
+
+    def labels(params):
+        return {
+            "base": jax.tree.map(lambda _: "freeze", params["base"]),
+            "lora": jax.tree.map(lambda _: "train", params["lora"]),
+        }
+
+    return optax.multi_transform(
+        {"train": inner, "freeze": optax.set_to_zero()}, labels)
+
+
+class LoRATask(Task):
+    """Wrap a transformer-family Task: params become ``{"base", "lora"}``,
+    the loss runs the inner task on the merged weights, and the partition
+    engine shards base params with the model's rule set while adapters
+    replicate (LORA_RULES)."""
+
+    def __init__(self, inner: Task, cfg: LoRAConfig):
+        self.inner = inner
+        self.cfg = cfg
+        self.default_data_kind = inner.default_data_kind
+
+    def init(self, key):
+        k_base, k_lora = jax.random.split(key)
+        base, extra = self.inner.init(k_base)
+        lora = init_lora(k_lora, base, self.cfg)
+        return {"base": base, "lora": lora}, extra
+
+    def _abstract(self):
+        return jax.eval_shape(
+            lambda k: self.init(k)[0], jax.ShapeDtypeStruct((2,), "uint32"))
+
+    def param_specs(self, rules):
+        from jax.sharding import PartitionSpec as P
+
+        abstract = self._abstract()
+        return {
+            "base": self.inner.param_specs(rules),
+            "lora": jax.tree.map(lambda _: P(), abstract["lora"]),
+        }
+
+    def extra_specs(self, rules):
+        return self.inner.extra_specs(rules)
+
+    def loss(self, params, extra, batch, *, mesh=None, interpret=None):
+        merged = merge_lora(params["base"], params["lora"], self.cfg)
+        return self.inner.loss(merged, extra, batch, mesh=mesh,
+                               interpret=interpret)
+
+    def tokens_per_step(self, batch_size, seq_len):
+        return self.inner.tokens_per_step(batch_size, seq_len)
+
+    def flops_per_token(self, seq_len):
+        return self.inner.flops_per_token(seq_len)
+
+    def batch_spec(self):
+        return self.inner.batch_spec()

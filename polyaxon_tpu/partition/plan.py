@@ -1,0 +1,350 @@
+"""Partition planning, rule-coverage audit, and compile-time validation.
+
+Three consumers of the same resolution:
+
+- ``polyaxon partition plan <polyaxonfile>`` (cli/main.py) prints the
+  resolved param -> PartitionSpec table + per-device bytes BEFORE launch;
+- the builtin runtime mirrors the summary (param count, bytes/device, axes
+  used) into run outputs for the dashboard;
+- ``python -m polyaxon_tpu.partition`` (scripts/ci.sh gate) audits that
+  every built-in model's FULL param tree is matched by its shipped rule
+  set AND that the engine reproduces the legacy logical-axis specs exactly
+  — a model edit can't silently fall back to replicated.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..parallel.mesh import MESH_AXES, normalize_axis_sizes
+from .builtins import (
+    LORA_RULES,
+    abstract_params_for_config,
+    rules_for_config,
+)
+from .rules import (
+    RuleSyntaxError,
+    UnmatchedParamError,
+    match_partition_rules,
+    normalize_spec,
+    overlay_partition_rules,
+    parse_rules,
+    spec_axes,
+    specs_equivalent,
+    tree_paths,
+    validate_rules_against,
+)
+
+
+def plan_axis_sizes(parallelism: Any, num_devices: Optional[int]) -> dict[str, int]:
+    """Mirror build_mesh's capacity absorption so the plan's shard factors
+    match what the runtime will actually build: unspecified capacity folds
+    into ``data`` when the device count is known."""
+    sizes = normalize_axis_sizes(parallelism)
+    declared = math.prod(sizes.values())
+    if num_devices and num_devices % declared == 0 \
+            and num_devices // declared > 1 and sizes["data"] == 1:
+        sizes["data"] = num_devices // declared
+    return sizes
+
+
+def _shard_factor(spec: Any, sizes: dict[str, int]) -> int:
+    return math.prod(sizes.get(ax, 1) for ax in spec_axes(spec))
+
+
+def _spec_str(spec: Any) -> str:
+    entries = normalize_spec(spec)
+    if not entries:
+        return "replicated"
+    return "(" + ", ".join(
+        "+".join(e) if e is not None else "-" for e in entries) + ")"
+
+
+def build_plan(
+    model: str,
+    *,
+    parallelism: Any = None,
+    num_devices: Optional[int] = None,
+    num_slices: int = 1,
+    partition_rules: Any = None,
+    lora: Any = None,
+) -> dict:
+    """Resolve the full param -> PartitionSpec table for a model + mesh
+    WITHOUT building the mesh or touching an accelerator. Returns
+    ``{"rows": [...], "summary": {...}}`` (JSON-able — the CLI renders the
+    table, the runtime logs the summary)."""
+    from ..models import REGISTRY
+
+    if model not in REGISTRY:
+        raise KeyError(
+            f"unknown model {model!r}; available: {sorted(REGISTRY)}")
+    family, cfg = REGISTRY[model]
+    abstract = abstract_params_for_config(family, cfg)
+    base_rules = rules_for_config(family, cfg)
+    if lora:
+        from .lora import LoRAConfig, init_lora
+
+        lcfg = LoRAConfig.from_spec(lora)
+        lora_abstract = jax.eval_shape(
+            lambda k: init_lora(k, abstract, lcfg),
+            jax.ShapeDtypeStruct((2,), "uint32"))
+        abstract = {"base": abstract, "lora": lora_abstract}
+        # adapters match "^lora/..." first; the model set's unanchored
+        # patterns match straight through the "base/" prefix
+        base_rules = LORA_RULES + base_rules
+    specs = match_partition_rules(base_rules, abstract)
+    user_rules = parse_rules(partition_rules) if partition_rules else ()
+    if user_rules:
+        specs = overlay_partition_rules(user_rules, abstract, specs)
+
+    sizes = plan_axis_sizes(parallelism, num_devices)
+    rows = []
+    total_params = 0
+    total_bytes = 0
+    shard_bytes = 0
+    axes_used: set[str] = set()
+    for (path, leaf), (_, spec) in zip(tree_paths(abstract),
+                                       tree_paths(specs, is_leaf=_is_spec)):
+        n = int(math.prod(leaf.shape)) if leaf.shape else 1
+        nbytes = n * np.dtype(leaf.dtype).itemsize
+        factor = _shard_factor(spec, sizes)
+        rows.append({
+            "param": path,
+            "shape": list(leaf.shape),
+            "dtype": str(np.dtype(leaf.dtype)),
+            "spec": _spec_str(spec),
+            "bytes": nbytes,
+            "bytes_per_device": nbytes // factor,
+        })
+        total_params += n
+        total_bytes += nbytes
+        shard_bytes += nbytes // factor
+        axes_used.update(ax for ax in spec_axes(spec) if sizes.get(ax, 1) > 1)
+    return {
+        "rows": rows,
+        "summary": {
+            "model": model,
+            "num_params": total_params,
+            "num_tensors": len(rows),
+            "total_bytes": total_bytes,
+            "bytes_per_device": shard_bytes,
+            "axes_used": sorted(axes_used),
+            "axis_sizes": {k: v for k, v in sizes.items() if v > 1},
+            "num_devices": num_devices,
+            "num_slices": num_slices,
+            "user_rules": len(user_rules),
+        },
+    }
+
+
+def _is_spec(x: Any) -> bool:
+    from jax.sharding import PartitionSpec
+
+    return isinstance(x, PartitionSpec)
+
+
+def format_plan(plan: dict) -> str:
+    rows = plan["rows"]
+    s = plan["summary"]
+    w_path = max([len(r["param"]) for r in rows] + [5])
+    w_shape = max([len(str(tuple(r["shape"]))) for r in rows] + [5])
+    w_spec = max([len(r["spec"]) for r in rows] + [4])
+    lines = [
+        f"{'param':<{w_path}}  {'shape':<{w_shape}}  {'dtype':<8}  "
+        f"{'spec':<{w_spec}}  {'bytes/device':>12}",
+        "-" * (w_path + w_shape + w_spec + 36),
+    ]
+    for r in rows:
+        lines.append(
+            f"{r['param']:<{w_path}}  {str(tuple(r['shape'])):<{w_shape}}  "
+            f"{r['dtype']:<8}  {r['spec']:<{w_spec}}  "
+            f"{r['bytes_per_device']:>12,}")
+    lines.append("-" * (w_path + w_shape + w_spec + 36))
+    axis = ", ".join(f"{k}={v}" for k, v in s["axis_sizes"].items()) or "none"
+    lines.append(
+        f"{s['model']}: {s['num_params']:,} params in {s['num_tensors']} "
+        f"tensors; {s['total_bytes']:,} bytes total, "
+        f"{s['bytes_per_device']:,} bytes/device "
+        f"(mesh axes {axis}; sharded over {s['axes_used'] or ['nothing']}"
+        f"; {s['num_slices']} slice(s))")
+    return "\n".join(lines)
+
+
+def plan_summary_from_shardings(abstract: Any, shardings: Any,
+                                mesh: Any) -> dict:
+    """The runtime-side mirror: summarize the Trainer's RESOLVED param
+    shardings (built-ins + user overlay, post-pipeline adjustments) so run
+    outputs show what actually launched, not a re-derivation."""
+    sizes = dict(mesh.shape)
+    total_params = 0
+    total_bytes = 0
+    shard_bytes = 0
+    axes_used: set[str] = set()
+    for (path, leaf), (_, sh) in zip(tree_paths(abstract),
+                                     tree_paths(shardings)):
+        n = int(math.prod(leaf.shape)) if leaf.shape else 1
+        nbytes = n * np.dtype(leaf.dtype).itemsize
+        spec = sh.spec
+        factor = _shard_factor(spec, sizes)
+        total_params += n
+        total_bytes += nbytes
+        shard_bytes += nbytes // factor
+        axes_used.update(ax for ax in spec_axes(spec) if sizes.get(ax, 1) > 1)
+    return {
+        "num_params": total_params,
+        "total_bytes": total_bytes,
+        "bytes_per_device": shard_bytes,
+        "axes_used": sorted(axes_used),
+        "num_devices": int(getattr(mesh, "size", 1)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Compile-time validation (converter._render_builtin)
+# ---------------------------------------------------------------------------
+
+_PARTITION_KEYS = ("partition_rules", "lora", "import")
+
+
+def needs_validation(builtin: dict) -> bool:
+    return any(k in builtin for k in _PARTITION_KEYS)
+
+
+def validate_builtin_spec(builtin: dict) -> None:
+    """Validate a builtin-runtime spec's partition/lora/import blocks at
+    COMPILE time: rule-syntax errors carry the offending regex, rules that
+    match nothing carry the nearest real param paths, and full-tree
+    coverage is re-checked — so every failure mode lands in the compile
+    error channel, never as a mid-init traceback in the pod."""
+    from ..models import REGISTRY
+
+    model = builtin.get("model", "llama-tiny")
+    if model not in REGISTRY:
+        raise RuleSyntaxError(
+            f"partition validation: unknown model {model!r}; available: "
+            f"{sorted(REGISTRY)}")
+    family, cfg = REGISTRY[model]
+    abstract = abstract_params_for_config(family, cfg)
+
+    lora_spec = builtin.get("lora")
+    if lora_spec:
+        from .lora import LoRAConfig, init_lora
+
+        if family not in ("lm", "mlm"):
+            raise RuleSyntaxError(
+                f"lora: is only supported for transformer LM/MLM models; "
+                f"{model!r} is family {family!r}")
+        lcfg = LoRAConfig.from_spec(lora_spec)
+        # raises LoRATargetError (with nearest paths) on a bad target
+        lora_abstract = jax.eval_shape(
+            lambda k: init_lora(k, abstract, lcfg),
+            jax.ShapeDtypeStruct((2,), "uint32"))
+        abstract = {"base": abstract, "lora": lora_abstract}
+
+    imp = builtin.get("import")
+    if imp is not None:
+        if not isinstance(imp, dict) or not imp.get("path"):
+            raise RuleSyntaxError(
+                "import: must be a mapping with at least a 'path' key")
+        if family not in ("lm", "mlm"):
+            raise RuleSyntaxError(
+                f"import: is only supported for transformer LM/MLM models; "
+                f"{model!r} is family {family!r}")
+        layout = imp.get("layout", "auto")
+        if layout not in ("auto", "flat", "hf-llama"):
+            raise RuleSyntaxError(
+                f"import: unknown layout {layout!r}; valid: auto | flat | "
+                f"hf-llama")
+        if layout == "hf-llama":
+            from .convert import ImportError_, _hf_llama_check
+
+            try:
+                _hf_llama_check(cfg)
+            except ImportError_ as e:
+                raise RuleSyntaxError(f"import: {e}") from e
+        if imp.get("dtype") is not None:
+            import numpy as _np
+
+            try:
+                _np.dtype(jax.numpy.dtype(imp["dtype"]))
+            except TypeError as e:
+                raise RuleSyntaxError(
+                    f"import: unknown dtype {imp['dtype']!r}") from e
+        import re as _re
+
+        for field, second in (("key_map", "replacement"),
+                              ("transpose", "axis list")):
+            for entry in imp.get(field) or []:
+                if not isinstance(entry, (list, tuple)) or len(entry) != 2:
+                    raise RuleSyntaxError(
+                        f"import: {field} entry {entry!r} must be a "
+                        f"[regex, {second}] pair")
+                pattern = entry[0]
+                try:
+                    _re.compile(pattern)
+                except _re.error as e:
+                    raise RuleSyntaxError(
+                        f"import: {field} regex {pattern!r} does not "
+                        f"compile: {e}", rule=pattern) from e
+                if field == "transpose" and (
+                        not isinstance(entry[1], (list, tuple))
+                        or not all(isinstance(a, int) for a in entry[1])):
+                    raise RuleSyntaxError(
+                        f"import: transpose axes {entry[1]!r} must be a "
+                        f"list of ints")
+
+    raw_rules = builtin.get("partition_rules")
+    if raw_rules:
+        user_rules = parse_rules(raw_rules)  # RuleSyntaxError w/ regex
+        validate_rules_against(user_rules, tree_paths(abstract))
+
+
+# ---------------------------------------------------------------------------
+# Rule-coverage audit (ci gate)
+# ---------------------------------------------------------------------------
+
+
+def audit(models: Optional[Sequence[str]] = None) -> dict[str, dict]:
+    """For every built-in model: (a) the shipped rule set matches the FULL
+    param tree (UnmatchedParamError otherwise — no silent replicate
+    fallback), and (b) the engine's specs are EQUIVALENT to the legacy
+    logical-axis Task specs (parity drift otherwise). Returns a per-model
+    report; raises on the first failing model."""
+    from ..models import REGISTRY
+    from ..parallel.mesh import ShardingRules
+    from ..train.tasks import task_for
+
+    report: dict[str, dict] = {}
+    for name in sorted(models or REGISTRY):
+        family, cfg = REGISTRY[name]
+        abstract = abstract_params_for_config(family, cfg)
+        rules = rules_for_config(family, cfg)
+        specs = match_partition_rules(rules, abstract)  # raises on gaps
+        oracle = task_for(family, cfg).param_specs(ShardingRules())
+        drift = []
+        for (path, _), (_, got), (_, want) in zip(
+                tree_paths(abstract),
+                tree_paths(specs, is_leaf=_is_spec),
+                tree_paths(oracle, is_leaf=_is_spec)):
+            if not specs_equivalent(got, want):
+                drift.append(
+                    f"{path}: engine {_spec_str(got)} != "
+                    f"legacy {_spec_str(want)}")
+        if drift:
+            _raise_drift(name, drift)
+        report[name] = {
+            "params": len(tree_paths(abstract)),
+            "rules": len(rules),
+            "status": "ok",
+        }
+    return report
+
+
+def _raise_drift(name: str, drift: list[str]) -> None:
+    raise AssertionError(
+        f"partition audit: {name} engine specs drifted from the legacy "
+        f"logical-axis specs:\n" + "\n".join(f"  - {d}" for d in drift))

@@ -9,6 +9,13 @@ Spec keys:
     model: registry name (e.g. "llama2-7b", "llama-tiny", "vit-b16", ...)
     steps, batch_size, seq_len, learning_rate, warmup_steps, schedule,
     optimizer, remat, parallelism {data,fsdp,model,context,expert,stage},
+    num_slices (multislice mesh: slice-major device order, data/fsdp over
+    DCN — injected from the tpujob topology by the compiler),
+    partition_rules ([[regex, spec], ...] — override/extend the built-in
+    partition rule sets; compile-time validated; docs/PARTITIONING.md),
+    import ({path, layout: auto|flat|hf-llama, dtype} — foreign-checkpoint
+    ingest through the rule engine, straight into sharded buffers),
+    lora ({rank, alpha, target} — freeze the base, train adapters),
     pp_microbatches / pp_remat_ticks (pipeline schedule: microbatch count,
     1F1B-style O(stages) activation stash),
     data {kind, path, ...}, checkpoint {save_interval_steps, max_to_keep},
@@ -151,6 +158,10 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
     # `watchdog: false` disables, `watchdog: {min_s: ..}` tunes
     wd_spec = spec.get("watchdog", True)
     wd_kw = wd_spec if isinstance(wd_spec, dict) else {}
+    # multislice (ROADMAP item 3): the compiler injects num_slices from the
+    # tpujob topology; MEGASCALE env is the fallback for hand-built specs
+    num_slices = int(spec.get("num_slices",
+                              os.environ.get("MEGASCALE_NUM_SLICES", 1)))
     tcfg = TrainerConfig(
         model=mcfg,
         optimizer=OptimizerConfig(
@@ -165,6 +176,7 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
         batch_size=batch_size,
         seq_len=seq_len,
         parallelism=spec.get("parallelism"),
+        num_slices=num_slices,
         checkpoint=ckpt,
         log_interval=int(spec.get("log_interval", 10)),
         grad_dtype=spec.get("grad_dtype"),
@@ -232,13 +244,53 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
             run.log_line(line)
             print(line, flush=True)
 
+    # -- partition engine wiring (ISSUE 13) ---------------------------------
+    # `lora:` wraps the task (frozen base + trainable adapters, optimizer
+    # masked so the base costs zero moments); `partition_rules:` overlay
+    # the built-in specs inside the Trainer; `import:` lands a foreign
+    # checkpoint directly in sharded buffers after the mesh exists.
+    lora_spec = spec.get("lora")
+    import_spec = spec.get("import")
+    partition_rules = spec.get("partition_rules")
+    tx = None
+    lora_cfg = None
+    if lora_spec:
+        if family not in ("lm", "mlm"):
+            raise SystemExit(
+                f"lora: is only supported for LM/MLM models (got {family})")
+        from ..partition.lora import LoRAConfig, LoRATask, frozen_base_optimizer
+        from ..train import make_optimizer
+
+        lora_cfg = LoRAConfig.from_spec(lora_spec)
+        task = LoRATask(task, lora_cfg)
+        tx = frozen_base_optimizer(make_optimizer(tcfg.optimizer))
+
     # pod-side spans (ISSUE 5 tentpole (a)): first-step compile, train
     # window, checkpoint saves join the control-plane lifecycle timeline
     # through the trace id tracking picked up from POLYAXON_TRACE_ID
     trainer = Trainer(tcfg, task=task, track=track,
                       on_span=run.log_span if run is not None else None,
                       chaos=chaos, on_progress=on_progress,
-                      on_stalled=on_stalled, log_line=log_line)
+                      on_stalled=on_stalled, log_line=log_line,
+                      partition_rules=partition_rules, tx=tx)
+
+    if run is not None:
+        # partition-plan mirror (ISSUE 13 satellite): the same summary
+        # `polyaxon partition plan` prints pre-launch, computed from the
+        # trainer's RESOLVED shardings, lands in run outputs for the
+        # dashboard — param count, bytes/device, axes actually used
+        try:
+            from ..partition import plan_summary_from_shardings
+
+            abstract = jax.eval_shape(
+                lambda k: trainer.task.init(k)[0], jax.random.PRNGKey(0))
+            psum = plan_summary_from_shardings(
+                abstract, trainer.param_shardings, trainer.mesh)
+            psum["num_slices"] = num_slices
+            run.log_outputs(partition_plan=psum)
+        except Exception as e:  # never fail a run over a dashboard mirror
+            print(f"[builtin] partition plan summary skipped: {e}",
+                  flush=True)
 
     data_spec = dict(spec.get("data") or {})
     data_kwargs: dict[str, Any] = {}
@@ -267,8 +319,51 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
     # replays 100k batches before training.
     from ..train.data import skip_batches
 
+    # foreign-checkpoint import (ISSUE 13): ingest into sharded device
+    # buffers through the rule engine — the Trainer's RESOLVED shardings
+    # (built-ins + user overlay) decide placement, so a 7B tree never
+    # materializes unsharded on one host. A latest complete checkpoint
+    # still wins inside restore_or_init (resume beats re-import).
+    init_params = None
+    if import_spec and trainer.checkpointer is not None \
+            and trainer.checkpointer.latest_complete_step() is not None:
+        # resume beats re-import: a restarted attempt must not pay the
+        # full foreign-tree read (minutes of I/O at 7B) only for
+        # restore_or_init to overwrite it with the checkpoint
+        print("[builtin] complete checkpoint found; skipping import",
+              flush=True)
+        import_spec = None
+    if import_spec:
+        if family not in ("lm", "mlm"):
+            raise SystemExit(
+                f"import: is only supported for LM/MLM models (got {family})")
+        from ..partition import convert as pconvert
+
+        base_shardings = (trainer.param_shardings["base"]
+                          if lora_cfg is not None else trainer.param_shardings)
+        imported = pconvert.import_params(
+            import_spec["path"], mcfg, trainer.mesh,
+            layout=import_spec.get("layout", "auto"),
+            shardings=base_shardings,
+            dtype=import_spec.get("dtype"),
+            key_map=import_spec.get("key_map"),
+            transpose=import_spec.get("transpose"),
+        )
+        if lora_cfg is not None:
+            from ..partition.lora import init_lora
+
+            adapters = init_lora(
+                jax.random.PRNGKey(int(spec.get("seed", 0))),
+                jax.tree.map(
+                    lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
+                    imported),
+                lora_cfg)
+            init_params = {"base": imported, "lora": adapters}
+        else:
+            init_params = imported
+
     t_restore = time.time()
-    state, start_step = trainer.restore_or_init()
+    state, start_step = trainer.restore_or_init(init_params=init_params)
     if run is not None:
         # zero-length-ish on a fresh start; on a resumed attempt this is
         # the checkpoint-read cost the timeline should surface

@@ -144,6 +144,10 @@ class _KFJob(_BaseRun):
     # upstream's Kubeflow workloads (DDP/TF/Horovod) become mesh configs of
     # the owned runtime (SURVEY.md §7 stage 4)
     runtime: Optional[dict[str, Any]] = None
+    # Declarative sharding overrides (docs/PARTITIONING.md): ordered
+    # [regex, spec] pairs over /-joined param paths, overlaid on the
+    # model's built-in partition rule set. Validated at compile time.
+    partition_rules: Optional[list[Any]] = None
 
 
 class V1TFJob(_KFJob):
@@ -282,8 +286,15 @@ class V1TPUJob(_BaseRun):
     init: Optional[list[V1Init]] = None
     sidecars: Optional[list[V1Container]] = None
     container: Optional[V1Container] = None
-    # Training-runtime shortcut: run a built-in model instead of a container
+    # Training-runtime shortcut: run a built-in model instead of a container.
+    # Partition-engine keys (docs/PARTITIONING.md): partition_rules
+    # ([[regex, spec], ...] sharding overrides), import ({path, layout,
+    # dtype} foreign-checkpoint ingest), lora ({rank, alpha, target}).
     runtime: Optional[dict[str, Any]] = None  # {model, config, precision, remat, ...}
+    # Declarative sharding overrides, mergeable from the operation level
+    # (the runtime dict's own partition_rules key wins). Compile-time
+    # validated: bad regexes / no-match rules fail `polyaxon check`.
+    partition_rules: Optional[list[Any]] = None
 
     @field_validator("accelerator")
     @classmethod

@@ -47,6 +47,11 @@ class TrainerConfig:
     batch_size: int = 8
     seq_len: int = 128
     parallelism: Optional[dict] = None
+    # Multislice (ROADMAP item 3): >1 orders mesh devices slice-major so
+    # data/fsdp span DCN while model/context/stage/expert stay intra-slice
+    # (parallel/mesh.build_mesh). On CPU this builds contiguous "virtual
+    # slices" — the numeric-parity dryrun/test path.
+    num_slices: int = 1
     checkpoint: Optional[CheckpointConfig] = None
     log_interval: int = 10
     accelerator: str = "v5e"
@@ -114,6 +119,8 @@ class Trainer:
         on_progress: Optional[Callable[[int, dict, int], None]] = None,
         on_stalled: Optional[Callable[[int, float, float], None]] = None,
         log_line: Optional[Callable[[str], None]] = None,
+        partition_rules: Optional[Any] = None,
+        tx: Optional[Any] = None,
     ):
         self.cfg = cfg
         if task is None:
@@ -123,7 +130,8 @@ class Trainer:
                 )
             task = LMTask(cfg.model)
         self.task = task
-        self.mesh = mesh if mesh is not None else build_mesh(cfg.parallelism)
+        self.mesh = mesh if mesh is not None else build_mesh(
+            cfg.parallelism, num_slices=cfg.num_slices)
         if rules is None:
             rules = ShardingRules()
             if self.mesh.shape.get("stage", 1) > 1:
@@ -140,7 +148,9 @@ class Trainer:
                 # layers shard over stages: each stage owns L/S layers
                 rules = rules.override(layers="stage")
         self.rules = rules
-        self.tx = make_optimizer(cfg.optimizer)
+        # tx override: LoRA runs hand in a frozen-base multi_transform
+        # (partition/lora.py); everything else builds from the config
+        self.tx = tx if tx is not None else make_optimizer(cfg.optimizer)
         self.track = track
         # lifecycle tracing (obs/trace.py): on_span(name, start, end, **meta)
         # with epoch seconds — the builtin runtime wires Run.log_span here so
@@ -159,6 +169,18 @@ class Trainer:
         self.checkpointer = Checkpointer(cfg.checkpoint) if cfg.checkpoint else None
 
         pspecs = task.param_specs(self.rules)
+        if partition_rules:
+            # user `partition_rules:` override-or-extend the built-in specs
+            # (ISSUE 13 tentpole): rules were already compile-time
+            # validated (partition.validate_builtin_spec); here they overlay
+            # the task's resolved spec tree, and _state_shardings hands the
+            # result to params AND optimizer moments alike
+            from ..partition import overlay_partition_rules, parse_rules
+
+            user_rules = parse_rules(partition_rules)
+            abstract = jax.eval_shape(
+                lambda k: task.init(k)[0], jax.random.PRNGKey(0))
+            pspecs = overlay_partition_rules(user_rules, abstract, pspecs)
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), pspecs
         )
@@ -239,8 +261,28 @@ class Trainer:
             extra=extra_sh,
         )
 
-    def restore_or_init(self, seed: int = 0) -> tuple[TrainState, int]:
-        state = self.init_state(seed)
+    def init_state_from(self, params: Any, extra: Any = None) -> TrainState:
+        """Build a TrainState around externally-constructed params (a
+        foreign-checkpoint import — partition/convert.py — hands in
+        already-sharded device arrays). Optimizer state initializes sharded
+        via jit + out_shardings; the params pass through as arguments, so
+        a 7B import never round-trips through host memory again."""
+        def _make(p):
+            return TrainState.create(p, self.tx, extra=extra)
+
+        abstract = jax.eval_shape(_make, params)
+        shardings = self._state_shardings(abstract)
+        return jax.jit(_make, out_shardings=shardings)(params)
+
+    def restore_or_init(
+        self, seed: int = 0, init_params: Optional[Any] = None,
+    ) -> tuple[TrainState, int]:
+        """Latest complete checkpoint wins (resume); else ``init_params``
+        (checkpoint import / LoRA base) when given; else a fresh init."""
+        if init_params is not None:
+            state = self.init_state_from(init_params)
+        else:
+            state = self.init_state(seed)
         if self.checkpointer and self.checkpointer.latest_step() is not None:
             try:
                 # skips torn/corrupt steps via the checksum manifests and

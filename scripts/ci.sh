@@ -1,11 +1,12 @@
 #!/usr/bin/env bash
 # Single-entry local CI gate (ISSUE 11 satellite): the concurrency
-# analyzer, then the tier-1 pytest suite — exactly what ROADMAP.md's
-# "Tier-1 verify" runs, so one command answers "is the tree shippable".
+# analyzer, the partition rule-coverage audit (ISSUE 13 satellite), then
+# the tier-1 pytest suite — exactly what ROADMAP.md's "Tier-1 verify"
+# runs, so one command answers "is the tree shippable".
 #
 # Usage:
-#   scripts/ci.sh            # analyzer + tier-1 tests
-#   scripts/ci.sh --fast     # analyzer only (seconds, no pytest)
+#   scripts/ci.sh            # analyzer + partition audit + tier-1 tests
+#   scripts/ci.sh --fast     # analyzer + audit only (no pytest)
 #
 # Exit code: non-zero iff either gate fails. Caveat for slow boxes: on a
 # 2-CPU container the tier-1 suite can exceed the 870s window by design
@@ -16,15 +17,21 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/2: concurrency invariant analyzer =="
+echo "== gate 1/3: concurrency invariant analyzer =="
 python -m polyaxon_tpu.analysis || exit 1
+
+echo "== gate 2/3: partition rule-coverage audit =="
+# every built-in model's full param tree must be matched by its shipped
+# partition rule set, with legacy logical-axis spec parity — a model edit
+# can't silently fall back to replicated sharding (docs/PARTITIONING.md)
+env JAX_PLATFORMS=cpu python -m polyaxon_tpu.partition || exit 1
 
 if [ "$1" = "--fast" ]; then
     echo "== --fast: skipping tier-1 pytest =="
     exit 0
 fi
 
-echo "== gate 2/2: tier-1 tests (ROADMAP.md verify) =="
+echo "== gate 3/3: tier-1 tests (ROADMAP.md verify) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -1,0 +1,748 @@
+"""Partition-rule engine + checkpoint import + LoRA (ISSUE 13).
+
+Tier-1 coverage: engine semantics (first-match-wins, scalar
+auto-replicate, loud UnmatchedParamError), built-in rule-set parity with
+the legacy logical-axis specs for EVERY zoo model, polyaxonfile rule
+parsing + compile-time validation, foreign-checkpoint import (flat +
+HF-llama layouts) to fp32-forward parity on a real mesh, LoRA training
+that only moves adapters, and the plan/audit tooling. The 2-process
+multislice import e2e is slow-marked (out of the 870s window)."""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from polyaxon_tpu.models import REGISTRY, llama, transformer
+from polyaxon_tpu.parallel import ShardingRules, build_mesh
+from polyaxon_tpu.partition import (
+    RuleSyntaxError,
+    UnmatchedParamError,
+    abstract_params_for,
+    audit,
+    build_plan,
+    match_partition_rules,
+    overlay_partition_rules,
+    parse_rules,
+    rules_for,
+    specs_equivalent,
+    tree_paths,
+    validate_rules_against,
+)
+from polyaxon_tpu.partition import convert
+from polyaxon_tpu.partition.lora import (
+    LoRAConfig,
+    LoRATask,
+    LoRATargetError,
+    frozen_base_optimizer,
+    init_lora,
+    merge_lora,
+)
+from polyaxon_tpu.train.tasks import task_for
+
+
+def _tree(**leaves):
+    """name -> shape dict into a flat abstract tree."""
+    return {k: jax.ShapeDtypeStruct(v, jnp.float32) for k, v in leaves.items()}
+
+
+class TestEngine:
+    def test_first_match_wins(self):
+        tree = {"a": {"w": jax.ShapeDtypeStruct((4, 4), jnp.float32)}}
+        rules = (("a/w$", P("model", None)), ("w$", P(None, "model")))
+        specs = match_partition_rules(rules, tree)
+        assert specs["a"]["w"] == P("model", None)
+        # order flipped -> other rule wins
+        specs = match_partition_rules(tuple(reversed(rules)), tree)
+        assert specs["a"]["w"] == P(None, "model")
+
+    def test_search_semantics_match_nested_paths(self):
+        tree = {"enc": {"layers": {"attn": {"wq": jax.ShapeDtypeStruct(
+            (2, 4, 4), jnp.float32)}}}}
+        specs = match_partition_rules((("attn/wq$", P(None, "fsdp", "model")),),
+                                      tree)
+        assert specs["enc"]["layers"]["attn"]["wq"] == P(None, "fsdp", "model")
+
+    def test_scalar_auto_replicates_without_rule(self):
+        tree = {"step": jax.ShapeDtypeStruct((), jnp.int32),
+                "one": jax.ShapeDtypeStruct((1,), jnp.float32),
+                "w": jax.ShapeDtypeStruct((4, 4), jnp.float32)}
+        specs = match_partition_rules((("w$", P("model")),), tree)
+        assert specs["step"] == P()
+        assert specs["one"] == P()
+        assert specs["w"] == P("model")
+
+    def test_unmatched_lists_every_path(self):
+        tree = _tree(a=(4, 4), b=(8,), c=(2, 2))
+        with pytest.raises(UnmatchedParamError) as ei:
+            match_partition_rules((("^a$", P()),), tree)
+        assert sorted(ei.value.paths) == ["b", "c"]
+        assert "b" in str(ei.value) and "c" in str(ei.value)
+
+    def test_bad_regex_raises_rule_syntax_with_pattern(self):
+        with pytest.raises(RuleSyntaxError) as ei:
+            match_partition_rules((("a/(w$", P()),), _tree(a=(2,)))
+        assert "a/(w$" in str(ei.value)
+        assert ei.value.rule == "a/(w$"
+
+    def test_overlong_spec_rejected(self):
+        with pytest.raises(RuleSyntaxError) as ei:
+            match_partition_rules(
+                (("w$", P("model", None, "fsdp")),), _tree(w=(4, 4)))
+        assert "3-entry" in str(ei.value)
+
+    def test_short_spec_accepted(self):
+        # JAX pads unspecified trailing dims with None
+        specs = match_partition_rules((("w$", P("fsdp")),), _tree(w=(4, 4, 4)))
+        assert specs["w"] == P("fsdp")
+
+    def test_overlay_overrides_and_keeps_base(self):
+        tree = _tree(a=(4, 4), b=(4, 4))
+        base = {"a": P("fsdp"), "b": P("model")}
+        out = overlay_partition_rules((("^a$", P()),), tree, base)
+        assert out["a"] == P() and out["b"] == P("model")
+
+
+class TestParseRules:
+    def test_parse_forms(self):
+        rules = parse_rules([
+            ["norm", None],
+            ["bias$", "replicated"],
+            ["wq$", [None, "fsdp", ["data", "expert"]]],
+        ])
+        assert rules[0] == ("norm", P())
+        assert rules[1] == ("bias$", P())
+        assert rules[2] == ("wq$", P(None, "fsdp", ("data", "expert")))
+
+    def test_parse_idempotent(self):
+        rules = parse_rules([["wq$", ["model"]]])
+        assert parse_rules(rules) == rules
+
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(RuleSyntaxError) as ei:
+            parse_rules([["wq$", ["tensor"]]])
+        assert "tensor" in str(ei.value) and "wq$" in str(ei.value)
+
+    def test_non_pair_rejected(self):
+        with pytest.raises(RuleSyntaxError):
+            parse_rules([["only-a-pattern"]])
+        with pytest.raises(RuleSyntaxError):
+            parse_rules("attn: model")
+
+    def test_validate_no_match_names_nearest_paths(self):
+        tree = abstract_params_for("llama-tiny")
+        with pytest.raises(RuleSyntaxError) as ei:
+            validate_rules_against(parse_rules([["attn/wz$", None]]),
+                                   tree_paths(tree))
+        msg = str(ei.value)
+        assert "matches no parameter" in msg
+        assert "attn/w" in msg  # nearest real paths are suggested
+
+
+class TestBuiltinParity:
+    """The acceptance bar: every shipped rule set reproduces the legacy
+    logical-axis ShardingRules specs EXACTLY, per model."""
+
+    @pytest.mark.parametrize("name", sorted(REGISTRY))
+    def test_engine_matches_legacy_specs(self, name):
+        family, cfg = REGISTRY[name]
+        abstract = abstract_params_for(name)
+        engine = match_partition_rules(rules_for(name), abstract)
+        kwargs = {"image_size": 32} if family == "resnet" else {}
+        oracle = task_for(family, cfg, **kwargs).param_specs(ShardingRules())
+
+        def is_spec(x):
+            return isinstance(x, P)
+
+        engine_flat = tree_paths(engine, is_leaf=is_spec)
+        oracle_flat = tree_paths(oracle, is_leaf=is_spec)
+        assert [p for p, _ in engine_flat] == [p for p, _ in oracle_flat]
+        for (path, got), (_, want) in zip(engine_flat, oracle_flat):
+            assert specs_equivalent(got, want), (
+                f"{name}: {path}: engine {got} != legacy {want}")
+
+    def test_audit_clean(self):
+        report = audit(["llama-tiny", "llama-moe-tiny", "gpt2-tiny",
+                        "bert-tiny", "vit-tiny", "resnet18-cifar"])
+        assert all(r["status"] == "ok" for r in report.values())
+
+    def test_audit_catches_model_edit_drift(self, monkeypatch):
+        """A new param name that no rule matches must fail the audit loudly
+        (the 'model edits can't silently fall back to replicated' lint)."""
+        from polyaxon_tpu.partition import builtins as pb
+
+        orig = pb.abstract_params_for_config
+
+        def with_extra(family, cfg):
+            tree = orig(family, cfg)
+            if family == "lm":
+                tree = dict(tree)
+                tree["brand_new_block"] = {
+                    "w": jax.ShapeDtypeStruct((8, 8), jnp.float32)}
+            return tree
+
+        monkeypatch.setattr(pb, "abstract_params_for_config", with_extra)
+        monkeypatch.setattr("polyaxon_tpu.partition.plan."
+                            "abstract_params_for_config", with_extra)
+        with pytest.raises(UnmatchedParamError) as ei:
+            audit(["llama-tiny"])
+        assert "brand_new_block/w" in str(ei.value)
+
+
+class TestTrainerOverlay:
+    def test_user_rules_override_builtin_shardings(self):
+        from polyaxon_tpu.train import OptimizerConfig, Trainer, TrainerConfig
+
+        cfg = llama.LLAMA_TINY
+        mesh = build_mesh({"fsdp": 2, "model": 2})
+        tcfg = TrainerConfig(model=cfg, optimizer=OptimizerConfig(),
+                             batch_size=8, seq_len=16)
+        tr = Trainer(tcfg, mesh=mesh,
+                     partition_rules=[["attn/w[qkv]$",
+                                       [None, None, "model", None]]])
+        wq = tr.param_shardings["layers"]["attn"]["wq"]
+        assert specs_equivalent(wq.spec, P(None, None, "model", None))
+        # untouched params keep the built-in spec
+        wo = tr.param_shardings["layers"]["attn"]["wo"]
+        assert specs_equivalent(wo.spec, P(None, "model", None, "fsdp"))
+        # opt-state moments inherit the overlay through _state_shardings
+        state = tr.init_state()
+        mu_wq = [leaf for path, leaf in tree_paths(state.opt_state)
+                 if "mu" in path and path.endswith("attn/wq")]
+        assert mu_wq, "adam mu for wq not found in opt state"
+        assert specs_equivalent(mu_wq[0].sharding.spec,
+                                P(None, None, "model", None))
+
+
+@pytest.fixture(scope="module")
+def tiny_native():
+    cfg = llama.LLAMA_TINY
+    params = transformer.init(jax.random.PRNGKey(3), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0,
+                                cfg.vocab_size)
+    ref = transformer.apply(params, tokens, cfg)
+    return cfg, params, tokens, ref
+
+
+class TestImport:
+    def test_flat_roundtrip_forward_parity_and_shardings(
+            self, tmp_path, tiny_native):
+        cfg, params, tokens, ref = tiny_native
+        convert.save_flat(params, str(tmp_path / "flat"))
+        mesh = build_mesh({"fsdp": 2, "model": 2})
+        imported = convert.import_params(
+            str(tmp_path / "flat"), cfg, mesh, layout="flat")
+        out = transformer.apply(imported, tokens, cfg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        wq = imported["layers"]["attn"]["wq"]
+        assert specs_equivalent(wq.sharding.spec,
+                                P(None, "fsdp", "model", None))
+        # buffers land sharded: each addressable shard holds 1/4 of wq
+        shard = wq.addressable_shards[0]
+        assert shard.data.shape[1] == wq.shape[1] // 2
+        assert shard.data.shape[2] == wq.shape[2] // 2
+
+    def test_hf_llama_roundtrip_matches_native_forward(
+            self, tmp_path, tiny_native):
+        """Acceptance: a foreign HF-layout llama checkpoint imports through
+        the rule engine and matches the native forward to fp32 tolerance."""
+        cfg, params, tokens, ref = tiny_native
+        convert.export_hf_llama(params, cfg, str(tmp_path / "hf"))
+        # HF-layout keys exist exactly as HF names them
+        src = convert.open_source(str(tmp_path / "hf"))
+        assert "model.layers.0.self_attn.q_proj.weight" in src.keys()
+        assert convert.detect_layout(src) == "hf-llama"
+        mesh = build_mesh({"fsdp": 2, "model": 2})
+        imported = convert.import_params(str(tmp_path / "hf"), cfg, mesh)
+        out = transformer.apply(imported, tokens, cfg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_import_reads_only_shard_slices(self, tmp_path, tiny_native):
+        """'Never materializes unsharded on one host': on a sharded mesh
+        the per-shard callbacks ask the source for sub-slices, not the
+        full stacked tensor."""
+        cfg, params, _, _ = tiny_native
+        convert.save_flat(params, str(tmp_path / "flat"))
+        src = convert.open_source(str(tmp_path / "flat"))
+        reads: list[tuple] = []
+        orig_get = src.get
+
+        class SpyArr:
+            def __init__(self, arr, key):
+                self.arr, self.key = arr, key
+                self.shape, self.dtype = arr.shape, arr.dtype
+
+            def transpose(self, axes):
+                return SpyArr(self.arr.transpose(axes), self.key)
+
+            def __getitem__(self, idx):
+                reads.append((self.key, idx))
+                return self.arr[idx]
+
+        src.get = lambda name: SpyArr(orig_get(name), name)
+        mesh = build_mesh({"fsdp": 2, "model": 2})
+        convert.import_params(src, cfg, mesh, layout="flat")
+        wq_reads = [idx for key, idx in reads if key == "layers/attn/wq"]
+        assert wq_reads, "wq was never read"
+        full = transformer.init(jax.random.PRNGKey(0), cfg)
+        wq_shape = full["layers"]["attn"]["wq"].shape
+        for idx in wq_reads:
+            sliced = np.empty(wq_shape, np.int8)[idx]
+            assert sliced.shape[1] <= wq_shape[1] // 2, (
+                "a shard callback read the full embed dim")
+
+    def test_dtype_cast_floats_only(self, tmp_path, tiny_native):
+        cfg, params, _, _ = tiny_native
+        convert.save_flat(params, str(tmp_path / "flat"))
+        mesh = build_mesh({})
+        imported = convert.import_params(
+            str(tmp_path / "flat"), cfg, mesh, layout="flat",
+            dtype="bfloat16")
+        assert imported["layers"]["attn"]["wq"].dtype == jnp.bfloat16
+
+    def test_missing_source_keys_listed(self, tmp_path, tiny_native):
+        cfg, params, _, _ = tiny_native
+        convert.save_flat(params, str(tmp_path / "flat"))
+        os.unlink(tmp_path / "flat" / "embed" / "tokens.npy")
+        mesh = build_mesh({})
+        with pytest.raises(convert.ImportError_) as ei:
+            convert.import_params(str(tmp_path / "flat"), cfg, mesh,
+                                  layout="flat")
+        assert "embed/tokens" in str(ei.value)
+
+    def test_key_map_and_transpose(self, tmp_path, tiny_native):
+        """The generic flat layout adapts renamed/transposed foreign trees
+        without a bespoke layout class."""
+        cfg, params, tokens, ref = tiny_native
+        foreign = {p.replace("lm_head/w", "head/kernel"): v
+                   for p, v in tree_paths(params)}
+        foreign["head/kernel"] = np.asarray(foreign["head/kernel"]).T
+        convert.save_flat(foreign, str(tmp_path / "foreign"))
+        mesh = build_mesh({})
+        imported = convert.import_params(
+            str(tmp_path / "foreign"), cfg, mesh, layout="flat",
+            key_map=[["^lm_head/w$", "head/kernel"]],
+            transpose=[["^lm_head/w$", [1, 0]]])
+        out = transformer.apply(imported, tokens, cfg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_hf_layout_rejects_non_llama_config(self, tmp_path):
+        from polyaxon_tpu.models import gpt2
+
+        with pytest.raises(convert.ImportError_) as ei:
+            convert.hf_llama_entries(
+                convert.NpyDirSource(str(tmp_path)), gpt2.GPT2_TINY, {})
+        assert "not HF-llama-shaped" in str(ei.value)
+
+
+class TestLoRA:
+    def test_b_zero_init_means_identity_at_step0(self, tiny_native):
+        cfg, params, tokens, ref = tiny_native
+        lcfg = LoRAConfig(rank=4, alpha=8.0)
+        adapters = init_lora(jax.random.PRNGKey(0), params, lcfg)
+        merged = merge_lora(params, adapters, lcfg)
+        out = transformer.apply(merged, tokens, cfg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6, rtol=1e-6)
+
+    def test_merge_changes_targets_only(self, tiny_native):
+        cfg, params, _, _ = tiny_native
+        lcfg = LoRAConfig(rank=4, alpha=8.0, target=r"attn/wq$")
+        adapters = init_lora(jax.random.PRNGKey(0), params, lcfg)
+        adapters["layers"]["attn"]["wq"]["b"] = jnp.ones_like(
+            adapters["layers"]["attn"]["wq"]["b"])
+        merged = merge_lora(params, adapters, lcfg)
+        assert not np.allclose(np.asarray(merged["layers"]["attn"]["wq"]),
+                               np.asarray(params["layers"]["attn"]["wq"]))
+        np.testing.assert_array_equal(
+            np.asarray(merged["layers"]["attn"]["wk"]),
+            np.asarray(params["layers"]["attn"]["wk"]))
+
+    def test_bad_target_lists_nearest_paths(self, tiny_native):
+        _, params, _, _ = tiny_native
+        with pytest.raises(LoRATargetError) as ei:
+            init_lora(jax.random.PRNGKey(0), params,
+                      LoRAConfig(target=r"attn/wz$"))
+        assert "matches no parameter" in str(ei.value)
+
+    def test_unfactorable_target_rejected(self, tiny_native):
+        _, params, _, _ = tiny_native
+        with pytest.raises(LoRATargetError) as ei:
+            init_lora(jax.random.PRNGKey(0), params,
+                      LoRAConfig(target=r"attn_norm/scale$"))
+        assert "no known fan-in/fan-out" in str(ei.value)
+
+    def test_lora_run_trains_only_adapters(self, tiny_native):
+        """Acceptance: a LoRA run trains ONLY adapter params — the base is
+        bitwise frozen across optimizer steps while adapters move."""
+        from polyaxon_tpu.train import (
+            DataConfig, OptimizerConfig, Trainer, TrainerConfig,
+            make_batches, make_optimizer,
+        )
+        from polyaxon_tpu.train.tasks import LMTask
+
+        cfg = llama.LLAMA_TINY
+        lcfg = LoRAConfig(rank=4, alpha=8.0)
+        task = LoRATask(LMTask(cfg), lcfg)
+        tcfg = TrainerConfig(
+            model=cfg,
+            optimizer=OptimizerConfig(learning_rate=1e-2, warmup_steps=1,
+                                      total_steps=4),
+            batch_size=8, seq_len=16, parallelism={"fsdp": 2, "model": 2})
+        mesh = build_mesh(tcfg.parallelism)
+        tr = Trainer(tcfg, mesh=mesh, task=task,
+                     tx=frozen_base_optimizer(make_optimizer(tcfg.optimizer)))
+        data = make_batches(DataConfig(
+            kind="synthetic-lm", batch_size=8, seq_len=16,
+            vocab_size=cfg.vocab_size), mesh)
+        state, _ = tr.restore_or_init()
+        base_before = jax.tree.map(np.asarray, state.params["base"])
+        state, metrics = tr.fit(data, num_steps=4, state=state)
+        assert np.isfinite(metrics["loss"])
+        for (p, before), (_, after) in zip(
+                tree_paths(base_before),
+                tree_paths(jax.tree.map(np.asarray, state.params["base"]))):
+            np.testing.assert_array_equal(before, after,
+                                          err_msg=f"base param {p} moved")
+        moved = [p for p, leaf in tree_paths(state.params["lora"])
+                 if p.endswith("/b") and np.abs(np.asarray(leaf)).max() > 0]
+        assert moved, "no adapter moved after 4 steps"
+
+    def test_base_shards_adapters_replicate(self, tiny_native):
+        from polyaxon_tpu.train import OptimizerConfig, Trainer, TrainerConfig
+        from polyaxon_tpu.train.tasks import LMTask
+
+        cfg = llama.LLAMA_TINY
+        task = LoRATask(LMTask(cfg), LoRAConfig(rank=4))
+        mesh = build_mesh({"fsdp": 2, "model": 2})
+        tr = Trainer(TrainerConfig(model=cfg, optimizer=OptimizerConfig(),
+                                   batch_size=8, seq_len=16),
+                     mesh=mesh, task=task)
+        wq = tr.param_shardings["base"]["layers"]["attn"]["wq"]
+        assert specs_equivalent(wq.spec, P(None, "fsdp", "model", None))
+        a = tr.param_shardings["lora"]["layers"]["attn"]["wq"]["a"]
+        assert specs_equivalent(a.spec, P())
+
+
+class TestCompileTime:
+    """UnmatchedParamError / rule-syntax errors surface at COMPILE time
+    (resolver -> _render_builtin -> validate_builtin_spec), not mid-init
+    in the pod."""
+
+    def _resolve(self, runtime, partition_rules=None):
+        from polyaxon_tpu.compiler.resolver import resolve
+        from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+        run = {"kind": "tpujob", "accelerator": "v5e", "topology": "2x2",
+               "runtime": runtime}
+        if partition_rules is not None:
+            run["partitionRules"] = partition_rules
+        spec = check_polyaxonfile({
+            "kind": "operation", "name": "t",
+            "component": {"kind": "component", "run": run}}).to_dict()
+        return resolve(spec, run_uuid="u" * 32, project="p",
+                       artifacts_path="/tmp/x")
+
+    def test_bad_regex_fails_resolve_with_offending_pattern(self):
+        with pytest.raises(RuleSyntaxError) as ei:
+            self._resolve({"model": "llama-tiny",
+                           "partition_rules": [["attn/(wq$", None]]})
+        assert "attn/(wq$" in str(ei.value)
+
+    def test_no_match_rule_fails_resolve_with_nearest_paths(self):
+        with pytest.raises(RuleSyntaxError) as ei:
+            self._resolve({"model": "llama-tiny",
+                           "partition_rules": [["attn/wqq$", None]]})
+        assert "nearest param paths" in str(ei.value)
+        assert "attn/w" in str(ei.value)
+
+    def test_unknown_axis_fails_resolve(self):
+        with pytest.raises(RuleSyntaxError):
+            self._resolve({"model": "llama-tiny",
+                           "partition_rules": [["attn/wq$", ["tensor"]]]})
+
+    def test_unknown_model_fails_resolve_when_partition_keys_present(self):
+        with pytest.raises(RuleSyntaxError) as ei:
+            self._resolve({"model": "llama-9t",
+                           "partition_rules": [["attn/wq$", None]]})
+        assert "llama-9t" in str(ei.value)
+
+    def test_bad_lora_target_fails_resolve(self):
+        with pytest.raises(LoRATargetError):
+            self._resolve({"model": "llama-tiny",
+                           "lora": {"rank": 4, "target": "attn/nope$"}})
+
+    def test_bad_import_key_map_regex_fails_resolve(self):
+        with pytest.raises(RuleSyntaxError) as ei:
+            self._resolve({"model": "llama-tiny",
+                           "import": {"path": "/x", "layout": "flat",
+                                      "key_map": [["[", "x"]]}})
+        assert "[" in str(ei.value) and "does not compile" in str(ei.value)
+
+    def test_bad_import_dtype_fails_resolve(self):
+        with pytest.raises(RuleSyntaxError) as ei:
+            self._resolve({"model": "llama-tiny",
+                           "import": {"path": "/x", "dtype": "bfloat17"}})
+        assert "bfloat17" in str(ei.value)
+
+    def test_bad_transpose_axes_fail_resolve(self):
+        with pytest.raises(RuleSyntaxError):
+            self._resolve({"model": "llama-tiny",
+                           "import": {"path": "/x", "layout": "flat",
+                                      "transpose": [["wq$", ["a", "b"]]]}})
+
+    def test_valid_blocks_flow_into_payload(self):
+        r = self._resolve(
+            {"model": "llama-tiny", "lora": {"rank": 4}},
+            partition_rules=[["attn/wq$", [None, "fsdp", "model", None]]])
+        b = r.payload.builtin
+        assert b["partition_rules"] == \
+            [["attn/wq$", [None, "fsdp", "model", None]]]
+        assert b["lora"] == {"rank": 4}
+        assert b["num_slices"] == 1
+
+    def test_runtime_dict_rules_win_over_run_level(self):
+        r = self._resolve(
+            {"model": "llama-tiny",
+             "partition_rules": [["attn/wq$", None]]},
+            partition_rules=[["mlp/wi$", None]])
+        assert r.payload.builtin["partition_rules"] == [["attn/wq$", None]]
+
+    def test_multislice_num_slices_injected(self):
+        from polyaxon_tpu.compiler.resolver import resolve
+        from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+        spec = check_polyaxonfile({
+            "kind": "operation", "name": "t",
+            "component": {"kind": "component", "run": {
+                "kind": "tpujob", "accelerator": "v5e", "topology": "2x2",
+                "numSlices": 2,
+                "runtime": {"model": "llama-tiny"}}}}).to_dict()
+        r = resolve(spec, run_uuid="u" * 32, project="p",
+                    artifacts_path="/tmp/x")
+        assert r.payload.builtin["num_slices"] == 2
+
+
+class TestPlan:
+    def test_plan_table_and_summary(self):
+        plan = build_plan("llama-tiny", parallelism={"fsdp": 2, "model": 2},
+                          num_devices=4)
+        s = plan["summary"]
+        assert s["num_params"] == llama.LLAMA_TINY.num_params()
+        assert set(s["axes_used"]) == {"fsdp", "model"}
+        by_param = {r["param"]: r for r in plan["rows"]}
+        wq = by_param["layers/attn/wq"]
+        assert wq["bytes_per_device"] == wq["bytes"] // 4
+        # replicated leaves pay full bytes on every device
+        norm = by_param["final_norm/scale"]
+        assert norm["bytes_per_device"] == norm["bytes"]
+        total = sum(r["bytes_per_device"] for r in plan["rows"])
+        assert s["bytes_per_device"] == total
+
+    def test_plan_absorbs_capacity_like_build_mesh(self):
+        plan = build_plan("llama-tiny", parallelism={"model": 2},
+                          num_devices=8)
+        assert plan["summary"]["axis_sizes"] == {"data": 4, "model": 2}
+
+    def test_plan_cli_runtime_num_slices_wins_over_topology(self, tmp_path):
+        """Hand-built specs set num_slices in the runtime dict (the same
+        precedence run_builtin honors); the plan must mirror it."""
+        import yaml
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        f = tmp_path / "op.yaml"
+        f.write_text(yaml.safe_dump({
+            "version": 1.1, "kind": "component", "name": "p",
+            "run": {"kind": "tpujob", "accelerator": "v5e",
+                    "topology": "2x2",
+                    "runtime": {"model": "llama-tiny", "num_slices": 2}}}))
+        res = CliRunner().invoke(cli, ["partition", "plan", "-f", str(f),
+                                       "--json"])
+        assert res.exit_code == 0, res.output
+        assert json.loads(res.output)["summary"]["num_slices"] == 2
+
+    def test_plan_applies_user_rules_and_lora(self):
+        plan = build_plan(
+            "llama-tiny", parallelism={"fsdp": 2, "model": 2}, num_devices=4,
+            partition_rules=[["attn/wq$", None]], lora={"rank": 4})
+        by_param = {r["param"]: r for r in plan["rows"]}
+        assert by_param["base/layers/attn/wq"]["spec"] == "replicated"
+        assert "lora/layers/attn/wq/a" in by_param
+
+    def test_format_plan_renders(self):
+        from polyaxon_tpu.partition import format_plan
+
+        text = format_plan(build_plan("llama-tiny"))
+        assert "layers/attn/wq" in text and "bytes/device" in text
+
+    def test_plan_cli_json(self, tmp_path):
+        import yaml
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        f = tmp_path / "op.yaml"
+        f.write_text(yaml.safe_dump({
+            "version": 1.1, "kind": "component", "name": "p",
+            "run": {"kind": "tpujob", "accelerator": "v5e",
+                    "topology": "2x2",
+                    "parallelism": {"fsdp": 2, "model": 2},
+                    "runtime": {"model": "llama-tiny"}}}))
+        res = CliRunner().invoke(cli, ["partition", "plan", "-f", str(f),
+                                       "--json"])
+        assert res.exit_code == 0, res.output
+        plan = json.loads(res.output)
+        assert plan["summary"]["num_devices"] == 4
+
+    def test_plan_cli_rejects_containers(self, tmp_path):
+        import yaml
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        f = tmp_path / "op.yaml"
+        f.write_text(yaml.safe_dump({
+            "version": 1.1, "kind": "component", "name": "p",
+            "run": {"kind": "job",
+                    "container": {"command": ["true"]}}}))
+        res = CliRunner().invoke(cli, ["partition", "plan", "-f", str(f)])
+        assert res.exit_code != 0
+        assert "runtime" in res.output
+
+
+class TestRuntimeIntegration:
+    def test_builtin_runs_import_lora_rules(self, tmp_path, monkeypatch,
+                                            tiny_native):
+        """run_builtin with partition_rules + import + lora: trains, base
+        == imported checkpoint (frozen), summary finite."""
+        cfg, params, _, _ = tiny_native
+        hf_dir = tmp_path / "ckpt"
+        convert.export_hf_llama(params, cfg, str(hf_dir))
+        from polyaxon_tpu.runtime.builtin import run_builtin
+
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv("PLX_ARTIFACTS_PATH", str(tmp_path))
+        summary = run_builtin({
+            "model": "llama-tiny", "steps": 2, "batch_size": 8,
+            "seq_len": 16, "checkpoint": False, "watchdog": False,
+            "parallelism": {"fsdp": 2, "model": 2},
+            "partition_rules": [["attn/w[qkv]$",
+                                 [None, None, "model", None]]],
+            "import": {"path": str(hf_dir), "layout": "hf-llama"},
+            "lora": {"rank": 4, "alpha": 8.0},
+        })
+        assert np.isfinite(summary["loss"])
+
+    def test_builtin_import_without_lora_starts_from_checkpoint(
+            self, tmp_path, monkeypatch, tiny_native):
+        """Full-finetune import: step-0 loss equals the loss of the
+        exported weights, proving the foreign tree actually landed."""
+        cfg, params, _, _ = tiny_native
+        convert.save_flat(params, str(tmp_path / "ckpt"))
+        from polyaxon_tpu.runtime.builtin import run_builtin
+
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv("PLX_ARTIFACTS_PATH", str(tmp_path))
+        common = dict(model="llama-tiny", steps=1, batch_size=8, seq_len=16,
+                      checkpoint=False, watchdog=False,
+                      parallelism={"fsdp": 2})
+        with_import = run_builtin({
+            **common, "import": {"path": str(tmp_path / "ckpt"),
+                                 "layout": "flat"}})
+        fresh = run_builtin(dict(common))
+        # same data seed, different weights -> different first loss; the
+        # imported run must NOT match the fresh-init loss
+        assert with_import["loss"] != pytest.approx(fresh["loss"], abs=1e-6)
+
+    def test_restarted_attempt_resumes_without_reimporting(
+            self, tmp_path, monkeypatch, tiny_native):
+        """Resume beats re-import: once a complete checkpoint exists in
+        the artifacts dir, a restarted attempt must not pay the foreign
+        tree read again (minutes of I/O at 7B)."""
+        cfg, params, _, _ = tiny_native
+        convert.save_flat(params, str(tmp_path / "ckpt"))
+        from polyaxon_tpu.partition import convert as pconvert
+        from polyaxon_tpu.runtime.builtin import run_builtin
+
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv("PLX_ARTIFACTS_PATH", str(tmp_path))
+        calls = []
+        orig = pconvert.import_params
+        monkeypatch.setattr(
+            pconvert, "import_params",
+            lambda *a, **kw: calls.append(1) or orig(*a, **kw))
+        spec = {"model": "llama-tiny", "steps": 2, "batch_size": 8,
+                "seq_len": 16, "watchdog": False,
+                "checkpoint": {"save_interval_steps": 1},
+                "import": {"path": str(tmp_path / "ckpt"),
+                           "layout": "flat"}}
+        first = run_builtin(dict(spec))
+        assert first["resumed_from_step"] == 0 and len(calls) == 1
+        second = run_builtin({**spec, "steps": 3})
+        assert second["resumed_from_step"] == 2
+        assert len(calls) == 1, "restarted attempt re-imported the tree"
+
+
+@pytest.mark.slow
+class TestTwoProcessImportE2E:
+    def test_multislice_import_pods(self, tmp_path):
+        """2-process (2 virtual slices x 1 host) tpujob importing an
+        HF-layout checkpoint through the rule engine: both pods join one
+        jax.distributed program over a 2-slice mesh, each host reads only
+        its shard slices, and the run succeeds with finite loss."""
+        from polyaxon_tpu.api.store import Store
+        from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+        from polyaxon_tpu.scheduler.agent import LocalAgent
+
+        cfg = llama.LLAMA_TINY
+        params = transformer.init(jax.random.PRNGKey(11), cfg)
+        hf_dir = tmp_path / "hf_ckpt"
+        convert.export_hf_llama(params, cfg, str(hf_dir))
+
+        spec = check_polyaxonfile({
+            "kind": "operation", "name": "ms-import",
+            "component": {"kind": "component", "run": {
+                "kind": "tpujob", "accelerator": "v5e", "topology": "2x2",
+                "numSlices": 2,
+                "parallelism": {"data": 2, "fsdp": 2},
+                "runtime": {
+                    "model": "llama-tiny", "steps": 2, "batch_size": 8,
+                    "seq_len": 16, "checkpoint": False, "watchdog": False,
+                    "platform": "cpu", "num_cpu_devices": 2,
+                    "import": {"path": str(hf_dir), "layout": "hf-llama"},
+                },
+            }},
+        }).to_dict()
+        store = Store(":memory:")
+        agent = LocalAgent(store, artifacts_root=str(tmp_path),
+                           backend="cluster", poll_interval=0.05)
+        uuid = store.create_run("p", spec=spec, name="msi")["uuid"]
+        deadline = time.monotonic() + 300
+        status = None
+        try:
+            while time.monotonic() < deadline:
+                agent.tick()
+                status = store.get_run(uuid)["status"]
+                if status in ("succeeded", "failed", "stopped"):
+                    break
+                time.sleep(0.05)
+            logs = "\n".join(
+                agent.cluster.pod_logs(f"plx-{uuid[:12]}-{i}")
+                for i in range(2))
+            assert status == "succeeded", (store.get_statuses(uuid), logs)
+            envs = agent.cluster.launched_env
+            assert sorted(e["MEGASCALE_SLICE_ID"]
+                          for e in envs.values()) == ["0", "1"]
+        finally:
+            agent.stop()
