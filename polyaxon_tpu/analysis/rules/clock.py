@@ -33,6 +33,9 @@ from ..engine import Finding, Project, Rule, call_target, import_aliases
 #: serve/ joined in ISSUE 12: decode deadlines, drain windows, Retry-After
 #: derivations and watchdog stalls are all duration arithmetic — an NTP
 #: step must not cancel a request early or fire a serving stall.
+#: api/stream.py (ISSUE 14) rides the api/ prefix: SSE keepalive windows
+#: and eviction write deadlines are durations too — an NTP step must not
+#: evict a healthy watcher (scope pinned by test_analysis).
 SCOPE_PREFIXES = ("api/", "scheduler/", "operator/", "resilience/",
                   "serve/")
 #: plus individual clock-sensitive modules outside those trees

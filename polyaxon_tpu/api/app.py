@@ -76,9 +76,28 @@ class ApiApp:
         self.app = web.Application(
             middlewares=[*(extra_middlewares or []), self._auth_middleware,
                          self._conflict_middleware])
+        # live push (ISSUE 14): one hub task tails the store's changelog
+        # and fans run deltas to the SSE watchers of /api/v1/streams/runs;
+        # constructed here (not at startup) so its polyaxon_stream_*
+        # families are registered from birth, started on the app's loop
+        from .stream import StreamHub
+
+        self.stream = StreamHub(store)
+        self.app.on_startup.append(self._start_stream)
+        # on_shutdown, NOT on_cleanup: aiohttp waits for open handlers
+        # BETWEEN the two, and the SSE handlers only exit once the hub's
+        # stop evicts them — on_cleanup would deadlock the drain against
+        # the watchers it is supposed to release
+        self.app.on_shutdown.append(self._stop_stream)
         self._routes()
         # the scheduler (if attached in-process) watches this queue
         self.new_run_event = asyncio.Event()
+
+    async def _start_stream(self, _app) -> None:
+        await self.stream.start()
+
+    async def _stop_stream(self, _app) -> None:
+        await self.stream.stop()
 
     def _auth_enabled(self) -> bool:
         if self.auth_token:
@@ -107,6 +126,11 @@ class ApiApp:
             return await handler(request)
         header = request.headers.get("Authorization", "")
         token = header[7:] if header.startswith("Bearer ") else None
+        if token is None and request.path.startswith("/api/v1/streams/"):
+            # EventSource cannot set request headers: the SSE stream
+            # accepts the bearer token as ?access_token= (this path
+            # only — everything else keeps the header-only contract)
+            token = request.rel_url.query.get("access_token") or None
         if token is None:
             return _json({"error": "unauthorized"}, status=401)
         if self.auth_token and token == self.auth_token:
@@ -128,6 +152,12 @@ class ApiApp:
         # project-scoped: only that project's routes; token admin and
         # project creation stay admin-only
         path_project = request.match_info.get("project")
+        if request.path.startswith("/api/v1/streams/"):
+            # the stream endpoint is global by shape; a scoped token
+            # subscribes fine but the hub pins its filter to the token's
+            # project — other tenants' deltas never reach it
+            request["scope_project"] = row["project"]
+            return await handler(request)
         if request.path.startswith("/api/v1/tokens") or (
                 path_project is None and request.path != "/api/v1/projects"):
             return _json({"error": "forbidden"}, status=403)
@@ -191,6 +221,7 @@ class ApiApp:
         r.add_get("/api/v1/store", self.get_store_status)
         r.add_get("/api/v1/changelog", self.get_changelog)
         r.add_get("/api/v1/store/snapshot", self.get_snapshot)
+        r.add_get("/api/v1/streams/runs", self.stream_runs)
         r.add_post("/api/v1/{project}/runs", self.create_run)
         r.add_get("/api/v1/{project}/runs", self.list_runs)
         r.add_get("/api/v1/{project}/runs/{uuid}", self.get_run)
@@ -325,6 +356,16 @@ class ApiApp:
                           "detail": str(e), "floor": e.floor}, status=410)
         return _json({"rows": rows,
                       "seq": span["seq"], "epoch": span["epoch"]})
+
+    async def stream_runs(self, request):
+        """SSE change-feed subscription (ISSUE 14): live run deltas off
+        the commit-ordered changelog — ``event: run|delete|heartbeat``
+        frames whose ``id:`` is the feed token, so ``Last-Event-ID``
+        reconnects resume loss-free; 410 on a pre-failover or compacted
+        token (full resync), 503 + Retry-After past ``max_watchers``.
+        ``?project=`` filters; ``?access_token=`` carries auth for
+        EventSource clients (docs/OBSERVABILITY.md "Live streams")."""
+        return await self.stream.handle(request)
 
     async def get_snapshot(self, request):
         """Crash-consistent store snapshot (standby bootstrap): streams

@@ -1975,12 +1975,18 @@ class Store:
             self._train_seen.pop(uuid, None)  # watermark dies with the row
             self._serve_seen.pop(uuid, None)
         with self._conn_ctx() as conn:
+            # project read BEFORE the delete: the change feed scopes
+            # deletions per-project (ISSUE 14), and a post-delete lookup
+            # can only answer None
+            row = conn.execute("SELECT project FROM runs WHERE uuid=?",
+                               (uuid,)).fetchone()
             cur = conn.execute("DELETE FROM runs WHERE uuid=?", (uuid,))
             conn.execute("DELETE FROM status_conditions WHERE run_uuid=?", (uuid,))
             conn.execute("DELETE FROM lineage WHERE run_uuid=?", (uuid,))
             conn.execute("DELETE FROM launch_intents WHERE run_uuid=?", (uuid,))
             if cur.rowcount > 0:
-                self._log_change(conn, "delete_run", {"uuid": uuid})
+                self._log_change(conn, "delete_run", {
+                    "uuid": uuid, "project": row[0] if row else None})
         return cur.rowcount > 0
 
     # -- statuses ----------------------------------------------------------

@@ -1,0 +1,597 @@
+"""Live push control plane: SSE change-feed fan-out (ISSUE 14).
+
+PR 3 built the commit-ordered ``?since=`` change feed and PR 7 gave it
+exact failover semantics — this module finally serves it LIVE. One
+:class:`StreamHub` task tails the store's changelog (the same
+commit-ordered log replication rides, so one event per committed write —
+nothing coalesces, nothing reorders) and fans deltas out to N subscribers
+of ``GET /api/v1/streams/runs`` over per-watcher *bounded* queues.
+
+Robustness contract (docs/RESILIENCE.md "Store crash matrix", watcher
+row):
+
+- **Slow watchers are evicted, never absorbed**: a watcher that can't
+  drain its buffer gets an ``evicted`` control event and a close — it
+  can NEVER backpressure the hub or starve other watchers. Every event
+  carries its feed token as the SSE ``id:``, so the standard
+  ``Last-Event-ID`` reconnect resumes exactly where the stream broke —
+  loss-free, duplicate-free, no full re-list.
+- **Failover-exact tokens**: a ``Last-Event-ID`` (or ``?since=``) from
+  before a store failover answers a deterministic 410 (epoch fence), and
+  one at or below the changelog compaction floor answers 410 too — the
+  pruned range is gone, and serving only the survivors would silently
+  diverge the watcher. 410 means *full resync*: re-list, then subscribe
+  fresh. Mid-stream, an epoch change makes the hub broadcast a
+  ``resync`` control event to every watcher for the same reason.
+- **Bounded admission**: past ``max_watchers`` the endpoint sheds with
+  503 + Retry-After (the PR-12 overload idiom) — a watcher burst
+  degrades loudly instead of melting the event loop.
+- **Async-correct** (analyzer rule R3): every store touch from the
+  handler or the hub task runs in the default executor; the event loop
+  only ever formats frames and awaits queues.
+
+Event shapes (``data:`` is JSON):
+
+- ``hello``      {since, epoch} — the subscriber's loss-free bootstrap
+  token (list first, then trust deltas after this token)
+- ``run``        a full client-shape run row (create/transition/output
+  merge — one event per committed write, in commit order)
+- ``delete``     {uuid}
+- ``heartbeat``  {uuid, step?, at} — liveness/progress ticks; the
+  dashboard uses them to refresh log tails and badges without polling
+- ``evicted``    {reason} then close — reconnect with Last-Event-ID
+- ``resync``     {epoch} then close — full resync, reconnect WITHOUT a
+  token
+
+Metrics (contracted in docs/OBSERVABILITY.md + test_obs):
+``polyaxon_stream_watchers``, ``polyaxon_stream_events_total``,
+``polyaxon_stream_evictions_total{reason}``,
+``polyaxon_stream_rejected_total``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from ..resilience.heartbeat import age_seconds
+from .store import CompactedLogError, StaleEpochError, Store
+
+#: ops forwarded to watchers — everything else in the changelog (lease,
+#: intent, condition, config, token, lineage) is control-plane internals;
+#: ``condition`` is deliberately skipped: the run row of the same
+#: transition already carries the new status, on the same commit
+_FORWARD_OPS = {"run", "delete_run", "heartbeat"}
+
+#: eviction reasons (the {reason} label values of
+#: polyaxon_stream_evictions_total)
+EVICT_SLOW = "slow"
+EVICT_RESYNC = "resync"
+EVICT_WRITE_TIMEOUT = "write_timeout"
+
+
+def _fmt_token(epoch: int, seq: int) -> str:
+    """The SSE ``id:`` — byte-identical to Store.feed_token: bare seq at
+    epoch 0 (pre-failover compatible), ``epoch:seq`` after a promotion."""
+    return f"{epoch}:{seq}" if epoch else str(seq)
+
+
+class _Watcher:
+    __slots__ = ("queue", "project", "evicted", "reason")
+
+    def __init__(self, buffer: int, project: Optional[str]):
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=buffer)
+        self.project = project
+        self.evicted = False
+        self.reason: Optional[str] = None
+
+
+class _Ctl:
+    """Control sentinel pushed into a watcher's queue (eviction/resync)."""
+
+    __slots__ = ("reason",)
+
+    def __init__(self, reason: str):
+        self.reason = reason
+
+
+class StreamHub:
+    """One changelog tailer fanning run deltas to N SSE watchers.
+
+    All hub state lives on the server's event loop: publication, (un)
+    registration and eviction run as loop callbacks, so no locks — the
+    only cross-thread entry is the store's transition listener, which
+    sets the wake event via ``call_soon_threadsafe``. Store reads happen
+    in the default executor (R3)."""
+
+    def __init__(self, store: Store, *, max_watchers: int = 256,
+                 buffer: int = 256, poll_interval: float = 0.5,
+                 keepalive_s: float = 15.0, write_timeout_s: float = 10.0,
+                 metrics=None):
+        self.store = store
+        self.max_watchers = int(max_watchers)
+        #: per-watcher queue bound; a watcher further behind than this is
+        #: evicted (it resumes by Last-Event-ID — cheap for it, free for
+        #: everyone else)
+        self.buffer = int(buffer)
+        #: heartbeats don't fire transition listeners; the poll floor
+        #: bounds their delivery latency (transitions wake instantly)
+        self.poll_interval = float(poll_interval)
+        self.keepalive_s = float(keepalive_s)
+        #: a watcher whose TCP write can't complete within this is gone
+        #: (kernel buffers full on a stalled peer) — closed and counted
+        self.write_timeout_s = float(write_timeout_s)
+        #: send-side buffering bound (bytes): applied to BOTH the asyncio
+        #: transport high-water mark and the socket's SO_SNDBUF, so a
+        #: consumer that stops draining backpressures the handler after
+        #: ~this many bytes instead of after the kernel's auto-tuned
+        #: megabytes — which is what makes a laggard's bounded queue
+        #: actually fill. None (production default) leaves the kernel
+        #: defaults; tests and the watcher-fault soak shrink it to make
+        #: evictions deterministic at small event volumes.
+        self.write_high_water: Optional[int] = None
+        self._watchers: dict[int, _Watcher] = {}
+        self._next_id = 0
+        self._cursor = 0
+        self._epoch = 0
+        self._running = False
+        self._task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # uuid -> project for heartbeat/delete scoping (run events carry
+        # their own); misses resolved in the tail executor, pruned on
+        # delete — bounded by live runs
+        self._projects: dict[str, Optional[str]] = {}
+
+        reg = metrics if metrics is not None else getattr(
+            store, "metrics", None)
+        if reg is None:
+            from ..obs.metrics import MetricsRegistry
+
+            reg = MetricsRegistry()
+        self.metrics = reg
+        self._g_watchers = reg.gauge(
+            "polyaxon_stream_watchers",
+            "Live SSE change-feed subscribers",
+            value_fn=lambda: len(self._watchers))
+        self._c_events = reg.counter(
+            "polyaxon_stream_events_total",
+            "Change-feed events published by the stream hub (per event, "
+            "not per delivery)")
+        self._c_evicted = {
+            reason: reg.counter(
+                "polyaxon_stream_evictions_total",
+                "Watchers evicted from the SSE stream",
+                labels={"reason": reason})
+            for reason in (EVICT_SLOW, EVICT_RESYNC, EVICT_WRITE_TIMEOUT)}
+        self._c_rejected = reg.counter(
+            "polyaxon_stream_rejected_total",
+            "Stream subscriptions shed at the max_watchers admission "
+            "bound (503 + Retry-After)")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._running:
+            return
+        self._loop = asyncio.get_running_loop()
+        self._wake = asyncio.Event()
+        self._running = True
+        self.store.add_transition_listener(self._on_transition)
+        boot = await self._loop.run_in_executor(None, self._read_head)
+        self._epoch, self._cursor = boot
+        self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        self._running = False
+        for w in list(self._watchers.values()):
+            self._evict(w, EVICT_RESYNC, count=False)
+        if self._wake is not None:
+            self._wake.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    def _on_transition(self, _uuid: str, _status: str) -> None:
+        # store writer threads -> loop wake; after stop() (or before
+        # start) this is a no-op — listeners can't be unregistered
+        loop, wake = self._loop, self._wake
+        if not self._running or loop is None or wake is None:
+            return
+        try:
+            loop.call_soon_threadsafe(wake.set)
+        except RuntimeError:
+            pass  # loop already closed (server teardown)
+
+    # -- the tail task -----------------------------------------------------
+
+    def _read_head(self) -> tuple[int, int]:
+        """(epoch, latest committed seq) — the subscribe-from-now
+        bootstrap. Runs in the executor."""
+        return self.store.current_epoch(), self.store.current_seq()
+
+    def _fetch(self) -> tuple[int, int, list[dict]]:
+        """Changelog rows after the hub cursor (paged to exhaustion) plus
+        the store's current epoch and the RAW tail cursor — the cursor
+        must advance past skipped ops too (a page of pure lease renewals
+        would otherwise be re-read forever). Runs in the executor."""
+        epoch = self.store.current_epoch()
+        rows: list[dict] = []
+        cursor = self._cursor
+        while True:
+            page = self.store.get_changelog(cursor, 500)
+            if not page:
+                break
+            rows.extend(page)
+            cursor = page[-1]["seq"]
+            if len(page) < 500:
+                break
+        return epoch, cursor, self._to_events(rows)
+
+    def _to_events(self, rows: list[dict]) -> list[dict]:
+        """Changelog rows -> watcher events (sync; executor context, so
+        heartbeat project-cache misses may read the store)."""
+        out = []
+        for rec in rows:
+            op = rec["op"]
+            if op not in _FORWARD_OPS:
+                continue
+            seq, epoch, payload = rec["seq"], int(rec["epoch"]), rec["payload"]
+            if op == "run":
+                data = _raw_row_to_run(payload["row"])
+                project = data.get("project")
+                self._projects[data["uuid"]] = project
+                ev_type = "run"
+            elif op == "delete_run":
+                # the payload carries the project (stamped before the
+                # row died — a post-delete get_run can only answer None
+                # and would hide the deletion from scoped watchers);
+                # the cache is the fallback for pre-r14 changelog rows
+                project = (payload.get("project")
+                           or self._project_of(payload["uuid"]))
+                self._projects.pop(payload["uuid"], None)
+                data = {"uuid": payload["uuid"], "project": project}
+                ev_type = "delete"
+            else:  # heartbeat
+                project = self._project_of(payload["uuid"])
+                data = payload
+                ev_type = "heartbeat"
+            token = _fmt_token(epoch, seq)
+            out.append({"type": ev_type, "seq": seq, "epoch": epoch,
+                        "id": token, "project": project, "data": data,
+                        # frame bytes encoded ONCE per event (executor
+                        # side): the loop fans the same bytes to every
+                        # watcher instead of json.dumps-ing the row
+                        # O(watchers) times on the hot path
+                        "frame": _sse_frame(ev_type, token, data)})
+        return out
+
+    def _project_of(self, uuid: str) -> Optional[str]:
+        if uuid not in self._projects:
+            run = self.store.get_run(uuid)
+            self._projects[uuid] = run.get("project") if run else None
+        return self._projects[uuid]
+
+    async def _run(self) -> None:
+        assert self._loop is not None and self._wake is not None
+        while self._running:
+            try:
+                await asyncio.wait_for(self._wake.wait(),
+                                       timeout=self.poll_interval)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            if not self._running:
+                return
+            try:
+                epoch, cursor, events = await self._loop.run_in_executor(
+                    None, self._fetch)
+            except CompactedLogError:
+                # the hub itself lagged behind a compaction (it was
+                # wedged, or the floor raced far ahead): the gap is
+                # unreadable — resync everyone, restart from the head
+                await self._resync()
+                continue
+            except Exception:
+                # store weather (outage window mid-failover): back off,
+                # the FailoverStore/standby sorts itself out underneath
+                await asyncio.sleep(min(self.poll_interval, 0.5))
+                continue
+            if epoch != self._epoch:
+                # a failover (or in-proc promotion) moved the epoch: the
+                # seq space may have diverged by the replication lag —
+                # the only loss-free answer is a full resync (the same
+                # verdict a 410 gives a reconnecting client)
+                await self._resync()
+                continue
+            for ev in events:
+                if ev["epoch"] != self._epoch:
+                    # an epoch boundary INSIDE the batch (in-proc
+                    # promotion): deliver nothing past it — resync
+                    await self._resync()
+                    break
+                self._publish(ev)
+                self._cursor = ev["seq"]
+            else:
+                self._cursor = max(self._cursor, cursor)
+
+    async def _resync(self) -> None:
+        for w in list(self._watchers.values()):
+            self._evict(w, EVICT_RESYNC)
+        try:
+            assert self._loop is not None
+            self._epoch, self._cursor = await self._loop.run_in_executor(
+                None, self._read_head)
+        except Exception:
+            await asyncio.sleep(min(self.poll_interval, 0.5))
+
+    def _publish(self, ev: dict) -> None:
+        self._c_events.inc()
+        for w in list(self._watchers.values()):
+            if not _visible(ev, w.project):
+                continue
+            try:
+                w.queue.put_nowait(ev)
+            except asyncio.QueueFull:
+                # the bounded-buffer contract: the laggard is evicted;
+                # it resumes by Last-Event-ID, everyone else never
+                # notices (the hub NEVER awaits a watcher)
+                self._evict(w, EVICT_SLOW)
+
+    def _evict(self, w: _Watcher, reason: str, count: bool = True) -> None:
+        if w.evicted:
+            return
+        w.evicted = True
+        w.reason = reason
+        for wid, cand in list(self._watchers.items()):
+            if cand is w:
+                del self._watchers[wid]
+        if count:
+            self._c_evicted[reason].inc()
+        # make room for the control sentinel if the queue is full — the
+        # dropped event is moot: eviction already means "resume by id"
+        try:
+            w.queue.put_nowait(_Ctl(reason))
+        except asyncio.QueueFull:
+            try:
+                w.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+            try:
+                w.queue.put_nowait(_Ctl(reason))
+            except asyncio.QueueFull:
+                pass
+
+    # -- subscription handler ---------------------------------------------
+
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        """GET /api/v1/streams/runs — the SSE subscription endpoint."""
+        q = request.rel_url.query
+        if "cursor" in q:
+            # a keyset-pagination cursor is a DIFFERENT token kind; with
+            # a Last-Event-ID (or at all) it is ambiguous which position
+            # the caller means — reject instead of guessing
+            return web.json_response(
+                {"error": "cursor is a pagination token; the stream "
+                          "resumes from since / Last-Event-ID only"},
+                status=400)
+        if not getattr(self.store, "_replicate", True):
+            return web.json_response(
+                {"error": "change feed disabled on this store "
+                          "(replicate=False)"}, status=503,
+                headers={"Retry-After": "30"})
+        # Last-Event-ID wins over ?since=: a browser EventSource re-sends
+        # its original query string on auto-reconnect, and the header is
+        # strictly newer than whatever the query asked for at open time
+        token = request.headers.get("Last-Event-ID") or q.get("since")
+        # a token-scoped subscription is PINNED to its project — the
+        # query must never widen it (?project=other on a scoped token
+        # would leak other tenants' deltas)
+        scope = request.get("scope_project")
+        project = scope if scope is not None else q.get("project")
+        if not self._running:
+            return web.json_response(
+                {"error": "stream hub not running"}, status=503,
+                headers={"Retry-After": "2"})
+        if len(self._watchers) >= self.max_watchers:
+            # bounded admission (the PR-12 shedding idiom): an honest
+            # 503 + Retry-After beats N+1 watchers all timing out
+            self._c_rejected.inc()
+            return web.json_response(
+                {"error": f"watcher limit reached "
+                          f"({self.max_watchers}); retry later"},
+                status=503, headers={"Retry-After": "2"})
+        assert self._loop is not None
+        resume_seq: Optional[int] = None
+        if token:
+            try:
+                # epoch validation: a pre-failover token raises
+                # StaleEpochError -> the conflict middleware's 410
+                resume_seq = self.store.parse_since(token)
+            except StaleEpochError:
+                raise
+            except (ValueError, TypeError):
+                # malformed token (non-numeric seq, '1:2:3'): the
+                # caller's input is wrong, not stale — 400, never a 500
+                return web.json_response(
+                    {"error": f"invalid feed token {token!r} (expected "
+                              "a change_seq int, optionally "
+                              "epoch-qualified as epoch:seq)"},
+                    status=400)
+
+        # register BEFORE any await: the queue starts buffering live
+        # events at exactly the hub cursor, so backlog (<= reg_cursor)
+        # plus queue (> reg_cursor) is gap-free and duplicate-free
+        w = _Watcher(self.buffer, project)
+        reg_cursor, reg_epoch = self._cursor, self._epoch
+        wid = self._next_id = self._next_id + 1
+        self._watchers[wid] = w
+        resp: Optional[web.StreamResponse] = None
+        try:
+            backlog: list[dict] = []
+            if resume_seq is not None and resume_seq < reg_cursor:
+                try:
+                    backlog = await self._loop.run_in_executor(
+                        None, self._catch_up, resume_seq, reg_cursor)
+                except CompactedLogError as e:
+                    self._drop(wid, w)
+                    return web.json_response(
+                        {"error": "feed token compacted away",
+                         "detail": str(e), "floor": e.floor}, status=410)
+
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Accel-Buffering": "no",
+            })
+            await resp.prepare(request)
+            if self.write_high_water is not None and \
+                    request.transport is not None:
+                request.transport.set_write_buffer_limits(
+                    high=self.write_high_water)
+                sock = request.transport.get_extra_info("socket")
+                if sock is not None:
+                    import socket as _socket
+
+                    try:
+                        sock.setsockopt(_socket.SOL_SOCKET,
+                                        _socket.SO_SNDBUF,
+                                        self.write_high_water)
+                    except OSError:
+                        pass
+            await self._write(resp, "retry: 3000\n\n".encode())
+            # hello carries the subscriber's loss-free anchor: the resume
+            # token when it brought one (deltas replay from exactly
+            # there), else the current head (list first, then trust
+            # deltas after this token)
+            last = resume_seq if resume_seq is not None else reg_cursor
+            hello = {"since": _fmt_token(reg_epoch, last),
+                     "epoch": reg_epoch}
+            await self._write(resp, _sse_frame(
+                "hello", _fmt_token(reg_epoch, last), hello))
+            for ev in backlog:
+                if not _visible(ev, project):
+                    continue
+                await self._write(resp, ev["frame"])
+                last = ev["seq"]
+            while True:
+                try:
+                    item = await asyncio.wait_for(
+                        w.queue.get(), timeout=self.keepalive_s)
+                except asyncio.TimeoutError:
+                    # liveness ping; also how a silently-dead peer is
+                    # noticed (the write eventually fails/times out)
+                    await self._write(resp, b": ping\n\n")
+                    continue
+                if isinstance(item, _Ctl):
+                    frame = _sse_frame(
+                        "resync" if item.reason == EVICT_RESYNC
+                        else "evicted",
+                        None,
+                        {"reason": item.reason, "epoch": self._epoch})
+                    try:
+                        # CancelledError must NOT be swallowed here — a
+                        # cancelled handler (client gone, shutdown) has
+                        # to unwind, not run on into write_eof
+                        await self._write(resp, frame)
+                    except (asyncio.TimeoutError, ConnectionError):
+                        pass
+                    break
+                if item["seq"] <= last:
+                    continue  # already sent via the backlog walk
+                await self._write(resp, item["frame"])
+                last = item["seq"]
+            try:
+                await resp.write_eof()
+            except Exception:
+                pass
+            return resp
+        except asyncio.TimeoutError:
+            # write timed out: the peer is wedged (kernel buffers full);
+            # count it as its own eviction reason, close, move on — a
+            # dead-peer stream ending is routine, not a handler error
+            if not w.evicted:
+                self._c_evicted[EVICT_WRITE_TIMEOUT].inc()
+            if resp is None:
+                raise
+            resp.force_close()
+            return resp
+        except ConnectionResetError:
+            # the peer vanished mid-stream — the normal way an SSE
+            # subscription ends; nothing to answer, nothing to log
+            if resp is None:
+                raise
+            return resp
+        finally:
+            self._drop(wid, w)
+
+    def _drop(self, wid: int, w: _Watcher) -> None:
+        if self._watchers.get(wid) is w:
+            del self._watchers[wid]
+
+    def _catch_up(self, after_seq: int, upto_seq: int) -> list[dict]:
+        """Backlog for a Last-Event-ID resume: changelog rows in
+        (after_seq, upto_seq], paged. Runs in the executor. Raises
+        CompactedLogError when the resume point predates the floor."""
+        rows: list[dict] = []
+        cursor = after_seq
+        while cursor < upto_seq:
+            page = self.store.get_changelog(cursor, 500)
+            if not page:
+                break
+            for rec in page:
+                if rec["seq"] > upto_seq:
+                    break
+                rows.append(rec)
+            cursor = page[-1]["seq"]
+            if len(page) < 500:
+                break
+        return self._to_events(rows)
+
+    async def _write(self, resp: web.StreamResponse, data: bytes) -> None:
+        await asyncio.wait_for(resp.write(data), timeout=self.write_timeout_s)
+
+
+def _visible(ev: dict, project: Optional[str]) -> bool:
+    """Project scoping: an unfiltered watcher sees everything; a filtered
+    one sees only its project — events whose project is UNKNOWN never
+    leak to a filtered watcher."""
+    if project is None:
+        return True
+    return ev.get("project") == project
+
+
+def _sse_frame(ev_type: str, ev_id: Optional[str], data: dict) -> bytes:
+    lines = []
+    if ev_id is not None:
+        lines.append(f"id: {ev_id}")
+    lines.append(f"event: {ev_type}")
+    lines.append(f"data: {json.dumps(data, separators=(',', ':'))}")
+    return ("\n".join(lines) + "\n\n").encode()
+
+
+def _raw_row_to_run(row: dict) -> dict:
+    """A changelog run payload (JSON columns as stored TEXT) -> the
+    client-shape run dict the listing endpoints serve, including the
+    derived heartbeat_age_s / heartbeat_step_age_s stamps the dashboard
+    badges read (same rules as Store.list_runs)."""
+    d = dict(row)
+    for c in Store._JSON_COLS:
+        if c in d:
+            d[c] = json.loads(d[c]) if d[c] else None
+    if d.get("status") in ("starting", "running"):
+        age = age_seconds(d.get("heartbeat_at") or d.get("started_at"))
+        if age is not None:
+            d["heartbeat_age_s"] = round(age, 3)
+        if d.get("heartbeat_step") is not None:
+            sage = age_seconds(d.get("heartbeat_step_at"))
+            if sage is not None:
+                d["heartbeat_step_age_s"] = round(sage, 3)
+    return d

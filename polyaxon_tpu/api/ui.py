@@ -27,6 +27,18 @@ trace (control-plane lifecycle spans + pod-side training spans from
 (⚠ when ``heartbeat_age_s`` > 60); the Metrics tab renders ``curve``
 events as line charts and ``confusion`` events as heat-shaded matrices.
 No build step, no dependencies — vanilla JS + fetch + inline SVG.
+
+v6 (live push, ISSUE 14): the 4s ``setInterval`` full re-render is DEAD.
+The page subscribes to the SSE change feed (``/api/v1/streams/runs``)
+and applies run deltas in place — run-table updates, the log tail,
+timeline and metrics refresh ride ``run``/``heartbeat`` events, so a
+steady-state session issues ZERO periodic re-list calls after the
+initial load (tested: tests/test_stream.py dashboard contract). Interval
+polling survives strictly as the fallback: when ``EventSource`` is
+missing or the stream fails 3+ times, the old ``refresh()`` loop takes
+over while the stream is re-probed in the background. A ``resync``
+control event (store failover / epoch rollover) triggers one full
+re-list plus a fresh subscription — never a silently-diverged table.
 """
 
 UI_HTML = """<!DOCTYPE html>
@@ -120,7 +132,8 @@ const COLORS = ["#0b68cb", "#cd2b31", "#18794e", "#b98900", "#7c3aed",
 const tokenBox = $("#token");
 tokenBox.value = localStorage.getItem("plx_token") || "";
 tokenBox.addEventListener("change", () => {
-  localStorage.setItem("plx_token", tokenBox.value); refresh();
+  localStorage.setItem("plx_token", tokenBox.value);
+  connectStream();  // carries the new token; its hello re-lists
 });
 function hdrs() {
   const t = tokenBox.value;
@@ -230,11 +243,26 @@ async function loadRuns() {
   if (!project) return;
   const f = $("#stFilter").value;
   const cur = pageCursors[page];
-  const resp = await j(`/api/v1/${project}/runs?paged=1&limit=${PAGE}` +
-                       (f ? `&status=${f}` : "") +
-                       (cur ? `&cursor=${encodeURIComponent(cur)}` : ""));
+  listInFlight = true;
+  let resp;
+  try {
+    resp = await j(`/api/v1/${project}/runs?paged=1&limit=${PAGE}` +
+                   (f ? `&status=${f}` : "") +
+                   (cur ? `&cursor=${encodeURIComponent(cur)}` : ""));
+  } catch (e) {
+    // a failed snapshot must not strand buffered deltas: apply them to
+    // the cache we still have (they are newer than it)
+    listInFlight = false;
+    replayDeltas();
+    throw e;
+  }
+  listInFlight = false;
   runCache = resp.results;
   if (resp.count != null) runTotal = resp.count;  // only page 1 carries it
+  // deltas that raced the snapshot re-apply ON TOP of it (the snapshot
+  // may predate them; a delta already reflected in it just re-updates
+  // its row, so the total never double-counts)
+  replayDeltas();
   pageCursors[page + 1] = resp.next_cursor;
   const lo = page * PAGE + (runCache.length ? 1 : 0);
   const hi = page * PAGE + runCache.length;
@@ -938,9 +966,199 @@ async function refresh() {
   try { await loadProjects(); await loadRuns();
         if (selected || compare) await render(); }
   catch (e) { $("#count").textContent = String(e); }
+  // the stream subscribes per-project; a project picked/switched after
+  // the subscription re-anchors it (first load subscribes before any
+  // project is known, so this fires exactly once at startup too)
+  if (es && esProject !== project) connectStream();
 }
-refresh();
-setInterval(refresh, 4000);
+// ---- live updates (ISSUE 14) ----------------------------------------------
+// The dashboard subscribes to the SSE change feed and applies run deltas
+// in place: after the initial load a steady-state session issues ZERO
+// periodic re-list calls. Polling survives only as the fallback — when
+// EventSource is missing (feature-detected) or the stream keeps failing.
+let es = null, esFails = 0, pollTimer = null, esRetryTimer = null;
+const POLL_MS = 4000;
+function startPolling() {
+  if (!pollTimer) pollTimer = setInterval(refresh, POLL_MS);
+}
+function stopPolling() {
+  if (pollTimer) { clearInterval(pollTimer); pollTimer = null; }
+}
+let tableTimer = null, detailTimer = null;
+function scheduleTable() {  // coalesce bursts of deltas into one render
+  if (tableTimer) return;
+  tableTimer = setTimeout(() => { tableTimer = null; renderRunsTable(); }, 250);
+}
+function scheduleDetail() { // live log tail / timeline / metrics refresh
+  if (detailTimer) return;
+  detailTimer = setTimeout(() => { detailTimer = null; render(); }, 1000);
+}
+// ALL deltas (runs, deletes, heartbeats) that race an in-flight listing
+// are BUFFERED and re-applied after the snapshot lands — a list response
+// older than a just-applied delta must not roll the row back (for a
+// DELETE the ghost row would otherwise persist forever: no further
+// event for a deleted run ever arrives to correct it)
+let listInFlight = false, pendingDeltas = [];
+function replayDeltas() {
+  const replay = pendingDeltas; pendingDeltas = [];
+  for (const [kind, d] of replay) {
+    if (kind === "run") applyRunDelta(d);
+    else if (kind === "delete") onRunDelete(d);
+    else onHeartbeat(d);
+  }
+}
+function onRunDelta(r) {
+  if (r.project !== project) return;
+  if (listInFlight) { pendingDeltas.push(["run", r]); return; }
+  applyRunDelta(r);
+}
+// filtered views re-list (throttled, EVENT-driven — still zero periodic
+// calls) whenever a delta may change membership: whether an off-page run
+// entered or left the filter is unknowable client-side, and guessing
+// diverges the count permanently now that polling is dead
+let relistTimer = null;
+function scheduleRelist() {
+  if (relistTimer) return;
+  relistTimer = setTimeout(() => { relistTimer = null; loadRuns(); }, 1500);
+}
+function applyRunDelta(r) {
+  const f = $("#stFilter").value;
+  const i = runCache.findIndex(x => x.uuid === r.uuid);
+  if (f) {
+    if (i >= 0 && r.status === f) {
+      runCache[i] = r; scheduleTable();       // in-place, still matching
+    } else if (i >= 0 || r.status === f) {
+      scheduleRelist();                        // membership changed
+    }
+  } else if (i >= 0) {
+    runCache[i] = r; scheduleTable();
+  } else if (r.status === "created") {
+    // only a CREATE is a new row; a transition/output-merge of an
+    // off-page run must neither fabricate a top-of-table entry nor
+    // inflate the total (its page re-renders when navigated to)
+    if (page === 0) {
+      runCache.unshift(r);
+      if (runCache.length > PAGE) runCache.pop();
+      scheduleTable();
+    }
+    runTotal++; $("#count").textContent = `${runTotal} runs`;
+  }
+  if (selected === r.uuid) scheduleDetail();
+}
+function onRunDelete(d) {
+  // delete events carry their project; another tenant's delete must not
+  // move this project's count (and an unknown-project delete only acts
+  // when the row is actually in the cache)
+  if (d.project && d.project !== project) return;
+  if (listInFlight) { pendingDeltas.push(["delete", d]); return; }
+  const f = $("#stFilter").value;
+  const i = runCache.findIndex(x => x.uuid === d.uuid);
+  if (i >= 0) { runCache.splice(i, 1); scheduleTable(); }
+  if (f) {
+    // whether the DELETED run matched the filter is unknowable for
+    // off-page rows — re-list (throttled, event-driven) for any
+    // same-project delete instead of guessing the count
+    if (i >= 0 || d.project === project) scheduleRelist();
+  } else if (d.project === project || i >= 0) {
+    if (runTotal > 0) { runTotal--; $("#count").textContent = `${runTotal} runs`; }
+    // a page-row delete under-fills the visible page while off-page
+    // rows exist — slide the next row in (event-driven re-list)
+    if (i >= 0 && runTotal >= PAGE) scheduleRelist();
+  }
+  if (selected === d.uuid) { selected = null; $("#dTitle").textContent = "Select a run"; }
+}
+function onHeartbeat(d) {
+  if (listInFlight) { pendingDeltas.push(["heartbeat", d]); return; }
+  const r = runCache.find(x => x.uuid === d.uuid);
+  if (r) {
+    r.heartbeat_age_s = 0;  // a fresh beat clears the zombie badge
+    if (typeof d.step === "number") {
+      if (r.heartbeat_step !== d.step) r.heartbeat_step_age_s = 0;
+      r.heartbeat_step = d.step;
+    }
+    scheduleTable();
+  }
+  // heartbeats are the liveness tick of the selected run's pod: refresh
+  // the log tail / timeline / metrics tabs without any interval polling
+  if (selected === d.uuid &&
+      ["logs", "timeline", "metrics", "overview"].includes(tab))
+    scheduleDetail();
+}
+let helloTimer = null, esProject = null;
+function connectStream() {
+  if (!window.EventSource) { refresh(); startPolling(); return; }
+  if (es) { es.close(); es = null; }
+  const t = tokenBox.value;
+  // subscribe scoped to the selected project: an unfiltered stream
+  // would ship every tenant's heartbeat ticks to every open tab (the
+  // hub filters server-side; the handlers' project guards stay as
+  // defense in depth). refresh() reconnects when the project changes.
+  esProject = project;
+  const qs = [];
+  if (project) qs.push("project=" + encodeURIComponent(project));
+  if (t) qs.push("access_token=" + encodeURIComponent(t));
+  es = new EventSource("/api/v1/streams/runs" +
+                       (qs.length ? "?" + qs.join("&") : ""));
+  // no-hello watchdog: a stream that CONNECTS but delivers nothing (a
+  // buffering proxy — the exact case the poll fallback exists for)
+  // never fires onerror; don't leave the page blank waiting for it
+  if (helloTimer) clearTimeout(helloTimer);
+  helloTimer = setTimeout(() => { refresh(); startPolling(); }, 5000);
+  // SUBSCRIBE-then-list: hello anchors the stream at the hub's head,
+  // and only then is the snapshot fetched — the other order loses any
+  // delta committed between the list response and the registration
+  // (deltas racing the fetch are buffered + replayed by loadRuns)
+  es.addEventListener("hello", () => {
+    if (helloTimer) { clearTimeout(helloTimer); helloTimer = null; }
+    esFails = 0; stopPolling(); refresh();
+  });
+  es.addEventListener("run", ev => { esFails = 0; onRunDelta(JSON.parse(ev.data)); });
+  es.addEventListener("delete", ev => onRunDelete(JSON.parse(ev.data)));
+  es.addEventListener("heartbeat", ev => onHeartbeat(JSON.parse(ev.data)));
+  es.addEventListener("resync", () => {
+    // an epoch rollover / store failover invalidated our position: full
+    // resync — subscribe FRESH (a reconnect carrying the stale
+    // Last-Event-ID would only earn a 410); the new hello re-lists
+    es.close(); es = null;
+    connectStream();
+  });
+  // "evicted" needs no handler: the server closes after it and the
+  // native EventSource reconnect carries Last-Event-ID — the hub
+  // replays what the bounded buffer dropped, loss-free
+  es.onerror = () => {
+    // repeated failures (server gone, proxy buffering, auth): fall back
+    // to interval polling, and keep probing the stream in the background
+    if (++esFails >= 3 && es) {
+      es.close(); es = null;
+      if (helloTimer) { clearTimeout(helloTimer); helloTimer = null; }
+      refresh();  // don't leave a blank page waiting for the first tick
+      startPolling();
+      if (!esRetryTimer) esRetryTimer = setTimeout(() => {
+        esRetryTimer = null; connectStream();
+      }, 60000);
+    }
+  };
+}
+// client-side badge aging: zombie/stalled suspicion is an AGE crossing a
+// threshold, and with polling dead nothing else moves the clock — a pod
+// that dies silently emits no events at all. Ages advance locally
+// between deltas (any fresh heartbeat/run event re-stamps them).
+const AGE_MS = 15000;
+setInterval(() => {
+  let crossed = false;
+  for (const r of runCache) {
+    if (!["starting", "running"].includes(r.status)) continue;
+    for (const k of ["heartbeat_age_s", "heartbeat_step_age_s"]) {
+      if (typeof r[k] !== "number") continue;
+      const before = r[k];
+      r[k] += AGE_MS / 1000;
+      const th = k === "heartbeat_age_s" ? 60 : 120;
+      if (before <= th && r[k] > th) crossed = true;
+    }
+  }
+  if (crossed) scheduleTable();
+}, AGE_MS);
+connectStream();  // hello triggers the initial refresh()
 </script>
 </body>
 </html>

@@ -15,6 +15,44 @@ from ..schemas.operation import V1Operation
 from ..schemas.statuses import V1Statuses, is_done
 
 
+def _iter_sse(resp, stop=None):
+    """Parse a streaming SSE response into event dicts
+    ``{"type", "id", "data"}`` (data JSON-decoded when possible).
+    Comment lines (``:``) are keepalives; ``stop`` is checked at every
+    line so a consumer can end the watch at a ping boundary."""
+    import json as _json
+
+    ev_type, ev_id, data_lines = None, None, []
+    for raw in resp.iter_lines(decode_unicode=True):
+        if stop is not None and stop.is_set():
+            return
+        if raw is None:
+            continue
+        line = raw if isinstance(raw, str) else raw.decode("utf-8")
+        if line == "":
+            if data_lines or ev_type:
+                data = "\n".join(data_lines)
+                try:
+                    data = _json.loads(data) if data else {}
+                except ValueError:
+                    data = {"raw": data}
+                yield {"type": ev_type or "message", "id": ev_id,
+                       "data": data}
+            ev_type, ev_id, data_lines = None, None, []
+            continue
+        if line.startswith(":"):
+            continue
+        field, _, value = line.partition(":")
+        value = value[1:] if value.startswith(" ") else value
+        if field == "event":
+            ev_type = value
+        elif field == "id":
+            ev_id = value
+        elif field == "data":
+            data_lines.append(value)
+        # "retry:" is honored by browsers; python consumers ignore it
+
+
 class ApiError(RuntimeError):
     def __init__(self, status: int, message: str,
                  retry_after: Optional[float] = None):
@@ -285,6 +323,134 @@ class RunClient(BaseClient):
 
     def delete(self, uuid: Optional[str] = None) -> dict:
         return self._json("DELETE", self._rpath(uuid=uuid))
+
+    # -- live change feed (ISSUE 14) ---------------------------------------
+
+    def watch_events(self, since: Optional[str] = None, *,
+                     project: bool = True, stop=None,
+                     connect_backoff_s: float = 0.5,
+                     read_timeout_s: float = 60.0):
+        """Generator over the live SSE change feed
+        (``GET /api/v1/streams/runs``): yields ``{"type", "id", "data"}``
+        dicts for every server event (``hello``/``run``/``delete``/
+        ``heartbeat``/``resync``/``evicted``).
+
+        Reconnect discipline (the ServeFront doctrine): **sticky** to the
+        working endpoint; **rotate only on connect failures and 503s**
+        (host-level verdicts — a dead host or a shedding/standby one);
+        **410 = resync**: the resume token predates a store failover or
+        was compacted away, so a ``{"type": "resync"}`` marker is yielded
+        (re-list your state!) and the stream re-subscribes WITHOUT a
+        token; **409 raises** — it is a verdict about the caller,
+        identical on every replica, never retried. A mid-stream drop or
+        an ``evicted`` close reconnects with ``Last-Event-ID`` — the hub
+        replays the missed window, loss-free and duplicate-free.
+
+        ``stop`` (a threading.Event) ends the generator at the next
+        event/keepalive boundary."""
+        import requests as _requests
+
+        token = since
+        attempt = 0
+        while stop is None or not stop.is_set():
+            headers = {"Accept": "text/event-stream"}
+            if token:
+                headers["Last-Event-ID"] = str(token)
+            params = {"project": self.project} if project else {}
+            url = f"{self.host}/api/v1/streams/runs"
+            try:
+                resp = self._session.get(
+                    url, headers=headers, params=params, stream=True,
+                    timeout=(self.timeout, read_timeout_s))
+            except (_requests.ConnectionError,
+                    _requests.Timeout):
+                # host-level: rotate (sticky thereafter), bounded backoff
+                self._host_idx = (self._host_idx + 1) % len(self.hosts)
+                attempt += 1
+                time.sleep(min(connect_backoff_s * (2 ** min(attempt, 4)),
+                               5.0))
+                continue
+            if resp.status_code == 503:
+                from ..resilience.retry import parse_retry_after
+
+                ra = parse_retry_after(resp.headers)
+                resp.close()
+                self._host_idx = (self._host_idx + 1) % len(self.hosts)
+                attempt += 1
+                time.sleep(min(ra if ra is not None else connect_backoff_s,
+                               5.0))
+                continue
+            if resp.status_code == 410:
+                # pre-failover / compacted token: full resync — the
+                # consumer must re-list, deltas resume from a fresh
+                # subscription (never silently skip the gap)
+                resp.close()
+                token = None
+                yield {"type": "resync", "id": None,
+                       "data": {"reason": "410"}}
+                continue
+            if resp.status_code >= 400:
+                body = resp.text[:500]
+                resp.close()
+                from ..resilience.retry import parse_retry_after
+
+                raise ApiError(resp.status_code, body,
+                               retry_after=parse_retry_after(resp.headers))
+            attempt = 0
+            resync = False
+            received = False
+            try:
+                for ev in _iter_sse(resp, stop=stop):
+                    received = True
+                    if ev.get("id"):
+                        token = ev["id"]
+                    if ev["type"] == "resync":
+                        resync = True
+                        yield ev
+                        break
+                    if ev["type"] == "evicted":
+                        # reconnect with Last-Event-ID: the hub replays
+                        # what the bounded buffer dropped
+                        yield ev
+                        break
+                    yield ev
+                else:
+                    # server closed cleanly (shutdown): reconnect with
+                    # the token — nothing was lost
+                    pass
+            except (_requests.ConnectionError, _requests.Timeout,
+                    _requests.exceptions.ChunkedEncodingError):
+                pass  # mid-stream drop: reconnect with Last-Event-ID
+            finally:
+                resp.close()
+            if resync:
+                token = None
+            if not received:
+                # a 200 that closed before a single event (a non-streaming
+                # intermediary, a server mid-drain): back off — an instant
+                # re-GET would hammer the endpoint and burn an admission
+                # slot per attempt (browsers honor `retry: 3000` here)
+                attempt += 1
+                time.sleep(min(connect_backoff_s * (2 ** min(attempt, 4)),
+                               5.0))
+
+    def watch(self, since: Optional[str] = None, *, stop=None,
+              heartbeats: bool = False):
+        """High-level live watch: yields ``{"type": "run", "run": {...}}``
+        for every committed run delta (plus ``delete``/``resync`` — and
+        ``heartbeat`` when asked). On ``resync`` the consumer must
+        re-list (``list_page``) before trusting further deltas."""
+        for ev in self.watch_events(since=since, stop=stop):
+            if ev["type"] == "run":
+                yield {"type": "run", "id": ev.get("id"), "run": ev["data"]}
+            elif ev["type"] == "delete":
+                yield {"type": "delete", "id": ev.get("id"),
+                       "uuid": ev["data"].get("uuid")}
+            elif ev["type"] == "resync":
+                yield {"type": "resync"}
+            elif ev["type"] == "heartbeat" and heartbeats:
+                yield {"type": "heartbeat", "id": ev.get("id"),
+                       "data": ev["data"]}
 
     # -- lifecycle ---------------------------------------------------------
 

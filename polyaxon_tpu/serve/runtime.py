@@ -14,7 +14,11 @@ Spec keys:
     checkpoint: checkpoint dir (a training run's outputs/checkpoints) or
         {path, step}; restored READ-ONLY via the PR-4 sha256 manifests —
         N replicas restoring the same manifest have zero side effects.
-        Absent: random init from ``init_seed`` (benchmarks/tests).
+    import: foreign-checkpoint boot (ISSUE 13 leftover): a path, or
+        {path, layout: flat|hf-llama|auto, dtype?, key_map?, transpose?}
+        ingested through partition.convert — `kind: service` runs serve
+        HF-layout exports directly. A native ``checkpoint:`` wins.
+        Both absent: random init from ``init_seed`` (benchmarks/tests).
     max_seq_len, block_size, num_blocks, max_slots, prefill_chunk,
     attn_impl ("gather" | "flash"), port (default 8000), bind,
     platform / num_cpu_devices (same semantics as the builtin trainer),
@@ -67,8 +71,13 @@ OUTPUT_KEYS = (
 
 def load_params(spec: dict, cfg) -> tuple[Any, dict]:
     """Weights for the engine: read-only checkpoint restore when the spec
-    names one (torn newest steps fall back per the manifest walk), random
-    init otherwise. Returns (params, provenance dict for outputs)."""
+    names one (torn newest steps fall back per the manifest walk), a
+    FOREIGN checkpoint via ``import:`` (ISSUE 13 leftover / ROADMAP item
+    3: ``kind: service`` runs boot from flat / HF-llama layouts through
+    the partition engine — read-only by construction, nothing in the
+    serve path ever writes weights back), random init otherwise. A native
+    ``checkpoint:`` wins over ``import:`` — mirroring the trainer's
+    resume-beats-re-import rule. Returns (params, provenance dict)."""
     ckpt = spec.get("checkpoint")
     if ckpt:
         from ..train.checkpoint import CheckpointConfig, Checkpointer
@@ -81,6 +90,32 @@ def load_params(spec: dict, cfg) -> tuple[Any, dict]:
         params = raw["params"] if isinstance(raw, dict) else raw.params
         return params, {"restored_from": path,
                         "restored_step": int(restored_step)}
+    imp = spec.get("import")
+    if imp:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec
+
+        from ..partition import convert as pconvert
+
+        if isinstance(imp, str):
+            imp = {"path": imp}
+        # a serving replica is one host, one engine: every param is
+        # replicated on a trivial single-device mesh (multi-replica
+        # scale-out is N pods, not one sharded pod), so the same lazy
+        # per-shard readers the trainer uses land here whole-but-cheap
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("serve",))
+        params = pconvert.import_params(
+            imp["path"], cfg, mesh,
+            layout=imp.get("layout", "auto"),
+            rules=[(".*", PartitionSpec())],
+            dtype=imp.get("dtype"),
+            key_map=imp.get("key_map"),
+            transpose=imp.get("transpose"),
+        )
+        return params, {"imported_from": imp["path"],
+                        "import_layout": imp.get("layout", "auto"),
+                        "restored_step": -1}
     import jax
 
     from ..models import transformer

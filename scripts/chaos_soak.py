@@ -20,7 +20,19 @@ Usage:
         [--rounds 1] [--keep] \
         [--kill-agent] [--split-brain] [--kills 2] [--lease-ttl 0.8] \
         [--agents 4] [--num-shards 8] [--rolling-kill] \
-        [--store-outage] [--serve-faults] [--metrics-dump [PATH]]
+        [--store-outage] [--serve-faults] [--watcher-faults] \
+        [--metrics-dump [PATH]]
+
+``--watcher-faults`` (ISSUE 14) runs the live-push fault soak: an SSE
+watcher fleet over the real HTTP server with a [primary, warm standby]
+store front — the primary is killed mid-stream (standby promotes, every
+watcher resyncs and follows the new epoch), a seeded slow watcher and a
+zero-drain watcher are evicted off their bounded buffers (the slow one
+resumes via ``Last-Event-ID``, loss-free), and a watcher burst past
+``max_watchers`` is shed with 503 + Retry-After. Exit 0 requires every
+surviving watcher's delta sequence to EQUAL the commit-ordered changelog
+oracle for each of its subscription segments (no lost, no duplicated,
+no reordered events) with all shedding visible in the strict scrape.
 
 ``--agents N`` (ISSUE 6) runs the SHARDED fleet soak: N concurrently-
 active agents split the shard leases over one store; ``--rolling-kill``
@@ -1373,6 +1385,445 @@ def run_serve_fault_soak(workdir: str, seed: int = 2024,
         srv.stop()
 
 
+class _SoakWatcher:
+    """A healthy change-feed subscriber (RunClient.watch_events on a
+    thread) recording every event + resync marker with receive times."""
+
+    def __init__(self, url: str, name: str, since=None):
+        import threading
+
+        from polyaxon_tpu.client import RunClient
+
+        self.name = name
+        self.events: list[dict] = []
+        self.stop = threading.Event()
+        self.error = None
+        self._client = RunClient(url, project="p")
+        self._since = since
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name=f"watcher-{name}")
+        self.thread.start()
+
+    def _run(self):
+        try:
+            for ev in self._client.watch_events(since=self._since,
+                                                stop=self.stop):
+                ev["t"] = time.monotonic()
+                self.events.append(ev)
+        except Exception as e:
+            self.error = repr(e)
+
+    def close(self):
+        self.stop.set()
+        self.thread.join(timeout=15)
+
+
+def _parse_token(token: str) -> tuple[int, int]:
+    """(epoch, seq) from a feed token ('seq' or 'epoch:seq')."""
+    s = str(token)
+    if ":" in s:
+        e, _, q = s.partition(":")
+        return int(e), int(q)
+    return 0, int(s)
+
+
+def _watcher_segments(events: list[dict]) -> list[dict]:
+    """Split a watcher's event log into hello-delimited segments:
+    [{since_seq, epoch, seqs: [...], alien: N}] — one per
+    (re)subscription. ``alien`` counts events whose epoch differs from
+    the segment's hello epoch: the hub must NEVER deliver a cross-epoch
+    event without a resync in between (the seq spaces diverged), so any
+    alien event is itself an oracle violation — counted, not filtered
+    away."""
+    segs: list[dict] = []
+    cur = None
+    for ev in events:
+        if ev["type"] == "hello":
+            epoch, seq = _parse_token(ev["data"]["since"])
+            cur = {"since_seq": seq, "epoch": epoch, "seqs": [],
+                   "alien": 0}
+            segs.append(cur)
+        elif ev["type"] in ("run", "delete", "heartbeat") and ev.get("id"):
+            epoch, seq = _parse_token(ev["id"])
+            if cur is None:
+                continue
+            if epoch == cur["epoch"]:
+                cur["seqs"].append(seq)
+            else:
+                cur["alien"] += 1
+    return segs
+
+
+def _reference_seqs(store, lo: int, hi: int, epoch: int) -> list[int]:
+    """Commit-ordered forwarded-event seqs in (lo, hi] on ``store`` for
+    ``epoch`` — the oracle a watcher's received sequence must equal."""
+    out: list[int] = []
+    cursor = lo
+    while cursor < hi:
+        rows = store.get_changelog(cursor, 500)
+        if not rows:
+            break
+        for r in rows:
+            if r["seq"] > hi:
+                break
+            if r["op"] in ("run", "delete_run", "heartbeat") \
+                    and int(r["epoch"]) == epoch:
+                out.append(r["seq"])
+        cursor = rows[-1]["seq"]
+        if len(rows) < 500:
+            break
+    return out
+
+
+def _raw_sse_reader(host: str, port: int, *, rcvbuf: int = 4096,
+                    chunk: int = 256, delay_s: float = 0.0,
+                    stop=None, deadline_s: float = 120.0) -> dict:
+    """A raw-socket SSE consumer with a TINY receive buffer: ``delay_s``
+    per chunk makes it the seeded SLOW watcher (falls behind the feed →
+    bounded-buffer eviction), ``delay_s`` huge + stop makes it the
+    zero-drain one. Returns {ids, evicted, eof} when the server closes
+    (eviction), ``stop`` fires, or ``deadline_s`` passes — the deadline
+    bounds the soak even when the eviction it expects never happens
+    (the regression then reads as a clean failed check, not a hang)."""
+    import re
+    import socket
+
+    deadline = time.monotonic() + deadline_s
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+    s.settimeout(10.0)
+    s.connect((host, port))
+    s.sendall(b"GET /api/v1/streams/runs?project=p HTTP/1.1\r\n"
+              b"Host: soak\r\nAccept: text/event-stream\r\n\r\n")
+    buf = b""
+    ids: list[str] = []
+    evicted = eof = False
+    id_re = re.compile(rb"^id: (.+)$", re.M)
+    try:
+        while (stop is None or not stop.is_set()) \
+                and time.monotonic() < deadline:
+            try:
+                data = s.recv(chunk)
+            except socket.timeout:
+                continue
+            if not data:
+                eof = True
+                break
+            buf += data
+            # parse only COMPLETE lines; the partial tail stays buffered
+            # (a chunk-straddling `id:` must not be recorded twice)
+            nl = buf.rfind(b"\n")
+            if nl >= 0:
+                complete, buf = buf[:nl + 1], buf[nl + 1:]
+                for m in id_re.finditer(complete):
+                    ids.append(m.group(1).decode())
+                if b"event: evicted" in complete:
+                    evicted = True
+                    break
+            if delay_s:
+                time.sleep(delay_s)
+    finally:
+        s.close()
+    return {"ids": ids, "evicted": evicted, "eof": eof}
+
+
+def run_watcher_fault_soak(workdir: str, seed: int = 2024, n_jobs: int = 6,
+                           watchers: int = 5, burst: int = 4,
+                           lease_ttl: float = 0.8,
+                           timeout: float = 300.0) -> dict:
+    """The ISSUE 14 live-push fault soak: an SSE watcher fleet over the
+    REAL HTTP server whose store front is [primary, warm standby], under
+    a job wave + a heartbeat pump, with every failure mode the stream
+    layer contracts for:
+
+    - a seeded SLOW watcher (throttled raw-socket reads) overflows its
+      bounded buffer → evicted with reason=slow → RESUMES via
+      ``Last-Event-ID`` and must land gap-free exactly after its last
+      received event (no full re-list);
+    - a STALLED (zero-drain) watcher → evicted; the hub and every other
+      watcher never block on it;
+    - the PRIMARY STORE is killed mid-stream → the standby promotes
+      (epoch bump) → the hub broadcasts ``resync`` → every healthy
+      watcher re-subscribes and follows the post-failover history; a
+      pinned pre-failover token is deterministically 410'd;
+    - a watcher BURST past ``max_watchers`` → every extra subscription
+      sheds 503 + Retry-After.
+
+    Exit contract (gates ``--watcher-faults`` exit 0): every surviving
+    watcher's delta sequence EQUALS the oracle changelog subsequence for
+    each of its subscription segments — no lost, no duplicated, no
+    reordered events — and all shedding/evictions are visible in the
+    strict /metrics scrape."""
+    import threading
+
+    import requests as _requests
+
+    from polyaxon_tpu.api.replication import FailoverStore, ReplicatedStandby
+    from polyaxon_tpu.api.server import ApiServer
+    from polyaxon_tpu.api.store import Store
+    from polyaxon_tpu.obs.metrics import MetricsRegistry, parse_prometheus
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.resilience import OutageStore
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    rng = random.Random(seed)
+    reg = MetricsRegistry()
+    primary = Store(":memory:", metrics=reg)
+    gate = OutageStore(primary)
+    standby = Store(":memory:", metrics=reg)
+    snap_dir = os.path.join(workdir, "snapshots")
+    primary.snapshot(snap_dir)
+    repl = ReplicatedStandby(gate, standby, poll_interval=0.02,
+                             promote_after=lease_ttl,
+                             snapshot_dir=snap_dir)
+    repl.bootstrap()
+    repl.start()
+    front = FailoverStore([gate, standby])
+    srv = ApiServer(store=front,
+                    artifacts_root=os.path.join(workdir, "artifacts"),
+                    port=0)
+    hub = srv.api.stream
+    hub.poll_interval = 0.05
+    hub.keepalive_s = 1.0
+    hub.buffer = 64
+    hub.write_high_water = 4096   # small transport slice: a wedged peer
+    hub.write_timeout_s = 3.0     # fills its bounded queue fast
+    hub.max_watchers = watchers + 3  # fleet + slow + stalled + 1 spare
+    srv.start()
+
+    cluster = FakeCluster(os.path.join(workdir, ".cluster"))
+    agents = [LocalAgent(front, workdir, backend="cluster",
+                         cluster=cluster, poll_interval=0.05,
+                         lease_ttl=lease_ttl, num_shards=4,
+                         max_parallel=4).start() for _ in range(2)]
+
+    fleet: list[_SoakWatcher] = []
+    pump_stop = threading.Event()
+    checks: dict = {}
+    try:
+        # -- fleet up ------------------------------------------------------
+        fleet = [_SoakWatcher(srv.url, f"w{i}") for i in range(watchers)]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not all(
+                any(e["type"] == "hello" for e in w.events)
+                for w in fleet):
+            time.sleep(0.05)
+
+        # -- heartbeat pump: the event volume that makes laggards lag ------
+        # (a real compilable job spec: the agents pick every created run
+        # up, and an invalid spec would just crash their compile pass)
+        pump_run = front.create_run(
+            "p", spec=_wave_specs(1, random.Random(seed + 999))[0],
+            name="pump")
+
+        def _pump():
+            i = 0
+            while not pump_stop.is_set():
+                try:
+                    front.heartbeat(pump_run["uuid"], step=i)
+                except Exception:
+                    pass  # outage window mid-failover: keep pumping
+                i += 1
+                time.sleep(0.005)
+
+        pump = threading.Thread(target=_pump, daemon=True, name="pump")
+        pump.start()
+
+        # -- phase A: slow + stalled watchers get evicted ------------------
+        stalled_stop = threading.Event()
+        stalled_out: dict = {}
+
+        def _stalled():
+            stalled_out.update(_raw_sse_reader(
+                "127.0.0.1", srv.port, rcvbuf=4096, chunk=64,
+                delay_s=30.0, stop=stalled_stop))
+
+        stalled_t = threading.Thread(target=_stalled, daemon=True)
+        stalled_t.start()
+        slow_out = _raw_sse_reader("127.0.0.1", srv.port, rcvbuf=4096,
+                                   chunk=256, delay_s=0.05)
+        checks["slow_watcher_evicted"] = (slow_out["evicted"]
+                                          or slow_out["eof"])
+        # resume by Last-Event-ID: a fresh subscription from the slow
+        # watcher's LAST received event must be accepted (not 410) and
+        # replay the missed window gap-free
+        resume_token = slow_out["ids"][-1] if slow_out["ids"] else None
+        resumed = _SoakWatcher(srv.url, "slow-resumed",
+                               since=resume_token)
+        fleet.append(resumed)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not any(
+                e["type"] == "hello" for e in resumed.events):
+            time.sleep(0.05)
+        checks["slow_watcher_resumed"] = (
+            resume_token is not None and resumed.error is None
+            and any(e["type"] == "hello" for e in resumed.events))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not stalled_out:
+            time.sleep(0.1)
+        stalled_stop.set()
+        stalled_t.join(timeout=10)
+
+        # -- the wave ------------------------------------------------------
+        uuids = [front.create_run("p", spec=s, name=s.get("name"))["uuid"]
+                 for s in _wave_specs(n_jobs, rng)]
+
+        # -- phase B: kill the primary mid-stream --------------------------
+        time.sleep(rng.uniform(0.4, 1.0))
+        pinned_token = primary.feed_token(primary.current_seq())
+        gate.kill_store()
+        t_kill = time.monotonic()
+        deadline = time.monotonic() + 10 * lease_ttl
+        while time.monotonic() < deadline and not repl.promoted:
+            time.sleep(0.02)
+        checks["standby_promoted"] = repl.promoted
+        promote_s = round(time.monotonic() - t_kill, 3)
+        # a pre-failover token against the live endpoint: 410, full stop
+        r410 = _requests.get(
+            f"{srv.url}/api/v1/streams/runs",
+            headers={"Last-Event-ID": pinned_token}, timeout=10,
+            stream=True)
+        checks["pre_failover_token_410"] = r410.status_code == 410
+        r410.close()
+
+        # -- quiesce: wave terminal, watchers caught up --------------------
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = [front.get_run(u) for u in uuids]
+            if all(r["status"] in ("succeeded", "failed", "stopped")
+                   for r in rows):
+                break
+            time.sleep(0.1)
+        statuses = {r["name"]: r["status"]
+                    for r in (front.get_run(u) for u in uuids)}
+        pump_stop.set()
+        pump.join(timeout=10)
+        sentinel = front.create_run(
+            "p", spec=_wave_specs(1, random.Random(seed + 998))[0],
+            name="sentinel")
+
+        def _caught_up(w: _SoakWatcher) -> bool:
+            return any(e["type"] == "run"
+                       and e["data"].get("uuid") == sentinel["uuid"]
+                       for e in w.events)
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not all(
+                _caught_up(w) for w in fleet):
+            time.sleep(0.1)
+        checks["all_watchers_saw_sentinel"] = all(
+            _caught_up(w) for w in fleet)
+        # every watcher subscribed before the kill must have been told to
+        # resync (the hub's epoch-rollover broadcast)
+        checks["every_watcher_saw_resync"] = all(
+            any(e["type"] == "resync" for e in w.events) for w in fleet)
+
+        # -- phase D: burst over max_watchers ------------------------------
+        hub.max_watchers = len(fleet)  # fleet holds every slot
+        shed = []
+        for _ in range(burst):
+            r = _requests.get(f"{srv.url}/api/v1/streams/runs",
+                              timeout=10, stream=True)
+            shed.append((r.status_code, r.headers.get("Retry-After")))
+            r.close()
+        checks["burst_shed_503"] = all(code == 503 for code, _ in shed)
+        checks["burst_retry_after"] = all(ra is not None
+                                          for _, ra in shed)
+
+        # -- the oracle: every segment equals the changelog subsequence ----
+        seq_ok = True
+        seq_detail = {}
+        for w in fleet:
+            for i, seg in enumerate(_watcher_segments(w.events)):
+                if seg["alien"]:
+                    # a cross-epoch event inside a segment means the hub
+                    # leaked a diverged seq space without a resync
+                    seq_ok = False
+                    seq_detail[f"{w.name}#{i}"] = {
+                        "epoch": seg["epoch"], "alien": seg["alien"]}
+                    continue
+                ref_store = standby if seg["epoch"] >= 1 else primary
+                got = seg["seqs"]
+                if not got:
+                    continue
+                ref = _reference_seqs(ref_store, seg["since_seq"],
+                                      got[-1], seg["epoch"])
+                if got != ref:
+                    seq_ok = False
+                    seq_detail[f"{w.name}#{i}"] = {
+                        "epoch": seg["epoch"],
+                        "got": got[-20:], "want": ref[-20:],
+                        "lost": len(set(ref) - set(got)),
+                        "dup": len(got) - len(set(got)),
+                    }
+        checks["delta_sequences_match_oracle"] = seq_ok
+        checks["no_watcher_errors"] = all(w.error is None for w in fleet)
+
+        # -- scrape reconciliation -----------------------------------------
+        scrape = reg.render()
+        fams = parse_prometheus(scrape)
+        evs = fams.get("polyaxon_stream_evictions_total", {})
+        slow_evs = sum(v for k, v in evs.items() if 'reason="slow"' in k)
+        wt_evs = sum(v for k, v in evs.items()
+                     if 'reason="write_timeout"' in k)
+        resync_evs = sum(v for k, v in evs.items()
+                         if 'reason="resync"' in k)
+        rejected = sum(fams.get(
+            "polyaxon_stream_rejected_total", {}).values())
+        checks["scrape_slow_evictions"] = (slow_evs + wt_evs) >= 2
+        checks["scrape_resync_evictions"] = resync_evs >= watchers
+        checks["scrape_rejected_counts_burst"] = rejected >= burst
+        checks["scrape_events_flowed"] = sum(fams.get(
+            "polyaxon_stream_events_total", {}).values()) > 0
+
+        return {
+            "ok": all(checks.values()),
+            "checks": checks,
+            "statuses": statuses,
+            "promote_s": promote_s,
+            "epoch": standby.current_epoch(),
+            "slow_watcher_ids": len(slow_out["ids"]),
+            "stalled_watcher": {k: (len(v) if isinstance(v, list) else v)
+                                for k, v in stalled_out.items()},
+            "shed": shed,
+            "seq_detail": seq_detail,
+            "metrics_text": scrape,
+        }
+    finally:
+        pump_stop.set()
+        for w in fleet:
+            w.close()
+        repl.stop()
+        for a in agents[:-1]:
+            a.drain()
+        for a in agents[-1:]:
+            a.stop()
+        srv.stop()
+
+
+def _run_watcher_faults_mode(args) -> int:
+    root = tempfile.mkdtemp(prefix="plx-watcher-fault-soak-")
+    ok = True
+    final_scrape = ""
+    try:
+        for i in range(args.rounds):
+            out = run_watcher_fault_soak(
+                os.path.join(root, f"round-{i}"), seed=args.seed + i,
+                lease_ttl=args.lease_ttl, timeout=args.timeout)
+            final_scrape = out.pop("metrics_text")
+            ok = ok and out["ok"]
+            print(json.dumps({"round": i, **out}))
+    finally:
+        if args.keep:
+            print(json.dumps({"workdir": root}))
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+    if args.metrics_dump:
+        _dump_metrics(args.metrics_dump, final_scrape)
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
 def _run_serve_faults_mode(args) -> int:
     root = tempfile.mkdtemp(prefix="plx-serve-fault-soak-")
     ok = True
@@ -1596,6 +2047,16 @@ def main() -> int:
                         "id, every 429 with Retry-After, drained pods "
                         "deleted only after in-flight completion, all "
                         "via the strict /metrics scrape")
+    p.add_argument("--watcher-faults", action="store_true",
+                   help="live-push fault soak (ISSUE 14): an SSE watcher "
+                        "fleet over the real HTTP server with a "
+                        "[primary, standby] store front — store kill + "
+                        "promotion mid-stream, seeded slow/stalled "
+                        "watcher evictions with Last-Event-ID resume, a "
+                        "watcher burst past max_watchers; exit 0 only if "
+                        "every surviving watcher's delta sequence equals "
+                        "the changelog oracle (no lost/dup/reordered) "
+                        "and all shedding shows in the strict scrape")
     p.add_argument("--store-outage", action="store_true",
                    help="store-survivability soak (ISSUE 7): kill the "
                         "PRIMARY STORE mid-wave under a sharded agent "
@@ -1620,15 +2081,19 @@ def main() -> int:
     args = p.parse_args()
 
     if args.lock_witness and (args.train_faults or args.serve_traffic
-                              or args.serve_faults or args.store_outage):
+                              or args.serve_faults or args.store_outage
+                              or args.watcher_faults):
         # refuse rather than silently run unwitnessed: an operator who
         # asked for the witness must not read a lucky exit 0 as
         # "cycle-free" when no locks were instrumented
         print("--lock-witness is wired into the kill-agent soaks only "
               "(--kill-agent / --agents N / --rolling-kill); it does not "
               "instrument --train-faults / --serve-traffic / "
-              "--serve-faults / --store-outage", file=sys.stderr)
+              "--serve-faults / --store-outage / --watcher-faults",
+              file=sys.stderr)
         return 2
+    if args.watcher_faults:
+        return _run_watcher_faults_mode(args)
     if args.train_faults:
         return _run_train_faults_mode(args)
     if args.serve_faults:

@@ -31,6 +31,11 @@ if [ "$1" = "--fast" ]; then
     exit 0
 fi
 
+# gate 3 carries the perf regression smokes too: sched_bench's saturated
+# burst (tests/test_sched_bench.py) and dashboard_bench's SSE fan-out
+# p95 bound (tests/test_dashboard_bench.py, ISSUE 14) both run as
+# ordinary tier-1 tests — a change that hands the scheduler win back to
+# polling or regresses publish->deliver latency fails this gate.
 echo "== gate 3/3: tier-1 tests (ROADMAP.md verify) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,294 @@
+"""Dashboard-under-load bench (ISSUE 14 / ROADMAP item 4 done-bar).
+
+Loads the control-plane API at thousands of runs with ~100 concurrent
+SSE watchers and measures what "heavy read traffic" costs:
+
+- **page render**: the dashboard's initial listing call
+  (``?paged=1&limit=100`` — keyset envelope, O(page) however many runs
+  exist) plus the static UI shell, p50/p95 over repeated fetches;
+- **delta fan-out**: publish→deliver latency of live change-feed events
+  (commit of a transition → the SSE frame landing in each watcher),
+  p50/p95 across every (event, watcher) pair — the number that says
+  whether push actually beats the 4s poll it replaced;
+- **bytes/watcher**: wire cost per subscriber for the whole round —
+  what a poll-based dashboard would multiply by runs/PAGE every 4s,
+  the push layer pays once per delta.
+
+Watchers consume the RAW SSE byte stream (requests, one thread each) so
+the byte accounting is the wire truth; the publisher drives paced
+transitions through the shared store and stamps publish times after the
+commit returns (the latency measured is the feed's, not sqlite's).
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/dashboard_bench.py \
+        [--runs 5000,10000] [--watchers 100] [--transitions 300] \
+        [--rate 100] [--out bench_artifacts/dashboard_bench_r14.json]
+    ... --smoke     # scaled-down tier-1 shape: 200 runs, 10 watchers,
+                    # asserts the p95 publish->deliver bound (exit 1 on
+                    # regression); wired into tests/test_dashboard_bench.py
+
+Results land in docs/PERFORMANCE.md ("Dashboard under load") next to
+the sched_bench rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+JOB_SPEC = {"run": {"kind": "job"}}
+
+#: --smoke acceptance bound: p95 publish->deliver under 2s on a 2-CPU
+#: container with 10 watchers (measured ~0.1-0.3s; the bound is a
+#: regression tripwire, not a target)
+SMOKE_P95_BOUND_S = 2.0
+
+
+class _RawWatcher(threading.Thread):
+    """One SSE subscriber over the raw byte stream: records receive time
+    per (uuid, status) run event and counts every wire byte."""
+
+    def __init__(self, url: str, idx: int):
+        super().__init__(daemon=True, name=f"watcher-{idx}")
+        self.url = url
+        self.received: dict[tuple, float] = {}
+        self.bytes = 0
+        self.events = 0
+        self.hello = threading.Event()
+        self.stop = threading.Event()
+        self.error = None
+
+    def run(self) -> None:
+        import requests
+
+        try:
+            resp = requests.get(
+                f"{self.url}/api/v1/streams/runs",
+                headers={"Accept": "text/event-stream"}, stream=True,
+                timeout=(10, 120))
+            if resp.status_code != 200:
+                self.error = f"HTTP {resp.status_code}"
+                return
+            ev_type, data_lines = None, []
+            for raw in resp.iter_lines():
+                if self.stop.is_set():
+                    break
+                if raw is None:
+                    continue
+                self.bytes += len(raw) + 1  # the \n iter_lines stripped
+                line = raw.decode("utf-8")
+                if line == "":
+                    now = time.monotonic()
+                    if ev_type == "hello":
+                        self.hello.set()
+                    elif ev_type == "run" and data_lines:
+                        self.events += 1
+                        d = json.loads("\n".join(data_lines))
+                        self.received[(d["uuid"], d["status"])] = now
+                    ev_type, data_lines = None, []
+                    continue
+                if line.startswith(":"):
+                    continue
+                field, _, value = line.partition(":")
+                value = value[1:] if value.startswith(" ") else value
+                if field == "event":
+                    ev_type = value
+                elif field == "data":
+                    data_lines.append(value)
+            resp.close()
+        except Exception as e:  # surfaced in the result row
+            self.error = repr(e)
+
+
+def _quantiles(vals: list) -> dict:
+    if not vals:
+        return {"p50_ms": None, "p95_ms": None, "max_ms": None}
+    vs = sorted(vals)
+    return {
+        "p50_ms": round(statistics.median(vs) * 1e3, 2),
+        "p95_ms": round(vs[min(int(0.95 * (len(vs) - 1)), len(vs) - 1)]
+                        * 1e3, 2),
+        "max_ms": round(vs[-1] * 1e3, 2),
+    }
+
+
+def run_bench(n_runs: int = 5000, watchers: int = 100,
+              transitions: int = 300, rate: float = 100.0,
+              settle_s: float = 10.0) -> dict:
+    """One bench round at ``n_runs`` seeded runs / ``watchers``
+    subscribers / ``transitions`` paced live deltas. Returns the result
+    row (page render + fan-out latency + bytes)."""
+    import requests
+
+    from polyaxon_tpu.api.server import ApiServer
+
+    import tempfile
+
+    art = tempfile.mkdtemp(prefix="plx-dash-bench-")
+    srv = ApiServer(db_path=":memory:", artifacts_root=art, port=0)
+    srv.api.stream.max_watchers = max(watchers + 8, 64)
+    srv.api.stream.poll_interval = 0.25
+    srv.start()
+    store = srv.store
+    fleet: list[_RawWatcher] = []
+    try:
+        # -- seed the run table (bulk: one transaction per 500) -----------
+        t0 = time.monotonic()
+        for lo in range(0, n_runs, 500):
+            batch = [{"spec": JOB_SPEC, "name": f"r{lo + i}"}
+                     for i in range(min(500, n_runs - lo))]
+            store.create_runs("bench", batch)
+        seed_s = time.monotonic() - t0
+
+        # -- page render under the full table -----------------------------
+        page_samples, shell_samples = [], []
+        for _ in range(10):
+            t = time.monotonic()
+            r = requests.get(
+                f"{srv.url}/api/v1/bench/runs",
+                params={"paged": 1, "limit": 100}, timeout=30)
+            r.raise_for_status()
+            page_samples.append(time.monotonic() - t)
+        assert len(r.json()["results"]) == 100
+        for _ in range(5):
+            t = time.monotonic()
+            requests.get(f"{srv.url}/ui", timeout=30).raise_for_status()
+            shell_samples.append(time.monotonic() - t)
+
+        # -- subscribe the watcher fleet ----------------------------------
+        fleet = [_RawWatcher(srv.url, i) for i in range(watchers)]
+        for w in fleet:
+            w.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not all(
+                w.hello.is_set() or w.error for w in fleet):
+            time.sleep(0.05)
+        connected = [w for w in fleet if w.hello.is_set()]
+        if len(connected) < watchers:
+            errs = {w.error for w in fleet if w.error}
+            raise RuntimeError(
+                f"only {len(connected)}/{watchers} watchers connected: "
+                f"{errs}")
+
+        # -- paced live deltas: publish time stamped AFTER the commit.
+        # Each pass over the run set advances one rung of the lifecycle
+        # ladder so every transition is LEGAL (a repeated queued->queued
+        # is a no-change edge the store rejects — it would publish
+        # nothing and read as a delivery failure).
+        ladder = ("compiled", "queued", "scheduled", "starting",
+                  "running", "succeeded")
+        uuids = [r["uuid"] for r in store.list_runs(
+            project="bench", limit=transitions, order="asc")]
+        if transitions > len(ladder) * len(uuids):
+            raise ValueError(
+                f"--transitions {transitions} exceeds the "
+                f"{len(ladder)} legal transitions x {len(uuids)} runs; "
+                "raise --runs or lower --transitions")
+        published: dict[tuple, float] = {}
+        period = 1.0 / rate if rate > 0 else 0.0
+        for i in range(transitions):
+            uuid = uuids[i % len(uuids)]
+            status = ladder[i // len(uuids)]
+            store.transition(uuid, status)
+            published[(uuid, status)] = time.monotonic()
+            if period:
+                time.sleep(period)
+
+        # -- drain: every watcher must see the final event ----------------
+        last_key = list(published)[-1]
+        deadline = time.monotonic() + settle_s + transitions / max(rate, 1)
+        while time.monotonic() < deadline:
+            if all(last_key in w.received for w in connected):
+                break
+            time.sleep(0.05)
+        for w in fleet:
+            w.stop.set()
+
+        # -- aggregate ----------------------------------------------------
+        lat: list[float] = []
+        delivered = 0
+        for w in connected:
+            for key, t_pub in published.items():
+                t_recv = w.received.get(key)
+                if t_recv is not None:
+                    delivered += 1
+                    lat.append(max(t_recv - t_pub, 0.0))
+        expected = len(published) * len(connected)
+        row = {
+            "runs": n_runs,
+            "watchers": len(connected),
+            "transitions": len(published),
+            "seed_s": round(seed_s, 2),
+            "page_render": _quantiles(page_samples),
+            "ui_shell": _quantiles(shell_samples),
+            "fanout": _quantiles(lat),
+            "delivered": delivered,
+            "expected": expected,
+            "delivery_ratio": round(delivered / max(expected, 1), 4),
+            "bytes_per_watcher": int(statistics.mean(
+                [w.bytes for w in connected])),
+            "bytes_per_event_per_watcher": round(statistics.mean(
+                [w.bytes / max(w.events, 1) for w in connected]), 1),
+            "watcher_errors": sorted({w.error for w in fleet if w.error}),
+        }
+        return row
+    finally:
+        for w in fleet:
+            w.stop.set()
+        srv.stop()
+        import shutil
+
+        shutil.rmtree(art, ignore_errors=True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser("dashboard_bench", description=__doc__)
+    p.add_argument("--runs", default="5000,10000",
+                   help="comma-separated run-table sizes")
+    p.add_argument("--watchers", type=int, default=100)
+    p.add_argument("--transitions", type=int, default=300)
+    p.add_argument("--rate", type=float, default=100.0,
+                   help="published transitions per second")
+    p.add_argument("--smoke", action="store_true",
+                   help="tier-1 shape: 200 runs, 10 watchers, 60 deltas; "
+                        f"exit 1 unless fan-out p95 < {SMOKE_P95_BOUND_S}s")
+    p.add_argument("--out", default=None,
+                   help="write the result rows as JSON (default for full "
+                        "runs: bench_artifacts/dashboard_bench_r14.json)")
+    args = p.parse_args()
+
+    if args.smoke:
+        row = run_bench(n_runs=200, watchers=10, transitions=60, rate=60.0)
+        ok = (row["delivery_ratio"] == 1.0
+              and row["fanout"]["p95_ms"] is not None
+              and row["fanout"]["p95_ms"] < SMOKE_P95_BOUND_S * 1e3)
+        print(json.dumps({"smoke": row, "ok": ok}))
+        return 0 if ok else 1
+
+    sizes = [int(s) for s in str(args.runs).split(",") if s]
+    rows = []
+    for n in sizes:
+        row = run_bench(n_runs=n, watchers=args.watchers,
+                        transitions=args.transitions, rate=args.rate)
+        rows.append(row)
+        print(json.dumps(row))
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_artifacts", "dashboard_bench_r14.json")
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump({"rows": rows,
+                   "box": f"cpu x{os.cpu_count()}"}, f, indent=2)
+    print(json.dumps({"artifact": out}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,26 @@
+"""R3 reproducer — the ISSUE 14 SSE-handler class: a blocking store
+call inside the async SSE subscription handler. The stream endpoint
+lives on the SAME event loop as every other API route — a changelog
+backlog read (sqlite) or a catch-up sleep run inline doesn't just slow
+THIS watcher, it wedges every concurrent watcher's queue drain, the
+``/api/v1/changelog`` replication tail (the PR-7 false-promotion
+trigger), and the hub's own fan-out task."""
+
+import sqlite3
+import time
+
+
+class MiniStreamHub:
+    def __init__(self, store):
+        self.store = store
+
+    async def handle(self, request):
+        # BAD: sqlite on the loop — the backlog read for a Last-Event-ID
+        # resume can be thousands of rows
+        conn = sqlite3.connect("/tmp/db.sqlite")
+        rows = conn.execute("SELECT * FROM changelog").fetchall()
+        # BAD: a blocking backoff wedges every watcher, not this one
+        time.sleep(0.2)
+        # BAD: O(whole database) store verb inline in the handler
+        snap = self.store.snapshot("/tmp/stream-snap")
+        return rows, snap

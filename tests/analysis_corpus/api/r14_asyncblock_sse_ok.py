@@ -1,0 +1,22 @@
+"""R3 clean twin — the api/stream.py shape: every store touch from the
+SSE handler or the hub's tail task ships to the default executor via a
+nested sync def; loop-side waits are asyncio primitives (queue get /
+wait_for), never time.sleep."""
+
+import asyncio
+
+
+class MiniStreamHub:
+    def __init__(self, store):
+        self.store = store
+
+    def _catch_up(self, after_seq):
+        # runs on a worker thread, not the loop
+        return self.store.get_changelog(after_seq, 500)
+
+    async def handle(self, request, after_seq):
+        loop = asyncio.get_event_loop()
+        backlog = await loop.run_in_executor(None, self._catch_up,
+                                             after_seq)
+        await asyncio.sleep(0)  # loop-friendly yield
+        return backlog

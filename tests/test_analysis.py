@@ -84,6 +84,9 @@ BAD_CASES = [
     ("donation", "r6_donated_reuse_bad.py", 2),
     # serve decode deadlines joined the clock rule's scope in ISSUE 12
     ("clock", "serve/r12_wall_clock_decode_deadline_bad.py", 3),
+    # the ISSUE 14 SSE surface: blocking store calls inside the async
+    # stream handler (the PR-7 blocked-loop class on a new endpoint)
+    ("asyncblock", "api/r14_asyncblock_sse_bad.py", 3),
 ]
 
 OK_TWINS = [
@@ -94,6 +97,7 @@ OK_TWINS = [
     "r5_contract_ok.py",
     "r6_rebind_ok.py",
     "serve/r12_monotonic_decode_ok.py",
+    "api/r14_asyncblock_sse_ok.py",
 ]
 
 
@@ -192,6 +196,15 @@ class TestEngine:
                                         "by_rule"}
         assert set(data["rules"]) == {"fence", "lockorder", "asyncblock",
                                       "clock", "metrics", "donation"}
+
+    def test_clock_rule_scope_covers_the_stream_module(self):
+        """ISSUE 14 satellite: api/stream.py (eviction write deadlines,
+        keepalive windows, backoff floors) is inside the clock rule's
+        scope — wall clock there would make an NTP step evict watchers."""
+        from polyaxon_tpu.analysis.rules.clock import _in_scope
+
+        assert _in_scope("polyaxon_tpu/api/stream.py")
+        assert _in_scope("api/stream.py")
 
     def test_fence_verbs_cover_the_fenced_store_contract(self):
         """The rule's verb list and FencedStore._FENCED must not drift:

@@ -504,6 +504,46 @@ class TestStoreOutageSoak:
 
 
 # ---------------------------------------------------------------------------
+# 4b. live push control plane (ISSUE 14): SSE watcher fleet surviving
+#     store failover, slow-watcher eviction + resume, and a watcher burst
+# ---------------------------------------------------------------------------
+
+
+class TestWatcherFaultSoak:
+    def test_sse_fleet_survives_failover_eviction_and_burst(
+            self, tmp_path):
+        """ISSUE 14 acceptance soak: an SSE watcher fleet over the real
+        HTTP server with a [primary, warm standby] store front — the
+        primary is killed mid-stream (standby promotes, every watcher is
+        resynced onto the new epoch and follows it), a seeded slow
+        watcher and a zero-drain watcher are evicted off their bounded
+        buffers (the slow one resumes via Last-Event-ID — accepted, not
+        410'd, gap-free), a pinned pre-failover token answers a
+        deterministic 410, and a watcher burst past max_watchers sheds
+        503 + Retry-After. Exit contract: every surviving watcher's
+        delta sequence EQUALS the commit-ordered changelog oracle for
+        each of its subscription segments (no lost, no duplicated, no
+        reordered events), and every eviction/shed is visible in the
+        strict /metrics scrape."""
+        from chaos_soak import run_watcher_fault_soak
+
+        from polyaxon_tpu.obs import parse_prometheus
+
+        out = run_watcher_fault_soak(str(tmp_path / "soak"), seed=2024,
+                                     timeout=180)
+        assert out["ok"], out["checks"] | {"seq": out["seq_detail"]}
+        assert out["epoch"] >= 1, out
+        assert all(v == "succeeded"
+                   for v in out["statuses"].values()), out
+        fams = parse_prometheus(out["metrics_text"])
+        assert sum(fams.get("polyaxon_stream_rejected_total",
+                            {}).values()) >= 4
+        evs = fams.get("polyaxon_stream_evictions_total", {})
+        assert sum(v for k, v in evs.items()
+                   if 'reason="resync"' in k) >= 5
+
+
+# ---------------------------------------------------------------------------
 # 5. self-healing training pods (ISSUE 8): hang -> watchdog -> resume,
 #    NaN burst -> skip -> rollback -> parity, watchdog-less hang ->
 #    stall-aware reap -> slice restart — all to oracle final-loss parity

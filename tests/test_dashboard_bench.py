@@ -1,0 +1,34 @@
+"""Tier-1 smoke for the dashboard-under-load bench (ISSUE 14): the
+scaled-down round (200 runs, 10 watchers, 60 live deltas) must deliver
+EVERY delta to EVERY watcher and keep the publish→deliver p95 under the
+smoke bound — the regression tripwire for the SSE fan-out path, wired
+into scripts/ci.sh via the tier-1 suite the same way sched_bench's
+smoke is."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from dashboard_bench import SMOKE_P95_BOUND_S, run_bench  # noqa: E402
+
+
+class TestDashboardBenchSmoke:
+    def test_smoke_delivers_everything_within_bound(self):
+        last = None
+        for _ in range(2):  # perf smoke on a shared box: best of 2
+            row = run_bench(n_runs=200, watchers=10, transitions=60,
+                            rate=60.0)
+            last = row
+            if (row["delivery_ratio"] == 1.0
+                    and not row["watcher_errors"]
+                    and row["fanout"]["p95_ms"] is not None
+                    and row["fanout"]["p95_ms"] < SMOKE_P95_BOUND_S * 1e3):
+                break
+        assert last["delivery_ratio"] == 1.0, last
+        assert not last["watcher_errors"], last
+        assert last["fanout"]["p95_ms"] < SMOKE_P95_BOUND_S * 1e3, last
+        # the keyset page render stays O(page): single-digit ms at 200
+        # runs, and the full-size artifact pins it flat at 5k/10k
+        assert last["page_render"]["p50_ms"] < 500, last

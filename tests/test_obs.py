@@ -80,6 +80,12 @@ EXPECTED_FAMILIES = {
     "polyaxon_serve_preemptions_total",
     "polyaxon_serve_draining",
     "polyaxon_serve_request_retries_total",
+    # live push (ISSUE 14): the SSE change-feed hub's fan-out/shedding
+    # state — registered by the ApiApp's StreamHub from birth
+    "polyaxon_stream_watchers",
+    "polyaxon_stream_events_total",
+    "polyaxon_stream_evictions_total",
+    "polyaxon_stream_rejected_total",
 }
 
 

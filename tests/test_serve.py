@@ -1226,6 +1226,55 @@ class TestServeRuntimeWeights:
         _drive(engine, [req])
         assert len(req.out_tokens) == 3
 
+    def test_import_hf_layout_checkpoint_serves_generate(
+            self, tiny, tmp_path):
+        """ISSUE 14 satellite (the ROADMAP item-3 leftover): a `kind:
+        service` run boots from a FOREIGN checkpoint — an HF-llama-layout
+        export imports through the partition engine into the serve
+        runtime (read-only by construction: nothing in the serve path
+        ever writes weights back) and serves a real ``/generate``
+        request with greedy token parity against the native weights."""
+        import requests
+
+        from polyaxon_tpu.partition import convert
+        from polyaxon_tpu.serve.runtime import build_engine
+
+        params, cfg = tiny
+        hf = tmp_path / "hf-ckpt"
+        convert.export_hf_llama(params, cfg, str(hf))
+        engine = build_engine({
+            "model": "llama-tiny",
+            "import": {"path": str(hf), "layout": "hf-llama"},
+            "max_slots": 2, "block_size": 8, "prefill_chunk": 16,
+            "max_seq_len": 64,
+        })
+        assert engine.provenance["imported_from"] == str(hf)
+        # the imported tree IS the native tree (round-trip identity)
+        got = jax.tree.leaves(engine.params)
+        want = jax.tree.leaves(params)
+        assert all(np.allclose(np.asarray(a), np.asarray(b),
+                               atol=1e-6, rtol=1e-6)
+                   for a, b in zip(got, want))
+        engine.start()
+        srv = _EngineServer(engine)
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            r = requests.post(f"{url}/generate", json={
+                "prompt": "imported", "max_new_tokens": 5}, timeout=120)
+            assert r.status_code == 200
+            out = r.json()
+            assert len(out["tokens"]) == 5
+        finally:
+            srv.stop()
+            engine.stop()
+        # greedy parity: native-weight engine produces the same tokens
+        ref = ServeEngine(params, cfg, max_slots=2, block_size=8,
+                          prefill_chunk=16, max_seq_len=64)
+        req = ref.submit([b % cfg.vocab_size for b in b"imported"],
+                         SamplingParams(max_new_tokens=5))
+        _drive(ref, [req])
+        assert out["tokens"] == req.out_tokens
+
 
 # -- autoscale control loop --------------------------------------------------
 

@@ -1,0 +1,459 @@
+"""Tier-1 suite for the SSE change-feed fan-out (ISSUE 14).
+
+Covers the stream layer's robustness contract end to end over real HTTP:
+commit-ordered live deltas, loss-free ``Last-Event-ID`` resume, the
+feed-token edge cases (pre-failover token -> 410, exactly-compacted seq
+-> 410, cursor ambiguity -> 400, epoch rollover mid-stream -> resync),
+bounded-buffer eviction that never starves healthy watchers, the
+``max_watchers`` admission bound (503 + Retry-After), EventSource query
+auth, client endpoint rotation, and the dashboard's zero-re-list
+contract under SSE.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+import requests
+
+from polyaxon_tpu.api import stream as stream_mod
+from polyaxon_tpu.api.server import ApiServer
+from polyaxon_tpu.api.store import Store
+from polyaxon_tpu.client import RunClient
+
+JOB = {"run": {"kind": "job"}}
+
+
+@pytest.fixture()
+def srv(tmp_path):
+    server = ApiServer(db_path=":memory:",
+                       artifacts_root=str(tmp_path / "art"), port=0)
+    # fast clocks: instant tail wakes, sub-second pings so watchers can
+    # stop at a keepalive boundary
+    server.api.stream.poll_interval = 0.05
+    server.api.stream.keepalive_s = 0.4
+    server.start()
+    yield server
+    server.stop()
+
+
+class Collector:
+    """A watch_events consumer on a thread, recording every event."""
+
+    def __init__(self, client: RunClient, since=None):
+        self.events: list = []
+        self.stop = threading.Event()
+        self.error = None
+        self._client = client
+        self._since = since
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        try:
+            for ev in self._client.watch_events(
+                    since=self._since, stop=self.stop):
+                self.events.append(ev)
+        except Exception as e:  # surfaced by the test, not swallowed
+            self.error = e
+
+    def of_type(self, *types) -> list:
+        return [e for e in self.events if e["type"] in types]
+
+    def wait_for(self, pred, timeout=15.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred(self):
+                return True
+            time.sleep(0.02)
+        return pred(self)
+
+    def close(self):
+        self.stop.set()
+        self.thread.join(timeout=10)
+
+
+def _statuses(col: Collector, uuid: str) -> list:
+    return [e["data"]["status"] for e in col.of_type("run")
+            if e["data"]["uuid"] == uuid]
+
+
+class TestLiveDeltas:
+    def test_run_deltas_arrive_in_commit_order(self, srv):
+        col = Collector(RunClient(srv.url, project="p"))
+        try:
+            assert col.wait_for(lambda c: c.of_type("hello"))
+            run = srv.store.create_run("p", spec=JOB, name="w1")
+            for st in ("compiled", "queued", "scheduled", "starting",
+                       "running", "succeeded"):
+                srv.store.transition(run["uuid"], st)
+            assert col.wait_for(
+                lambda c: "succeeded" in _statuses(c, run["uuid"]))
+            got = _statuses(col, run["uuid"])
+            assert got == ["created", "compiled", "queued", "scheduled",
+                           "starting", "running", "succeeded"]
+            # ids are the feed tokens, strictly increasing
+            seqs = [int(e["id"].split(":")[-1])
+                    for e in col.of_type("run")]
+            assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        finally:
+            col.close()
+
+    def test_heartbeat_and_delete_events(self, srv):
+        run = srv.store.create_run("p", spec=JOB, name="hb")
+        srv.store.transition(run["uuid"], "running", force=True)
+        col = Collector(RunClient(srv.url, project="p"))
+        try:
+            assert col.wait_for(lambda c: c.of_type("hello"))
+            srv.store.heartbeat(run["uuid"], step=7)
+            assert col.wait_for(lambda c: any(
+                e["data"].get("step") == 7
+                for e in c.of_type("heartbeat")))
+            srv.store.delete_run(run["uuid"])
+            assert col.wait_for(lambda c: any(
+                e["data"].get("uuid") == run["uuid"]
+                for e in c.of_type("delete")))
+        finally:
+            col.close()
+
+    def test_project_scoping(self, srv):
+        col = Collector(RunClient(srv.url, project="mine"))
+        try:
+            assert col.wait_for(lambda c: c.of_type("hello"))
+            srv.store.create_run("other", spec=JOB, name="not-mine")
+            mine = srv.store.create_run("mine", spec=JOB, name="mine-1")
+            assert col.wait_for(lambda c: any(
+                e["data"]["uuid"] == mine["uuid"]
+                for e in c.of_type("run")))
+            assert all(e["data"]["project"] == "mine"
+                       for e in col.of_type("run"))
+        finally:
+            col.close()
+
+    def test_last_event_id_resumes_loss_free(self, srv):
+        col = Collector(RunClient(srv.url, project="p"))
+        try:
+            assert col.wait_for(lambda c: c.of_type("hello"))
+            run = srv.store.create_run("p", spec=JOB, name="resume")
+            assert col.wait_for(
+                lambda c: _statuses(c, run["uuid"]) == ["created"])
+        finally:
+            col.close()
+        token = col.of_type("run")[-1]["id"]
+        # committed while NOBODY is subscribed
+        for st in ("compiled", "queued", "scheduled"):
+            srv.store.transition(run["uuid"], st)
+        col2 = Collector(RunClient(srv.url, project="p"), since=token)
+        try:
+            assert col2.wait_for(
+                lambda c: "scheduled" in _statuses(c, run["uuid"]))
+            # the missed window replays exactly once, in order, with no
+            # duplicate of the event the token points at
+            assert _statuses(col2, run["uuid"]) == [
+                "compiled", "queued", "scheduled"]
+        finally:
+            col2.close()
+
+
+class TestFeedTokenEdges:
+    def test_cursor_param_is_rejected_400(self, srv):
+        r = requests.get(f"{srv.url}/api/v1/streams/runs",
+                         params={"cursor": "2026|abc"}, timeout=5)
+        assert r.status_code == 400
+        r = requests.get(f"{srv.url}/api/v1/streams/runs",
+                         params={"cursor": "2026|abc"},
+                         headers={"Last-Event-ID": "5"}, timeout=5)
+        assert r.status_code == 400
+
+    def test_malformed_token_is_400_not_500(self, srv):
+        for bad in ("garbage", "1:2:3", "1:xyz"):
+            r = requests.get(f"{srv.url}/api/v1/streams/runs",
+                             headers={"Last-Event-ID": bad}, timeout=5,
+                             stream=True)
+            assert r.status_code == 400, (bad, r.status_code)
+            r.close()
+
+    def test_exactly_compacted_token_410_and_floor_token_ok(
+            self, srv, tmp_path):
+        from polyaxon_tpu.api.replication import snapshot_to
+
+        run = srv.store.create_run("p", spec=JOB, name="c")
+        for st in ("compiled", "queued"):
+            srv.store.transition(run["uuid"], st)
+        snapshot_to(srv.store, str(tmp_path / "snap"), keep=0)
+        floor = srv.store.current_seq()
+        # a token BELOW the floor: the pruned range is gone -> 410
+        r = requests.get(f"{srv.url}/api/v1/streams/runs",
+                         headers={"Last-Event-ID": str(floor - 1)},
+                         timeout=5, stream=True)
+        assert r.status_code == 410
+        assert "compacted" in r.text
+        r.close()
+        # exactly AT the floor: nothing pruned is needed -> subscribes
+        # and resumes loss-free
+        col = Collector(RunClient(srv.url, project="p"),
+                        since=str(floor))
+        try:
+            assert col.wait_for(lambda c: c.of_type("hello"))
+            srv.store.transition(run["uuid"], "scheduled")
+            assert col.wait_for(
+                lambda c: "scheduled" in _statuses(c, run["uuid"]))
+            assert col.error is None
+        finally:
+            col.close()
+
+    def test_epoch_rollover_mid_stream_resyncs_and_410s_old_token(
+            self, srv):
+        col = Collector(RunClient(srv.url, project="p"))
+        try:
+            assert col.wait_for(lambda c: c.of_type("hello"))
+            run = srv.store.create_run("p", spec=JOB, name="epoch")
+            assert col.wait_for(
+                lambda c: _statuses(c, run["uuid"]) == ["created"])
+            old_token = col.of_type("run")[-1]["id"]
+            srv.store.promote()
+            # the hub broadcasts resync; the client re-subscribes fresh
+            assert col.wait_for(lambda c: c.of_type("resync"))
+            srv.store.transition(run["uuid"], "compiled")
+            assert col.wait_for(
+                lambda c: "compiled" in _statuses(c, run["uuid"]))
+            # post-rollover events carry epoch-qualified ids
+            last = [e for e in col.of_type("run")
+                    if e["data"]["status"] == "compiled"][-1]
+            assert last["id"].startswith("1:")
+        finally:
+            col.close()
+        # the pre-rollover token is deterministically dead: 410
+        r = requests.get(f"{srv.url}/api/v1/streams/runs",
+                         headers={"Last-Event-ID": old_token},
+                         timeout=5, stream=True)
+        assert r.status_code == 410
+        r.close()
+
+    def test_replicate_off_store_answers_503(self, tmp_path):
+        server = ApiServer(
+            db_path=":memory:", artifacts_root=str(tmp_path / "a"),
+            port=0, store=Store(":memory:", replicate=False))
+        server.start()
+        try:
+            r = requests.get(f"{server.url}/api/v1/streams/runs",
+                             timeout=5)
+            assert r.status_code == 503
+            assert r.headers.get("Retry-After")
+        finally:
+            server.stop()
+
+
+class TestBackpressure:
+    def test_zero_drain_watcher_evicted_while_others_receive(self):
+        """The bounded-buffer contract at the hub layer: a watcher that
+        never drains overflows its queue and is evicted with a control
+        sentinel; a healthy watcher subscribed to the same hub receives
+        every event, in order, unaffected."""
+        store = Store(":memory:")
+        hub = stream_mod.StreamHub(store, buffer=2, poll_interval=0.02)
+
+        async def scenario():
+            await hub.start()
+            stuck = stream_mod._Watcher(2, None)
+            healthy = stream_mod._Watcher(256, None)
+            hub._watchers[101] = stuck
+            hub._watchers[102] = healthy
+            run = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: store.create_run("p", spec=JOB, name="z"))
+            for st in ("compiled", "queued", "scheduled", "starting",
+                       "running"):
+                await asyncio.get_running_loop().run_in_executor(
+                    None, store.transition, run["uuid"], st)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if healthy.queue.qsize() >= 6 and stuck.evicted:
+                    break
+                await asyncio.sleep(0.02)
+            got = []
+            while not healthy.queue.empty():
+                got.append(healthy.queue.get_nowait())
+            await hub.stop()
+            return stuck, healthy, got
+
+        stuck, healthy, got = asyncio.run(scenario())
+        assert stuck.evicted and stuck.reason == stream_mod.EVICT_SLOW
+        assert 101 not in hub._watchers
+        # the stuck watcher's queue ends with the eviction sentinel
+        items = []
+        while not stuck.queue.empty():
+            items.append(stuck.queue.get_nowait())
+        assert isinstance(items[-1], stream_mod._Ctl)
+        # the healthy watcher saw the whole transition sequence in order
+        statuses = [ev["data"]["status"] for ev in got
+                    if not isinstance(ev, stream_mod._Ctl)
+                    and ev["type"] == "run"]
+        assert statuses == ["created", "compiled", "queued", "scheduled",
+                            "starting", "running"]
+        ev_metric = hub.metrics.get("polyaxon_stream_evictions_total",
+                                    {"reason": "slow"})
+        assert ev_metric is not None and ev_metric.value >= 1
+
+    def test_max_watchers_sheds_with_503_retry_after(self, srv):
+        srv.api.stream.max_watchers = 1
+        col = Collector(RunClient(srv.url, project="p"))
+        try:
+            assert col.wait_for(lambda c: c.of_type("hello"))
+            r = requests.get(f"{srv.url}/api/v1/streams/runs",
+                             timeout=5, stream=True)
+            assert r.status_code == 503
+            assert r.headers.get("Retry-After")
+            r.close()
+            rej = srv.store.metrics.get("polyaxon_stream_rejected_total")
+            assert rej is not None and rej.value >= 1
+            # the admitted watcher is untouched by the shed
+            run = srv.store.create_run("p", spec=JOB, name="adm")
+            assert col.wait_for(lambda c: any(
+                e["data"]["uuid"] == run["uuid"]
+                for e in c.of_type("run")))
+        finally:
+            col.close()
+
+    def test_watchers_gauge_tracks_subscriptions(self, srv):
+        gauge = srv.store.metrics.get("polyaxon_stream_watchers")
+        assert gauge is not None and gauge.value == 0
+        col = Collector(RunClient(srv.url, project="p"))
+        try:
+            assert col.wait_for(lambda c: c.of_type("hello"))
+            assert gauge.value == 1
+        finally:
+            col.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and gauge.value != 0:
+            time.sleep(0.05)
+        assert gauge.value == 0
+
+
+class TestAuthAndRotation:
+    def test_access_token_query_param(self, tmp_path):
+        server = ApiServer(db_path=":memory:",
+                           artifacts_root=str(tmp_path / "a"), port=0,
+                           auth_token="sekrit")
+        server.api.stream.keepalive_s = 0.4
+        server.start()
+        try:
+            r = requests.get(f"{server.url}/api/v1/streams/runs",
+                             timeout=5)
+            assert r.status_code == 401
+            r = requests.get(f"{server.url}/api/v1/streams/runs",
+                             params={"access_token": "nope"}, timeout=5)
+            assert r.status_code == 401
+            r = requests.get(
+                f"{server.url}/api/v1/streams/runs",
+                params={"access_token": "sekrit"}, timeout=5, stream=True)
+            assert r.status_code == 200
+            first = next(r.iter_lines(decode_unicode=True))
+            assert first.startswith("retry:")
+            r.close()
+        finally:
+            server.stop()
+
+    def test_scoped_token_cannot_widen_its_project_filter(self, tmp_path):
+        """A project-scoped token's subscription is pinned to its
+        project: ``?project=other`` must not leak other tenants'
+        deltas."""
+        server = ApiServer(db_path=":memory:",
+                           artifacts_root=str(tmp_path / "a"), port=0,
+                           auth_token="admin")
+        server.api.stream.poll_interval = 0.05
+        server.api.stream.keepalive_s = 0.4
+        server.start()
+        try:
+            scoped = server.store.create_token(project="mine")["token"]
+            r = requests.get(
+                f"{server.url}/api/v1/streams/runs",
+                params={"access_token": scoped, "project": "other"},
+                timeout=5, stream=True)
+            assert r.status_code == 200
+            server.store.create_run("other", spec=JOB, name="leak")
+            mine = server.store.create_run("mine", spec=JOB, name="ok")
+            got = []
+            deadline = time.monotonic() + 10
+            for line in r.iter_lines(decode_unicode=True):
+                if line and line.startswith("data:") and "uuid" in line:
+                    got.append(line)
+                if any(mine["uuid"] in l for l in got) \
+                        or time.monotonic() > deadline:
+                    break
+            r.close()
+            assert any(mine["uuid"] in l for l in got)
+            assert not any("leak" in l or "other" in l for l in got), got
+        finally:
+            server.stop()
+
+    def test_watch_rotates_off_dead_endpoint(self, srv):
+        dead = "http://127.0.0.1:1"  # connect-refused instantly
+        client = RunClient([dead, srv.url], project="p", timeout=3)
+        col = Collector(client)
+        try:
+            assert col.wait_for(lambda c: c.of_type("hello"))
+            # sticky after the rotation
+            assert client.host == srv.url
+        finally:
+            col.close()
+
+
+class TestDashboardContract:
+    def test_ui_streams_not_polls(self):
+        from polyaxon_tpu.api.ui import UI_HTML
+
+        assert "EventSource" in UI_HTML
+        # the unconditional 4s full re-render is dead; polling survives
+        # only as the feature-detected / failure-triggered fallback
+        assert "setInterval(refresh, 4000)" not in UI_HTML
+        assert "startPolling" in UI_HTML and "connectStream" in UI_HTML
+        assert "access_token=" in UI_HTML
+
+    def test_sse_session_issues_zero_relists_after_initial_load(
+            self, tmp_path):
+        """The satellite regression: a dashboard-shaped session (one
+        initial paged list + an SSE subscription) stays current through
+        live deltas with ZERO further listing calls."""
+        from aiohttp import web
+
+        listing_calls = []
+
+        @web.middleware
+        async def counting(request, handler):
+            if request.path.endswith("/runs") and (
+                    "paged" in request.rel_url.query
+                    or "cursor" in request.rel_url.query
+                    or "offset" in request.rel_url.query):
+                listing_calls.append(str(request.rel_url))
+            return await handler(request)
+
+        server = ApiServer(db_path=":memory:",
+                           artifacts_root=str(tmp_path / "a"), port=0,
+                           extra_middlewares=[counting])
+        server.api.stream.poll_interval = 0.05
+        server.api.stream.keepalive_s = 0.4
+        server.start()
+        try:
+            client = RunClient(server.url, project="p")
+            server.store.create_run("p", spec=JOB, name="seed")
+            page = client.list_page(limit=100)     # the initial load
+            assert len(page["results"]) == 1
+            assert len(listing_calls) == 1
+            col = Collector(client)
+            try:
+                assert col.wait_for(lambda c: c.of_type("hello"))
+                run = server.store.create_run("p", spec=JOB, name="live")
+                for st in ("compiled", "queued", "scheduled", "starting",
+                           "running", "succeeded"):
+                    server.store.transition(run["uuid"], st)
+                assert col.wait_for(
+                    lambda c: "succeeded" in _statuses(c, run["uuid"]))
+                # the session followed a whole lifecycle live — and never
+                # re-listed
+                assert len(listing_calls) == 1, listing_calls
+            finally:
+                col.close()
+        finally:
+            server.stop()
