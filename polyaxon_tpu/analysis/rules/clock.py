@@ -36,8 +36,12 @@ from ..engine import Finding, Project, Rule, call_target, import_aliases
 #: api/stream.py (ISSUE 14) rides the api/ prefix: SSE keepalive windows
 #: and eviction write deadlines are durations too — an NTP step must not
 #: evict a healthy watcher (scope pinned by test_analysis).
+#: tenancy/ joined in ISSUE 15: token-bucket refill arithmetic and the
+#: agent's quota-refresh TTL are durations — a wall-clock bucket would
+#: mint (or confiscate) a burst of admission tokens on every NTP step
+#: (corpus pair: analysis_corpus/tenancy/r15_*).
 SCOPE_PREFIXES = ("api/", "scheduler/", "operator/", "resilience/",
-                  "serve/")
+                  "serve/", "tenancy/")
 #: plus individual clock-sensitive modules outside those trees
 SCOPE_FILES = ("train/watchdog.py",)
 

@@ -58,12 +58,33 @@ def _not_found(msg="not found"):
 class ApiApp:
     def __init__(self, store: Store, artifacts_root: str,
                  auth_token: Optional[str] = None,
-                 extra_middlewares: Optional[list] = None):
+                 extra_middlewares: Optional[list] = None,
+                 rate_limit: Optional[float] = None,
+                 rate_limit_burst: Optional[float] = None):
         """``extra_middlewares`` run BEFORE auth — the chaos harness
-        injects its flaky-HTTP middleware here (resilience/chaos.py)."""
+        injects its flaky-HTTP middleware here (resilience/chaos.py).
+
+        ``rate_limit`` (requests/second) arms per-tenant token buckets on
+        the WRITE endpoints (ISSUE 15): a tenant's burst past its bucket
+        answers 429 + Retry-After (the PR-12 serve idiom) instead of
+        letting one tenant's create storm starve the store's write path.
+        ``None`` disables (the local/dev default); the standalone server
+        exposes it as ``--rate-limit``."""
         self.store = store
         self.artifacts_root = os.path.abspath(artifacts_root)
         os.makedirs(self.artifacts_root, exist_ok=True)
+        self.rate_limiter = None
+        if rate_limit:
+            from ..tenancy import TenantRateLimiter
+
+            self.rate_limiter = TenantRateLimiter(
+                rate=float(rate_limit), burst=rate_limit_burst)
+        # the family is contracted (EXPECTED_FAMILIES) and must exist on
+        # a server with rate limiting off too — registered from birth
+        self.store.metrics.counter(
+            "polyaxon_api_rate_limited_total",
+            "API write requests shed by the per-tenant token bucket (429)",
+            labels={"tenant": "default"})
         # Token auth (SURVEY.md §2 API "RBAC(-lite)"): auth engages when a
         # static admin token is configured OR the store holds minted tokens.
         # The static token is the admin bootstrap; store tokens (POST
@@ -75,6 +96,7 @@ class ApiApp:
         self._tokens_seen = False
         self.app = web.Application(
             middlewares=[*(extra_middlewares or []), self._auth_middleware,
+                         self._rate_limit_middleware,
                          self._conflict_middleware])
         # live push (ISSUE 14): one hub task tails the store's changelog
         # and fans run deltas to the SSE watchers of /api/v1/streams/runs;
@@ -174,6 +196,40 @@ class ApiApp:
         return await handler(request)
 
     @web.middleware
+    async def _rate_limit_middleware(self, request, handler):
+        """Per-tenant token-bucket admission on the API write path
+        (ISSUE 15 tentpole (2), PR-12 idiom). Runs AFTER auth, so the
+        bucket keys on the token-derived tenant — one tenant's 10k-run
+        create burst drains ITS bucket, not the fleet's. Reads are never
+        limited (dashboards poll), and over-limit writes are shed with
+        429 + Retry-After: the client backs off, nothing queues
+        unbounded, nothing is silently dropped."""
+        if (self.rate_limiter is None
+                or request.method not in ("POST", "PUT", "DELETE")
+                or not request.path.startswith("/api/v1/")):
+            return await handler(request)
+        from ..tenancy import tenant_of
+
+        tenant = tenant_of(request.get("identity"))
+        ok, retry_after = self.rate_limiter.acquire(tenant)
+        if ok:
+            return await handler(request)
+        self.store.metrics.counter(
+            "polyaxon_api_rate_limited_total",
+            "API write requests shed by the per-tenant token bucket (429)",
+            labels={"tenant": tenant}).inc()
+        import math
+
+        return _json(
+            {"error": "rate limited",
+             "detail": f"tenant {tenant!r} exceeded the API write rate "
+                       f"({self.rate_limiter.rate:g}/s)",
+             "tenant": tenant,
+             "retry_after_s": round(retry_after, 3)},
+            status=429,
+            headers={"Retry-After": str(max(1, math.ceil(retry_after)))})
+
+    @web.middleware
     async def _conflict_middleware(self, request, handler):
         """Store-state verdicts become their contracted HTTP answers
         (docs/RESILIENCE.md "Store crash matrix"):
@@ -217,6 +273,10 @@ class ApiApp:
         r.add_get("/api/v1/tokens", self.list_tokens)
         r.add_delete("/api/v1/tokens/{token_id}", self.revoke_token)
         r.add_get("/api/v1/projects/{project}", self.get_project)
+        r.add_get("/api/v1/quotas", self.list_quotas)
+        r.add_get("/api/v1/quotas/{tenant}", self.get_quota)
+        r.add_put("/api/v1/quotas/{tenant}", self.put_quota)
+        r.add_delete("/api/v1/quotas/{tenant}", self.delete_quota)
         r.add_get("/api/v1/agent/lease", self.get_agent_lease)
         r.add_get("/api/v1/store", self.get_store_status)
         r.add_get("/api/v1/changelog", self.get_changelog)
@@ -293,6 +353,53 @@ class ApiApp:
                 "degraded": getattr(self.store, "degraded", None),
             },
         })
+
+    def _quota_in_use(self, tenant: str) -> float:
+        """Live chips-in-use for a tenant, read from the shared registry
+        (the agent binds polyaxon_tenant_chips_in_use{tenant} there) — no
+        second accounting path for the quotas API to drift from."""
+        g = self.store.metrics.get("polyaxon_tenant_chips_in_use",
+                                   {"tenant": tenant})
+        try:
+            return float(g.value) if g is not None else 0.0
+        except Exception:
+            return 0.0
+
+    async def list_quotas(self, request):
+        """List tenant quotas with live usage (admin-only by scoping —
+        the route carries no {project}, so scoped tokens get 403)."""
+        rows = self.store.list_quotas()
+        for row in rows:
+            row["in_use"] = self._quota_in_use(row["tenant"])
+        return _json(rows)
+
+    async def get_quota(self, request):
+        """One tenant's quota + live usage."""
+        tenant = request.match_info["tenant"]
+        row = self.store.get_quota(tenant)
+        if row is None:
+            return _not_found(f"tenant {tenant!r} has no quota")
+        row["in_use"] = self._quota_in_use(tenant)
+        return _json(row)
+
+    async def put_quota(self, request):
+        """Set a tenant's chip quota: body {"chips": N} (admin-only)."""
+        tenant = request.match_info["tenant"]
+        body = await request.json()
+        try:
+            chips = int(body["chips"])
+            if chips < 0:
+                raise ValueError
+        except (KeyError, TypeError, ValueError):
+            return _json({"error": "body must carry a non-negative "
+                                   "integer 'chips'"}, status=400)
+        return _json(self.store.set_quota(tenant, chips), 201)
+
+    async def delete_quota(self, request):
+        """Drop a tenant's quota row (in-flight runs fall back to the
+        default quota loudly — docs/SCHEDULING.md)."""
+        ok = self.store.delete_quota(request.match_info["tenant"])
+        return _json({"deleted": ok}, 200 if ok else 404)
 
     async def get_timeline(self, request):
         """The run's merged trace: control-plane lifecycle spans (from the
@@ -508,6 +615,13 @@ class ApiApp:
             # server's TCP bridge at ANY host:port it can reach (SSRF,
             # ADVICE r5 high). Only the agent writes it, via the store.
             meta = {k: v for k, v in meta.items() if k != "service"}
+        # tenant (ISSUE 15): derived server-side from the token identity;
+        # an explicit body tenant is honored only for admin/auth-off
+        # callers — a scoped token must not bill another tenant's quota
+        identity = request.get("identity")
+        tenant = body.get("tenant")
+        if tenant is not None and identity not in (None, "admin"):
+            tenant = None
         run = self.store.create_run(
             project,
             spec=body.get("spec"),
@@ -518,7 +632,8 @@ class ApiApp:
             tags=body.get("tags"),
             pipeline_uuid=body.get("pipeline_uuid"),
             # server-derived from the auth token, never client-supplied
-            created_by=request.get("identity"),
+            created_by=identity,
+            tenant=tenant,
         )
         self.new_run_event.set()
         return _json(run, 201)

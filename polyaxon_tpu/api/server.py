@@ -29,10 +29,14 @@ class ApiServer:
         auth_token: "Optional[str]" = None,
         extra_middlewares: "Optional[list]" = None,
         store: "Optional[Store]" = None,
+        rate_limit: "Optional[float]" = None,
+        rate_limit_burst: "Optional[float]" = None,
     ):
         self.store = store if store is not None else Store(db_path)
         self.api = ApiApp(self.store, artifacts_root, auth_token=auth_token,
-                          extra_middlewares=extra_middlewares)
+                          extra_middlewares=extra_middlewares,
+                          rate_limit=rate_limit,
+                          rate_limit_burst=rate_limit_burst)
         self.host = host
         self.port = port
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -105,6 +109,13 @@ def main() -> None:
                         "before self-promotion; <=0 keeps promotion manual")
     p.add_argument("--replication-poll", type=float, default=0.5,
                    help="with --standby-of: changelog tail interval (s)")
+    p.add_argument("--rate-limit", type=float, default=0.0,
+                   help="per-tenant API write rate (requests/s, token "
+                        "bucket keyed on the auth token's tenant); over-"
+                        "limit writes answer 429 + Retry-After. <=0 "
+                        "disables (docs/SCHEDULING.md)")
+    p.add_argument("--rate-limit-burst", type=float, default=0.0,
+                   help="token-bucket burst size (default 2x the rate)")
     p.add_argument("--compact-every", type=float, default=900.0,
                    help="changelog compaction interval (snapshot + prune, "
                         "keeping a 10k-row tail margin); <=0 disables — "
@@ -112,7 +123,11 @@ def main() -> None:
     args = p.parse_args()
     import os as _os
 
-    server = ApiServer(args.db, args.artifacts_root, args.host, args.port)
+    server = ApiServer(
+        args.db, args.artifacts_root, args.host, args.port,
+        rate_limit=(args.rate_limit if args.rate_limit > 0 else None),
+        rate_limit_burst=(args.rate_limit_burst
+                          if args.rate_limit_burst > 0 else None))
     data_dir = _os.path.dirname(args.db) or "."
     standby = None
     if args.standby_of:

@@ -40,6 +40,7 @@ CREATE TABLE IF NOT EXISTS runs (
     cloning_kind TEXT,
     pipeline_uuid TEXT,
     created_by TEXT,
+    tenant TEXT,
     created_at TEXT NOT NULL,
     updated_at TEXT NOT NULL,
     started_at TEXT,
@@ -115,6 +116,16 @@ CREATE TABLE IF NOT EXISTS launch_intents (
     token INTEGER,
     attempt INTEGER NOT NULL,
     state TEXT NOT NULL,
+    created_at TEXT NOT NULL,
+    updated_at TEXT NOT NULL
+);
+-- per-tenant chip quotas (ISSUE 15): the admission/fair-share budget the
+-- agent walks against. One row per tenant; absent tenants fall back to
+-- the 'default' row (or unlimited when none exists) — loudly, via a
+-- status condition + counter, never a KeyError in the scheduler pass.
+CREATE TABLE IF NOT EXISTS quotas (
+    tenant TEXT PRIMARY KEY,
+    chips INTEGER NOT NULL,
     created_at TEXT NOT NULL,
     updated_at TEXT NOT NULL
 );
@@ -487,6 +498,11 @@ class Store:
             cols = {r[1] for r in conn.execute("PRAGMA table_info(runs)")}
             if "created_by" not in cols:
                 conn.execute("ALTER TABLE runs ADD COLUMN created_by TEXT")
+            if "tenant" not in cols:
+                # tenancy (ISSUE 15): accounting unit, stamped at create;
+                # pre-r15 rows read NULL and derive their tenant from
+                # created_by at scheduling time
+                conn.execute("ALTER TABLE runs ADD COLUMN tenant TEXT")
             if "heartbeat_at" not in cols:
                 conn.execute("ALTER TABLE runs ADD COLUMN heartbeat_at TEXT")
             if "heartbeat_step" not in cols:
@@ -515,6 +531,102 @@ class Store:
             self._epoch = int(row[0]) if row else 0
             row = conn.execute("SELECT MAX(seq) FROM changelog").fetchone()
             self._applied_seq = int(row[0]) if row and row[0] else 0
+        # tenancy (ISSUE 15): in-memory quota view backing the
+        # polyaxon_quota_chips{tenant} gauges — refreshed by every quota
+        # verb and by get_quota_map() (the agent's 2s poll), so a scrape
+        # never pays a table walk per series. The default-tenant series
+        # registers from birth: the family is contracted in
+        # EXPECTED_FAMILIES and must exist on an empty store too.
+        self._quota_cache: dict[str, int] = {}
+        self._quota_lock = threading.Lock()
+        self._register_quota_gauge("default")
+        for row_ in self.list_quotas():
+            self._quota_cache[row_["tenant"]] = int(row_["chips"])
+            self._register_quota_gauge(row_["tenant"])
+
+    # -- tenant quotas (ISSUE 15) ------------------------------------------
+
+    def _register_quota_gauge(self, tenant: str) -> None:
+        self.metrics.gauge(
+            "polyaxon_quota_chips",
+            "Configured per-tenant chip quota (0 = no quota row)",
+            labels={"tenant": tenant},
+            value_fn=lambda t=tenant: float(self._quota_cache.get(t, 0)))
+
+    def set_quota(self, tenant: str, chips: int, fence=None) -> dict:
+        """Upsert one tenant's chip quota (``PUT /api/v1/quotas/{tenant}``).
+        Fenceable like every control-plane write: an embedder driving a
+        write-fenced store passes its lease fence explicitly. Replicated
+        — a promoted standby serves the same quota table."""
+        chips = int(chips)
+        if chips < 0:
+            raise ValueError(f"quota chips must be >= 0, got {chips}")
+        self._check_writable()
+        with self._conn_ctx() as conn:
+            self._check_fence(conn, fence)
+            now = _now()
+            conn.execute(
+                "INSERT INTO quotas (tenant, chips, created_at, updated_at)"
+                " VALUES (?,?,?,?) ON CONFLICT(tenant) DO UPDATE SET"
+                " chips=excluded.chips, updated_at=excluded.updated_at",
+                (tenant, chips, now, now))
+            self._log_change(conn, "quota", {
+                "tenant": tenant, "chips": chips, "created_at": now,
+                "updated_at": now})
+        with self._quota_lock:
+            self._quota_cache[tenant] = chips
+        self._register_quota_gauge(tenant)
+        return {"tenant": tenant, "chips": chips}
+
+    def get_quota(self, tenant: str) -> Optional[dict]:
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                "SELECT tenant, chips, created_at, updated_at FROM quotas "
+                "WHERE tenant=?", (tenant,)).fetchone()
+        if row is None:
+            return None
+        return {"tenant": row[0], "chips": row[1], "created_at": row[2],
+                "updated_at": row[3]}
+
+    def list_quotas(self) -> list[dict]:
+        with self._conn_ctx() as conn:
+            rows = conn.execute(
+                "SELECT tenant, chips, created_at, updated_at FROM quotas "
+                "ORDER BY tenant").fetchall()
+        return [{"tenant": r[0], "chips": r[1], "created_at": r[2],
+                 "updated_at": r[3]} for r in rows]
+
+    def delete_quota(self, tenant: str, fence=None) -> bool:
+        """Drop a tenant's quota row. In-flight runs of the deleted
+        tenant fall back to the default quota LOUDLY (status condition +
+        polyaxon_tenant_quota_fallbacks_total) — the scheduler never
+        KeyErrors over a vanished tenant."""
+        self._check_writable()
+        with self._conn_ctx() as conn:
+            self._check_fence(conn, fence)
+            cur = conn.execute("DELETE FROM quotas WHERE tenant=?",
+                               (tenant,))
+            if cur.rowcount > 0:
+                self._log_change(conn, "quota_delete", {"tenant": tenant})
+        with self._quota_lock:
+            self._quota_cache.pop(tenant, None)
+        return cur.rowcount > 0
+
+    def get_quota_map(self) -> dict[str, int]:
+        """{tenant: chips} — ONE table read, refreshing the gauge cache.
+        The agent polls this on its quota-refresh cadence; the gauges ride
+        along for free."""
+        with self._conn_ctx() as conn:
+            rows = conn.execute("SELECT tenant, chips FROM quotas").fetchall()
+        fresh = {r[0]: int(r[1]) for r in rows}
+        with self._quota_lock:
+            stale = set(self._quota_cache) - set(fresh)
+            self._quota_cache.update(fresh)
+            for t in stale:
+                self._quota_cache.pop(t, None)
+        for t in fresh:
+            self._register_quota_gauge(t)
+        return fresh
 
     # -- connection plumbing ----------------------------------------------
 
@@ -1074,7 +1186,7 @@ class Store:
     _RUN_COLS = (
         "uuid", "project", "name", "kind", "status", "spec", "compiled",
         "inputs", "outputs", "meta", "tags", "original_uuid", "cloning_kind",
-        "pipeline_uuid", "created_by", "created_at", "updated_at",
+        "pipeline_uuid", "created_by", "tenant", "created_at", "updated_at",
         "started_at", "finished_at", "heartbeat_at", "heartbeat_step",
         "heartbeat_step_at", "change_seq",
     )
@@ -1303,6 +1415,19 @@ class Store:
                 f"INSERT OR REPLACE INTO launch_intents ({','.join(cols)}) "
                 f"VALUES ({','.join('?' * len(cols))})",
                 [p.get(c) for c in cols])
+        elif op == "quota":
+            conn.execute(
+                "INSERT OR REPLACE INTO quotas (tenant, chips, created_at, "
+                "updated_at) VALUES (?,?,?,?)",
+                (p["tenant"], int(p["chips"]), p["created_at"],
+                 p["updated_at"]))
+            with self._quota_lock:
+                self._quota_cache[p["tenant"]] = int(p["chips"])
+            self._register_quota_gauge(p["tenant"])
+        elif op == "quota_delete":
+            conn.execute("DELETE FROM quotas WHERE tenant=?", (p["tenant"],))
+            with self._quota_lock:
+                self._quota_cache.pop(p["tenant"], None)
         elif op == "promote":
             pass  # epoch adoption handled by the apply loop's max_epoch
         # unknown ops are skipped: a newer primary may log kinds an older
@@ -1420,13 +1545,14 @@ class Store:
         cloning_kind: Optional[str] = None,
         pipeline_uuid: Optional[str] = None,
         created_by: Optional[str] = None,
+        tenant: Optional[str] = None,
         fence=None,
     ) -> dict:
         return self.create_runs(project, [dict(
             spec=spec, name=name, kind=kind, inputs=inputs, meta=meta,
             tags=tags, uuid=uuid, original_uuid=original_uuid,
             cloning_kind=cloning_kind, pipeline_uuid=pipeline_uuid,
-            created_by=created_by,
+            created_by=created_by, tenant=tenant,
         )], fence=fence)[0]
 
     def create_runs(self, project: str, runs: list[dict],
@@ -1460,6 +1586,22 @@ class Store:
                     parents[puid] = self.get_run(puid)
                 if parents[puid]:
                     created_by = parents[puid].get("created_by")
+            # tenant (ISSUE 15): the accounting unit, stamped at create —
+            # explicit wins (soaks/benches, admin backfills), pipeline
+            # children inherit their parent's tenant (a sweep's trials
+            # must bill the sweep's owner), otherwise derived from the
+            # auth-token identity in created_by
+            tenant = r.get("tenant")
+            if tenant is None and r.get("pipeline_uuid"):
+                puid = r["pipeline_uuid"]
+                if puid not in parents:
+                    parents[puid] = self.get_run(puid)
+                if parents[puid]:
+                    tenant = parents[puid].get("tenant")
+            if tenant is None:
+                from ..tenancy import tenant_of
+
+                tenant = tenant_of(created_by)
             run_uuid = r.get("uuid") or uuid_mod.uuid4().hex
             uuids.append(run_uuid)
             rows.append((
@@ -1470,7 +1612,7 @@ class Store:
                 json.dumps(r.get("meta")) if r.get("meta") else None,
                 json.dumps(r.get("tags")) if r.get("tags") else None,
                 r.get("original_uuid"), r.get("cloning_kind"),
-                r.get("pipeline_uuid"), created_by,
+                r.get("pipeline_uuid"), created_by, tenant,
             ))
             conds.append((
                 run_uuid,
@@ -1489,9 +1631,9 @@ class Store:
                 first = top - len(rows) + 1
                 conn.executemany(
                     "INSERT INTO runs (uuid, project, name, kind, status, spec, inputs, meta, tags,"
-                    " original_uuid, cloning_kind, pipeline_uuid, created_by, created_at, updated_at,"
-                    " change_seq)"
-                    " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                    " original_uuid, cloning_kind, pipeline_uuid, created_by, tenant, created_at,"
+                    " updated_at, change_seq)"
+                    " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
                     [row + (now, now, first + i) for i, row in enumerate(rows)])
                 conn.executemany(
                     "INSERT INTO status_conditions (run_uuid, condition, created_at) VALUES (?,?,?)",
@@ -2118,6 +2260,55 @@ class Store:
             results.append((self._get_run_conn(conn, uuid), True))
             applied.append((uuid, dst.value))
 
+    def annotate_status(self, uuid: str, reason: str,
+                        message: Optional[str] = None, fence=None,
+                        meta_patch: Optional[dict] = None) -> Optional[dict]:
+        """Append a status condition at the run's CURRENT status without
+        transitioning it — the loud-but-not-lifecycle writes (ISSUE 15):
+        ``queued(OverQuota)`` parking, ``UnknownTenant`` quota fallback.
+        ``meta_patch`` merges keys into run.meta in the same transaction
+        (``None`` values delete keys), so "parked" is one commit: the
+        condition for the history, the meta flag for listings. Fenced
+        like every lifecycle write; fires no transition listeners (the
+        status did not change — re-waking the scheduler over its own
+        annotation would churn)."""
+        self._check_writable()
+        with self._transition_lock:
+            with self._conn_ctx() as conn:
+                self._check_fence(conn, fence)
+                run = self._get_run_conn(conn, uuid)
+                if run is None:
+                    return None
+                cond = V1StatusCondition.get_condition(
+                    V1Statuses(run["status"]), reason=reason,
+                    message=message)
+                now = _now()
+                seq = self._bump_seq(conn)
+                cond_json = json.dumps(cond.to_dict())
+                conn.execute(
+                    "INSERT INTO status_conditions (run_uuid, condition, "
+                    "created_at) VALUES (?,?,?)", (uuid, cond_json, now))
+                sets = ["updated_at=?", "change_seq=?"]
+                args: list[Any] = [now, seq]
+                if meta_patch:
+                    meta = dict(run.get("meta") or {})
+                    for k, v in meta_patch.items():
+                        if v is None:
+                            meta.pop(k, None)
+                        else:
+                            meta[k] = v
+                    sets.append("meta=?")
+                    args.append(json.dumps(meta))
+                conn.execute(
+                    f"UPDATE runs SET {','.join(sets)} WHERE uuid=?",
+                    args + [uuid])
+                self._log_run_row(conn, uuid, seq=seq)
+                if self._replicate:
+                    self._log_change(conn, "condition", {
+                        "run_uuid": uuid, "condition": cond_json,
+                        "created_at": now})
+                return self._get_run_conn(conn, uuid)
+
     def add_transition_listener(self, fn) -> None:
         """Register ``fn(uuid, new_status)`` called after every applied
         transition (any writer: agent, executor callbacks, API clients)."""
@@ -2201,7 +2392,7 @@ class FencedStore:
 
     _FENCED = ("create_run", "create_runs", "transition", "transition_many",
                "update_run", "merge_outputs", "record_launch_intent",
-               "mark_launched", "adopt_launch")
+               "mark_launched", "adopt_launch", "annotate_status")
 
     def __init__(self, inner, fence_source, on_stale=None):
         import inspect

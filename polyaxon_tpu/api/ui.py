@@ -87,6 +87,16 @@ UI_HTML = """<!DOCTYPE html>
   button.small { font-size: 12px; padding: 2px 8px; }
   .twist { cursor: pointer; color: #697386; user-select: none; }
   .winner td { background: #f0faf4; }
+  .prio { font-size: 11px; padding: 1px 6px; border-radius: 8px;
+          background: #eef1f6; color: #3c4257; }
+  .prio.high { background: #fde8e8; color: #cd2b31; }
+  .prio.preemptible { background: #e7f4ec; color: #18794e; }
+  .quota { display: inline-block; margin-right: 14px; }
+  .quota .qname { font-weight: 600; }
+  .qbar { display: inline-block; width: 60px; height: 7px;
+          background: #e3e8ee; border-radius: 4px; margin-left: 5px;
+          overflow: hidden; vertical-align: middle; }
+  .qbar span { display: block; height: 100%; background: #0b68cb; }
 </style>
 </head>
 <body>
@@ -103,10 +113,11 @@ UI_HTML = """<!DOCTYPE html>
 </header>
 <main>
   <section id="runs"><h2>Runs</h2>
+    <div id="quotas" class="muted" style="margin-bottom:6px"></div>
     <div id="cmpBar" class="muted">check ≥2 runs to compare
       <button class="small" id="cmpBtn" style="display:none">compare</button></div>
     <table id="runsTable">
-    <thead><tr><th></th><th>name</th><th>kind</th><th>status</th><th>progress</th><th>by</th><th>uuid</th></tr></thead>
+    <thead><tr><th></th><th>name</th><th>kind</th><th>status</th><th>priority</th><th>tenant</th><th>progress</th><th>by</th><th>uuid</th></tr></thead>
     <tbody></tbody></table>
     <div id="pageBar" class="muted" style="margin-top:6px">
       <button class="small" id="prevPg" disabled>&laquo; prev</button>
@@ -193,12 +204,24 @@ function addRunRow(tb, r, depth, kids) {
       `&#8987;</span>` : "";
   const progress = typeof r.heartbeat_step === "number"
     ? `step ${r.heartbeat_step}${stalled}` : "";
+  // tenancy columns (ISSUE 15): priority-class badge and tenant, plus an
+  // over-quota park flag from the meta the agent stamps loudly
+  const prio = (r.compiled && r.compiled.priority)
+    || (r.spec && r.spec.priority) || "normal";
+  const prioCell = prio === "normal"
+    ? `<span class="muted">normal</span>`
+    : `<span class="prio ${esc(prio)}">${esc(prio)}</span>`;
+  const overQ = (r.meta && r.meta.over_quota)
+    ? ` <span title="parked: tenant over its chip quota"` +
+      ` style="cursor:help">&#9203;</span>` : "";
   tr.innerHTML =
     `<td><input type="checkbox" data-u="${r.uuid}"` +
     `${checked.has(r.uuid) ? " checked" : ""}/></td>` +
     `<td ${pad}>${twist}${esc(r.name || "")}${kidNote}</td>` +
     `<td>${esc(r.kind || "")}</td>` +
-    `<td>${stBadge(r.status)}${stale}</td>` +
+    `<td>${stBadge(r.status)}${stale}${overQ}</td>` +
+    `<td>${prioCell}</td>` +
+    `<td class="muted">${esc(r.tenant || "")}</td>` +
     `<td class="muted">${progress}</td>` +
     `<td class="muted">${esc(r.created_by || "")}</td>` +
     `<td class="muted">${r.uuid.slice(0,8)}</td>`;
@@ -962,8 +985,26 @@ async function render() {
     });
   }
 }
+// tenant/usage panel (ISSUE 15): quota rows with live chips-in-use bars.
+// Scoped tokens get 403 on the admin-shaped route — the panel just hides.
+async function loadQuotas() {
+  const el = $("#quotas");
+  try {
+    const qs = await j("/api/v1/quotas");
+    if (!qs.length) { el.innerHTML = ""; return; }
+    el.innerHTML = `<b>Tenant quotas</b> ` + qs.map(q => {
+      const used = q.in_use || 0;
+      const pct = q.chips ? Math.min(100, Math.round(100 * used / q.chips)) : 0;
+      const over = q.chips && used >= q.chips ? "background:#cd2b31" : "";
+      return `<span class="quota"><span class="qname">${esc(q.tenant)}` +
+        `</span> ${used}/${q.chips} chips` +
+        `<span class="qbar"><span style="width:${pct}%;${over}"></span>` +
+        `</span></span>`;
+    }).join("");
+  } catch (e) { el.innerHTML = ""; }
+}
 async function refresh() {
-  try { await loadProjects(); await loadRuns();
+  try { await loadProjects(); await loadRuns(); await loadQuotas();
         if (selected || compare) await render(); }
   catch (e) { $("#count").textContent = String(e); }
   // the stream subscribes per-project; a project picked/switched after

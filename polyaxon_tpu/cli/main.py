@@ -332,9 +332,18 @@ def ops_ls(project, host, status, created_by, limit):
             if (r.get("heartbeat_step_age_s", 0) > 120
                     and r.get("heartbeat_age_s", float("inf")) <= 60):
                 prog += f" STALLED({r['heartbeat_step_age_s']:.0f}s)"
+        # tenancy columns (ISSUE 15): tenant, priority class, and the
+        # over-quota parked flag the agent stamps into run meta
+        spec = r.get("spec") or {}
+        prio = (r.get("compiled") or {}).get("priority") \
+            or spec.get("priority") or "normal"
+        over = " OVER-QUOTA" if (r.get("meta") or {}).get("over_quota") \
+            else ""
         click.echo(f"{r['uuid']}  {r['status']:<12} "
-                   f"{r.get('kind') or '-':<10} {r.get('name') or ''}{by}"
-                   f"{prog}")
+                   f"{r.get('kind') or '-':<10} "
+                   f"{r.get('tenant') or 'default':<10} "
+                   f"{prio:<11} {r.get('name') or ''}{by}"
+                   f"{prog}{over}")
 
 
 @ops.command("get")
@@ -789,6 +798,63 @@ def config_cmd(host, project, token, show):
         cfg["token"] = token
     save_config(cfg)
     click.echo("config saved")
+
+
+@cli.group()
+def quota():
+    """Tenant chip quotas (admin; docs/SCHEDULING.md)."""
+
+
+def _quota_backend(host):
+    """QuotaClient when a host is configured, else the local store —
+    same hostless bootstrap idiom as token administration."""
+    h = get_host(host)
+    if h:
+        from ..client import QuotaClient
+
+        return QuotaClient(h, auth_token=get_token(h))
+    from ..api.store import Store
+
+    return Store(os.path.join(".plx", "db.sqlite"))
+
+
+@quota.command("ls")
+@click.option("--host", default=None)
+def quota_ls(host):
+    """List tenant quotas with live chips in use."""
+    be = _quota_backend(host)
+    rows = be.list() if hasattr(be, "_req") else be.list_quotas()
+    if not rows:
+        click.echo("no quotas configured (every tenant is unlimited)")
+        return
+    click.echo(f"{'tenant':<20} {'chips':>6} {'in use':>7}")
+    for r in rows:
+        in_use = r.get("in_use")
+        click.echo(f"{r['tenant']:<20} {r['chips']:>6} "
+                   f"{in_use if in_use is not None else '-':>7}")
+
+
+@quota.command("set")
+@click.argument("tenant")
+@click.argument("chips", type=int)
+@click.option("--host", default=None)
+def quota_set(tenant, chips, host):
+    """Set TENANT's chip quota to CHIPS."""
+    be = _quota_backend(host)
+    out = be.set(tenant, chips) if hasattr(be, "_req") \
+        else be.set_quota(tenant, chips)
+    click.echo(json.dumps(out, indent=2))
+
+
+@quota.command("rm")
+@click.argument("tenant")
+@click.option("--host", default=None)
+def quota_rm(tenant, host):
+    """Drop TENANT's quota row (its runs fall back to the default
+    quota, loudly)."""
+    be = _quota_backend(host)
+    be.delete(tenant) if hasattr(be, "_req") else be.delete_quota(tenant)
+    click.echo("deleted")
 
 
 @cli.group()

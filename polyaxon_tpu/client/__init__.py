@@ -3,6 +3,7 @@ serve front — the request-path failover client for `kind: service`
 replica fleets (ISSUE 12)."""
 
 from .client import (
-    AgentClient, ApiError, BaseClient, ProjectClient, RunClient, TokenClient,
+    AgentClient, ApiError, BaseClient, ProjectClient, QuotaClient, RunClient,
+    TokenClient,
 )
 from .serve import ServeFront, ServeUnavailableError  # noqa: F401

@@ -209,6 +209,24 @@ class AgentClient(BaseClient):
         return self._req("GET", "/metrics").text
 
 
+class QuotaClient(BaseClient):
+    """Tenant chip-quota administration (ISSUE 15, docs/SCHEDULING.md)."""
+
+    def list(self) -> list[dict]:
+        """Every quota row, each with live ``in_use`` chips."""
+        return self._json("GET", "/api/v1/quotas")
+
+    def get(self, tenant: str) -> dict:
+        return self._json("GET", f"/api/v1/quotas/{tenant}")
+
+    def set(self, tenant: str, chips: int) -> dict:
+        return self._json("PUT", f"/api/v1/quotas/{tenant}",
+                          json={"chips": int(chips)})
+
+    def delete(self, tenant: str) -> dict:
+        return self._json("DELETE", f"/api/v1/quotas/{tenant}")
+
+
 class TokenClient(BaseClient):
     """Token administration (RBAC-lite): mint/list/revoke access tokens."""
 
@@ -320,6 +338,21 @@ class RunClient(BaseClient):
         if status:
             params["status"] = status
         return self._json("GET", f"/api/v1/{self.project}/runs", params=params)
+
+    # -- tenant quotas (ISSUE 15) ------------------------------------------
+
+    def quotas(self) -> list[dict]:
+        """Tenant quota rows, each with live ``in_use`` chips — the
+        tenancy pane `polyaxon quota ls` and the dashboard render
+        (admin-scoped server-side; docs/SCHEDULING.md)."""
+        return self._json("GET", "/api/v1/quotas")
+
+    def set_quota(self, tenant: str, chips: int) -> dict:
+        return self._json("PUT", f"/api/v1/quotas/{tenant}",
+                          json={"chips": int(chips)})
+
+    def get_quota(self, tenant: str) -> dict:
+        return self._json("GET", f"/api/v1/quotas/{tenant}")
 
     def delete(self, uuid: Optional[str] = None) -> dict:
         return self._json("DELETE", self._rpath(uuid=uuid))

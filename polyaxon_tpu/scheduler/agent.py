@@ -26,6 +26,11 @@ from ..compiler.resolver import resolve
 from ..resilience.heartbeat import _max_retries
 from ..runtime.local import LocalExecution, LocalExecutor
 from ..schemas.statuses import V1Statuses, is_done
+from ..tenancy import (
+    DEFAULT_TENANT, NORMAL_RANK, priority_rank, run_priority,
+    select_victims, tenant_of,
+)
+from ..tenancy.fairshare import drf_key
 
 
 def _is_dag_spec(spec: dict) -> bool:
@@ -366,6 +371,41 @@ class LocalAgent:
         self._chips_in_use: dict[str, int] = {}
         self._tuners: dict[str, threading.Thread] = {}
         self._sidecars: dict[str, _RunSidecar] = {}
+        # -- tenancy (ISSUE 15, docs/SCHEDULING.md) ------------------------
+        # Per-tenant chip quotas turn the per-shard FIFO wait queues into
+        # a weighted fair-share (DRF-style) walk: entries are ordered by
+        # (priority class, tenant usage/quota ratio, admission order),
+        # so FIFO is preserved within one tenant+class and a single
+        # tenant with no classes degrades to the r7 walk EXACTLY (the
+        # fast path below literally runs the r7 code). Quotas are read
+        # from the store on a small TTL; per-run tenant/class metadata is
+        # cached at queue admission.
+        self.quota_refresh_s = 2.0
+        self._quotas: dict[str, int] = {}
+        self._quota_fetch_at = float("-inf")
+        self._run_tenant: dict[str, str] = {}    # uuid -> tenant (reserved)
+        self._pending_meta: dict[str, tuple] = {}  # uuid -> (tenant, rank)
+        self._over_quota_marked: set = set()     # parked loudly already
+        self._tenant_fallback_marked: set = set()
+        # runs being preempted RIGHT NOW: their dying attempt's terminal
+        # report must not overwrite the queued(Preempted) row (the same
+        # late-report hazard _do_stop solves with a done status — but a
+        # preempted run goes back to queued, where 'failed' is legal, so
+        # the agent swallows the report instead)
+        self._preempting: set = set()
+        self._preempt_wanted: list = []  # filled by the fair walk per pass
+        #: audit trail for soaks/tests: (victim_uuid, preemptor_uuid)
+        self.preemptions: list[tuple] = []
+        self._c_preemptions = self.metrics.counter(
+            "polyaxon_preemptions_total",
+            "Runs preempted back to queued, by reason",
+            labels={"reason": "priority"})
+        self._c_tenant_fallbacks = self.metrics.counter(
+            "polyaxon_tenant_quota_fallbacks_total",
+            "Scheduling passes that met a run whose tenant has no quota "
+            "row and fell back to the default quota")
+        self._tenant_gauges: set = set()
+        self._bind_tenant_gauge(DEFAULT_TENANT)
         self.sidecar_interval = 1.0
         self._stop = threading.Event()
         self._wake = threading.Event()  # set by the watch thread
@@ -561,7 +601,7 @@ class LocalAgent:
         """Reset one shard's wait-queue state (the shared step of a
         rebuild, a demotion, and a voluntary release)."""
         for uuid, _ in self._shard_pending[shard]:
-            self._pending_set.discard(uuid)
+            self._drop_pending(uuid)
         self._shard_pending[shard].clear()
         self._shard_watermark[shard] = None
 
@@ -588,6 +628,7 @@ class LocalAgent:
             for u in lost:
                 self._chips_in_use.pop(u, None)
                 self._active.pop(u, None)
+                self._run_tenant.pop(u, None)
             for u in [u for u in self._sidecars
                       if self._shard_name(u) == shard]:
                 self._sidecars.pop(u).stop_evt.set()
@@ -926,6 +967,235 @@ class LocalAgent:
                 labels={"shard": shard, "kind": kind})
             self._c_shard_passes[key] = c
         c.inc()
+
+    # -- tenancy: quotas, fair share, preemption (ISSUE 15) ----------------
+
+    def _bind_tenant_gauge(self, tenant: str) -> None:
+        """Register the tenant's chips-in-use gauge once (get-or-create
+        registry semantics keep the series continuous across takeovers,
+        same as every other agent gauge)."""
+        if tenant in self._tenant_gauges:
+            return
+        self._tenant_gauges.add(tenant)
+        self.metrics.gauge(
+            "polyaxon_tenant_chips_in_use",
+            "Chips reserved by the tenant's scheduled runs (this agent)",
+            labels={"tenant": tenant},
+            value_fn=lambda t=tenant: float(
+                self._tenant_usage().get(t, 0)))
+
+    def _refresh_quotas(self, force: bool = False) -> None:
+        """Pull the quota table on a small TTL. A change re-arms every
+        shard's walk (the watermark gate knows nothing about quota
+        geometry) — that is also how a RAISED quota unparks work without
+        any run event: the periodic resync wake lands here."""
+        now = time.monotonic()
+        if not force and now - self._quota_fetch_at < self.quota_refresh_s:
+            return
+        self._quota_fetch_at = now
+        try:
+            fresh = self.store.get_quota_map()
+        except Exception:
+            return  # store weather: keep the cached view, retry next TTL
+        if fresh != self._quotas:
+            self._quotas = fresh
+            for t in fresh:
+                self._bind_tenant_gauge(t)
+            for s in self.shards:
+                self._shard_fresh[s] = True
+
+    def _quota_for(self, tenant: str) -> Optional[int]:
+        """Effective chip quota for a tenant (None = unlimited). With no
+        quota table at all, tenancy is off and everyone is unlimited;
+        with one, unknown/deleted tenants fall back to the 'default'
+        row (or unlimited when none exists)."""
+        if not self._quotas:
+            return None
+        q = self._quotas.get(tenant)
+        if q is not None:
+            return q
+        return self._quotas.get(DEFAULT_TENANT)
+
+    def _quota_for_loud(self, tenant: str, uuid: str) -> Optional[int]:
+        """:meth:`_quota_for`, but an unknown/deleted tenant referenced
+        by an in-flight run is surfaced LOUDLY — a status condition on
+        the run plus the fallback counter — instead of KeyErroring the
+        scheduler pass (the ISSUE 15 regression class)."""
+        if not self._quotas:
+            return None
+        q = self._quotas.get(tenant)
+        if q is not None:
+            return q
+        if (tenant != DEFAULT_TENANT
+                and uuid not in self._tenant_fallback_marked):
+            self._tenant_fallback_marked.add(uuid)
+            self._c_tenant_fallbacks.inc()
+            try:
+                self.store.annotate_status(
+                    uuid, reason="UnknownTenant",
+                    message=(f"tenant {tenant!r} has no quota row "
+                             "(unknown or deleted); scheduling under the "
+                             "default quota"))
+            except StaleLeaseError:
+                raise
+            except Exception:
+                traceback.print_exc()
+        return self._quotas.get(DEFAULT_TENANT)
+
+    def _tenant_usage(self) -> dict:
+        """{tenant: reserved chips} across every run this agent drives —
+        the fair-share numerator. Derived from the same ``_chips_in_use``
+        map the global budget reads, so services (whose reservation the
+        autoscaler rewrites live) and restarts account identically for
+        both budgets."""
+        with self._lock:
+            held = dict(self._chips_in_use)
+        usage: dict[str, int] = {}
+        for u, d in held.items():
+            t = self._run_tenant.get(u)
+            if t is None:
+                t = self._resolve_run_tenant(u)
+            usage[t] = usage.get(t, 0) + d
+        return usage
+
+    def _resolve_run_tenant(self, uuid: str) -> str:
+        """Lazy tenant lookup for a reservation made before this agent
+        tracked tenants for it (adoption, autoscale rewrite): one store
+        read, cached for the run's lifetime."""
+        try:
+            run = self.store.get_run(uuid)
+        except Exception:
+            return DEFAULT_TENANT  # store weather: don't cache the guess
+        t = ((run or {}).get("tenant")
+             or tenant_of((run or {}).get("created_by")))
+        self._run_tenant[uuid] = t
+        self._bind_tenant_gauge(t)
+        return t
+
+    def _drop_pending(self, uuid: str) -> None:
+        self._pending_set.discard(uuid)
+        self._pending_meta.pop(uuid, None)
+
+    def _mark_over_quota(self, uuid: str, tenant: str, quota: int,
+                         usage: int, demand: int) -> None:
+        """Park a queued run loudly (once): over-quota work is accepted
+        and waits — never silently dropped — with a ``queued(OverQuota)``
+        condition for the history and ``meta.over_quota`` for listings
+        (`ops ls`, the dashboard badge)."""
+        if uuid in self._over_quota_marked:
+            return
+        self._over_quota_marked.add(uuid)
+        try:
+            self.store.annotate_status(
+                uuid, reason="OverQuota",
+                message=(f"parked: tenant {tenant!r} holds {usage} of its "
+                         f"{quota}-chip quota and this run needs {demand} "
+                         "more"),
+                meta_patch={"over_quota": True})
+        except StaleLeaseError:
+            raise
+        except Exception:
+            traceback.print_exc()
+
+    def _clear_over_quota(self, run: dict) -> None:
+        """Unpark: the run fits its tenant's quota again — drop the
+        listing flag before it schedules (the condition history keeps
+        the park/unpark record)."""
+        uuid = run["uuid"]
+        if uuid not in self._over_quota_marked:
+            return
+        self._over_quota_marked.discard(uuid)
+        meta = dict(run.get("meta") or {})
+        if meta.pop("over_quota", None) is None:
+            return
+        try:
+            self.store.update_run(uuid, meta=meta)
+        except StaleLeaseError:
+            raise
+        except Exception:
+            traceback.print_exc()
+
+    def _preempt_pass(self) -> None:
+        """Checkpoint-safe priority preemption (ISSUE 15 tentpole (4)).
+
+        The fair walk recorded queue heads it could not place for lack of
+        chips. For the best one (lowest class rank, oldest), pick victims
+        newest-first among strictly-lower-class runs this agent drives —
+        training only, never services, never pipeline drivers — and drive
+        each through the existing stop machinery into
+        ``queued(Preempted)``: graceful stop, the run's checkpoints stay
+        on disk, and the relaunch resumes from its newest complete step
+        through the unchanged launch-intent + fence path. One candidate
+        per pass bounds the work; the walk re-runs immediately after so
+        the preemptor takes the freed chips in the SAME pass (the
+        bounded-delay guarantee the soak asserts)."""
+        wanted, self._preempt_wanted = self._preempt_wanted, []
+        if not wanted:
+            return
+        wanted.sort()
+        for rank, _seq, uuid, demand, tenant in wanted:
+            free = self._free_capacity()
+            needed = demand - max(free, 0)
+            if needed <= 0:
+                continue  # freed since the walk: the next walk places it
+            quota = self._quota_for(tenant)
+            usage = self._tenant_usage()
+            if quota is not None and usage.get(tenant, 0) + demand > quota:
+                continue  # parked by quota — killing victims can't help
+            with self._lock:
+                held = dict(self._chips_in_use)
+            owned = [u for u in held
+                     if u not in self._tuners and self._owns_run(u)]
+            try:
+                rows = [r for r in self.store.get_runs(owned)
+                        if r["status"] in self._INFLIGHT]
+            except Exception:
+                traceback.print_exc()
+                return
+            victims = select_victims(rows, held, rank, needed)
+            if victims is None:
+                continue  # even preempting everything eligible won't fit
+            for v in victims:
+                self._preempt_run(v, by_uuid=uuid)
+            self._schedule_pending(allow_preempt=False)
+            return
+
+    def _preempt_run(self, run: dict, by_uuid: str) -> None:
+        """Drive one victim through graceful-stop → checkpoint →
+        ``queued(Preempted)``. The QUEUED transition lands FIRST (fenced,
+        like every lifecycle write); the dying attempt's late terminal
+        report is swallowed via ``_preempting`` — queued is not a done
+        status, so the _do_stop trick (late reports bounce off a terminal
+        row) does not apply here. Deliberately NOT the retrying path: a
+        preemption is the scheduler's choice, it must not burn the run's
+        ``termination.maxRetries`` fault budget."""
+        uuid = run["uuid"]
+        self._preempting.add(uuid)
+        with self._lock:
+            ex = self._active.pop(uuid, None)
+            self._chips_in_use.pop(uuid, None)
+            sidecar = self._sidecars.pop(uuid, None)
+        if sidecar is not None:
+            sidecar.stop_evt.set()
+        self.store.transition(
+            uuid, V1Statuses.QUEUED.value, force=True, reason="Preempted",
+            message=(f"preempted by higher-priority run {by_uuid[:12]}; "
+                     "will resume from the newest complete checkpoint"))
+        if self.reconciler is not None and self.reconciler.is_tracked(uuid):
+            try:
+                self.reconciler.delete(uuid)  # fires no status callback
+            except Exception:
+                traceback.print_exc()
+        if ex is not None:
+            ex.stop()  # SIGTERM first; the checkpoint cadence covers it
+        # the dead attempt's progress.json must not freeze the resumed
+        # attempt's stall clocks (same hazard as the retry path)
+        self._drop_stale_progress(uuid)
+        self._c_preemptions.inc()
+        self.preemptions.append((uuid, by_uuid))
+        row = self.store.get_run(uuid)
+        if row is not None and row["status"] == V1Statuses.QUEUED.value:
+            self._enqueue_pending(row)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1674,6 +1944,15 @@ class LocalAgent:
             pass
 
     def _on_status(self, run_uuid: str, status: str, message: Optional[str]) -> None:
+        if run_uuid in self._preempting and is_done(status):
+            # the preempted attempt's dying executor reports its death
+            # AFTER the preemption already re-queued the run: the report
+            # describes the killed attempt, not the run — swallow it
+            # (queued -> failed is a legal transition, so the store
+            # cannot reject it the way it rejects late reports on a
+            # terminal row)
+            self._preempting.discard(run_uuid)
+            return
         if is_done(status):
             self._collect_outputs_safe(run_uuid)
         if status in (V1Statuses.RETRYING.value, V1Statuses.QUEUED.value):
@@ -1700,6 +1979,15 @@ class LocalAgent:
         """Batched status callback for the reconciler: a multi-step
         lifecycle edge (restart: running -> retrying -> queued -> scheduled)
         lands as ONE store transaction instead of four."""
+        swallowed = [u for u, s, _ in updates
+                     if u in self._preempting and is_done(s)]
+        if swallowed:
+            # same late-report hazard as _on_status, batched shape
+            self._preempting -= set(swallowed)
+            updates = [t for t in updates
+                       if not (t[0] in swallowed and is_done(t[1]))]
+            if not updates:
+                return
         for uuid, status, _ in updates:
             if is_done(status):
                 self._collect_outputs_safe(uuid)
@@ -1738,7 +2026,10 @@ class LocalAgent:
         with self._lock:
             self._active.pop(run_uuid, None)
             self._chips_in_use.pop(run_uuid, None)
+            self._run_tenant.pop(run_uuid, None)
             sidecar = self._sidecars.pop(run_uuid, None)
+        self._over_quota_marked.discard(run_uuid)
+        self._tenant_fallback_marked.discard(run_uuid)
         # capacity just freed — re-wake the loop. The terminal transition's
         # own wake can race ahead of this release (the loop sees free <
         # watermark and skips the walk), and without this nudge a blocked
@@ -2137,19 +2428,33 @@ class LocalAgent:
         else:
             demand = 1
         shard = self._shard_name(uuid)
+        # tenancy metadata cached at admission (ISSUE 15): tenant from the
+        # create-time stamp (legacy rows derive from created_by), class
+        # rank from the compiled spec — the fair walk never re-reads rows
+        # to ORDER them, only to schedule them
+        self._pending_meta[uuid] = (
+            run.get("tenant") or tenant_of(run.get("created_by")),
+            priority_rank(run_priority(run)))
         self._shard_pending[shard].append((uuid, demand))
         self._pending_set.add(uuid)
         self._shard_fresh[shard] = True
 
-    def _schedule_pending(self) -> None:
-        """Walk the owned shards' wait queues FIFO, scheduling every run
-        whose demand fits the free budget (smaller runs may backfill past
-        a blocked big one, same as the old full scan). Store reads happen
+    def _schedule_pending(self, allow_preempt: bool = True) -> None:
+        """Walk the owned shards' wait queues, scheduling every run whose
+        demand fits the free budget (smaller runs may backfill past a
+        blocked big one, same as the old full scan). Store reads happen
         ONLY for runs that fit — blocked entries cost an in-memory
         comparison, and a shard with no new entries and not enough freed
         capacity for its smallest blocked run (its watermark) skips its
         walk outright: a quiet wake stays O(1) and touches zero store
         rows, per shard.
+
+        Tenancy (ISSUE 15): each shard walk is FIFO when no quotas are
+        configured and every entry is class ``normal`` (the r7 path,
+        byte-identical), and a weighted fair-share walk otherwise. After
+        the walks, blocked higher-class heads may preempt lower-class
+        running work (``allow_preempt`` guards the one recursive re-walk
+        the preemption pass issues).
 
         Chip-budget sub-allocation (ISSUE 6 tentpole): with several owned
         shards competing for one budget, each first walks an equal slice
@@ -2157,6 +2462,9 @@ class LocalAgent:
         idle chips — flows to the hungriest shard (deepest remaining
         queue) in a second pass. One owned shard (num_shards=1) degrades
         to the r7 single-queue walk exactly."""
+        self._refresh_quotas()
+        if allow_preempt:
+            self._preempt_wanted = []
         runnable: list[str] = []
         free = None
         for s in self._owned_shards():
@@ -2173,26 +2481,44 @@ class LocalAgent:
                 continue
             runnable.append(s)
         if not runnable or free is None:
+            if allow_preempt:
+                self._preempt_pass()
             return
         if len(runnable) == 1:
             self._walk_shard(runnable[0], free)
-            return
-        base = free // len(runnable)
-        leftover = free - base * len(runnable)
-        for s in runnable:
-            leftover += base - self._walk_shard(s, base)
-        # rebalance: idle chips flow to the hungriest shard first
-        for s in sorted(runnable,
-                        key=lambda s: -len(self._shard_pending[s])):
-            if leftover <= 0:
-                return
-            if self._shard_pending[s]:
-                leftover -= self._walk_shard(s, leftover)
+        else:
+            base = free // len(runnable)
+            leftover = free - base * len(runnable)
+            for s in runnable:
+                leftover += base - self._walk_shard(s, base)
+            # rebalance: idle chips flow to the hungriest shard first
+            for s in sorted(runnable,
+                            key=lambda s: -len(self._shard_pending[s])):
+                if leftover <= 0:
+                    break
+                if self._shard_pending[s]:
+                    leftover -= self._walk_shard(s, leftover)
+        if allow_preempt:
+            self._preempt_pass()
 
     def _walk_shard(self, shard: str, budget: int) -> int:
-        """FIFO walk of one shard's wait queue with ``budget`` chips to
-        hand out; returns the chips actually placed and re-arms the
-        shard's blocked-demand watermark."""
+        """Walk one shard's wait queue with ``budget`` chips to hand out;
+        returns the chips actually placed. Dispatch (ISSUE 15): the
+        weighted fair-share walk engages only when tenancy is in play —
+        quotas configured, or any queued entry carrying a non-default
+        priority class; otherwise the r7 FIFO walk runs unchanged, so
+        ``num_tenants=1`` with no classes IS the pre-tenancy scheduler
+        (the sched_bench single-tenant A/B pins this)."""
+        if self._quotas or any(
+                self._pending_meta.get(u, (None, NORMAL_RANK))[1]
+                != NORMAL_RANK
+                for u, _ in self._shard_pending[shard]):
+            return self._walk_fair(shard, budget)
+        return self._walk_fifo(shard, budget)
+
+    def _walk_fifo(self, shard: str, budget: int) -> int:
+        """FIFO walk of one shard's wait queue (the r7 scheduler):
+        re-arms the shard's blocked-demand watermark."""
         self._shard_fresh[shard] = False
         pending = self._shard_pending[shard]
         watermark: Optional[int] = None
@@ -2207,13 +2533,13 @@ class LocalAgent:
                 continue
             run = self.store.get_run(uuid)
             if run is None or run["status"] != V1Statuses.QUEUED.value:
-                self._pending_set.discard(uuid)
+                self._drop_pending(uuid)
                 continue  # stopped/advanced while waiting
             outcome = self._maybe_schedule(run)
             if outcome == "scheduled":
                 budget -= demand
                 used += demand
-                self._pending_set.discard(uuid)
+                self._drop_pending(uuid)
             elif outcome == "blocked":
                 # the authoritative in-lock gate disagreed with our free
                 # snapshot (concurrent scheduling); keep it queued
@@ -2221,8 +2547,92 @@ class LocalAgent:
                 watermark = (demand if watermark is None
                              else min(watermark, demand))
             else:
-                self._pending_set.discard(uuid)
+                self._drop_pending(uuid)
         self._shard_pending[shard] = kept
+        self._shard_watermark[shard] = watermark
+        return used
+
+    def _walk_fair(self, shard: str, budget: int) -> int:
+        """Weighted fair-share walk (ISSUE 15 tentpole (3)): a DRF-style
+        generalization of the FIFO walk. Entries group into per-
+        (class, tenant) FIFO queues; each step takes the head whose key
+        (priority rank, tenant usage/quota ratio, admission order) is
+        smallest, so:
+
+        - classes strictly dominate (a ``high`` head always beats a
+          ``normal`` one),
+        - within a class, the tenant FURTHEST UNDER its quota share goes
+          first and usage converges onto quota proportions,
+        - within one tenant+class, admission (created_at) order is
+          preserved — FIFO, with the same smaller-run backfill past
+          blocked heads the FIFO walk allows.
+
+        Usage ratios update as reservations land, so one walk interleaves
+        tenants instead of draining the least-loaded one. Entries that
+        exceed their tenant's remaining quota are PARKED (kept queued,
+        marked loudly once); entries short only on chips arm the
+        watermark exactly like the FIFO walk and become preemption
+        candidates for the post-walk pass."""
+        self._shard_fresh[shard] = False
+        entries = list(self._shard_pending[shard])
+        self._shard_pending[shard].clear()
+        groups: dict[tuple, "collections.deque"] = {}
+        for seq, (uuid, demand) in enumerate(entries):
+            tenant, rank = self._pending_meta.get(
+                uuid, (DEFAULT_TENANT, NORMAL_RANK))
+            groups.setdefault((rank, tenant), collections.deque()).append(
+                (seq, uuid, demand))
+        usage = self._tenant_usage()
+        kept: list[tuple] = []  # (seq, uuid, demand) — rebuilt FIFO below
+        watermark: Optional[int] = None
+        used = 0
+
+        def keep(seq: int, uuid: str, demand: int) -> None:
+            nonlocal watermark
+            kept.append((seq, uuid, demand))
+            watermark = (demand if watermark is None
+                         else min(watermark, demand))
+
+        while groups:
+            key = min(groups, key=lambda k: drf_key(
+                k[0], usage.get(k[1], 0), self._quota_for(k[1]),
+                groups[k][0][0]))
+            rank, tenant = key
+            q = groups[key]
+            seq, uuid, demand = q.popleft()
+            if not q:
+                del groups[key]
+            quota = self._quota_for_loud(tenant, uuid)
+            if quota is not None and usage.get(tenant, 0) + demand > quota:
+                self._mark_over_quota(uuid, tenant, quota,
+                                      usage.get(tenant, 0), demand)
+                keep(seq, uuid, demand)
+                continue
+            if demand > max(budget, 0):
+                keep(seq, uuid, demand)
+                self._preempt_wanted.append(
+                    (rank, seq, uuid, demand, tenant))
+                continue
+            run = self.store.get_run(uuid)
+            if run is None or run["status"] != V1Statuses.QUEUED.value:
+                self._drop_pending(uuid)
+                continue  # stopped/advanced while waiting
+            self._clear_over_quota(run)
+            outcome = self._maybe_schedule(run)
+            if outcome == "scheduled":
+                budget -= demand
+                used += demand
+                usage[tenant] = usage.get(tenant, 0) + demand
+                self._drop_pending(uuid)
+            elif outcome == "blocked":
+                keep(seq, uuid, demand)
+                self._preempt_wanted.append(
+                    (rank, seq, uuid, demand, tenant))
+            else:
+                self._drop_pending(uuid)
+        kept.sort()  # admission order: the queue stays created_at ASC
+        self._shard_pending[shard] = collections.deque(
+            (u, d) for _, u, d in kept)
         self._shard_watermark[shard] = watermark
         return used
 
@@ -2433,6 +2843,10 @@ class LocalAgent:
                 if sum(self._chips_in_use.values()) + demand > self.capacity_chips:
                     return "blocked"
                 self._chips_in_use[uuid] = demand
+                # tenant accounting rides the reservation (ISSUE 15):
+                # stamped here so fair-share usage needs no store read
+                self._run_tenant[uuid] = (
+                    run.get("tenant") or tenant_of(run.get("created_by")))
             else:
                 active = len(self._active)
                 if self.reconciler is not None:
@@ -2441,6 +2855,10 @@ class LocalAgent:
                     active += self.reconciler.active_count()
                 if active >= self.max_parallel:
                     return "blocked"
+        # a re-launch consumes any leftover preemption latch: from here on
+        # the run's reports are the NEW attempt's and must flow normally
+        self._preempting.discard(uuid)
+        self._bind_tenant_gauge(self._run_tenant.get(uuid, DEFAULT_TENANT))
         try:
             resolved = resolve(
                 run["compiled"] or spec,
@@ -2470,6 +2888,7 @@ class LocalAgent:
         except Exception as e:
             with self._lock:
                 self._chips_in_use.pop(uuid, None)
+                self._run_tenant.pop(uuid, None)
             self.store.transition(
                 uuid, V1Statuses.FAILED.value, reason="SchedulingError", message=str(e)[:500],
             )
@@ -2564,6 +2983,7 @@ class LocalAgent:
             # reconciler.delete() below fires no status callback, so release
             # the chip reservation here (not only in _on_status)
             self._chips_in_use.pop(uuid, None)
+            self._run_tenant.pop(uuid, None)
         # mark stopped BEFORE killing: the dying process's late 'failed'
         # report must land on a done status and be rejected (atomic
         # transition in the store)

@@ -34,6 +34,11 @@ class _OpCommon(BaseSchema):
     tags: Optional[list[str]] = None
     presets: Optional[list[str]] = None
     queue: Optional[str] = None
+    # scheduling priority class (ISSUE 15, docs/SCHEDULING.md): "high"
+    # may preempt running lower-class training work, "preemptible" is
+    # first in line to be preempted; absent = "normal". Compile-time
+    # validated — a typo fails the polyaxonfile check, not the scheduler.
+    priority: Optional[str] = None
     cache: Optional[V1Cache] = None
     termination: Optional[V1Termination] = None
     plugins: Optional[V1Plugins] = None
@@ -59,6 +64,17 @@ class _OpCommon(BaseSchema):
     def _check_trigger(cls, v: Optional[str]) -> Optional[str]:
         if v is not None and v not in TriggerPolicy.VALUES:
             raise ValueError(f"Unknown trigger policy '{v}'")
+        return v
+
+    @field_validator("priority")
+    @classmethod
+    def _check_priority(cls, v: Optional[str]) -> Optional[str]:
+        from ..tenancy import PRIORITY_CLASSES
+
+        if v is not None and v not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"Unknown priority class '{v}' (one of: "
+                f"{', '.join(sorted(PRIORITY_CLASSES))})")
         return v
 
     @field_validator("schedule", mode="before")
@@ -166,7 +182,8 @@ class V1CompiledOperation(_OpCommon):
             "tags": sorted(set(op.tags or []) | set(comp.tags or [])) or None,
             **pick(
                 "version", "name", "description", "presets", "queue", "cache",
-                "termination", "plugins", "build", "hooks", "isApproved", "cost",
+                "priority", "termination", "plugins", "build", "hooks",
+                "isApproved", "cost",
             ),
             # op-only sections pass through verbatim
             **{

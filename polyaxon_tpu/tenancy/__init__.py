@@ -1,0 +1,56 @@
+"""Multi-tenant scheduling (ISSUE 15): tenant identity, chip quotas,
+priority classes, weighted fair-share ordering, and API rate limiting.
+
+The control plane's identity seams already existed — ``created_by`` is
+derived from the stable token id at run creation, and PR-14's tokens are
+project-scoped capabilities. This package turns those seams into a real
+tenancy layer, the Borg-style subsystem every production training stack
+grows:
+
+- **tenant** — the accounting unit. Stamped on every run at creation
+  (explicit, or derived from ``created_by`` via :func:`tenant_of`); runs
+  with no identity land in :data:`DEFAULT_TENANT`.
+- **quota** — per-tenant chip budget (``quotas`` store table, served by
+  ``PUT/GET /api/v1/quotas/{tenant}``). Over-quota work is *parked*
+  (``queued`` with an ``OverQuota`` condition), never dropped.
+- **priority class** — ``high | normal | preemptible`` on the
+  polyaxonfile operation, compile-time validated. Higher classes may
+  preempt strictly-lower-class *training* runs (never services) through
+  the existing graceful-stop → checkpoint → ``queued(Preempted)`` path.
+- **weighted fair share** — the agent's per-shard FIFO wait queues
+  become a DRF-style walk ordered by (priority class, tenant
+  usage/quota ratio, created_at): FIFO is preserved within one
+  tenant+class, and a single tenant degrades to plain FIFO exactly.
+- **rate limiting** — per-tenant token buckets on the API's write
+  endpoints (:class:`TenantRateLimiter`), answering 429 + Retry-After
+  in the PR-12 serve idiom.
+
+Everything here is pure policy/state: no store or scheduler imports, so
+the api/ and scheduler/ layers can both depend on it without cycles.
+docs/SCHEDULING.md is the operator-facing contract.
+"""
+
+from .fairshare import (  # noqa: F401
+    DEFAULT_TENANT,
+    NORMAL_RANK,
+    PRIORITY_CLASSES,
+    jain_index,
+    priority_rank,
+    run_priority,
+    select_victims,
+    tenant_of,
+)
+from .ratelimit import TenantRateLimiter, TokenBucket  # noqa: F401
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "NORMAL_RANK",
+    "PRIORITY_CLASSES",
+    "TenantRateLimiter",
+    "TokenBucket",
+    "jain_index",
+    "priority_rank",
+    "run_priority",
+    "select_victims",
+    "tenant_of",
+]

@@ -1,0 +1,109 @@
+"""Fair-share policy primitives: tenant identity, priority classes, DRF
+ordering keys, victim selection, and the Jain fairness index the benches
+assert convergence with.
+
+Pure functions over plain dicts — the agent's scheduling walk and the
+soak/bench harnesses share these so "what the scheduler does" and "what
+the test asserts" cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: runs with no identity (auth off, direct store writers) account here
+DEFAULT_TENANT = "default"
+
+#: class name -> rank; LOWER rank wins the walk and may preempt strictly
+#: higher ranks. "normal" is the default for specs that say nothing.
+PRIORITY_CLASSES = {"high": 0, "normal": 1, "preemptible": 2}
+NORMAL_RANK = PRIORITY_CLASSES["normal"]
+
+
+def priority_rank(priority: Optional[str]) -> int:
+    """Rank for a priority-class name. Unknown/absent values rank as
+    ``normal`` — the compiler validates the polyaxonfile field, so an
+    unknown string here can only come from a raw store write, and the
+    scheduler must not KeyError over it."""
+    return PRIORITY_CLASSES.get(priority or "normal", NORMAL_RANK)
+
+
+def tenant_of(created_by: Optional[str]) -> str:
+    """Tenant derived from a run's ``created_by`` identity.
+
+    ``created_by`` is ``label#id`` for labelled tokens and ``token-<id>``
+    for unlabelled ones (ADVICE r5: the stable token id, never the
+    user-chosen label alone). The tenant is the LABEL half — two tokens
+    labelled "ci" are the same tenant for accounting even though they are
+    distinct identities — and the full identity for unlabelled tokens.
+    ``admin`` and anonymous callers account to :data:`DEFAULT_TENANT`."""
+    if not created_by or created_by == "admin":
+        return DEFAULT_TENANT
+    label, sep, _ = created_by.partition("#")
+    return label if sep and label else created_by
+
+
+def run_priority(run: dict) -> str:
+    """The priority class of a run row (compiled spec wins — it is the
+    validated one — falling back to the raw spec for pre-compile rows)."""
+    for key in ("compiled", "spec"):
+        doc = run.get(key)
+        if isinstance(doc, dict) and doc.get("priority"):
+            return str(doc["priority"])
+    return "normal"
+
+
+def drf_key(rank: int, usage: float, quota: Optional[int],
+            seq: int) -> tuple:
+    """Ordering key for one tenant+class queue head: (priority rank,
+    dominant-share ratio, admission sequence). Tenants with no quota
+    (tenancy off, or an unlimited tenant) compare at ratio 0 — among
+    themselves that reduces to (rank, seq): priority-FIFO, and with one
+    tenant and one class to plain FIFO, the r7 walk exactly."""
+    ratio = (usage / quota) if quota else 0.0
+    return (rank, ratio, seq)
+
+
+def select_victims(running: list[dict], chips: dict, rank: int,
+                   needed: int) -> Optional[list[dict]]:
+    """Pick preemption victims for a blocked run of class ``rank``.
+
+    ``running``: candidate run rows (the caller pre-filters to runs it
+    owns and drives); ``chips``: {uuid: reserved chips}. Victims must be
+    strictly lower class (rank > ``rank``), must be *compute* — service
+    runs are never preempted, only training — and are taken newest-first
+    (by created_at), so the work lost to a preemption is the work that
+    has made the least progress. Returns the victim rows once their
+    freed chips cover ``needed``, or None when even preempting every
+    eligible run would not fit the candidate (preempting anyway would
+    kill work without unblocking anything)."""
+    eligible = []
+    for run in running:
+        if run.get("kind") == "service":
+            continue
+        if priority_rank(run_priority(run)) <= rank:
+            continue
+        eligible.append(run)
+    eligible.sort(key=lambda r: (r.get("created_at") or "", r["uuid"]),
+                  reverse=True)
+    victims, freed = [], 0
+    for run in eligible:
+        victims.append(run)
+        freed += max(int(chips.get(run["uuid"], 0)), 0)
+        if freed >= needed:
+            return victims
+    return None
+
+
+def jain_index(values: list[float]) -> float:
+    """Jain's fairness index over per-tenant normalized shares:
+    ``(sum x)^2 / (n * sum x^2)``. 1.0 = perfectly quota-proportional;
+    1/n = one tenant holds everything. The soak/bench acceptance bound
+    is computed over mean steady-window shares divided by quota."""
+    xs = [float(v) for v in values]
+    if not xs:
+        return 1.0
+    sq = sum(x * x for x in xs)
+    if sq == 0.0:
+        return 1.0
+    return (sum(xs) ** 2) / (len(xs) * sq)

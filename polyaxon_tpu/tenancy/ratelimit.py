@@ -1,0 +1,82 @@
+"""Per-tenant token-bucket rate limiting for the API's write endpoints
+(ISSUE 15, PR-12 serve idiom: shed with 429 + Retry-After, never queue
+unbounded work).
+
+Buckets run on ``time.monotonic()`` — refill arithmetic is a duration on
+one machine, and an NTP step must not mint (or confiscate) a burst of
+tokens. The R4 clock rule covers this module (``tenancy/`` is in its
+scope); the corpus pair ``analysis_corpus/tenancy/r15_*`` pins the
+bug class.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/second refill, ``burst``
+    capacity. ``acquire(n)`` is non-blocking — it either spends the
+    tokens or answers how long until they exist."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate!r}")
+        self.rate = float(rate)
+        self.burst = float(burst) if burst else max(self.rate * 2.0, 1.0)
+        self._tokens = self.burst
+        self._stamp = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        elapsed = max(now - self._stamp, 0.0)
+        self._stamp = now
+        self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
+
+    def acquire(self, n: float = 1.0) -> tuple[bool, float]:
+        """Try to spend ``n`` tokens. Returns ``(True, 0.0)`` on success
+        or ``(False, retry_after_seconds)`` — the time until ``n`` tokens
+        will have refilled, the Retry-After the API answers with."""
+        now = time.monotonic()
+        with self._lock:
+            self._refill(now)
+            if self._tokens >= n:
+                self._tokens -= n
+                return True, 0.0
+            return False, (n - self._tokens) / self.rate
+
+
+class TenantRateLimiter:
+    """One :class:`TokenBucket` per tenant, bounded LRU so an identity
+    churn (many one-shot tokens) cannot grow the map without bound. All
+    tenants share one (rate, burst) policy — quotas differentiate
+    *capacity*; the rate limit only protects the API write path."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 max_tenants: int = 1024):
+        self.rate = float(rate)
+        self.burst = burst
+        self.max_tenants = int(max_tenants)
+        self._buckets: "collections.OrderedDict[str, TokenBucket]" = (
+            collections.OrderedDict())
+        self._lock = threading.Lock()
+
+    def _bucket(self, tenant: str) -> TokenBucket:
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is None:
+                b = TokenBucket(self.rate, self.burst)
+                self._buckets[tenant] = b
+                while len(self._buckets) > self.max_tenants:
+                    # evict the least-recently-used bucket; a revived
+                    # tenant just starts a fresh (full) bucket
+                    self._buckets.popitem(last=False)
+            else:
+                self._buckets.move_to_end(tenant)
+            return b
+
+    def acquire(self, tenant: str, n: float = 1.0) -> tuple[bool, float]:
+        return self._bucket(tenant).acquire(n)

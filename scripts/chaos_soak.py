@@ -713,6 +713,261 @@ def _run_train_faults_mode(args) -> int:
     return 0 if ok else 1
 
 
+def _tenant_train_spec(name: str, runtime: dict, priority: str,
+                       topology: str = "2x2"):
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": name,
+        "priority": priority,
+        "termination": {"maxRetries": 2},
+        "component": {
+            "kind": "component",
+            "name": "train",
+            "run": {"kind": "tpujob", "accelerator": "v5e",
+                    "topology": topology, "runtime": runtime},
+        },
+    }).to_dict()
+
+
+def _tenant_sleep_spec(seconds: float):
+    return {
+        "kind": "operation",
+        "component": {
+            "kind": "component", "name": "sleep",
+            "run": {"kind": "job", "container": {"command": [
+                sys.executable, "-c",
+                f"import time; time.sleep({seconds})"]}},
+        },
+    }
+
+
+def run_tenant_soak(workdir: str, seed: int = 2024,
+                    timeout: float = 600.0) -> dict:
+    """The ISSUE 15 tenancy soak, two phases over ONE chip-budgeted agent
+    (capacity 8, backend auto: jobs run locally, tpujobs through the
+    FakeCluster operator path):
+
+    - **fairness**: 3 tenants with 2:1:1 quotas (4/2/2 of 8 chips) drive
+      a saturated interleaved burst of 1-chip jobs; while the budget
+      stays saturated, per-tenant chips-in-use is sampled from the
+      strict /metrics scrape — shares must converge quota-proportional
+      (Jain >= 0.95 over the steady window's means).
+
+    - **preemption + parity**: two ``preemptible`` 2x2 training tpujobs
+      (tenant alpha, sync checkpoints every 4 steps) fill the budget;
+      mid-training, a ``high`` 2x2 training (tenant bravo) is submitted.
+      The agent must preempt the NEWEST victim within a bounded delay,
+      run the high job, then resume the victim from its newest complete
+      checkpoint — and the victim's final loss must be EXACTLY the
+      uninterrupted oracle's (0.0 delta: checkpoint restore is bit-exact
+      and the seeded data stream replays), with zero duplicate pod
+      applies and the preemption visible in the scrape.
+    """
+    from polyaxon_tpu.api.store import Store
+    from polyaxon_tpu.obs import parse_prometheus
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    store = Store(":memory:")
+    quotas = {"alpha": 4, "bravo": 2, "charlie": 2}
+    for t, c in quotas.items():
+        store.set_quota(t, c)
+    cluster = FakeCluster(os.path.join(workdir, ".cluster"))
+    agent = LocalAgent(store, workdir, backend="auto", cluster=cluster,
+                       capacity_chips=8, poll_interval=0.05,
+                       zombie_after=60.0)
+    agent.quota_refresh_s = 0.2
+    agent.start()
+    out: dict = {"quotas": dict(quotas)}
+    busy_statuses = ["created", "compiled", "queued", "scheduled",
+                     "starting", "running"]
+
+    def _tenant_series(fams) -> dict:
+        series = fams.get("polyaxon_tenant_chips_in_use", {})
+        return {t: series.get(
+            f'polyaxon_tenant_chips_in_use{{tenant="{t}"}}', 0.0)
+            for t in quotas}
+
+    try:
+        # -- phase 1: quota-proportional fairness under saturation -------
+        uuids = []
+        for i in range(8):
+            for t in sorted(quotas):
+                uuids.append(store.create_run(
+                    "p", name=f"{t}-{i}", spec=_tenant_sleep_spec(0.4),
+                    tenant=t)["uuid"])
+        samples: list[dict] = []
+        deadline = time.monotonic() + timeout / 3
+        while time.monotonic() < deadline:
+            sample = _tenant_series(parse_prometheus(store.metrics.render()))
+            if sum(sample.values()) >= 8:
+                samples.append(sample)
+            if not store.list_runs(statuses=busy_statuses, limit=1):
+                break
+            time.sleep(0.05)
+        mean_share = {
+            t: (sum(s[t] for s in samples) / len(samples)) if samples
+            else 0.0 for t in quotas}
+        from polyaxon_tpu.tenancy import jain_index
+
+        out["fairness"] = {
+            "steady_samples": len(samples),
+            "mean_share_chips": {t: round(v, 3)
+                                 for t, v in mean_share.items()},
+            "jain": round(jain_index(
+                [mean_share[t] / quotas[t] for t in quotas]), 4),
+            "statuses": {u[:8]: (store.get_run(u) or {}).get("status")
+                         for u in uuids},
+            "all_succeeded": all(
+                (store.get_run(u) or {}).get("status") == "succeeded"
+                for u in uuids),
+        }
+        # -- phase 2: priority preemption + 0.0-delta resume parity ------
+        # operator raises quotas for the training phase (oversubscribed
+        # quotas are normal — fair share arbitrates the real capacity)
+        store.set_quota("alpha", 8)
+        store.set_quota("bravo", 4)
+        rt = _train_fault_runtime(seed, watchdog=False)
+        victims = [store.create_run(
+            "p", spec=_tenant_train_spec(f"victim-{i}", rt, "preemptible"),
+            tenant="alpha")["uuid"] for i in range(2)]
+        # wait until both trainings are PAST a checkpoint (step >= 8 with
+        # save_interval_steps=4) so the preemption has a resume point
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = [store.get_run(u) for u in victims]
+            if all((r.get("heartbeat_step") or 0) >= 8 for r in rows):
+                break
+            if any(is_done_status(r["status"]) for r in rows):
+                break  # something died early: the checks below will say
+            time.sleep(0.2)
+        rt_high = _train_fault_runtime(seed, steps=8)
+        t_submit = time.monotonic()
+        high = store.create_run(
+            "p", spec=_tenant_train_spec("high-prio", rt_high, "high"),
+            tenant="bravo")["uuid"]
+        # bounded-delay preemption: one victim must reach
+        # queued(Preempted) promptly
+        preempt_delay = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if agent.preemptions:
+                preempt_delay = time.monotonic() - t_submit
+                break
+            time.sleep(0.05)
+        out["preempt_delay_s"] = (round(preempt_delay, 3)
+                                  if preempt_delay is not None else None)
+        # drain: high completes, victim resumes and completes
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = [store.get_run(u) for u in victims + [high]]
+            if all(is_done_status(r["status"]) for r in rows):
+                break
+            time.sleep(0.5)
+        out["preemptions"] = [(v[:8], b[:8]) for v, b in agent.preemptions]
+        out["high_status"] = store.get_run(high)["status"]
+        out["victims"] = {}
+        for u in victims:
+            row = store.get_run(u)
+            out["victims"][u[:8]] = {
+                "status": row["status"],
+                "loss": (row.get("outputs") or {}).get("loss"),
+                "resumed_from_step": (row.get("outputs") or {}).get(
+                    "resumed_from_step"),
+                "conditions": [
+                    (c.get("type"), c.get("reason"))
+                    for c in store.get_statuses(u) if c.get("reason")],
+            }
+        out["preempted_uuids"] = [v[:8] for v, _ in agent.preemptions]
+        out["duplicate_applies"] = list(
+            getattr(cluster, "duplicate_applies", []))
+        out["metrics_text"] = store.metrics.render()
+        return out
+    finally:
+        agent.stop()
+
+
+def is_done_status(status: str) -> bool:
+    return status in ("succeeded", "failed", "stopped", "skipped",
+                      "upstream_failed", "done")
+
+
+def _run_tenants_mode(args) -> int:
+    from polyaxon_tpu.obs import parse_prometheus
+
+    root = tempfile.mkdtemp(prefix="plx-tenant-soak-")
+    ok = True
+    final_scrape = ""
+    try:
+        oracle = _train_oracle(os.path.join(root, "oracle"),
+                               seed=args.seed)
+        print(json.dumps({"pass": "oracle", "loss": oracle["loss"]}))
+        out = run_tenant_soak(os.path.join(root, "tenants"),
+                              seed=args.seed, timeout=args.timeout)
+        final_scrape = out["metrics_text"]
+        fams = parse_prometheus(final_scrape)
+        preempt_total = sum(
+            fams.get("polyaxon_preemptions_total", {}).values())
+        quota_series = fams.get("polyaxon_quota_chips", {})
+        checks = {
+            # quota-proportional convergence over the steady window
+            "fairness_jain": out["fairness"]["jain"] >= 0.95,
+            "fairness_all_succeeded": out["fairness"]["all_succeeded"],
+            # bounded-delay high-priority preemption
+            "preempted": len(out["preemptions"]) >= 1,
+            "preempt_delay_bounded": (
+                out["preempt_delay_s"] is not None
+                and out["preempt_delay_s"] <= 10.0),
+            "high_succeeded": out["high_status"] == "succeeded",
+            # zero duplicate launches through the whole soak
+            "no_duplicate_applies": not out["duplicate_applies"],
+            # the strict scrape tells the same story as the audit trail
+            "scrape_preemptions": preempt_total == float(
+                len(out["preemptions"])),
+            "scrape_quota_series": (
+                quota_series.get('polyaxon_quota_chips{tenant="alpha"}')
+                == 8.0),
+        }
+        parity = {}
+        for short, v in out["victims"].items():
+            loss = v["loss"]
+            delta = None if loss is None else abs(loss - oracle["loss"])
+            parity[short] = delta
+            checks[f"succeeded_{short}"] = v["status"] == "succeeded"
+            # 0.0-delta: checkpoint restore is bit-exact and the seeded
+            # stream replays, so a preempted-then-resumed run lands on
+            # EXACTLY the uninterrupted loss
+            checks[f"parity_zero_{short}"] = delta == 0.0
+        for short in out["preempted_uuids"]:
+            v = out["victims"].get(short, {})
+            checks[f"preempted_condition_{short}"] = (
+                ("queued", "Preempted") in (v.get("conditions") or []))
+            checks[f"resumed_{short}"] = (
+                (v.get("resumed_from_step") or 0) > 0)
+        ok = all(checks.values())
+        print(json.dumps({
+            "pass": "tenants", "ok": ok, "checks": checks,
+            "fairness": out["fairness"],
+            "preempt_delay_s": out["preempt_delay_s"],
+            "preemptions": out["preemptions"],
+            "parity_abs": parity,
+            "victims": {k: {kk: vv for kk, vv in v.items()
+                            if kk != "conditions"}
+                        for k, v in out["victims"].items()},
+        }))
+    finally:
+        if args.keep:
+            print(json.dumps({"workdir": root}))
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+    if args.metrics_dump:
+        _dump_metrics(args.metrics_dump, final_scrape)
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
 def run_store_outage_soak(workdir: str, seed: int = 2024, n_jobs: int = 12,
                           agents: int = 4, num_shards: int = 8,
                           lease_ttl: float = 0.8, timeout: float = 300.0,
@@ -2057,6 +2312,17 @@ def main() -> int:
                         "every surviving watcher's delta sequence equals "
                         "the changelog oracle (no lost/dup/reordered) "
                         "and all shedding shows in the strict scrape")
+    p.add_argument("--tenants", action="store_true",
+                   help="multi-tenant scheduling soak (ISSUE 15): 3 "
+                        "tenants with 2:1:1 quotas under a saturated "
+                        "burst must converge to quota-proportional chip "
+                        "shares (Jain >= 0.95 over the steady window), a "
+                        "high-priority submit must preempt the newest "
+                        "lower-class training within a bounded delay, "
+                        "every preempted run must resume to 0.0-delta "
+                        "final-loss parity vs its uninterrupted oracle, "
+                        "zero duplicate launches — all via the strict "
+                        "/metrics scrape")
     p.add_argument("--store-outage", action="store_true",
                    help="store-survivability soak (ISSUE 7): kill the "
                         "PRIMARY STORE mid-wave under a sharded agent "
@@ -2082,7 +2348,7 @@ def main() -> int:
 
     if args.lock_witness and (args.train_faults or args.serve_traffic
                               or args.serve_faults or args.store_outage
-                              or args.watcher_faults):
+                              or args.watcher_faults or args.tenants):
         # refuse rather than silently run unwitnessed: an operator who
         # asked for the witness must not read a lucky exit 0 as
         # "cycle-free" when no locks were instrumented
@@ -2094,6 +2360,8 @@ def main() -> int:
         return 2
     if args.watcher_faults:
         return _run_watcher_faults_mode(args)
+    if args.tenants:
+        return _run_tenants_mode(args)
     if args.train_faults:
         return _run_train_faults_mode(args)
     if args.serve_faults:

@@ -32,10 +32,12 @@ if [ "$1" = "--fast" ]; then
 fi
 
 # gate 3 carries the perf regression smokes too: sched_bench's saturated
-# burst (tests/test_sched_bench.py) and dashboard_bench's SSE fan-out
-# p95 bound (tests/test_dashboard_bench.py, ISSUE 14) both run as
-# ordinary tier-1 tests — a change that hands the scheduler win back to
-# polling or regresses publish->deliver latency fails this gate.
+# burst (tests/test_sched_bench.py), dashboard_bench's SSE fan-out
+# p95 bound (tests/test_dashboard_bench.py, ISSUE 14), and the tenancy
+# fairness smoke + suite (tests/test_tenancy.py, sched_bench --tenants,
+# ISSUE 15) all run as ordinary tier-1 tests — a change that hands the
+# scheduler win back to polling, regresses publish->deliver latency, or
+# breaks quota-proportional fairness fails this gate.
 echo "== gate 3/3: tier-1 tests (ROADMAP.md verify) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -15,7 +15,14 @@ modes:
 Usage:
     python scripts/sched_bench.py [N] [--mode wake|poll|both]
         [--poll-interval SEC] [--max-parallel M] [--agents A]
-        [--out PATH] [--suite]
+        [--out PATH] [--suite] [--tenants]
+
+``--tenants`` (ISSUE 15) runs the multi-tenant fairness smoke: a
+saturated interleaved burst from 3 tenants under 2:1:1 chip quotas,
+reporting each tenant's mean steady-window chip share (from the strict
+/metrics scrape), Jain's fairness index over the quota-normalized
+shares, and the single-tenant FIFO-vs-fair-share A/B (the
+no-regression row).
 
 ``--agents A`` (ISSUE 6) drives the burst with a fleet of A shard-aware
 agents over ONE shared file-backed store (num_shards=8 work partitions,
@@ -80,7 +87,10 @@ def run_mode(n: int, mode: str, poll_interval: float, max_parallel: int,
              timeout: float = 300.0, agents: int = 1,
              num_shards: int = 8,
              file_store: "bool | None" = None,
-             spec: "dict | None" = None) -> dict:
+             spec: "dict | None" = None,
+             quotas: "dict | None" = None,
+             tenant: "str | None" = None,
+             capacity_chips: "int | None" = None) -> dict:
     from polyaxon_tpu.api.store import Store
     from polyaxon_tpu.scheduler.agent import LocalAgent
 
@@ -98,6 +108,12 @@ def run_mode(n: int, mode: str, poll_interval: float, max_parallel: int,
         file_store = agents > 1
     store = Store(os.path.join(workdir, "db.sqlite")
                   if file_store else ":memory:")
+    # tenancy A/B (ISSUE 15): ``quotas`` configures the quota table and
+    # ``tenant`` stamps every created run, so the SAME burst can be run
+    # through the FIFO fast path (no quotas) and the fair-share walk
+    # (one tenant, quota == capacity) — the single-tenant-parity check.
+    for t, c in (quotas or {}).items():
+        store.set_quota(t, c)
     created: dict[str, float] = {}
     running: dict[str, float] = {}
     done: dict[str, float] = {}
@@ -113,6 +129,7 @@ def run_mode(n: int, mode: str, poll_interval: float, max_parallel: int,
     fleet = [LocalAgent(
         store, workdir, backend="local", max_parallel=max_parallel,
         poll_interval=poll_interval,
+        capacity_chips=capacity_chips,
         use_change_feed=(mode == "wake"),
         num_shards=(num_shards if agents > 1 else 1),
         # generous TTL for a benchmark fleet: nobody dies here, and a
@@ -135,7 +152,7 @@ def run_mode(n: int, mode: str, poll_interval: float, max_parallel: int,
         for i in range(n):
             uuid = store.create_run(
                 project="bench", name=f"noop-{i}",
-                spec=spec or NOOP_SPEC)["uuid"]
+                spec=spec or NOOP_SPEC, tenant=tenant)["uuid"]
             created[uuid] = time.monotonic()
         deadline = time.monotonic() + timeout
         while len(done) < n and time.monotonic() < deadline:
@@ -211,6 +228,112 @@ def run_multi_agent(n: int = 48, poll_interval: float = 0.2,
     }
 
 
+def run_tenants(n_per_tenant: int = 8,
+                quotas: "dict | None" = None,
+                capacity: int = 8,
+                job_seconds: float = 0.4,
+                poll_interval: float = 0.05,
+                timeout: float = 180.0,
+                ab: bool = True) -> dict:
+    """Multi-tenant fairness smoke (ISSUE 15): a saturated interleaved
+    burst from 3 tenants with 2:1:1 chip quotas against one chip-budgeted
+    agent. While the budget stays saturated, per-tenant chips-in-use is
+    sampled from the STRICT /metrics scrape (the
+    ``polyaxon_tenant_chips_in_use{tenant}`` family — the same series an
+    operator's Prometheus sees), each tenant's mean steady-window share
+    is normalized by its quota, and Jain's fairness index over those
+    ratios is reported: 1.0 = perfectly quota-proportional.
+
+    ``ab=True`` appends the single-tenant A/B row: the same saturated
+    burst through the FIFO fast path (no quotas) and through the
+    fair-share walk with ONE tenant whose quota equals capacity — the
+    walks must order identically, so runs/min must match (the no-
+    regression acceptance row)."""
+    import tempfile as _tf
+
+    from polyaxon_tpu.api.store import Store
+    from polyaxon_tpu.obs import parse_prometheus
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+    from polyaxon_tpu.tenancy import jain_index
+
+    quotas = dict(quotas or {"tenant-a": capacity // 2,
+                             "tenant-b": capacity // 4,
+                             "tenant-c": capacity // 4})
+    workdir = _tf.mkdtemp(prefix="sched_bench_tenants_")
+    store = Store(":memory:")
+    for t, c in quotas.items():
+        store.set_quota(t, c)
+    agent = LocalAgent(store, workdir, backend="local",
+                       capacity_chips=capacity,
+                       poll_interval=poll_interval)
+    agent.quota_refresh_s = 0.2
+    agent.start()
+    tenants = sorted(quotas)
+    uuids = []
+    t0 = time.monotonic()
+    samples: list[dict] = []
+    try:
+        for i in range(n_per_tenant):
+            for t in tenants:  # interleaved: every tenant is backlogged
+                uuids.append(store.create_run(
+                    "bench", name=f"{t}-{i}",
+                    spec=sleep_spec(job_seconds), tenant=t)["uuid"])
+        deadline = time.monotonic() + timeout
+        busy_statuses = ["created", "compiled", "queued", "scheduled",
+                         "starting", "running"]
+        while time.monotonic() < deadline:
+            fams = parse_prometheus(store.metrics.render())
+            series = fams.get("polyaxon_tenant_chips_in_use", {})
+            sample = {
+                t: series.get(
+                    f'polyaxon_tenant_chips_in_use{{tenant="{t}"}}', 0.0)
+                for t in tenants}
+            if sum(sample.values()) >= capacity:
+                samples.append(sample)  # steady (saturated) window only
+            if not store.list_runs(statuses=busy_statuses, limit=1):
+                break
+            time.sleep(poll_interval)
+    finally:
+        agent.stop()
+    wall = time.monotonic() - t0
+    completed = sum(
+        1 for u in uuids
+        if (store.get_run(u) or {}).get("status") == "succeeded")
+    mean_share = {
+        t: (sum(s[t] for s in samples) / len(samples)) if samples else 0.0
+        for t in tenants}
+    ratios = [mean_share[t] / quotas[t] if quotas[t] else 0.0
+              for t in tenants]
+    out = {
+        "metric": "scheduler_tenant_fairness",
+        "quotas": quotas,
+        "capacity_chips": capacity,
+        "runs": len(uuids),
+        "completed": completed,
+        "steady_samples": len(samples),
+        "mean_share_chips": {t: round(v, 3) for t, v in mean_share.items()},
+        "share_over_quota": [round(r, 4) for r in ratios],
+        "jain_fairness": round(jain_index(ratios), 4),
+        "wall_s": round(wall, 3),
+    }
+    if ab:
+        n = 3 * n_per_tenant
+        fifo = run_mode(n, "wake", poll_interval, max_parallel=capacity,
+                        capacity_chips=capacity,
+                        spec=sleep_spec(job_seconds), timeout=timeout)
+        fair = run_mode(n, "wake", poll_interval, max_parallel=capacity,
+                        capacity_chips=capacity,
+                        spec=sleep_spec(job_seconds), timeout=timeout,
+                        quotas={"solo": capacity}, tenant="solo")
+        out["single_tenant_ab"] = {
+            "fifo_runs_per_min": fifo["runs_per_min"],
+            "fair_share_runs_per_min": fair["runs_per_min"],
+            "fifo_completed": fifo["completed"],
+            "fair_share_completed": fair["completed"],
+        }
+    return out
+
+
 def run_suite(n: int = 100, poll_interval: float = 0.2) -> dict:
     """Both BASELINE scenarios, both modes, plus the multi-agent scaling
     sweep — the committed-artifact shape.
@@ -250,6 +373,8 @@ def main() -> None:
 
     if "--suite" in sys.argv:
         out = run_suite(n, poll_interval)
+    elif "--tenants" in sys.argv:
+        out = run_tenants(poll_interval=min(poll_interval, 0.05))
     else:
         out = run_bench(n, mode, poll_interval, max_parallel, agents=agents)
     line = json.dumps(out)

@@ -1,0 +1,27 @@
+"""R15 clean twin — the shipped token bucket's discipline: refill deltas
+on ``time.monotonic()`` (NTP-immune durations), wall clock only where a
+persisted human-facing timestamp genuinely needs it, justified inline."""
+
+import time
+
+
+class MonotonicBucket:
+    def __init__(self, rate: float, burst: float):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.stamp = time.monotonic()
+
+    def acquire(self) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def audit_row(self) -> dict:
+        # plx: allow(clock): persisted audit timestamp read by humans across machines — wall clock is the contract
+        return {"rejected_at": time.time()}

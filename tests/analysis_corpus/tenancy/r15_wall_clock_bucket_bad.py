@@ -1,0 +1,25 @@
+"""R15 reproducer — wall-clock token-bucket refill (the ISSUE 15 rate
+limiter's bug class): ``time.time()`` deltas drive the refill, so an NTP
+step backwards freezes admission for the step's span and a step forward
+mints a full burst of tokens out of thin air. The clock rule must flag
+every wall-clock read in tenancy/ code."""
+
+import time
+
+
+class WallClockBucket:
+    def __init__(self, rate: float, burst: float):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.stamp = time.time()  # finding: wall clock seeds the refill
+
+    def acquire(self) -> bool:
+        now = time.time()  # finding: refill arithmetic on the wall clock
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False

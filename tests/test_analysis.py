@@ -87,6 +87,9 @@ BAD_CASES = [
     # the ISSUE 14 SSE surface: blocking store calls inside the async
     # stream handler (the PR-7 blocked-loop class on a new endpoint)
     ("asyncblock", "api/r14_asyncblock_sse_bad.py", 3),
+    # ISSUE 15 tenancy: wall-clock token-bucket refill (an NTP step mints
+    # or confiscates a burst of API admission tokens)
+    ("clock", "tenancy/r15_wall_clock_bucket_bad.py", 2),
 ]
 
 OK_TWINS = [
@@ -98,6 +101,7 @@ OK_TWINS = [
     "r6_rebind_ok.py",
     "serve/r12_monotonic_decode_ok.py",
     "api/r14_asyncblock_sse_ok.py",
+    "tenancy/r15_monotonic_bucket_ok.py",
 ]
 
 

@@ -86,6 +86,15 @@ EXPECTED_FAMILIES = {
     "polyaxon_stream_events_total",
     "polyaxon_stream_evictions_total",
     "polyaxon_stream_rejected_total",
+    # multi-tenant scheduling (ISSUE 15): quota geometry, per-tenant
+    # usage, priority preemptions, API write shedding, and the
+    # unknown-tenant fallback — all present from birth (default-tenant
+    # series) so a scrape answers "is tenancy healthy" on day zero
+    "polyaxon_quota_chips",
+    "polyaxon_tenant_chips_in_use",
+    "polyaxon_preemptions_total",
+    "polyaxon_api_rate_limited_total",
+    "polyaxon_tenant_quota_fallbacks_total",
 }
 
 

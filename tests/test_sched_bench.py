@@ -76,6 +76,26 @@ class TestSchedBench:
             f"2 agents never beat 1 on runs/min in {len(attempts)} "
             f"attempts: {attempts}")
 
+    def test_tenant_fairness_smoke(self):
+        """Tier-1 fairness smoke (ISSUE 15): `sched_bench --tenants`
+        must complete its interleaved 3-tenant burst and converge the
+        steady-window chip shares near quota proportions (Jain bound;
+        the slow soak and chaos_soak --tenants assert the tight 0.95
+        bar, this smoke guards the machinery on a noisy shared box)."""
+        from sched_bench import run_tenants
+
+        attempts = []
+        for _ in range(3):
+            out = run_tenants(n_per_tenant=5, job_seconds=0.3,
+                              poll_interval=0.05, ab=False)
+            assert out["completed"] == out["runs"], out
+            attempts.append(out["jain_fairness"])
+            if out["steady_samples"] >= 3 and out["jain_fairness"] >= 0.9:
+                return
+        raise AssertionError(
+            f"tenant shares never converged (jain per attempt: "
+            f"{attempts})")
+
     def test_poll_mode_detaches_change_feed(self):
         """use_change_feed=False must detach the SCHEDULING feed — no
         dirty tracking, no loop wakes, full scans every tick

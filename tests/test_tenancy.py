@@ -1,0 +1,651 @@
+"""Multi-tenant scheduling suite (ISSUE 15): tenant registry + chip
+quotas, identity stamping, API rate limiting, the weighted fair-share
+walk (incl. the single-tenant == FIFO parity bar), over-quota
+park/unpark, checkpoint-safe priority preemption, and the
+unknown-tenant fallback regression. docs/SCHEDULING.md is the contract
+under test."""
+
+import os
+import sys
+import time
+
+import pytest
+import requests
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from polyaxon_tpu.api import ApiServer  # noqa: E402
+from polyaxon_tpu.api.store import StaleLeaseError, Store  # noqa: E402
+from polyaxon_tpu.client import QuotaClient, RunClient  # noqa: E402
+from polyaxon_tpu.obs import parse_prometheus  # noqa: E402
+from polyaxon_tpu.polyaxonfile import check_polyaxonfile  # noqa: E402
+from polyaxon_tpu.scheduler.agent import LocalAgent  # noqa: E402
+from polyaxon_tpu.tenancy import (  # noqa: E402
+    DEFAULT_TENANT,
+    TenantRateLimiter,
+    TokenBucket,
+    jain_index,
+    priority_rank,
+    run_priority,
+    select_victims,
+    tenant_of,
+)
+from polyaxon_tpu.tenancy.fairshare import drf_key  # noqa: E402
+
+
+def sleep_spec(seconds: float, priority=None) -> dict:
+    d = {
+        "kind": "operation",
+        "component": {
+            "kind": "component", "name": "s",
+            "run": {"kind": "job", "container": {"command": [
+                sys.executable, "-c",
+                f"import time; time.sleep({seconds})"]}},
+        },
+    }
+    if priority:
+        d["priority"] = priority
+    return d
+
+
+def wait_for(pred, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- identity + classes (pure) ------------------------------------------------
+
+
+class TestIdentity:
+    def test_tenant_of_label_and_bare_tokens(self):
+        assert tenant_of("alice#3") == "alice"
+        assert tenant_of("ci#7") == "ci"
+        # two tokens labelled "ci" are ONE tenant for accounting
+        assert tenant_of("ci#8") == "ci"
+        assert tenant_of("token-9") == "token-9"
+        assert tenant_of(None) == DEFAULT_TENANT
+        assert tenant_of("admin") == DEFAULT_TENANT
+
+    def test_priority_rank_total_order_and_unknowns(self):
+        assert priority_rank("high") < priority_rank("normal")
+        assert priority_rank("normal") < priority_rank("preemptible")
+        # unknown strings (raw store writes) rank normal, never KeyError
+        assert priority_rank("nonsense") == priority_rank("normal")
+        assert priority_rank(None) == priority_rank("normal")
+
+    def test_run_priority_prefers_compiled(self):
+        run = {"spec": {"priority": "preemptible"},
+               "compiled": {"priority": "high"}}
+        assert run_priority(run) == "high"
+        assert run_priority({"spec": {"priority": "preemptible"}}) \
+            == "preemptible"
+        assert run_priority({}) == "normal"
+
+
+class TestPriorityCompileTime:
+    def test_valid_priority_flows_to_compiled(self):
+        op = check_polyaxonfile({**sleep_spec(0, "high")})
+        compiled = op.to_dict()
+        assert compiled["priority"] == "high"
+        from polyaxon_tpu.schemas.operation import V1CompiledOperation
+
+        cop = V1CompiledOperation.from_operation(op)
+        assert cop.priority == "high"
+
+    def test_bad_priority_fails_the_polyaxonfile_check(self):
+        with pytest.raises(Exception, match="priority"):
+            check_polyaxonfile({**sleep_spec(0, "urgent")})
+
+
+# -- quota store --------------------------------------------------------------
+
+
+class TestQuotaStore:
+    def test_set_get_list_delete(self):
+        s = Store(":memory:")
+        assert s.get_quota("a") is None
+        assert s.set_quota("a", 4) == {"tenant": "a", "chips": 4}
+        s.set_quota("b", 2)
+        assert s.get_quota("a")["chips"] == 4
+        assert [q["tenant"] for q in s.list_quotas()] == ["a", "b"]
+        s.set_quota("a", 6)  # upsert
+        assert s.get_quota_map() == {"a": 6, "b": 2}
+        assert s.delete_quota("a") is True
+        assert s.delete_quota("a") is False
+        assert s.get_quota_map() == {"b": 2}
+
+    def test_set_quota_validates(self):
+        s = Store(":memory:")
+        with pytest.raises(ValueError):
+            s.set_quota("a", -1)
+
+    def test_quota_gauge_exported_from_birth_and_on_set(self):
+        s = Store(":memory:")
+        fams = parse_prometheus(s.metrics.render())
+        assert 'polyaxon_quota_chips{tenant="default"}' \
+            in fams["polyaxon_quota_chips"]
+        s.set_quota("teamA", 16)
+        fams = parse_prometheus(s.metrics.render())
+        assert fams["polyaxon_quota_chips"][
+            'polyaxon_quota_chips{tenant="teamA"}'] == 16
+
+    def test_quota_replicates_through_the_changelog(self):
+        a = Store(":memory:")
+        a.set_quota("a", 4)
+        a.delete_quota("a")
+        a.set_quota("b", 2)
+        b = Store(":memory:")
+        b.apply_changelog(a.get_changelog(0, 500))
+        assert b.get_quota_map() == {"b": 2}
+
+    def test_set_quota_is_fenceable(self):
+        s = Store(":memory:")
+        lease = s.acquire_lease("scheduler", "me", ttl=30)
+        with pytest.raises(StaleLeaseError):
+            s.set_quota("a", 4, fence=("scheduler", lease["token"] - 1))
+        s.set_quota("a", 4, fence=("scheduler", lease["token"]))
+        assert s.get_quota("a")["chips"] == 4
+
+
+class TestTenantStamping:
+    def test_derived_from_created_by(self):
+        s = Store(":memory:")
+        assert s.create_run("p", spec={}, created_by="alice#3")["tenant"] \
+            == "alice"
+        assert s.create_run("p", spec={})["tenant"] == DEFAULT_TENANT
+
+    def test_explicit_tenant_wins(self):
+        s = Store(":memory:")
+        r = s.create_run("p", spec={}, created_by="alice#3", tenant="ml")
+        assert r["tenant"] == "ml"
+
+    def test_pipeline_children_inherit_parent_tenant(self):
+        s = Store(":memory:")
+        parent = s.create_run("p", spec={}, tenant="ml")
+        child = s.create_run("p", spec={}, pipeline_uuid=parent["uuid"])
+        assert child["tenant"] == "ml"
+
+    def test_annotate_status_appends_condition_and_patches_meta(self):
+        s = Store(":memory:")
+        r = s.create_run("p", spec={}, name="x")
+        s.annotate_status(r["uuid"], reason="OverQuota", message="parked",
+                          meta_patch={"over_quota": True})
+        row = s.get_run(r["uuid"])
+        assert row["status"] == "created"  # no transition happened
+        assert row["meta"]["over_quota"] is True
+        assert [c.get("reason") for c in s.get_statuses(r["uuid"])][-1] \
+            == "OverQuota"
+        # None values delete meta keys
+        s.annotate_status(r["uuid"], reason="QuotaRestored",
+                          meta_patch={"over_quota": None})
+        assert "over_quota" not in (s.get_run(r["uuid"])["meta"] or {})
+
+
+# -- rate limiting ------------------------------------------------------------
+
+
+class TestRateLimit:
+    def test_token_bucket_burst_then_refill(self):
+        b = TokenBucket(rate=1000.0, burst=2)
+        assert b.acquire() == (True, 0.0)
+        assert b.acquire()[0] is True
+        ok, retry = b.acquire()
+        assert ok is False and retry > 0
+        time.sleep(0.01)  # 1000/s refills ~10 tokens
+        assert b.acquire()[0] is True
+
+    def test_tenant_isolation_and_lru_bound(self):
+        rl = TenantRateLimiter(rate=100.0, burst=1, max_tenants=2)
+        assert rl.acquire("a")[0] is True
+        assert rl.acquire("a")[0] is False
+        assert rl.acquire("b")[0] is True  # b's bucket is untouched
+        rl.acquire("c")  # evicts the LRU bucket; map stays bounded
+        assert len(rl._buckets) == 2
+
+    def test_api_write_endpoints_shed_with_429_shape(self):
+        srv = ApiServer(port=0, rate_limit=1.0, rate_limit_burst=2).start()
+        try:
+            codes = []
+            for i in range(4):
+                codes.append(requests.post(
+                    srv.url + "/api/v1/p/runs",
+                    json={"spec": {}, "name": f"r{i}"}, timeout=10))
+            statuses = [r.status_code for r in codes]
+            assert statuses[:2] == [201, 201]
+            assert 429 in statuses[2:]
+            shed = [r for r in codes if r.status_code == 429][0]
+            assert int(shed.headers["Retry-After"]) >= 1
+            body = shed.json()
+            assert body["error"] == "rate limited"
+            assert body["tenant"] == DEFAULT_TENANT
+            assert body["retry_after_s"] > 0
+            # reads are never rate limited
+            assert requests.get(srv.url + "/api/v1/p/runs",
+                                timeout=10).status_code == 200
+            fams = parse_prometheus(
+                requests.get(srv.url + "/metrics", timeout=10).text)
+            assert sum(fams["polyaxon_api_rate_limited_total"].values()) \
+                >= 1
+        finally:
+            srv.stop()
+
+    def test_rate_limit_off_by_default(self):
+        srv = ApiServer(port=0).start()
+        try:
+            for i in range(8):
+                assert requests.post(
+                    srv.url + "/api/v1/p/runs", json={"spec": {}},
+                    timeout=10).status_code == 201
+        finally:
+            srv.stop()
+
+
+# -- fair-share ordering (pure) ----------------------------------------------
+
+
+class TestFairShareOrdering:
+    def test_drf_key_class_dominates_then_ratio_then_seq(self):
+        # high beats normal regardless of ratio
+        assert drf_key(0, 100, 10, 5) < drf_key(1, 0, 10, 0)
+        # within a class, lower usage/quota ratio wins
+        assert drf_key(1, 1, 4, 9) < drf_key(1, 2, 4, 0)
+        # equal ratios: admission order (FIFO)
+        assert drf_key(1, 2, 4, 1) < drf_key(1, 2, 4, 2)
+        # no quota = ratio 0: reduces to (class, seq) = priority-FIFO
+        assert drf_key(1, 50, None, 1) < drf_key(1, 0, 4, 2) or \
+            drf_key(1, 50, None, 1)[1] == 0.0
+
+    def test_ordering_is_deterministic(self):
+        keys = [drf_key(r, u, q, s)
+                for r in (0, 1, 2) for u in (0, 2) for q in (4, None)
+                for s in (0, 1)]
+        assert sorted(keys) == sorted(keys, key=tuple)  # total order holds
+
+    def test_select_victims_newest_first_lower_class_only(self):
+        rows = [
+            {"uuid": "old", "kind": "tpujob", "created_at": "2026-01-01",
+             "spec": {"priority": "preemptible"}},
+            {"uuid": "new", "kind": "tpujob", "created_at": "2026-01-02",
+             "spec": {"priority": "preemptible"}},
+            {"uuid": "svc", "kind": "service", "created_at": "2026-01-03",
+             "spec": {"priority": "preemptible"}},
+            {"uuid": "normal", "kind": "job", "created_at": "2026-01-04",
+             "spec": {}},
+        ]
+        chips = {"old": 4, "new": 4, "svc": 4, "normal": 4}
+        # high (rank 0) preempting: newest ELIGIBLE first — the service
+        # is never eligible, the newest training is
+        victims = select_victims(rows, chips, priority_rank("high"), 4)
+        assert [v["uuid"] for v in victims] == ["normal"] or \
+            [v["uuid"] for v in victims] == ["new"]
+        # normal (rank 1) may only take preemptible victims
+        victims = select_victims(rows, chips, priority_rank("normal"), 8)
+        assert [v["uuid"] for v in victims] == ["new", "old"]
+        # insufficient even preempting everything -> None (never partial)
+        assert select_victims(rows, chips, priority_rank("normal"), 99) \
+            is None
+        # equal class is never a victim
+        assert select_victims(
+            [rows[3]], chips, priority_rank("normal"), 1) is None
+
+    def test_jain_index(self):
+        assert jain_index([1.0, 1.0, 1.0]) == pytest.approx(1.0)
+        assert jain_index([1.0, 0.0, 0.0]) == pytest.approx(1 / 3)
+        assert jain_index([]) == 1.0
+
+
+# -- agent integration --------------------------------------------------------
+
+
+def make_agent(tmp_path, store, capacity=4, **kw):
+    return LocalAgent(store, str(tmp_path), backend="local",
+                      capacity_chips=capacity, poll_interval=0.05, **kw)
+
+
+class TestFairShareAgent:
+    def test_no_quotas_no_classes_takes_the_fifo_fast_path(
+            self, tmp_path, monkeypatch):
+        """With tenancy off the dispatch must run the r7 FIFO walk —
+        the fair walk would be a silent perf/behavior change for every
+        existing deployment."""
+        store = Store(":memory:")
+        agent = make_agent(tmp_path, store)
+
+        def boom(*a, **k):
+            raise AssertionError("fair walk engaged without tenancy")
+
+        monkeypatch.setattr(agent, "_walk_fair", boom)
+        for i in range(3):
+            store.create_run("p", name=f"r{i}", spec=sleep_spec(0.05))
+        try:
+            agent.tick()
+            assert wait_for(lambda: not store.list_runs(
+                statuses=["created", "compiled", "queued", "scheduled",
+                          "starting", "running"], limit=1))
+        finally:
+            agent.stop()
+
+    def test_single_tenant_fifo_parity(self, tmp_path):
+        """ISSUE 15 acceptance: num_tenants=1 degrades to today's FIFO
+        EXACTLY — the scheduling order of a saturated single-tenant
+        burst under the fair walk equals creation order (what the r7
+        agent does)."""
+        store = Store(":memory:")
+        store.set_quota("solo", 2)
+        agent = make_agent(tmp_path, store, capacity=2)
+        agent.quota_refresh_s = 0.0
+        order = []
+        store.add_transition_listener(
+            lambda u, s: order.append(u) if s == "scheduled" else None)
+        uuids = [store.create_run("p", name=f"r{i}",
+                                  spec=sleep_spec(0.15), tenant="solo")
+                 ["uuid"] for i in range(6)]
+        try:
+            agent.start()
+            assert wait_for(lambda: len(order) >= 6, timeout=30)
+        finally:
+            agent.stop()
+        assert order[:6] == uuids
+
+    def test_drf_interleaves_backlogged_tenants(self, tmp_path):
+        """Two tenants with equal quotas, tenant a's whole burst created
+        BEFORE tenant b's: plain FIFO would drain a first; the fair walk
+        must give each tenant its quota share immediately."""
+        store = Store(":memory:")
+        store.set_quota("a", 1)
+        store.set_quota("b", 1)
+        agent = make_agent(tmp_path, store, capacity=2)
+        agent.quota_refresh_s = 0.0
+        for i in range(3):
+            store.create_run("p", name=f"a{i}", spec=sleep_spec(5),
+                             tenant="a")
+        for i in range(3):
+            store.create_run("p", name=f"b{i}", spec=sleep_spec(5),
+                             tenant="b")
+        try:
+            agent.tick()
+            usage = agent._tenant_usage()
+            assert usage == {"a": 1, "b": 1}, usage
+        finally:
+            agent.stop()
+
+    def test_tenant_usage_gauge_in_scrape(self, tmp_path):
+        store = Store(":memory:")
+        store.set_quota("a", 2)
+        agent = make_agent(tmp_path, store, capacity=2)
+        agent.quota_refresh_s = 0.0
+        store.create_run("p", name="x", spec=sleep_spec(5), tenant="a")
+        try:
+            agent.tick()
+            fams = parse_prometheus(store.metrics.render())
+            assert fams["polyaxon_tenant_chips_in_use"][
+                'polyaxon_tenant_chips_in_use{tenant="a"}'] == 1
+        finally:
+            agent.stop()
+
+
+class TestOverQuota:
+    def test_park_loudly_then_unpark(self, tmp_path):
+        store = Store(":memory:")
+        store.set_quota("a", 1)
+        agent = make_agent(tmp_path, store, capacity=4)
+        agent.quota_refresh_s = 0.0
+        first = store.create_run("p", name="first",
+                                 spec=sleep_spec(0.3), tenant="a")["uuid"]
+        second = store.create_run("p", name="second",
+                                  spec=sleep_spec(0.1), tenant="a")["uuid"]
+        try:
+            agent.tick()
+            row = store.get_run(second)
+            # accepted and PARKED, never dropped or failed
+            assert row["status"] == "queued"
+            assert row["meta"]["over_quota"] is True
+            reasons = [c.get("reason")
+                       for c in store.get_statuses(second)]
+            assert "OverQuota" in reasons
+            # capacity was never the limit — quota was
+            assert store.get_run(first)["status"] in (
+                "scheduled", "starting", "running")
+            # first finishes -> quota frees -> second unparks and runs
+            assert wait_for(lambda: (store.get_run(first) or {})
+                            .get("status") == "succeeded", timeout=20)
+            agent.tick()
+            assert wait_for(lambda: (store.get_run(second) or {})
+                            .get("status") == "succeeded", timeout=20)
+            assert "over_quota" not in (
+                store.get_run(second)["meta"] or {})
+        finally:
+            agent.stop()
+
+    def test_over_quota_condition_stamped_once(self, tmp_path):
+        store = Store(":memory:")
+        store.set_quota("a", 0)
+        agent = make_agent(tmp_path, store, capacity=4)
+        agent.quota_refresh_s = 0.0
+        u = store.create_run("p", name="x", spec=sleep_spec(1),
+                             tenant="a")["uuid"]
+        try:
+            agent.tick()
+            agent.tick()
+            agent.tick()
+            reasons = [c.get("reason") for c in store.get_statuses(u)]
+            assert reasons.count("OverQuota") == 1
+        finally:
+            agent.stop()
+
+
+class TestUnknownTenantFallback:
+    def test_unknown_tenant_schedules_under_default_loudly(self, tmp_path):
+        """The ISSUE 15 regression unit: a run whose tenant has no quota
+        row (unknown, or deleted mid-flight) must NOT KeyError the
+        scheduling pass — it falls back to the default quota with a
+        status condition + counter."""
+        store = Store(":memory:")
+        store.set_quota("known", 2)
+        agent = make_agent(tmp_path, store, capacity=2)
+        agent.quota_refresh_s = 0.0
+        u = store.create_run("p", name="x", spec=sleep_spec(0.1),
+                             tenant="ghost")["uuid"]
+        try:
+            agent.tick()  # must not raise
+            assert wait_for(lambda: (store.get_run(u) or {})
+                            .get("status") == "succeeded", timeout=20)
+            reasons = [c.get("reason") for c in store.get_statuses(u)]
+            assert "UnknownTenant" in reasons
+            fams = parse_prometheus(store.metrics.render())
+            assert sum(fams["polyaxon_tenant_quota_fallbacks_total"]
+                       .values()) == 1
+        finally:
+            agent.stop()
+
+    def test_deleted_tenant_falls_back_to_default_row(self, tmp_path):
+        store = Store(":memory:")
+        store.set_quota("doomed", 2)
+        store.set_quota("default", 1)
+        agent = make_agent(tmp_path, store, capacity=4)
+        agent.quota_refresh_s = 0.0
+        u1 = store.create_run("p", name="x1", spec=sleep_spec(5),
+                              tenant="doomed")["uuid"]
+        u2 = store.create_run("p", name="x2", spec=sleep_spec(5),
+                              tenant="doomed")["uuid"]
+        store.delete_quota("doomed")
+        try:
+            agent.tick()
+            # the default row (1 chip) now governs: one runs, one parks
+            statuses = {u: store.get_run(u)["status"] for u in (u1, u2)}
+            assert sorted(statuses.values()) == ["queued", "scheduled"] \
+                or sorted(statuses.values()) == ["queued", "running"] \
+                or sorted(statuses.values()) == ["queued", "starting"]
+        finally:
+            agent.stop()
+
+
+class TestPreemption:
+    def test_high_preempts_newest_lower_class_and_both_recover(
+            self, tmp_path):
+        store = Store(":memory:")
+        agent = make_agent(tmp_path, store, capacity=2)
+        v1 = store.create_run("p", name="v1",
+                              spec=sleep_spec(8, "preemptible"))["uuid"]
+        time.sleep(0.01)  # distinct created_at for newest-first
+        v2 = store.create_run("p", name="v2",
+                              spec=sleep_spec(8, "preemptible"))["uuid"]
+        try:
+            agent.tick()
+            assert wait_for(lambda: all(
+                (store.get_run(v) or {}).get("status")
+                in ("starting", "running") for v in (v1, v2)))
+            hi = store.create_run("p", name="hi",
+                                  spec=sleep_spec(0.2, "high"))["uuid"]
+            agent.tick()
+            # exactly ONE victim, the NEWEST lower-class run
+            assert [v for v, _ in agent.preemptions] == [v2]
+            assert ("queued", "Preempted") in [
+                (c.get("type"), c.get("reason"))
+                for c in store.get_statuses(v2)]
+            # the preemptor took the freed chips in the SAME pass
+            assert store.get_run(hi)["status"] in (
+                "scheduled", "starting", "running")
+            # v1 (older) was untouched
+            assert store.get_run(v1)["status"] in ("starting", "running")
+            fams = parse_prometheus(store.metrics.render())
+            assert fams["polyaxon_preemptions_total"][
+                'polyaxon_preemptions_total{reason="priority"}'] == 1
+            # the victim re-queued WITHOUT burning retry budget
+            reasons = [c.get("type")
+                       for c in store.get_statuses(v2)]
+            assert "retrying" not in reasons
+            assert wait_for(lambda: (store.get_run(hi) or {})
+                            .get("status") == "succeeded", timeout=20)
+        finally:
+            agent.stop()
+
+    def test_normal_never_preempts_normal(self, tmp_path):
+        store = Store(":memory:")
+        agent = make_agent(tmp_path, store, capacity=1)
+        v = store.create_run("p", name="v", spec=sleep_spec(3))["uuid"]
+        try:
+            agent.tick()
+            assert wait_for(lambda: (store.get_run(v) or {})
+                            .get("status") in ("starting", "running"))
+            w = store.create_run("p", name="w", spec=sleep_spec(1))["uuid"]
+            agent.tick()
+            assert agent.preemptions == []
+            assert store.get_run(w)["status"] == "queued"
+        finally:
+            agent.stop()
+
+    def test_preemption_respects_the_preemptor_quota(self, tmp_path):
+        """A candidate parked by its own quota must not kill victims —
+        the chips it would free cannot be used."""
+        store = Store(":memory:")
+        store.set_quota("big", 4)
+        store.set_quota("small", 0)
+        agent = make_agent(tmp_path, store, capacity=1)
+        agent.quota_refresh_s = 0.0
+        v = store.create_run("p", name="v",
+                             spec=sleep_spec(2, "preemptible"),
+                             tenant="big")["uuid"]
+        try:
+            agent.tick()
+            assert wait_for(lambda: (store.get_run(v) or {})
+                            .get("status") in ("starting", "running"))
+            store.create_run("p", name="hi", spec=sleep_spec(1, "high"),
+                             tenant="small")
+            agent.tick()
+            assert agent.preemptions == []
+            assert store.get_run(v)["status"] in ("starting", "running")
+        finally:
+            agent.stop()
+
+
+# -- API / client / CLI surface ----------------------------------------------
+
+
+class TestQuotaSurface:
+    @pytest.fixture()
+    def srv(self):
+        srv = ApiServer(port=0).start()
+        yield srv
+        srv.stop()
+
+    def test_quota_crud_and_clients(self, srv):
+        qc = QuotaClient(srv.url)
+        assert qc.set("teamA", 8) == {"tenant": "teamA", "chips": 8}
+        assert qc.get("teamA")["chips"] == 8
+        assert [q["tenant"] for q in qc.list()] == ["teamA"]
+        assert "in_use" in qc.list()[0]
+        rc = RunClient(srv.url, project="p")
+        assert rc.quotas()[0]["tenant"] == "teamA"
+        rc.set_quota("teamB", 2)
+        assert rc.get_quota("teamB")["chips"] == 2
+        assert qc.delete("teamB")["deleted"] is True
+        assert requests.get(srv.url + "/api/v1/quotas/teamB",
+                            timeout=10).status_code == 404
+        assert requests.put(srv.url + "/api/v1/quotas/bad",
+                            json={"chips": -3},
+                            timeout=10).status_code == 400
+
+    def test_scoped_token_gets_403_and_cannot_spoof_tenant(self, srv):
+        tok = srv.store.create_token(project="p", label="team")
+        hdrs = {"Authorization": f"Bearer {tok['token']}"}
+        # quota admin is admin-shaped: scoped tokens are forbidden
+        assert requests.get(srv.url + "/api/v1/quotas", headers=hdrs,
+                            timeout=10).status_code == 403
+        # a scoped token cannot bill another tenant: the body tenant is
+        # ignored and the token identity derives the tenant
+        r = requests.post(srv.url + "/api/v1/p/runs",
+                          json={"spec": {}, "tenant": "someone-else"},
+                          headers=hdrs, timeout=10)
+        assert r.status_code == 201
+        assert r.json()["tenant"] == "team"
+
+    def test_cli_quota_and_ops_ls_columns(self, srv):
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli
+
+        r = CliRunner().invoke(
+            cli, ["quota", "set", "teamA", "8", "--host", srv.url])
+        assert r.exit_code == 0, r.output
+        r = CliRunner().invoke(cli, ["quota", "ls", "--host", srv.url])
+        assert r.exit_code == 0, r.output
+        assert "teamA" in r.output and "8" in r.output
+        srv.store.create_run("p", name="job1",
+                             spec=sleep_spec(0, "high"), tenant="teamA")
+        r = CliRunner().invoke(cli, [
+            "ops", "ls", "--host", srv.url, "--project", "p"])
+        assert r.exit_code == 0, r.output
+        assert "teamA" in r.output and "high" in r.output
+        r = CliRunner().invoke(
+            cli, ["quota", "rm", "teamA", "--host", srv.url])
+        assert r.exit_code == 0, r.output
+
+
+# -- fairness soak (slow) -----------------------------------------------------
+
+
+@pytest.mark.slow
+class TestTenantFairnessSoak:
+    def test_saturated_burst_converges_quota_proportional(self):
+        """Scaled-down twin of `chaos_soak.py --tenants` phase 1: 3
+        tenants, 2:1:1 quotas, saturated burst — mean steady-window
+        shares must be quota-proportional (Jain >= 0.95) and every run
+        must complete."""
+        from sched_bench import run_tenants
+
+        out = run_tenants(n_per_tenant=8, job_seconds=0.5,
+                          poll_interval=0.05, ab=True)
+        assert out["completed"] == out["runs"], out
+        assert out["steady_samples"] >= 5, out
+        assert out["jain_fairness"] >= 0.95, out
+        ab = out["single_tenant_ab"]
+        assert ab["fifo_completed"] == ab["fair_share_completed"]
+        # single-tenant fair share must not regress FIFO throughput
+        assert ab["fair_share_runs_per_min"] \
+            >= 0.7 * ab["fifo_runs_per_min"], ab
