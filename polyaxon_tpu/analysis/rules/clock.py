@@ -40,8 +40,12 @@ from ..engine import Finding, Project, Rule, call_target, import_aliases
 #: agent's quota-refresh TTL are durations — a wall-clock bucket would
 #: mint (or confiscate) a burst of admission tokens on every NTP step
 #: (corpus pair: analysis_corpus/tenancy/r15_*).
+#: federation/ joined in ISSUE 16: cluster-health staleness and failover
+#: gating are TTL-lease durations — a wall-clock health check would
+#: declare a live cluster lost (and re-place its running work) on an NTP
+#: step backwards (corpus pair: analysis_corpus/federation/r16_*).
 SCOPE_PREFIXES = ("api/", "scheduler/", "operator/", "resilience/",
-                  "serve/", "tenancy/")
+                  "serve/", "tenancy/", "federation/")
 #: plus individual clock-sensitive modules outside those trees
 SCOPE_FILES = ("train/watchdog.py",)
 

@@ -35,7 +35,7 @@ from ..engine import Finding, Project, Rule, dotted_name
 WRITE_VERBS = frozenset({
     "create_run", "create_runs", "transition", "transition_many",
     "update_run", "merge_outputs", "record_launch_intent",
-    "mark_launched", "adopt_launch", "annotate_status",
+    "mark_launched", "adopt_launch", "annotate_status", "place_run",
 })
 
 #: root-relative path prefixes where the discipline applies — the

@@ -277,6 +277,10 @@ class ApiApp:
         r.add_get("/api/v1/quotas/{tenant}", self.get_quota)
         r.add_put("/api/v1/quotas/{tenant}", self.put_quota)
         r.add_delete("/api/v1/quotas/{tenant}", self.delete_quota)
+        r.add_get("/api/v1/clusters", self.list_clusters)
+        r.add_get("/api/v1/clusters/{name}", self.get_cluster)
+        r.add_put("/api/v1/clusters/{name}", self.put_cluster)
+        r.add_delete("/api/v1/clusters/{name}", self.delete_cluster)
         r.add_get("/api/v1/agent/lease", self.get_agent_lease)
         r.add_get("/api/v1/store", self.get_store_status)
         r.add_get("/api/v1/changelog", self.get_changelog)
@@ -399,6 +403,48 @@ class ApiApp:
         """Drop a tenant's quota row (in-flight runs fall back to the
         default quota loudly — docs/SCHEDULING.md)."""
         ok = self.store.delete_quota(request.match_info["tenant"])
+        return _json({"deleted": ok}, 200 if ok else 404)
+
+    async def list_clusters(self, request):
+        """The federated cluster registry with live health (ISSUE 16):
+        each row carries region/chip_type/registered capacity plus a
+        ``healthy`` flag computed from its cluster-health TTL lease.
+        Admin-only by scoping (no {project} in the route)."""
+        return _json(self.store.list_clusters())
+
+    async def get_cluster(self, request):
+        name = request.match_info["name"]
+        row = self.store.get_cluster(name)
+        if row is None:
+            return _not_found(f"cluster {name!r} is not registered")
+        return _json(row)
+
+    async def put_cluster(self, request):
+        """Register/update a cluster backend out-of-band (agents register
+        themselves at start; this is the operator path for pre-seeding a
+        registry or correcting capacity). Body: {"region", "chipType",
+        "capacity"} — all optional."""
+        name = request.match_info["name"]
+        body = await request.json()
+        try:
+            capacity = int(body.get("capacity", 0) or 0)
+            if capacity < 0:
+                raise ValueError
+        except (TypeError, ValueError):
+            return _json({"error": "'capacity' must be a non-negative "
+                                   "integer"}, status=400)
+        return _json(self.store.register_cluster(
+            name, region=body.get("region"),
+            chip_type=body.get("chipType", body.get("chip_type")),
+            capacity=capacity), 201)
+
+    async def delete_cluster(self, request):
+        """The DEATH CERTIFICATE (docs/RESILIENCE.md "Cluster crash
+        matrix"): the operator's assertion that this cluster — and every
+        pod on it — is permanently gone. Survivor agents then re-place
+        its remaining runs WITHOUT proving the pod set is dead first, so
+        only issue it when the hardware truly is."""
+        ok = self.store.delete_cluster(request.match_info["name"])
         return _json({"deleted": ok}, 200 if ok else 404)
 
     async def get_timeline(self, request):

@@ -15,6 +15,7 @@ import uuid as uuid_mod
 import zlib
 from typing import Any, Optional
 
+from ..federation.health import CLUSTER_HEALTH_PREFIX
 from ..resilience.heartbeat import age_seconds
 from ..schemas.statuses import DONE_STATUSES, V1StatusCondition, V1Statuses, can_transition, is_done
 
@@ -126,6 +127,19 @@ CREATE TABLE IF NOT EXISTS launch_intents (
 CREATE TABLE IF NOT EXISTS quotas (
     tenant TEXT PRIMARY KEY,
     chips INTEGER NOT NULL,
+    created_at TEXT NOT NULL,
+    updated_at TEXT NOT NULL
+);
+-- cluster registry (ISSUE 16): one row per named cluster backend an agent
+-- owns. Capacity/region/chip_type feed placement + spillover decisions;
+-- liveness is NOT a column — it is the ``cluster-health-<name>`` TTL
+-- lease in agent_leases, renewed by the owning agent, so "healthy" can
+-- never go stale in a crashed writer's row. Replicated like quotas.
+CREATE TABLE IF NOT EXISTS clusters (
+    name TEXT PRIMARY KEY,
+    region TEXT,
+    chip_type TEXT,
+    capacity INTEGER NOT NULL DEFAULT 0,
     created_at TEXT NOT NULL,
     updated_at TEXT NOT NULL
 );
@@ -543,6 +557,27 @@ class Store:
         for row_ in self.list_quotas():
             self._quota_cache[row_["tenant"]] = int(row_["chips"])
             self._register_quota_gauge(row_["tenant"])
+        # federation (ISSUE 16): in-memory cluster-registry view backing the
+        # polyaxon_cluster_{healthy,chips}{cluster} gauges — refreshed by
+        # every cluster verb and by get_cluster_map() (the agents' poll), so
+        # a scrape never pays a table walk per series. Like the quota
+        # gauges, the families register from birth (a 'local' placeholder
+        # series on a store with no registry): EXPECTED_FAMILIES contracts
+        # them on an empty, non-federated store too.
+        self._cluster_cache: dict[str, dict] = {}
+        self._cluster_health: dict[str, bool] = {}
+        self._cluster_lock = threading.Lock()
+        self.metrics.counter(
+            "polyaxon_cluster_spillovers_total",
+            "Runs re-placed onto another cluster for capacity (spillover)")
+        self.metrics.counter(
+            "polyaxon_cluster_failovers_total",
+            "Runs re-placed off a lost cluster onto survivors")
+        self._register_cluster_gauges("local")
+        for row_ in self.list_clusters():
+            self._cluster_cache[row_["name"]] = row_
+            self._cluster_health[row_["name"]] = bool(row_["healthy"])
+            self._register_cluster_gauges(row_["name"])
 
     # -- tenant quotas (ISSUE 15) ------------------------------------------
 
@@ -627,6 +662,196 @@ class Store:
         for t in fresh:
             self._register_quota_gauge(t)
         return fresh
+
+    # -- cluster registry (ISSUE 16) ---------------------------------------
+
+    _CLUSTER_COLS = ("name", "region", "chip_type", "capacity",
+                     "created_at", "updated_at")
+
+    def _register_cluster_gauges(self, name: str) -> None:
+        self.metrics.gauge(
+            "polyaxon_cluster_healthy",
+            "1 while the cluster's health lease is live "
+            "(1 for the 'local' placeholder on a non-federated store)",
+            labels={"cluster": name},
+            value_fn=lambda n=name: (
+                1.0 if self._cluster_health.get(n, True) else 0.0))
+        self.metrics.gauge(
+            "polyaxon_cluster_chips",
+            "Registered chip capacity of the cluster (0 = unregistered)",
+            labels={"cluster": name},
+            value_fn=lambda n=name: float(
+                (self._cluster_cache.get(n) or {}).get("capacity", 0)))
+
+    def register_cluster(self, name: str, region: Optional[str] = None,
+                         chip_type: Optional[str] = None, capacity: int = 0,
+                         fence=None) -> dict:
+        """Upsert one named cluster backend (``PUT /api/v1/clusters/{name}``
+        and every federated agent's start()). Replicated like quotas — a
+        promoted standby serves the same registry. Health is NOT written
+        here: it is the cluster-health-<name> lease, so a dead writer's
+        row can never claim liveness."""
+        capacity = int(capacity)
+        if capacity < 0:
+            raise ValueError(f"cluster capacity must be >= 0, got {capacity}")
+        self._check_writable()
+        with self._conn_ctx() as conn:
+            self._check_fence(conn, fence)
+            now = _now()
+            conn.execute(
+                "INSERT INTO clusters (name, region, chip_type, capacity, "
+                "created_at, updated_at) VALUES (?,?,?,?,?,?) "
+                "ON CONFLICT(name) DO UPDATE SET region=excluded.region, "
+                "chip_type=excluded.chip_type, capacity=excluded.capacity, "
+                "updated_at=excluded.updated_at",
+                (name, region, chip_type, capacity, now, now))
+            self._log_change(conn, "cluster", {
+                "name": name, "region": region, "chip_type": chip_type,
+                "capacity": capacity, "created_at": now, "updated_at": now})
+        row = {"name": name, "region": region, "chip_type": chip_type,
+               "capacity": capacity}
+        healthy = self._cluster_healthy(name)
+        with self._cluster_lock:
+            self._cluster_cache[name] = row
+            self._cluster_health[name] = healthy
+        self._register_cluster_gauges(name)
+        return row
+
+    def _cluster_healthy(self, name: str,
+                         leases: Optional[dict] = None) -> bool:
+        if leases is None:
+            leases = {r["name"]: r for r in self.list_leases(
+                prefix=CLUSTER_HEALTH_PREFIX)}
+        row = leases.get(CLUSTER_HEALTH_PREFIX + name)
+        return row is not None and not row["expired"]
+
+    def get_cluster(self, name: str) -> Optional[dict]:
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                f"SELECT {','.join(self._CLUSTER_COLS)} FROM clusters "
+                "WHERE name=?", (name,)).fetchone()
+        if row is None:
+            return None
+        d = dict(zip(self._CLUSTER_COLS, row))
+        d["healthy"] = self._cluster_healthy(name)
+        with self._cluster_lock:
+            self._cluster_health[name] = d["healthy"]
+        return d
+
+    def list_clusters(self) -> list[dict]:
+        """Every registered cluster with its lease-derived ``healthy``
+        flag — the registry view placement, spillover, and the dashboard
+        read. Refreshes the gauge caches as a side effect (the agents'
+        poll keeps the scrape view current)."""
+        with self._conn_ctx() as conn:
+            rows = conn.execute(
+                f"SELECT {','.join(self._CLUSTER_COLS)} FROM clusters "
+                "ORDER BY name").fetchall()
+        leases = {r["name"]: r for r in self.list_leases(
+            prefix=CLUSTER_HEALTH_PREFIX)}
+        out = []
+        for r in rows:
+            d = dict(zip(self._CLUSTER_COLS, r))
+            d["healthy"] = self._cluster_healthy(d["name"], leases)
+            out.append(d)
+        with self._cluster_lock:
+            for d in out:
+                self._cluster_cache[d["name"]] = d
+                self._cluster_health[d["name"]] = d["healthy"]
+        for d in out:
+            self._register_cluster_gauges(d["name"])
+        return out
+
+    def delete_cluster(self, name: str, fence=None) -> bool:
+        """Drop a cluster's registry row — the operator's explicit death
+        certificate (``polyaxon clusters forget``). Runs still placed on
+        the deleted cluster are re-placed UNCONDITIONALLY by the next
+        federation pass: deleting asserts the pods are gone, which is why
+        it is an operator verb and never automatic (see the split-brain
+        note in docs/RESILIENCE.md)."""
+        self._check_writable()
+        with self._conn_ctx() as conn:
+            self._check_fence(conn, fence)
+            cur = conn.execute("DELETE FROM clusters WHERE name=?", (name,))
+            if cur.rowcount > 0:
+                self._log_change(conn, "cluster_delete", {"name": name})
+        with self._cluster_lock:
+            self._cluster_cache.pop(name, None)
+            self._cluster_health.pop(name, None)
+        return cur.rowcount > 0
+
+    def get_cluster_map(self) -> dict[str, dict]:
+        """{name: registry row + healthy} — the agents' poll (spill and
+        placement decisions); the gauges ride along for free."""
+        return {d["name"]: d for d in self.list_clusters()}
+
+    def cluster_load(self) -> dict[str, int]:
+        """{cluster: live non-terminal runs placed on it} — SQL-side, one
+        GROUP BY. The spill walk's headroom estimate (floor one chip per
+        run): a sibling whose live placed runs already cover its
+        registered capacity is saturated, and spilling there would only
+        relocate the queue."""
+        with self._conn_ctx() as conn:
+            rows = conn.execute(
+                "SELECT json_extract(meta, '$.cluster') AS c, COUNT(*) "
+                "FROM runs WHERE status NOT IN "
+                "('succeeded', 'failed', 'stopped', 'skipped') "
+                "AND json_extract(meta, '$.cluster') IS NOT NULL "
+                "GROUP BY c",
+            ).fetchall()
+        return {row[0]: int(row[1]) for row in rows}
+
+    _PLACE_UNSET = object()
+
+    def place_run(self, uuid: str, cluster: Optional[str],
+                  expect: Any = _PLACE_UNSET, fence=None) -> bool:
+        """CAS on a run's CURRENT placement (``meta.cluster``): atomically
+        move it to ``cluster`` (None un-places it) iff its placement still
+        equals ``expect``. This single verb is what makes federation
+        duplicate-free: N agents may all try to claim an unplaced run
+        (``expect=None``) or spill/fail-over a placed one — exactly one
+        CAS wins, the rest observe False and drop it. Fires the change
+        feed at the run's current status so the WINNING cluster's agent
+        wakes immediately instead of waiting out its resync interval.
+        Spill/failover hops append the previous placement to
+        ``meta.placement_history`` (the anti-ping-pong record)."""
+        self._check_writable()
+        status = None
+        with self._transition_lock:
+            with self._conn_ctx() as conn:
+                self._check_fence(conn, fence)
+                if not conn.in_transaction:
+                    conn.execute("BEGIN IMMEDIATE")
+                row = conn.execute(
+                    "SELECT meta, status FROM runs WHERE uuid=?",
+                    (uuid,)).fetchone()
+                if row is None:
+                    return False
+                meta = json.loads(row[0]) if row[0] else {}
+                current = meta.get("cluster")
+                if expect is not self._PLACE_UNSET and current != expect:
+                    return False
+                if current == cluster:
+                    return True  # idempotent re-place: no write, no wake
+                if current is not None:
+                    hist = list(meta.get("placement_history") or [])
+                    hist.append(current)
+                    from ..federation.placement import MAX_PLACEMENT_HISTORY
+
+                    meta["placement_history"] = hist[-MAX_PLACEMENT_HISTORY:]
+                if cluster is None:
+                    meta.pop("cluster", None)
+                else:
+                    meta["cluster"] = cluster
+                seq = self._bump_seq(conn)
+                conn.execute(
+                    "UPDATE runs SET meta=?, updated_at=?, change_seq=? "
+                    "WHERE uuid=?",
+                    (json.dumps(meta), _now(), seq, uuid))
+                self._log_run_row(conn, uuid, seq=seq)
+                status = row[1]
+        self._notify_listeners([(uuid, status)])
+        return True
 
     # -- connection plumbing ----------------------------------------------
 
@@ -1428,6 +1653,22 @@ class Store:
             conn.execute("DELETE FROM quotas WHERE tenant=?", (p["tenant"],))
             with self._quota_lock:
                 self._quota_cache.pop(p["tenant"], None)
+        elif op == "cluster":
+            conn.execute(
+                "INSERT OR REPLACE INTO clusters (name, region, chip_type, "
+                "capacity, created_at, updated_at) VALUES (?,?,?,?,?,?)",
+                (p["name"], p.get("region"), p.get("chip_type"),
+                 int(p.get("capacity") or 0), p["created_at"],
+                 p["updated_at"]))
+            with self._cluster_lock:
+                self._cluster_cache[p["name"]] = {
+                    c: p.get(c) for c in self._CLUSTER_COLS}
+            self._register_cluster_gauges(p["name"])
+        elif op == "cluster_delete":
+            conn.execute("DELETE FROM clusters WHERE name=?", (p["name"],))
+            with self._cluster_lock:
+                self._cluster_cache.pop(p["name"], None)
+                self._cluster_health.pop(p["name"], None)
         elif op == "promote":
             pass  # epoch adoption handled by the apply loop's max_epoch
         # unknown ops are skipped: a newer primary may log kinds an older
@@ -2392,7 +2633,8 @@ class FencedStore:
 
     _FENCED = ("create_run", "create_runs", "transition", "transition_many",
                "update_run", "merge_outputs", "record_launch_intent",
-               "mark_launched", "adopt_launch", "annotate_status")
+               "mark_launched", "adopt_launch", "annotate_status",
+               "place_run")
 
     def __init__(self, inner, fence_source, on_stale=None):
         import inspect

@@ -113,6 +113,7 @@ UI_HTML = """<!DOCTYPE html>
 </header>
 <main>
   <section id="runs"><h2>Runs</h2>
+    <div id="clusters" class="muted" style="margin-bottom:6px"></div>
     <div id="quotas" class="muted" style="margin-bottom:6px"></div>
     <div id="cmpBar" class="muted">check ≥2 runs to compare
       <button class="small" id="cmpBtn" style="display:none">compare</button></div>
@@ -214,12 +215,19 @@ function addRunRow(tb, r, depth, kids) {
   const overQ = (r.meta && r.meta.over_quota)
     ? ` <span title="parked: tenant over its chip quota"` +
       ` style="cursor:help">&#9203;</span>` : "";
+  // federation (ISSUE 16): which cluster hosts the run, with its hop
+  // history (spillovers/failovers) in the hover
+  const placed = (r.meta && r.meta.cluster)
+    ? ` <span class="muted" title="placed on ${esc(r.meta.cluster)}` +
+      `${(r.meta.placement_history || []).length
+         ? " via " + r.meta.placement_history.map(esc).join(" → ") : ""}"` +
+      ` style="cursor:help">@${esc(r.meta.cluster)}</span>` : "";
   tr.innerHTML =
     `<td><input type="checkbox" data-u="${r.uuid}"` +
     `${checked.has(r.uuid) ? " checked" : ""}/></td>` +
     `<td ${pad}>${twist}${esc(r.name || "")}${kidNote}</td>` +
     `<td>${esc(r.kind || "")}</td>` +
-    `<td>${stBadge(r.status)}${stale}${overQ}</td>` +
+    `<td>${stBadge(r.status)}${stale}${overQ}${placed}</td>` +
     `<td>${prioCell}</td>` +
     `<td class="muted">${esc(r.tenant || "")}</td>` +
     `<td class="muted">${progress}</td>` +
@@ -1003,8 +1011,30 @@ async function loadQuotas() {
     }).join("");
   } catch (e) { el.innerHTML = ""; }
 }
+// federation panel (ISSUE 16): registered clusters with live health —
+// a LOST cluster (lapsed health lease) shows loudly while its runs
+// re-place onto survivors. Hidden on single-cluster deployments.
+async function loadClusters() {
+  const el = $("#clusters");
+  try {
+    const cs = await j("/api/v1/clusters");
+    if (!cs.length) { el.innerHTML = ""; return; }
+    el.innerHTML = `<b>Clusters</b> ` + cs.map(c => {
+      const mark = c.healthy
+        ? `<span style="color:#30a46c">●</span>`
+        : `<span style="color:#cd2b31" title="health lease lapsed: ` +
+          `runs re-placing onto survivors">● LOST</span>`;
+      return `<span class="quota"><span class="qname">${esc(c.name)}` +
+        `</span> ${mark} ${esc(c.chip_type || "?")}` +
+        `×${c.capacity || 0}` +
+        (c.region ? ` <span class="muted">${esc(c.region)}</span>` : "") +
+        `</span>`;
+    }).join("");
+  } catch (e) { el.innerHTML = ""; }
+}
 async function refresh() {
   try { await loadProjects(); await loadRuns(); await loadQuotas();
+        await loadClusters();
         if (selected || compare) await render(); }
   catch (e) { $("#count").textContent = String(e); }
   // the stream subscribes per-project; a project picked/switched after

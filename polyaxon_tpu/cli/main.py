@@ -858,6 +858,79 @@ def quota_rm(tenant, host):
 
 
 @cli.group()
+def cluster():
+    """Federated cluster registry (admin; docs/SCHEDULING.md)."""
+
+
+def _cluster_backend(host):
+    """ClusterClient when a host is configured, else the local store —
+    same hostless bootstrap idiom as quota administration."""
+    h = get_host(host)
+    if h:
+        from ..client import ClusterClient
+
+        return ClusterClient(h, auth_token=get_token(h))
+    from ..api.store import Store
+
+    return Store(os.path.join(".plx", "db.sqlite"))
+
+
+@cluster.command("ls")
+@click.option("--host", default=None)
+def cluster_ls(host):
+    """List registered clusters with live health."""
+    be = _cluster_backend(host)
+    rows = be.list() if hasattr(be, "_req") else be.list_clusters()
+    if not rows:
+        click.echo("no clusters registered (single-cluster deployment)")
+        return
+    click.echo(f"{'cluster':<20} {'region':<12} {'chips':<10} "
+               f"{'capacity':>8} {'health':>8}")
+    for r in rows:
+        click.echo(f"{r['name']:<20} {r.get('region') or '-':<12} "
+                   f"{r.get('chip_type') or '-':<10} "
+                   f"{r.get('capacity') or 0:>8} "
+                   f"{'up' if r.get('healthy') else 'LOST':>8}")
+
+
+@cluster.command("register")
+@click.argument("name")
+@click.option("--region", default=None)
+@click.option("--chip-type", default=None,
+              help="TPU family (or full slice type) this cluster carries")
+@click.option("--capacity", type=int, default=0,
+              help="Registered chip capacity (spillover sizing input)")
+@click.option("--host", default=None)
+def cluster_register(name, region, chip_type, capacity, host):
+    """Register/update NAME in the cluster registry (agents of the
+    cluster do this themselves at start; this is the operator path)."""
+    be = _cluster_backend(host)
+    out = (be.register(name, region=region, chip_type=chip_type,
+                       capacity=capacity)
+           if hasattr(be, "_req")
+           else be.register_cluster(name, region=region,
+                                    chip_type=chip_type, capacity=capacity))
+    click.echo(json.dumps(out, indent=2))
+
+
+@cluster.command("rm")
+@click.argument("name")
+@click.option("--yes", is_flag=True, help="skip the confirmation prompt")
+@click.option("--host", default=None)
+def cluster_rm(name, yes, host):
+    """Issue NAME's death certificate: survivors re-place its remaining
+    runs WITHOUT proving its pods are gone first. Only for a cluster
+    that is permanently lost."""
+    if not yes:
+        click.confirm(
+            f"Declare cluster {name!r} permanently dead and re-place its "
+            f"runs?", abort=True)
+    be = _cluster_backend(host)
+    be.delete(name) if hasattr(be, "_req") else be.delete_cluster(name)
+    click.echo("deleted")
+
+
+@cli.group()
 def token():
     """Mint / list / revoke API access tokens (admin)."""
 

@@ -3,7 +3,9 @@ serve front — the request-path failover client for `kind: service`
 replica fleets (ISSUE 12)."""
 
 from .client import (
-    AgentClient, ApiError, BaseClient, ProjectClient, QuotaClient, RunClient,
-    TokenClient,
+    AgentClient, ApiError, BaseClient, ClusterClient, ProjectClient,
+    QuotaClient, RunClient, TokenClient,
 )
-from .serve import ServeFront, ServeUnavailableError  # noqa: F401
+from .serve import (  # noqa: F401
+    ServeFront, ServeUnavailableError, federated_endpoints,
+)

@@ -227,6 +227,30 @@ class QuotaClient(BaseClient):
         return self._json("DELETE", f"/api/v1/quotas/{tenant}")
 
 
+class ClusterClient(BaseClient):
+    """Federated cluster-registry administration (ISSUE 16,
+    docs/SCHEDULING.md "Placement and spillover")."""
+
+    def list(self) -> list[dict]:
+        """Every registered cluster with its live ``healthy`` flag."""
+        return self._json("GET", "/api/v1/clusters")
+
+    def get(self, name: str) -> dict:
+        return self._json("GET", f"/api/v1/clusters/{name}")
+
+    def register(self, name: str, region: Optional[str] = None,
+                 chip_type: Optional[str] = None, capacity: int = 0) -> dict:
+        return self._json("PUT", f"/api/v1/clusters/{name}",
+                          json={"region": region, "chipType": chip_type,
+                                "capacity": int(capacity)})
+
+    def delete(self, name: str) -> dict:
+        """The death certificate: survivors re-place this cluster's runs
+        without waiting to prove its pods are gone. Irreversible intent —
+        only for hardware that is truly not coming back."""
+        return self._json("DELETE", f"/api/v1/clusters/{name}")
+
+
 class TokenClient(BaseClient):
     """Token administration (RBAC-lite): mint/list/revoke access tokens."""
 

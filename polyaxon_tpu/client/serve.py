@@ -281,3 +281,38 @@ class ServeFront:
                 return None
             time.sleep(0.1)
         return None
+
+
+def federated_endpoints(store, project: str,
+                        uuids: Optional[list] = None,
+                        name: Optional[str] = None) -> Callable[[], list]:
+    """An ``endpoints_fn`` that discovers a service's replicas ACROSS
+    clusters (ISSUE 16): every live service run of ``project`` — all of
+    them, or just ``uuids``/``name``-matched ones — contributes the
+    agent-stamped ``meta.service`` endpoint of whichever cluster hosts
+    it. Pin one service run per cluster (``placement.cluster``) and a
+    ServeFront over this callable keeps answering through the loss of an
+    entire cluster: the lost cluster's endpoint goes connect-dead (the
+    front rotates off it within one attempt), and the run itself is
+    either already re-placed by failover or still serving from its pin's
+    surviving siblings. Re-polled per request batch, so endpoints follow
+    placement with no client restart."""
+    def _endpoints() -> list:
+        eps = []
+        try:
+            runs = store.list_runs(project=project)
+        except Exception:
+            return eps
+        for run in runs:
+            if uuids is not None and run["uuid"] not in uuids:
+                continue
+            if name is not None and run.get("name") != name:
+                continue
+            if run["status"] not in ("scheduled", "starting", "running"):
+                continue
+            svc = (run.get("meta") or {}).get("service")
+            if not svc:
+                continue
+            eps.append(f"http://{svc['host']}:{svc['port']}")
+        return eps
+    return _endpoints

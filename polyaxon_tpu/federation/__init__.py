@@ -1,0 +1,59 @@
+"""Cross-cluster federation (ISSUE 16): named cluster backends, placement
+constraints, spillover scheduling, and cluster-loss failover.
+
+Upstream Polyaxon's deployment story is one agent per remote cluster
+reporting to a single API; this package gives the repo the same shape. Each
+agent registers a named cluster backend with ``{region, chip_type,
+capacity}`` in a store-backed registry (replicated like quotas) and keeps a
+heartbeated health lease on it. Runs declare placement constraints
+(``placement.cluster`` hard pin, ``placement.chipType`` family match) that
+are validated at COMPILE time against the registry; the fair-share walk
+spans clusters with per-cluster budgets, and capacity-starved or over-quota
+runs SPILL to the next eligible cluster instead of parking. Multislice jobs
+never spill — PR 13's DCN assumptions are intra-cluster.
+
+The robustness core is cluster-loss failover: a cluster whose health lease
+lapses is declared lost by a surviving cluster's agent, which fences the
+lost cluster's writes out (bumping its shard-lease tokens), classifies its
+victims' pods under the PR-4 "listing failure is unknown, not no-pods"
+rule, and re-places them onto survivors through the existing launch-intent
+path — zero duplicate launches, no retry budget burned, resumed from the
+newest complete checkpoint. docs/RESILIENCE.md's "Cluster crash matrix" is
+the operator contract.
+
+Everything here is pure policy: no store or scheduler imports, so the
+api/ and scheduler/ layers can both depend on it without cycles (the same
+layering rule as the tenancy package).
+"""
+
+from .health import (  # noqa: F401
+    CLUSTER_FAILOVER_PREFIX,
+    CLUSTER_HEALTH_PREFIX,
+    cluster_of_health_lease,
+    failover_lease_name,
+    health_lease_name,
+)
+from .placement import (  # noqa: F401
+    chip_family,
+    is_multislice,
+    nearest_cluster_hint,
+    parse_placement,
+    placement_allows,
+    spill_candidates,
+    validate_placement,
+)
+
+__all__ = [
+    "CLUSTER_FAILOVER_PREFIX",
+    "CLUSTER_HEALTH_PREFIX",
+    "chip_family",
+    "cluster_of_health_lease",
+    "failover_lease_name",
+    "health_lease_name",
+    "is_multislice",
+    "nearest_cluster_hint",
+    "parse_placement",
+    "placement_allows",
+    "spill_candidates",
+    "validate_placement",
+]

@@ -1,0 +1,42 @@
+"""Cluster health + failover lease naming (ISSUE 16).
+
+A cluster's liveness is a single TTL lease in the EXISTING agent_leases
+table — ``cluster-health-<name>`` — renewed by that cluster's agent on the
+same ttl/3 beat as its shard leases. Liveness therefore means "an agent of
+this cluster can reach the store and its loop is passing", which is exactly
+the property federation cares about: a cluster whose agents cannot reach
+the store cannot be scheduled onto and cannot safely keep its runs.
+
+``cluster-failover-<name>`` is the single-driver gate for re-placing a lost
+cluster's runs: exactly one survivor holds it while it fences the victim
+cluster out and walks its runs, so N survivors never race each other's
+re-placements (the CAS on run placement would catch that too — the lease
+just keeps the work from being done N times).
+
+Lease *expiry* is computed by the store against the persisted renewed_at
+wall timestamp (the one justified wall-clock read, see Store._lease_age);
+nothing in this module reads a clock.
+"""
+
+from typing import Optional
+
+CLUSTER_HEALTH_PREFIX = "cluster-health-"
+CLUSTER_FAILOVER_PREFIX = "cluster-failover-"
+
+
+def health_lease_name(cluster: str) -> str:
+    """The health lease of a named cluster backend."""
+    return f"{CLUSTER_HEALTH_PREFIX}{cluster}"
+
+
+def failover_lease_name(cluster: str) -> str:
+    """The single-driver lease a survivor holds while re-placing the
+    named (lost) cluster's runs."""
+    return f"{CLUSTER_FAILOVER_PREFIX}{cluster}"
+
+
+def cluster_of_health_lease(lease_name: str) -> Optional[str]:
+    """Inverse of :func:`health_lease_name`; None for non-health rows."""
+    if not lease_name.startswith(CLUSTER_HEALTH_PREFIX):
+        return None
+    return lease_name[len(CLUSTER_HEALTH_PREFIX):]

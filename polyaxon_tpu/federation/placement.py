@@ -1,0 +1,156 @@
+"""Placement constraints + spillover ordering (ISSUE 16, pure policy).
+
+A run's polyaxonfile may carry::
+
+    placement:
+      cluster: us-east     # HARD pin: run here or nowhere (never spills)
+      chipType: v5e        # chip-family constraint: any cluster of this family
+
+Both are validated at COMPILE time against the store-backed cluster
+registry (``validate_placement``) so a typo'd cluster name or a family
+nobody registered fails with a nearest-cluster hint instead of parking a
+run forever. At scheduling time ``placement_allows`` filters clusters;
+``spill_candidates`` orders the eligible survivors for a capacity-starved
+run, excluding clusters the run already visited (anti-ping-pong).
+
+Multislice jobs (``num_slices > 1``) NEVER spill: PR 13's DCN/megascale
+assumptions — slice-to-slice traffic over the datacenter network — are
+intra-cluster, so ``is_multislice`` is the walk's spill veto.
+"""
+
+import difflib
+from typing import Iterable, Optional
+
+from ..schemas.tpu import ACCELERATOR_SPECS
+
+#: meta.placement_history cap: spill/failover hops a run remembers (the
+#: anti-ping-pong window — after this many hops the oldest is forgotten
+#: and the run may revisit it, which beats parking forever)
+MAX_PLACEMENT_HISTORY = 8
+
+
+def chip_family(chip_type: Optional[str]) -> Optional[str]:
+    """'v5e-256'/'v5e' -> 'v5e' (a registry row may carry either shape)."""
+    if not chip_type:
+        return None
+    return str(chip_type).partition("-")[0]
+
+
+def parse_placement(spec: Optional[dict]) -> dict:
+    """``{cluster, chip_type}`` (both Optional[str]) from an operation or
+    compiled-operation dict; tolerant of both camelCase and snake_case
+    (the schema serializes by alias)."""
+    p = (spec or {}).get("placement") or {}
+    return {
+        "cluster": p.get("cluster"),
+        "chip_type": p.get("chipType", p.get("chip_type")),
+    }
+
+
+def nearest_cluster_hint(name: str, known: Iterable[str]) -> str:
+    """The ``did you mean`` tail of a compile-time placement error."""
+    known = sorted(known)
+    if not known:
+        return "no clusters are registered"
+    close = difflib.get_close_matches(name, known, n=1, cutoff=0.4)
+    if close:
+        return f"did you mean {close[0]!r}? registered: {known}"
+    return f"registered clusters: {known}"
+
+
+def validate_placement(placement: dict, clusters: list[dict]) -> None:
+    """Compile-time check of one run's placement against the registry.
+    Raises ValueError (-> CompilationError on the run) when the pin names
+    an unknown cluster, the chip family is not a known TPU generation, no
+    registered cluster carries that family, or the pinned cluster's family
+    contradicts the constraint."""
+    want_cluster = placement.get("cluster")
+    want_family = chip_family(placement.get("chip_type"))
+    by_name = {c["name"]: c for c in clusters}
+    if want_family is not None and want_family not in ACCELERATOR_SPECS:
+        raise ValueError(
+            f"placement.chipType {placement.get('chip_type')!r} is not a "
+            f"known TPU generation (known: {sorted(ACCELERATOR_SPECS)})")
+    if want_cluster is not None and want_cluster not in by_name:
+        raise ValueError(
+            f"placement.cluster {want_cluster!r} is not a registered "
+            f"cluster — {nearest_cluster_hint(want_cluster, by_name)}")
+    if want_family is not None:
+        if want_cluster is not None:
+            have = chip_family(by_name[want_cluster].get("chip_type"))
+            if have is not None and have != want_family:
+                raise ValueError(
+                    f"placement.cluster {want_cluster!r} is a {have} "
+                    f"cluster but placement.chipType wants {want_family}")
+        elif clusters and not any(
+                chip_family(c.get("chip_type")) == want_family
+                for c in clusters):
+            families = sorted({chip_family(c.get("chip_type")) or "?"
+                               for c in clusters})
+            raise ValueError(
+                f"no registered cluster carries chip family "
+                f"{want_family!r} (available: {families})")
+
+
+def placement_allows(placement: dict, cluster: dict) -> bool:
+    """May this run land on this registry row? (Health/capacity are the
+    scheduler's concern — this is the pure constraint check.)"""
+    want_cluster = placement.get("cluster")
+    if want_cluster is not None and want_cluster != cluster.get("name"):
+        return False
+    want_family = chip_family(placement.get("chip_type"))
+    if want_family is not None:
+        have = chip_family(cluster.get("chip_type"))
+        if have is not None and have != want_family:
+            return False
+    return True
+
+
+def is_multislice(spec: Optional[dict]) -> bool:
+    """True for tpujob/jaxjob runs spanning >1 slice — the spill veto
+    (DCN stays intra-cluster, PR 13). Reads the raw spec/compiled dict;
+    accepts both the operation shape (run under component.run) and the
+    compiled shape (run at top level)."""
+    spec = spec or {}
+    r = (spec.get("component") or {}).get("run") or spec.get("run") or {}
+    if r.get("kind") not in ("tpujob", "jaxjob"):
+        return False
+    try:
+        return int(r.get("numSlices", r.get("num_slices", 1)) or 1) > 1
+    except (TypeError, ValueError):
+        return False
+
+
+def spill_candidates(home: str, demand: int, placement: dict,
+                     clusters: dict[str, dict],
+                     visited: Iterable[str] = (),
+                     load: Optional[dict] = None) -> list[str]:
+    """Eligible spill targets for a capacity-starved run placed on
+    ``home``, best-first: healthy registered clusters other than home (and
+    other than already-visited hops), matching the run's constraints, with
+    registered capacity >= demand. ``load`` ({cluster: live non-terminal
+    runs placed there}, floor-one-chip-each estimate) turns the walk
+    headroom-aware: a sibling may queue at most ONE wave ahead (live
+    placed runs < 2x its capacity) — past that it is SATURATED and
+    skipped, because spilling into a deep queue only relocates the
+    backlog, stranding the spiller's own chips once its head-of-line
+    work drains. Deterministic order — most free capacity
+    first (capacity - load when known), name as tie-break — so concurrent
+    walkers converge instead of scattering."""
+    visited = set(visited) | {home}
+    out = []
+    for name, row in clusters.items():
+        if name in visited:
+            continue
+        if not row.get("healthy", False):
+            continue
+        cap = int(row.get("capacity") or 0)
+        if cap < max(int(demand), 1):
+            continue
+        if not placement_allows(placement, row):
+            continue
+        used = int((load or {}).get(name, 0))
+        if load is not None and used >= 2 * cap:
+            continue
+        out.append((used - cap, name))
+    return [name for _, name in sorted(out)]

@@ -23,6 +23,10 @@ from ..api.store import (
     shard_lease_names,
 )
 from ..compiler.resolver import resolve
+from ..federation import (
+    failover_lease_name, health_lease_name, is_multislice, parse_placement,
+    placement_allows, spill_candidates, validate_placement,
+)
 from ..resilience.heartbeat import _max_retries
 from ..runtime.local import LocalExecution, LocalExecutor
 from ..schemas.statuses import V1Statuses, is_done
@@ -145,6 +149,10 @@ class LocalAgent:
         lease_name: str = "scheduler",
         num_shards: int = 1,
         stall_grace: Optional[float] = None,
+        cluster_name: Optional[str] = None,
+        region: Optional[str] = None,
+        chip_type: Optional[str] = None,
+        fed_clusters: Optional[dict] = None,
     ):
         import uuid as uuid_mod
 
@@ -169,8 +177,24 @@ class LocalAgent:
         self.lease_ttl = lease_ttl
         self.lease_name = lease_name
         self.num_shards = max(int(num_shards), 1)
-        self.shards: list[str] = (shard_lease_names(self.num_shards)
-                                  if self.num_shards > 1 else [lease_name])
+        # federation (ISSUE 16, docs/RESILIENCE.md "Cluster crash matrix"):
+        # a named agent owns a named cluster backend. Its shard/presence
+        # lease namespace is PREFIXED with the cluster name, so each
+        # cluster runs its own PR-6 sharded control plane — which runs a
+        # run is decided by placement (run meta.cluster, CAS'd through
+        # Store.place_run), not by the hash. cluster_name=None keeps every
+        # name and every code path byte-identical to the single-cluster
+        # deployment.
+        self.cluster_name = cluster_name
+        self.region = region
+        self.chip_type = chip_type
+        # {cluster name: Cluster handle} — peer backends this agent may
+        # observe/tear down during cluster-loss failover; without a
+        # handle a lost peer's pods are unobservable and its runs wait
+        # for the operator's death certificate (delete_cluster)
+        self.fed_clusters = dict(fed_clusters or {})
+        self._cluster_prefix = f"{cluster_name}." if cluster_name else ""
+        self.shards: list[str] = self._shard_names(self.num_shards)
         self._shard_set = set(self.shards)
         self._lease_id = uuid_mod.uuid4().hex
         self._shard_leases: dict[str, dict] = {}   # shard -> live lease row
@@ -188,9 +212,40 @@ class LocalAgent:
         # live-agent presence lease (self-named, nobody competes): lets
         # every agent count the live fleet and compute its fair share of
         # shards without a separate membership table
-        self._presence_name = AGENT_PREFIX + self._lease_id
+        # federated agents prefix presence too: each cluster's fair-share
+        # counts its OWN fleet (cluster A gaining an agent must not shrink
+        # cluster B's shard shares)
+        self._presence_prefix = AGENT_PREFIX + self._cluster_prefix
+        self._presence_name = self._presence_prefix + self._lease_id
         self._presence: Optional[dict] = None
         self._presence_renewed = float("-inf")
+        # -- federation runtime state (ISSUE 16) ---------------------------
+        # cluster-health-<name> lease row while held; renewed on the same
+        # ttl/3 beat as shards. Losing it (renew rejected: a survivor
+        # fenced us out during failover) demotes EVERY held shard — the
+        # fleet has declared this cluster lost, its writes must stop.
+        self._health_lease: Optional[dict] = None
+        self._health_renewed = float("-inf")
+        # (uuid, lost_cluster) pairs whose pod listing FAILED during
+        # cluster-loss classification: parked for retry, never counted as
+        # "no pods" (the PR-4 rule — a listing failure is unknown, not
+        # absence; satellite 1's double-launch is exactly that misread)
+        self._fed_retry: set = set()
+        self._fed_clusters_cache: dict = {}
+        self._fed_fetch_at = float("-inf")
+        self.fed_refresh_s = 2.0
+        # sibling-load snapshot for the spill walk's headroom throttle;
+        # bumped locally on every spill this agent wins, so one pass
+        # never over-fills a target between store refreshes
+        self._fed_load_cache: dict = {}
+        self._fed_load_at = float("-inf")
+        # runs already annotated ClusterLost-parked (hard pin/no handle):
+        # annotate once, not every federation pass
+        self._cluster_lost_marked: set = set()
+        #: audit trails for soaks/tests: (uuid, from_cluster, to_cluster)
+        self.spillovers: list[tuple] = []
+        #: (uuid, lost_cluster) re-placed off a lost cluster by THIS agent
+        self.failovers: list[tuple] = []
         self._probe_at = 0.0  # next shard acquisition/rebalance probe
         self._dead_presence: list = []  # expired agent-* rows, GC'd by probe
         self._last_pass_at = time.monotonic()  # loop liveness stamp
@@ -406,6 +461,15 @@ class LocalAgent:
             "row and fell back to the default quota")
         self._tenant_gauges: set = set()
         self._bind_tenant_gauge(DEFAULT_TENANT)
+        # federation counters: same names + help as the store's from-birth
+        # registrations (get-or-create returns those instances, so agent
+        # increments and store scrapes are one series)
+        self._c_spillovers = self.metrics.counter(
+            "polyaxon_cluster_spillovers_total",
+            "Runs re-placed onto another cluster for capacity (spillover)")
+        self._c_failovers = self.metrics.counter(
+            "polyaxon_cluster_failovers_total",
+            "Runs re-placed off a lost cluster onto survivors")
         self.sidecar_interval = 1.0
         self._stop = threading.Event()
         self._wake = threading.Event()  # set by the watch thread
@@ -485,6 +549,12 @@ class LocalAgent:
     @property
     def _pending_fresh(self) -> bool:
         return self._shard_fresh[self.shards[0]]
+
+    def _shard_names(self, k: int) -> list[str]:
+        """Shard lease names for a ``k``-shard layout, in this agent's
+        (cluster-prefixed when federated) lease namespace."""
+        names = shard_lease_names(k) if k > 1 else [self.lease_name]
+        return [self._cluster_prefix + n for n in names]
 
     def _shard_name(self, run_uuid: str) -> str:
         """The shard (= lease name) owning a run: stable uuid hash."""
@@ -702,6 +772,45 @@ class LocalAgent:
             pass
         self._presence_renewed = now
 
+    def _acquire_health(self) -> None:
+        """Best-effort grab of this cluster's health lease. None (a peer
+        agent of the SAME cluster holds it live) is fine — any one live
+        agent keeps the cluster healthy."""
+        try:
+            self._health_lease = self.store.acquire_lease(
+                health_lease_name(self.cluster_name), self._lease_id,
+                ttl=self.lease_ttl)
+        except Exception:
+            self._health_lease = None
+
+    def _renew_health(self, now: float) -> None:
+        """Renew ``cluster-health-<name>`` on the shard beat. A REJECTED
+        renewal means a survivor cluster fenced us out mid-failover (it
+        bumped our lease tokens after our TTL lapsed): the fleet has
+        declared this cluster lost and is re-placing its runs, so every
+        held shard demotes NOW — continuing to drive runs another cluster
+        is adopting is the exact double-launch federation exists to
+        prevent. Store faults keep the lease and retry (same weather
+        policy as shard renewal)."""
+        self._health_renewed = now
+        if self._health_lease is None:
+            self._acquire_health()
+            return
+        try:
+            ok = self.store.renew_lease(
+                health_lease_name(self.cluster_name), self._lease_id,
+                self._health_lease["token"])
+        except Exception:
+            return  # transient fault: keep the lease, retry next beat
+        if not ok:
+            self._health_lease = None
+            print(f"[agent {self._lease_id[:8]}] cluster "
+                  f"{self.cluster_name!r} health lease fenced out — "
+                  f"demoting all shards", flush=True)
+            for s in list(self._shard_leases):
+                self._demote_shard(s)
+            self._drain_demotions()
+
     def _fair_share(self) -> tuple[int, list[str]]:
         """(fair share of shards for this agent, shards currently free).
         One lease-table scan: live holders = distinct holders of live
@@ -717,16 +826,20 @@ class LocalAgent:
         shares sum to >= K, so every shard finds an owner."""
         rows = self.store.list_leases()
         holders = {self._lease_id}
+        # federated: only THIS cluster's presence rows count (the prefix
+        # embeds the cluster name) — each cluster balances its own fleet
         live_presence = {
             row["holder"] for row in rows
-            if row["name"].startswith(AGENT_PREFIX) and not row["expired"]}
+            if row["name"].startswith(self._presence_prefix)
+            and not row["expired"]}
         # expired presence rows are dead incarnations (crashes/hard kills
         # never DELETE their self-named row): collect them for the
         # probe's opportunistic GC, or agent_leases grows by one row per
         # crashed incarnation forever and every scan pays for it
         self._dead_presence = [
             (row["name"], row["holder"], row["token"]) for row in rows
-            if row["name"].startswith(AGENT_PREFIX) and row["expired"]]
+            if row["name"].startswith(self._presence_prefix)
+            and row["expired"]]
         free = set(self.shards)
         for row in rows:
             live = not row["expired"]
@@ -737,7 +850,7 @@ class LocalAgent:
                 elif (row["holder"] in live_presence
                       and row["holder"] != self._lease_id):
                     free.discard(row["name"])  # busy peer, not a corpse
-            elif live and row["name"].startswith(AGENT_PREFIX):
+            elif live and row["name"].startswith(self._presence_prefix):
                 holders.add(row["holder"])
         fair = math.ceil(len(self.shards) / max(len(holders), 1))
         return fair, [s for s in self.shards if s in free]
@@ -840,6 +953,8 @@ class LocalAgent:
         beat = self.lease_ttl / 3.0
         if now - self._presence_renewed >= beat:
             self._renew_presence(now)
+        if self.cluster_name and now - self._health_renewed >= beat:
+            self._renew_health(now)
         # snapshot: _demote_shard pops this dict from whichever thread's
         # write was rejected — iterating the live dict would
         # intermittently die mid-pass with 'changed size during iteration'
@@ -889,6 +1004,14 @@ class LocalAgent:
                     self._presence_name, self._lease_id, presence["token"])
             except Exception:
                 pass
+        health, self._health_lease = self._health_lease, None
+        if health is not None and self.cluster_name:
+            try:
+                self.store.release_lease(
+                    health_lease_name(self.cluster_name), self._lease_id,
+                    health["token"])
+            except Exception:
+                pass
 
     def _register_shard_lease_gauges(self) -> None:
         for s in self.shards:
@@ -905,8 +1028,7 @@ class LocalAgent:
         fences — a duplicate launch the per-shard fencing cannot catch —
         so a mismatched starter adopts the store's K before probing."""
         self.num_shards = max(int(num_shards), 1)
-        self.shards = (shard_lease_names(self.num_shards)
-                       if self.num_shards > 1 else [self.lease_name])
+        self.shards = self._shard_names(self.num_shards)
         self._shard_set = set(self.shards)
         self._shard_pending = {s: collections.deque() for s in self.shards}
         self._pending_set = set()
@@ -1200,13 +1322,32 @@ class LocalAgent:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "LocalAgent":
+        if self.cluster_name:
+            # register/refresh this cluster backend in the store-backed
+            # registry (replicated like quotas) and take the health lease
+            # before the first probe: a sibling cluster's spill walk must
+            # never see a scheduling-capable cluster as unregistered or
+            # dead. Best-effort — the registry is a routing hint, not a
+            # mutation gate.
+            try:
+                self.store.register_cluster(
+                    self.cluster_name, region=self.region,
+                    chip_type=self.chip_type,
+                    capacity=self.capacity_chips or self.max_parallel)
+            except Exception:
+                traceback.print_exc()
         if self.lease_ttl <= 0:
             self.cold_start_resync()
         else:
             self._leasing = True
+            # per-cluster shard-count agreement: each cluster's fleet
+            # hashes ITS OWN run subset, so the layout claims must not
+            # collide across clusters
+            key = (f"num_shards.{self.cluster_name}" if self.cluster_name
+                   else "num_shards")
             try:
                 won = int(self.store.claim_config(
-                    "num_shards", str(self.num_shards)))
+                    key, str(self.num_shards)))
             except Exception:
                 won = self.num_shards  # store weather: run with our K
             if won != self.num_shards:
@@ -1217,6 +1358,9 @@ class LocalAgent:
                 self._adopt_shard_layout(won)
             now = time.monotonic()
             self._renew_presence(now)
+            if self.cluster_name:
+                self._acquire_health()
+                self._health_renewed = now
             self._probe_at = now + self.lease_ttl / 3.0
             acquired = self._probe_shards()
             if acquired:
@@ -1371,6 +1515,12 @@ class LocalAgent:
             offset += 500
         if scope is not None:
             runs = [r for r in runs if self._shard_name(r["uuid"]) in scope]
+        if self.cluster_name:
+            # federated: this agent resyncs only runs PLACED here. Queued
+            # runs placed elsewhere belong to their cluster's agents;
+            # unplaced in-flight rows (mid-failover refloat) are claimed
+            # by CAS so exactly one survivor adopts each
+            runs = [r for r in runs if self._resync_placed(r)]
         pods_by_run = self._cluster_pods_by_run(
             [r["uuid"] for r in runs if r["status"] in self._INFLIGHT])
         for s in scoped:
@@ -2303,6 +2453,10 @@ class LocalAgent:
         for run in self.store.list_runs(status=V1Statuses.STOPPING.value):
             if self._owns_run(run["uuid"]):
                 self._do_stop(run)
+        if self.cluster_name:
+            # cluster-loss watch: health-lease lapse on a sibling = lost
+            # cluster; re-place its runs onto survivors (ISSUE 16)
+            self._federation_pass()
         if self._resync_retry:
             self._retry_resync_classification()
         if self.reconciler is not None:
@@ -2411,6 +2565,12 @@ class LocalAgent:
         uuid = run["uuid"]
         if uuid in self._pending_set:
             return
+        if self.cluster_name and not self._placed_eligible(run):
+            # federated: placed on (or constrained to) another cluster —
+            # its agents drive it; this is the single chokepoint for every
+            # queued-admission path (full tick, dirty tick, resync,
+            # compile promotion)
+            return
         spec = run.get("spec") or {}
         if (_is_pipeline_spec(spec)
                 or uuid in self._active
@@ -2423,6 +2583,12 @@ class LocalAgent:
         if self.capacity_chips is not None:
             demand = self._chip_demand(run["compiled"] or spec)
             if demand > self.capacity_chips:
+                # federated: a run too big for THIS cluster may fit a
+                # sibling — spill instead of failing; unplaced runs just
+                # stay queued for an agent it fits (only a run too big
+                # for EVERY registered cluster fails loudly)
+                if self.cluster_name and self._spill_or_defer(run, demand):
+                    return
                 self._maybe_schedule(run)  # fails it with SchedulingError
                 return
         else:
@@ -2527,6 +2693,14 @@ class LocalAgent:
         while pending:
             uuid, demand = pending.popleft()
             if demand > max(budget, 0):
+                # capacity-starved here: a federated agent offers the run
+                # to a sibling cluster before parking it on the watermark
+                # (the fair walk's demand>budget branch does the same)
+                if self.cluster_name:
+                    row = self.store.get_run(uuid)
+                    if row is not None and self._try_spill(row, demand):
+                        self._drop_pending(uuid)
+                        continue
                 kept.append((uuid, demand))
                 watermark = (demand if watermark is None
                              else min(watermark, demand))
@@ -2541,6 +2715,11 @@ class LocalAgent:
                 used += demand
                 self._drop_pending(uuid)
             elif outcome == "blocked":
+                # capacity-starved here: a federated agent offers the run
+                # to a sibling cluster before parking it
+                if self.cluster_name and self._try_spill(run, demand):
+                    self._drop_pending(uuid)
+                    continue
                 # the authoritative in-lock gate disagreed with our free
                 # snapshot (concurrent scheduling); keep it queued
                 kept.append((uuid, demand))
@@ -2604,11 +2783,25 @@ class LocalAgent:
                 del groups[key]
             quota = self._quota_for_loud(tenant, uuid)
             if quota is not None and usage.get(tenant, 0) + demand > quota:
+                # federated: quotas are per-cluster budgets (usage counts
+                # only THIS agent's reservations) — an over-quota run may
+                # have headroom on a sibling cluster, so offer it there
+                # before parking it here
+                if self.cluster_name:
+                    row = self.store.get_run(uuid)
+                    if row is not None and self._try_spill(row, demand):
+                        self._drop_pending(uuid)
+                        continue
                 self._mark_over_quota(uuid, tenant, quota,
                                       usage.get(tenant, 0), demand)
                 keep(seq, uuid, demand)
                 continue
             if demand > max(budget, 0):
+                if self.cluster_name:
+                    row = self.store.get_run(uuid)
+                    if row is not None and self._try_spill(row, demand):
+                        self._drop_pending(uuid)
+                        continue
                 keep(seq, uuid, demand)
                 self._preempt_wanted.append(
                     (rank, seq, uuid, demand, tenant))
@@ -2625,6 +2818,9 @@ class LocalAgent:
                 usage[tenant] = usage.get(tenant, 0) + demand
                 self._drop_pending(uuid)
             elif outcome == "blocked":
+                if self.cluster_name and self._try_spill(run, demand):
+                    self._drop_pending(uuid)
+                    continue
                 keep(seq, uuid, demand)
                 self._preempt_wanted.append(
                     (rank, seq, uuid, demand, tenant))
@@ -2666,12 +2862,20 @@ class LocalAgent:
                 api_token=self.api_token,
                 connections=self.connections,
             )
+            compiled_d = resolved.compiled.to_dict()
+            if compiled_d.get("placement"):
+                # placement constraints fail HERE, at compile time, with a
+                # nearest-cluster hint — a typo'd pin must never park a
+                # run forever in a cluster-less queue (ISSUE 16)
+                validate_placement(
+                    parse_placement(compiled_d),
+                    list(self.store.get_cluster_map().values()))
             hit = self._cache_lookup(run, resolved)
             if hit is not None:
                 return V1Statuses.SKIPPED.value
             self.store.update_run(
                 uuid,
-                compiled=resolved.compiled.to_dict(),
+                compiled=compiled_d,
                 kind=resolved.compiled.get_run_kind(),
             )
             self.store.transition(uuid, V1Statuses.COMPILED.value)
@@ -2816,12 +3020,18 @@ class LocalAgent:
         uuid = run["uuid"]
         spec = run.get("spec") or {}
         if spec.get("matrix"):
+            if not self._claim_for_dispatch(run):
+                return "lost-claim"
             self._start_tuner(run)
             return "started"
         if _is_dag_spec(spec):
+            if not self._claim_for_dispatch(run):
+                return "lost-claim"
             self._start_dag(run)
             return "started"
         if _is_scheduled_spec(spec):
+            if not self._claim_for_dispatch(run):
+                return "lost-claim"
             self._start_schedule(run)
             return "started"
         if self.reconciler is not None and self.reconciler.is_tracked(uuid):
@@ -2855,6 +3065,16 @@ class LocalAgent:
                     active += self.reconciler.active_count()
                 if active >= self.max_parallel:
                     return "blocked"
+        # federated placement claim (ISSUE 16): AFTER the capacity gate
+        # reserved chips (only an agent that can actually host the run
+        # competes), BEFORE the expensive resolve. Exactly one cluster
+        # wins the CAS on an unplaced run; losers release the reservation
+        # and drop the entry from their queues.
+        if not self._claim_for_dispatch(run):
+            with self._lock:
+                self._chips_in_use.pop(uuid, None)
+                self._run_tenant.pop(uuid, None)
+            return "lost-claim"
         # a re-launch consumes any leftover preemption latch: from here on
         # the run's reports are the NEW attempt's and must flow normally
         self._preempting.discard(uuid)
@@ -2914,7 +3134,11 @@ class LocalAgent:
         host = "127.0.0.1"
         if self._use_cluster(resolved):
             host = self.cluster.service_host(f"plx-{uuid[:12]}")
-        meta = dict(run.get("meta") or {})
+        # re-read: `run` is the pre-dispatch snapshot, and the dispatch
+        # claim CASes meta.cluster in between — stamping the snapshot
+        # wholesale would erase the placement (and its history)
+        row = self.store.get_run(uuid) or run
+        meta = dict(row.get("meta") or {})
         # the FULL resolved port list is stamped too: the portforward
         # handler validates ?port= against agent-stamped ports only (the
         # client-supplied spec is not a trustworthy source — SSRF fix)
@@ -2978,6 +3202,13 @@ class LocalAgent:
 
     def _do_stop(self, run: dict) -> None:
         uuid = run["uuid"]
+        if self.cluster_name:
+            # federated: only the cluster HOSTING the run tears it down
+            # (its pods live there); unplaced stopping runs (mid-failover
+            # refloat) are safe for anyone — no pods anywhere
+            placed = (run.get("meta") or {}).get("cluster")
+            if placed is not None and placed != self.cluster_name:
+                return
         with self._lock:
             ex = self._active.pop(uuid, None)
             # reconciler.delete() below fires no status callback, so release
@@ -2992,6 +3223,373 @@ class LocalAgent:
             ex.stop()
         if self.reconciler is not None and self.reconciler.is_tracked(uuid):
             self.reconciler.delete(uuid)
+
+    # -- federation: placement, spillover, cluster-loss failover (ISSUE 16)
+
+    def _fed_registry(self, force: bool = False) -> dict:
+        """{name: cluster registry row (with ``healthy``)} on a small TTL
+        (same refresh policy as quotas): the spill walk runs per
+        scheduling pass and must not pay a registry scan each time."""
+        now = time.monotonic()
+        if force or now - self._fed_fetch_at >= self.fed_refresh_s:
+            try:
+                self._fed_clusters_cache = self.store.get_cluster_map()
+                self._fed_fetch_at = now
+            except Exception:
+                traceback.print_exc()
+        return self._fed_clusters_cache
+
+    def _cluster_load(self) -> dict:
+        """Live placed-run counts per cluster on the registry's refresh
+        cadence. The returned dict is the cache itself: ``_try_spill``
+        bumps the winning target in place, so consecutive spills within
+        one refresh window see the headroom they already consumed."""
+        now = time.monotonic()
+        if now - self._fed_load_at >= self.fed_refresh_s:
+            try:
+                self._fed_load_cache = self.store.cluster_load()
+                self._fed_load_at = now
+            except Exception:
+                traceback.print_exc()
+        return self._fed_load_cache
+
+    def _my_cluster_row(self) -> dict:
+        """This agent's registry row; synthesized from ctor config until
+        the start()-time registration lands (eligibility checks must not
+        depend on registration ordering)."""
+        row = self._fed_registry().get(self.cluster_name)
+        if row is None:
+            row = {"name": self.cluster_name, "region": self.region,
+                   "chip_type": self.chip_type,
+                   "capacity": self.capacity_chips or self.max_parallel,
+                   "healthy": True}
+        return row
+
+    @staticmethod
+    def _run_placement(run: dict) -> dict:
+        return parse_placement(run.get("compiled") or run.get("spec") or {})
+
+    def _placed_eligible(self, run: dict) -> bool:
+        """May THIS cluster's queue admit this run? A PLACED run belongs
+        to its cluster, full stop; an unplaced run to any cluster its
+        compile-validated constraints allow (the dispatch-time CAS claim
+        arbitrates between several eligible clusters)."""
+        if not self.cluster_name:
+            return True
+        placed = (run.get("meta") or {}).get("cluster")
+        if placed is not None:
+            return placed == self.cluster_name
+        return placement_allows(self._run_placement(run),
+                                self._my_cluster_row())
+
+    def _resync_placed(self, run: dict) -> bool:
+        """Cold-start scope filter, federated mode: queued rows by
+        eligibility; placed in-flight/stopping rows by residence; an
+        UNPLACED in-flight row (a failover refloated it and crashed
+        before anyone claimed it) is claimed by CAS right here so exactly
+        one survivor adopts and classifies it."""
+        if run["status"] == V1Statuses.QUEUED.value:
+            return self._placed_eligible(run)
+        placed = (run.get("meta") or {}).get("cluster")
+        if placed is not None:
+            return placed == self.cluster_name
+        if not placement_allows(self._run_placement(run),
+                                self._my_cluster_row()):
+            return False
+        try:
+            return bool(self.store.place_run(
+                run["uuid"], self.cluster_name, expect=None))
+        except Exception:
+            traceback.print_exc()
+            return False
+
+    def _claim_for_dispatch(self, run: dict) -> bool:
+        """Own the run before launching it. Placed here => yes; placed
+        elsewhere => no (its cluster drives it); unplaced => CAS-claim,
+        so of N eligible clusters' walks exactly ONE launches — the same
+        zero-duplicate-launch guarantee the per-shard fence gives within
+        a cluster, lifted across clusters. Runs AFTER the capacity gate
+        reserved chips: only an agent that can actually host the run
+        right now competes for it."""
+        if not self.cluster_name:
+            return True
+        placed = (run.get("meta") or {}).get("cluster")
+        if placed is not None:
+            return placed == self.cluster_name
+        try:
+            return bool(self.store.place_run(
+                run["uuid"], self.cluster_name, expect=None))
+        except Exception:
+            traceback.print_exc()
+            return False
+
+    def _try_spill(self, run: dict, demand: int) -> bool:
+        """Offer a capacity-starved or over-quota run placed HERE to a
+        sibling cluster (docs/SCHEDULING.md "Placement and spillover").
+        True = the run now belongs to another cluster and the caller
+        drops it from this queue. Hard pins never spill (park is the
+        contract); multislice never spills (its DCN/megascale traffic is
+        intra-cluster, PR 13); unplaced runs don't need to (every
+        eligible cluster's walk already queues them — whoever has
+        capacity claims at dispatch)."""
+        if not self.cluster_name:
+            return False
+        uuid = run["uuid"]
+        spec = run.get("compiled") or run.get("spec") or {}
+        placement = parse_placement(spec)
+        meta = run.get("meta") or {}
+        if meta.get("cluster") != self.cluster_name:
+            return False
+        if placement.get("cluster") is not None or is_multislice(spec):
+            return False
+        load = self._cluster_load()
+        targets = spill_candidates(
+            self.cluster_name, demand, placement, self._fed_registry(),
+            visited=meta.get("placement_history") or (), load=load)
+        for target in targets:
+            try:
+                moved = self.store.place_run(
+                    uuid, target, expect=self.cluster_name)
+            except Exception:
+                traceback.print_exc()
+                return False
+            if moved:
+                load[target] = int(load.get(target, 0)) + 1
+                self._c_spillovers.inc()
+                self.spillovers.append((uuid, self.cluster_name, target))
+                try:
+                    self.store.annotate_status(
+                        uuid, reason="Spillover",
+                        message=f"no capacity on {self.cluster_name}: "
+                                f"re-placed onto {target}")
+                except Exception:
+                    pass
+                return True
+        return False
+
+    def _spill_or_defer(self, run: dict, demand: int) -> bool:
+        """A queued run too big for THIS cluster's whole budget: spill it
+        when it is ours to move; leave it for a bigger cluster's walk
+        when unplaced. Returns False only when NO registered sibling
+        could EVER host it — then the caller fails it loudly, exactly
+        like the single-cluster scheduler would."""
+        placed = (run.get("meta") or {}).get("cluster")
+        if placed == self.cluster_name and self._try_spill(run, demand):
+            return True
+        placement = self._run_placement(run)
+        fits_elsewhere = any(
+            int(row.get("capacity") or 0) >= demand
+            and placement_allows(placement, row)
+            for name, row in self._fed_registry().items()
+            if name != self.cluster_name)
+        if placed is None and fits_elsewhere:
+            return True  # an agent it fits will claim it
+        if placed == self.cluster_name and fits_elsewhere:
+            return True  # spill targets busy/unhealthy now: retry later
+        return False
+
+    def _federation_pass(self) -> None:
+        """Cluster-loss watch, run once per full pass: a sibling whose
+        ``cluster-health-<name>`` lease lapsed is LOST — its runs re-place
+        onto survivors; a sibling placed-on but NOT registered was deleted
+        by the operator (the death certificate) — its runs re-place
+        unconditionally (docs/RESILIENCE.md "Cluster crash matrix")."""
+        try:
+            rows = self.store.list_clusters()
+        except Exception:
+            traceback.print_exc()
+            return
+        registered = {r["name"]: r for r in rows}
+        self._fed_clusters_cache = registered
+        self._fed_fetch_at = time.monotonic()
+        lost = {n for n, r in registered.items()
+                if n != self.cluster_name and not r.get("healthy")}
+        # one paged scan groups every live run by placement; victims are
+        # runs placed on a lost or unregistered cluster. The re-read in
+        # _failover_run guards against this snapshot going stale.
+        victims: dict[str, list] = {}
+        scan = [V1Statuses.QUEUED.value, *self._INFLIGHT,
+                V1Statuses.STOPPING.value]
+        offset = 0
+        while True:
+            try:
+                page = self.store.list_runs(statuses=scan, limit=500,
+                                            offset=offset, order="asc")
+            except Exception:
+                traceback.print_exc()
+                return
+            for run in page:
+                placed = (run.get("meta") or {}).get("cluster")
+                if placed is None or placed == self.cluster_name:
+                    continue
+                if placed in lost or placed not in registered:
+                    victims.setdefault(placed, []).append(run)
+            if len(page) < 500:
+                break
+            offset += 500
+        for name, runs in sorted(victims.items()):
+            self._failover_cluster(name, runs,
+                                   certified=name not in registered)
+
+    def _failover_cluster(self, lost: str, victims: list,
+                          certified: bool = False) -> None:
+        """Re-place one lost cluster's runs onto survivors, as the SINGLE
+        driver: the ``cluster-failover-<lost>`` lease gates the walk so N
+        surviving agents do the work once, and the victim cluster is
+        FENCED OUT first — every expired lease under its namespace gets
+        its token bumped, so a zombie agent of the lost cluster waking
+        mid-failover is write-rejected per shard, not a second writer.
+        The health lease is deliberately left alone: a survivor holding
+        it would read as 'healthy again'."""
+        gate = failover_lease_name(lost)
+        try:
+            lease = self.store.acquire_lease(
+                gate, self._lease_id, ttl=self.lease_ttl)
+        except Exception:
+            return
+        if lease is None:
+            return  # another survivor is already driving this failover
+        try:
+            if not certified:
+                try:
+                    peer_rows = self.store.list_leases(prefix=f"{lost}.")
+                except Exception:
+                    traceback.print_exc()
+                    return
+                for row in peer_rows:
+                    if not row["expired"]:
+                        # live lease under the lost namespace: its agents
+                        # are back mid-lapse — abort, health re-resolves
+                        # next pass
+                        return
+                for row in peer_rows:
+                    try:
+                        bumped = self.store.acquire_lease(
+                            row["name"], self._lease_id, ttl=self.lease_ttl)
+                        if bumped is not None:
+                            # bump-and-release: the token counter survives
+                            # release, so the zombie stays fenced while a
+                            # RECOVERING agent can re-acquire instantly
+                            self.store.release_lease(
+                                row["name"], self._lease_id,
+                                bumped["token"])
+                    except Exception:
+                        traceback.print_exc()
+                        return
+            for run in victims:
+                try:
+                    self._failover_run(run, lost, certified)
+                except Exception:
+                    traceback.print_exc()
+        finally:
+            try:
+                self.store.release_lease(gate, self._lease_id,
+                                         lease["token"])
+            except Exception:
+                pass
+
+    def _failover_run(self, run: dict, lost: str, certified: bool) -> None:
+        """Re-place one victim run off ``lost``. Robustness rules:
+
+        - hard-pinned to the lost cluster: parked loudly (the pin is the
+          user's contract), once;
+        - in-flight with no way to PROVE the pod set is gone (no backend
+          handle, listing fails): parked — a partitioned cluster's pods
+          may still be executing, and re-placing would double-launch. A
+          FAILED listing parks-and-retries, it never counts as "no pods"
+          (the PR-4 rule);
+        - re-queue is a FORCED transition with reason=ClusterLost, never
+          the retrying/backoff path: losing a cluster is the platform's
+          failure, not the run's — its retry budget is untouched and it
+          resumes from its newest complete checkpoint;
+        - the victim is refloated (placement -> None) so ANY eligible
+          survivor claims it through the normal dispatch CAS."""
+        uuid = run["uuid"]
+        try:
+            run = self.store.get_run(uuid) or run
+        except Exception:
+            return
+        meta = run.get("meta") or {}
+        if meta.get("cluster") != lost:
+            self._fed_retry.discard((uuid, lost))
+            return  # moved/claimed since the scan snapshot
+        status = run["status"]
+        terminal = status not in (V1Statuses.QUEUED.value,
+                                  V1Statuses.STOPPING.value,
+                                  *self._INFLIGHT)
+        if terminal:
+            self._fed_retry.discard((uuid, lost))
+            return
+        if self._run_placement(run).get("cluster") == lost:
+            if uuid not in self._cluster_lost_marked:
+                self._cluster_lost_marked.add(uuid)
+                try:
+                    self.store.annotate_status(
+                        uuid, reason="ClusterLost",
+                        message=f"cluster {lost!r} is lost and this run "
+                                f"is pinned to it (placement.cluster): "
+                                f"parked until the cluster returns")
+                except Exception:
+                    pass
+            return
+        if status == V1Statuses.QUEUED.value:
+            if self.store.place_run(uuid, None, expect=lost):
+                self._note_failover(uuid, lost)
+            return
+        handle = self.fed_clusters.get(lost)
+        if handle is None and not certified:
+            if uuid not in self._cluster_lost_marked:
+                self._cluster_lost_marked.add(uuid)
+                try:
+                    self.store.annotate_status(
+                        uuid, reason="ClusterLost",
+                        message=f"cluster {lost!r} is lost but this "
+                                f"agent has no handle to its backend: "
+                                f"cannot prove the pod set is gone "
+                                f"(split-brain hazard) — parked until an "
+                                f"operator deletes the cluster")
+                except Exception:
+                    pass
+            return
+        if handle is not None:
+            try:
+                pods = handle.pod_statuses({"app.polyaxon.com/run": uuid})
+            except Exception:
+                if not certified:
+                    # satellite 1: a failed listing is UNKNOWN, not
+                    # "no pods" — park and retry next federation pass
+                    self._fed_retry.add((uuid, lost))
+                    return
+                pods = []
+            live = [p for p in pods
+                    if getattr(p, "phase", None) not in ("Succeeded",
+                                                         "Failed")]
+            if live:
+                try:
+                    handle.delete_selected({"app.polyaxon.com/run": uuid})
+                except Exception:
+                    if not certified:
+                        self._fed_retry.add((uuid, lost))
+                        return
+        self._fed_retry.discard((uuid, lost))
+        if status == V1Statuses.STOPPING.value:
+            self.store.transition(uuid, V1Statuses.STOPPED.value,
+                                  force=True)
+            return
+        # re-queue FIRST, then refloat: the store never shows an
+        # unplaced IN-FLIGHT row (a cold-starting agent would CAS-claim
+        # and misclassify it as its own slice loss, burning retry budget)
+        self.store.transition(
+            uuid, V1Statuses.QUEUED.value, force=True, reason="ClusterLost",
+            message=f"cluster {lost!r} lost; re-placing onto survivors — "
+                    f"resumes from its newest complete checkpoint")
+        if self.store.place_run(uuid, None, expect=lost):
+            self._note_failover(uuid, lost)
+
+    def _note_failover(self, uuid: str, lost: str) -> None:
+        self._c_failovers.inc()
+        self.failovers.append((uuid, lost))
+        self._cluster_lost_marked.discard(uuid)
 
     # -- matrix pipelines --------------------------------------------------
 

@@ -26,6 +26,31 @@ from .lifecycle import (
 from .matrix import MatrixUnion
 
 
+class V1Placement(BaseSchema):
+    """Cross-cluster placement constraints (ISSUE 16,
+    docs/SCHEDULING.md "Placement and spillover"): ``cluster`` HARD-pins
+    the run to one named cluster backend (it parks rather than spill if
+    that cluster is full, and parks with ``ClusterLost`` if it dies);
+    ``chipType`` restricts scheduling and spillover to clusters of one
+    TPU generation. The chip family is validated here (schema level);
+    cluster names are validated at compile time against the live
+    registry, with nearest-cluster hints."""
+
+    cluster: Optional[str] = None
+    chip_type: Optional[str] = None
+
+    @field_validator("chip_type")
+    @classmethod
+    def _check_chip_type(cls, v: Optional[str]) -> Optional[str]:
+        from .tpu import ACCELERATOR_SPECS
+
+        if v is not None and v.partition("-")[0] not in ACCELERATOR_SPECS:
+            raise ValueError(
+                f"Unknown chip family '{v}' (one of: "
+                f"{', '.join(sorted(ACCELERATOR_SPECS))})")
+        return v
+
+
 class _OpCommon(BaseSchema):
     version: Optional[float] = None
     kind: Optional[str] = None  # "operation"
@@ -39,6 +64,10 @@ class _OpCommon(BaseSchema):
     # first in line to be preempted; absent = "normal". Compile-time
     # validated — a typo fails the polyaxonfile check, not the scheduler.
     priority: Optional[str] = None
+    # cross-cluster placement constraints (ISSUE 16): hard cluster pin
+    # and/or chip-family restriction, compile-time validated against the
+    # cluster registry (nearest-cluster hints on a typo)
+    placement: Optional[V1Placement] = None
     cache: Optional[V1Cache] = None
     termination: Optional[V1Termination] = None
     plugins: Optional[V1Plugins] = None
@@ -182,8 +211,8 @@ class V1CompiledOperation(_OpCommon):
             "tags": sorted(set(op.tags or []) | set(comp.tags or [])) or None,
             **pick(
                 "version", "name", "description", "presets", "queue", "cache",
-                "priority", "termination", "plugins", "build", "hooks",
-                "isApproved", "cost",
+                "priority", "placement", "termination", "plugins", "build",
+                "hooks", "isApproved", "cost",
             ),
             # op-only sections pass through verbatim
             **{

@@ -21,7 +21,7 @@ Usage:
         [--kill-agent] [--split-brain] [--kills 2] [--lease-ttl 0.8] \
         [--agents 4] [--num-shards 8] [--rolling-kill] \
         [--store-outage] [--serve-faults] [--watcher-faults] \
-        [--metrics-dump [PATH]]
+        [--clusters] [--metrics-dump [PATH]]
 
 ``--watcher-faults`` (ISSUE 14) runs the live-push fault soak: an SSE
 watcher fleet over the real HTTP server with a [primary, warm standby]
@@ -55,6 +55,19 @@ retry budget), and a drain-gated cooldown scale-down. Exit 0 requires
 zero lost accepted requests, exactly-once generation per request id,
 every 429 carrying Retry-After, and drains completing before deletion —
 reconciled against the strict /metrics scrape.
+
+``--clusters`` (ISSUE 16) runs the cross-cluster federation soak: three
+federated clusters (one agent + one FakeCluster each) over ONE store, a
+job wave pre-placed across them, and a two-replica service driven
+through the cross-cluster failover front — then one cluster dies WHOLE
+(agent hard-killed, every pod gone) at a seeded mid-wave moment. Exit 0
+requires terminal-state parity with the fault-free oracle, zero
+duplicate pod launches on any cluster, every victim re-placed by a
+survivor's failover pass (no retry budget burned), the service
+answering with ZERO failed requests through the loss window, and the
+lost cluster reading unhealthy on every surface — all reconciled
+against the strict /metrics scrape (docs/RESILIENCE.md §"Cluster crash
+matrix").
 
 ``--metrics-dump`` archives the last round's final /metrics scrape
 (validated Prometheus text, docs/OBSERVABILITY.md) into bench_artifacts —
@@ -2138,6 +2151,306 @@ def _run_serve_traffic_mode(args) -> int:
     return 0 if ok else 1
 
 
+def run_cluster_soak(workdir: str, seed: int = 2024, n_jobs: int = 9,
+                     lease_ttl: float = 0.8, timeout: float = 300.0,
+                     lose: bool = True) -> dict:
+    """The ISSUE 16 federation soak: THREE federated clusters (one agent
+    + one FakeCluster each, cross-wired health/listing handles) over one
+    store, a pre-placed job wave spread across them, and a 2-replica
+    service driven through the cross-cluster failover front — then the
+    'alpha' cluster dies WHOLE (agent hard-killed AND every pod gone) at
+    a seeded mid-wave moment.
+
+    Exit contract (gated by ``_run_clusters_mode``): terminal-state
+    parity with the fault-free oracle, zero duplicate pod launches on
+    ANY cluster, every alpha victim re-placed by a survivor's failover
+    pass, and zero failed service requests through the loss window (the
+    front rotates off the dead endpoint; the lost replica comes back on
+    a survivor) — all reconciled against the strict /metrics scrape.
+    ``lose=False`` is the oracle."""
+    import threading
+
+    import requests as _requests
+
+    from polyaxon_tpu.api.store import Store
+    from polyaxon_tpu.client.serve import ServeFront, ServeUnavailableError
+    from polyaxon_tpu.client.serve import federated_endpoints
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    rng = random.Random(seed)
+    store = Store(":memory:")
+    names = ("alpha", "beta", "gamma")
+    clusters = {n: FakeCluster(os.path.join(workdir, n, ".cluster"))
+                for n in names}
+    agents = {}
+    for n in names:
+        agents[n] = LocalAgent(
+            store, os.path.join(workdir, n), backend="cluster",
+            cluster=clusters[n], poll_interval=0.05, lease_ttl=lease_ttl,
+            cluster_name=n, chip_type="v5e", capacity_chips=4,
+            max_parallel=8,
+            fed_clusters={m: clusters[m] for m in names if m != n})
+
+    def _free_port() -> int:
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def _svc_spec(svc_name: str, port: int) -> dict:
+        # a minimal /generate replica (ServeFront's wire contract)
+        code = (
+            "import json, http.server\n"
+            "class H(http.server.BaseHTTPRequestHandler):\n"
+            "    def do_POST(self):\n"
+            "        n = int(self.headers.get('Content-Length') or 0)\n"
+            "        body = json.loads(self.rfile.read(n) or b'{}')\n"
+            "        out = json.dumps({'done': True, 'request_id':"
+            " body.get('request_id', ''), 'text': 'ok'}).encode()\n"
+            "        self.send_response(200)\n"
+            "        self.send_header('Content-Type',"
+            " 'application/json')\n"
+            "        self.send_header('Content-Length',"
+            " str(len(out)))\n"
+            "        self.end_headers()\n"
+            "        self.wfile.write(out)\n"
+            "    def log_message(self, *a):\n"
+            "        pass\n"
+            f"http.server.ThreadingHTTPServer(('127.0.0.1', {port}),"
+            " H).serve_forever()\n"
+        )
+        return check_polyaxonfile({
+            "kind": "operation",
+            "name": svc_name,
+            "component": {"kind": "component", "run": {
+                "kind": "service", "ports": [port],
+                "container": {"command": [sys.executable, "-c", code]},
+            }},
+        }).to_dict()
+
+    results: dict = {"requests": 0, "after_loss": 0, "failures": []}
+    stop_traffic = threading.Event()
+    lost_at: list = []
+    svc_uuids: list = []
+
+    try:
+        # EVERYTHING is placed before any agent starts: an unplaced run
+        # is fair game for any eligible cluster's dispatch claim, and the
+        # soak's victim set must be deterministic
+        uuids = [store.create_run("p", spec=s, name=s.get("name"))["uuid"]
+                 for s in _wave_specs(n_jobs, rng)]
+        for i, uuid in enumerate(uuids):
+            assert store.place_run(uuid, names[i % len(names)],
+                                   expect=None)
+        for svc_name, home in (("svc-a", "alpha"), ("svc-b", "beta")):
+            spec = _svc_spec(svc_name, _free_port())
+            u = store.create_run("p", spec=spec, name=svc_name)["uuid"]
+            assert store.place_run(u, home, expect=None)
+            svc_uuids.append(u)
+        for agent in agents.values():
+            agent.start()
+
+        endpoints = federated_endpoints(store, "p", uuids=svc_uuids)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(endpoints()) < 2:
+            time.sleep(0.1)
+        if len(endpoints()) < 2:
+            raise RuntimeError(
+                f"service replicas never published: {endpoints()}")
+
+        front = ServeFront(endpoints_fn=endpoints, timeout=10.0,
+                           max_attempts=8, backoff_s=0.1,
+                           metrics=store.metrics,
+                           on_retry=store.count_serve_retries)
+
+        def traffic() -> None:
+            n = 0
+            while not stop_traffic.is_set():
+                rid = f"req-{n}"
+                n += 1
+                try:
+                    out = front.generate(prompt="ping", request_id=rid)
+                    results["requests"] += 1
+                    if lost_at and not out.get("done"):
+                        results["failures"].append((rid, "not done"))
+                    if lost_at:
+                        results["after_loss"] += 1
+                except (ServeUnavailableError,
+                        _requests.RequestException) as e:
+                    results["failures"].append((rid, repr(e)))
+                time.sleep(0.05)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+
+        time.sleep(rng.uniform(0.4, 1.2))
+        if lose:
+            # the whole cluster at once: control plane AND data plane
+            agents["alpha"].hard_kill()
+            clusters["alpha"].shutdown()
+            lost_at.append(time.monotonic())
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = [store.get_run(u) for u in uuids]
+            if all(r["status"] in ("succeeded", "failed", "stopped")
+                   for r in rows):
+                break
+            time.sleep(0.1)
+        if lose:
+            # the lost replica must come BACK on a survivor (no hard
+            # pin), restoring the fleet to 2 live endpoints
+            svc_a = svc_uuids[0]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                row = store.get_run(svc_a)
+                if (row["status"] == "running"
+                        and (row["meta"] or {}).get("cluster") != "alpha"
+                        and len(endpoints()) >= 2):
+                    break
+                time.sleep(0.1)
+            time.sleep(1.0)  # a post-recovery traffic window
+        else:
+            lost_at.append(time.monotonic())  # count a steady window
+            time.sleep(1.0)
+        stop_traffic.set()
+        t.join(timeout=30)
+
+        statuses = {store.get_run(u)["name"]: store.get_run(u)["status"]
+                    for u in uuids}
+        svc_rows = [store.get_run(u) for u in svc_uuids]
+        return {
+            "statuses": statuses,
+            "svc": [{"name": r["name"], "status": r["status"],
+                     "cluster": (r["meta"] or {}).get("cluster")}
+                    for r in svc_rows],
+            "serve": {"requests": results["requests"],
+                      "after_loss": results["after_loss"],
+                      "failures": results["failures"][:10]},
+            "failovers": {n: list(a.failovers)
+                          for n, a in agents.items() if n != "alpha"},
+            "spillovers": {n: list(a.spillovers)
+                           for n, a in agents.items()},
+            "duplicate_applies": [
+                (n, d) for n in names
+                for d in clusters[n].duplicate_applies],
+            "launch_counts": {n: dict(clusters[n].launch_counts)
+                              for n in names},
+            "cluster_health": {n: store.get_cluster(n)["healthy"]
+                               for n in names},
+            "fence_rejections": store.stats["fence_rejections"],
+            "metrics_text": store.metrics.render(),
+        }
+    finally:
+        stop_traffic.set()
+        for u in svc_uuids:
+            try:
+                store.transition(u, "stopping")
+            except Exception:
+                pass
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and svc_uuids:
+            rows = [store.get_run(u) for u in svc_uuids]
+            if all(r["status"] in ("stopped", "failed", "succeeded")
+                   for r in rows):
+                break
+            time.sleep(0.1)
+        for agent in agents.values():
+            try:
+                agent.stop()
+            except Exception:
+                pass
+        for cluster in clusters.values():
+            cluster.shutdown()
+
+
+def _run_clusters_mode(args) -> int:
+    from polyaxon_tpu.obs import parse_prometheus
+
+    root = tempfile.mkdtemp(prefix="plx-cluster-soak-")
+    ok = True
+    final_scrape = ""
+    try:
+        oracle = run_cluster_soak(
+            os.path.join(root, "oracle"), seed=args.seed,
+            n_jobs=args.trials * 3, lease_ttl=args.lease_ttl,
+            timeout=args.timeout, lose=False)
+        final_scrape = oracle["metrics_text"]
+        print(json.dumps({"pass": "oracle", "statuses": oracle["statuses"],
+                          "serve": oracle["serve"]}))
+        if (any(v != "succeeded" for v in oracle["statuses"].values())
+                or oracle["serve"]["failures"]
+                or oracle["serve"]["requests"] == 0):
+            print(json.dumps({"error": "oracle pass did not fully succeed"}))
+            return 2
+        for i in range(args.rounds):
+            seed = args.seed + i
+            out = run_cluster_soak(
+                os.path.join(root, f"lose-{seed}"), seed=seed,
+                n_jobs=args.trials * 3, lease_ttl=args.lease_ttl,
+                timeout=args.timeout, lose=True)
+            final_scrape = out["metrics_text"]
+            fams = parse_prometheus(final_scrape)
+            failovers = [f for fs in out["failovers"].values() for f in fs]
+            c_failovers = fams.get(
+                "polyaxon_cluster_failovers_total", {}).get(
+                "polyaxon_cluster_failovers_total", 0.0)
+            converged = out["statuses"] == oracle["statuses"]
+            no_dups = not out["duplicate_applies"]
+            survivors_took_over = (
+                len(failovers) >= 1
+                and all(lost == "alpha" for _, lost in failovers)
+                and c_failovers >= len(failovers))
+            # the registry must read the truth on every surface: the
+            # scrape's healthy gauge agrees with the store row
+            alpha_down = (
+                out["cluster_health"]["alpha"] is False
+                and fams.get("polyaxon_cluster_healthy", {}).get(
+                    'polyaxon_cluster_healthy{cluster="alpha"}') == 0.0
+                and all(fams.get("polyaxon_cluster_healthy", {}).get(
+                    f'polyaxon_cluster_healthy{{cluster="{n}"}}') == 1.0
+                    for n in ("beta", "gamma")))
+            serve_ok = (not out["serve"]["failures"]
+                        and out["serve"]["after_loss"] > 0
+                        and all(s["status"] == "running"
+                                for s in out["svc"])
+                        and all(s["cluster"] in ("beta", "gamma")
+                                for s in out["svc"]))
+            round_ok = (converged and no_dups and survivors_took_over
+                        and alpha_down and serve_ok)
+            ok = ok and round_ok
+            print(json.dumps({
+                "pass": f"lose-{seed}", "ok": round_ok,
+                "converged": converged,
+                "duplicate_applies": out["duplicate_applies"],
+                "failovers": failovers,
+                "failovers_total": c_failovers,
+                "cluster_health": out["cluster_health"],
+                "serve": out["serve"],
+                "svc": out["svc"],
+                "diff": {k: (oracle["statuses"].get(k),
+                             out["statuses"].get(k))
+                         for k in set(oracle["statuses"])
+                         | set(out["statuses"])
+                         if oracle["statuses"].get(k)
+                         != out["statuses"].get(k)},
+            }))
+    finally:
+        if args.keep:
+            print(json.dumps({"workdir": root}))
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+    if args.metrics_dump:
+        _dump_metrics(args.metrics_dump, final_scrape)
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
 def _dump_metrics(path: str, text: str) -> None:
     """Archive the final /metrics scrape of the last round (validated
     Prometheus text) so every soak leaves a machine-readable telemetry
@@ -2323,6 +2636,16 @@ def main() -> int:
                         "final-loss parity vs its uninterrupted oracle, "
                         "zero duplicate launches — all via the strict "
                         "/metrics scrape")
+    p.add_argument("--clusters", action="store_true",
+                   help="cross-cluster federation soak (ISSUE 16): a "
+                        "3-cluster federated fleet over one store with a "
+                        "pre-placed job wave and a 2-replica service — "
+                        "one cluster dies WHOLE (agent + pods) mid-wave; "
+                        "survivors must re-place every victim with zero "
+                        "duplicate launches, converge to oracle parity, "
+                        "and the service must answer through the loss "
+                        "via the cross-cluster front — all via the "
+                        "strict /metrics scrape")
     p.add_argument("--store-outage", action="store_true",
                    help="store-survivability soak (ISSUE 7): kill the "
                         "PRIMARY STORE mid-wave under a sharded agent "
@@ -2348,7 +2671,8 @@ def main() -> int:
 
     if args.lock_witness and (args.train_faults or args.serve_traffic
                               or args.serve_faults or args.store_outage
-                              or args.watcher_faults or args.tenants):
+                              or args.watcher_faults or args.tenants
+                              or args.clusters):
         # refuse rather than silently run unwitnessed: an operator who
         # asked for the witness must not read a lucky exit 0 as
         # "cycle-free" when no locks were instrumented
@@ -2358,6 +2682,8 @@ def main() -> int:
               "--serve-faults / --store-outage / --watcher-faults",
               file=sys.stderr)
         return 2
+    if args.clusters:
+        return _run_clusters_mode(args)
     if args.watcher_faults:
         return _run_watcher_faults_mode(args)
     if args.tenants:

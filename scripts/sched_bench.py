@@ -15,7 +15,14 @@ modes:
 Usage:
     python scripts/sched_bench.py [N] [--mode wake|poll|both]
         [--poll-interval SEC] [--max-parallel M] [--agents A]
-        [--out PATH] [--suite] [--tenants]
+        [--out PATH] [--suite] [--tenants] [--spillover]
+
+``--spillover`` (ISSUE 16) runs the federated spillover A/B: a burst
+aimed entirely at the 'big' cluster of a 60/40 two-cluster federation.
+Hard-pinned (spill vetoed) it strands the small cluster at ~60% fleet
+utilization; unpinned, the big cluster's walk must spill its backlog
+across and hold steady-window utilization > 90% — sampled from the
+strict /metrics scrape.
 
 ``--tenants`` (ISSUE 15) runs the multi-tenant fairness smoke: a
 saturated interleaved burst from 3 tenants under 2:1:1 chip quotas,
@@ -334,6 +341,113 @@ def run_tenants(n_per_tenant: int = 8,
     return out
 
 
+def run_spillover(n: int = 30, big: int = 6, small: int = 4,
+                  job_seconds: float = 1.0,
+                  poll_interval: float = 0.05,
+                  timeout: float = 300.0) -> dict:
+    """Federated spillover A/B (ISSUE 16): a burst aimed ENTIRELY at the
+    'big' cluster of a 60/40 two-cluster federation, so 40% of the
+    fleet's chips would sit stranded without cross-cluster scheduling.
+
+    Variant A pins every run (``placement.cluster: big`` — the hard pin
+    vetoes spillover by contract), measuring the stranded baseline:
+    steady-window utilization ≈ big/(big+small). Variant B submits the
+    SAME skewed burst unpinned (pre-placed on 'big', so the skew is
+    real, not a dispatch-claim accident): the big cluster's fair walk
+    must spill its over-capacity backlog onto 'small', and the
+    acceptance row is steady-window utilization > 0.9 across the
+    federation. Utilization is sampled from the STRICT /metrics scrape
+    (the ``polyaxon_agent_shard_chips_in_use{shard}`` family — what an
+    operator's Prometheus sees), only while enough demand remains to
+    fill every chip."""
+    from polyaxon_tpu.api.store import Store
+    from polyaxon_tpu.obs import parse_prometheus
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    caps = {"big": big, "small": small}
+    total = big + small
+    terminal = ("succeeded", "failed", "stopped", "skipped")
+
+    def variant(pin: bool) -> dict:
+        workdir = tempfile.mkdtemp(prefix="sched_bench_spill_")
+        store = Store(":memory:")
+        agents = {}
+        for name, cap in caps.items():
+            agents[name] = LocalAgent(
+                store, os.path.join(workdir, name), backend="cluster",
+                cluster=FakeCluster(
+                    os.path.join(workdir, name, ".cluster")),
+                poll_interval=poll_interval, cluster_name=name,
+                chip_type="v5e", capacity_chips=cap,
+                max_parallel=cap * 2)
+            # the bench compresses hours of cluster time into seconds of
+            # 1 s jobs — refresh the spill walk's load snapshot on the
+            # same compressed timescale
+            agents[name].fed_refresh_s = 0.25
+        spec = sleep_spec(job_seconds)
+        if pin:
+            spec = dict(spec)
+            spec["placement"] = {"cluster": "big"}
+        uuids = [store.create_run("bench", name=f"s-{i}",
+                                  spec=spec)["uuid"]
+                 for i in range(n)]
+        # placed BEFORE the agents start: the skew must be the
+        # submitter's, not whichever dispatch claim wins the race
+        for u in uuids:
+            assert store.place_run(u, "big", expect=None)
+        samples: list[float] = []
+        t0 = time.monotonic()
+        try:
+            for a in agents.values():
+                a.start()
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                rows = [store.get_run(u) for u in uuids]
+                live = [r for r in rows
+                        if r["status"] not in terminal]
+                if not live:
+                    break
+                if len(live) >= total:  # saturated-demand window only
+                    fams = parse_prometheus(store.metrics.render())
+                    series = fams.get(
+                        "polyaxon_agent_shard_chips_in_use", {})
+                    used = sum(series.get(
+                        "polyaxon_agent_shard_chips_in_use"
+                        f'{{shard="{c}.scheduler"}}', 0.0)
+                        for c in caps)
+                    samples.append(used / total)
+                time.sleep(poll_interval)
+        finally:
+            spilled = sum(len(a.spillovers) for a in agents.values())
+            for a in agents.values():
+                a.stop()
+        wall = time.monotonic() - t0
+        completed = sum(
+            1 for u in uuids
+            if (store.get_run(u) or {}).get("status") == "succeeded")
+        util = sum(samples) / len(samples) if samples else 0.0
+        return {
+            "variant": "pinned_no_spill" if pin else "spillover",
+            "utilization": round(util, 4),
+            "steady_samples": len(samples),
+            "runs": n,
+            "completed": completed,
+            "runs_per_min": round(completed / (wall / 60.0), 2)
+            if wall else 0.0,
+            "spillovers": spilled,
+            "wall_s": round(wall, 3),
+        }
+
+    return {
+        "metric": "scheduler_federated_spillover",
+        "capacity_chips": dict(caps),
+        "stranded_fraction_without_spill": round(small / total, 2),
+        "job_seconds": job_seconds,
+        "results": [variant(True), variant(False)],
+    }
+
+
 def run_suite(n: int = 100, poll_interval: float = 0.2) -> dict:
     """Both BASELINE scenarios, both modes, plus the multi-agent scaling
     sweep — the committed-artifact shape.
@@ -375,6 +489,8 @@ def main() -> None:
         out = run_suite(n, poll_interval)
     elif "--tenants" in sys.argv:
         out = run_tenants(poll_interval=min(poll_interval, 0.05))
+    elif "--spillover" in sys.argv:
+        out = run_spillover(poll_interval=min(poll_interval, 0.05))
     else:
         out = run_bench(n, mode, poll_interval, max_parallel, agents=agents)
     line = json.dumps(out)

@@ -1,0 +1,23 @@
+"""R16 reproducer — wall-clock cluster-health staleness (the ISSUE 16
+federation bug class): ``time.time()`` deltas decide whether a sibling
+cluster's health lease lapsed. An NTP step FORWARD makes every live
+cluster look lost at once — and "lost" triggers failover, which tears
+down and re-places that cluster's running work. The clock rule must flag
+every wall-clock read in federation/ code."""
+
+import time
+
+
+class WallClockHealth:
+    def __init__(self, ttl: float):
+        self.ttl = ttl
+        self.renewed: dict = {}
+
+    def beat(self, cluster: str) -> None:
+        self.renewed[cluster] = time.time()  # finding: wall-clock stamp
+
+    def lost(self, cluster: str) -> bool:
+        # finding: lease-lapse arithmetic on the wall clock — an NTP
+        # step forward fails over EVERY cluster simultaneously
+        age = time.time() - self.renewed.get(cluster, 0.0)
+        return age >= self.ttl

@@ -1,0 +1,23 @@
+"""R16 clean twin — the shipped discipline: health-lapse deltas on
+``time.monotonic()`` (NTP-immune durations); wall clock only for the
+persisted registry timestamp humans read across machines, justified
+inline."""
+
+import time
+
+
+class MonotonicHealth:
+    def __init__(self, ttl: float):
+        self.ttl = ttl
+        self.renewed: dict = {}
+
+    def beat(self, cluster: str) -> None:
+        self.renewed[cluster] = time.monotonic()
+
+    def lost(self, cluster: str) -> bool:
+        age = time.monotonic() - self.renewed.get(cluster, 0.0)
+        return age >= self.ttl
+
+    def registry_row(self, cluster: str) -> dict:
+        # plx: allow(clock): persisted registered_at timestamp read by humans across machines — wall clock is the contract
+        return {"name": cluster, "registered_at": time.time()}

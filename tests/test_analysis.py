@@ -90,6 +90,9 @@ BAD_CASES = [
     # ISSUE 15 tenancy: wall-clock token-bucket refill (an NTP step mints
     # or confiscates a burst of API admission tokens)
     ("clock", "tenancy/r15_wall_clock_bucket_bad.py", 2),
+    # ISSUE 16 federation: wall-clock cluster-health staleness (an NTP
+    # step declares every live cluster lost and re-places its work)
+    ("clock", "federation/r16_wall_clock_cluster_health_bad.py", 2),
 ]
 
 OK_TWINS = [
@@ -102,6 +105,7 @@ OK_TWINS = [
     "serve/r12_monotonic_decode_ok.py",
     "api/r14_asyncblock_sse_ok.py",
     "tenancy/r15_monotonic_bucket_ok.py",
+    "federation/r16_wall_clock_cluster_health_ok.py",
 ]
 
 

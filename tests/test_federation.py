@@ -1,0 +1,699 @@
+"""Cross-cluster federation suite (ISSUE 16): cluster registry +
+replication, placement CAS, compile-time placement validation with
+nearest-cluster hints, spillover vetoes (hard pin, multislice),
+cluster-loss failover (zero duplicate launches, retry budget untouched,
+PR-4 "failed listing is unknown, not no-pods"), the single-cluster ==
+PR-15 parity bar, and the API/client surface. docs/RESILIENCE.md's
+"Cluster crash matrix" and docs/SCHEDULING.md's "Placement and
+spillover" are the contracts under test."""
+
+import os
+import sys
+import time
+
+import pytest
+import requests
+
+from polyaxon_tpu.api import ApiServer
+from polyaxon_tpu.api.store import AGENT_PREFIX, StaleLeaseError, Store
+from polyaxon_tpu.client import ClusterClient, federated_endpoints
+from polyaxon_tpu.federation import (
+    chip_family,
+    health_lease_name,
+    is_multislice,
+    nearest_cluster_hint,
+    parse_placement,
+    placement_allows,
+    spill_candidates,
+    validate_placement,
+)
+from polyaxon_tpu.federation.placement import MAX_PLACEMENT_HISTORY
+from polyaxon_tpu.operator.cluster import FakeCluster
+from polyaxon_tpu.scheduler.agent import LocalAgent
+
+RETRYING = "retrying"
+TERMINAL = ("succeeded", "failed", "stopped", "skipped")
+
+
+def job_spec(seconds: float = 0.0, placement: dict = None) -> dict:
+    cmd = ([sys.executable, "-c", f"import time; time.sleep({seconds})"]
+           if seconds else ["true"])
+    d = {
+        "kind": "operation",
+        "component": {
+            "kind": "component", "name": "j",
+            "run": {"kind": "job", "container": {"command": cmd}},
+        },
+    }
+    if placement:
+        d["placement"] = placement
+    return d
+
+
+def multislice_spec(num_slices: int = 2) -> dict:
+    return {
+        "kind": "operation",
+        "component": {
+            "kind": "component", "name": "ms",
+            "run": {"kind": "tpujob", "accelerator": "v5e-8",
+                    "numSlices": num_slices,
+                    "container": {"command": ["true"]}},
+        },
+    }
+
+
+def wait_for(pred, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def fed_agent(store, root, name, capacity, *, chip_type="v5e",
+              region=None, fed_clusters=None, lease_ttl=2.0, **kw):
+    return LocalAgent(
+        store, str(root), backend="cluster",
+        cluster=FakeCluster(os.path.join(str(root), ".cluster")),
+        poll_interval=0.05, lease_ttl=lease_ttl,
+        cluster_name=name, region=region, chip_type=chip_type,
+        capacity_chips=capacity, fed_clusters=fed_clusters, **kw)
+
+
+# -- pure placement policy ----------------------------------------------------
+
+
+class TestPlacementPolicy:
+    def test_chip_family_strips_topology(self):
+        assert chip_family("v5e-256") == "v5e"
+        assert chip_family("v4") == "v4"
+        assert chip_family(None) is None
+
+    def test_parse_placement_both_casings(self):
+        assert parse_placement({"placement": {"cluster": "a",
+                                              "chipType": "v4"}}) \
+            == {"cluster": "a", "chip_type": "v4"}
+        assert parse_placement({"placement": {"chip_type": "v5p"}}) \
+            == {"cluster": None, "chip_type": "v5p"}
+        assert parse_placement({}) == {"cluster": None, "chip_type": None}
+
+    def test_is_multislice_spill_veto(self):
+        assert is_multislice(multislice_spec(2))
+        assert not is_multislice(multislice_spec(1))
+        assert not is_multislice(job_spec())
+        # compiled shape: run at top level
+        assert is_multislice({"run": {"kind": "jaxjob", "numSlices": 3}})
+
+    def test_nearest_cluster_hint(self):
+        assert "did you mean 'us-west'" in nearest_cluster_hint(
+            "us-wset", ["us-east", "us-west"])
+        assert "no clusters are registered" in nearest_cluster_hint("x", [])
+
+    def test_validate_placement_typo_names_the_neighbour(self):
+        clusters = [{"name": "us-east", "chip_type": "v5e"},
+                    {"name": "us-west", "chip_type": "v5e"}]
+        with pytest.raises(ValueError, match="did you mean 'us-west'"):
+            validate_placement({"cluster": "us-wset", "chip_type": None},
+                               clusters)
+
+    def test_validate_placement_family_nobody_registered(self):
+        clusters = [{"name": "a", "chip_type": "v5e"}]
+        with pytest.raises(ValueError, match="no registered cluster carries"):
+            validate_placement({"cluster": None, "chip_type": "v4"},
+                               clusters)
+
+    def test_validate_placement_pin_contradicts_family(self):
+        clusters = [{"name": "a", "chip_type": "v5e"}]
+        with pytest.raises(ValueError, match="is a v5e cluster"):
+            validate_placement({"cluster": "a", "chip_type": "v4"}, clusters)
+
+    def test_validate_placement_unknown_generation(self):
+        with pytest.raises(ValueError, match="not a known TPU generation"):
+            validate_placement({"cluster": None, "chip_type": "v99"}, [])
+
+    def test_placement_allows(self):
+        row = {"name": "a", "chip_type": "v5e-256"}
+        assert placement_allows({"cluster": "a", "chip_type": "v5e"}, row)
+        assert not placement_allows({"cluster": "b", "chip_type": None}, row)
+        assert not placement_allows({"cluster": None, "chip_type": "v4"}, row)
+        # a registry row with no chip_type accepts any family
+        assert placement_allows({"cluster": None, "chip_type": "v4"},
+                                {"name": "x"})
+
+    def test_spill_candidates_order_and_anti_ping_pong(self):
+        clusters = {
+            "home": {"name": "home", "capacity": 2, "healthy": True},
+            "big": {"name": "big", "capacity": 16, "healthy": True},
+            "small": {"name": "small", "capacity": 4, "healthy": True},
+            "dead": {"name": "dead", "capacity": 64, "healthy": False},
+            "tiny": {"name": "tiny", "capacity": 1, "healthy": True},
+        }
+        placement = {"cluster": None, "chip_type": None}
+        # most registered capacity first; home/unhealthy/too-small dropped
+        assert spill_candidates("home", 2, placement, clusters) \
+            == ["big", "small"]
+        # visited hops excluded (anti-ping-pong)
+        assert spill_candidates("home", 2, placement, clusters,
+                                visited=["big"]) == ["small"]
+
+    def test_spill_candidates_headroom_throttle(self):
+        """With a live-load snapshot the walk is headroom-aware: most
+        FREE capacity first (not most registered), and a sibling already
+        queueing a full wave ahead (load >= 2x capacity) is saturated —
+        spilling there would only relocate the backlog."""
+        clusters = {
+            "home": {"name": "home", "capacity": 2, "healthy": True},
+            "big": {"name": "big", "capacity": 16, "healthy": True},
+            "small": {"name": "small", "capacity": 4, "healthy": True},
+        }
+        placement = {"cluster": None, "chip_type": None}
+        # big holds 15 live runs (1 free), small holds 0 (4 free):
+        # the emptier sibling wins despite 4x less registered capacity
+        assert spill_candidates("home", 1, placement, clusters,
+                                load={"big": 15, "small": 0}) \
+            == ["small", "big"]
+        # a full wave queued ahead saturates the target outright
+        assert spill_candidates("home", 1, placement, clusters,
+                                load={"big": 32, "small": 7}) == ["small"]
+        # load=None (no snapshot) keeps the registered-capacity order
+        assert spill_candidates("home", 1, placement, clusters,
+                                load=None) == ["big", "small"]
+
+    def test_store_cluster_load_counts_live_placed_runs(self, tmp_path):
+        s = Store(":memory:")
+        s.register_cluster("a", capacity=4)
+        s.register_cluster("b", capacity=4)
+        ua = s.create_run("p", spec=job_spec())["uuid"]
+        ub = s.create_run("p", spec=job_spec())["uuid"]
+        done = s.create_run("p", spec=job_spec())["uuid"]
+        unplaced = s.create_run("p", spec=job_spec())["uuid"]
+        assert s.place_run(ua, "a", expect=None)
+        assert s.place_run(ub, "b", expect=None)
+        assert s.place_run(done, "a", expect=None)
+        for st in ("compiled", "queued", "scheduled", "starting",
+                   "running", "succeeded"):
+            s.transition(done, st)
+        assert s.cluster_load() == {"a": 1, "b": 1}
+        assert unplaced not in s.cluster_load()  # keys are clusters
+
+
+# -- store: registry + placement CAS ------------------------------------------
+
+
+class TestClusterRegistry:
+    def test_register_list_get_delete(self):
+        s = Store(":memory:")
+        row = s.register_cluster("us-east", region="us-east1",
+                                 chip_type="v5e", capacity=8)
+        assert row["capacity"] == 8
+        s.register_cluster("us-west", chip_type="v4", capacity=16)
+        assert [c["name"] for c in s.list_clusters()] \
+            == ["us-east", "us-west"]
+        # upsert
+        s.register_cluster("us-east", region="us-east1",
+                           chip_type="v5e", capacity=12)
+        assert s.get_cluster("us-east")["capacity"] == 12
+        assert s.delete_cluster("us-east") is True
+        assert s.delete_cluster("us-east") is False
+        assert s.get_cluster("us-east") is None
+
+    def test_healthy_is_lease_derived_truth(self):
+        s = Store(":memory:")
+        s.register_cluster("a", capacity=4)
+        assert s.get_cluster("a")["healthy"] is False  # no lease yet
+        lease = s.acquire_lease(health_lease_name("a"), "agent-1", ttl=0.2)
+        assert lease is not None
+        assert s.get_cluster("a")["healthy"] is True
+        assert wait_for(lambda: s.get_cluster("a")["healthy"] is False,
+                        timeout=5), "health never lapsed with the lease"
+
+    def test_registry_replicates_through_the_changelog(self):
+        a = Store(":memory:")
+        a.register_cluster("x", chip_type="v5e", capacity=4)
+        a.register_cluster("y", chip_type="v4", capacity=8)
+        a.delete_cluster("x")
+        b = Store(":memory:")
+        b.apply_changelog(a.get_changelog(0, 500))
+        assert [c["name"] for c in b.list_clusters()] == ["y"]
+        assert b.get_cluster("y")["capacity"] == 8
+
+    def test_cluster_gauges_register_from_birth(self):
+        from polyaxon_tpu.obs import parse_prometheus
+
+        s = Store(":memory:")
+        fams = parse_prometheus(s.metrics.render())
+        for fam in ("polyaxon_cluster_healthy", "polyaxon_cluster_chips",
+                    "polyaxon_cluster_spillovers_total",
+                    "polyaxon_cluster_failovers_total"):
+            assert fam in fams, fam
+        s.register_cluster("us-east", capacity=8)
+        fams = parse_prometheus(s.metrics.render())
+        assert fams["polyaxon_cluster_chips"][
+            'polyaxon_cluster_chips{cluster="us-east"}'] == 8
+        assert fams["polyaxon_cluster_healthy"][
+            'polyaxon_cluster_healthy{cluster="us-east"}'] == 0
+
+
+class TestPlaceRunCAS:
+    def test_cas_semantics(self):
+        s = Store(":memory:")
+        run = s.create_run("p", spec=job_spec())
+        uuid = run["uuid"]
+        # claim an unplaced run: exactly one of N expect=None CASes wins
+        assert s.place_run(uuid, "a", expect=None) is True
+        assert s.place_run(uuid, "b", expect=None) is False
+        assert s.get_run(uuid)["meta"]["cluster"] == "a"
+        # idempotent re-place: True, no history entry
+        assert s.place_run(uuid, "a", expect="a") is True
+        assert "placement_history" not in s.get_run(uuid)["meta"]
+        # spill hop records provenance
+        assert s.place_run(uuid, "b", expect="a") is True
+        assert s.get_run(uuid)["meta"]["placement_history"] == ["a"]
+        # un-place (failover refloat) needs the right expectation
+        assert s.place_run(uuid, None, expect="a") is False
+        assert s.place_run(uuid, None, expect="b") is True
+        assert "cluster" not in s.get_run(uuid)["meta"]
+        # unconditional write still works (no expect)
+        assert s.place_run(uuid, "c") is True
+        assert s.place_run("no-such-run", "a") is False
+
+    def test_history_is_capped(self):
+        s = Store(":memory:")
+        uuid = s.create_run("p", spec=job_spec())["uuid"]
+        prev = None
+        for i in range(MAX_PLACEMENT_HISTORY + 4):
+            assert s.place_run(uuid, f"c{i}", expect=prev)
+            prev = f"c{i}"
+        hist = s.get_run(uuid)["meta"]["placement_history"]
+        assert len(hist) == MAX_PLACEMENT_HISTORY
+        assert hist[-1] == f"c{MAX_PLACEMENT_HISTORY + 2}"
+
+    def test_place_run_is_fenceable(self):
+        s = Store(":memory:")
+        uuid = s.create_run("p", spec=job_spec())["uuid"]
+        lease = s.acquire_lease("scheduler", "me", ttl=30)
+        with pytest.raises(StaleLeaseError):
+            s.place_run(uuid, "a", fence=("scheduler", lease["token"] - 1))
+        assert s.place_run(uuid, "a", fence=("scheduler", lease["token"]))
+
+
+# -- compile-time placement validation (satellite 3) ---------------------------
+
+
+class TestCompileTimePlacement:
+    def _compile_one(self, tmp_path, spec):
+        store = Store(":memory:")
+        store.register_cluster("us-east", chip_type="v5e", capacity=8)
+        store.register_cluster("us-west", chip_type="v5e", capacity=8)
+        agent = fed_agent(store, tmp_path, "us-east", 8)
+        run = store.create_run("p", spec=spec)
+        for _ in range(20):
+            agent.tick()
+            row = store.get_run(run["uuid"])
+            if row["status"] in TERMINAL or row.get("compiled"):
+                break
+        return store, store.get_run(run["uuid"])
+
+    def _failure_message(self, store, row):
+        return " ".join(c.get("message") or ""
+                        for c in store.get_statuses(row["uuid"]))
+
+    def test_typo_pin_fails_compile_with_hint(self, tmp_path):
+        store, row = self._compile_one(
+            tmp_path, job_spec(placement={"cluster": "us-wset"}))
+        assert row["status"] == "failed"
+        msg = self._failure_message(store, row)
+        assert "did you mean 'us-west'" in msg, msg
+
+    def test_unregistered_family_fails_compile(self, tmp_path):
+        store, row = self._compile_one(
+            tmp_path, job_spec(placement={"chipType": "v4"}))
+        assert row["status"] == "failed"
+        msg = self._failure_message(store, row)
+        assert "no registered cluster carries chip family 'v4'" in msg, msg
+
+    def test_valid_pin_compiles_and_runs(self, tmp_path):
+        store, row = self._compile_one(
+            tmp_path, job_spec(placement={"cluster": "us-east",
+                                          "chipType": "v5e"}))
+        assert row["status"] != "failed", \
+            self._failure_message(store, row)
+        assert (row.get("compiled") or {}).get("placement", {}).get(
+            "cluster") == "us-east"
+
+
+# -- spillover ----------------------------------------------------------------
+
+
+class TestSpillover:
+    def _two_agents(self, store, tmp_path, cap_a=1, cap_b=8):
+        a = fed_agent(store, tmp_path / "a", "a", cap_a)
+        b = fed_agent(store, tmp_path / "b", "b", cap_b)
+        return a, b
+
+    def test_capacity_starved_run_spills_and_completes(self, tmp_path):
+        store = Store(":memory:")
+        a, b = self._two_agents(store, tmp_path)
+        # pin a sleeper to a's only chip (hard pins never spill), then
+        # place a second run on a: its walk must spill it to b
+        sleeper = store.create_run(
+            "p", spec=job_spec(6.0, placement={"cluster": "a"}))
+        a.start()
+        b.start()
+        try:
+            assert wait_for(lambda: store.get_run(
+                sleeper["uuid"])["status"] == "running")
+            starved = store.create_run("p", spec=job_spec(0.1))
+            store.place_run(starved["uuid"], "a", expect=None)
+            assert wait_for(lambda: store.get_run(
+                starved["uuid"])["status"] == "succeeded"), \
+                store.get_run(starved["uuid"])
+            row = store.get_run(starved["uuid"])
+            assert row["meta"]["cluster"] == "b"
+            assert row["meta"]["placement_history"] == ["a"]
+            assert a.spillovers == [(starved["uuid"], "a", "b")]
+            conds = store.get_statuses(starved["uuid"])
+            assert any(c.get("reason") == "Spillover" for c in conds)
+            # the pinned sleeper stayed home
+            assert store.get_run(sleeper["uuid"])["meta"]["cluster"] == "a"
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_hard_pin_never_spills(self, tmp_path):
+        store = Store(":memory:")
+        store.register_cluster("a", chip_type="v5e", capacity=1)
+        store.register_cluster("b", chip_type="v5e", capacity=8)
+        store.acquire_lease(health_lease_name("b"), "hb", ttl=30)
+        agent = fed_agent(store, tmp_path, "a", 1)
+        uuid = store.create_run("p", spec=job_spec(
+            placement={"cluster": "a"}))["uuid"]
+        store.place_run(uuid, "a", expect=None)
+        run = store.get_run(uuid)
+        run["compiled"] = job_spec(placement={"cluster": "a"})
+        assert agent._try_spill(run, 1) is False
+        assert store.get_run(uuid)["meta"]["cluster"] == "a"
+
+    def test_multislice_never_spills(self, tmp_path):
+        store = Store(":memory:")
+        store.register_cluster("a", chip_type="v5e", capacity=8)
+        store.register_cluster("b", chip_type="v5e", capacity=64)
+        store.acquire_lease(health_lease_name("b"), "hb", ttl=30)
+        agent = fed_agent(store, tmp_path, "a", 8)
+        uuid = store.create_run("p", spec=multislice_spec(2))["uuid"]
+        store.place_run(uuid, "a", expect=None)
+        run = store.get_run(uuid)
+        assert agent._try_spill(run, 16) is False
+        assert store.get_run(uuid)["meta"]["cluster"] == "a"
+        # the single-slice twin of the same job MAY spill
+        uuid2 = store.create_run("p", spec=multislice_spec(1))["uuid"]
+        store.place_run(uuid2, "a", expect=None)
+        assert agent._try_spill(store.get_run(uuid2), 8) is True
+        assert store.get_run(uuid2)["meta"]["cluster"] == "b"
+
+    def test_spill_respects_chip_family_constraint(self, tmp_path):
+        store = Store(":memory:")
+        store.register_cluster("a", chip_type="v5e", capacity=1)
+        store.register_cluster("v4-farm", chip_type="v4", capacity=64)
+        store.register_cluster("v5e-farm", chip_type="v5e", capacity=8)
+        for n in ("v4-farm", "v5e-farm"):
+            store.acquire_lease(health_lease_name(n), "hb", ttl=30)
+        agent = fed_agent(store, tmp_path, "a", 1)
+        uuid = store.create_run("p", spec=job_spec(
+            placement={"chipType": "v5e"}))["uuid"]
+        store.place_run(uuid, "a", expect=None)
+        run = store.get_run(uuid)
+        run["compiled"] = job_spec(placement={"chipType": "v5e"})
+        assert agent._try_spill(run, 1) is True
+        assert store.get_run(uuid)["meta"]["cluster"] == "v5e-farm"
+
+
+# -- single-cluster parity (satellite 3) ---------------------------------------
+
+
+class TestSingleClusterParity:
+    N = 6
+
+    def _drive(self, store, agent):
+        uuids = [store.create_run("p", spec=job_spec(0.05),
+                                  name=f"r{i}")["uuid"]
+                 for i in range(self.N)]
+        agent.start()
+        try:
+            assert wait_for(lambda: all(
+                store.get_run(u)["status"] in TERMINAL for u in uuids))
+        finally:
+            agent.stop()
+        return {store.get_run(u)["name"]: store.get_run(u)["status"]
+                for u in uuids}
+
+    def test_unfederated_agent_is_byte_identical_to_pr15(self, tmp_path):
+        """cluster_name=None: lease names, presence prefix and walk are
+        the PR-15 shapes exactly — no placement metadata appears."""
+        store = Store(":memory:")
+        agent = LocalAgent(
+            store, str(tmp_path), backend="cluster",
+            cluster=FakeCluster(str(tmp_path / ".cluster")),
+            poll_interval=0.05, capacity_chips=4)
+        assert agent.shards == ["scheduler"]  # unprefixed PR-6 name
+        assert agent._presence_prefix == AGENT_PREFIX
+        results = self._drive(store, agent)
+        assert set(results.values()) == {"succeeded"}, results
+        assert agent.spillovers == [] and agent.failovers == []
+        for run in store.list_runs(project="p"):
+            assert "cluster" not in (run.get("meta") or {})
+
+    def test_single_registered_cluster_matches_plain_outcomes(self, tmp_path):
+        plain_store = Store(":memory:")
+        plain = LocalAgent(
+            plain_store, str(tmp_path / "plain"), backend="cluster",
+            cluster=FakeCluster(str(tmp_path / "plain" / ".cluster")),
+            poll_interval=0.05, capacity_chips=4)
+        oracle = self._drive(plain_store, plain)
+
+        fed_store = Store(":memory:")
+        fed = fed_agent(fed_store, tmp_path / "fed", "solo", 4)
+        assert fed.shards == ["solo.scheduler"]  # namespaced, same count
+        results = self._drive(fed_store, fed)
+        assert results == oracle, (results, oracle)
+        assert fed.spillovers == [] and fed.failovers == []
+
+
+# -- cluster-loss failover (the robustness core) -------------------------------
+
+
+class _FlakyHandle:
+    """Cluster handle whose pod listing fails on demand — the PR-4
+    'listing failure is unknown, not no-pods' probe (satellite 1)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.fail = False
+        self.listings = 0
+
+    def pod_statuses(self, selector):
+        self.listings += 1
+        if self.fail:
+            raise ConnectionError("cluster API unreachable (injected)")
+        return self.inner.pod_statuses(selector)
+
+    def delete_selected(self, selector):
+        return self.inner.delete_selected(selector)
+
+
+class TestClusterLossFailover:
+    def _lose_east(self, tmp_path, flaky=False):
+        store = Store(":memory:")
+        east_cluster = FakeCluster(str(tmp_path / "east" / ".cluster"))
+        handle = _FlakyHandle(east_cluster) if flaky else east_cluster
+        east = LocalAgent(
+            store, str(tmp_path / "east"), backend="cluster",
+            cluster=east_cluster, poll_interval=0.05, lease_ttl=0.8,
+            cluster_name="east", chip_type="v5e", capacity_chips=4)
+        west = LocalAgent(
+            store, str(tmp_path / "west"), backend="cluster",
+            cluster=FakeCluster(str(tmp_path / "west" / ".cluster")),
+            poll_interval=0.05, lease_ttl=0.8,
+            cluster_name="west", chip_type="v5e", capacity_chips=4,
+            fed_clusters={"east": handle})
+        return store, east, east_cluster, west, handle
+
+    def test_runs_replace_onto_survivors(self, tmp_path):
+        store, east, east_cluster, west, _ = self._lose_east(tmp_path)
+        # place BEFORE the agents start: an unplaced run is fair game for
+        # any eligible cluster's dispatch claim
+        victim = store.create_run("p", spec=job_spec(30.0))
+        pinned = store.create_run(
+            "p", spec=job_spec(30.0, placement={"cluster": "east"}))
+        uuid, pinned_uuid = victim["uuid"], pinned["uuid"]
+        assert store.place_run(uuid, "east", expect=None)
+        east.start()
+        west.start()
+        try:
+            assert wait_for(lambda: store.get_run(uuid)["status"]
+                            == "running")
+            assert wait_for(lambda: store.get_run(pinned_uuid)["status"]
+                            == "running")
+            # the whole cluster dies: agent and pods at once
+            east.hard_kill()
+            east_cluster.shutdown()
+            assert wait_for(
+                lambda: store.get_run(uuid)["meta"].get("cluster")
+                == "west" and store.get_run(uuid)["status"] == "running",
+                timeout=30), store.get_run(uuid)
+            assert west.failovers == [(uuid, "east")]
+            row = store.get_run(uuid)
+            conds = store.get_statuses(uuid)
+            # satellite 2: platform failure, not the run's — the forced
+            # ClusterLost re-queue never touches the retry/backoff budget
+            assert sum(1 for c in conds
+                       if c.get("type") == RETRYING) == 0, conds
+            lost = [c for c in conds if c.get("reason") == "ClusterLost"]
+            assert lost and "newest complete checkpoint" in \
+                lost[0]["message"]
+            assert row["meta"]["placement_history"][-1] == "east"
+            # registry truth: east reads LOST on every surface
+            assert store.get_cluster("east")["healthy"] is False
+            # the PIN is the user's contract: parked loudly, not moved
+            pinned_row = store.get_run(pinned_uuid)
+            assert pinned_row["meta"].get("cluster") == "east"
+            assert any(c.get("reason") == "ClusterLost"
+                       for c in store.get_statuses(pinned_uuid))
+            # zero duplicate launches anywhere
+            assert east_cluster.duplicate_applies == []
+            assert west.cluster.duplicate_applies == []
+        finally:
+            west.stop()
+            east_cluster.shutdown()
+
+    def test_failed_pod_listing_parks_never_no_pods(self, tmp_path):
+        """Satellite 1: while the lost cluster's pod listing FAILS, its
+        victims stay parked (unknown != gone) — re-placing on a misread
+        would double-launch. Recovery of the listing releases them."""
+        store, east, east_cluster, west, handle = self._lose_east(
+            tmp_path, flaky=True)
+        uuid = store.create_run("p", spec=job_spec(30.0))["uuid"]
+        assert store.place_run(uuid, "east", expect=None)
+        east.start()
+        west.start()
+        try:
+            assert wait_for(lambda: store.get_run(uuid)["status"]
+                            == "running")
+            handle.fail = True
+            east.hard_kill()
+            east_cluster.shutdown()
+            # west sees east lost and probes the listing — and parks
+            assert wait_for(lambda: (uuid, "east") in west._fed_retry,
+                            timeout=30)
+            row = store.get_run(uuid)
+            assert row["meta"]["cluster"] == "east"  # NOT re-placed
+            assert row["status"] == "running"        # NOT re-queued
+            assert west.failovers == []
+            # hold the park across several more federation passes
+            listings = handle.listings
+            assert wait_for(lambda: handle.listings >= listings + 2,
+                            timeout=30)
+            assert store.get_run(uuid)["meta"]["cluster"] == "east"
+            # the listing recovers: NOW the victim re-places, exactly once
+            handle.fail = False
+            assert wait_for(
+                lambda: store.get_run(uuid)["meta"].get("cluster")
+                == "west", timeout=30), store.get_run(uuid)
+            assert west.failovers == [(uuid, "east")]
+            assert west._fed_retry == set()
+            assert east_cluster.duplicate_applies == []
+            assert west.cluster.duplicate_applies == []
+            assert east_cluster.launch_counts.get(uuid, 0) == 1
+            assert west.cluster.launch_counts.get(uuid, 0) >= 1
+        finally:
+            west.stop()
+            east_cluster.shutdown()
+
+    def test_queued_victims_refloat_without_pod_proof(self, tmp_path):
+        """A QUEUED victim has no pods to prove gone — it refloats
+        immediately and any eligible survivor claims it."""
+        store, east, east_cluster, west, _ = self._lose_east(tmp_path)
+        # placed on east, which never comes up (registered, no lease)
+        store.register_cluster("east", chip_type="v5e", capacity=4)
+        uuid = store.create_run("p", spec=job_spec(0.1))["uuid"]
+        assert store.place_run(uuid, "east", expect=None)
+        west.start()
+        try:
+            assert wait_for(lambda: store.get_run(uuid)["status"]
+                            == "succeeded", timeout=30), store.get_run(uuid)
+            assert store.get_run(uuid)["meta"]["cluster"] == "west"
+        finally:
+            west.stop()
+
+    def test_retry_budget_is_untouched_by_failover(self, tmp_path):
+        """Satellite 2 unit: the re-queue is a forced ClusterLost
+        transition — the RETRYING path (which burns the run's retry
+        budget and backs off) is never entered, so a victim retains its
+        full budget for its OWN failures after the move."""
+        store, east, east_cluster, west, _ = self._lose_east(tmp_path)
+        uuid = store.create_run("p", spec=job_spec(30.0))["uuid"]
+        assert store.place_run(uuid, "east", expect=None)
+        east.start()
+        west.start()
+        try:
+            assert wait_for(lambda: store.get_run(uuid)["status"]
+                            == "running")
+            before = sum(1 for c in store.get_statuses(uuid)
+                         if c.get("type") == RETRYING)
+            east.hard_kill()
+            east_cluster.shutdown()
+            assert wait_for(
+                lambda: store.get_run(uuid)["meta"].get("cluster")
+                == "west", timeout=30)
+            after = sum(1 for c in store.get_statuses(uuid)
+                        if c.get("type") == RETRYING)
+            assert after == before == 0, \
+                "cluster loss burned the run's retry budget"
+        finally:
+            west.stop()
+            east_cluster.shutdown()
+
+
+# -- API / client surface ------------------------------------------------------
+
+
+class TestClusterSurface:
+    @pytest.fixture()
+    def srv(self):
+        srv = ApiServer(port=0).start()
+        yield srv
+        srv.stop()
+
+    def test_cluster_crud_over_http(self, srv):
+        cc = ClusterClient(srv.url)
+        row = cc.register("us-east", region="us-east1", chip_type="v5e",
+                          capacity=8)
+        assert row["name"] == "us-east" and row["capacity"] == 8
+        assert [c["name"] for c in cc.list()] == ["us-east"]
+        got = cc.get("us-east")
+        assert got["chip_type"] == "v5e"
+        assert got["healthy"] is False  # nobody holds the health lease
+        assert cc.delete("us-east")["deleted"] is True
+        assert requests.get(srv.url + "/api/v1/clusters/us-east",
+                            timeout=10).status_code == 404
+        assert requests.put(srv.url + "/api/v1/clusters/bad",
+                            json={"capacity": -2},
+                            timeout=10).status_code == 400
+
+    def test_federated_endpoints_follow_placement(self):
+        store = Store(":memory:")
+        a = store.create_run("p", spec=job_spec(), name="svc")
+        store.transition(a["uuid"], "running", force=True)
+        store.update_run(a["uuid"], meta={
+            "service": {"host": "127.0.0.1", "port": 7001}})
+        b = store.create_run("p", spec=job_spec(), name="svc")
+        store.transition(b["uuid"], "running", force=True)
+        store.update_run(b["uuid"], meta={
+            "service": {"host": "127.0.0.1", "port": 7002}})
+        fn = federated_endpoints(store, "p", name="svc")
+        assert sorted(fn()) == ["http://127.0.0.1:7001",
+                                "http://127.0.0.1:7002"]
+        # a lost cluster's replica drops out as failover re-queues it
+        store.transition(b["uuid"], "queued", force=True)
+        assert fn() == ["http://127.0.0.1:7001"]

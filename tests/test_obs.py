@@ -95,6 +95,13 @@ EXPECTED_FAMILIES = {
     "polyaxon_preemptions_total",
     "polyaxon_api_rate_limited_total",
     "polyaxon_tenant_quota_fallbacks_total",
+    # cross-cluster federation (ISSUE 16): registry health/capacity
+    # gauges (a plain stack scrapes them as {cluster="local"}) and the
+    # two re-placement counters — all registered from birth
+    "polyaxon_cluster_healthy",
+    "polyaxon_cluster_chips",
+    "polyaxon_cluster_spillovers_total",
+    "polyaxon_cluster_failovers_total",
 }
 
 
