@@ -327,6 +327,11 @@ class Store:
                       # the serve FRONT (wire count_serve_retries as its
                       # on_retry hook)
                       "serve_rejected": 0, "serve_preemptions": 0,
+                      # serving raw speed (ISSUE 17): prefix-cache and
+                      # speculative decoding counters, same delta contract
+                      "serve_prefix_hits": 0, "serve_prefix_misses": 0,
+                      "serve_cow_copies": 0,
+                      "serve_spec_proposed": 0, "serve_spec_accepted": 0,
                       "serve_request_retries": 0}
         # per-run (incarnation, last-seen cumulative train counters) for
         # delta accounting; in-memory like the counters themselves —
@@ -482,6 +487,45 @@ class Store:
             "Serve replicas currently draining (fresh reporters)",
             value_fn=(lambda p=peers: float(sum(
                 st._serve_traffic_for_scrape()["draining"] for st in p))))
+        # serving raw speed (ISSUE 17): prefix-shared paged KV and
+        # speculative decoding, bridged from the same heartbeat payload —
+        # counters through the incarnation-keyed delta path, the shared
+        # blocks gauge from fresh reporters only
+        self.metrics.counter(
+            "polyaxon_serve_prefix_cache_hits_total",
+            "Prompt KV blocks served from the shared prefix cache at "
+            "admission (no re-prefill)",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("serve_prefix_hits", 0) for st in p)))
+        self.metrics.counter(
+            "polyaxon_serve_prefix_cache_misses_total",
+            "Prompt KV blocks prefilled fresh (not found in the prefix "
+            "cache)",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("serve_prefix_misses", 0) for st in p)))
+        self.metrics.gauge(
+            "polyaxon_serve_shared_kv_blocks",
+            "KV blocks currently referenced by more than one sequence "
+            "(fresh reporters, pooled)",
+            value_fn=(lambda p=peers: float(sum(
+                st._serve_traffic_for_scrape()["shared_kv_blocks"]
+                for st in p))))
+        self.metrics.counter(
+            "polyaxon_serve_cow_copies_total",
+            "Copy-on-write block copies triggered by writes into shared "
+            "KV blocks",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("serve_cow_copies", 0) for st in p)))
+        self.metrics.counter(
+            "polyaxon_serve_spec_tokens_proposed_total",
+            "Draft tokens proposed by speculative decoding",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("serve_spec_proposed", 0) for st in p)))
+        self.metrics.counter(
+            "polyaxon_serve_spec_tokens_accepted_total",
+            "Draft tokens accepted by target verification",
+            value_fn=(lambda p=peers: sum(
+                st.stats.get("serve_spec_accepted", 0) for st in p)))
         self.metrics.gauge(
             "polyaxon_store_epoch",
             "Store epoch (bumped by every standby promotion)",
@@ -2251,6 +2295,21 @@ class Store:
                 "rejected", serve.get("rejected_total"))
             self.stats["serve_preemptions"] += delta(
                 "preempted", serve.get("preemptions_total"))
+            # serving raw speed (ISSUE 17): prefix-cache + speculative
+            # counters ride the same incarnation-keyed delta path, and
+            # the shared-blocks gauge is last-write-per-reporter like
+            # running/waiting/kv
+            self.stats["serve_prefix_hits"] += delta(
+                "prefix_hits", serve.get("prefix_cache_hits"))
+            self.stats["serve_prefix_misses"] += delta(
+                "prefix_misses", serve.get("prefix_cache_misses"))
+            self.stats["serve_cow_copies"] += delta(
+                "cow_copies", serve.get("cow_copies"))
+            self.stats["serve_spec_proposed"] += delta(
+                "spec_proposed", serve.get("spec_tokens_proposed"))
+            self.stats["serve_spec_accepted"] += delta(
+                "spec_accepted", serve.get("spec_tokens_accepted"))
+            rec["shared_kv_blocks"] = _num(serve.get("shared_kv_blocks"))
         for field_, hist in (("ttft", self._h_serve_ttft),
                              ("itl", self._h_serve_itl)):
             obs = serve.get(field_)
@@ -2279,6 +2338,7 @@ class Store:
         one service run; None aggregates every run."""
         now = time.monotonic()  # same clock as rec["at"] freshness stamps
         running = waiting = kv_used = kv_total = reporters = draining = 0
+        shared_kv = 0
         with self._train_lock:
             runs = ([uuid] if uuid is not None
                     else list(self._serve_seen))
@@ -2294,10 +2354,12 @@ class Store:
                     waiting += rec.get("waiting", 0)
                     kv_used += rec.get("kv_used", 0)
                     kv_total += rec.get("kv_total", 0)
+                    shared_kv += rec.get("shared_kv_blocks", 0)
                     draining += 1 if rec.get("draining") else 0
         return {"running": running, "waiting": waiting,
                 "reporters": reporters, "kv_used": kv_used,
                 "kv_total": kv_total, "draining": draining,
+                "shared_kv_blocks": shared_kv,
                 "kv_utilization": (kv_used / kv_total if kv_total else 0.0)}
 
     def serve_replica_drain(self, uuid: str) -> dict:

@@ -8,8 +8,16 @@ attempt — e.g. the ``serve-endpoint-*.json`` files replicas publish into
 the run dir) and applies the BaseClient failover doctrine to the
 ``/generate`` path:
 
-- requests **round-robin across replicas** (a front that pins one
-  replica starves the rest and melts under its own hot spot);
+- requests with a common prompt prefix **prefer the same replica**
+  (prefix-affinity, ISSUE 17): the first ``affinity_block`` prompt
+  tokens hash to a home replica, so each replica's radix prefix cache
+  accumulates HOT prefixes instead of every replica holding a lukewarm
+  copy of all of them. Affinity is a preference, not a pin — a dead,
+  draining, or overloaded home replica falls back to the rotation
+  below, trading a one-off re-prefill for availability;
+- requests without usable affinity **round-robin across replicas** (a
+  front that pins one replica starves the rest and melts under its own
+  hot spot);
   **connect failures and 503s retry elsewhere** — a dead pod or a
   draining replica is a host-level verdict, the endpoint is skipped for
   ``dead_for_s`` before re-probing, and the request carries an
@@ -71,6 +79,7 @@ class ServeFront:
         retry_after_cap_s: float = 10.0,
         metrics=None,
         on_retry: Optional[Callable[[int], None]] = None,
+        affinity_block: int = 16,
     ):
         if not endpoints and endpoints_fn is None:
             raise ValueError("ServeFront needs endpoints or endpoints_fn")
@@ -83,6 +92,10 @@ class ServeFront:
         #: seconds a replica that answered with a host-level failure
         #: (connect error / 503) is skipped before being re-probed
         self.dead_for_s = 2.0
+        #: prompt tokens hashed into the prefix-affinity key (0 disables
+        #: affinity routing; match the replicas' serve block_size so one
+        #: cached block's worth of prefix decides the home replica)
+        self.affinity_block = int(affinity_block)
         self._rr = 0                      # round-robin start cursor
         self._dead: dict = {}             # endpoint -> monotonic re-probe time
         self._session = requests.Session()
@@ -109,16 +122,44 @@ class ServeFront:
                 pass
         return eps or self._static
 
-    def _pick(self) -> Optional[str]:
-        """Round-robin across replicas (spread, not sticky-to-one),
-        skipping endpoints recently seen host-level dead — unless every
-        endpoint is marked dead, in which case probe anyway. None when
-        discovery found nothing (the caller backs off and re-discovers
-        next attempt)."""
+    def _affinity_key(self, body: dict) -> Optional[int]:
+        """Stable hash of the first ``affinity_block`` prompt tokens (or
+        prompt-string bytes) — requests sharing that much prefix share a
+        home replica, so its radix cache sees the repeats."""
+        if self.affinity_block <= 0:
+            return None
+        import zlib
+
+        toks = body.get("tokens")
+        if toks is not None:
+            head = ",".join(str(int(t)) for t in
+                            toks[:self.affinity_block]).encode()
+        else:
+            prompt = body.get("prompt")
+            if not prompt:
+                return None
+            head = str(prompt)[:self.affinity_block * 8].encode(
+                "utf-8", "replace")
+        return zlib.crc32(head)
+
+    def _pick(self, affinity: Optional[int] = None,
+              first_attempt: bool = False) -> Optional[str]:
+        """Pick a replica: on the FIRST attempt of a request with an
+        affinity key, prefer its home replica (``key % len``) when not
+        recently dead — the radix caches only warm up if repeats land on
+        the same pod. Otherwise (no key, retries, dead home) round-robin
+        across replicas (spread, not sticky-to-one), skipping endpoints
+        recently seen host-level dead — unless every endpoint is marked
+        dead, in which case probe anyway. None when discovery found
+        nothing (the caller backs off and re-discovers next attempt)."""
         eps = self._endpoints()
         if not eps:
             return None
         now = time.monotonic()
+        if affinity is not None and first_attempt:
+            home = eps[affinity % len(eps)]
+            if self._dead.get(home, 0) <= now:
+                return home
         for _ in range(len(eps)):
             ep = eps[self._rr % len(eps)]
             self._rr += 1
@@ -159,8 +200,9 @@ class ServeFront:
         if stream:
             body["stream"] = True
         last: Optional[BaseException] = None
+        affinity = self._affinity_key(body)
         for attempt in range(self.max_attempts):
-            ep = self._pick()
+            ep = self._pick(affinity, first_attempt=(attempt == 0))
             if ep is None:
                 # discovery found nothing (replicas not published yet):
                 # back off and re-discover on the next attempt

@@ -104,12 +104,62 @@ def _apply_serve_to_pod(cm: dict, serve: Optional[dict], ctx: dict) -> None:
             cm["workingDir"] = ctx["globals"]["run_artifacts_path"]
 
 
+def validate_serve_spec(serve: dict) -> None:
+    """Compile-time checks for a service spec's serving-speed keys
+    (ISSUE 17) — the ``validate_builtin_spec`` idiom for serving: a bad
+    ``speculative:`` block fails the COMPILE with the offending field in
+    the condition, not as a SystemExit inside the pod after scheduling.
+    Only statically decidable facts are checked here (zoo names, vocab
+    agreement between zoo-named draft and target, k bounds); a draft
+    loaded from a checkpoint path is validated at pod boot."""
+    from ..models import REGISTRY
+
+    sd = serve.get("speculative")
+    if not sd:
+        return
+    if not isinstance(sd, dict) or "draft" not in sd:
+        raise ValueError(
+            "speculative: must be a mapping with a 'draft' key "
+            "(zoo name or draft spec dict) and optional 'k'")
+    k = sd.get("k", 4)
+    if not isinstance(k, int) or isinstance(k, bool) or not 1 <= k <= 16:
+        raise ValueError(
+            f"speculative.k must be an int in 1..16, got {k!r}")
+    draft = sd["draft"]
+    dname = draft if isinstance(draft, str) else (
+        draft.get("model", "llama-tiny") if isinstance(draft, dict)
+        else None)
+    if dname is None:
+        raise ValueError(
+            f"speculative.draft must be a zoo name or a spec dict, "
+            f"got {type(draft).__name__}")
+    if dname not in REGISTRY:
+        raise ValueError(
+            f"speculative.draft model {dname!r} unknown; "
+            f"available: {sorted(REGISTRY)}")
+    dfamily, dcfg = REGISTRY[dname]
+    if dfamily != "lm":
+        raise ValueError(
+            f"speculative.draft needs a causal-LM model; "
+            f"{dname!r} is {dfamily!r}")
+    tname = serve.get("model", "llama-tiny")
+    if tname in REGISTRY:
+        tfamily, tcfg = REGISTRY[tname]
+        if tfamily == "lm" and dcfg.vocab_size != tcfg.vocab_size:
+            raise ValueError(
+                f"speculative.draft {dname!r} vocab {dcfg.vocab_size} "
+                f"!= target {tname!r} vocab {tcfg.vocab_size}: "
+                f"proposals would be meaningless")
+
+
 def _render_serve(run: Any, ctx: dict) -> Optional[dict]:
     """Render a `kind: service` run's serving-runtime spec."""
     runtime = getattr(run, "runtime", None)
     if not runtime:
         return None
-    return dict(render_value(runtime, ctx))
+    serve = dict(render_value(runtime, ctx))
+    validate_serve_spec(serve)
+    return serve
 
 
 def service_replica_floor(autoscale: Optional[dict],

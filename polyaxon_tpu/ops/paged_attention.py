@@ -34,6 +34,16 @@ Shapes (G = query heads per KV head, GQA):
     k/v pool    [N, bs, KVH, D]   the shared block pool
     block_tables[B, T] int32      pool indices, row-padded with 0
     lengths     [B]   int32       live tokens per sequence (0 = idle slot)
+
+Aliased tables (ISSUE 17, prefix-shared paged KV): nothing in either
+implementation assumes table rows are disjoint — the same pool index may
+appear in ANY number of rows (sequences sharing a refcounted prefix
+block) and both paths read the pool, never write it, so aliasing is
+free. The gather path materializes the aliased block once per referring
+row; the flash path's index map DMAs it once per referring grid step.
+The tier-1 shared-table parity tests pin this: gather stays bit-exact
+and flash stays allclose against the dense oracle when every row's
+table starts with the same physical blocks.
 """
 
 from __future__ import annotations

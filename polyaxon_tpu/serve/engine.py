@@ -61,7 +61,7 @@ import numpy as np
 
 from ..models.transformer import TransformerConfig
 from .kv_cache import OutOfBlocksError, SequenceBlocks
-from .model import decode_step, init_cache, prefill_chunk
+from .model import decode_step, init_cache, prefill_chunk, verify_step
 
 
 class EngineOverloadedError(RuntimeError):
@@ -134,6 +134,10 @@ class GenRequest:
     # minus the pending next_token); None for a first admission
     _resume_prefix: Optional[list] = None
     _rng: Optional[np.random.Generator] = None
+    # speculative decoding (ISSUE 17): the draft model's mirror of this
+    # sequence in the draft KV cache, with its own prefill cursor
+    draft_seq: SequenceBlocks = field(default_factory=SequenceBlocks)
+    draft_prefilled: int = 0
 
     @property
     def rng(self) -> np.random.Generator:
@@ -189,6 +193,10 @@ class ServeEngine:
         preempt_grace_s: float = 2.0,
         completed_cache: int = 256,
         metrics=None,
+        enable_prefix_cache: bool = True,
+        draft_params: Any = None,
+        draft_cfg: Optional[TransformerConfig] = None,
+        spec_k: int = 0,
     ):
         from ..obs.metrics import MetricsRegistry
 
@@ -204,7 +212,36 @@ class ServeEngine:
         self.prefill_chunk = int(prefill_chunk)
         self.attn_impl = attn_impl
         self.cache = init_cache(cfg, num_blocks=int(num_blocks),
-                                block_size=self.block_size)
+                                block_size=self.block_size,
+                                enable_prefix_cache=enable_prefix_cache)
+        # -- speculative decoding (ISSUE 17 tentpole (b)) --------------------
+        # a small draft proposes spec_k tokens per iteration; the target
+        # verifies them in ONE batched verify_step. The draft keeps its
+        # own (mirrored) paged cache; worst-case reservations carry a
+        # +spec_k margin because a verify writes K/V up to spec_k
+        # positions past the accepted length (masked garbage until the
+        # next step overwrites it).
+        self.spec_k = int(spec_k) if draft_params is not None else 0
+        self.draft_params = draft_params if self.spec_k > 0 else None
+        self.draft_cfg = draft_cfg if self.spec_k > 0 else None
+        self.draft_cache = None
+        if self.draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_params needs draft_cfg")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}: proposals would be meaningless")
+            if draft_cfg.max_seq < self.max_seq_len:
+                from dataclasses import replace
+
+                draft_cfg = replace(draft_cfg, max_seq=self.max_seq_len)
+                self.draft_cfg = draft_cfg
+            self.draft_cache = init_cache(
+                draft_cfg, num_blocks=int(num_blocks),
+                block_size=self.block_size,
+                enable_prefix_cache=enable_prefix_cache)
+        self._reserve_extra = self.spec_k  # verify-window block margin
         self._slots: list[Optional[GenRequest]] = [None] * self.max_slots
         self._waiting: collections.deque[GenRequest] = collections.deque()
         self._ids = itertools.count(1)
@@ -270,6 +307,34 @@ class ServeEngine:
             "polyaxon_serve_draining",
             "1 while this replica is draining (admission closed)",
             value_fn=lambda: 1.0 if self._draining else 0.0)
+        # serving raw speed (ISSUE 17): prefix-cache and speculative
+        # decoding families — registered from birth whether or not the
+        # features are enabled (the scrape contract has no optional rows)
+        self._c_prefix_hits = self.metrics.counter(
+            "polyaxon_serve_prefix_cache_hits_total",
+            "Full prompt blocks mapped from the prefix cache at admission "
+            "(refcount++, no re-prefill)")
+        self._c_prefix_misses = self.metrics.counter(
+            "polyaxon_serve_prefix_cache_misses_total",
+            "Full prompt blocks prefilled because the prefix cache had no "
+            "chain for them")
+        self.metrics.gauge(
+            "polyaxon_serve_shared_kv_blocks",
+            "KV blocks currently referenced by more than one holder "
+            "(sequences and/or the prefix index)",
+            value_fn=lambda: float(self.cache.allocator.shared_count))
+        self._c_cow = self.metrics.counter(
+            "polyaxon_serve_cow_copies_total",
+            "Copy-on-write block copies (a write into a shared block)",
+            value_fn=lambda: float(self.cache.cow_copies + (
+                self.draft_cache.cow_copies
+                if self.draft_cache is not None else 0)))
+        self._c_spec_proposed = self.metrics.counter(
+            "polyaxon_serve_spec_tokens_proposed_total",
+            "Draft tokens proposed to the speculative verify step")
+        self._c_spec_accepted = self.metrics.counter(
+            "polyaxon_serve_spec_tokens_accepted_total",
+            "Draft tokens accepted by the target's verify step")
         # drained into heartbeats by the runtime (bounded: a beat outage
         # keeps the newest window, not an unbounded backlog)
         self._obs_lock = threading.Lock()
@@ -362,7 +427,11 @@ class ServeEngine:
                                    if deadline_s else None))
         if not prompt:
             return self._fail_new(req, "empty prompt"), True
-        total = len(prompt) + sampling.max_new_tokens
+        # +spec_k: a speculative verify writes K/V up to spec_k positions
+        # past the accepted length, so reservations (and the max-seq
+        # bound) carry that margin
+        total = (len(prompt) + sampling.max_new_tokens
+                 + self._reserve_extra)
         if total > self.max_seq_len:
             return self._fail_new(
                 req, f"prompt+max_new_tokens {total} exceeds "
@@ -420,6 +489,8 @@ class ServeEngine:
             if r is req:
                 self._slots[i] = None
         self.cache.release(req.seq)
+        if self.draft_cache is not None:
+            self.draft_cache.release(req.draft_seq)
         req.state = "failed"
         req.error = reason
         req.finished_at = time.monotonic()
@@ -476,24 +547,62 @@ class ServeEngine:
 
     def _admit(self) -> None:
         """Move waiting requests into free slots while blocks last —
-        between iterations, never mid-iteration (Orca admission rule)."""
+        between iterations, never mid-iteration (Orca admission rule).
+
+        Prefix sharing (ISSUE 17): admission first maps every cached full
+        prefix block into the request's table (refcount++, zero copies),
+        then allocates only the remainder; ``prefilled`` starts at the
+        first unshared token. When the cache covers the whole prompt
+        block-aligned, the block holding the LAST prompt token is COW'd
+        up front — that is the only position prefill ever writes inside
+        shared territory (everything later lands in fresh blocks)."""
         for i in range(self.max_slots):
             if not self._waiting or self._slots[i] is not None:
                 continue
             req = self._waiting[0]
-            total = len(req.prompt) + req.sampling.max_new_tokens
-            try:
-                self.cache.ensure(req.seq, total)
-            except OutOfBlocksError:
-                return  # strict FIFO: no small-request overtake starvation
-            self._waiting.popleft()
-            req.state = "prefill"
+            total = (len(req.prompt) + req.sampling.max_new_tokens
+                     + self._reserve_extra)
             # a preempted request re-prefills its whole emitted prefix
             # (recompute-on-readmit) minus the pending next_token, whose
             # K/V the first post-resume decode step writes — the exact
             # invariant an unpreempted request maintains
-            req._resume_prefix = (req.prompt + req.out_tokens[:-1]
-                                  if req.out_tokens else None)
+            src = (req.prompt + req.out_tokens[:-1]
+                   if req.out_tokens else req.prompt)
+            shared = self.cache.share_prefix(req.seq, src)
+            d_shared = (self.draft_cache.share_prefix(req.draft_seq, src)
+                        if self.draft_cache is not None else 0)
+            try:
+                self.cache.ensure(req.seq, total)
+                if self.draft_cache is not None:
+                    self.draft_cache.ensure(req.draft_seq, total)
+                start = min(shared, len(src) - 1)
+                if shared > start:
+                    # fully-covered prompt: prefill still recomputes the
+                    # last token (its logits seed generation) — the write
+                    # into the shared tail block must COW first
+                    self.cache.ensure_writable(req.seq, start)
+                d_start = min(d_shared, len(src) - 1)
+                if d_shared > d_start and self.draft_cache is not None:
+                    self.draft_cache.ensure_writable(req.draft_seq, d_start)
+            except OutOfBlocksError:
+                # roll the mapping back (decref) and keep FIFO order: no
+                # small-request overtake starvation
+                self.cache.release(req.seq)
+                if self.draft_cache is not None:
+                    self.draft_cache.release(req.draft_seq)
+                return
+            bs = self.block_size
+            self._c_prefix_hits.inc(shared // bs)
+            self._c_prefix_misses.inc(
+                self.cache.blocks_for(len(src)) - shared // bs)
+            self._waiting.popleft()
+            req.state = "prefill"
+            req._resume_prefix = src if req.out_tokens else None
+            req.prefilled = start
+            req.seq.length = start
+            if self.draft_cache is not None:
+                req.draft_prefilled = d_start
+                req.draft_seq.length = d_start
             self._blocked_since = None
             self._slots[i] = req
 
@@ -523,9 +632,13 @@ class ServeEngine:
         if not any(s is None for s in self._slots):
             self._blocked_since = None  # slot-starved, not block-starved
             return
-        total = len(head.prompt) + head.sampling.max_new_tokens
+        total = (len(head.prompt) + head.sampling.max_new_tokens
+                 + self._reserve_extra)
         short = self.cache.blocks_short(head.seq, total)
-        if self.cache.allocator.can_alloc(short):
+        if self.cache.free_plus_evictable() >= short:
+            # the free list + index-only (evictable) prefix blocks cover
+            # it: admission's own eviction path will reclaim them — no
+            # reason to evict a RUNNING sequence
             self._blocked_since = None
             return
         if self._blocked_since is None:
@@ -540,8 +653,8 @@ class ServeEngine:
             return
         victims = [(i, r) for i, r in enumerate(self._slots)
                    if r is not None and r.preemptions == 0
-                   and self.cache.allocator.free_count
-                   + len(r.seq.block_ids) >= short]
+                   and self.cache.free_plus_evictable()
+                   + self.cache.reclaimable_on_release(r.seq) >= short]
         if not victims:
             return
         i, victim = max(victims, key=lambda t: t[1].id)
@@ -549,8 +662,14 @@ class ServeEngine:
         self._blocked_since = now  # fresh grace before the next eviction
 
     def _preempt_locked(self, slot: int, req: GenRequest) -> None:
-        self.cache.release(req.seq)   # blocks back to the pool; length 0
+        # release is a DECREF: blocks the victim shared with the prefix
+        # index or another sequence survive at their remaining refcount —
+        # a preempted sharer can never free a live sharer's blocks
+        self.cache.release(req.seq)
+        if self.draft_cache is not None:
+            self.draft_cache.release(req.draft_seq)
         req.prefilled = 0
+        req.draft_prefilled = 0
         req.state = "waiting"
         req.preemptions += 1
         self._slots[slot] = None
@@ -570,40 +689,69 @@ class ServeEngine:
         tps = self._c_tokens.value / elapsed
         return min(max(outstanding / max(tps, 1.0), 1.0), 60.0)
 
+    def _prefill_step(self, params, cfg, cache, seq, src: list,
+                      prefilled: int):
+        """One bounded prefill chunk of ``src`` into ``cache`` starting at
+        ``prefilled``; returns (last-chunk logits, new prefilled)."""
+        import jax.numpy as jnp
+
+        c = self.prefill_chunk
+        chunk = src[prefilled:prefilled + c]
+        padded = chunk + [0] * (c - len(chunk))
+        tables = jnp.asarray(cache.block_table_array(
+            [seq], self.max_blocks_per_seq))
+        logits, cache.k, cache.v = prefill_chunk(
+            params, jnp.asarray([padded], jnp.int32),
+            jnp.asarray(prefilled, jnp.int32),
+            jnp.asarray(len(chunk), jnp.int32),
+            cache.k, cache.v, tables, cfg=cfg)
+        return logits, prefilled + len(chunk)
+
     def _prefill_one(self) -> bool:
-        """Advance the first mid-prefill request by one bounded chunk.
-        Returns True when it advanced one."""
+        """Advance the first mid-prefill request by one bounded chunk —
+        the target's prompt first, then (speculative mode) the draft's
+        mirror of it. Returns True when it advanced one."""
         req = next((r for r in self._slots
                     if r is not None and r.state == "prefill"), None)
         if req is None:
             return False
-        import jax.numpy as jnp
-
         src = (req._resume_prefix if req._resume_prefix is not None
                else req.prompt)
-        c = self.prefill_chunk
-        chunk = src[req.prefilled:req.prefilled + c]
-        padded = chunk + [0] * (c - len(chunk))
-        tables = jnp.asarray(self.cache.block_table_array(
-            [req.seq], self.max_blocks_per_seq))
-        logits, self.cache.k, self.cache.v = prefill_chunk(
-            self.params, jnp.asarray([padded], jnp.int32),
-            jnp.asarray(req.prefilled, jnp.int32),
-            jnp.asarray(len(chunk), jnp.int32),
-            self.cache.k, self.cache.v, tables, cfg=self.cfg)
-        req.prefilled += len(chunk)
-        req.seq.length = req.prefilled
-        if req.prefilled >= len(src):
-            if req.out_tokens:
-                # resumed after a preemption: every emitted token already
-                # left through the stream — rearm the pending next_token
-                # and decode on, emitting nothing twice
-                req.next_token = req.out_tokens[-1]
-            else:
-                tok = sample_token(np.asarray(logits[0]), req.sampling,
-                                   req.rng)
-                req.next_token = tok
-                self._emit(req, tok)
+        if req.prefilled < len(src):
+            logits, req.prefilled = self._prefill_step(
+                self.params, self.cfg, self.cache, req.seq, src,
+                req.prefilled)
+            # readiness must flip BEFORE any token is emitted: the
+            # /generate response races the tail of the engine iteration,
+            # and a client that got its answer may probe /healthz before
+            # the loop reaches its end-of-iteration _ready.set()
+            self._ready.set()
+            req.seq.length = req.prefilled
+            if req.prefilled >= len(src):
+                # the prompt's full blocks are frozen from here (writes
+                # only ever land past len(src)): publish them so later
+                # prompts sharing the prefix skip their re-prefill
+                self.cache.publish_prefix(req.seq, req.prompt)
+                if req.out_tokens:
+                    # resumed after a preemption: every emitted token
+                    # already left through the stream — rearm the pending
+                    # next_token and decode on, emitting nothing twice
+                    req.next_token = req.out_tokens[-1]
+                else:
+                    tok = sample_token(np.asarray(logits[0]), req.sampling,
+                                       req.rng)
+                    req.next_token = tok
+                    self._emit(req, tok)
+        elif self.draft_cache is not None:
+            _, req.draft_prefilled = self._prefill_step(
+                self.draft_params, self.draft_cfg, self.draft_cache,
+                req.draft_seq, src, req.draft_prefilled)
+            req.draft_seq.length = req.draft_prefilled
+            if req.draft_prefilled >= len(src):
+                self.draft_cache.publish_prefix(req.draft_seq, req.prompt)
+        if req.prefilled >= len(src) and (
+                self.draft_cache is None
+                or req.draft_prefilled >= len(src)):
             req.state = "running"
             req._resume_prefix = None
         return True
@@ -611,6 +759,8 @@ class ServeEngine:
     def _decode_batch(self) -> int:
         """One decode iteration over every running slot. Returns tokens
         emitted."""
+        if self.draft_cache is not None:
+            return self._decode_batch_spec()
         running = [(i, r) for i, r in enumerate(self._slots)
                    if r is not None and r.state == "running"]
         if not running:
@@ -654,6 +804,118 @@ class ServeEngine:
                 self._finish(i, r)
         return emitted
 
+    def _decode_batch_spec(self) -> int:
+        """One SPECULATIVE iteration (ISSUE 17 tentpole (b)): the draft
+        greedily proposes ``spec_k`` tokens per running row, the target
+        scores pending-token + proposals in ONE batched
+        :func:`verify_step`, and each greedy row emits the longest prefix
+        of proposals agreeing with the target's own greedy choices plus
+        one correction token — token-for-token what plain decode would
+        have produced, just fewer target dispatches per token. Non-greedy
+        rows sample from the verify step's first-position logits (those
+        ARE the plain-decode logits) and ignore the proposals.
+
+        Rejected positions' K/V (target and draft) stay behind as masked
+        garbage: ``seq.length`` only advances over accepted tokens, and
+        the next iteration's writes overwrite the junk positions before
+        any mask can reach them."""
+        running = [(i, r) for i, r in enumerate(self._slots)
+                   if r is not None and r.state == "running"]
+        if not running:
+            return 0
+        import jax.numpy as jnp
+
+        b, k = self.max_slots, self.spec_k
+        tokens0 = np.zeros(b, np.int32)
+        pos0 = np.zeros(b, np.int32)
+        active = np.zeros(b, bool)
+        for i, r in running:
+            tokens0[i] = r.next_token
+            pos0[i] = r.seq.length
+            active[i] = True
+        t_tables = jnp.asarray(self.cache.block_table_array(
+            [r.seq if r is not None else None for r in self._slots],
+            self.max_blocks_per_seq))
+        d_tables = jnp.asarray(self.draft_cache.block_table_array(
+            [r.draft_seq if r is not None else None for r in self._slots],
+            self.max_blocks_per_seq))
+        active_j = jnp.asarray(active)
+        # 1) draft proposes k tokens, greedy, writing its own cache.
+        # k+1 dispatches: step j consumes [pending, p1..pk][j], so the
+        # FINAL step exists only to deposit p_k's K/V — without it a
+        # fully-accepted window leaves the draft's copy of the last
+        # accepted position unwritten, and the next window's proposals
+        # would attend over garbage there (its prediction is discarded)
+        # The greedy argmax stays ON DEVICE between draft steps: pulling
+        # logits to host per step would force a blocking transfer after
+        # every draft dispatch and serialize the window — the draft loop
+        # is dispatch-overhead bound, and async dispatch pipelines it.
+        d_tok = jnp.asarray(tokens0)
+        d_pos = jnp.asarray(pos0)
+        prop_parts = []
+        for j in range(k + 1):
+            d_logits, self.draft_cache.k, self.draft_cache.v = decode_step(
+                self.draft_params, d_tok, d_pos,
+                self.draft_cache.k, self.draft_cache.v, d_tables, active_j,
+                cfg=self.draft_cfg, impl=self.attn_impl)
+            d_pos = d_pos + 1
+            if j == k:
+                break
+            d_tok = jnp.argmax(d_logits, axis=-1).astype(jnp.int32)
+            prop_parts.append(d_tok)
+        proposals_j = jnp.stack(prop_parts, axis=1)          # [B, k]
+        # 2) target verifies pending + proposals in one batched dispatch;
+        # the proposals' host transfer overlaps the verify dispatch
+        ver_tokens = jnp.concatenate(
+            [jnp.asarray(tokens0)[:, None], proposals_j], axis=1)
+        logits, self.cache.k, self.cache.v = verify_step(
+            self.params, ver_tokens, jnp.asarray(pos0),
+            self.cache.k, self.cache.v, t_tables, active_j, cfg=self.cfg)
+        proposals = np.asarray(proposals_j)                  # [B, k]
+        logits_np = np.asarray(logits)                       # [B, k+1, V]
+        self._decode_steps += 1
+        emitted = 0
+        for i, r in running:
+            r.seq.length += 1  # the pending token's K/V just landed
+            sp = r.sampling
+            done = len(r.out_tokens) >= sp.max_new_tokens or (
+                sp.stop_token is not None
+                and r.out_tokens and r.out_tokens[-1] == sp.stop_token)
+            if done:
+                self._finish(i, r)
+                continue
+            if sp.temperature > 0.0:
+                # sampled rows take the plain-decode path off the verify
+                # logits' first position (bit-identical to decode_step)
+                self._c_spec_proposed.inc(k)
+                cands = [sample_token(logits_np[i, 0], sp, r.rng)]
+            else:
+                greedy = np.argmax(logits_np[i], axis=-1)    # [k+1]
+                m = 0
+                while m < k and proposals[i, m] == greedy[m]:
+                    m += 1
+                self._c_spec_proposed.inc(k)
+                self._c_spec_accepted.inc(m)
+                cands = [int(t) for t in proposals[i, :m]] + [int(greedy[m])]
+            finished = False
+            for ci, tok in enumerate(cands):
+                r.next_token = tok
+                self._emit(r, tok)
+                emitted += 1
+                if len(r.out_tokens) >= sp.max_new_tokens or (
+                        sp.stop_token is not None and tok == sp.stop_token):
+                    self._finish(i, r)
+                    finished = True
+                    break
+                if ci < len(cands) - 1:
+                    # every accepted (non-final) token's K/V was verified
+                    # into the cache this step; only the final emitted
+                    # token stays pending
+                    r.seq.length += 1
+            if not finished:
+                r.draft_seq.length = r.seq.length
+        return emitted
+
     def _emit(self, req: GenRequest, tok: int) -> None:
         now = time.monotonic()
         req.out_tokens.append(tok)
@@ -692,6 +954,8 @@ class ServeEngine:
         req.state = "done"
         req.finished_at = time.monotonic()
         self.cache.release(req.seq)
+        if self.draft_cache is not None:
+            self.draft_cache.release(req.draft_seq)
         self._slots[slot] = None
         self._c_requests.inc()
         req.stream.put(None)
@@ -765,6 +1029,8 @@ class ServeEngine:
                             r.error = repr(e)
                             r.finished_at = time.monotonic()
                             self.cache.release(r.seq)
+                            if self.draft_cache is not None:
+                                self.draft_cache.release(r.draft_seq)
                             self._slots[i] = None
                             r.stream.put(None)
                             r.done.set()
@@ -800,6 +1066,20 @@ class ServeEngine:
             # rejected/preempted store families see it
             "rejected_total": int(self._c_rejected.value),
             "preemptions_total": int(self._c_preempted.value),
+            # serving raw speed (ISSUE 17): prefix-cache + speculative
+            # counters ride the same heartbeat delta path, plus the
+            # refcount audit the fault soak gates on (any violation means
+            # a release freed a block someone still referenced)
+            "prefix_cache_hits": int(self._c_prefix_hits.value),
+            "prefix_cache_misses": int(self._c_prefix_misses.value),
+            "shared_kv_blocks": int(self.cache.allocator.shared_count),
+            "cow_copies": int(self._c_cow.value),
+            "spec_tokens_proposed": int(self._c_spec_proposed.value),
+            "spec_tokens_accepted": int(self._c_spec_accepted.value),
+            "kv_audit_violations": int(
+                self.cache.allocator.audit_violations + (
+                    self.draft_cache.allocator.audit_violations
+                    if self.draft_cache is not None else 0)),
             "draining": bool(self._draining),
             "drained": bool(self.drained) if self._draining else False,
             "ready": self.ready,

@@ -38,11 +38,12 @@ from .kv_cache import PagedKVCache
 
 
 def init_cache(cfg: TransformerConfig, num_blocks: int, block_size: int,
-               dtype=None) -> PagedKVCache:
+               dtype=None, enable_prefix_cache: bool = True) -> PagedKVCache:
     return PagedKVCache(
         num_layers=cfg.num_layers, num_blocks=num_blocks,
         block_size=block_size, kv_heads=cfg.kv_heads, head_dim=cfg.hd,
-        dtype=dtype or cfg.dtype)
+        dtype=dtype or cfg.dtype,
+        enable_prefix_cache=enable_prefix_cache)
 
 
 def _layer_qkv(x, lp, cfg: TransformerConfig, rope_tables, positions):
@@ -251,6 +252,123 @@ def prefill_chunk(
     eq = "bh,vh->bv" if vocab_major else "bh,hv->bv"
     logits = jnp.einsum(eq, hidden_last, w.astype(dt)).astype(jnp.float32)
     return logits, k_pool, v_pool
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg",),
+    donate_argnames=("k_pool", "v_pool"),
+)
+def verify_step(
+    params: dict,
+    tokens: jax.Array,        # [B, S] int32 — pending token + S-1 proposals
+    positions: jax.Array,     # [B] int32 — cache position of tokens[:, 0]
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [B, T] int32
+    active: jax.Array,        # [B] bool
+    *,
+    cfg: TransformerConfig,
+):
+    """Speculative VERIFY: one batched multi-token incremental step — the
+    target model scores a draft's S-token window (pending token + S-1
+    proposals) per running row in a single dispatch. Returns
+    (logits [B, S, V] f32, k_pool, v_pool): ``logits[:, j]`` is the
+    next-token distribution after ``tokens[:, j]``, bit-identical to what
+    ``decode_step`` would produce at that position (same layer math, f32
+    softmax — the greedy-parity pin relies on it).
+
+    All S positions' K/V are written (inactive rows to the trash block);
+    the engine advances ``seq.length`` only over the ACCEPTED prefix, so
+    rejected positions are masked garbage the next step overwrites."""
+    dt = cfg.dtype
+    block_size = k_pool.shape[2]
+    b, s = tokens.shape
+    offs = jnp.arange(s, dtype=jnp.int32)
+    positions_2d = positions[:, None] + offs[None, :]        # [B, S]
+    live = active[:, None] & jnp.ones((b, s), bool)
+    pos_safe = jnp.clip(positions_2d, 0, cfg.max_seq - 1)
+    x = params["embed"]["tokens"].astype(dt)[tokens]         # [B, S, h]
+    if cfg.pos == "learned":
+        x = x + params["embed"]["pos"].astype(dt)[pos_safe]
+    rope_tables = None
+    if cfg.pos == "rope":
+        cos, sin = rope_frequencies(cfg.hd, cfg.max_seq, cfg.rope_theta)
+        rope_tables = (cos, sin)
+    blk, slot = _write_coords(
+        pos_safe, block_tables, block_size, live, k_pool.shape[1] - 1)
+    capacity = block_tables.shape[1] * block_size
+    k_ids = jnp.arange(capacity)
+
+    def layer(x, xs):
+        lp, k_l, v_l = xs
+        q, k, v = _layer_qkv(x, lp, cfg, rope_tables, pos_safe)
+        k_l = _write_kv(k_l, k.transpose(0, 2, 1, 3), blk, slot)
+        v_l = _write_kv(v_l, v.transpose(0, 2, 1, 3), blk, slot)
+        kc = gather_blocks(k_l, block_tables)                # [B, C_cap, KVH, D]
+        vc = gather_blocks(v_l, block_tables)
+        qg = _regroup(q, cfg.kv_heads)                       # [B,KVH,G,S,D]
+        scores = jnp.einsum(
+            "bhgsd,bchd->bhgsc", qg.astype(jnp.float32),
+            kc.astype(jnp.float32)) * (cfg.hd ** -0.5)
+        mask = k_ids[None, None, :] <= positions_2d[..., None]  # [B, S, C_cap]
+        scores = jnp.where(mask[:, None, None, :, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+        o = jnp.einsum("bhgsc,bchd->bhgsd", probs,
+                       vc.astype(jnp.float32)).astype(dt)
+        bb, kvh, g, ss, d = o.shape
+        o = o.reshape(bb, kvh * g, ss, d).transpose(0, 2, 1, 3).reshape(
+            bb, ss, kvh * g * d)
+        x = _layer_mlp(x, o, lp, cfg)
+        return x, (k_l, v_l)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        layer, x, (params["layers"], k_pool, v_pool))
+    hidden = _norm(x, params["final_norm"], cfg)             # [B, S, h]
+    w, vocab_major = head_weights(params, cfg)
+    eq = "bsh,vh->bsv" if vocab_major else "bsh,hv->bsv"
+    logits = jnp.einsum(eq, hidden, w.astype(dt)).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
+def extend_with_identity_layers(params: dict, cfg: TransformerConfig,
+                                extra_layers: int):
+    """A target model that provably agrees with its draft: append
+    ``extra_layers`` IDENTITY layers (attention and MLP output
+    projections zeroed, so each appended layer is ``x -> x + 0 + 0``) to
+    scan-stacked ``params``. The extended model's logits equal the
+    original's bit-for-bit while costing ``(L + extra) / L`` the compute —
+    the controlled fixture the speculative bench and acceptance tests use
+    (100% draft agreement by construction, honest per-layer cost).
+    Returns (params, cfg) for the extended model."""
+    from dataclasses import replace
+
+    import jax.tree_util as jtu
+
+    layers = params["layers"]
+
+    def _tail(leaf):
+        rep = jnp.repeat(leaf[-1:], extra_layers, axis=0)
+        return rep
+
+    tail = jtu.tree_map(_tail, layers)
+    # zero exactly the residual-branch outputs: the appended layers still
+    # run full attention + MLP (honest cost) but contribute nothing
+    tail = dict(tail)
+    tail["attn"] = dict(tail["attn"])
+    tail["attn"]["wo"] = jnp.zeros_like(tail["attn"]["wo"])
+    if "bo" in tail["attn"]:
+        tail["attn"]["bo"] = jnp.zeros_like(tail["attn"]["bo"])
+    tail["mlp"] = dict(tail["mlp"])
+    tail["mlp"]["wo"] = jnp.zeros_like(tail["mlp"]["wo"])
+    if "bo" in tail["mlp"]:
+        tail["mlp"]["bo"] = jnp.zeros_like(tail["mlp"]["bo"])
+    stacked = jtu.tree_map(
+        lambda a, b: jnp.concatenate([a, b], axis=0), layers, tail)
+    out = dict(params)
+    out["layers"] = stacked
+    return out, replace(cfg, num_layers=cfg.num_layers + extra_layers)
 
 
 def dense_reference_decode(params, cfg: TransformerConfig, prompts,

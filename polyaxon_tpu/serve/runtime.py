@@ -24,6 +24,16 @@ Spec keys:
     platform / num_cpu_devices (same semantics as the builtin trainer),
     report_interval (outputs/heartbeat cadence seconds, default 2)
 
+Serving raw speed keys (ISSUE 17):
+    prefix_cache: false disables prefix-shared paged KV (COW + radix
+        index; default on — sharing is refcount-safe under preemption)
+    speculative: {draft, k} — draft-verify speculative decoding: ``draft``
+        is a zoo name (must share the target's vocab) or a spec dict with
+        its own checkpoint/import keys (e.g. the run's LoRA base), ``k``
+        the tokens proposed per iteration (1..16). Greedy outputs are
+        token-for-token identical to plain decode; the compiler validates
+        the block at compile time (compiler/converter.py).
+
 Fault-tolerance spec keys (ISSUE 12, docs/RESILIENCE.md serving matrix):
     max_waiting: admission queue bound (beyond it: 429 + Retry-After)
     preempt_grace_s: head-of-line block starvation before a KV-pressure
@@ -66,6 +76,7 @@ OUTPUT_KEYS = (
     "serve_ttft_p50_ms", "serve_ttft_p95_ms", "serve_intertoken_p50_ms",
     "serve_intertoken_p95_ms", "serve_running", "serve_waiting",
     "serve_kv_block_utilization", "serve_port", "serve_replica",
+    "serve_prefix_hit_rate", "serve_spec_acceptance_rate",
 )
 
 
@@ -125,6 +136,43 @@ def load_params(spec: dict, cfg) -> tuple[Any, dict]:
         "restored_step": -1}
 
 
+def load_draft(spec: dict, target_cfg):
+    """Speculative draft weights (ISSUE 17): ``speculative.draft`` is a
+    zoo name (random init unless the draft dict carries its own
+    checkpoint/import keys) or a full sub-spec dict — e.g. the run's LoRA
+    base via ``{model: ..., import: ...}``. The draft must speak the
+    target's vocabulary, enforced here AND at compile time. Returns
+    (draft_params, draft_cfg, k) or (None, None, 0) when disabled."""
+    sd = spec.get("speculative")
+    if not sd:
+        return None, None, 0
+    from ..models import REGISTRY
+
+    if not isinstance(sd, dict) or "draft" not in sd:
+        raise SystemExit("speculative: needs {draft, k}")
+    draft = sd["draft"]
+    dspec = {"model": draft} if isinstance(draft, str) else dict(draft)
+    dname = dspec.get("model", "llama-tiny")
+    if dname not in REGISTRY:
+        raise SystemExit(
+            f"speculative.draft model {dname!r} unknown; "
+            f"available: {sorted(REGISTRY)}")
+    dfamily, dcfg = REGISTRY[dname]
+    if dfamily != "lm":
+        raise SystemExit(
+            f"speculative.draft needs a causal-LM model; "
+            f"{dname!r} is {dfamily!r}")
+    if dcfg.vocab_size != target_cfg.vocab_size:
+        raise SystemExit(
+            f"speculative.draft vocab {dcfg.vocab_size} != target vocab "
+            f"{target_cfg.vocab_size}")
+    k = int(sd.get("k", 4))
+    if not 1 <= k <= 16:
+        raise SystemExit(f"speculative.k must be 1..16, got {k}")
+    dparams, _ = load_params(dspec, dcfg)
+    return dparams, dcfg, k
+
+
 def build_engine(spec: dict):
     """REGISTRY model + overrides -> a ready (not yet started) engine."""
     from dataclasses import replace
@@ -144,6 +192,7 @@ def build_engine(spec: dict):
     if max_seq > cfg.max_seq:
         cfg = replace(cfg, max_seq=max_seq)
     params, provenance = load_params(spec, cfg)
+    draft_params, draft_cfg, spec_k = load_draft(spec, cfg)
     engine = ServeEngine(
         params, cfg,
         max_slots=int(spec.get("max_slots", 8)),
@@ -155,6 +204,10 @@ def build_engine(spec: dict):
         attn_impl=spec.get("attn_impl", "gather"),
         max_waiting=int(spec.get("max_waiting", 128)),
         preempt_grace_s=float(spec.get("preempt_grace_s", 2.0)),
+        enable_prefix_cache=bool(spec.get("prefix_cache", True)),
+        draft_params=draft_params,
+        draft_cfg=draft_cfg,
+        spec_k=spec_k,
     )
     engine.provenance = provenance
     engine.model_name = name
@@ -257,6 +310,16 @@ class ServeReporter(threading.Thread):
                     / max(snap["kv_blocks_total"], 1), 4),
                 "serve_port": self.port,
                 "serve_replica": self.replica,
+                # serving raw speed (ISSUE 17): the two dimensionless
+                # health numbers of the fast path — how much prefill the
+                # radix cache absorbed, how much decode the draft did
+                "serve_prefix_hit_rate": round(
+                    snap["prefix_cache_hits"]
+                    / max(snap["prefix_cache_hits"]
+                          + snap["prefix_cache_misses"], 1), 4),
+                "serve_spec_acceptance_rate": round(
+                    snap["spec_tokens_accepted"]
+                    / max(snap["spec_tokens_proposed"], 1), 4),
             }
             try:
                 self.tracked.log_outputs(**{

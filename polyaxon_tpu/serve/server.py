@@ -19,7 +19,11 @@ Routes:
     GET  /healthz    readiness: 200 only when the engine completed a
                      first successful step AND is not draining — probes
                      and the failover front stop routing otherwise (503)
-    GET  /stats      engine traffic snapshot (JSON twin of /metrics)
+    GET  /stats      engine traffic snapshot (JSON twin of /metrics) —
+                     includes the serving-speed state (ISSUE 17):
+                     prefix_cache_hits/misses, shared_kv_blocks,
+                     cow_copies, spec_tokens_proposed/accepted and the
+                     kv_audit_violations safety counter (must stay 0)
     GET  /metrics    pod-local Prometheus families (polyaxon_serve_*)
 
 Tokenization: the model zoo has no external tokenizer; byte-vocab models
@@ -191,6 +195,11 @@ def build_app(engine: ServeEngine, *, metrics=None,
             "draining": engine.draining,
             "running": engine.running_count,
             "waiting": engine.waiting_count,
+            # fast-path config (ISSUE 17): lets probes and the front see
+            # which replicas run the draft/prefix-cache configuration
+            # during a rollout
+            "speculative_k": engine.spec_k,
+            "prefix_cache": engine.cache.prefix_index is not None,
         }, status=200 if ok else 503)
 
     async def stats(_request) -> web.Response:

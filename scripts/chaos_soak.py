@@ -54,7 +54,12 @@ admission queue, 1 injected engine hang (watchdog hard-exit into the
 retry budget), and a drain-gated cooldown scale-down. Exit 0 requires
 zero lost accepted requests, exactly-once generation per request id,
 every 429 carrying Retry-After, and drains completing before deletion —
-reconciled against the strict /metrics scrape.
+reconciled against the strict /metrics scrape. The fleet traffic shares
+a 16-token system prefix (ISSUE 17), so the same soak gates the
+prefix-shared paged KV cache: ``kv_audit_violations`` must read exactly
+0 on every surviving engine (no kill/preemption ever frees a live
+sharer's blocks) and ``GET /result/{id}`` must return token-identical
+output to the original POST.
 
 ``--clusters`` (ISSUE 16) runs the cross-cluster federation soak: three
 federated clusters (one agent + one FakeCluster each) over ONE store, a
@@ -1348,7 +1353,17 @@ def run_serve_fault_soak(workdir: str, seed: int = 2024,
 
     Exit contract: zero lost accepted requests, exactly-once per id,
     every 429 with Retry-After, drains completed, all reconciled against
-    the strict /metrics scrape. Returns the checks + scrape."""
+    the strict /metrics scrape. Returns the checks + scrape.
+
+    ISSUE 17 (prefix-shared paged KV) rides the same soak: the fleet
+    traffic shares a 16-token system prefix (2 full blocks at the soak's
+    block_size=8), so every admission exercises the refcounted prefix
+    cache while replicas are killed and KV pressure preempts — the exit
+    gate asserts ``kv_audit_violations == 0`` on every surviving engine
+    (a kill or preemption that freed a live sharer's blocks would trip
+    the allocator audit), that the store scrape carries the prefix-cache
+    hit counter, and that resume-by-id (``GET /result/{id}``) returns
+    token-identical output to the original POST."""
     import glob
     import threading
 
@@ -1439,6 +1454,14 @@ def run_serve_fault_soak(workdir: str, seed: int = 2024,
     stop_traffic = threading.Event()
     ramp_stop = threading.Event()
 
+    # shared-prefix fleet traffic (ISSUE 17): every worker request opens
+    # with the same 16-token "system prompt" — 2 full blocks at the
+    # soak's block_size=8 — so admissions hit the radix prefix index and
+    # share refcounted blocks across slots while the fault phases below
+    # kill replicas and preempt under KV pressure
+    sys_prefix = [17, 23, 5, 42, 99, 7, 130, 61,
+                  11, 3, 88, 150, 29, 76, 44, 9]
+
     def worker(name: str, count: int, max_new: int = 6,
                until: "threading.Event | None" = None) -> None:
         """Issue ``count`` requests (or keep issuing until ``until``
@@ -1449,8 +1472,8 @@ def run_serve_fault_soak(workdir: str, seed: int = 2024,
         while (n < count) if until is None else (not until.is_set()):
             rid = f"{name}-{n}"
             n += 1
-            tokens = [wrng.randrange(4, 200)
-                      for _ in range(wrng.randrange(5, 11))]
+            tokens = sys_prefix + [wrng.randrange(4, 200)
+                                   for _ in range(wrng.randrange(5, 11))]
             with res_lock:
                 submitted.append(rid)
             deadline = time.monotonic() + 120.0
@@ -1560,6 +1583,7 @@ def run_serve_fault_soak(workdir: str, seed: int = 2024,
         probe = {"tokens": [9, 8, 7, 6, 5], "max_new_tokens": 4,
                  "request_id": "probe-cache"}
         exactly_once = False
+        resume_parity = False
         for probe_ep in endpoints():
             try:
                 r1 = _requests.post(f"{probe_ep}/generate", json=probe,
@@ -1572,13 +1596,22 @@ def run_serve_fault_soak(workdir: str, seed: int = 2024,
                 exactly_once = (second.get("cached") is True
                                 and second.get("tokens")
                                 == first.get("tokens"))
+                # resume-by-id (ISSUE 17): GET /result/{id} must return
+                # the identical token sequence from the completed cache
+                r3 = _requests.get(f"{probe_ep}/result/probe-cache",
+                                   timeout=60)
+                resume_parity = (r3.status_code == 200
+                                 and r3.json().get("tokens")
+                                 == first.get("tokens"))
                 break
             except _requests.RequestException:
                 continue
 
         # -- cooldown: tail requests in flight while the drain begins -----
+        # max_new=30: longest prompt (16 shared + 11 tail) + 30 stays
+        # within max_seq_len=64 even with the shared-prefix traffic
         tails = [threading.Thread(target=worker,
-                                  args=(f"tail{i}", 1, 40), daemon=True)
+                                  args=(f"tail{i}", 1, 30), daemon=True)
                  for i in range(2)]
         for t in tails:
             t.start()
@@ -1590,6 +1623,23 @@ def run_serve_fault_soak(workdir: str, seed: int = 2024,
             if len(live_serve_pods()) == 1 and agent.autoscale_drains:
                 break
             time.sleep(0.5)
+
+        # KV-safety audit (ISSUE 17): ask every surviving engine for its
+        # allocator audit counter. A replica kill or KV-pressure
+        # preemption that freed a block still referenced by a live
+        # sharer would have tripped a refcount underflow / double-free
+        # and incremented this — the exit gate pins it at exactly 0.
+        kv_audit = 0
+        live_stats = 0
+        prefix_hits_live = 0
+        for ep in endpoints():
+            try:
+                st = _requests.get(f"{ep}/stats", timeout=5).json()
+            except (_requests.RequestException, ValueError):
+                continue  # killed/drained replica's endpoint file
+            live_stats += 1
+            kv_audit += int(st.get("kv_audit_violations", 0))
+            prefix_hits_live += int(st.get("prefix_cache_hits", 0))
 
         scrape = store.metrics.render()
         from polyaxon_tpu.obs.metrics import parse_prometheus
@@ -1627,6 +1677,16 @@ def run_serve_fault_soak(workdir: str, seed: int = 2024,
                 >= max(int(0.9 * len(accepted)), 1),
             "no_duplicate_applies":
                 not agent.cluster.duplicate_applies,
+            # prefix-shared paged KV under faults (ISSUE 17): the audit
+            # counter is the hard safety gate — kills + preemptions must
+            # never free a live sharer's blocks; hits prove the shared
+            # fleet traffic actually exercised the radix index, on the
+            # live engine and through the store's heartbeat bridge
+            "kv_audit_zero": live_stats >= 1 and kv_audit == 0,
+            "prefix_sharing_exercised": prefix_hits_live >= 1,
+            "scrape_prefix_hits": fam(
+                "polyaxon_serve_prefix_cache_hits_total") >= 1,
+            "resume_by_id_parity": resume_parity,
         }
         return {
             "ok": all(checks.values()),
@@ -1637,6 +1697,8 @@ def run_serve_fault_soak(workdir: str, seed: int = 2024,
             "kills": kills,
             "drains": list(agent.autoscale_drains),
             "launch_counts": dict(agent.cluster.launch_counts),
+            "kv_audit_violations": kv_audit,
+            "prefix_cache_hits_live": prefix_hits_live,
             "metrics_text": scrape,
         }
     finally:

@@ -1,6 +1,7 @@
-"""Online-inference load generator (ISSUE 9 CI satellite).
+"""Online-inference load generator (ISSUE 9 CI satellite; serving raw
+speed modes added by ISSUE 17).
 
-Two layers, one JSON artifact (bench_artifacts/serve_bench_rXX.json):
+Layers, one JSON artifact (bench_artifacts/serve_bench_rXX.json):
 
 - **Engine sweep** (default): llama-tiny on CPU, a concurrency sweep over
   the continuous-batching engine — for each width C: ``requests`` prompts
@@ -9,6 +10,18 @@ Two layers, one JSON artifact (bench_artifacts/serve_bench_rXX.json):
   baseline (one request holds the engine end-to-end), so
   ``batched_vs_sequential`` is the honest iteration-level-batching win:
   same engine, same kernels, only the batch width changes.
+- **Prefix sharing** (``--prefix-share``): a fleet of concurrent requests
+  sharing one long system prompt, measured against the identical engine
+  with the prefix cache disabled (every request re-prefills the prompt).
+  Reports TTFT p50/p95 both ways, the TTFT speedup, and the EXTRA KV
+  blocks each request allocated beyond the shared prefix — fully-shared
+  prompt blocks must cost zero new blocks per request.
+- **Speculative decoding** (``--speculative``): plain decode vs
+  draft-propose/target-verify on an identity-extended target (the draft
+  plus zeroed residual layers — bit-identical logits at a deeper-model
+  per-layer cost, so acceptance is ~100% and the speedup is the honest
+  fewer-target-dispatches win). Reports tokens/s both ways, the speedup,
+  and the measured acceptance rate.
 - **Orchestrated probe** (``--orchestrated``): the same numbers read from
   a REAL `kind: service` run's own outputs and the control plane's
   ``/metrics`` scrape — store → agent → operator pod → serve runtime →
@@ -17,10 +30,12 @@ Two layers, one JSON artifact (bench_artifacts/serve_bench_rXX.json):
 
 Usage:
     python scripts/serve_bench.py [--requests N] [--max-new M]
-        [--prompt-len P] [--sweep 1,2,4,8] [--orchestrated] [--out PATH]
+        [--prompt-len P] [--sweep 1,2,4,8] [--prefix-share]
+        [--speculative] [--orchestrated] [--out PATH]
 
-Importable: ``run_engine_bench(...)`` / ``run_sweep(...)`` return the same
-dicts — the tier-1 smoke runs a scaled-down sweep through them.
+Importable: ``run_engine_bench(...)`` / ``run_sweep(...)`` /
+``run_prefix_share_bench(...)`` / ``run_speculative_bench(...)`` return
+the same dicts — the tier-1 smokes run scaled-down configs through them.
 """
 
 from __future__ import annotations
@@ -120,6 +135,172 @@ def run_sweep(widths=(1, 2, 4, 8), **kw) -> dict:
         "platform": "cpu",
         "rows": rows,
         "batched_vs_sequential": round(widest / base, 2) if base else None,
+    }
+
+
+def run_prefix_share_bench(*, requests: int = 64, sys_len: int = 1024,
+                           tail_len: int = 8, max_new: int = 8,
+                           block_size: int = 16, prefill_chunk: int = 32,
+                           seed: int = 0, best_of: int = 3,
+                           params=None, cfg=None) -> dict:
+    """Shared-system-prompt fleet: ``requests`` concurrent prompts that
+    all start with the same ``sys_len``-token system prompt (distinct
+    ``tail_len`` tails). Runs the identical workload twice — prefix cache
+    warmed vs disabled — and reports TTFT both ways plus the extra KV
+    blocks each sharing request allocated beyond the shared prefix.
+    ``best_of`` repeats each side on a fresh engine and keeps the best
+    (min p50) repeat, so a CI scheduling hiccup can't fail the smoke."""
+    import jax
+    import numpy as np
+
+    from polyaxon_tpu.models import REGISTRY, transformer as T
+    from polyaxon_tpu.serve.engine import SamplingParams, ServeEngine
+
+    if cfg is None:
+        _, cfg = REGISTRY["llama-tiny"]
+    if params is None:
+        params = T.init(jax.random.PRNGKey(seed), cfg)
+    rng = np.random.default_rng(seed)
+    sys_prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, sys_len)]
+    prompts = [sys_prompt
+               + [int(t) for t in rng.integers(1, cfg.vocab_size, tail_len)]
+               for _ in range(requests)]
+    max_seq = sys_len + tail_len + max_new + block_size
+    sp = SamplingParams(max_new_tokens=max_new)
+
+    def _drive(eng, reqs):
+        while not all(r.state in ("done", "failed") for r in reqs):
+            eng.step()
+
+    def _measure(enable_prefix_cache: bool) -> dict:
+        best = None
+        for _ in range(max(best_of, 1)):
+            eng = ServeEngine(params, cfg, max_slots=requests,
+                              block_size=block_size,
+                              prefill_chunk=prefill_chunk,
+                              max_seq_len=max_seq,
+                              enable_prefix_cache=enable_prefix_cache)
+            # warm request compiles the shapes AND (shared side) publishes
+            # the system prompt's blocks into the prefix index
+            _drive(eng, [eng.submit(sys_prompt, sp)])
+            s0 = eng.snapshot()
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, sp) for p in prompts]
+            _drive(eng, reqs)
+            wall = time.perf_counter() - t0
+            assert all(r.state == "done" for r in reqs)
+            s1 = eng.snapshot()
+            ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+            hits = s1["prefix_cache_hits"] - s0["prefix_cache_hits"]
+            misses = s1["prefix_cache_misses"] - s0["prefix_cache_misses"]
+            row = {
+                "ttft_p50_ms": round(_quant(ttfts, 0.5) * 1e3, 2),
+                "ttft_p95_ms": round(_quant(ttfts, 0.95) * 1e3, 2),
+                "wall_s": round(wall, 3),
+                "prefix_hits": hits,
+                "prefix_misses": misses,
+                # prompt blocks each request allocated (and prefilled)
+                # itself; a fully-shared prefix block costs zero
+                "extra_kv_blocks_per_request": round(misses / requests, 3),
+                "cow_copies": s1["cow_copies"] - s0["cow_copies"],
+                "kv_audit_violations": s1["kv_audit_violations"],
+            }
+            if best is None or row["ttft_p50_ms"] < best["ttft_p50_ms"]:
+                best = row
+        return best
+
+    shared = _measure(True)
+    baseline = _measure(False)
+    shared_blocks = sys_len // block_size
+    return {
+        "kind": "prefix_share_bench",
+        "requests": requests,
+        "sys_len": sys_len,
+        "tail_len": tail_len,
+        "max_new": max_new,
+        "block_size": block_size,
+        "shared_prefix_blocks": shared_blocks,
+        "shared": shared,
+        "reprefill": baseline,
+        "ttft_p50_speedup": round(
+            baseline["ttft_p50_ms"] / max(shared["ttft_p50_ms"], 1e-9), 2),
+        "ttft_p95_speedup": round(
+            baseline["ttft_p95_ms"] / max(shared["ttft_p95_ms"], 1e-9), 2),
+    }
+
+
+def run_speculative_bench(*, requests: int = 4, prompt_len: int = 32,
+                          max_new: int = 96, spec_k: int = 6,
+                          target_layers_mult: int = 32,
+                          block_size: int = 16, seed: int = 0,
+                          best_of: int = 3) -> dict:
+    """Plain decode vs speculative decode on an identity-extended target:
+    the target is llama-tiny plus zeroed residual layers (bit-identical
+    logits, ``target_layers_mult``× the per-token layer cost), the draft
+    is plain llama-tiny — so acceptance is ~100% and the speedup measures
+    exactly what speculation buys: one target dispatch per accepted
+    window instead of one per token."""
+    import jax
+    import numpy as np
+
+    from polyaxon_tpu.models import REGISTRY, transformer as T
+    from polyaxon_tpu.serve.engine import SamplingParams, ServeEngine
+    from polyaxon_tpu.serve.model import extend_with_identity_layers
+
+    _, cfg = REGISTRY["llama-tiny"]
+    params = T.init(jax.random.PRNGKey(seed), cfg)
+    big_params, big_cfg = extend_with_identity_layers(
+        params, cfg, cfg.num_layers * (target_layers_mult - 1))
+    rng = np.random.default_rng(seed)
+    max_seq = prompt_len + max_new + spec_k + block_size
+    sp = SamplingParams(max_new_tokens=max_new)
+
+    def _drive(eng, reqs):
+        while not all(r.state in ("done", "failed") for r in reqs):
+            eng.step()
+
+    def _measure(**spec_kw) -> tuple:
+        eng = ServeEngine(big_params, big_cfg, max_slots=requests,
+                          block_size=block_size,
+                          prefill_chunk=min(prompt_len, 32),
+                          max_seq_len=max_seq, **spec_kw)
+        _drive(eng, [eng.submit(
+            [int(t) for t in rng.integers(1, cfg.vocab_size, prompt_len)],
+            sp) for _ in range(2)])
+        best = 0.0
+        for _ in range(max(best_of, 1)):
+            prompts = [[int(t) for t in
+                        rng.integers(1, cfg.vocab_size, prompt_len)]
+                       for _ in range(requests)]
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, sp) for p in prompts]
+            _drive(eng, reqs)
+            wall = time.perf_counter() - t0
+            assert all(r.state == "done" for r in reqs)
+            tokens = sum(len(r.out_tokens) for r in reqs)
+            best = max(best, tokens / wall)
+        return best, eng.snapshot()
+
+    plain_tps, _ = _measure()
+    spec_tps, snap = _measure(draft_params=params, draft_cfg=cfg,
+                              spec_k=spec_k)
+    proposed = snap["spec_tokens_proposed"]
+    accepted = snap["spec_tokens_accepted"]
+    return {
+        "kind": "speculative_bench",
+        "requests": requests,
+        "prompt_len": prompt_len,
+        "max_new": max_new,
+        "spec_k": spec_k,
+        "target_layers": big_cfg.num_layers,
+        "draft_layers": cfg.num_layers,
+        "plain_tokens_per_sec": round(plain_tps, 2),
+        "spec_tokens_per_sec": round(spec_tps, 2),
+        "speedup": round(spec_tps / max(plain_tps, 1e-9), 2),
+        "tokens_proposed": proposed,
+        "tokens_accepted": accepted,
+        "acceptance_rate": round(accepted / max(proposed, 1), 4),
+        "kv_audit_violations": snap["kv_audit_violations"],
     }
 
 
@@ -232,6 +413,13 @@ def main() -> None:
     p.add_argument("--max-new", type=int, default=32)
     p.add_argument("--prompt-len", type=int, default=24)
     p.add_argument("--sweep", default="1,2,4,8")
+    p.add_argument("--prefix-share", action="store_true",
+                   help="shared-system-prompt fleet vs re-prefill baseline")
+    p.add_argument("--speculative", action="store_true",
+                   help="speculative decoding vs plain decode")
+    p.add_argument("--spec-k", type=int, default=6)
+    p.add_argument("--sys-len", type=int, default=1024)
+    p.add_argument("--share-requests", type=int, default=64)
     p.add_argument("--orchestrated", action="store_true",
                    help="also probe a real service run (outputs + scrape)")
     p.add_argument("--out", default=None)
@@ -240,6 +428,14 @@ def main() -> None:
     widths = tuple(int(w) for w in args.sweep.split(","))
     out = run_sweep(widths, requests=args.requests,
                     prompt_len=args.prompt_len, max_new=args.max_new)
+    if args.prefix_share:
+        out["prefix_share"] = run_prefix_share_bench(
+            requests=args.share_requests, sys_len=args.sys_len)
+    if args.speculative:
+        # the speculative bench keeps its own max_new default: its
+        # measurement window must be long enough to amortize warmup,
+        # independent of the sweep's per-request token count
+        out["speculative"] = run_speculative_bench(spec_k=args.spec_k)
     if args.orchestrated:
         out["orchestrated"] = run_orchestrated_probe(
             requests=min(args.requests, 8), max_new=args.max_new)

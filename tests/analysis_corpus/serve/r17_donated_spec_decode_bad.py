@@ -1,0 +1,41 @@
+"""R6 reproducer — the ISSUE 17 speculative-verify class: the target's
+batched verify step donates the paged KV pools (they are rewritten in
+place with the verify window's K/V), and the engine keeps reading the
+OLD pool handles afterwards — e.g. a host-side acceptance audit or a
+COW copy sourced from the donated array. XLA:CPU may decline the
+donation so tests pass; on TPU the read returns garbage, which
+corrupts every sequence sharing those prefix blocks."""
+
+import jax
+import jax.numpy as jnp
+
+
+def speculative_verify_loop(params, k_pool, v_pool, windows):
+    verify = jax.jit(_verify_step, donate_argnums=(1, 2))
+    accepted = []
+    for tokens in windows:
+        logits, new_k, new_v = verify(params, k_pool, v_pool, tokens)
+        # BAD: `k_pool`/`v_pool` were donated to the call above — this
+        # host-side readback (an "acceptance audit" of the window's
+        # cached keys) is use-after-free on TPU
+        accepted.append(jnp.sum(k_pool[0]) + jnp.sum(v_pool[0]))
+        k_pool, v_pool = new_k, new_v
+    return k_pool, v_pool, accepted
+
+
+def _verify_step(params, k_pool, v_pool, tokens):
+    return tokens, k_pool, v_pool
+
+
+def cow_from_donated(params, k_pool, v_pool, tokens, dst, src):
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def verify_step(p, kp, vp, tok):
+        return tok, kp, vp
+
+    logits, new_k, new_v = verify_step(params, k_pool, v_pool, tokens)
+    # BAD: copy-on-write sourced from the donated pool — the block being
+    # "preserved" for the forked sharer is already invalidated
+    new_k = new_k.at[dst].set(k_pool[src])
+    return logits, new_k, new_v

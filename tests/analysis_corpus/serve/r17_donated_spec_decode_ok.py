@@ -1,0 +1,37 @@
+"""R6 clean twin — the sanctioned speculative-verify idioms: the
+donated pool names are rebound by the verify call's own assignment
+(``logits, k_pool, v_pool = verify(...)``), and anything the host needs
+from the pre-verify pools (the COW source block, audit sums) is read
+BEFORE the donating call or threaded through the jitted function."""
+
+import jax
+import jax.numpy as jnp
+
+
+def speculative_verify_loop(params, k_pool, v_pool, windows):
+    verify = jax.jit(_verify_step, donate_argnums=(1, 2))
+    accepted = []
+    for tokens in windows:
+        # read BEFORE donation: fine
+        accepted.append(jnp.sum(k_pool[0]) + jnp.sum(v_pool[0]))
+        logits, k_pool, v_pool = verify(params, k_pool, v_pool, tokens)
+    return k_pool, v_pool, accepted
+
+
+def _verify_step(params, k_pool, v_pool, tokens):
+    return tokens, k_pool, v_pool
+
+
+def cow_then_verify(params, k_pool, v_pool, tokens, dst, src):
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def verify_step(p, kp, vp, tok):
+        return tok, kp, vp
+
+    # the COW copy happens inside the pre-call pools (functional .at
+    # update), and the verify call rebinds both donated names
+    k_pool = k_pool.at[dst].set(k_pool[src])
+    v_pool = v_pool.at[dst].set(v_pool[src])
+    logits, k_pool, v_pool = verify_step(params, k_pool, v_pool, tokens)
+    return logits, k_pool, v_pool

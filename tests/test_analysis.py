@@ -93,6 +93,10 @@ BAD_CASES = [
     # ISSUE 16 federation: wall-clock cluster-health staleness (an NTP
     # step declares every live cluster lost and re-places its work)
     ("clock", "federation/r16_wall_clock_cluster_health_bad.py", 2),
+    # ISSUE 17 speculative verify: host reads of the paged KV pools
+    # after they were donated to the jitted verify step (the PR-8
+    # donated-reuse class on the serving fast path)
+    ("donation", "serve/r17_donated_spec_decode_bad.py", 2),
 ]
 
 OK_TWINS = [
@@ -106,6 +110,7 @@ OK_TWINS = [
     "api/r14_asyncblock_sse_ok.py",
     "tenancy/r15_monotonic_bucket_ok.py",
     "federation/r16_wall_clock_cluster_health_ok.py",
+    "serve/r17_donated_spec_decode_ok.py",
 ]
 
 

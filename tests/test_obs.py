@@ -102,6 +102,15 @@ EXPECTED_FAMILIES = {
     "polyaxon_cluster_chips",
     "polyaxon_cluster_spillovers_total",
     "polyaxon_cluster_failovers_total",
+    # serving raw speed (ISSUE 17): prefix-shared paged KV (radix cache
+    # hit/miss, live shared blocks, COW copies) and speculative decoding
+    # (proposed/accepted draft tokens) — bridged from serve heartbeats
+    "polyaxon_serve_prefix_cache_hits_total",
+    "polyaxon_serve_prefix_cache_misses_total",
+    "polyaxon_serve_shared_kv_blocks",
+    "polyaxon_serve_cow_copies_total",
+    "polyaxon_serve_spec_tokens_proposed_total",
+    "polyaxon_serve_spec_tokens_accepted_total",
 }
 
 
