@@ -3,6 +3,7 @@ catalog with per-rule provenance)."""
 
 from .blocking_async import BlockingAsyncRule
 from .clock import ClockRule
+from .crossshard import CrossShardRule
 from .donation import DonationRule
 from .fence import FenceRule
 from .lockorder import LockOrderRule
@@ -15,7 +16,9 @@ ALL_RULES = (
     ClockRule,          # R4 — wall clock in lease arithmetic (PR 1/4)
     MetricsContractRule,  # R5 — metrics contract drift (PR 5/7)
     DonationRule,       # R6 — donated-buffer reuse (PR 8)
+    CrossShardRule,     # R7 — cross-shard verb in a held shard txn (PR 18)
 )
 
 __all__ = ["ALL_RULES", "FenceRule", "LockOrderRule", "BlockingAsyncRule",
-           "ClockRule", "MetricsContractRule", "DonationRule"]
+           "ClockRule", "MetricsContractRule", "DonationRule",
+           "CrossShardRule"]

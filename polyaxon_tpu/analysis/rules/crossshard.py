@@ -1,0 +1,154 @@
+"""R7 — cross-shard store verbs inside another shard's transaction scope.
+
+Bug-class provenance (ISSUE 18, sharded store): the run space is
+partitioned over K independent SQLite backends, each with its own writer
+lock and its own ``_conn_ctx()`` write transaction. The moment two
+shards exist, a new hazard class exists with them: code that opens shard
+A's transaction and then — while A's writer lock is held — reaches into
+shard B (a nested ``B._conn_ctx()``, or any routed store verb on B,
+which opens B's transaction internally). Two such paths with opposite
+shard orders deadlock exactly like the PR-6 lock-order class, except the
+"locks" are per-shard SQLite writer locks the static lock graph (R2)
+cannot see — they live behind sqlite3, not ``threading``. Even a single
+such path is a correctness smell: the outer shard's transaction is
+neither isolated from nor atomic with the inner one, so a crash between
+the two commits splits what the author thought was one atomic step
+(why ``ShardedStore._split_fence`` documents verify-then-strip as
+explicitly non-atomic and keeps the cross-shard read OUTSIDE the target
+shard's transaction).
+
+The discipline this rule enforces: finish (or never start) shard A's
+transaction before touching shard B. Route first, then transact —
+per-shard sub-batches each open exactly one backend's transaction.
+
+Detection, per ``with <X>._conn_ctx()`` block (module- and class-level,
+same execution context only — nested defs/lambdas run later, typically
+after release):
+
+- a nested ``with <Y>._conn_ctx()`` where ``Y`` is not syntactically the
+  same receiver as ``X`` is a finding (holding one shard's writer lock
+  while opening another's);
+- a call ``<Y>.<verb>(...)`` where ``verb`` is a store verb that opens
+  its own transaction and ``Y`` differs from ``X`` is a finding (the
+  verb will open ``Y``'s transaction under ``X``'s lock).
+
+Receivers are compared by their unparsed source text: ``self`` ==
+``self``, ``home`` != ``target``, ``self._shards[i]`` !=
+``self._shards[j]``. Two spellings of the same object (aliasing) are
+invisible — like R2, this rule proposes and the chaos soak witnesses.
+Same-receiver calls stay allowed: a store method calling its own
+helpers inside its own transaction is the normal single-shard shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..engine import Finding, Project, Rule
+
+#: store verbs that open their OWN write/read transaction when called —
+#: invoking one on shard B while holding shard A's ``_conn_ctx`` nests
+#: B's transaction under A's writer lock
+STORE_VERBS = frozenset({
+    "create_run", "create_runs", "transition", "transition_many",
+    "update_run", "merge_outputs", "heartbeat", "delete_run",
+    "get_run", "get_runs", "list_runs", "count_runs", "get_statuses",
+    "acquire_lease", "renew_lease", "renew_leases", "release_lease",
+    "record_launch_intent", "mark_launched", "adopt_launch",
+    "get_changelog", "apply_changelog", "changelog_span", "snapshot",
+    "promote", "claim_config", "set_config", "get_config",
+    "serve_traffic", "annotate_status", "find_cached_run",
+})
+
+
+def _receiver_src(expr: ast.AST) -> Optional[str]:
+    """Source text of a receiver expression (``self._shards[i]``,
+    ``home``, ...) for syntactic same-object comparison; None for
+    receivers too dynamic to render (calls, comprehensions...)."""
+    if isinstance(expr, (ast.Name, ast.Attribute, ast.Subscript)):
+        try:
+            return ast.unparse(expr)
+        except Exception:
+            return None
+    return None
+
+
+def _conn_ctx_receiver(expr: ast.AST) -> Optional[str]:
+    """``X`` when ``expr`` is ``X._conn_ctx()``, else None."""
+    if (isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "_conn_ctx"):
+        return _receiver_src(expr.func.value)
+    return None
+
+
+def _walk_same_context(node):
+    """``node`` + descendants, excluding nested function/lambda/class
+    bodies — a closure bound under the hold runs later (usually after
+    release); flagging its calls would fabricate findings."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda, ast.ClassDef)):
+        return
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_same_context(child)
+
+
+class CrossShardRule(Rule):
+    name = "crossshard"
+    title = "cross-shard store verb inside another shard's transaction"
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for w in ast.walk(sf.tree):
+                if not isinstance(w, ast.With):
+                    continue
+                holders = [_conn_ctx_receiver(item.context_expr)
+                           for item in w.items]
+                holders = [h for h in holders if h is not None]
+                if not holders:
+                    continue
+                self._scan_hold(sf, w, holders, findings)
+        return findings
+
+    def _scan_hold(self, sf, w: ast.With, holders: list[str],
+                   findings: list[Finding]) -> None:
+        for stmt in w.body:
+            for sub in _walk_same_context(stmt):
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        inner = _conn_ctx_receiver(item.context_expr)
+                        if inner is not None and inner not in holders:
+                            findings.append(Finding(
+                                rule=self.name, path=sf.rel,
+                                line=sub.lineno,
+                                message=(
+                                    f"nested {inner}._conn_ctx() while "
+                                    f"holding {holders[0]}'s transaction"
+                                    " — one shard's writer lock held "
+                                    "while opening another's (deadlock "
+                                    "order hazard; finish or never "
+                                    "start the outer transaction "
+                                    "first)"),
+                            ))
+                elif (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in STORE_VERBS):
+                    recv = _receiver_src(sub.func.value)
+                    if recv is None or recv in holders:
+                        continue
+                    findings.append(Finding(
+                        rule=self.name, path=sf.rel, line=sub.lineno,
+                        message=(
+                            f"store verb {recv}.{sub.func.attr}() "
+                            f"inside {holders[0]}'s transaction scope — "
+                            "the verb opens its own transaction under "
+                            "the held shard's writer lock; route the "
+                            "call outside the hold (per-shard "
+                            "sub-batches open exactly one backend's "
+                            "transaction)"),
+                    ))

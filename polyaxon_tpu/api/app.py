@@ -355,6 +355,9 @@ class ApiApp:
                 "epoch": self.store.current_epoch(),
                 "read_only": bool(getattr(self.store, "read_only", False)),
                 "degraded": getattr(self.store, "degraded", None),
+                # sharded backend (ISSUE 18): 0 = single-file store
+                "store_num_shards": int(getattr(
+                    self.store, "store_num_shards", 0) or 0),
             },
         })
 
@@ -522,10 +525,32 @@ class ApiApp:
 
     async def get_snapshot(self, request):
         """Crash-consistent store snapshot (standby bootstrap): streams
-        snapshot.db with its sha256/seq/epoch manifest in headers."""
+        snapshot.db with its sha256/seq/epoch manifest in headers.
+        Against a sharded store (ISSUE 18) pass ``?shard=i`` to stream
+        shard i's snapshot.db; omitting it is a 400 carrying
+        ``num_shards`` so the client can iterate — there is no single
+        whole-fleet DB file to stream."""
         import shutil
         import time as _time
         import uuid as _uuid
+
+        backends = getattr(self.store, "backends", None)
+        snap_store = self.store
+        if backends is not None:
+            raw = request.rel_url.query.get("shard")
+            if raw is None:
+                return _json(
+                    {"error": "sharded store: pass ?shard=i",
+                     "num_shards": len(backends)}, status=400)
+            try:
+                idx = int(raw)
+                snap_store = backends[idx] if idx >= 0 else None
+            except (ValueError, IndexError):
+                snap_store = None
+            if snap_store is None:
+                return _json(
+                    {"error": f"shard {raw!r} out of range",
+                     "num_shards": len(backends)}, status=400)
 
         # per-request dir: two concurrent bootstraps must not race one
         # shared snapshot.db (headers from one body from the other);
@@ -543,7 +568,7 @@ class ApiApp:
                         shutil.rmtree(p, ignore_errors=True)
                 except OSError:
                     pass
-            return self.store.snapshot(snap_dir)
+            return snap_store.snapshot(snap_dir)
 
         # off the event loop: the backup+sha256 is O(whole DB), and
         # stalling the loop for it would silence /api/v1/changelog long

@@ -99,6 +99,9 @@ def restore_snapshot(dirpath: str, store: Store) -> dict:
         store._epoch = int(row[0]) if row else 0
         row = conn.execute("SELECT MAX(seq) FROM changelog").fetchone()
         store._applied_seq = int(row[0]) if row and row[0] else 0
+    # the restore replaced the run table wholesale behind the write
+    # path's back — the count_runs row counters must re-derive
+    store._count_invalidate()
     return manifest
 
 
@@ -527,7 +530,25 @@ def snapshot_to(store: Store, dirpath: str,
     :class:`~polyaxon_tpu.api.store.CompactedLogError` (re-bootstrap from
     the snapshot) instead of silently skipping the pruned writes. The
     default margin covers any standby within ~10k rows of the head;
-    ``keep < 0`` disables pruning (snapshot only)."""
+    ``keep < 0`` disables pruning (snapshot only).
+
+    A sharded store (ISSUE 18: it exposes ``.backends``) compacts per
+    backend into ``shard-NN/`` subdirs — each shard keeps its OWN
+    ``keep``-row tail and records its own floor, so a lagging tailer of
+    the stitched feed 410s on exactly the shard component it fell behind
+    on. ``ChangelogCompactor`` therefore works on either implementation
+    unchanged."""
+    backends = getattr(store, "backends", None)
+    if backends is not None:
+        manifests = [
+            snapshot_to(b, os.path.join(dirpath, f"shard-{i:02d}"),
+                        keep=keep)
+            for i, b in enumerate(backends)]
+        from .sharded_store import pack_seqs
+
+        return {"num_shards": len(backends), "shards": manifests,
+                "seq": pack_seqs([m["seq"] for m in manifests]),
+                "epoch": sum(m.get("epoch", 0) for m in manifests)}
     manifest = store.snapshot(dirpath)
     if keep >= 0:
         floor = manifest["seq"] - keep

@@ -120,15 +120,30 @@ def main() -> None:
                    help="changelog compaction interval (snapshot + prune, "
                         "keeping a 10k-row tail margin); <=0 disables — "
                         "the changelog then grows one row per write")
+    p.add_argument("--store-shards", type=int, default=0,
+                   help="partition the run space over K independent "
+                        "SQLite shards (ISSUE 18), each with its own "
+                        "writer lock — --db becomes a DIRECTORY of "
+                        "shard-NN.sqlite files. 0 keeps the single-file "
+                        "store. The shard count is claimed first-writer-"
+                        "wins in the store config; reopening with a "
+                        "different K is refused")
     args = p.parse_args()
     import os as _os
 
+    store = None
+    if args.store_shards > 0:
+        from .sharded_store import ShardedStore
+
+        store = ShardedStore(args.db, shards=args.store_shards)
     server = ApiServer(
         args.db, args.artifacts_root, args.host, args.port,
+        store=store,
         rate_limit=(args.rate_limit if args.rate_limit > 0 else None),
         rate_limit_burst=(args.rate_limit_burst
                           if args.rate_limit_burst > 0 else None))
-    data_dir = _os.path.dirname(args.db) or "."
+    data_dir = (args.db if args.store_shards > 0
+                else _os.path.dirname(args.db)) or "."
     standby = None
     if args.standby_of:
         from .replication import make_standby

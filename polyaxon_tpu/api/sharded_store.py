@@ -1,0 +1,732 @@
+"""Sharded server-backed store (ISSUE 18 tentpole): K independent
+SQLite backends behind the single-store verb surface.
+
+Every control-plane write used to serialize through ONE SQLite writer
+lock. :class:`ShardedStore` partitions the run space by the same
+``crc32(uuid) % K`` hash the agents already use (:func:`shard_index`),
+so each shard is a full :class:`~polyaxon_tpu.api.store.Store` — its own
+writer lock, its own commit-ordered ``change_seq`` changelog, its own
+epoch/fencing/snapshot machinery — and N agents stop convoying on one
+lock. The router keeps TODAY'S contract for every consumer:
+
+**Composite feed tokens.** Consumers of the change feed (SSE watchers,
+``?since=`` pollers, ``ReplicatedStandby``) compare and propagate
+INTEGER tokens. The stitched feed therefore packs the per-shard cursor
+vector into one integer — shard i's seq in bit field
+``[40*i, 40*(i+1))`` — and qualifies it with the SUM of the per-shard
+epochs. Each stitched event advances exactly one component, so tokens
+stay strictly monotone along the feed; any single shard promoting
+changes the epoch sum, so a pre-failover cursor is deterministically
+rejected (410) exactly like today. 40 bits per shard is ~10^12 writes
+per shard — decades at control-plane rates — and Python ints carry the
+K*40-bit composite losslessly (tokens travel as strings; the JSON
+``seq`` fields are arbitrary-precision for Python clients).
+
+**Stitching.** :meth:`get_changelog` k-way-merges the per-shard tails by
+``(created_at, shard_index)`` — deterministic for a given cursor,
+per-shard seq order preserved (within a shard, ``created_at`` is stamped
+under the writer lock, so the merge key respects seq order modulo a
+wall-clock step; cross-shard ordering is by stamp, same-process clocks).
+Every emitted record is re-sequenced to the composite cursor AFTER
+consuming it and carries ``shard``/``shard_seq``/``shard_epoch`` so
+:meth:`apply_changelog` can demux a stitched tail back into per-shard
+replays — a ``ReplicatedStandby`` whose target is another ShardedStore
+replicates through the stitched feed unchanged (HTTP or in-process).
+
+**Routing.** Run-scoped verbs go to the owning shard; ``create_runs`` /
+``transition_many`` split into per-shard transactions (PR 6's per-shard
+sub-batch fencing semantics: a rejected sub-batch fails alone);
+``list_runs``/``count_runs`` merge keyset pages across shards;
+projects/tokens/quotas/clusters/config and presence leases live on the
+designated META shard (backend 0 — which also owns its 1/K of the run
+space). ``shard-<i>`` lease rows live IN backend i, so the lifecycle
+fence check stays atomic with the guarded write (a run's store shard IS
+its agent shard once the fleet adopts this store's claimed
+``num_shards``). A fenced write whose lease lives on a DIFFERENT
+backend (e.g. a quota write fenced by a shard lease) is verified against
+the lease's home backend and then stripped: stale callers are still
+rejected, but the check is no longer in the guarded write's transaction
+— see docs/RESILIENCE.md for the honest scope of that fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid as uuid_mod
+from typing import Any, Optional
+
+from .store import (
+    CompactedLogError,
+    StaleEpochError,
+    Store,
+    StoreBackend,
+    shard_index,
+)
+
+#: bits per shard in the composite feed token (matches EPOCH_STRIDE's
+#: 40-bit seq field in lease fencing tokens: ~10^12 writes per shard)
+SHARD_SEQ_BITS = 40
+SHARD_SEQ_MASK = (1 << SHARD_SEQ_BITS) - 1
+
+
+def pack_seqs(seqs: list) -> int:
+    """Per-shard seq vector -> one composite integer (shard i in bit
+    field ``[40*i, 40*(i+1))``). Strictly monotone under single-component
+    advances, which is what makes the stitched feed's tokens comparable
+    with plain ``<`` by every existing consumer."""
+    v = 0
+    for i, s in enumerate(seqs):
+        s = int(s)
+        if s < 0 or s > SHARD_SEQ_MASK:
+            raise ValueError(f"shard seq {s} out of the 40-bit field")
+        v |= s << (SHARD_SEQ_BITS * i)
+    return v
+
+
+def unpack_seqs(v: int, num_shards: int) -> list[int]:
+    """Composite integer -> per-shard seq vector. Values <= 0 decode to
+    the all-zeros vector (the bootstrap cursor)."""
+    v = int(v)
+    if v <= 0:
+        return [0] * num_shards
+    return [(v >> (SHARD_SEQ_BITS * i)) & SHARD_SEQ_MASK
+            for i in range(num_shards)]
+
+
+def _run_scoped(name: str):
+    """Route a run-scoped verb to the uuid's owning shard, re-homing any
+    fence first (same-shard fences — the lifecycle hot path — pass
+    through untouched and stay transaction-atomic)."""
+
+    def _verb(self, run_uuid: str, *a: Any, **kw: Any) -> Any:
+        target = self._shard_of(run_uuid)
+        if kw.get("fence") is not None:
+            kw["fence"] = self._split_fence(target, kw["fence"])
+        return getattr(target, name)(run_uuid, *a, **kw)
+
+    _verb.__name__ = name
+    _verb.__qualname__ = f"ShardedStore.{name}"
+    _verb.__doc__ = f"Routed to the run's owning shard: Store.{name}."
+    return _verb
+
+
+def _meta_scoped(name: str):
+    """Route a control-plane verb (projects, tokens, quotas, clusters,
+    config) to the meta shard, re-homing any fence first."""
+
+    def _verb(self, *a: Any, **kw: Any) -> Any:
+        if kw.get("fence") is not None:
+            kw["fence"] = self._split_fence(self._meta, kw["fence"])
+        return getattr(self._meta, name)(*a, **kw)
+
+    _verb.__name__ = name
+    _verb.__qualname__ = f"ShardedStore.{name}"
+    _verb.__doc__ = f"Routed to the meta shard: Store.{name}."
+    return _verb
+
+
+class ShardedStore(StoreBackend):
+    """K :class:`Store` backends behind the single-store verb surface.
+
+    ``root`` is a directory (one ``shard-NN.sqlite`` per backend) or
+    ``":memory:"`` (tests/benches). The shard count is claimed into the
+    meta shard's config on first open and pinned: reopening a store
+    sharded at K with a different K is refused — the hash routing would
+    silently strand every row. The same claim seeds the fleet-wide
+    ``num_shards`` agent partition count, aligning agent shards with
+    store shards so ``shard-<i>`` fences check atomically on backend i.
+    """
+
+    #: satellite 1 (shard-scoped resync): agents probe this to learn the
+    #: store can scan a shard subset server-side instead of full-scanning
+    store_num_shards: int = 0
+
+    def __init__(self, root: str = ":memory:", shards: int = 4,
+                 metrics=None, replicate: bool = True):
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        self.path = root
+        self.num_shards = int(shards)
+        self.store_num_shards = self.num_shards
+        from ..obs.metrics import MetricsRegistry
+
+        # ONE registry across every backend: Store's peer-aggregation
+        # contract (counters SUM across _store_sources, epoch takes the
+        # max) gives the sharded deployment one pane of glass for free
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        paths: list[str]
+        if root == ":memory:":
+            paths = [":memory:"] * self.num_shards
+        else:
+            os.makedirs(root, exist_ok=True)
+            paths = [os.path.join(root, f"shard-{i:02d}.sqlite")
+                     for i in range(self.num_shards)]
+        self._shards: list[Store] = [
+            Store(p, metrics=self.metrics, replicate=replicate)
+            for p in paths]
+        self._meta = self._shards[0]
+        self._listener_lock = threading.Lock()
+        if root != ":memory:":
+            claimed = self._meta.claim_config(
+                "store_num_shards", str(self.num_shards))
+            if int(claimed) != self.num_shards:
+                raise ValueError(
+                    f"store at {root!r} was sharded at {claimed} backends; "
+                    f"reopening at {self.num_shards} would strand rows "
+                    "(crc32 routing) — use the original shard count")
+        # align the fleet's work partitions with the store's shards: the
+        # first writer wins, so a store opened before any agent pins
+        # num_shards == K and every shard-<i> fence checks on backend i
+        self._meta.claim_config("num_shards", str(self.num_shards))
+
+    # -- routing helpers ---------------------------------------------------
+
+    @property
+    def backends(self) -> list[Store]:
+        """The per-shard backends, index == shard index (backend 0 is
+        also the meta shard). Replication/compaction tooling iterates
+        this; everything else should go through the verbs."""
+        return list(self._shards)
+
+    def _shard_of(self, run_uuid: str) -> Store:
+        return self._shards[shard_index(run_uuid, self.num_shards)]
+
+    def _lease_home(self, name: str) -> Store:
+        """``shard-<i>`` leases live IN backend i (atomic lifecycle
+        fencing); presence and everything else live on the meta shard."""
+        if name and name.startswith("shard-"):
+            try:
+                i = int(name.rsplit("-", 1)[1])
+            except ValueError:
+                return self._meta
+            if 0 <= i < self.num_shards:
+                return self._shards[i]
+        return self._meta
+
+    def _split_fence(self, target: Store, fence):
+        """Re-home a fence for a write landing on ``target``. A fence
+        whose lease lives on ``target`` passes through (checked inside
+        the guarded transaction, exactly like the single store). A
+        CROSS-shard fence is verified against the lease's home backend
+        and then STRIPPED: the stale caller is still rejected
+        (StaleLeaseError), but check and write are two transactions — a
+        takeover landing exactly between them can let one guarded write
+        through. Only non-lifecycle writes (quota/config/cluster) can
+        hit this path; docs/RESILIENCE.md records the gap honestly."""
+        if fence is None:
+            return None
+        name = fence[0]
+        home = self._lease_home(name)
+        if home is target:
+            return fence
+        with home._conn_ctx() as conn:
+            home._check_fence(conn, fence)
+        return None
+
+    def _resolve_callable_fence(self, fence, run_uuid: Optional[str]):
+        if callable(fence):
+            return fence(run_uuid) if run_uuid else None
+        return fence
+
+    # -- feed tokens (composite vector) ------------------------------------
+
+    def _pack(self, seqs: list) -> int:
+        return pack_seqs(seqs)
+
+    def _unpack(self, v: int) -> list[int]:
+        return unpack_seqs(v, self.num_shards)
+
+    def current_epoch(self) -> int:
+        """SUM of the per-shard epochs: any single shard promoting
+        changes it, so every epoch-qualified token minted before that
+        failover is deterministically rejected (410)."""
+        return sum(b.current_epoch() for b in self._shards)
+
+    def current_seq(self) -> int:
+        """Composite of the per-shard committed seqs. Each component is
+        individually snapshot-consistent (an in-flight writer's rows land
+        after it), so a bootstrap from this token is loss-free."""
+        return self._pack([b.current_seq() for b in self._shards])
+
+    def feed_token(self, seq: int) -> str:
+        epoch = self.current_epoch()
+        return f"{epoch}:{seq}" if epoch else str(seq)
+
+    def parse_since(self, token) -> int:
+        """Validate a composite feed token against the CURRENT epoch sum
+        and return the composite seq (same contract as Store.parse_since:
+        bare ints are internal callers and skip the epoch check)."""
+        if isinstance(token, int):
+            return token
+        s = str(token)
+        if ":" in s:
+            e_str, _, seq_str = s.partition(":")
+            epoch, seq = int(e_str), int(seq_str)
+        else:
+            epoch, seq = 0, int(s)
+        current = self.current_epoch()
+        if epoch != current:
+            raise StaleEpochError(epoch, current)
+        return seq
+
+    def since_token(self, run: dict) -> str:
+        """Resume token for a row delivered by a ``since`` listing: the
+        composite cursor stamped onto the row at delivery (exact, loss-
+        free). Rows from other paths fall back to a token that replays
+        every OTHER shard from 0 — duplicate-heavy but never lossy."""
+        tok = run.get("_since_token")
+        if tok is not None:
+            return tok
+        vec = [0] * self.num_shards
+        vec[shard_index(run["uuid"], self.num_shards)] = run["change_seq"]
+        return self.feed_token(self._pack(vec))
+
+    run_cursor = staticmethod(Store.run_cursor)
+
+    # -- stitched changelog (the feed every consumer tails) ----------------
+
+    def get_changelog(self, after_seq: int = 0,
+                      limit: int = 500) -> list[dict]:
+        """Merge the per-shard changelogs after the composite cursor into
+        one totally-ordered page.
+
+        Deterministic k-way merge by ``(created_at, shard_index)`` over
+        the shard head rows; each emitted record advances exactly one
+        component of the cursor vector, so the re-sequenced composite
+        ``seq`` is strictly increasing along the page and across pages
+        resumed from any returned seq. A truncated shard page (exactly
+        ``limit`` rows came back) can never drain before the output page
+        fills, so the merge never emits past a shard's unfetched rows.
+        One shard's compacted tail raises :class:`CompactedLogError`
+        whose floor is the composite with THAT component at the shard's
+        floor — the 410 the tailer needs to re-bootstrap."""
+        vec = self._unpack(after_seq)
+        limit = int(limit)
+        pages: list[list[dict]] = []
+        for i, b in enumerate(self._shards):
+            try:
+                pages.append(b.get_changelog(vec[i], limit))
+            except CompactedLogError as e:
+                floor_vec = list(vec)
+                floor_vec[i] = e.floor
+                raise CompactedLogError(int(after_seq),
+                                        self._pack(floor_vec)) from e
+        heads = [0] * self.num_shards
+        epoch = self.current_epoch()
+        out: list[dict] = []
+        cur = list(vec)
+        while len(out) < limit:
+            best = None
+            for i, page in enumerate(pages):
+                if heads[i] >= len(page):
+                    continue
+                rec = page[heads[i]]
+                key = (rec["created_at"], i)
+                if best is None or key < best[0]:
+                    best = (key, i)
+            if best is None:
+                break
+            i = best[1]
+            rec = dict(pages[i][heads[i]])
+            heads[i] += 1
+            cur[i] = rec["seq"]
+            rec["shard"] = i
+            rec["shard_seq"] = rec["seq"]
+            rec["shard_epoch"] = rec["epoch"]
+            rec["seq"] = self._pack(cur)
+            # consumers compare the record epoch to current_epoch()
+            # (stream.py's epoch-flip detection): stitched records carry
+            # the SUM, like every other sharded epoch surface
+            rec["epoch"] = epoch
+            out.append(rec)
+        return out
+
+    def changelog_span(self) -> dict:
+        return {
+            "seq": self._pack([b.changelog_span()["seq"]
+                               for b in self._shards]),
+            "epoch": self.current_epoch(),
+        }
+
+    def apply_changelog(self, rows: list[dict]) -> int:
+        """Replay a STITCHED tail (a sharded standby's write path): demux
+        each record back to its shard by the ``shard``/``shard_seq``
+        markers the stitcher stamped, and replay per backend — each
+        backend keeps its own idempotent applied watermark."""
+        groups: dict[int, list[dict]] = {}
+        for rec in rows:
+            if "shard" not in rec:
+                raise ValueError(
+                    "apply_changelog on a ShardedStore needs stitched "
+                    "records (shard/shard_seq markers); got a raw row — "
+                    "replicate per backend via .backends instead")
+            groups.setdefault(int(rec["shard"]), []).append({
+                "seq": rec["shard_seq"],
+                "epoch": rec.get("shard_epoch", rec["epoch"]),
+                "op": rec["op"],
+                "payload": rec["payload"],
+                "created_at": rec["created_at"],
+            })
+        applied = 0
+        for i in sorted(groups):
+            applied += self._shards[i].apply_changelog(groups[i])
+        return applied
+
+    @property
+    def _applied_seq(self) -> int:
+        """Composite applied watermark (ReplicatedStandby reads this to
+        seed its cursor on attach/restart)."""
+        return self._pack([b._applied_seq for b in self._shards])
+
+    def promote(self) -> int:
+        """Promote every shard (epoch bump + lease wipe per backend);
+        returns the new epoch SUM. Single-shard failover (one backend
+        restored from its own snapshot/standby) bumps only that shard's
+        epoch — the sum still changes, so every composite token dies."""
+        for b in self._shards:
+            b.promote()
+        return self.current_epoch()
+
+    def snapshot(self, dirpath: str) -> dict:
+        """Per-shard snapshots under ``shard-NN/`` subdirs plus a
+        combined manifest (composite seq, epoch sum)."""
+        manifests = []
+        for i, b in enumerate(self._shards):
+            manifests.append(
+                b.snapshot(os.path.join(dirpath, f"shard-{i:02d}")))
+        return {
+            "num_shards": self.num_shards,
+            "shards": manifests,
+            "seq": self._pack([m["seq"] for m in manifests]),
+            "epoch": self.current_epoch(),
+            "created_at": manifests[0]["created_at"],
+        }
+
+    # -- run fan-out verbs -------------------------------------------------
+
+    def create_run(self, project: str, spec: Optional[dict] = None,
+                   name: Optional[str] = None, kind: Optional[str] = None,
+                   inputs: Optional[dict] = None, meta: Optional[dict] = None,
+                   tags: Optional[list] = None, uuid: Optional[str] = None,
+                   original_uuid: Optional[str] = None,
+                   cloning_kind: Optional[str] = None,
+                   pipeline_uuid: Optional[str] = None,
+                   created_by: Optional[str] = None,
+                   tenant: Optional[str] = None, fence=None) -> dict:
+        return self.create_runs(project, [dict(
+            spec=spec, name=name, kind=kind, inputs=inputs, meta=meta,
+            tags=tags, uuid=uuid, original_uuid=original_uuid,
+            cloning_kind=cloning_kind, pipeline_uuid=pipeline_uuid,
+            created_by=created_by, tenant=tenant,
+        )], fence=fence)[0]
+
+    def create_runs(self, project: str, runs: list[dict],
+                    fence=None) -> list[dict]:
+        """Split the batch into per-shard transactions by each entry's
+        (pre-assigned) uuid hash. Pipeline-parent inheritance
+        (created_by/tenant) resolves HERE, through routed lookups — the
+        parent may live on a different shard than its children, so the
+        backend's own same-db lookup can't be trusted with it."""
+        if callable(fence):
+            puid = next((r.get("pipeline_uuid") for r in runs
+                         if r.get("pipeline_uuid")), None)
+            fence = fence(puid) if puid else None
+        self._meta.create_project(project)
+        parents: dict[str, Optional[dict]] = {}
+        entries: list[dict] = []
+        for r in runs:
+            r = dict(r)
+            r["uuid"] = r.get("uuid") or uuid_mod.uuid4().hex
+            puid = r.get("pipeline_uuid")
+            if puid and (r.get("created_by") is None
+                         or r.get("tenant") is None):
+                if puid not in parents:
+                    parents[puid] = self.get_run(puid)
+                parent = parents[puid]
+                if parent:
+                    if r.get("created_by") is None:
+                        r["created_by"] = parent.get("created_by")
+                    if r.get("tenant") is None:
+                        r["tenant"] = parent.get("tenant")
+            entries.append(r)
+        groups: dict[int, list[dict]] = {}
+        for r in entries:
+            groups.setdefault(
+                shard_index(r["uuid"], self.num_shards), []).append(r)
+        by_uuid: dict[str, dict] = {}
+        for i in sorted(groups):
+            target = self._shards[i]
+            out = target.create_runs(
+                project, groups[i],
+                fence=self._split_fence(target, fence))
+            for row in out:
+                by_uuid[row["uuid"]] = row
+        return [by_uuid[r["uuid"]] for r in entries]
+
+    def transition(self, run_uuid: str, status: str,
+                   reason: Optional[str] = None,
+                   message: Optional[str] = None, force: bool = False,
+                   fence=None) -> tuple[Optional[dict], bool]:
+        # single-edge fast path: route straight to the owning backend —
+        # executor status callbacks fire this once per lifecycle edge
+        # across the whole fleet, and the batch-grouping machinery is
+        # pure overhead for one run
+        target = self._shards[shard_index(run_uuid, self.num_shards)]
+        return target.transition(
+            run_uuid, status, reason=reason, message=message, force=force,
+            fence=self._split_fence(
+                target, self._resolve_callable_fence(fence, run_uuid)))
+
+    def transition_many(self, transitions: list[tuple],
+                        fence=None) -> list[tuple[Optional[dict], bool]]:
+        """Per-shard sub-batches, one transaction each (PR 6 semantics:
+        a fence rejection fails only its shard's sub-batch — here the
+        split happens by STORE shard, and the error propagates to the
+        caller exactly like the single store's). Entry order is preserved
+        within each shard; results come back in input order."""
+        groups: dict[tuple, list[tuple[int, tuple]]] = {}
+        order: list[tuple] = []
+        for idx, t in enumerate(transitions):
+            si = shard_index(t[0], self.num_shards)
+            f = self._resolve_callable_fence(fence, t[0])
+            key = (si, f)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append((idx, t))
+        results: list = [None] * len(transitions)
+        for key in order:
+            si, f = key
+            target = self._shards[si]
+            out = target.transition_many(
+                [t for _, t in groups[key]],
+                fence=self._split_fence(target, f))
+            for (idx, _), r in zip(groups[key], out):
+                results[idx] = r
+        return results
+
+    def get_runs(self, uuids: list[str]) -> list[dict]:
+        groups: dict[int, list[str]] = {}
+        for u in uuids:
+            groups.setdefault(shard_index(u, self.num_shards), []).append(u)
+        by_uuid: dict[str, dict] = {}
+        for i, us in groups.items():
+            for row in self._shards[i].get_runs(us):
+                by_uuid[row["uuid"]] = row
+        return [by_uuid[u] for u in uuids if u in by_uuid]
+
+    def find_cached_run(self, project: str,
+                        cache_key: str) -> Optional[dict]:
+        for b in self._shards:
+            hit = b.find_cached_run(project, cache_key)
+            if hit is not None:
+                return hit
+        return None
+
+    # -- merged listings ---------------------------------------------------
+
+    def list_runs(self, project: Optional[str] = None,
+                  status: Optional[str] = None,
+                  pipeline_uuid: Optional[str] = None,
+                  limit: int = 100, offset: int = 0,
+                  statuses: Optional[list[str]] = None,
+                  created_by: Optional[str] = None,
+                  order: str = "desc", cursor: Optional[str] = None,
+                  since: Optional[str] = None,
+                  shards: Optional[list[int]] = None) -> list[dict]:
+        """Single-store listing semantics over K backends.
+
+        Keyset/offset mode merge-sorts per-shard pages by
+        ``(created_at, uuid)`` — each shard applies the same cursor
+        predicate, so the merged walk is the same total order the single
+        store serves. ``since`` mode walks the shards' deltas in shard
+        order, stamping each row's exact composite resume cursor
+        (consumed via :meth:`since_token`): a truncated page resumes
+        mid-shard, untouched shards replay from the caller's token —
+        loss-free either way. ``shards`` scopes the scan to those
+        backends only (satellite 1: an agent resyncing shard i reads
+        backend i, not K backends x the whole table)."""
+        filters = dict(project=project, status=status,
+                       pipeline_uuid=pipeline_uuid, statuses=statuses,
+                       created_by=created_by)
+        targets = (list(enumerate(self._shards)) if shards is None else
+                   [(i, self._shards[i]) for i in sorted(set(shards))
+                    if 0 <= i < self.num_shards])
+        if since is not None:
+            vec = self._unpack(self.parse_since(since))
+            want = int(limit) + int(offset)
+            out: list[dict] = []
+            for i, b in targets:
+                if len(out) >= want:
+                    break
+                rows = b.list_runs(**filters, limit=want - len(out),
+                                   since=vec[i])
+                for r in rows:
+                    vec[i] = r["change_seq"]
+                    r["_since_token"] = self.feed_token(self._pack(vec))
+                    out.append(r)
+            return out[offset:offset + limit]
+        if order not in ("desc", "asc"):
+            raise ValueError(f"bad order {order!r}")
+        per = int(limit) + int(offset)
+        merged: list[dict] = []
+        for _, b in targets:
+            merged.extend(b.list_runs(**filters, limit=per, order=order,
+                                      cursor=cursor))
+        merged.sort(key=lambda r: (r["created_at"], r["uuid"]),
+                    reverse=(order == "desc"))
+        return merged[offset:offset + limit]
+
+    def count_runs(self, project: Optional[str] = None,
+                   status: Optional[str] = None,
+                   pipeline_uuid: Optional[str] = None,
+                   statuses: Optional[list[str]] = None,
+                   created_by: Optional[str] = None) -> int:
+        """Sum of the per-shard counts — each backend serves its count
+        from the write-path row counters when the filters allow (the
+        first-page COUNT(*) satellite), so a paged-listing bootstrap
+        costs K dict lookups, not K table scans."""
+        return sum(b.count_runs(project=project, status=status,
+                                pipeline_uuid=pipeline_uuid,
+                                statuses=statuses, created_by=created_by)
+                   for b in self._shards)
+
+    # -- leases ------------------------------------------------------------
+
+    def acquire_lease(self, name: str, holder: str, *a: Any,
+                      **kw: Any):
+        return self._lease_home(name).acquire_lease(name, holder, *a, **kw)
+
+    def renew_lease(self, name: str, holder: str, token: int) -> bool:
+        return self._lease_home(name).renew_lease(name, holder, token)
+
+    def renew_leases(self, renewals: list[tuple],
+                     holder: str) -> list[bool]:
+        groups: dict[int, list[tuple[int, tuple]]] = {}
+        for idx, renewal in enumerate(renewals):
+            home = self._lease_home(renewal[0])
+            groups.setdefault(self._shards.index(home), []).append(
+                (idx, renewal))
+        results: list[bool] = [False] * len(renewals)
+        for i, entries in groups.items():
+            out = self._shards[i].renew_leases(
+                [r for _, r in entries], holder)
+            for (idx, _), ok in zip(entries, out):
+                results[idx] = ok
+        return results
+
+    def release_lease(self, name: str, holder: str, token: int) -> bool:
+        return self._lease_home(name).release_lease(name, holder, token)
+
+    def get_lease(self, name: str) -> Optional[dict]:
+        return self._lease_home(name).get_lease(name)
+
+    def list_leases(self, prefix: Optional[str] = None) -> list[dict]:
+        """Aggregate across backends (shard-<i> rows live on backend i,
+        presence rows on meta — disjoint by construction)."""
+        rows: list[dict] = []
+        for b in self._shards:
+            rows.extend(b.list_leases(prefix))
+        rows.sort(key=lambda r: r["name"])
+        return rows
+
+    # -- serve traffic -----------------------------------------------------
+
+    def serve_traffic(self, uuid: Optional[str] = None) -> dict:
+        if uuid is not None:
+            return self._shard_of(uuid).serve_traffic(uuid)
+        totals: dict = {}
+        for b in self._shards:
+            for k, v in b.serve_traffic().items():
+                if isinstance(v, (int, float)):
+                    if k.endswith("utilization"):
+                        totals[k] = max(totals.get(k, 0.0), v)
+                    else:
+                        totals[k] = totals.get(k, 0) + v
+                else:
+                    totals.setdefault(k, v)
+        return totals
+
+    @property
+    def serve_fresh_s(self) -> float:
+        return self._meta.serve_fresh_s
+
+    @serve_fresh_s.setter
+    def serve_fresh_s(self, value: float) -> None:
+        for b in self._shards:
+            b.serve_fresh_s = value
+
+    # -- cross-backend state -----------------------------------------------
+
+    def cluster_load(self) -> dict[str, int]:
+        totals: dict[str, int] = {}
+        for b in self._shards:
+            for name, n in b.cluster_load().items():
+                totals[name] = totals.get(name, 0) + n
+        return totals
+
+    @property
+    def stats(self) -> dict:
+        """Aggregated backend counters (sums). A snapshot view — writers
+        go through verbs, never this dict."""
+        totals: dict = {}
+        for b in self._shards:
+            for k, v in b.stats.items():
+                totals[k] = totals.get(k, 0) + v
+        return totals
+
+    def add_transition_listener(self, fn) -> None:
+        with self._listener_lock:
+            for b in self._shards:
+                b.add_transition_listener(fn)
+
+    def set_read_only(self, flag: bool) -> None:
+        for b in self._shards:
+            b.set_read_only(flag)
+
+    @property
+    def read_only(self) -> bool:
+        return any(b.read_only for b in self._shards)
+
+    @property
+    def degraded(self) -> Optional[str]:
+        for b in self._shards:
+            if b.degraded is not None:
+                return b.degraded
+        return None
+
+    def probe_recovery(self) -> bool:
+        return all(b.probe_recovery() for b in self._shards)
+
+    def chaos_disk_full(self, n: int = 1) -> None:
+        for b in self._shards:
+            b.chaos_disk_full(n)
+
+
+#: run-scoped verbs: routed to the owning shard, fence re-homed
+for _name in (
+    "get_run", "get_statuses", "update_run", "merge_outputs", "heartbeat",
+    "annotate_status", "delete_run", "record_launch_intent",
+    "mark_launched", "adopt_launch", "get_launch_intent", "add_lineage",
+    "get_lineage", "serve_replica_drain", "serve_progress", "place_run",
+):
+    setattr(ShardedStore, _name, _run_scoped(_name))
+
+#: control-plane verbs: routed to the meta shard
+for _name in (
+    "create_project", "get_project", "list_projects",
+    "create_token", "resolve_token", "list_tokens", "revoke_token",
+    "has_tokens",
+    "claim_config", "get_config", "set_config",
+    "set_quota", "get_quota", "list_quotas", "delete_quota",
+    "get_quota_map",
+    "register_cluster", "get_cluster", "list_clusters", "delete_cluster",
+    "get_cluster_map",
+    "count_serve_retries",
+):
+    setattr(ShardedStore, _name, _meta_scoped(_name))
+del _name
+
+
+__all__ = ["SHARD_SEQ_BITS", "ShardedStore", "pack_seqs", "unpack_seqs"]

@@ -5,6 +5,7 @@ scheduler threads)."""
 
 from __future__ import annotations
 
+import abc
 import datetime
 import errno
 import json
@@ -293,7 +294,97 @@ def _now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
 
 
-class Store:
+class StoreBackend(abc.ABC):
+    """The pluggable store contract (ISSUE 18): the verb surface every
+    caller — API handlers, agents, replication, chaos wrappers — codes
+    against. :class:`Store` is the single-SQLite implementation;
+    :class:`~polyaxon_tpu.api.sharded_store.ShardedStore` routes the same
+    surface over K of them. The abstract set below is the load-bearing
+    core (feed, lifecycle, leases, listings); the full surface — run-
+    scoped reads/writes, projects/tokens/quotas/clusters/config, serve
+    verbs — is pinned by tests/test_sharded_store.py's surface-parity
+    check rather than enumerated here, so the contract can't silently
+    fork between implementations.
+
+    Contract invariants every implementation must keep:
+
+    - ``feed_token``/``parse_since`` round-trip, and a token minted
+      before ANY failover (epoch change) raises :class:`StaleEpochError`;
+    - ``get_changelog`` pages are strictly ``seq``-ascending, resumable
+      from any returned seq, and raise :class:`CompactedLogError` below
+      the compaction floor — never a silent gap;
+    - write verbs honor ``fence=(lease_name, token)`` with
+      :class:`StaleLeaseError` rejection;
+    - ``transition_many``/``create_runs`` fire listeners only after
+      their transaction commits, in order, for applied entries only.
+    """
+
+    @abc.abstractmethod
+    def create_runs(self, project: str, runs: list, fence=None) -> list:
+        ...
+
+    @abc.abstractmethod
+    def transition_many(self, transitions: list, fence=None) -> list:
+        ...
+
+    @abc.abstractmethod
+    def list_runs(self, **kw: Any) -> list:
+        ...
+
+    @abc.abstractmethod
+    def count_runs(self, **kw: Any) -> int:
+        ...
+
+    @abc.abstractmethod
+    def get_changelog(self, after_seq: int = 0, limit: int = 500) -> list:
+        ...
+
+    @abc.abstractmethod
+    def apply_changelog(self, rows: list) -> int:
+        ...
+
+    @abc.abstractmethod
+    def changelog_span(self) -> dict:
+        ...
+
+    @abc.abstractmethod
+    def current_seq(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def current_epoch(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def feed_token(self, seq: int) -> str:
+        ...
+
+    @abc.abstractmethod
+    def parse_since(self, token) -> int:
+        ...
+
+    @abc.abstractmethod
+    def since_token(self, run: dict) -> str:
+        ...
+
+    @abc.abstractmethod
+    def acquire_lease(self, name: str, holder: str, *a: Any, **kw: Any):
+        ...
+
+    @abc.abstractmethod
+    def promote(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def snapshot(self, dirpath: str) -> dict:
+        ...
+
+    @abc.abstractmethod
+    def add_transition_listener(self, fn) -> None:
+        ...
+
+
+class Store(StoreBackend):
     """Thread-safe SQLite store. One connection per thread (sqlite3
     check_same_thread), WAL so readers never block the writer."""
 
@@ -332,7 +423,24 @@ class Store:
                       "serve_prefix_hits": 0, "serve_prefix_misses": 0,
                       "serve_cow_copies": 0,
                       "serve_spec_proposed": 0, "serve_spec_accepted": 0,
-                      "serve_request_retries": 0}
+                      "serve_request_retries": 0,
+                      # count_runs fast path (ISSUE 18 satellite): paged-
+                      # listing bootstraps served from write-path row
+                      # counters vs the COUNT(*) slow path, plus how many
+                      # reconciles found (and repaired) drift
+                      "count_fast": 0, "count_slow": 0,
+                      "count_drift_repairs": 0}
+        # per-project run-row counters behind the count_runs fast path:
+        # lazily seeded from one GROUP BY, then maintained by the write
+        # path (create_runs/delete_run) and INVALIDATED by replication
+        # replay (apply_changelog upserts can't tell inserts from
+        # updates). Every `count_reconcile_every` fast-path hits the
+        # cache is re-derived from SQL and drift repaired loudly (the
+        # stats counter) — the drift-reconciling slow path.
+        self._run_counts: Optional[dict[str, int]] = None
+        self._count_lock = threading.Lock()
+        self._count_hits = 0
+        self.count_reconcile_every = 1024
         # per-run (incarnation, last-seen cumulative train counters) for
         # delta accounting; in-memory like the counters themselves —
         # Prometheus counters are process-local by contract. Bounded by
@@ -1622,6 +1730,10 @@ class Store:
                         (max_epoch,))
                     self._epoch = max_epoch
                 self._applied_seq = last
+        if any(r["op"] in ("run", "delete_run") for r in todo):
+            # replayed upserts can't tell an insert from an update — the
+            # row counters re-derive on the next fast-path count
+            self._count_invalidate()
         return len(todo)
 
     def _apply_change(self, conn, rec: dict) -> None:
@@ -1941,6 +2053,7 @@ class Store:
                 conn.rollback()
                 raise
         self._h_write.observe(time.perf_counter() - t0)
+        self._count_add(project, len(rows))
         # creation flows through the same feed as transitions so a
         # subscribed agent learns about new runs without scanning
         self._notify_listeners(
@@ -2100,13 +2213,68 @@ class Store:
         statuses: Optional[list[str]] = None,
         created_by: Optional[str] = None,
     ) -> int:
-        """Total rows matching the listing filters (pagination UIs)."""
+        """Total rows matching the listing filters (pagination UIs).
+
+        The project-only shape — what every paged-listing bootstrap asks
+        — is served from the write-path row counters (O(1) dict lookup;
+        ``stats['count_fast']``), with a drift-reconciling slow path
+        every ``count_reconcile_every`` hits. Filtered shapes keep the
+        exact COUNT(*) (``stats['count_slow']``)."""
+        if (status is None and statuses is None and pipeline_uuid is None
+                and created_by is None):
+            return self._count_fast(project)
+        self.stats["count_slow"] += 1
         where, args = self._runs_where(
             project=project, status=status, statuses=statuses,
             pipeline_uuid=pipeline_uuid, created_by=created_by)
         with self._conn_ctx() as conn:
             return conn.execute(
                 "SELECT COUNT(*) FROM runs" + where, args).fetchone()[0]
+
+    def _count_table(self) -> dict[str, int]:
+        with self._conn_ctx() as conn:
+            rows = conn.execute(
+                "SELECT project, COUNT(*) FROM runs GROUP BY project"
+            ).fetchall()
+        return {r[0]: int(r[1]) for r in rows}
+
+    def _count_fast(self, project: Optional[str]) -> int:
+        with self._count_lock:
+            counts = self._run_counts
+            self._count_hits += 1
+            reconcile = (counts is None
+                         or self._count_hits >= self.count_reconcile_every)
+        if reconcile:
+            # re-derive OUTSIDE the cache lock (the SQL read must not
+            # serialize every fast-path caller), then swap + audit
+            fresh = self._count_table()
+            with self._count_lock:
+                if (self._run_counts is not None
+                        and self._run_counts != fresh):
+                    self.stats["count_drift_repairs"] += 1
+                self._run_counts = fresh
+                self._count_hits = 0
+                counts = fresh
+        self.stats["count_fast"] += 1
+        if project is not None:
+            return counts.get(project, 0)
+        return sum(counts.values())
+
+    def _count_add(self, project: str, n: int) -> None:
+        """Write-path counter maintenance (called AFTER the commit — a
+        rolled-back batch never lands here)."""
+        with self._count_lock:
+            if self._run_counts is None:
+                return
+            self._run_counts[project] = max(
+                self._run_counts.get(project, 0) + n, 0)
+
+    def _count_invalidate(self) -> None:
+        """Drop the cache where the write path can't see the delta
+        (replication replay, snapshot restore): the next fast-path hit
+        re-derives from SQL."""
+        with self._count_lock:
+            self._run_counts = None
 
     def update_run(self, uuid: str, fence=None, **fields: Any) -> Optional[dict]:
         self._check_writable()
@@ -2432,6 +2600,8 @@ class Store:
             if cur.rowcount > 0:
                 self._log_change(conn, "delete_run", {
                     "uuid": uuid, "project": row[0] if row else None})
+        if cur.rowcount > 0 and row:
+            self._count_add(row[0], -1)
         return cur.rowcount > 0
 
     # -- statuses ----------------------------------------------------------

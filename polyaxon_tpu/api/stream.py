@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -214,7 +215,18 @@ class StreamHub:
 
     def _read_head(self) -> tuple[int, int]:
         """(epoch, latest committed seq) — the subscribe-from-now
-        bootstrap. Runs in the executor."""
+        bootstrap. Runs in the executor. Store weather (SQLITE_BUSY
+        burst, failover window) rides a short bounded retry: the tail
+        loop treats every later read as retryable, and the one boot
+        read must not be the single place a transient can kill server
+        startup."""
+        delay = 0.05
+        for _ in range(5):
+            try:
+                return self.store.current_epoch(), self.store.current_seq()
+            except Exception:
+                time.sleep(delay)
+                delay = min(delay * 2, 0.5)
         return self.store.current_epoch(), self.store.current_seq()
 
     def _fetch(self) -> tuple[int, int, list[dict]]:

@@ -1034,19 +1034,34 @@ def token_revoke(token_id, host):
               help="changelog compaction interval in seconds (snapshot + "
                    "prune with a 10k-row tail margin, so the replication "
                    "log stays bounded); <=0 disables")
+@click.option("--store-shards", default=0, type=int,
+              help="partition the run DATABASE over K independent SQLite "
+                   "shards, each with its own writer lock (docs/"
+                   "PERFORMANCE.md 'Sharded store') — kills the single-"
+                   "writer serialization ceiling under multi-agent "
+                   "fleets. Files live under <data-dir>/store/. The "
+                   "count is claimed first-writer-wins; reopening the "
+                   "same data dir with a different K is refused. 0 = "
+                   "single-file db.sqlite")
 def server(host, port, data_dir, max_parallel, capacity_chips, backend, auth_token,
            artifacts_store, kube, kube_host, kube_namespace, kube_token, kube_ca,
            kube_insecure, agent_config, num_shards, standby_of, promote_after,
-           compact_every):
+           compact_every, store_shards):
     """Start the API server + scheduling agent (one process)."""
     from ..api.server import ApiServer
     from ..scheduler.agent import LocalAgent
 
     os.makedirs(data_dir, exist_ok=True)
+    store = None
+    if store_shards > 0:
+        from ..api.sharded_store import ShardedStore
+
+        store = ShardedStore(os.path.join(data_dir, "store"),
+                             shards=store_shards)
     srv = ApiServer(
         db_path=os.path.join(data_dir, "db.sqlite"),
         artifacts_root=os.path.join(data_dir, "artifacts"),
-        host=host, port=port, auth_token=auth_token,
+        host=host, port=port, auth_token=auth_token, store=store,
     )
     standby = None
     if standby_of:

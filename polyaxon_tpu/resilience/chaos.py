@@ -285,6 +285,13 @@ class FaultyStore:
         # pass — a SQLITE-weather blip must cost one scale decision,
         # never the agent loop
         "serve_traffic",
+        # sharded-store routing/stitching verbs (ISSUE 18): the
+        # cross-shard fan-outs and the feed-token round-trip are single
+        # verbs to the caller, so one gate covers the whole fan-out —
+        # a blip mid-stitch must surface as ONE retriable error, never a
+        # half-merged page
+        "count_runs", "find_cached_run", "feed_token", "parse_since",
+        "since_token", "current_seq", "current_epoch", "cluster_load",
     )
 
     def __init__(self, inner: Any, seed: int = 0, fault_rate: float = 0.2,

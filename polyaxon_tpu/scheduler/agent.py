@@ -52,13 +52,16 @@ def _is_pipeline_spec(spec: dict) -> bool:
     return bool(spec.get("matrix")) or _is_dag_spec(spec) or _is_scheduled_spec(spec)
 
 
-def _list_runs_all(store, status: str, order: str = "desc") -> list[dict]:
-    """Paginate past list_runs' limit — recovery must see every run."""
+def _list_runs_all(store, status: str, order: str = "desc",
+                   scan_kw: "dict | None" = None) -> list[dict]:
+    """Paginate past list_runs' limit — recovery must see every run.
+    ``scan_kw`` passes shard scoping through to a sharded store
+    (``LocalAgent._scan_shards_kw``)."""
     out: list[dict] = []
     offset = 0
     while True:
         page = store.list_runs(status=status, limit=500, offset=offset,
-                               order=order)
+                               order=order, **(scan_kw or {}))
         out += page
         if len(page) < 500:
             return out
@@ -576,6 +579,29 @@ class LocalAgent:
         if self.lease_ttl <= 0 or not self._leasing:
             return True
         return self._shard_name(run_uuid) in self._shard_leases
+
+    def _scan_shards_kw(self) -> dict:
+        """``list_runs`` kwargs scoping a full-pass scan to the owned
+        shards' store backends (ISSUE 18). Only when the store partitions
+        the run space on the SAME crc32 hash/count as the agent's work
+        shards — then an agent holding 2 of 8 shards reads 2 backends
+        instead of every agent paging the whole fleet's run table (the
+        N-agent full-scan multiplication, same fix as the scoped
+        ``cold_start_resync``). Empty dict = unscoped (plain store,
+        unaligned partitions, or this agent owns everything anyway); the
+        per-run ``_owns_run`` filter stays either way."""
+        if getattr(self.store, "store_num_shards", 0) != self.num_shards:
+            return {}
+        owned = self._owned_shards()
+        if not owned or len(owned) == len(self.shards):
+            return {}
+        idx = []
+        for s in owned:
+            try:
+                idx.append(int(s.rsplit("-", 1)[1]))
+            except (ValueError, IndexError):
+                return {}  # non-numeric shard naming: stay unscoped
+        return {"shards": sorted(idx)}
 
     def _fence_for_shard(self, shard: str) -> Optional[tuple]:
         """Fence for the next write to a run of ``shard``. None =
@@ -1504,11 +1530,26 @@ class LocalAgent:
                                    if self._shard_name(u) in scope}
         scan_statuses = [V1Statuses.QUEUED.value, *self._INFLIGHT,
                          V1Statuses.STOPPING.value]
+        # sharded store (ISSUE 18): when the store partitions the run
+        # space on the SAME crc32 hash/count the agent leases use, a
+        # scoped resync scans only the owning shards' backends instead
+        # of K agents each paging the whole fleet's run table — the
+        # N-agent full-resync multiplication docs/PERFORMANCE.md
+        # recorded as the server-backed-store follow-up. The Python
+        # filter below stays as belt-and-braces (and does the work when
+        # the partitions don't align).
+        scan_kw: dict = {}
+        if (scope is not None
+                and getattr(self.store, "store_num_shards", 0)
+                == self.num_shards):
+            scan_kw["shards"] = sorted(
+                int(s.rsplit("-", 1)[1]) for s in scope)
         runs: list[dict] = []
         offset = 0
         while True:
             page = self.store.list_runs(statuses=scan_statuses, limit=500,
-                                        offset=offset, order="asc")
+                                        offset=offset, order="asc",
+                                        **scan_kw)
             runs += page
             if len(page) < 500:
                 break
@@ -2225,6 +2266,12 @@ class LocalAgent:
         """Post-run hooks (upstream V1Hook): webhook/slack connections get
         a POST with the run summary when the trigger matches. Fire-and-
         forget threads — a slow endpoint must not stall the agent."""
+        if not any(getattr(c, "kind", None) in ("webhook", "slack")
+                   for c in self.connections.values()):
+            # no hook-capable connection configured: skip the per-run
+            # store read — at burst rates this listener fires for every
+            # terminal edge in the fleet, and the lookup is pure waste
+            return
         run = self.store.get_run(run_uuid)
         if not run:
             return
@@ -2430,12 +2477,15 @@ class LocalAgent:
         owned = self._owned_shards()
         for s in owned:
             self._count_shard_pass(s, "full")
+        # sharded store (ISSUE 18): scope every full-pass scan to the
+        # owned shards' backends — see _scan_shards_kw
+        scan_kw = self._scan_shards_kw()
         for run in self.store.list_runs(status=V1Statuses.CREATED.value,
-                                        order="asc"):
+                                        order="asc", **scan_kw):
             if self._owns_run(run["uuid"]):
                 self._compile(run)
         compiled = [r for r in self.store.list_runs(
-            status=V1Statuses.COMPILED.value, order="asc")
+            status=V1Statuses.COMPILED.value, order="asc", **scan_kw)
             if self._owns_run(r["uuid"])]
         if compiled:
             # one transaction for the whole promotion wave, not 3×N commits
@@ -2444,13 +2494,14 @@ class LocalAgent:
         for s in owned:
             self._clear_shard_queue(s)
         for run in _list_runs_all(self.store, V1Statuses.QUEUED.value,
-                                  order="asc"):
+                                  order="asc", scan_kw=scan_kw):
             if self._owns_run(run["uuid"]):
                 self._enqueue_pending(run)
         for s in owned:
             self._shard_fresh[s] = True
         self._schedule_pending()
-        for run in self.store.list_runs(status=V1Statuses.STOPPING.value):
+        for run in self.store.list_runs(status=V1Statuses.STOPPING.value,
+                                        **scan_kw):
             if self._owns_run(run["uuid"]):
                 self._do_stop(run)
         if self.cluster_name:

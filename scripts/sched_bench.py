@@ -15,7 +15,16 @@ modes:
 Usage:
     python scripts/sched_bench.py [N] [--mode wake|poll|both]
         [--poll-interval SEC] [--max-parallel M] [--agents A]
-        [--out PATH] [--suite] [--tenants] [--spillover]
+        [--out PATH] [--suite [10k-queued-runs]] [--tenants] [--spillover]
+
+``--suite 10k-queued-runs`` (ISSUE 18) runs the sharded-store
+control-plane burst: N (default 10,000) queued runs against a 4-agent
+fleet with instant in-process executors over the crc32-sharded SQLite
+backend, plus the single-writer-lock control row and the rolling-kill
+round — while a feed auditor tails the stitched ``?since=`` changelog
+the whole time (total order, per-shard gap-freedom, duplicate-launch
+and loss-free-replay audits). The committed artifact is
+bench_artifacts/sched_bench_r18.json.
 
 ``--spillover`` (ISSUE 16) runs the federated spillover A/B: a burst
 aimed entirely at the 'big' cluster of a 60/40 two-cluster federation.
@@ -448,6 +457,365 @@ def run_spillover(n: int = 30, big: int = 6, small: int = 4,
     }
 
 
+class _InstantExecution:
+    """Execution handle for :class:`InstantExecutor` submissions."""
+
+    def __init__(self):
+        self.returncode = None
+        self.proc = None
+        self.thread = None
+
+    def wait(self, timeout=None):
+        return self.returncode if self.returncode is not None else -1
+
+    def stop(self):
+        pass
+
+
+class InstantExecutor:
+    """Zero-cost drop-in for an agent's LocalExecutor: reports the same
+    lifecycle edges a real pod would (starting -> running -> succeeded)
+    from one worker thread, without fork/exec, artifact dirs, or log
+    files. The 10k-queued-runs burst measures the CONTROL PLANE — the
+    store's writer locks, the scheduling walk, the changelog — and on a
+    2-CPU bench box 10,000 `true` subprocess spawns would measure the
+    kernel's fork rate instead. (r6/r7's 100-run rows keep the real
+    subprocess executor; their numbers stay comparable across releases.)
+
+    Before emitting the terminal status the worker waits for the run to
+    appear in ``agent._active``: a real subprocess is slow enough that
+    the agent always finishes bookkeeping its launch first, and an
+    instant executor must not let the terminal callback's cleanup race
+    ahead of that insert (the entry would leak and eat a parallel slot
+    forever)."""
+
+    def __init__(self, agent):
+        import queue
+        import threading
+
+        self.agent = agent
+        self._q = queue.SimpleQueue()
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def submit(self, payload, block=False):
+        ex = _InstantExecution()
+        self._q.put((payload.run_uuid, ex))
+        return ex
+
+    def _drain(self):
+        # submissions arriving while a batch is in flight coalesce into
+        # the next one, and the whole batch's edges land through the
+        # agent's BATCHED callback (_on_status_many — the same shape the
+        # cluster reconciler uses so a multi-step edge is one store
+        # transaction, not four)
+        import queue
+
+        while True:
+            batch = [self._q.get()]
+            try:
+                while len(batch) < 64:
+                    batch.append(self._q.get_nowait())
+            except queue.Empty:
+                pass
+            closing = any(item is None for item in batch)
+            batch = [item for item in batch if item is not None]
+            if batch:
+                self.agent._on_status_many(
+                    [(u, s, None) for u, _ in batch
+                     for s in ("starting", "running")])
+                deadline = time.monotonic() + 2.0
+                for uuid, _ in batch:
+                    while (uuid not in self.agent._active
+                           and time.monotonic() < deadline):
+                        time.sleep(0)
+                for _, ex in batch:
+                    ex.returncode = 0
+                self.agent._on_status_many(
+                    [(u, "succeeded", None) for u, _ in batch])
+            if closing:
+                return
+
+    def close(self):
+        self._q.put(None)
+
+
+def _audit_feed(store, start_seq: int, stop_evt, out: dict) -> None:
+    """Tail the (stitched) changelog from ``start_seq`` like an SSE
+    watcher holding a ``?since=`` cursor, and pin the feed contract while
+    the wave commits underneath:
+
+    - composite ``seq`` strictly increasing across every page,
+    - per-shard ``shard_seq`` contiguous (a gap = a lost record),
+    - per-run status-edge streams collected for the duplicate-launch and
+      loss-free-replay audits (a second ``running`` edge with no
+      re-queue edge between = a duplicate launch).
+
+    Works against the single-backend store too (records just carry no
+    ``shard`` marker, so the gap check has nothing to do)."""
+    cursor = int(start_seq)
+    last = cursor
+    shard_next: dict = {}
+    page_lat: list = []
+    edges: dict = {}
+    violations: list = []
+    pages = records = 0
+    while True:
+        t0 = time.perf_counter()
+        recs = store.get_changelog(cursor, limit=1000)
+        page_lat.append(time.perf_counter() - t0)
+        if not recs:
+            if stop_evt.is_set():
+                break
+            time.sleep(0.03)
+            continue
+        pages += 1
+        records += len(recs)
+        for r in recs:
+            if r["seq"] <= last:
+                violations.append(
+                    f"seq not monotone: {r['seq']} after {last}")
+            last = r["seq"]
+            sh = r.get("shard")
+            if sh is not None:
+                nxt = shard_next.get(sh)
+                if nxt is not None and r["shard_seq"] != nxt:
+                    violations.append(
+                        f"shard {sh} gap: expected {nxt}, "
+                        f"got {r['shard_seq']}")
+                shard_next[sh] = r["shard_seq"] + 1
+            if r["op"] == "condition":
+                p = r["payload"]
+                cond = p.get("condition")
+                if isinstance(cond, str):
+                    cond = json.loads(cond)
+                edges.setdefault(p["run_uuid"], []).append(
+                    (cond or {}).get("type"))
+        cursor = last
+    out["pages"] = pages
+    out["records"] = records
+    out["violations"] = violations
+    out["edges"] = edges
+    out["page_p50_ms"] = round(_percentile(page_lat, 0.50) * 1000, 3)
+    out["page_p95_ms"] = round(_percentile(page_lat, 0.95) * 1000, 3)
+
+
+_REQUEUE_EDGES = frozenset(["retrying", "queued", "scheduled", "created",
+                            "compiled"])
+
+
+def _duplicate_launches(uuids: list, edges: dict) -> list:
+    """Runs whose stitched edge stream shows a second ``running`` with no
+    re-queue edge in between — two executors holding the same run at
+    once. A relaunch after an agent death is NOT a duplicate: adoption
+    re-queues the run first, and those edges land in the feed between
+    the two ``running``s (total order across shards is what makes this
+    audit possible at all)."""
+    dups = []
+    for u in uuids:
+        running_live = False
+        for e in edges.get(u, []):
+            if e == "running":
+                if running_live:
+                    dups.append(u)
+                    break
+                running_live = True
+            elif e in _REQUEUE_EDGES:
+                running_live = False
+    return dups
+
+
+def run_sharded_burst(n: int = 10000, agents: int = 4,
+                      store_shards: int = 8,
+                      poll_interval: float = 0.2,
+                      max_parallel: int = 64,
+                      sharded: bool = True,
+                      rolling_kill: bool = False,
+                      kills: int = 1,
+                      timeout: float = 600.0,
+                      batch: int = 250) -> dict:
+    """The ISSUE 18 control-plane burst: ``n`` queued runs driven by a
+    fleet of ``agents`` shard-aware agents with instant (in-process)
+    executors, over either the sharded store (``store_shards`` crc32
+    partitions, one writer lock each) or the single-file control
+    (``sharded=False`` — every write serializes through ONE writer
+    lock; same fleet, same executor, so the delta is the store).
+
+    A feed auditor tails the stitched ``?since=`` changelog from the
+    pre-wave cursor the whole time (loss-free replay + duplicate-launch
+    audit — see :func:`_audit_feed`). ``rolling_kill`` hard-kills
+    ``kills`` fleet members WITHOUT replacement mid-wave: survivors
+    must adopt the orphaned shard leases and re-queue the dead agents'
+    in-flight runs, and the audit must still show zero duplicate
+    launches and a loss-free replay."""
+    import threading
+
+    from polyaxon_tpu.api.store import Store
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    workdir = tempfile.mkdtemp(prefix="sched_bench_shard_")
+    if sharded:
+        from polyaxon_tpu.api.sharded_store import ShardedStore
+
+        store = ShardedStore(os.path.join(workdir, "store"),
+                             shards=store_shards)
+    else:
+        store = Store(os.path.join(workdir, "db.sqlite"))
+    created: dict = {}
+    running: dict = {}
+    done: dict = {}
+    failed: set = set()
+
+    def _listener(uuid, status):
+        now = time.monotonic()
+        if status == "running":
+            running.setdefault(uuid, now)
+        elif status in ("succeeded", "failed", "stopped"):
+            if status == "failed":
+                failed.add(uuid)
+            done.setdefault(uuid, now)
+
+    store.add_transition_listener(_listener)
+    fleet = [LocalAgent(
+        store, workdir, backend="local", max_parallel=max_parallel,
+        poll_interval=poll_interval, use_change_feed=True,
+        num_shards=store_shards,
+        # rolling-kill needs fast adoption; the fault-free burst must
+        # not spend its wall time on lease churn
+        lease_ttl=(1.5 if rolling_kill else 10.0),
+    ) for _ in range(agents)]
+    executors = []
+    for a in fleet:
+        a.executor = InstantExecutor(a)
+        executors.append(a.executor)
+        a.start()
+    # wait for the fleet's fair-share rebalance to CONVERGE, not just
+    # for first acquisition — a shard released mid-wave sits unowned
+    # for a lease tick, and that stall would be charged to the store
+    deadline = time.monotonic() + 30
+    spread = 1 if store_shards % agents else 0
+    while time.monotonic() < deadline:
+        counts = [len(a._shard_leases) for a in fleet]
+        if (sum(counts) == store_shards and min(counts) > 0
+                and max(counts) - min(counts) <= spread):
+            break
+        time.sleep(0.05)
+
+    audit: dict = {}
+    stop_evt = threading.Event()
+    auditor = threading.Thread(
+        target=_audit_feed, args=(store, store.current_seq(), stop_evt,
+                                  audit),
+        daemon=True)
+    auditor.start()
+
+    kill_marks = ([int(n * (i + 1) / (kills + 1)) for i in range(kills)]
+                  if rolling_kill else [])
+    killed = 0
+    uuids: list = []
+    t0 = time.monotonic()
+    try:
+        for base in range(0, n, batch):
+            rows = [{"name": f"burst-{i}", "spec": NOOP_SPEC}
+                    for i in range(base, min(base + batch, n))]
+            for r in store.create_runs("bench", rows):
+                created[r["uuid"]] = time.monotonic()
+                uuids.append(r["uuid"])
+        wave_deadline = time.monotonic() + timeout
+        while len(done) < n and time.monotonic() < wave_deadline:
+            if killed < len(kill_marks) and len(done) >= kill_marks[killed]:
+                victim = fleet[killed]
+                victim.hard_kill()
+                killed += 1
+            time.sleep(0.05)
+    finally:
+        for a in fleet:
+            if not getattr(a, "_dead", False):
+                a.stop()
+        stop_evt.set()
+        auditor.join(timeout=30)
+        for ex in executors:
+            ex.close()
+    wall = time.monotonic() - t0
+
+    edges = audit.get("edges", {})
+    dups = _duplicate_launches(uuids, edges)
+    # loss-free replay = the feed diverges from the store's own truth
+    # nowhere. A run FAILING under a rolling kill is the local
+    # executor's designed adoption semantics (fail loudly, never hang,
+    # never duplicate — agent.cold_start_resync), and the feed must
+    # replay that failure faithfully; it is not a feed loss. Two
+    # checks: every terminal edge the live listener saw must appear in
+    # the replay, and a deterministic sample of full per-run condition
+    # histories must match the store record for record.
+    terminal = ("succeeded", "failed", "stopped")
+    replay_lost = [u for u in done
+                   if not any(e in terminal for e in edges.get(u, []))]
+    sample = uuids[:500]
+    feed_store_mismatches = 0
+    for u in sample:
+        conds = [c.get("type") for c in store.get_statuses(u)]
+        if edges.get(u, []) != conds:
+            feed_store_mismatches += 1
+    ttr = [running[u] - created[u] for u in created if u in running]
+    return {
+        "backend": "sharded" if sharded else "single",
+        "store_shards": store_shards if sharded else 1,
+        "runs": n,
+        "completed": len(done),
+        "failed": len(failed),
+        "agents": agents,
+        "agents_killed": killed,
+        "max_parallel": max_parallel,
+        "poll_interval_s": poll_interval,
+        "time_to_running_p50_s": round(_percentile(ttr, 0.50), 4),
+        "time_to_running_p95_s": round(_percentile(ttr, 0.95), 4),
+        "wall_s": round(wall, 3),
+        "runs_per_min": round(len(done) / wall * 60.0, 1) if wall > 0 else None,
+        "feed_pages": audit.get("pages"),
+        "feed_records": audit.get("records"),
+        "feed_page_p50_ms": audit.get("page_p50_ms"),
+        "feed_page_p95_ms": audit.get("page_p95_ms"),
+        "feed_order_violations": len(audit.get("violations", [])),
+        "duplicate_launches": len(dups),
+        "replay_lost": len(replay_lost),
+        "feed_store_history_sample": len(sample),
+        "feed_store_history_mismatches": feed_store_mismatches,
+    }
+
+
+def run_sharded_suite(n: int = 10000, agents: int = 4,
+                      store_shards: int = 8,
+                      poll_interval: float = 0.2,
+                      control_n: int = 2000) -> dict:
+    """``--suite 10k-queued-runs`` (ISSUE 18): the sharded-store scaling
+    artifact. Three rows:
+
+    - ``burst``: the headline — n queued runs, ``agents`` agents, the
+      sharded backend. Acceptance: runs/min >= 3x r7's single-agent
+      3,256.4 (the committed sched_bench_r07.json saturated-wake row).
+    - ``single_backend_control``: the SAME fleet + instant executors
+      over ONE SQLite file — what the writer-lock convoy does to the
+      identical workload (smaller n so the convoy doesn't eat the
+      bench's wall-clock budget; runs/min normalizes).
+    - ``rolling_kill``: the burst with a mid-wave agent kill and no
+      replacement — zero duplicate launches and a loss-free stitched
+      replay while shard leases change hands."""
+    return {
+        "metric": "sched_sharded_10k_queued_runs",
+        "r7_single_agent_runs_per_min": 3256.4,
+        "burst": run_sharded_burst(
+            n, agents=agents, store_shards=store_shards,
+            poll_interval=poll_interval),
+        "single_backend_control": run_sharded_burst(
+            control_n, agents=agents, store_shards=store_shards,
+            sharded=False, poll_interval=poll_interval),
+        "rolling_kill": run_sharded_burst(
+            control_n, agents=agents, store_shards=store_shards,
+            rolling_kill=True, poll_interval=poll_interval),
+    }
+
+
 def run_suite(n: int = 100, poll_interval: float = 0.2) -> dict:
     """Both BASELINE scenarios, both modes, plus the multi-agent scaling
     sweep — the committed-artifact shape.
@@ -468,8 +836,21 @@ def run_suite(n: int = 100, poll_interval: float = 0.2) -> dict:
 
 
 def main() -> None:
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
-    n = int(args[0]) if args else 100
+    argv = sys.argv[1:]
+    # positional N: skip flags AND their value tokens (--mode wake must
+    # not leave "wake" to be parsed as N)
+    skip = set()
+    for i, a in enumerate(argv):
+        if a in ("--mode", "--poll-interval", "--max-parallel",
+                 "--agents", "--out"):
+            skip.add(i + 1)
+        elif (a == "--suite" and i + 1 < len(argv)
+                and not argv[i + 1].startswith("--")
+                and not argv[i + 1].isdigit()):
+            skip.add(i + 1)  # the optional suite name
+    args = [a for i, a in enumerate(argv)
+            if not a.startswith("--") and i not in skip]
+    n = int(args[0]) if args else None
     mode = "both"
     if "--mode" in sys.argv:
         mode = sys.argv[sys.argv.index("--mode") + 1]
@@ -485,14 +866,26 @@ def main() -> None:
     if "--agents" in sys.argv:
         agents = int(sys.argv[sys.argv.index("--agents") + 1])
 
+    suite_name = None
     if "--suite" in sys.argv:
-        out = run_suite(n, poll_interval)
+        i = sys.argv.index("--suite")
+        if (i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("--")
+                and not sys.argv[i + 1].isdigit()):
+            suite_name = sys.argv[i + 1]
+
+    if suite_name in ("10k-queued-runs", "10k", "sharded"):
+        out = run_sharded_suite(n if n is not None else 10000,
+                                agents=(agents if agents > 1 else 4),
+                                poll_interval=poll_interval)
+    elif "--suite" in sys.argv:
+        out = run_suite(n if n is not None else 100, poll_interval)
     elif "--tenants" in sys.argv:
         out = run_tenants(poll_interval=min(poll_interval, 0.05))
     elif "--spillover" in sys.argv:
         out = run_spillover(poll_interval=min(poll_interval, 0.05))
     else:
-        out = run_bench(n, mode, poll_interval, max_parallel, agents=agents)
+        out = run_bench(n if n is not None else 100, mode, poll_interval,
+                        max_parallel, agents=agents)
     line = json.dumps(out)
     if "--out" in sys.argv:
         path = sys.argv[sys.argv.index("--out") + 1]

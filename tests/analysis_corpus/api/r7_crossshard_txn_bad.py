@@ -1,0 +1,33 @@
+"""R7 reproducer — the ISSUE-18 cross-shard transaction class: code
+holding one shard's ``_conn_ctx()`` write transaction while reaching
+into ANOTHER shard — a nested transaction or a routed store verb. Two
+such paths with opposite shard orders deadlock on the per-shard SQLite
+writer locks; even one path splits an intended atomic step across two
+independent commits."""
+
+
+class BadRouter:
+    def __init__(self, shards):
+        self._shards = shards
+        self._meta = shards[0]
+
+    def move_run(self, run, src, dst):
+        # nested transaction: dst's writer lock acquired while src's is
+        # held — the deadlock-order hazard
+        with src._conn_ctx() as conn:
+            conn.execute("DELETE FROM runs WHERE uuid=?", (run,))
+            with dst._conn_ctx() as conn2:  # BAD
+                conn2.execute("INSERT INTO runs(uuid) VALUES (?)", (run,))
+
+    def create_with_audit(self, backend, project, rows):
+        # routed verb on the meta shard under a data shard's hold: the
+        # verb opens meta's transaction beneath backend's writer lock
+        with backend._conn_ctx() as conn:
+            conn.execute("INSERT INTO runs(uuid) VALUES (?)",
+                         (rows[0]["uuid"],))
+            self._meta.claim_config("num_shards", len(self._shards))  # BAD
+
+    def fan_out(self, i, j, pairs):
+        with self._shards[i]._conn_ctx() as conn:
+            conn.execute("BEGIN")
+            self._shards[j].transition_many(pairs)  # BAD

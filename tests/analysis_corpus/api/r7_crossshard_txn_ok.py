@@ -1,0 +1,39 @@
+"""R7 clean twin — the sanctioned shapes: route FIRST, then transact;
+each shard's transaction opens and commits on its own, and cross-shard
+reads happen OUTSIDE any held transaction (the verify-then-strip
+discipline ``ShardedStore._split_fence`` documents)."""
+
+
+class GoodRouter:
+    def __init__(self, shards):
+        self._shards = shards
+        self._meta = shards[0]
+
+    def move_run(self, run, src, dst):
+        # sequential transactions: src's commits (and releases its
+        # writer lock) before dst's opens
+        with src._conn_ctx() as conn:
+            conn.execute("DELETE FROM runs WHERE uuid=?", (run,))
+        with dst._conn_ctx() as conn:
+            conn.execute("INSERT INTO runs(uuid) VALUES (?)", (run,))
+
+    def create_with_audit(self, backend, project, rows):
+        # the meta-shard write happens before the data shard's hold
+        self._meta.claim_config("num_shards", len(self._shards))
+        with backend._conn_ctx() as conn:
+            conn.execute("INSERT INTO runs(uuid) VALUES (?)",
+                         (rows[0]["uuid"],))
+
+    def fan_out(self, groups):
+        # per-shard sub-batches: each routed verb opens exactly one
+        # backend's transaction, no hold spans two shards
+        for target, pairs in groups:
+            target.transition_many(pairs)
+
+    def same_shard_helper(self, backend, uuid):
+        # same-receiver work inside its own transaction is the normal
+        # single-shard shape — allowed
+        with backend._conn_ctx() as conn:
+            backend._check_fence(conn, None)
+            conn.execute("UPDATE runs SET status='queued' WHERE uuid=?",
+                         (uuid,))

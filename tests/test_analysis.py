@@ -62,7 +62,7 @@ class TestLiveTree:
         assert analysis_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rule in ("fence", "lockorder", "asyncblock", "clock",
-                     "metrics", "donation"):
+                     "metrics", "donation", "crossshard"):
             assert rule in out
 
     def test_cli_rejects_unknown_rule(self):
@@ -97,6 +97,10 @@ BAD_CASES = [
     # after they were donated to the jitted verify step (the PR-8
     # donated-reuse class on the serving fast path)
     ("donation", "serve/r17_donated_spec_decode_bad.py", 2),
+    # ISSUE 18 sharded store: cross-shard verbs / nested transactions
+    # under a held shard's writer lock (the per-shard SQLite lock-order
+    # hazard R2's threading-lock graph cannot see)
+    ("crossshard", "api/r7_crossshard_txn_bad.py", 3),
 ]
 
 OK_TWINS = [
@@ -111,6 +115,7 @@ OK_TWINS = [
     "tenancy/r15_monotonic_bucket_ok.py",
     "federation/r16_wall_clock_cluster_health_ok.py",
     "serve/r17_donated_spec_decode_ok.py",
+    "api/r7_crossshard_txn_ok.py",
 ]
 
 
@@ -208,7 +213,8 @@ class TestEngine:
         assert set(data["summary"]) == {"total", "active", "suppressed",
                                         "by_rule"}
         assert set(data["rules"]) == {"fence", "lockorder", "asyncblock",
-                                      "clock", "metrics", "donation"}
+                                      "clock", "metrics", "donation",
+                                      "crossshard"}
 
     def test_clock_rule_scope_covers_the_stream_module(self):
         """ISSUE 14 satellite: api/stream.py (eviction write deadlines,

@@ -76,6 +76,42 @@ class TestSchedBench:
             f"2 agents never beat 1 on runs/min in {len(attempts)} "
             f"attempts: {attempts}")
 
+    def test_sharded_store_beats_single_backend_two_agents(self):
+        """Scaled-down ISSUE 18 regression smoke: the same instant-
+        executor control-plane burst, 2 agents, over the crc32-sharded
+        store vs ONE SQLite file. Every write in the single-backend row
+        serializes through one writer lock; the sharded row splits the
+        run space over 8 locks, so its runs/min must be at least the
+        single row's. The feed audits must hold in BOTH rows: zero
+        duplicate launches, zero stitched-order violations, loss-free
+        replay. Best-of-3 like the other perf smokes (shared box).
+        n=600 is deliberate: a 200-run wave drains before the single
+        writer lock ever convoys (both backends ~15k runs/min there);
+        at 600 queued the lock is the bottleneck and the single row
+        reliably drops to ~1/2 the sharded throughput."""
+        from sched_bench import run_sharded_burst
+
+        attempts = []
+        for _ in range(3):
+            single = run_sharded_burst(
+                n=600, agents=2, store_shards=8, sharded=False,
+                poll_interval=0.1, timeout=120, batch=100)
+            shard = run_sharded_burst(
+                n=600, agents=2, store_shards=8, sharded=True,
+                poll_interval=0.1, timeout=120, batch=100)
+            for r in (single, shard):
+                assert r["completed"] == 600, r
+                assert r["duplicate_launches"] == 0, r
+                assert r["feed_order_violations"] == 0, r
+                assert r["replay_lost"] == 0, r
+                assert r["feed_store_history_mismatches"] == 0, r
+            attempts.append((single["runs_per_min"], shard["runs_per_min"]))
+            if shard["runs_per_min"] >= single["runs_per_min"]:
+                return
+        raise AssertionError(
+            f"sharded store never matched the single backend's runs/min "
+            f"in {len(attempts)} attempts (single, sharded): {attempts}")
+
     def test_tenant_fairness_smoke(self):
         """Tier-1 fairness smoke (ISSUE 15): `sched_bench --tenants`
         must complete its interleaved 3-tenant burst and converge the

@@ -1,0 +1,670 @@
+"""Tier-1 suite for the sharded server-backed store (ISSUE 18).
+
+Pins the tentpole's contract: crc32 routing behind the single-store verb
+surface (surface parity is asserted, not assumed), composite feed tokens,
+the stitched changelog's invariants (total order across shards, loss-free
+pagination and ``since`` walks, Last-Event-ID resume over SSE,
+deterministic 410 when ONE shard fails over, per-shard compaction
+floors), replication through the stitched feed (sharded primary ->
+sharded standby, in-process and HTTP), chaos gating of the new verbs
+(FaultyStore/OutageStore), and the two perf satellites: the
+row-counter ``count_runs`` fast path and shard-scoped
+``cold_start_resync``.
+"""
+
+import inspect
+import sqlite3
+import threading
+import time
+
+import pytest
+import requests
+
+from polyaxon_tpu.api.sharded_store import (
+    ShardedStore,
+    pack_seqs,
+    unpack_seqs,
+)
+from polyaxon_tpu.api.store import (
+    CompactedLogError,
+    StaleEpochError,
+    StaleLeaseError,
+    Store,
+    StoreBackend,
+    shard_index,
+)
+
+JOB = {"run": {"kind": "job"}}
+
+
+def _sharded(k=4):
+    return ShardedStore(":memory:", shards=k)
+
+
+def _spread_runs(store, n, project="p", status=None):
+    """n runs through the router; returns rows (crc32 spreads them)."""
+    rows = [store.create_run(project, spec=JOB, name=f"r{i}")
+            for i in range(n)]
+    if status:
+        store.transition_many([(r["uuid"], status, None, None, True)
+                               for r in rows])
+    return rows
+
+
+def _owning(store, uuid):
+    return store.backends[shard_index(uuid, store.num_shards)]
+
+
+# ---------------------------------------------------------------------------
+# token packing
+# ---------------------------------------------------------------------------
+
+
+class TestCompositeTokens:
+    def test_pack_unpack_round_trip(self):
+        vec = [7, 0, 123456789, 3]
+        assert unpack_seqs(pack_seqs(vec), 4) == vec
+        assert unpack_seqs(0, 3) == [0, 0, 0]
+        assert unpack_seqs(-1, 2) == [0, 0]
+
+    def test_single_component_advance_is_strictly_monotone(self):
+        vec = [5, 9, 2]
+        v0 = pack_seqs(vec)
+        for i in range(3):
+            bumped = list(vec)
+            bumped[i] += 1
+            assert pack_seqs(bumped) > v0
+
+    def test_overflowing_component_is_loud(self):
+        with pytest.raises(ValueError):
+            pack_seqs([1 << 40])
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+
+class TestRouting:
+    def test_surface_parity_with_store(self):
+        """Every public Store callable exists on ShardedStore — a new
+        verb added to Store without a routing decision here fails loudly
+        instead of AttributeError-ing at 2am."""
+        s = _sharded(3)
+        missing = [
+            name for name, _ in inspect.getmembers(Store, callable)
+            if not name.startswith("_")
+            and not callable(getattr(s, name, None))]
+        assert missing == []
+        assert isinstance(s, StoreBackend)
+        assert isinstance(Store(":memory:"), StoreBackend)
+
+    def test_runs_land_on_their_crc32_shard(self):
+        s = _sharded(4)
+        rows = _spread_runs(s, 16)
+        owners = set()
+        for r in rows:
+            i = shard_index(r["uuid"], 4)
+            owners.add(i)
+            assert s.backends[i].get_run(r["uuid"]) is not None
+            for j, b in enumerate(s.backends):
+                if j != i:
+                    assert b.get_run(r["uuid"]) is None
+            # the routed read agrees with the direct one
+            assert s.get_run(r["uuid"])["uuid"] == r["uuid"]
+        assert len(owners) > 1  # the hash actually spread the space
+
+    def test_lifecycle_round_trip_matches_single_store(self):
+        s = _sharded(4)
+        (r,) = _spread_runs(s, 1)
+        u = r["uuid"]
+        for st in ("compiled", "queued", "scheduled", "starting",
+                   "running"):
+            row, changed = s.transition(u, st)
+            assert changed and row["status"] == st
+        s.heartbeat(u, step=11)
+        s.merge_outputs(u, {"loss": 0.5})
+        row = s.get_run(u)
+        assert row["heartbeat_step"] == 11
+        assert row["outputs"] == {"loss": 0.5}
+        conds = s.get_statuses(u)
+        assert conds[0]["type"] == "created"
+        assert conds[-1]["type"] == "running"
+
+    def test_meta_state_lives_on_backend_zero(self):
+        s = _sharded(3)
+        s.set_quota("tenant-a", 8)
+        s.register_cluster("west", capacity=16)
+        tok = s.create_token(project="p", label="alice")
+        meta, others = s.backends[0], s.backends[1:]
+        assert meta.get_quota("tenant-a") is not None
+        assert meta.get_cluster("west") is not None
+        assert meta.resolve_token(tok["token"]) is not None
+        for b in others:
+            assert b.get_quota("tenant-a") is None
+            assert b.get_cluster("west") is None
+
+    def test_shard_lease_lives_on_its_own_backend(self):
+        s = _sharded(4)
+        lease = s.acquire_lease("shard-2", "agent-a", ttl=30.0)
+        assert lease is not None
+        assert s.backends[2].get_lease("shard-2") is not None
+        assert s.backends[0].get_lease("shard-2") is None
+        # presence (non shard-<i>) leases live on meta
+        s.acquire_lease("agent-xyz", "agent-a", ttl=30.0)
+        assert s.backends[0].get_lease("agent-xyz") is not None
+        # the aggregated listing sees both
+        names = {l["name"] for l in s.list_leases()}
+        assert {"shard-2", "agent-xyz"} <= names
+
+    def test_same_shard_fence_is_enforced_atomically(self):
+        """A run fenced by ITS shard's lease: the check rides inside the
+        owning backend's transaction, exactly like the single store."""
+        s = _sharded(4)
+        (r,) = _spread_runs(s, 1)
+        i = shard_index(r["uuid"], 4)
+        lease = s.acquire_lease(f"shard-{i}", "agent-a", ttl=30.0)
+        fence = (f"shard-{i}", lease["token"])
+        row, changed = s.transition(r["uuid"], "compiled", fence=fence)
+        assert changed
+        with pytest.raises(StaleLeaseError):
+            s.transition(r["uuid"], "queued",
+                         fence=(f"shard-{i}", lease["token"] - 1))
+
+    def test_cross_shard_fence_verified_then_stripped(self):
+        """A write landing on shard j fenced by shard i's lease: the
+        stale caller is still rejected (verified against the lease's
+        home backend), the fresh caller goes through."""
+        s = _sharded(4)
+        rows = _spread_runs(s, 12)
+        lease = s.acquire_lease("shard-1", "agent-a", ttl=30.0)
+        victim = next(r for r in rows
+                      if shard_index(r["uuid"], 4) not in (1,))
+        with pytest.raises(StaleLeaseError):
+            s.transition(victim["uuid"], "compiled",
+                         fence=("shard-1", lease["token"] - 1))
+        row, changed = s.transition(victim["uuid"], "compiled",
+                                    fence=("shard-1", lease["token"]))
+        assert changed and row["status"] == "compiled"
+
+    def test_pipeline_parent_inheritance_crosses_shards(self):
+        """created_by/tenant inherit from a pipeline parent even when
+        parent and child hash to different shards (the router resolves
+        the parent through routed lookups, not the backend's same-db
+        one)."""
+        s = _sharded(4)
+        parent = s.create_run("p", spec=JOB, name="pipe",
+                              created_by="alice", tenant="t-a")
+        kids = s.create_runs("p", [
+            {"spec": JOB, "name": f"k{i}",
+             "pipeline_uuid": parent["uuid"]}
+            for i in range(8)])
+        shards_hit = {shard_index(k["uuid"], 4) for k in kids}
+        assert len(shards_hit) > 1
+        for k in kids:
+            assert k["created_by"] == "alice"
+            assert k["tenant"] == "t-a"
+
+    def test_reopening_with_a_different_shard_count_is_refused(
+            self, tmp_path):
+        root = str(tmp_path / "store")
+        s = ShardedStore(root, shards=4)
+        rows = _spread_runs(s, 6)
+        with pytest.raises(ValueError, match="sharded at 4"):
+            ShardedStore(root, shards=3)
+        s2 = ShardedStore(root, shards=4)
+        for r in rows:
+            assert s2.get_run(r["uuid"])["name"] == r["name"]
+
+    def test_claimed_num_shards_aligns_the_agent_partitions(self):
+        s = _sharded(4)
+        assert s.get_config("num_shards") == "4"
+        assert s.store_num_shards == 4
+
+
+# ---------------------------------------------------------------------------
+# stitched changelog
+# ---------------------------------------------------------------------------
+
+
+class TestStitchedFeed:
+    def test_total_order_and_per_shard_subsequences(self):
+        """The merged feed is strictly seq-increasing, and projecting it
+        back onto any one shard yields exactly that backend's own
+        changelog (order preserved, nothing lost, nothing invented)."""
+        s = _sharded(3)
+        rows = _spread_runs(s, 18, status="queued")
+        feed = s.get_changelog(0, 10_000)
+        seqs = [r["seq"] for r in feed]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        for i, b in enumerate(s.backends):
+            own = b.get_changelog(0, 10_000)
+            projected = [(r["shard_seq"], r["op"]) for r in feed
+                         if r["shard"] == i]
+            assert projected == [(r["seq"], r["op"]) for r in own]
+        assert len(feed) == sum(
+            len(b.get_changelog(0, 10_000)) for b in s.backends)
+
+    def test_paged_walk_replays_loss_free(self):
+        """Walking the feed page by page from 0, resuming from each
+        page's last composite seq, replays every record exactly once —
+        including pages smaller than one shard's backlog (the truncated-
+        shard-page case the merge must not read past)."""
+        s = _sharded(4)
+        _spread_runs(s, 25, status="queued")
+        whole = s.get_changelog(0, 10_000)
+        walked, cursor = [], 0
+        while True:
+            page = s.get_changelog(cursor, 7)
+            if not page:
+                break
+            walked.extend(page)
+            cursor = page[-1]["seq"]
+        assert [(r["shard"], r["shard_seq"]) for r in walked] == \
+            [(r["shard"], r["shard_seq"]) for r in whole]
+
+    def test_changelog_span_matches_current_seq(self):
+        s = _sharded(3)
+        _spread_runs(s, 9)
+        span = s.changelog_span()
+        assert span["seq"] == s.current_seq()
+        assert span["epoch"] == s.current_epoch()
+        feed = s.get_changelog(0, 10_000)
+        assert feed[-1]["seq"] == s.current_seq()
+
+    def test_since_walk_with_small_pages_is_loss_free(self):
+        """The paged ``?since=`` listing contract over K shards: resume
+        via each page's last row's since_token; every run appears, and a
+        fully-drained cursor returns an empty page (no spin)."""
+        s = _sharded(4)
+        rows = _spread_runs(s, 23)
+        token = s.feed_token(0)
+        seen, pages = [], 0
+        while True:
+            page = s.list_runs(since=token, limit=3)
+            pages += 1
+            if not page:
+                break
+            seen.extend(r["uuid"] for r in page)
+            token = s.since_token(page[-1])
+            assert pages < 100
+        assert sorted(seen) == sorted(r["uuid"] for r in rows)
+        # incremental: one more write, the same cursor picks up only it
+        extra = s.create_run("p", spec=JOB, name="late")
+        page = s.list_runs(since=token, limit=10)
+        assert [r["uuid"] for r in page] == [extra["uuid"]]
+
+    def test_fallback_since_token_replays_but_never_loses(self):
+        """since_token on a row that did NOT come from a since walk
+        (no stamped cursor) must yield a token that re-serves other
+        shards' rows rather than skipping any."""
+        s = _sharded(4)
+        rows = _spread_runs(s, 12)
+        row = s.get_run(rows[-1]["uuid"])
+        token = s.since_token(row)
+        replay = {r["uuid"] for r in s.list_runs(since=token, limit=100)}
+        # everything on OTHER shards replays; nothing is lost
+        other = {r["uuid"] for r in rows
+                 if shard_index(r["uuid"], 4)
+                 != shard_index(row["uuid"], 4)}
+        assert other <= replay
+
+    def test_single_shard_promote_kills_every_token(self):
+        """Deterministic 410: ONE backend failing over changes the epoch
+        sum, so any composite token minted before it is rejected —
+        there is no shard whose watchers silently keep a stale cursor."""
+        s = _sharded(4)
+        _spread_runs(s, 8)
+        token = s.feed_token(s.current_seq())
+        assert s.parse_since(token) == s.current_seq()
+        s.backends[2].promote()
+        with pytest.raises(StaleEpochError):
+            s.parse_since(token)
+        fresh = s.feed_token(s.current_seq())
+        assert s.parse_since(fresh) == s.current_seq()
+
+    def test_per_shard_compaction_floor_raises_composite_410(
+            self, tmp_path):
+        from polyaxon_tpu.api.replication import snapshot_to
+
+        s = _sharded(3)
+        _spread_runs(s, 12, status="queued")
+        manifest = snapshot_to(s, str(tmp_path / "snap"), keep=0)
+        assert manifest["num_shards"] == 3
+        with pytest.raises(CompactedLogError) as exc:
+            s.get_changelog(0, 100)
+        # the floor is a composite: at least one component is the
+        # pruning shard's floor
+        floors = unpack_seqs(exc.value.floor, 3)
+        assert any(f > 0 for f in floors)
+        # at the head: nothing pruned is needed — clean empty page
+        assert s.get_changelog(s.current_seq(), 100) == []
+
+    def test_apply_changelog_demuxes_back_to_shards(self):
+        primary, standby = _sharded(3), _sharded(3)
+        rows = _spread_runs(primary, 10, status="queued")
+        standby.set_read_only(True)
+        feed = primary.get_changelog(0, 10_000)
+        applied = standby.apply_changelog(feed)
+        assert applied == len(feed)
+        assert standby._applied_seq == primary.current_seq()
+        for r in rows:
+            got = standby.get_run(r["uuid"])
+            assert got is not None and got["status"] == "queued"
+        # idempotent: replaying the same tail applies nothing
+        assert standby.apply_changelog(feed) == 0
+
+    def test_apply_changelog_rejects_unstitched_rows(self):
+        s = _sharded(2)
+        with pytest.raises(ValueError, match="stitched"):
+            s.apply_changelog([{"seq": 1, "epoch": 0, "op": "run",
+                               "payload": {}, "created_at": "x"}])
+
+
+# ---------------------------------------------------------------------------
+# replication + HTTP surface
+# ---------------------------------------------------------------------------
+
+
+class TestReplicationAndHttp:
+    def test_replicated_standby_over_the_stitched_feed(self):
+        from polyaxon_tpu.api.replication import ReplicatedStandby
+
+        primary, standby = _sharded(3), _sharded(3)
+        rows = _spread_runs(primary, 9, status="queued")
+        repl = ReplicatedStandby(primary, standby, poll_interval=0.01)
+        repl.poll_once()
+        assert repl.lag == 0
+        for r in rows:
+            assert standby.get_run(r["uuid"])["status"] == "queued"
+        # incremental tail after the first catch-up
+        more = _spread_runs(primary, 4)
+        repl.poll_once()
+        for r in more:
+            assert standby.get_run(r["uuid"]) is not None
+        # promotion: the standby becomes writable, epoch sum moves
+        repl.promote()
+        assert not standby.read_only
+        assert standby.current_epoch() > 0
+
+    @pytest.fixture()
+    def srv(self, tmp_path):
+        from polyaxon_tpu.api.server import ApiServer
+
+        server = ApiServer(artifacts_root=str(tmp_path / "art"), port=0,
+                           store=ShardedStore(":memory:", shards=3))
+        server.api.stream.poll_interval = 0.05
+        server.api.stream.keepalive_s = 0.4
+        server.start()
+        yield server
+        server.stop()
+
+    def test_changelog_endpoint_serves_the_stitched_feed(self, srv):
+        _spread_runs(srv.store, 8, status="queued")
+        r = requests.get(f"{srv.url}/api/v1/changelog",
+                         params={"after": 0, "limit": 1000}, timeout=5)
+        assert r.status_code == 200
+        data = r.json()
+        seqs = [row["seq"] for row in data["rows"]]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert {row["shard"] for row in data["rows"]} == {0, 1, 2}
+        assert data["seq"] == srv.store.current_seq()
+        # resume from mid-feed over HTTP: no loss, no duplicates
+        mid = seqs[len(seqs) // 2]
+        r2 = requests.get(f"{srv.url}/api/v1/changelog",
+                          params={"after": mid, "limit": 1000}, timeout=5)
+        assert [row["seq"] for row in r2.json()["rows"]] == \
+            [q for q in seqs if q > mid]
+
+    def test_http_standby_replicates_a_sharded_primary(self, srv):
+        from polyaxon_tpu.api.replication import (
+            HttpReplicationSource,
+            ReplicatedStandby,
+        )
+
+        rows = _spread_runs(srv.store, 6, status="queued")
+        standby = ShardedStore(":memory:", shards=3)
+        repl = ReplicatedStandby(HttpReplicationSource(srv.url), standby,
+                                 poll_interval=0.01)
+        repl.poll_once()
+        for r in rows:
+            assert standby.get_run(r["uuid"])["status"] == "queued"
+        assert standby._applied_seq == srv.store.current_seq()
+
+    def test_snapshot_endpoint_is_shard_scoped(self, srv, tmp_path):
+        _spread_runs(srv.store, 5)
+        r = requests.get(f"{srv.url}/api/v1/store/snapshot", timeout=10)
+        assert r.status_code == 400
+        assert r.json()["num_shards"] == 3
+        r = requests.get(f"{srv.url}/api/v1/store/snapshot",
+                         params={"shard": 99}, timeout=10)
+        assert r.status_code == 400
+        r = requests.get(f"{srv.url}/api/v1/store/snapshot",
+                         params={"shard": 1}, timeout=10)
+        assert r.status_code == 200
+        assert r.headers["X-Snapshot-Seq"] == \
+            str(srv.store.backends[1].current_seq())
+
+    def test_stats_reports_the_shard_count(self, srv):
+        data = requests.get(f"{srv.url}/api/v1/stats", timeout=5).json()
+        assert data["store_state"]["store_num_shards"] == 3
+
+    def test_sse_last_event_id_resumes_loss_free_across_shards(self, srv):
+        """The ISSUE-14 resume contract over the stitched feed: commit
+        transitions on several shards while NOBODY is subscribed, resume
+        from the last delivered token, replay in order without loss."""
+        from test_stream import Collector, _statuses
+
+        from polyaxon_tpu.client import RunClient
+
+        col = Collector(RunClient(srv.url, project="p"))
+        try:
+            assert col.wait_for(lambda c: c.of_type("hello"))
+            rows = _spread_runs(srv.store, 3)
+            assert col.wait_for(
+                lambda c: len({e["data"]["uuid"]
+                               for e in c.of_type("run")}) == 3)
+        finally:
+            col.close()
+        token = col.of_type("run")[-1]["id"]
+        for r in rows:  # committed while nobody watches, multi-shard
+            for st in ("compiled", "queued"):
+                srv.store.transition(r["uuid"], st)
+        col2 = Collector(RunClient(srv.url, project="p"), since=token)
+        try:
+            assert col2.wait_for(
+                lambda c: all("queued" in _statuses(c, r["uuid"])
+                              for r in rows))
+            for r in rows:
+                assert _statuses(col2, r["uuid"]) == [
+                    "compiled", "queued"]
+        finally:
+            col2.close()
+
+    def test_stream_pre_failover_token_is_410(self, srv):
+        _spread_runs(srv.store, 4)
+        token = srv.store.feed_token(srv.store.current_seq())
+        srv.store.backends[1].promote()
+        r = requests.get(f"{srv.url}/api/v1/streams/runs",
+                         headers={"Last-Event-ID": token},
+                         timeout=5, stream=True)
+        assert r.status_code == 410
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos gating
+# ---------------------------------------------------------------------------
+
+
+class TestChaosGating:
+    def test_every_gated_verb_exists_on_the_sharded_store(self):
+        """FaultyStore's method list and the sharded surface must not
+        drift: a gated verb that does not exist would silently never
+        fault (getattr would raise instead of gating)."""
+        from polyaxon_tpu.resilience.chaos import FaultyStore
+
+        s = _sharded(2)
+        for name in FaultyStore._DEFAULT_METHODS:
+            assert callable(getattr(s, name)), name
+
+    def test_faulty_store_gates_routing_and_stitching_verbs(self):
+        from polyaxon_tpu.resilience.chaos import FaultyStore
+
+        s = _sharded(2)
+        rows = _spread_runs(s, 4)
+        faulty = FaultyStore(s, fault_rate=1.0)
+        for call in (
+            lambda: faulty.count_runs(),
+            lambda: faulty.get_changelog(0, 10),
+            lambda: faulty.feed_token(0),
+            lambda: faulty.since_token(rows[0]),
+            lambda: faulty.current_seq(),
+            lambda: faulty.transition_many(
+                [(rows[0]["uuid"], "compiled")]),
+            lambda: faulty.find_cached_run("p", "k"),
+            lambda: faulty.cluster_load(),
+        ):
+            with pytest.raises(sqlite3.OperationalError):
+                call()
+        # the wrapped store was never touched: one verb, one gate, no
+        # half-merged fan-out
+        assert s.get_run(rows[0]["uuid"])["status"] == "created"
+
+    def test_outage_store_blocks_the_whole_surface(self):
+        from polyaxon_tpu.api.replication import StoreUnavailableError
+        from polyaxon_tpu.resilience.chaos import OutageStore
+
+        s = _sharded(2)
+        _spread_runs(s, 2)
+        outage = OutageStore(s)
+        assert outage.count_runs() == 2  # alive: passes through
+        outage.kill_store()
+        for call in (lambda: outage.count_runs(),
+                     lambda: outage.get_changelog(0, 10),
+                     lambda: outage.list_runs(limit=5)):
+            with pytest.raises(StoreUnavailableError):
+                call()
+        outage.revive()
+        assert outage.count_runs() == 2
+
+
+# ---------------------------------------------------------------------------
+# count_runs fast path (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class TestCountFastPath:
+    def test_unfiltered_counts_come_from_the_row_counters(self):
+        s = Store(":memory:")
+        for i in range(5):
+            s.create_run("a", spec=JOB, name=f"a{i}")
+        for i in range(3):
+            s.create_run("b", spec=JOB, name=f"b{i}")
+        assert s.count_runs() == 8
+        assert s.count_runs(project="a") == 5
+        assert s.count_runs(project="nope") == 0
+        assert s.stats["count_fast"] >= 3
+        assert s.stats["count_slow"] == 0
+        # filtered counts stay on the exact slow path
+        assert s.count_runs(status="created") == 8
+        assert s.stats["count_slow"] == 1
+
+    def test_counters_track_creates_and_deletes(self):
+        s = Store(":memory:")
+        rows = [s.create_run("p", spec=JOB, name=f"r{i}")
+                for i in range(4)]
+        assert s.count_runs(project="p") == 4
+        s.delete_run(rows[0]["uuid"])
+        assert s.count_runs(project="p") == 3
+        s.create_run("p", spec=JOB, name="again")
+        assert s.count_runs(project="p") == 4
+
+    def test_drift_reconcile_repairs_and_counts(self):
+        s = Store(":memory:")
+        _ = [s.create_run("p", spec=JOB, name=f"r{i}") for i in range(3)]
+        assert s.count_runs(project="p") == 3  # seeds the cache
+        s._run_counts["p"] += 5  # simulated drift (a bug, a replica...)
+        s.count_reconcile_every = 1
+        assert s.count_runs(project="p") == 3  # repaired, not served stale
+        assert s.stats["count_drift_repairs"] >= 1
+
+    def test_changelog_replay_invalidates_the_cache(self):
+        primary, standby = Store(":memory:"), Store(":memory:")
+        _ = [primary.create_run("p", spec=JOB, name=f"r{i}")
+             for i in range(4)]
+        standby.set_read_only(True)
+        assert standby.count_runs() == 0  # cache seeded at 0
+        standby.apply_changelog(primary.get_changelog(0, 1000))
+        assert standby.count_runs() == 4  # replay invalidated it
+
+    def test_sharded_count_sums_per_shard_fast_paths(self):
+        s = _sharded(4)
+        _spread_runs(s, 13)
+        assert s.count_runs() == 13
+        assert s.count_runs(project="p") == 13
+        assert s.stats["count_fast"] > 0
+
+
+# ---------------------------------------------------------------------------
+# shard-scoped resync (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestShardScopedResync:
+    def test_list_runs_shards_param_reads_only_those_backends(self):
+        s = _sharded(4)
+        rows = _spread_runs(s, 20, status="queued")
+        before = [b.stats["runs_deserialized"] for b in s.backends]
+        got = s.list_runs(statuses=["queued"], shards=[1], limit=500,
+                          order="asc")
+        after = [b.stats["runs_deserialized"] for b in s.backends]
+        assert {shard_index(r["uuid"], 4) for r in got} <= {1}
+        assert sorted(r["uuid"] for r in got) == sorted(
+            r["uuid"] for r in rows if shard_index(r["uuid"], 4) == 1)
+        for i in (0, 2, 3):
+            assert after[i] == before[i], \
+                f"backend {i} was scanned for a shard-1-scoped listing"
+        assert after[1] > before[1]
+
+    def test_cold_start_resync_scans_only_the_owned_shards(self, tmp_path):
+        """The PERFORMANCE.md follow-up, closed: an agent resyncing
+        shard i over the sharded store reads backend i — the other K-1
+        backends' run tables are not touched at all."""
+        from polyaxon_tpu.scheduler.agent import LocalAgent
+
+        s = _sharded(4)
+        _spread_runs(s, 16, status="queued")
+        agent = LocalAgent(s, str(tmp_path), num_shards=4,
+                           poll_interval=0.05)
+        try:
+            before = [b.stats["runs_deserialized"] for b in s.backends]
+            agent.cold_start_resync(shards=["shard-2"])
+            after = [b.stats["runs_deserialized"] for b in s.backends]
+            for i in (0, 1, 3):
+                assert after[i] == before[i], \
+                    f"backend {i} scanned during a shard-2 resync"
+            assert after[2] > before[2]
+        finally:
+            agent.stop()
+
+    def test_unaligned_partitions_fall_back_to_the_filtered_scan(
+            self, tmp_path):
+        """Agent partitions != store shards: the scoped scan kwarg must
+        NOT be sent (the hash spaces differ); the Python filter keeps
+        correctness."""
+        from polyaxon_tpu.scheduler.agent import LocalAgent
+
+        s = _sharded(4)
+        _spread_runs(s, 8, status="queued")
+        agent = LocalAgent(s, str(tmp_path), num_shards=2,
+                           poll_interval=0.05)
+        try:
+            agent.cold_start_resync(shards=["shard-1"])
+            # every queued run the agent adopted hashes into ITS
+            # shard-1 under num_shards=2
+            assert agent._pending_set
+            for uuid in list(agent._pending_set):
+                assert shard_index(uuid, 2) == 1
+        finally:
+            agent.stop()
