@@ -36,11 +36,17 @@ WRITE_VERBS = frozenset({
     "create_run", "create_runs", "transition", "transition_many",
     "update_run", "merge_outputs", "record_launch_intent",
     "mark_launched", "adopt_launch", "annotate_status", "place_run",
+    # sweep write-ahead windows (ISSUE 19): a trial intent or its
+    # created-marker written without the pipeline shard's fence lets a
+    # dead driver keep planting windows a successor already owns
+    "record_trial_intents", "mark_trials_created",
 })
 
 #: root-relative path prefixes where the discipline applies — the
 #: modules that drive run lifecycles on an agent's behalf
-SCOPE_PREFIXES = ("scheduler/", "operator/", "resilience/heartbeat.py")
+SCOPE_PREFIXES = ("scheduler/", "operator/", "resilience/heartbeat.py",
+                  # the sweep driver launches trial runs (ISSUE 19)
+                  "hypertune/")
 
 #: receivers trusted by convention: the fenced proxy's canonical names
 CANONICAL = ("self.store", "store")

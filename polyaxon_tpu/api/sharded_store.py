@@ -710,6 +710,10 @@ for _name in (
     "annotate_status", "delete_run", "record_launch_intent",
     "mark_launched", "adopt_launch", "get_launch_intent", "add_lineage",
     "get_lineage", "serve_replica_drain", "serve_progress", "place_run",
+    # sweep trial intents (ISSUE 19): first arg is the sweep (pipeline)
+    # uuid, so intents land on the SAME shard as the pipeline row and the
+    # children created under its fence
+    "record_trial_intents", "mark_trials_created", "list_trial_intents",
 ):
     setattr(ShardedStore, _name, _run_scoped(_name))
 

@@ -121,6 +121,31 @@ CREATE TABLE IF NOT EXISTS launch_intents (
     created_at TEXT NOT NULL,
     updated_at TEXT NOT NULL
 );
+-- write-ahead trial intents (ISSUE 19): the sweep driver records the
+-- (sweep_uuid, trial_index, params_hash) of a suggestion window BEFORE
+-- create_runs, so a successor adopting the sweep can tell "intent
+-- recorded, trials never created" (re-derive the same suggestion — the
+-- sampler is seeded per (sweep_uuid, trial_index) — and launch exactly
+-- once) from "trials created, marker stale" (adopt the child rows).
+-- params_hash is the replay audit: a re-derived suggestion that hashes
+-- differently is a determinism bug and fails loudly, never silently
+-- launching a divergent trial under a recorded index.
+-- suggestion is the full {params, meta} JSON: recovery launches the
+-- RECORDED window verbatim (exactly-once even when other trials finished
+-- between the corpse's propose and the successor's replay), while
+-- params_hash audits that a re-derived proposal from the same history
+-- agrees (the per-(sweep_uuid, trial_index) seeding contract).
+CREATE TABLE IF NOT EXISTS trial_intents (
+    sweep_uuid TEXT NOT NULL,
+    trial_index INTEGER NOT NULL,
+    params_hash TEXT,
+    suggestion TEXT,
+    run_uuid TEXT,
+    state TEXT NOT NULL,
+    created_at TEXT NOT NULL,
+    updated_at TEXT NOT NULL,
+    PRIMARY KEY (sweep_uuid, trial_index)
+);
 -- per-tenant chip quotas (ISSUE 15): the admission/fair-share budget the
 -- agent walks against. One row per tenant; absent tenants fall back to
 -- the 'default' row (or unlimited when none exists) — loudly, via a
@@ -402,6 +427,8 @@ class Store(StoreBackend):
         # agent.py asserts it), so the counters are part of the contract.
         self.stats = {"transactions": 0, "runs_deserialized": 0,
                       "fence_rejections": 0, "launch_intents": 0,
+                      # write-ahead sweep suggestion windows (ISSUE 19)
+                      "trial_intents": 0,
                       "epoch_fence_rejections": 0,
                       # data-plane self-healing counters (ISSUE 8):
                       # accumulated by DELTA from the cumulative counts
@@ -508,6 +535,8 @@ class Store(StoreBackend):
             ("fence_rejections",
              "Fenced writes rejected for a stale lease token"),
             ("launch_intents", "Write-ahead launch intents recorded"),
+            ("trial_intents",
+             "Write-ahead sweep trial intents recorded (ISSUE 19)"),
             ("epoch_fence_rejections",
              "Fenced writes rejected because their token predates the "
              "store epoch (a write from before a failover)"),
@@ -1558,6 +1587,103 @@ class Store(StoreBackend):
             (json.dumps(meta), _now(), seq, run_uuid))
         self._log_run_row(conn, run_uuid, seq=seq)
 
+    # -- trial intents (write-ahead sweep windows, ISSUE 19) ---------------
+
+    _TRIAL_INTENT_COLS = ("sweep_uuid", "trial_index", "params_hash",
+                          "suggestion", "run_uuid", "state", "created_at",
+                          "updated_at")
+
+    def record_trial_intents(self, sweep_uuid: str, entries: list,
+                             fence=None) -> list[dict]:
+        """Write-ahead rows for one suggestion window: commit every
+        (trial_index, params_hash) of the window in ONE transaction BEFORE
+        ``create_runs``. A crash after this commit but before the children
+        exist leaves state='intent' rows with no matching child: the
+        successor re-derives the same suggestions (the sampler is seeded
+        per (sweep_uuid, trial_index)) and launches them exactly once. A
+        replayed window whose re-derived hash disagrees with the recorded
+        one raises — a silent divergence here is a duplicated trial with a
+        different identity, the exact bug the intent exists to prevent."""
+        self._check_writable()
+        out: list[dict] = []
+        with self._transition_lock:
+            with self._conn_ctx() as conn:
+                self._check_fence(conn, fence)
+                now = _now()
+                for e in entries:
+                    idx = int(e["trial_index"])
+                    phash = e.get("params_hash")
+                    sugg = e.get("suggestion")
+                    if sugg is not None and not isinstance(sugg, str):
+                        sugg = json.dumps(sugg, sort_keys=True)
+                    prev = conn.execute(
+                        "SELECT params_hash, suggestion, run_uuid, state, "
+                        "created_at FROM trial_intents WHERE sweep_uuid=? "
+                        "AND trial_index=?", (sweep_uuid, idx)).fetchone()
+                    if prev is not None:
+                        if phash and prev[0] and phash != prev[0]:
+                            raise RuntimeError(
+                                f"trial intent replay mismatch for sweep "
+                                f"{sweep_uuid} trial {idx}: recorded hash "
+                                f"{prev[0]} != re-derived {phash}")
+                        out.append({"sweep_uuid": sweep_uuid,
+                                    "trial_index": idx,
+                                    "params_hash": prev[0],
+                                    "suggestion": prev[1],
+                                    "run_uuid": prev[2], "state": prev[3],
+                                    "created_at": prev[4], "updated_at": now})
+                        continue
+                    conn.execute(
+                        "INSERT INTO trial_intents (sweep_uuid, trial_index, "
+                        "params_hash, suggestion, run_uuid, state, "
+                        "created_at, updated_at) "
+                        "VALUES (?,?,?,?,NULL,'intent',?,?)",
+                        (sweep_uuid, idx, phash, sugg, now, now))
+                    row = {"sweep_uuid": sweep_uuid, "trial_index": idx,
+                           "params_hash": phash, "suggestion": sugg,
+                           "run_uuid": None, "state": "intent",
+                           "created_at": now, "updated_at": now}
+                    self._log_change(conn, "trial_intent", row)
+                    self.stats["trial_intents"] += 1
+                    out.append(row)
+        return out
+
+    def mark_trials_created(self, sweep_uuid: str, entries: list,
+                            fence=None) -> None:
+        """Flip window intents to state='created' AFTER ``create_runs``
+        committed the child rows — the trials exist now; a successor must
+        adopt them by (sweep_uuid, trial_index), never re-create. Entries
+        are ``(trial_index, run_uuid)`` pairs."""
+        self._check_writable()
+        with self._transition_lock:
+            with self._conn_ctx() as conn:
+                self._check_fence(conn, fence)
+                now = _now()
+                for idx, run_uuid in entries:
+                    conn.execute(
+                        "UPDATE trial_intents SET state='created', "
+                        "run_uuid=?, updated_at=? WHERE sweep_uuid=? AND "
+                        "trial_index=?", (run_uuid, now, sweep_uuid,
+                                          int(idx)))
+                    if self._replicate:
+                        row = conn.execute(
+                            f"SELECT {','.join(self._TRIAL_INTENT_COLS)} "
+                            "FROM trial_intents WHERE sweep_uuid=? AND "
+                            "trial_index=?",
+                            (sweep_uuid, int(idx))).fetchone()
+                        if row is not None:
+                            self._log_change(
+                                conn, "trial_intent",
+                                dict(zip(self._TRIAL_INTENT_COLS, row)))
+
+    def list_trial_intents(self, sweep_uuid: str) -> list[dict]:
+        with self._conn_ctx() as conn:
+            rows = conn.execute(
+                f"SELECT {','.join(self._TRIAL_INTENT_COLS)} FROM "
+                "trial_intents WHERE sweep_uuid=? ORDER BY trial_index",
+                (sweep_uuid,)).fetchall()
+        return [dict(zip(self._TRIAL_INTENT_COLS, r)) for r in rows]
+
     # -- runs --------------------------------------------------------------
 
     _RUN_COLS = (
@@ -1763,7 +1889,10 @@ class Store(StoreBackend):
             for table, col in (("runs", "uuid"),
                                ("status_conditions", "run_uuid"),
                                ("lineage", "run_uuid"),
-                               ("launch_intents", "run_uuid")):
+                               ("launch_intents", "run_uuid"),
+                               # a deleted pipeline takes its sweep's
+                               # write-ahead window markers with it
+                               ("trial_intents", "sweep_uuid")):
                 conn.execute(f"DELETE FROM {table} WHERE {col}=?",
                              (p["uuid"],))
         elif op == "project":
@@ -1794,6 +1923,12 @@ class Store(StoreBackend):
                     "attempt", "state", "created_at", "updated_at")
             conn.execute(
                 f"INSERT OR REPLACE INTO launch_intents ({','.join(cols)}) "
+                f"VALUES ({','.join('?' * len(cols))})",
+                [p.get(c) for c in cols])
+        elif op == "trial_intent":
+            cols = self._TRIAL_INTENT_COLS
+            conn.execute(
+                f"INSERT OR REPLACE INTO trial_intents ({','.join(cols)}) "
                 f"VALUES ({','.join('?' * len(cols))})",
                 [p.get(c) for c in cols])
         elif op == "quota":
@@ -2597,6 +2732,8 @@ class Store(StoreBackend):
             conn.execute("DELETE FROM status_conditions WHERE run_uuid=?", (uuid,))
             conn.execute("DELETE FROM lineage WHERE run_uuid=?", (uuid,))
             conn.execute("DELETE FROM launch_intents WHERE run_uuid=?", (uuid,))
+            conn.execute("DELETE FROM trial_intents WHERE sweep_uuid=?",
+                         (uuid,))
             if cur.rowcount > 0:
                 self._log_change(conn, "delete_run", {
                     "uuid": uuid, "project": row[0] if row else None})
@@ -2866,7 +3003,12 @@ class FencedStore:
     _FENCED = ("create_run", "create_runs", "transition", "transition_many",
                "update_run", "merge_outputs", "record_launch_intent",
                "mark_launched", "adopt_launch", "annotate_status",
-               "place_run")
+               "place_run",
+               # sweep write-ahead windows (ISSUE 19): first positional arg
+               # is the sweep (pipeline) uuid, so the default resolver
+               # fences them with the PIPELINE's shard lease — the same
+               # lease that authorizes the tuner's create_runs
+               "record_trial_intents", "mark_trials_created")
 
     def __init__(self, inner, fence_source, on_stale=None):
         import inspect

@@ -886,15 +886,55 @@ async function renderSweep(r) {
     html += `<h3>Parallel coordinates <span class="muted">green = best</span></h3>` +
             parcoords(axes, rows);
   }
+  // crash-safe sweep meta (ISSUE 19): the tuner stamps every trial with
+  // (trial_index, rung, parent_trial) — durable STORE truth, so the rung
+  // ladder and PBT lineage render from the listing alone
+  const sweepKids = kids.filter(k => k.meta && num(k.meta.trial_index));
+  if (sweepKids.length) {
+    const rungs = [...new Set(sweepKids.map(k => k.meta.rung || 0))].sort((a, b) => a - b);
+    if (rungs.length > 1 || (rungs.length === 1 && rungs[0] > 0)) {
+      html += `<h3>Rungs</h3><table class="cmp"><tr><th>rung</th>` +
+        `<th>trials</th><th>done</th><th>best ${esc(sweepMetric)}</th></tr>`;
+      for (const rg of rungs) {
+        const at = sweepKids.filter(k => (k.meta.rung || 0) === rg);
+        const fin = at.filter(k => num((k.outputs || {})[sweepMetric]))
+                      .map(k => k.outputs[sweepMetric]);
+        const best = fin.length
+          ? (sweepMax ? Math.max(...fin) : Math.min(...fin)) : null;
+        html += `<tr><td>${rg}</td><td>${at.length}</td><td>${fin.length}</td>` +
+          `<td>${best === null ? "" : fmt(best)}</td></tr>`;
+      }
+      html += `</table>`;
+    }
+  }
+  const byIndex = {};
+  for (const k of sweepKids) byIndex[k.meta.trial_index] = k;
+  const trialCell = k => {
+    if (!(k.meta && num(k.meta.trial_index))) return "";
+    let cell = `#${k.meta.trial_index}`;
+    if (num(k.meta.rung) && k.meta.rung > 0) cell += ` r${k.meta.rung}`;
+    if (k.meta.parent_trial != null) {
+      // PBT exploit lineage: forked from the parent's checkpoint
+      const par = Object.values(byIndex).find(p => p.uuid === k.meta.parent_trial);
+      cell += ` <span class="muted" title="forked from ` +
+        `${esc(par ? label(par) : String(k.meta.parent_trial).slice(0, 8))}` +
+        `" style="cursor:help">&#8618;</span>`;
+    }
+    return cell;
+  };
+  const hasTrials = sweepKids.length > 0;
   const ranked = [...done].sort((a, b) =>
     sweepMax ? b.outputs[sweepMetric] - a.outputs[sweepMetric]
              : a.outputs[sweepMetric] - b.outputs[sweepMetric]);
   html += `<h3>Leaderboard</h3><table class="cmp"><tr><th>#</th><th>run</th>` +
+    (hasTrials ? `<th>trial</th>` : "") +
     `<th>status</th><th>${esc(sweepMetric)}</th>` +
     pkeys.map(p => `<th>${esc(p)}</th>`).join("") + `</tr>`;
   ranked.slice(0, 10).forEach((k, i) => {
     html += `<tr class="${i === 0 ? "winner" : ""} krow" data-u="${k.uuid}">` +
-      `<td>${i + 1}</td><td>${esc(label(k))}</td><td>${stBadge(k.status)}</td>` +
+      `<td>${i + 1}</td><td>${esc(label(k))}</td>` +
+      (hasTrials ? `<td class="muted">${trialCell(k)}</td>` : "") +
+      `<td>${stBadge(k.status)}</td>` +
       `<td>${fmt(k.outputs[sweepMetric])}</td>` +
       pkeys.map(p => `<td>${num((k.inputs || {})[p]) ? fmt(k.inputs[p]) : ""}</td>`).join("") +
       `</tr>`;

@@ -539,6 +539,88 @@ def ops_delete(uuid, project, host):
     click.echo("deleted")
 
 
+# -- sweeps (ISSUE 19) -------------------------------------------------------
+
+
+@cli.group()
+def sweep():
+    """Inspect hyperparameter sweeps (durable tuner state)."""
+
+
+@sweep.command("ls")
+@click.argument("uuid")
+@click.option("--project", "-p", default=None)
+@click.option("--host", default=None)
+@click.option("--metric", default="loss", help="objective output to rank by")
+@click.option("--max", "maximize", is_flag=True, help="higher is better")
+@click.option("--limit", default=1000)
+def sweep_ls(uuid, project, host, metric, maximize, limit):
+    """Rungs, trials and the current best of one sweep.
+
+    Reads the durable trial meta the tuner stamps onto every child run
+    — ``(trial_index, rung, parent_trial)`` is STORE truth, so the table
+    renders identically before and after an agent takeover or a store
+    failover. In local mode the pending write-ahead intent windows
+    (recorded but not yet marked created) are listed too."""
+    rc, local = _ops_client(host, project)
+    pipe = rc.refresh(uuid) if rc else local[0].get_run(uuid)
+    if not pipe:
+        raise click.ClickException("sweep run not found")
+    kids = rc.list(pipeline_uuid=uuid, limit=limit) if rc \
+        else local[0].list_runs(pipeline_uuid=uuid, limit=limit)
+    trials = sorted(
+        (k for k in kids
+         if isinstance((k.get("meta") or {}).get("trial_index"), int)),
+        key=lambda k: k["meta"]["trial_index"])
+    click.echo(f"sweep {uuid}  status={pipe['status']}  "
+               f"trials={len(trials)}")
+    if not trials:
+        click.echo("no trials recorded yet")
+        return
+
+    def score(k):
+        v = (k.get("outputs") or {}).get(metric)
+        return v if isinstance(v, (int, float)) else None
+
+    # rung ladder: trial counts + per-rung best of the objective
+    rungs = sorted({k["meta"].get("rung") or 0 for k in trials})
+    if len(rungs) > 1 or rungs[0] > 0:
+        click.echo("rung  trials  done  best")
+        for rg in rungs:
+            at = [k for k in trials if (k["meta"].get("rung") or 0) == rg]
+            vals = [score(k) for k in at if score(k) is not None]
+            best = (max(vals) if maximize else min(vals)) if vals else None
+            click.echo(f"{rg:>4}  {len(at):>6}  {len(vals):>4}  "
+                       f"{best if best is not None else '-'}")
+        click.echo("")
+    click.echo(f"trial  rung  status        {metric:<12} parent    uuid")
+    best_k = None
+    for k in trials:
+        v = score(k)
+        if v is not None and (best_k is None
+                              or (v > score(best_k) if maximize
+                                  else v < score(best_k))):
+            best_k = k
+        parent = k["meta"].get("parent_trial")
+        click.echo(f"{k['meta']['trial_index']:>5}  "
+                   f"{k['meta'].get('rung') or 0:>4}  "
+                   f"{k['status']:<12}  "
+                   f"{v if v is not None else '-':<12} "
+                   f"{(parent or '-')[:8]:<8}  {k['uuid']}")
+    if best_k is not None:
+        click.echo(f"best: trial {best_k['meta']['trial_index']} "
+                   f"{metric}={score(best_k)} "
+                   f"params={json.dumps(best_k.get('inputs') or {})}")
+    if not rc:
+        # write-ahead windows still open: intent committed, create_runs
+        # not yet marked — the exactly-once protocol's in-flight edge
+        pending = [i for i in local[0].list_trial_intents(uuid)
+                   if i.get("state") != "created"]
+        if pending:
+            click.echo(f"pending intent windows: "
+                       f"{[i['trial_index'] for i in pending]}")
+
+
 # -- observability -----------------------------------------------------------
 
 

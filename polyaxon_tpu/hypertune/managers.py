@@ -22,6 +22,7 @@ from ..schemas.matrix import (
     V1Hyperopt,
     V1Iterative,
     V1Mapping,
+    V1Pbt,
     V1RandomSearch,
 )
 from . import space
@@ -47,10 +48,42 @@ class BaseManager:
 
     def __init__(self, config: Any):
         self.config = config
+        #: set by :meth:`bind_sweep` — switches sampling from the
+        #: manager-private sequential generator to per-trial derived seeds
+        self.sweep_uuid: Optional[str] = None
 
     @property
     def concurrency(self) -> int:
         return getattr(self.config, "concurrency", None) or 4
+
+    def bind_sweep(self, sweep_uuid: str) -> None:
+        """Tie this manager's draws to a sweep identity (ISSUE 19): every
+        fresh sample is seeded per ``(sweep_uuid, trial identity)`` via
+        :func:`space.trial_rng`, so a successor that rebuilt history from
+        the store re-derives the SAME proposals the corpse made — a
+        process-local sequential generator cannot replay. Unbound managers
+        (direct library use, old tests) keep the sequential behavior."""
+        self.sweep_uuid = sweep_uuid
+
+    def restore(self, observations: list[Observation],
+                trial_metas: list[dict]) -> None:
+        """Rebuild internal cursors from store truth on sweep adoption.
+        ``observations`` are the finished trials; ``trial_metas`` are the
+        metas of every trial issued but not yet observed (live children
+        AND pending write-ahead intents — both consumed manager budget).
+        Default: stateless managers need nothing."""
+
+    def _draw_rng(self, identity: Any) -> np.random.Generator:
+        """The generator for one trial's draws: derived per identity when
+        the manager is bound to a sweep, the sequential one otherwise."""
+        if self.sweep_uuid is not None:
+            return space.trial_rng(self.sweep_uuid, identity,
+                                   getattr(self.config, "seed", None))
+        rng = getattr(self, "_rng", None)
+        if rng is None:
+            rng = self._rng = np.random.default_rng(
+                getattr(self.config, "seed", None))
+        return rng
 
     def done(self, observations: list[Observation]) -> bool:
         raise NotImplementedError
@@ -111,10 +144,21 @@ class RandomSearchManager(BaseManager):
     def done(self, obs: list[Observation]) -> bool:
         return len(obs) >= self.config.num_runs
 
+    def _sample_window(self, base: int, n: int) -> list[Suggestion]:
+        """``n`` fresh suggestions for global sample indices base..base+n-1.
+        Bound managers seed each index independently (replay-stable);
+        unbound ones consume the sequential generator as before."""
+        if self.sweep_uuid is None:
+            return [Suggestion(params=p) for p in
+                    space.sample_suggestions(self.config.params, n,
+                                             self._draw_rng(None))]
+        return [Suggestion(params=space.sample_suggestions(
+                    self.config.params, 1, self._draw_rng(base + i))[0])
+                for i in range(n)]
+
     def suggest(self, obs: list[Observation]) -> list[Suggestion]:
         n = self.config.num_runs - len(obs)
-        return [Suggestion(params=p)
-                for p in space.sample_suggestions(self.config.params, n, self._rng)]
+        return self._sample_window(len(obs), n)
 
 
 class IterativeManager(RandomSearchManager):
@@ -132,8 +176,7 @@ class IterativeManager(RandomSearchManager):
 
     def suggest(self, obs: list[Observation]) -> list[Suggestion]:
         n = self.config.max_iterations - len(obs)
-        return [Suggestion(params=p)
-                for p in space.sample_suggestions(self.config.params, n, self._rng)]
+        return self._sample_window(len(obs), n)
 
 
 class HyperbandManager(BaseManager):
@@ -173,6 +216,19 @@ class HyperbandManager(BaseManager):
     def done(self, obs: list[Observation]) -> bool:
         return self._cursor >= len(self._schedule)
 
+    def restore(self, observations: list[Observation],
+                trial_metas: list[dict]) -> None:
+        """Advance the schedule cursor past every (bracket, rung) that
+        store truth shows was already issued — adoption resumes at the
+        first un-issued rung instead of re-running the bracket."""
+        issued = set()
+        for m in [o.trial_meta for o in observations] + list(trial_metas):
+            if m.get("bracket") is not None and m.get("rung") is not None:
+                issued.add((int(m["bracket"]), int(m["rung"])))
+        for j, (s, i) in enumerate(self._schedule):
+            if (s, i) in issued:
+                self._cursor = max(self._cursor, j + 1)
+
     def suggest(self, obs: list[Observation]) -> list[Suggestion]:
         if self.done(obs):
             return []
@@ -182,7 +238,16 @@ class HyperbandManager(BaseManager):
         resource = self.config.resource
         budget = resource.cast(r_i)
         if i == 0:
-            params = space.sample_suggestions(self.config.params, n_i, self._rng)
+            if self.sweep_uuid is None:
+                params = space.sample_suggestions(
+                    self.config.params, n_i, self._rng)
+            else:
+                # seed each base config per (sweep, bracket, slot) so a
+                # replayed rung re-derives the same configs
+                params = [space.sample_suggestions(
+                              self.config.params, 1,
+                              self._draw_rng(f"b{s}c{j}"))[0]
+                          for j in range(n_i)]
         else:
             # promote top n_i from the previous rung of this bracket
             prev = [o for o in obs if o.trial_meta.get("bracket") == s
@@ -259,13 +324,34 @@ class AshaManager(HyperbandManager):
                 return Suggestion(
                     params=params, meta={"rung": k + 1, "config_id": cid})
         if self._sampled < self.budget:
-            params = space.sample_suggestions(self.config.params, 1, self._rng)[0]
+            rng = (self._rng if self.sweep_uuid is None
+                   else self._draw_rng(self._sampled))
+            params = space.sample_suggestions(self.config.params, 1, rng)[0]
             params[self.config.resource.name] = self.rung_resource(0)
             sugg = Suggestion(
                 params=params, meta={"rung": 0, "config_id": self._sampled})
             self._sampled += 1
             return sugg
         return None
+
+    def restore(self, observations: list[Observation],
+                trial_metas: list[dict]) -> None:
+        """Rebuild the sampled-config counter and the promoted sets from
+        store truth: a trial meta at rung k+1 proves config_id was
+        promoted out of rung k (issued promotions are consumed even when
+        the promoted trial is still running — or was only committed as a
+        write-ahead intent). config_ids are assigned densely from 0, so
+        the counter is max(id)+1."""
+        top = -1
+        for m in [o.trial_meta for o in observations] + list(trial_metas):
+            cid = m.get("config_id")
+            if cid is None:
+                continue
+            top = max(top, int(cid))
+            rung = int(m.get("rung", 0))
+            if 0 < rung <= self.s_max:
+                self._promoted.setdefault(rung - 1, set()).add(cid)
+        self._sampled = max(self._sampled, top + 1)
 
     def done(self, obs: list[Observation]) -> bool:
         # only meaningful between propose calls: budget exhausted and no
@@ -288,6 +374,150 @@ class AshaManager(HyperbandManager):
     def suggest(self, obs: list[Observation]) -> list[Suggestion]:
         # sync fallback (e.g. a driver that never learned the async
         # protocol): one trial at a time is still barrier-free enough
+        return self.propose(obs, 1)
+
+
+class PbtManager(BaseManager):
+    """Population based training (Jaderberg et al. 2017; ISSUE 19) — the
+    first consumer of PR-13's checkpoint-fork machinery.
+
+    ``population`` members train in generations of ``max_iterations``
+    resource units each. When member m finishes generation g-1, exploit
+    ranks the cohort's latest scores: a bottom-``quartile`` (or failed)
+    member abandons its weights and forks a top-quartile survivor's
+    checkpoint — the child's meta carries ``parent_trial`` (the survivor's
+    run uuid) and the tuner plumbs it into the runtime's ``fork_from``
+    (``Checkpointer.restore_raw`` + ``init_state_from``) — while explore
+    perturbs the survivor's hyperparameters. Survivors continue from
+    their OWN previous trial's checkpoint with params unchanged (also a
+    fork: every generation is a fresh run). All draws are seeded per
+    ``(sweep_uuid, m<member>g<generation>)``, so an adopted population
+    replays its exploit/explore decisions deterministically given the
+    same observed history.
+
+    Level-triggered like ASHA: ``propose`` derives everything from the
+    observation list plus the issued-set, which :meth:`restore` rebuilds
+    from store truth on adoption."""
+
+    asynchronous = True
+    config: V1Pbt
+
+    def __init__(self, config: V1Pbt):
+        super().__init__(config)
+        self.population = int(config.population)
+        self.generations = int(config.num_generations)
+        #: (member, generation) pairs already proposed — consumed budget,
+        #: whether the trial is finished, live, or only a pending intent
+        self._issued: set = set()
+
+    @property
+    def concurrency(self) -> int:
+        return self.config.concurrency or self.population
+
+    def restore(self, observations: list[Observation],
+                trial_metas: list[dict]) -> None:
+        for m in [o.trial_meta for o in observations] + list(trial_metas):
+            if m.get("member") is not None and m.get("generation") is not None:
+                self._issued.add((int(m["member"]), int(m["generation"])))
+
+    def _by_member_gen(self, obs: list[Observation]) -> dict:
+        out: dict = {}
+        for o in obs:
+            m, g = o.trial_meta.get("member"), o.trial_meta.get("generation")
+            if m is not None and g is not None:
+                out[(int(m), int(g))] = o
+        return out
+
+    def _budget_params(self, params: dict) -> dict:
+        res = self.config.resource
+        params = dict(params)
+        params[res.name] = res.cast(self.config.max_iterations)
+        return params
+
+    def _perturb(self, params: dict, rng: np.random.Generator) -> dict:
+        """Explore: numeric hps ×/÷ perturb_factor, any hp resampled from
+        its distribution with resample_prob (off-grid values are the
+        point — PBT walks the space the grid can't express)."""
+        out = dict(params)
+        f = float(self.config.perturb_factor)
+        for name, hp in self.config.params.items():
+            v = out.get(name)
+            if rng.random() < float(self.config.resample_prob):
+                out[name] = space.sample_param(hp, rng)
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[name] = float(v * (f if rng.random() < 0.5 else 1.0 / f))
+        return out
+
+    def propose(self, obs: list[Observation], n: int) -> list[Suggestion]:
+        by = self._by_member_gen(obs)
+        q = max(1, int(round(self.population * float(self.config.quartile))))
+        out: list[Suggestion] = []
+        for m in range(self.population):
+            if len(out) >= max(n, 0):
+                break
+            g = 0
+            while (m, g) in self._issued:
+                g += 1
+            if g >= self.generations:
+                continue
+            rng = self._draw_rng(f"m{m}g{g}")
+            if g == 0:
+                params = space.sample_suggestions(
+                    self.config.params, 1, rng)[0]
+                sugg = Suggestion(
+                    params=self._budget_params(params),
+                    meta={"member": m, "generation": 0, "rung": 0,
+                          "config_id": m})
+            else:
+                prev = by.get((m, g - 1))
+                if prev is None:
+                    continue  # previous generation still in flight
+                cohort = sorted(
+                    ((mm, o) for mm in range(self.population)
+                     for o in [by.get((mm, g - 1))]
+                     if o is not None and o.metric is not None),
+                    key=lambda t: t[1].metric, reverse=self._maximize())
+                failed = prev.metric is None
+                bottom = {mm for mm, _ in cohort[len(cohort) - q:]}
+                if failed and not cohort:
+                    continue  # nobody to fork from; member stays dead
+                if failed or (m in bottom and len(cohort) > q):
+                    # exploit: fork a top-quartile survivor, explore its hps
+                    top = cohort[:q]
+                    pm, po = top[int(rng.integers(0, len(top)))]
+                    params = self._perturb(dict(po.params), rng)
+                    parent = po
+                else:
+                    params = dict(prev.params)
+                    parent = prev
+                sugg = Suggestion(
+                    params=self._budget_params(params),
+                    meta={"member": m, "generation": g, "rung": g,
+                          "config_id": m,
+                          "parent_trial": parent.trial_meta.get("uuid")})
+            self._issued.add((m, g))
+            out.append(sugg)
+        return out
+
+    def done(self, obs: list[Observation]) -> bool:
+        by = self._by_member_gen(obs)
+        for m in range(self.population):
+            last = max((g for (mm, g) in by if mm == m), default=-1)
+            if last >= self.generations - 1:
+                continue  # member finished its schedule
+            # a member is only DONE early if it can never advance: its
+            # latest generation failed and no cohort member scored
+            nxt = last + 1
+            if (m, nxt) in self._issued and (m, nxt) not in by:
+                return False  # in flight
+            if last >= 0 and by[(m, last)].metric is None and not any(
+                    o.metric is not None for (mm, g), o in by.items()
+                    if g == last):
+                continue  # stranded member: nobody to fork from
+            return False
+        return True
+
+    def suggest(self, obs: list[Observation]) -> list[Suggestion]:
         return self.propose(obs, 1)
 
 
@@ -413,6 +643,7 @@ def make_manager(config: Any) -> BaseManager:
         "bayes": BayesManager,
         "hyperopt": HyperoptManager,
         "iterative": IterativeManager,
+        "pbt": PbtManager,
     }
     kind = getattr(config, "kind", None)
     if kind not in kinds:

@@ -4,6 +4,7 @@ sampling, grid enumeration, and numeric encoding for model-based search
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import math
 from typing import Any, Optional
@@ -11,6 +12,22 @@ from typing import Any, Optional
 import numpy as np
 
 from ..schemas.matrix import GRID_KINDS
+
+
+def trial_rng(sweep_uuid: str, trial_index: Any,
+              seed: Optional[int] = None) -> np.random.Generator:
+    """Deterministic generator for ONE trial's draws, keyed by
+    ``(sweep_uuid, trial_index)`` (+ the search's declared seed).
+
+    This is what makes a replayed ``propose()`` agree with history
+    (ISSUE 19): a successor agent that adopts a sweep and re-derives a
+    lost suggestion window gets the SAME parameters the corpse committed
+    in its trial intent — a shared mutable generator would have advanced
+    past them. ``trial_index`` may be any stable identity token (ASHA
+    uses the config_id, PBT uses ``m<member>g<generation>``)."""
+    key = f"{sweep_uuid}:{trial_index}:{'' if seed is None else int(seed)}"
+    digest = hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest()
+    return np.random.default_rng(int.from_bytes(digest, "big"))
 
 
 def sample_param(hp: Any, rng: np.random.Generator) -> Any:

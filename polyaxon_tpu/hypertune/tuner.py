@@ -30,10 +30,14 @@ chips allow, not a process count.
 from __future__ import annotations
 
 import copy
+import hashlib
+import json
 import os
+import sqlite3
 import time
 from typing import Any, Optional
 
+from ..api.replication import StoreUnavailableError
 from ..api.store import Store
 from ..schemas.matrix import V1FailureEarlyStopping, V1MetricEarlyStopping
 from ..schemas.operation import V1Operation
@@ -41,9 +45,49 @@ from ..schemas.statuses import V1Statuses, is_done
 from ..schemas.tpu import SliceTopology, SubSliceAssignment, pack_subslices
 from .managers import Observation, Suggestion, make_manager
 
+#: sweep metric families (ISSUE 19) — registered from birth by the agent
+#: (:func:`register_sweep_metrics`), incremented by the tuner through the
+#: SAME registry, so one strict /metrics scrape covers both layers
+SWEEP_TRIALS_HELP = "Sweep trials by lifecycle state"
+SWEEP_PROMOTIONS_HELP = "ASHA/Hyperband rung promotions launched"
+PBT_FORKS_HELP = "PBT exploit forks launched (checkpoint reuse)"
+SWEEP_LIVE_HELP = "In-flight trials of active sweep drivers"
+
+#: meta keys the tuner (or the launch-intent machinery) stamps on child
+#: rows — everything else in a child's meta is the manager's suggestion
+#: meta, which adoption must hand back to the manager verbatim
+_INFRA_META_KEYS = ("trial_index", "subslice", "sweep_uuid", "params_hash",
+                    "owner")
+
+
+def register_sweep_metrics(registry, live_fn=None) -> None:
+    """Register the sweep families at agent birth (labels included), so a
+    strict scrape sees them at zero before the first sweep runs."""
+    for state in ("launched", "succeeded", "failed", "adopted"):
+        registry.counter("polyaxon_sweep_trials_total", SWEEP_TRIALS_HELP,
+                         labels={"state": state})
+    registry.counter("polyaxon_sweep_promotions_total",
+                     SWEEP_PROMOTIONS_HELP)
+    registry.counter("polyaxon_pbt_forks_total", PBT_FORKS_HELP)
+    registry.gauge("polyaxon_sweep_live_trials", SWEEP_LIVE_HELP,
+                   labels={"sweep": "all"},
+                   value_fn=live_fn or (lambda: 0))
+
+
+def params_hash(params: dict) -> str:
+    """Stable digest of one trial's bound params — the replay-determinism
+    audit carried by both the write-ahead intent and the child's meta."""
+    blob = json.dumps(params, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
 
 class _SweepState:
-    """Mutable state shared by the sync and async tuner loops."""
+    """Mutable state shared by the sync and async tuner loops.
+
+    Since ISSUE 19 this is a CACHE of store truth, not the truth itself:
+    every field is rebuilt by :meth:`Tuner._build_state`'s cold-start scan
+    over child rows + trial intents, so the driver can die at any point
+    and a successor resumes the sweep exactly where it stopped."""
 
     def __init__(self, concurrency: int, early: list):
         self.concurrency = concurrency
@@ -68,23 +112,40 @@ class _SweepState:
 
 
 class Tuner:
+    #: store errors the driver rides out in place (SQLITE_BUSY weather, a
+    #: failover window before the standby promotes). StaleLeaseError is
+    #: deliberately NOT here: a fenced write means another agent owns the
+    #: sweep now — the driver must die and let the successor's adoption
+    #: scan take over.
+    _TRANSIENT = (sqlite3.OperationalError, StoreUnavailableError)
+
     def __init__(
         self,
         store: Store,
         pipeline_run: dict,
         poll_interval: float = 0.2,
         artifacts_root: Optional[str] = None,
+        adopt: bool = False,
+        metrics=None,
     ):
         self.store = store
         self.pipeline = pipeline_run
         self.poll_interval = poll_interval
         self.artifacts_root = artifacts_root
+        self.adopt = adopt
+        self.metrics = metrics
+        self.sweep_uuid = pipeline_run["uuid"]
+        #: read by the agent's per-sweep live-trials gauge
+        self.live_trials = 0
         spec = pipeline_run["spec"]
         op = V1Operation.from_dict(spec)
         if op.matrix is None:
             raise ValueError("pipeline run has no matrix section")
         self.matrix = op.matrix
         self.manager = make_manager(self.matrix)
+        # per-(sweep_uuid, trial) seeded draws: a replayed propose() after
+        # adoption agrees with the corpse's recorded intents
+        self.manager.bind_sweep(self.sweep_uuid)
         self.metric = getattr(self.matrix, "metric", None)
         if self.metric is not None:
             self.metric_name = self.metric.name
@@ -99,12 +160,28 @@ class Tuner:
             self.metric_name = es_metrics[0] if es_metrics else "loss"
         self._child_spec = self._make_child_spec(spec)
         self.assignments = self._plan_subslices(op)
+        #: windows whose intent committed but whose create didn't (found
+        #: by adoption, or left by a transient create failure in-process):
+        #: (trial_index, Suggestion), launched before anything new
+        self._pending: list[tuple[int, Suggestion]] = []
+        #: created children whose intent rows still say 'intent' — the
+        #: mark write hit weather; repaired level-triggered each pass
+        self._unmarked: list[tuple[int, str]] = []
 
     def _make_child_spec(self, spec: dict) -> dict:
         child = copy.deepcopy(spec)
         child.pop("matrix", None)
         child.pop("schedule", None)
+        # trials are preemptible-class tenants (ISSUE 19): ASHA rungs
+        # yield chips to production traffic and resume checkpoint-safe;
+        # an explicit priority on the sweep operation wins
+        child.setdefault("priority", "preemptible")
         return child
+
+    def _count(self, name: str, help_txt: str, labels: Optional[dict] = None,
+               n: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(name, help_txt, labels=labels or {}).inc(n)
 
     # -- sub-slice packing -------------------------------------------------
 
@@ -148,7 +225,15 @@ class Tuner:
         for name, value in sugg.params.items():
             params[name] = {"value": value}
         spec["params"] = params
-        meta: dict[str, Any] = {"trial_index": index, **(sugg.meta or {})}
+        # durable sweep identity (ISSUE 19): everything a successor needs
+        # to rebuild _SweepState lives on the child row itself
+        meta: dict[str, Any] = {
+            "trial_index": index,
+            "sweep_uuid": self.sweep_uuid,
+            "params_hash": params_hash(sugg.params),
+            **(sugg.meta or {}),
+        }
+        meta.setdefault("rung", 0)
         if assignment is not None:
             run = spec.get("component", {}).get("run", {})
             run["topology"] = "x".join(str(d) for d in assignment.shape)
@@ -158,6 +243,9 @@ class Tuner:
                 "origin": list(assignment.origin),
                 "shape": list(assignment.shape),
             }
+        parent = (sugg.meta or {}).get("parent_trial")
+        if parent:
+            self._wire_fork(spec, parent, (sugg.meta or {}).get("fork_step"))
         name = f"{self.pipeline.get('name') or 'sweep'}-t{index}"
         spec["name"] = name
         return dict(
@@ -168,6 +256,33 @@ class Tuner:
             meta=meta,
             pipeline_uuid=self.pipeline["uuid"],
         )
+
+    def _wire_fork(self, spec: dict, parent_uuid: str,
+                   step: Optional[int]) -> None:
+        """Plumb a PBT exploit fork into the child (ISSUE 19, PR-13's fork
+        machinery). Builtin-runtime trials get ``runtime.fork_from`` —
+        the trainer restores the parent's checkpoint read-only
+        (``Checkpointer.restore_raw``) and seeds its own state from it
+        (``init_state_from`` via ``restore_or_init``). Container trials
+        get ``PLX_FORK_PATH``/``PLX_FORK_STEP`` env instead — the trial
+        script loads whatever the parent left in its artifacts dir."""
+        if not self.artifacts_root:
+            return
+        parent_dir = os.path.join(
+            self.artifacts_root, self.pipeline["project"], parent_uuid)
+        run = spec.get("component", {}).get("run", {})
+        if isinstance(run.get("runtime"), dict):
+            run["runtime"]["fork_from"] = {
+                "path": os.path.join(parent_dir, "outputs", "checkpoints"),
+                **({"step": int(step)} if step is not None else {}),
+            }
+            return
+        container = run.get("container")
+        if isinstance(container, dict):
+            env = container.setdefault("env", [])
+            env.append({"name": "PLX_FORK_PATH", "value": parent_dir})
+            if step is not None:
+                env.append({"name": "PLX_FORK_STEP", "value": str(step)})
 
     def _trial_metric(self, run: dict) -> Optional[float]:
         outputs = run.get("outputs") or {}
@@ -221,6 +336,114 @@ class Tuner:
                     return True
         return False
 
+    # -- cold-start rebuild (ISSUE 19) -------------------------------------
+
+    def _list_children(self) -> list[dict]:
+        """Every child row of this sweep, in trial_index order — the
+        durable record _build_state scans."""
+        rows: list[dict] = []
+        offset = 0
+        while True:
+            page = self.store.list_runs(
+                pipeline_uuid=self.sweep_uuid, limit=500, offset=offset,
+                order="asc")
+            rows.extend(r for r in page
+                        if (r.get("meta") or {}).get("trial_index")
+                        is not None)
+            if len(page) < 500:
+                break
+            offset += 500
+        rows.sort(key=lambda r: int(r["meta"]["trial_index"]))
+        return rows
+
+    @staticmethod
+    def _sugg_of(run: dict) -> Suggestion:
+        """Reconstruct the manager's suggestion from a child row: inputs
+        are the bound params; meta is the row's meta minus the keys the
+        tuner/launch machinery stamped on top."""
+        meta = {k: v for k, v in (run.get("meta") or {}).items()
+                if k not in _INFRA_META_KEYS}
+        return Suggestion(params=dict(run.get("inputs") or {}), meta=meta)
+
+    def _build_state(self) -> _SweepState:
+        """Level-triggered rebuild: _SweepState from store truth.
+
+        Child rows are the record of every CREATED trial (finished ones
+        become observations, live ones are adopted into their slots);
+        trial intents cover the propose->create gap (a state='intent' row
+        with no matching child is a window the corpse committed but never
+        created — its recorded suggestion relaunches verbatim, exactly
+        once). The manager's own cursors rebuild from the union of both,
+        so an issued-but-unfinished promotion is never issued twice."""
+        st = _SweepState(self.manager.concurrency,
+                         getattr(self.matrix, "early_stopping", None) or [])
+        if not self.adopt:
+            return st
+        children = self._list_children()
+        intents = self.store.list_trial_intents(self.sweep_uuid)
+        by_index = {int(r["meta"]["trial_index"]): r for r in children}
+        top = -1
+        live_metas: list[dict] = []
+        adopted = 0
+        for run in children:
+            idx = int(run["meta"]["trial_index"])
+            top = max(top, idx)
+            sugg = self._sugg_of(run)
+            if is_done(run["status"]):
+                metric = self._trial_metric(run)
+                ok = run["status"] in (V1Statuses.SUCCEEDED.value,
+                                       V1Statuses.SKIPPED.value)
+                if not ok:
+                    metric = None
+                    st.failures += 1
+                st.observe(sugg, run, metric)
+            else:
+                slot = ((run["meta"].get("subslice") or {}).get("index")
+                        if self.assignments else None)
+                if slot is None or slot not in st.free:
+                    slot = st.free[-1]
+                st.free.remove(slot)
+                st.inflight[slot] = (sugg, run)
+                live_metas.append(run["meta"])
+                adopted += 1
+        for row in intents:
+            idx = int(row["trial_index"])
+            top = max(top, idx)
+            if idx in by_index:
+                if row["state"] != "created":
+                    # created but never marked: repair the marker
+                    self._unmarked.append((idx, by_index[idx]["uuid"]))
+                continue
+            sugg_blob = json.loads(row["suggestion"] or "{}")
+            sugg = Suggestion(params=sugg_blob.get("params") or {},
+                              meta=sugg_blob.get("meta") or {})
+            self._pending.append((idx, sugg))
+            live_metas.append(dict(sugg.meta))
+        st.trial_index = top + 1
+        self._pending.sort(key=lambda t: t[0])
+        self.manager.restore(st.observations, live_metas)
+        if adopted:
+            self._count("polyaxon_sweep_trials_total", SWEEP_TRIALS_HELP,
+                        labels={"state": "adopted"}, n=adopted)
+        return st
+
+    def _flush_pending(self, st: _SweepState) -> None:
+        """Relaunch recovered windows (and retry unmarked intents) before
+        proposing anything new — level-triggered, safe to call every
+        pass."""
+        if self._unmarked:
+            try:
+                self.store.mark_trials_created(self.sweep_uuid,
+                                               list(self._unmarked))
+                self._unmarked = []
+            except self._TRANSIENT:
+                pass  # weather; retried next pass
+        while self._pending and st.free:
+            take = min(len(self._pending), len(st.free))
+            batch, self._pending = self._pending[:take], self._pending[take:]
+            self._launch_many(st, [s for _, s in batch],
+                              indices=[i for i, _ in batch])
+
     # -- the loop ----------------------------------------------------------
 
     def run(self) -> dict[str, Any]:
@@ -233,24 +456,33 @@ class Tuner:
         free slot immediately asks the manager for one more trial
         (promotion or fresh sample); a straggler occupies exactly its own
         slot while every other sub-slice keeps churning (VERDICT r3 #5)."""
-        st = _SweepState(self.manager.concurrency,
-                         getattr(self.matrix, "early_stopping", None) or [])
+        st = self._build_state()
 
         while True:
-            to_launch = []
-            while len(to_launch) < len(st.free):
-                batch = self.manager.propose(st.observations, 1)
-                if not batch:
-                    break
-                to_launch.append(batch[0])
-            if to_launch:
-                self._launch_many(st, to_launch)
+            try:
+                self._flush_pending(st)
+                to_launch = []
+                while len(to_launch) < len(st.free):
+                    batch = self.manager.propose(st.observations, 1)
+                    if not batch:
+                        break
+                    to_launch.append(batch[0])
+                if to_launch:
+                    self._launch_many(st, to_launch)
 
-            if not st.inflight:
-                break  # nothing running, nothing proposable: sweep is done
+                if not st.inflight and not self._pending:
+                    break  # nothing running, nothing proposable: done
 
-            self._check_pipeline_stop(st.inflight)
-            self._reap(st)
+                self._check_pipeline_stop(st.inflight)
+                self._reap(st)
+            except self._TRANSIENT:
+                # store weather (SQLITE_BUSY, a failover window before
+                # the standby promotes): state is level-triggered, so
+                # riding it out in place is always safe
+                time.sleep(self.poll_interval)
+                continue
+            finally:
+                self.live_trials = len(st.inflight)
             if st.target_reached:
                 self._stop_and_drain(st)
                 break
@@ -264,11 +496,17 @@ class Tuner:
             if st.inflight:
                 time.sleep(self.poll_interval)
 
+        self.live_trials = 0
         return self._summary(st.observations, stopped_early=st.target_reached)
 
     def _run_sync(self) -> dict[str, Any]:
-        st = _SweepState(self.manager.concurrency,
-                         getattr(self.matrix, "early_stopping", None) or [])
+        st = self._build_state()
+        if st.inflight or self._pending:
+            # adoption mid-batch: relaunch recovered windows, then drain
+            # the partial batch to observations — sync managers reason in
+            # rung barriers, so the loop below must start at one
+            self._flush_pending(st)
+            self._drain_adopted(st)
 
         while not st.target_reached and not self.manager.done(st.observations):
             batch = self.manager.suggest(st.observations)
@@ -277,13 +515,23 @@ class Tuner:
             queue = list(batch)
             st.reset_slots(min(st.concurrency, max(len(queue), 1)))
 
-            while queue or st.inflight:
-                take = min(len(queue), len(st.free))
-                if take:
-                    self._launch_many(st, [queue.pop(0) for _ in range(take)])
+            while queue or st.inflight or self._pending:
+                try:
+                    self._flush_pending(st)
+                    take = min(len(queue), len(st.free))
+                    if take:
+                        self._launch_many(
+                            st, [queue.pop(0) for _ in range(take)])
 
-                self._check_pipeline_stop(st.inflight)
-                self._reap(st)
+                    self._check_pipeline_stop(st.inflight)
+                    self._reap(st)
+                except self._TRANSIENT:
+                    # store weather: ride it out — parked windows relaunch
+                    # via _flush_pending on the next pass
+                    time.sleep(self.poll_interval)
+                    continue
+                finally:
+                    self.live_trials = len(st.inflight)
                 if st.target_reached:
                     self._stop_and_drain(st)
                     break
@@ -299,30 +547,95 @@ class Tuner:
                         f"failure early stopping: {st.failures}/"
                         f"{st.trial_index} trials failed"
                     )
-                if queue or st.inflight:
+                if queue or st.inflight or self._pending:
                     time.sleep(self.poll_interval)
 
+        self.live_trials = 0
         return self._summary(st.observations, stopped_early=st.target_reached)
+
+    def _drain_adopted(self, st: _SweepState) -> None:
+        """Sync-manager adoption: run the adopted partial batch to
+        completion so the main loop starts at a clean rung barrier."""
+        while st.inflight or self._pending:
+            try:
+                self._flush_pending(st)
+                self._check_pipeline_stop(st.inflight)
+                self._reap(st)
+            except self._TRANSIENT:
+                pass
+            finally:
+                self.live_trials = len(st.inflight)
+            if st.target_reached:
+                self._stop_and_drain(st)
+                return
+            if st.inflight or self._pending:
+                time.sleep(self.poll_interval)
+        st.reset_slots(st.concurrency)
 
     # -- shared loop mechanics --------------------------------------------
 
-    def _launch_many(self, st: "_SweepState", suggs: list) -> None:
+    def _launch_many(self, st: "_SweepState", suggs: list,
+                     indices: Optional[list[int]] = None) -> None:
         """Create trials for ``suggs`` in free slots (slot index doubles as
         the sub-slice assignment when packing). The whole window is ONE
         store transaction — a 16-wide suggestion batch used to be 32
-        commits (run + condition each)."""
+        commits (run + condition each).
+
+        ISSUE 19 launch protocol: intent -> create -> mark. The window's
+        (index, params_hash, suggestion) rows commit BEFORE create_runs,
+        so a crash between the two leaves recoverable intents instead of
+        silently dropped trials. ``indices`` pins trial indices when
+        relaunching recovered windows (_flush_pending); otherwise indices
+        are allocated from st.trial_index."""
         entries = []
-        for sugg in suggs:
+        for pos, sugg in enumerate(suggs):
+            if indices is not None:
+                index = indices[pos]
+            else:
+                index = st.trial_index
+                st.trial_index += 1
             slot = st.free.pop()
             assignment = self.assignments[slot] if self.assignments else None
             entries.append(
-                (slot, sugg,
-                 self._trial_payload(sugg, st.trial_index, assignment)))
-            st.trial_index += 1
-        rows = self.store.create_runs(
-            self.pipeline["project"], [p for _, _, p in entries])
-        for (slot, sugg, _), row in zip(entries, rows):
+                (slot, index, sugg,
+                 self._trial_payload(sugg, index, assignment)))
+        try:
+            self.store.record_trial_intents(self.sweep_uuid, [
+                {"trial_index": index,
+                 "params_hash": params_hash(sugg.params),
+                 "suggestion": {"params": sugg.params,
+                                "meta": sugg.meta or {}}}
+                for _, index, sugg, _ in entries])
+            rows = self.store.create_runs(
+                self.pipeline["project"], [p for _, _, _, p in entries])
+        except self._TRANSIENT:
+            # store weather mid-launch: treat it like a crash at this exact
+            # point — park the window in _pending (indices are burned, the
+            # intents that DID commit will replay these very suggestions)
+            # and give the slots back
+            for slot, index, sugg, _ in entries:
+                st.free.append(slot)
+                self._pending.append((index, sugg))
+            self._pending.sort(key=lambda t: t[0])
+            raise
+        marks = []
+        for (slot, index, sugg, _), row in zip(entries, rows):
             st.inflight[slot] = (sugg, row)
+            marks.append((index, row["uuid"]))
+            meta = sugg.meta or {}
+            if meta.get("parent_trial"):
+                self._count("polyaxon_pbt_forks_total", PBT_FORKS_HELP)
+            elif meta.get("rung", 0) and "config_id" in meta:
+                self._count("polyaxon_sweep_promotions_total",
+                            SWEEP_PROMOTIONS_HELP)
+        self._count("polyaxon_sweep_trials_total", SWEEP_TRIALS_HELP,
+                    labels={"state": "launched"}, n=len(rows))
+        try:
+            self.store.mark_trials_created(self.sweep_uuid, marks)
+        except self._TRANSIENT:
+            # children exist; only the marker write hit weather — repaired
+            # level-triggered by _flush_pending
+            self._unmarked.extend(marks)
 
     def _reap(self, st: "_SweepState") -> None:
         """One poll pass: record finished trials as observations, free
@@ -342,6 +655,8 @@ class Tuner:
                     metric = None
                     st.failures += 1
                 st.observe(sugg, trial, metric)
+                self._count("polyaxon_sweep_trials_total", SWEEP_TRIALS_HELP,
+                            labels={"state": "succeeded" if ok else "failed"})
                 if self._metric_value_met(metric, st.early):
                     st.target_reached = True
             elif run["status"] == V1Statuses.RUNNING.value:

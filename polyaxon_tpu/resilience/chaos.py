@@ -271,6 +271,11 @@ class FaultyStore:
         # renewal must not look like a lost lease to the agent
         "acquire_lease", "renew_lease", "release_lease",
         "record_launch_intent", "mark_launched", "adopt_launch",
+        # sweep trial-intent verbs (ISSUE 19): a suggestion window's
+        # write-ahead commit and the adoption scan behind it see the same
+        # SQLITE_BUSY weather as every other driver write — a blip must
+        # cost one retry, never a lost or doubled trial
+        "record_trial_intents", "mark_trials_created", "list_trial_intents",
         # shard-lease verbs (ISSUE 6): the batched renewal heartbeat and
         # the fair-share listing behind shard acquisition/rebalance ride
         # the same gate, so shard adoption itself is chaos-testable

@@ -362,6 +362,41 @@ def run_builtin(spec: dict[str, Any]) -> dict[str, Any]:
         else:
             init_params = imported
 
+    # PBT exploit fork (ISSUE 19): the tuner pinned a parent trial's
+    # checkpoint dir (+ optionally a step) in runtime.fork_from — restore
+    # it READ-ONLY (the parent may still be training; a writer's purge
+    # would delete its newer steps) and seed this member's state from the
+    # parent's params through restore_or_init's init_params path, exactly
+    # like a foreign-checkpoint import. Resume still beats re-fork: a
+    # preempted fork that already saved its own checkpoint restores THAT.
+    fork_spec = spec.get("fork_from")
+    if fork_spec and trainer.checkpointer is not None \
+            and trainer.checkpointer.latest_complete_step() is not None:
+        print("[builtin] complete checkpoint found; skipping fork restore",
+              flush=True)
+        fork_spec = None
+    if fork_spec:
+        from ..train.checkpoint import CheckpointConfig as _CkptCfg
+        from ..train.checkpoint import Checkpointer as _Ckpt
+
+        ro = _Ckpt(_CkptCfg(directory=fork_spec["path"]), read_only=True)
+        fork_step = fork_spec.get("step")
+        try:
+            raw, restored = ro.restore_raw(
+                step=int(fork_step) if fork_step is not None else None)
+        except Exception as e:
+            if fork_step is None:
+                raise
+            # the pinned step tore with the parent's preemption: fall
+            # back to the parent's newest complete step rather than
+            # failing the member
+            raw, restored = ro.restore_raw()
+            print(f"[builtin] fork step {fork_step} not restorable "
+                  f"({e}); using parent step {restored}", flush=True)
+        init_params = raw["params"] if isinstance(raw, dict) else raw.params
+        print(f"[builtin] forked from {fork_spec['path']} @ step {restored}",
+              flush=True)
+
     t_restore = time.time()
     state, start_step = trainer.restore_or_init(init_params=init_params)
     if run is not None:

@@ -23,6 +23,7 @@ from ..api.store import (
     shard_lease_names,
 )
 from ..compiler.resolver import resolve
+from ..hypertune.tuner import register_sweep_metrics
 from ..federation import (
     failover_lease_name, health_lease_name, is_multislice, parse_placement,
     placement_allows, spill_candidates, validate_placement,
@@ -428,6 +429,14 @@ class LocalAgent:
         self._active: dict[str, LocalExecution] = {}
         self._chips_in_use: dict[str, int] = {}
         self._tuners: dict[str, threading.Thread] = {}
+        # live Tuner driver objects (ISSUE 19): kept alongside the threads
+        # so the from-birth sweep gauge can sum their in-flight trials
+        self._tuner_objs: dict[str, object] = {}
+        register_sweep_metrics(
+            self.metrics,
+            live_fn=lambda: float(sum(
+                getattr(t, "live_trials", 0)
+                for t in list(self._tuner_objs.values()))))
         self._sidecars: dict[str, _RunSidecar] = {}
         # -- tenancy (ISSUE 15, docs/SCHEDULING.md) ------------------------
         # Per-tenant chip quotas turn the per-shard FIFO wait queues into
@@ -1589,6 +1598,16 @@ class LocalAgent:
                 self.store.transition(uuid, V1Statuses.STOPPED.value, force=True)
                 continue
             if _is_pipeline_spec(spec):
+                if spec.get("matrix"):
+                    # sweeps survive driver loss (ISSUE 19): the store
+                    # holds the whole state — child rows + write-ahead
+                    # trial intents — so a successor driver adopts
+                    # mid-rung instead of failing the pipeline
+                    try:
+                        self._start_tuner(run, adopt=True)
+                    except Exception:
+                        traceback.print_exc()
+                    continue
                 self.store.transition(
                     uuid, V1Statuses.FAILED.value, force=True,
                     reason="AgentRestart",
@@ -3644,32 +3663,52 @@ class LocalAgent:
 
     # -- matrix pipelines --------------------------------------------------
 
-    def _start_tuner(self, run: dict) -> None:
+    def _start_tuner(self, run: dict, adopt: bool = False) -> None:
         uuid = run["uuid"]
         if uuid in self._tuners:
             return
         from ..hypertune.tuner import Tuner
 
-        # one transaction for the two-step start edge
-        self.store.transition_many([(uuid, V1Statuses.SCHEDULED.value),
-                                    (uuid, V1Statuses.RUNNING.value)])
+        if not adopt:
+            # one transaction for the two-step start edge
+            self.store.transition_many([(uuid, V1Statuses.SCHEDULED.value),
+                                        (uuid, V1Statuses.RUNNING.value)])
+        elif run["status"] != V1Statuses.RUNNING.value:
+            # adopting a sweep the corpse scheduled but never started
+            self.store.transition(uuid, V1Statuses.RUNNING.value, force=True)
+
+        # construct BEFORE the thread starts so the live-trials gauge and
+        # the resync guard see the driver the moment this method returns;
+        # adoption's store scan happens inside the thread (Tuner.run)
+        tuner = Tuner(self.store, run, artifacts_root=self.artifacts_root,
+                      adopt=adopt, metrics=self.metrics)
 
         def _run_tuner():
             try:
-                tuner = Tuner(self.store, run, artifacts_root=self.artifacts_root)
                 best = tuner.run()
                 self.store.merge_outputs(uuid, {"best": best})
                 self.store.transition(uuid, V1Statuses.SUCCEEDED.value)
+            except StaleLeaseError:
+                # another agent owns the sweep's shard now: its adoption
+                # scan resumes the sweep — exit without a terminal write
+                # (which would itself be fenced anyway)
+                pass
             except Exception as e:
                 traceback.print_exc()
-                self.store.transition(
-                    uuid, V1Statuses.FAILED.value, reason="TunerError", message=str(e)[:500],
-                )
+                try:
+                    self.store.transition(
+                        uuid, V1Statuses.FAILED.value, reason="TunerError",
+                        message=str(e)[:500],
+                    )
+                except StaleLeaseError:
+                    pass
             finally:
                 self._tuners.pop(uuid, None)
+                self._tuner_objs.pop(uuid, None)
 
         t = threading.Thread(target=_run_tuner, daemon=True)
         self._tuners[uuid] = t
+        self._tuner_objs[uuid] = tuner
         t.start()
 
     def _start_dag(self, run: dict) -> None:

@@ -60,6 +60,7 @@ from .matrix import (
     V1MetricEarlyStopping,
     V1OptimizationMetric,
     V1OptimizationResource,
+    V1Pbt,
     V1RandomSearch,
 )
 from .operation import V1CompiledOperation, V1Operation

@@ -285,10 +285,40 @@ class V1Iterative(_BaseSearch):
     seed: Optional[int] = None
 
 
+class V1Pbt(_BaseSearch):
+    """Population based training (Jaderberg et al. 2017; ISSUE 19).
+
+    A population of ``population`` members trains in generations of
+    ``resource`` each. After a member finishes a generation, exploit
+    compares it to its cohort: a bottom-``quartile`` member abandons its
+    weights, forks a top-``quartile`` survivor's checkpoint
+    (``parent_trial`` in the child's meta; the runtime restores it via
+    ``Checkpointer.restore_raw`` + ``init_state_from`` — PR-13's fork
+    machinery), and explore perturbs the survivor's hyperparameters
+    (numeric hps ×/÷ ``perturb_factor``, choices resampled with
+    ``resample_prob``). Survivors continue from their own checkpoints
+    with params unchanged. All draws are seeded per
+    ``(sweep_uuid, member, generation)`` so an adopted population
+    replays its exploit/explore decisions deterministically."""
+
+    kind: Literal["pbt"] = "pbt"
+    population: int
+    num_generations: int
+    # resource units each trial trains per generation (named like the
+    # other kinds' total budget; here the generation IS the unit of work)
+    max_iterations: int
+    resource: V1OptimizationResource
+    metric: V1OptimizationMetric
+    quartile: float = 0.25
+    perturb_factor: float = 1.2
+    resample_prob: float = 0.25
+    seed: Optional[int] = None
+
+
 MatrixUnion = Annotated[
     Union[
         V1Mapping, V1GridSearch, V1RandomSearch, V1Hyperband,
-        V1Bayes, V1Hyperopt, V1Iterative,
+        V1Bayes, V1Hyperopt, V1Iterative, V1Pbt,
     ],
     Field(discriminator="kind"),
 ]

@@ -21,7 +21,7 @@ Usage:
         [--kill-agent] [--split-brain] [--kills 2] [--lease-ttl 0.8] \
         [--agents 4] [--num-shards 8] [--rolling-kill] \
         [--store-outage] [--serve-faults] [--watcher-faults] \
-        [--clusters] [--metrics-dump [PATH]]
+        [--clusters] [--sweeps] [--metrics-dump [PATH]]
 
 ``--watcher-faults`` (ISSUE 14) runs the live-push fault soak: an SSE
 watcher fleet over the real HTTP server with a [primary, warm standby]
@@ -46,6 +46,23 @@ epoch), and the soak asserts oracle convergence, zero duplicate launches,
 promotion < 2x lease TTL, and that a pre-failover fencing token AND a
 pre-failover ``?since=`` cursor are both deterministically rejected
 (epoch fence 409 / 410) — all via the strict /metrics scrape.
+
+``--sweeps`` (ISSUE 19) runs the crash-safe sweep soak: a pinned-uuid
+async-ASHA sweep driven through a [primary, warm standby] store front
+while the agent is hard-killed + replaced twice AND the primary store is
+killed mid-rung (standby promotes). Because every suggestion draw is
+seeded per ``(sweep_uuid, trial_index)`` and every launch window commits
+a write-ahead trial intent before ``create_runs``, each successor agent
+adopts the sweep from store truth and continues the EXACT decision
+sequence: exit 0 requires the surviving child rows to match an offline
+manager simulation trial-for-trial (params hash, rung, config id — zero
+lost, zero duplicated, zero re-decided trials), every intent row marked
+'created' against its child, and a poisoned-fence write probe rejected.
+A PBT population (exploit forks via the checkpoint fork machinery,
+explore perturbs) then runs under one agent kill and must provably beat
+the best STATIC member — its final loss under the analytically chained
+landscape — by a margin, with every fork's parent a real prior-
+generation trial of the same sweep.
 
 ``--serve-faults`` (ISSUE 12) runs the serving fault soak: REAL serve
 pods under a traffic ramp driven through the request-path failover
@@ -1181,6 +1198,444 @@ def _run_store_outage_mode(args) -> int:
                          if oracle["statuses"].get(k)
                          != out["statuses"].get(k)},
             }))
+    finally:
+        if args.keep:
+            print(json.dumps({"workdir": root}))
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+    if args.metrics_dump:
+        _dump_metrics(args.metrics_dump, final_scrape)
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
+#: pinned sweep uuids (ISSUE 19): every per-(sweep_uuid, trial) seeded
+#: draw — space samples, ASHA fresh configs, PBT exploit picks and
+#: perturb coin-flips — is a pure function of these strings, so the
+#: offline oracle simulation and every chaos round replay the exact same
+#: decision sequence
+_ASHA_SWEEP_UUID = "sweep-asha-soak"
+_PBT_SWEEP_UUID = "sweep-pbt-soak"
+
+#: one PBT generation of the analytic landscape: the parent's final loss
+#: chains through PLX_FORK_PATH (the fork machinery's container-trial
+#: surface), and the loss-dependent optimum makes a STATIC lr provably
+#: suboptimal — exploit/explore must track the moving target to win
+_PBT_TRIAL = (
+    "import json, os\n"
+    "p = json.loads(os.environ['PLX_PARAMS'])\n"
+    "lr = float(p['lr'])\n"
+    "L = 100.0\n"
+    "fork = os.environ.get('PLX_FORK_PATH')\n"
+    "if fork:\n"
+    "    with open(os.path.join(fork, 'outputs.json')) as f:\n"
+    "        L = float(json.load(f)['loss'])\n"
+    "opt = 0.6 * (L / 100.0) ** 0.5\n"
+    "L = L * (0.3 + abs(lr - opt))\n"
+    "json.dump({'loss': L}, open(os.path.join(\n"
+    "    os.environ['PLX_ARTIFACTS_PATH'], 'outputs.json'), 'w'))\n"
+)
+
+
+def _pbt_static_loss(lr: float, generations: int = 3) -> float:
+    """What a member that never exploits/explores ends at: the same
+    chained landscape ``_PBT_TRIAL`` computes, evaluated analytically."""
+    L = 100.0
+    for _ in range(generations):
+        opt = 0.6 * (L / 100.0) ** 0.5
+        L = L * (0.3 + abs(lr - opt))
+    return L
+
+
+def _asha_sweep_spec() -> dict:
+    """Concurrency-1 async-ASHA sweep over a convex 1-d landscape.
+
+    ``loss(x, steps) = (x - 3.7)^2 + 8/steps`` — more resource
+    monotonically helps, so rung promotions are meaningful. Concurrency 1
+    makes the greedy async promotion rule a deterministic function of the
+    (seeded) draw sequence: the offline manager simulation IS the oracle
+    and the chaos pass must reproduce it trial-for-trial. (At
+    concurrency > 1 async ASHA's promotions legitimately depend on
+    completion order — that surface is covered by the tier-1 fault-
+    injection units in tests/test_hypertune.py, not by status parity.)"""
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "asha-soak",
+        "termination": {"maxRetries": 3},
+        "matrix": {
+            "kind": "hyperband", "asynchronous": True, "concurrency": 1,
+            "maxIterations": 9, "eta": 3, "numRuns": 6,
+            "resource": {"name": "steps", "type": "int"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "params": {"x": {"kind": "uniform", "value": [0, 8]}},
+            "seed": 7,
+        },
+        "component": {
+            "kind": "component",
+            "inputs": [{"name": "x", "type": "float"},
+                       {"name": "steps", "type": "int",
+                        "isOptional": True}],
+            "run": {"kind": "job", "container": {"command": [
+                sys.executable, "-c",
+                "import json, os, time; "
+                "p = json.loads(os.environ['PLX_PARAMS']); "
+                "x = float(p['x']); s = int(p['steps']); "
+                "time.sleep(0.03 * s); "
+                "json.dump({'loss': (x - 3.7) ** 2 + 8.0 / s}, "
+                "open(os.path.join(os.environ['PLX_ARTIFACTS_PATH'], "
+                "'outputs.json'), 'w'))",
+            ]}},
+        },
+    }).to_dict()
+
+
+def _pbt_sweep_spec() -> dict:
+    """PBT population over the loss-chained landscape (``_PBT_TRIAL``):
+    4 members x 3 generations, perturb x/÷ 2.0. The win-audit compares
+    the population's best final loss against the best member's STATIC
+    trajectory computed analytically from the recorded gen-0 draws."""
+    from polyaxon_tpu.polyaxonfile import check_polyaxonfile
+
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": "pbt-soak",
+        "termination": {"maxRetries": 3},
+        "matrix": {
+            "kind": "pbt", "population": 4, "numGenerations": 3,
+            "maxIterations": 1,
+            "resource": {"name": "steps", "type": "int"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "perturbFactor": 2.0, "resampleProb": 0.25,
+            "params": {"lr": {"kind": "uniform", "value": [0.05, 0.9]}},
+            "seed": 11,
+        },
+        "component": {
+            "kind": "component",
+            "inputs": [{"name": "lr", "type": "float"},
+                       {"name": "steps", "type": "int",
+                        "isOptional": True}],
+            "run": {"kind": "job", "container": {"command": [
+                sys.executable, "-c", _PBT_TRIAL,
+            ]}},
+        },
+    }).to_dict()
+
+
+def _simulate_asha(spec: dict, sweep_uuid: str) -> list[dict]:
+    """Offline oracle for the ASHA arm: replay the manager's decision
+    sequence against the analytic loss. Same matrix parse, same
+    ``bind_sweep`` seeding, same concurrency-1 propose/observe loop the
+    Tuner runs — returns the expected (params_hash, rung, config_id)
+    per trial_index. A chaos pass whose surviving store truth differs
+    from this list LOST, DUPLICATED or RE-DECIDED a trial."""
+    from polyaxon_tpu.hypertune.managers import Observation, make_manager
+    from polyaxon_tpu.hypertune.tuner import params_hash
+    from polyaxon_tpu.schemas import V1Operation
+
+    op = V1Operation.from_dict(spec)
+    mgr = make_manager(op.matrix)
+    mgr.bind_sweep(sweep_uuid)
+    obs: list = []
+    seq: list[dict] = []
+    while True:
+        batch = mgr.propose(obs, 1)
+        if not batch:
+            break
+        sugg = batch[0]
+        loss = ((float(sugg.params["x"]) - 3.7) ** 2
+                + 8.0 / int(sugg.params["steps"]))
+        seq.append({"params_hash": params_hash(sugg.params),
+                    "rung": int((sugg.meta or {}).get("rung", 0)),
+                    "config_id": (sugg.meta or {}).get("config_id"),
+                    "loss": loss})
+        obs.append(Observation(params=sugg.params, metric=loss,
+                               trial_meta={**(sugg.meta or {}),
+                                           "uuid": f"sim-{len(seq)}"}))
+    return seq
+
+
+def run_sweep_soak(workdir: str, *, spec: dict, sweep_uuid: str,
+                   seed: int = 2024, kills: int = 0,
+                   kill_store: bool = False, lease_ttl: float = 0.8,
+                   timeout: float = 300.0) -> dict:
+    """One crash-safe-sweep pass (ISSUE 19): drive a pinned-uuid sweep
+    pipeline through a [primary, warm standby] store front under one
+    agent; hard-kill + replace the agent ``kills`` times (each successor
+    must ADOPT the live sweep from store truth — intent rows + child
+    rows — and continue the exact decision sequence), then optionally
+    kill the primary store mid-rung (the standby promotes and the tuner
+    rides the failover on re-derived observations). After each kill a
+    poisoned-fence ``record_trial_intents`` probe plays the corpse's
+    in-flight suggestion window: it must be rejected, never inserted.
+
+    Returns the full store-truth audit surface: child rows sorted by
+    trial_index, intent rows, pipeline outputs, the shared scrape, and
+    the crash-safety counters."""
+    from polyaxon_tpu.api.replication import FailoverStore, ReplicatedStandby
+    from polyaxon_tpu.api.store import StaleLeaseError, Store
+    from polyaxon_tpu.obs.metrics import MetricsRegistry
+    from polyaxon_tpu.operator import FakeCluster
+    from polyaxon_tpu.resilience import OutageStore
+    from polyaxon_tpu.scheduler.agent import LocalAgent
+
+    rng = random.Random(seed)
+    # ONE registry across primary + standby: the sweep counters must stay
+    # continuous through promotion, like every other soak's pane of glass
+    reg = MetricsRegistry()
+    primary = Store(":memory:", metrics=reg)
+    gate = OutageStore(primary)
+    standby = Store(":memory:", metrics=reg)
+    snap_dir = os.path.join(workdir, "snapshots")
+    primary.snapshot(snap_dir)
+    repl = ReplicatedStandby(
+        gate, standby, poll_interval=0.02,
+        promote_after=(lease_ttl if kill_store else None),
+        snapshot_dir=snap_dir)
+    repl.bootstrap()
+    repl.start()
+    front = FailoverStore([gate, standby])
+    cluster = FakeCluster(os.path.join(workdir, ".cluster"))
+
+    def new_agent():
+        return LocalAgent(front, workdir, backend="cluster",
+                          cluster=cluster, poll_interval=0.05,
+                          lease_ttl=lease_ttl, max_parallel=4).start()
+
+    agent = new_agent()
+    stale_rejected = 0
+    promote_s = None
+    try:
+        front.create_run("p", spec=spec, name=spec.get("name"),
+                         uuid=sweep_uuid)
+        for _ in range(kills):
+            time.sleep(rng.uniform(0.6, 1.4))
+            agent.hard_kill()
+            # the corpse's tuner thread replays its in-flight suggestion
+            # window: the write-ahead intent must bounce off the poisoned
+            # fence (a success would plant a junk row the audit catches)
+            try:
+                agent.store.record_trial_intents(sweep_uuid, [{
+                    "trial_index": 999999, "params_hash": "corpse",
+                    "suggestion": {"params": {}, "meta": {}}}])
+            except StaleLeaseError:
+                stale_rejected += 1
+            except Exception:
+                pass
+            agent = new_agent()  # cold_start_resync ADOPTS the live sweep
+        if kill_store:
+            time.sleep(rng.uniform(0.4, 1.0))  # mid-rung
+            gate.kill_store()
+            t_kill = time.monotonic()
+            deadline = time.monotonic() + 10.0 * lease_ttl
+            while not repl.promoted and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if not repl.promoted:
+                raise RuntimeError("standby never promoted")
+            promote_s = round(time.monotonic() - t_kill, 3)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            row = front.get_run(sweep_uuid)
+            if row["status"] in ("succeeded", "failed", "stopped"):
+                break
+            time.sleep(0.1)
+        serving = standby if kill_store else primary
+        pipeline = front.get_run(sweep_uuid)
+        children = [r for r in serving.list_runs(
+                        pipeline_uuid=sweep_uuid, limit=500)
+                    if (r.get("meta") or {}).get("trial_index") is not None]
+        children.sort(key=lambda r: r["meta"]["trial_index"])
+        return {
+            "pipeline_status": pipeline["status"],
+            "best": (pipeline.get("outputs") or {}).get("best"),
+            "children": children,
+            "intents": serving.list_trial_intents(sweep_uuid),
+            "metrics_text": reg.render(),
+            "promote_s": promote_s,
+            "stale_writes_rejected": stale_rejected,
+            "fence_rejections": serving.stats["fence_rejections"],
+            "duplicate_applies": list(
+                getattr(cluster, "duplicate_applies", [])),
+            "launch_counts": dict(getattr(cluster, "launch_counts", {})),
+        }
+    finally:
+        repl.stop()
+        agent.stop()
+
+
+def _audit_sweep(out: dict, sim: list[dict]) -> list[str]:
+    """Store-truth vs oracle-simulation conjunction for the ASHA arm.
+    Empty list == the sweep survived with ZERO lost, duplicated or
+    re-decided trials and exactly-once intent accounting."""
+    problems: list[str] = []
+    by_index: dict[int, dict] = {}
+    for row in out["children"]:
+        idx = int(row["meta"]["trial_index"])
+        if idx in by_index:
+            problems.append(f"trial_index {idx} has more than one child")
+        by_index[idx] = row
+    if sorted(by_index) != list(range(len(sim))):
+        problems.append(
+            f"trial indices {sorted(by_index)} != 0..{len(sim) - 1}")
+    intents = {int(r["trial_index"]): r for r in out["intents"]}
+    if sorted(intents) != sorted(by_index):
+        problems.append("intent rows do not cover exactly the children")
+    for idx in sorted(by_index):
+        row, meta = by_index[idx], by_index[idx]["meta"]
+        if row["status"] != "succeeded":
+            problems.append(f"trial {idx}: status {row['status']}")
+        if idx < len(sim):
+            want = sim[idx]
+            if meta.get("params_hash") != want["params_hash"]:
+                problems.append(f"trial {idx}: params_hash diverged "
+                                "from the oracle simulation")
+            if int(meta.get("rung", 0)) != want["rung"]:
+                problems.append(
+                    f"trial {idx}: rung {meta.get('rung')} != "
+                    f"{want['rung']} (promotion sequence diverged)")
+            if meta.get("config_id") != want["config_id"]:
+                problems.append(f"trial {idx}: config_id diverged")
+        intent = intents.get(idx)
+        if intent is None:
+            continue
+        if intent["state"] != "created":
+            problems.append(f"trial {idx}: intent left '{intent['state']}'")
+        if intent["run_uuid"] != row["uuid"]:
+            problems.append(f"trial {idx}: intent bound to a different run")
+        if intent["params_hash"] != meta.get("params_hash"):
+            problems.append(f"trial {idx}: intent/child params_hash split")
+    return problems
+
+
+def _audit_pbt(out: dict, margin: float = 0.9) -> dict:
+    """PBT win + lineage audit: exactly-once trials, every fork's parent
+    a real previous-generation trial of the same sweep, and the
+    population's best final loss beating the best STATIC member (the
+    analytically chained trajectory of the best gen-0 draw) by
+    ``margin``."""
+    problems: list[str] = []
+    children = out["children"]
+    if out["pipeline_status"] != "succeeded":
+        problems.append(f"pipeline ended {out['pipeline_status']}")
+    by_uuid = {r["uuid"]: r for r in children}
+    idxs = sorted(int(r["meta"]["trial_index"]) for r in children)
+    if idxs != list(range(len(children))):
+        problems.append("trial indices not contiguous/unique")
+    intents = {int(r["trial_index"]): r for r in out["intents"]}
+    if sorted(intents) != idxs:
+        problems.append("intent rows do not cover exactly the children")
+    forks = 0
+    for row in children:
+        meta = row["meta"]
+        idx = int(meta["trial_index"])
+        intent = intents.get(idx)
+        if intent is not None and (intent["state"] != "created"
+                                   or intent["run_uuid"] != row["uuid"]):
+            problems.append(f"trial {idx}: intent not marked against "
+                            "its child")
+        if row["status"] != "succeeded":
+            problems.append(f"trial {idx}: status {row['status']}")
+        parent = meta.get("parent_trial")
+        gen = int(meta.get("generation", 0))
+        if gen > 0 and not parent:
+            problems.append(f"trial {idx}: generation {gen} without a "
+                            "fork parent")
+        if parent:
+            forks += 1
+            prow = by_uuid.get(parent)
+            if prow is None:
+                problems.append(f"trial {idx}: fork parent is not a "
+                                "trial of this sweep")
+            elif int(prow["meta"].get("generation", 0)) != gen - 1:
+                problems.append(f"trial {idx}: fork parent generation "
+                                "mismatch")
+    if out["duplicate_applies"]:
+        problems.append("duplicate pod applies")
+    gen0 = [r for r in children
+            if int(r["meta"].get("generation", 0)) == 0]
+    best_static = (min(_pbt_static_loss(float(r["inputs"]["lr"]))
+                       for r in gen0) if gen0 else None)
+    losses = [float((r.get("outputs") or {}).get("loss"))
+              for r in children
+              if (r.get("outputs") or {}).get("loss") is not None]
+    best_pbt = min(losses) if losses else None
+    if forks < 1:
+        problems.append("no exploit forks recorded")
+    if (best_pbt is None or best_static is None
+            or not best_pbt < margin * best_static):
+        problems.append(
+            f"pbt best {best_pbt} did not beat the best static member "
+            f"{best_static} by margin {margin}")
+    return {"ok": not problems, "problems": problems, "forks": forks,
+            "trials": len(children), "best_pbt": best_pbt,
+            "best_static": best_static}
+
+
+def _run_sweeps_mode(args) -> int:
+    root = tempfile.mkdtemp(prefix="plx-sweep-soak-")
+    ok = True
+    final_scrape = ""
+    try:
+        asha_spec = _asha_sweep_spec()
+        sim = _simulate_asha(asha_spec, _ASHA_SWEEP_UUID)
+        # fault-free pass FIRST: if the undisturbed sweep can't reproduce
+        # the offline simulation, chaos parity would be meaningless
+        oracle = run_sweep_soak(
+            os.path.join(root, "oracle"), spec=asha_spec,
+            sweep_uuid=_ASHA_SWEEP_UUID, seed=args.seed, kills=0,
+            kill_store=False, lease_ttl=args.lease_ttl,
+            timeout=args.timeout)
+        final_scrape = oracle["metrics_text"]
+        problems = _audit_sweep(oracle, sim)
+        print(json.dumps({"pass": "oracle",
+                          "trials": len(oracle["children"]),
+                          "sim_trials": len(sim),
+                          "pipeline": oracle["pipeline_status"],
+                          "best": oracle["best"],
+                          "problems": problems}))
+        if oracle["pipeline_status"] != "succeeded" or problems:
+            print(json.dumps({"error": "fault-free sweep did not match "
+                                       "the offline oracle simulation"}))
+            return 2
+        for i in range(args.rounds):
+            seed = args.seed + i
+            out = run_sweep_soak(
+                os.path.join(root, f"asha-{seed}"), spec=asha_spec,
+                sweep_uuid=_ASHA_SWEEP_UUID, seed=seed, kills=args.kills,
+                kill_store=True, lease_ttl=args.lease_ttl,
+                timeout=args.timeout)
+            final_scrape = out["metrics_text"]
+            problems = _audit_sweep(out, sim)
+            round_ok = (out["pipeline_status"] == "succeeded"
+                        and not problems
+                        and not out["duplicate_applies"]
+                        and out["stale_writes_rejected"] >= 1
+                        and out["promote_s"] is not None
+                        and out["promote_s"] < 2.0 * args.lease_ttl)
+            ok = ok and round_ok
+            print(json.dumps({
+                "pass": f"sweep-asha-{seed}", "ok": round_ok,
+                "trials": len(out["children"]),
+                "pipeline": out["pipeline_status"],
+                "promote_s": out["promote_s"],
+                "stale_writes_rejected": out["stale_writes_rejected"],
+                "fence_rejections": out["fence_rejections"],
+                "duplicate_applies": out["duplicate_applies"],
+                "problems": problems,
+            }))
+        pbt = run_sweep_soak(
+            os.path.join(root, "pbt"), spec=_pbt_sweep_spec(),
+            sweep_uuid=_PBT_SWEEP_UUID, seed=args.seed, kills=1,
+            kill_store=False, lease_ttl=args.lease_ttl,
+            timeout=args.timeout)
+        final_scrape = pbt["metrics_text"]
+        report = _audit_pbt(pbt)
+        ok = ok and report["ok"]
+        print(json.dumps({
+            "pass": "sweep-pbt", **report,
+            "stale_writes_rejected": pbt["stale_writes_rejected"],
+        }))
     finally:
         if args.keep:
             print(json.dumps({"workdir": root}))
@@ -2715,6 +3170,17 @@ def main() -> int:
                         "every pre-failover token/cursor, and converge to "
                         "the fault-free oracle with zero duplicate "
                         "launches and zero lost terminal transitions")
+    p.add_argument("--sweeps", action="store_true",
+                   help="crash-safe sweep soak (ISSUE 19): a pinned-uuid "
+                        "async-ASHA sweep under --kills agent kills + a "
+                        "primary-store kill must converge with ZERO "
+                        "lost/duplicated/re-decided trials — child rows "
+                        "matching the offline manager simulation "
+                        "trial-for-trial, every write-ahead intent "
+                        "marked 'created' against its child; then a PBT "
+                        "population (exploit forks + explore perturbs) "
+                        "under 1 agent kill must provably beat its best "
+                        "static member's final loss")
     p.add_argument("--lock-witness", nargs="?", metavar="PATH",
                    const=_artifact_path("lock_witness.json"),
                    default=None,
@@ -2734,7 +3200,7 @@ def main() -> int:
     if args.lock_witness and (args.train_faults or args.serve_traffic
                               or args.serve_faults or args.store_outage
                               or args.watcher_faults or args.tenants
-                              or args.clusters):
+                              or args.clusters or args.sweeps):
         # refuse rather than silently run unwitnessed: an operator who
         # asked for the witness must not read a lucky exit 0 as
         # "cycle-free" when no locks were instrumented
@@ -2756,6 +3222,8 @@ def main() -> int:
         return _run_serve_faults_mode(args)
     if args.serve_traffic:
         return _run_serve_traffic_mode(args)
+    if args.sweeps:
+        return _run_sweeps_mode(args)
     if args.store_outage:
         return _run_store_outage_mode(args)
     if (args.kill_agent or args.split_brain or args.rolling_kill

@@ -1,0 +1,25 @@
+"""R19 reproducer — the ISSUE 19 unfenced-sweep class: a tuner driving
+trial launches through a RAW store handle. A dead driver incarnation
+would keep committing intent windows and creating trial runs for a
+sweep a successor agent already adopted — duplicate trials under fresh
+indices, the exact corruption the write-ahead protocol exists to stop."""
+
+from polyaxon_tpu.api.store import Store
+
+
+class BadTuner:
+    def __init__(self, path: str, sweep_uuid: str):
+        # raw store under a non-canonical name: nothing fences the
+        # sweep's launch protocol
+        self.db = Store(path)
+        self.sweep = sweep_uuid
+
+    def launch_window(self, entries: list, payloads: list) -> None:
+        self.db.record_trial_intents(self.sweep, entries)  # BAD
+        rows = self.db.create_runs("proj", payloads)  # BAD
+        self.db.mark_trials_created(
+            self.sweep, [(e["trial_index"], r["uuid"])
+                         for e, r in zip(entries, rows)])  # BAD
+
+    def finish(self, best: dict) -> None:
+        self.db.merge_outputs(self.sweep, {"best": best})  # BAD

@@ -1,0 +1,39 @@
+"""R19 clean twin — the sanctioned sweep-launch shapes: the whole
+write-ahead window (intent -> create -> mark) rides the agent's
+FencedStore handle under the canonical ``store`` name, or carries an
+explicit ``fence=`` resolved from the pipeline's shard lease."""
+
+from polyaxon_tpu.api.store import FencedStore
+
+
+class GoodTuner:
+    def __init__(self, store, sweep_uuid: str):
+        # the agent hands its FencedStore down; the tuner keeps it under
+        # the canonical name so every window write carries the shard fence
+        self.store = store
+        self.sweep = sweep_uuid
+
+    def launch_window(self, entries: list, payloads: list) -> None:
+        self.store.record_trial_intents(self.sweep, entries)
+        rows = self.store.create_runs("proj", payloads)
+        self.store.mark_trials_created(
+            self.sweep, [(e["trial_index"], r["uuid"])
+                         for e, r in zip(entries, rows)])
+
+    def finish(self, best: dict) -> None:
+        self.store.merge_outputs(self.sweep, {"best": best})
+
+
+class ExplicitFence:
+    def __init__(self, raw, fence_source):
+        self.fenced = FencedStore(raw, fence_source)
+
+    def repair_marker(self, raw_store, sweep: str, marks: list,
+                      token: int) -> None:
+        # a one-off repair may write through the raw handle only by
+        # carrying the shard fence explicitly
+        raw_store.mark_trials_created(sweep, marks,
+                                      fence=("shard-3", token))
+
+    def replay_window(self, sweep: str, entries: list) -> None:
+        self.fenced.record_trial_intents(sweep, entries)  # proxy-tracked

@@ -101,6 +101,11 @@ BAD_CASES = [
     # under a held shard's writer lock (the per-shard SQLite lock-order
     # hazard R2's threading-lock graph cannot see)
     ("crossshard", "api/r7_crossshard_txn_bad.py", 3),
+    # ISSUE 19 sweeps: the tuner's write-ahead launch window (intent ->
+    # create -> mark) driven through a raw store handle — a dead driver
+    # would keep planting trials a successor already owns (the R1 fence
+    # class extended to the hypertune/ path)
+    ("fence", "hypertune/r19_unfenced_trial_create_bad.py", 4),
 ]
 
 OK_TWINS = [
@@ -116,6 +121,7 @@ OK_TWINS = [
     "federation/r16_wall_clock_cluster_health_ok.py",
     "serve/r17_donated_spec_decode_ok.py",
     "api/r7_crossshard_txn_ok.py",
+    "hypertune/r19_unfenced_trial_create_ok.py",
 ]
 
 

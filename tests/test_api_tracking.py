@@ -344,7 +344,11 @@ class TestUi:
                            "renderGraph", "data-tab=\"graph\"", "dagOps",
                            # v4 cursor pagination (VERDICT r5 weak #7):
                            # page controls over the envelope listing
-                           "paged=1", "pageCursors", "nextPg", "prevPg"):
+                           "paged=1", "pageCursors", "nextPg", "prevPg",
+                           # ISSUE 19 durable-sweep surfaces: rung ladder
+                           # and trial-index/lineage cells from the meta
+                           # the tuner stamps onto every trial
+                           "trial_index", "Rungs", "parent_trial"):
                 assert marker in r.text, marker
             # the shell is open; the data endpoints it calls are not
             assert requests.get(f"{srv.url}/api/v1/projects", timeout=5).status_code == 401

@@ -744,3 +744,424 @@ class TestAshaPacking:
                 [(t["name"], _outputs_mtime(t)) for t in trials])
         finally:
             agent.stop()
+
+
+# -- crash-safe sweeps (ISSUE 19) --------------------------------------------
+# Sweep state is STORE truth: per-(sweep_uuid, trial_index) seeded draws,
+# write-ahead trial intents, and cold-start _SweepState rebuild mean a
+# successor agent adopting a sweep continues the EXACT decision sequence
+# the corpse would have produced.
+
+
+ASHA_TRIAL_SLOW = """
+import json, os, time
+params = json.loads(os.environ["PLX_PARAMS"])
+x = float(params["x"])
+s = int(params["steps"])
+time.sleep(0.15)
+out = {"loss": (x - 3.0) ** 2 + 1.0 / s}
+with open(os.path.join(os.environ["PLX_ARTIFACTS_PATH"], "outputs.json"), "w") as f:
+    json.dump(out, f)
+"""
+
+
+def _asha_crash_spec(name="asha", concurrency=1, num_runs=4, seed=5):
+    return check_polyaxonfile({
+        "kind": "operation",
+        "name": name,
+        "termination": {"maxRetries": 3},
+        "matrix": {
+            "kind": "hyperband", "asynchronous": True,
+            "concurrency": concurrency,
+            "maxIterations": 9, "eta": 3, "numRuns": num_runs,
+            "resource": {"name": "steps", "type": "int"},
+            "metric": {"name": "loss", "optimization": "minimize"},
+            "params": {"x": {"kind": "uniform", "value": [0, 8]}},
+            "seed": seed,
+        },
+        "component": {
+            "kind": "component",
+            "inputs": [{"name": "x", "type": "float"},
+                       {"name": "steps", "type": "int",
+                        "isOptional": True}],
+            "run": {"kind": "job",
+                    "init": [{"file": {"filename": "trial.py",
+                                       "content": ASHA_TRIAL_SLOW}}],
+                    "container": {"command": [sys.executable, "trial.py"]}},
+        },
+    }).to_dict()
+
+
+def _simulate_sweep(spec, sweep_uuid):
+    """Offline oracle: the bound manager's concurrency-1 decision sequence
+    against the analytic trial loss — what the store MUST contain after
+    any sequence of crashes/adoptions."""
+    from polyaxon_tpu.hypertune.tuner import params_hash
+    from polyaxon_tpu.schemas import V1Operation
+
+    op = V1Operation.from_dict(spec)
+    mgr = make_manager(op.matrix)
+    mgr.bind_sweep(sweep_uuid)
+    obs, seq = [], []
+    while True:
+        batch = mgr.propose(obs, 1)
+        if not batch:
+            break
+        s = batch[0]
+        seq.append({"params": dict(s.params),
+                    "hash": params_hash(s.params),
+                    "meta": dict(s.meta or {})})
+        obs.append(Observation(
+            params=s.params,
+            metric=(float(s.params["x"]) - 3.0) ** 2
+            + 1.0 / int(s.params["steps"]),
+            trial_meta={**(s.meta or {}), "uuid": f"sim-{len(seq)}"}))
+    return seq
+
+
+def _audit_against_sim(store, sweep_uuid, sim):
+    """Exactly-once + decision-parity audit over store truth."""
+    from polyaxon_tpu.hypertune.tuner import params_hash
+
+    children = [r for r in store.list_runs(pipeline_uuid=sweep_uuid,
+                                           limit=500)
+                if (r.get("meta") or {}).get("trial_index") is not None]
+    by_index = {}
+    for row in children:
+        idx = int(row["meta"]["trial_index"])
+        assert idx not in by_index, f"trial_index {idx} duplicated"
+        by_index[idx] = row
+    assert sorted(by_index) == list(range(len(sim))), (
+        sorted(by_index), len(sim))
+    intents = {int(r["trial_index"]): r
+               for r in store.list_trial_intents(sweep_uuid)}
+    assert sorted(intents) == sorted(by_index)
+    for idx, row in sorted(by_index.items()):
+        meta, want = row["meta"], sim[idx]
+        assert row["status"] == "succeeded", (idx, row["status"])
+        assert meta["params_hash"] == want["hash"], idx
+        assert meta["params_hash"] == params_hash(row["inputs"]), idx
+        assert int(meta.get("rung", 0)) == int(
+            want["meta"].get("rung", 0)), idx
+        assert meta.get("config_id") == want["meta"].get("config_id"), idx
+        intent = intents[idx]
+        assert intent["state"] == "created", (idx, intent)
+        assert intent["run_uuid"] == row["uuid"], idx
+        assert intent["params_hash"] == meta["params_hash"], idx
+    return by_index
+
+
+class TestSeededDraws:
+    """Satellite: suggestion draws are a pure function of
+    (sweep_uuid, trial_index) — replayed propose() agrees exactly."""
+
+    def test_trial_rng_partitions_by_identity(self):
+        from polyaxon_tpu.hypertune.space import trial_rng
+
+        a = trial_rng("sweep-x", 3, seed=7).uniform(0, 8)
+        assert a == trial_rng("sweep-x", 3, seed=7).uniform(0, 8)
+        others = {trial_rng("sweep-x", 4, seed=7).uniform(0, 8),
+                  trial_rng("sweep-y", 3, seed=7).uniform(0, 8),
+                  trial_rng("sweep-x", 3, seed=8).uniform(0, 8)}
+        assert a not in others and len(others) == 3
+
+    def test_golden_derived_draws(self):
+        """Regression pin: the blake2b-derived streams are part of the
+        durable-sweep contract — changing them silently would break
+        intent replay for every in-flight production sweep."""
+        from polyaxon_tpu.hypertune.space import trial_rng
+
+        golden = [6.078353624932219, 0.4623605934180164, 7.962590062910293]
+        got = [trial_rng("golden-sweep", i, seed=7).uniform(0, 8)
+               for i in range(3)]
+        assert got == pytest.approx(golden, abs=1e-12)
+
+    def test_restore_continuation_matches_uninterrupted_run(self):
+        """Crash at EVERY point of the sweep: a fresh manager restored
+        from the first k observations continues with exactly the
+        suggestions the uninterrupted manager would have produced."""
+        spec = _asha_crash_spec()
+        from polyaxon_tpu.schemas import V1Operation
+
+        cfg = V1Operation.from_dict(spec).matrix
+
+        def loss(p):
+            return (float(p["x"]) - 3.0) ** 2 + 1.0 / int(p["steps"])
+
+        def drain(mgr, obs, tag):
+            seq = []
+            while True:
+                batch = mgr.propose(obs, 1)
+                if not batch:
+                    break
+                s = batch[0]
+                seq.append((s.params, dict(s.meta or {})))
+                obs.append(Observation(
+                    params=s.params, metric=loss(s.params),
+                    trial_meta={**(s.meta or {}),
+                                "uuid": f"{tag}{len(obs)}"}))
+            return seq
+
+        m1 = make_manager(cfg)
+        m1.bind_sweep("sweep-adopt-test")
+        obs: list = []
+        seq1 = drain(m1, obs, "u")
+        assert len(seq1) == 5
+        for k in range(1, len(seq1)):
+            m2 = make_manager(cfg)
+            m2.bind_sweep("sweep-adopt-test")
+            m2.restore(obs[:k], [])
+            cont = drain(m2, list(obs[:k]), "r")
+            assert cont == seq1[k:], f"diverged after crash at trial {k}"
+
+
+class TestSweepCrashAdoption:
+    """Tentpole: hard-kill the agent mid-sweep; the successor rebuilds
+    _SweepState from store truth and finishes the EXACT sequence."""
+
+    def _stack(self, tmp_path, store=None):
+        from polyaxon_tpu.operator import FakeCluster
+
+        store = store or Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".cluster"))
+
+        def new_agent():
+            return LocalAgent(store, str(tmp_path), backend="cluster",
+                              cluster=cluster, poll_interval=0.05,
+                              lease_ttl=0.4, max_parallel=4).start()
+
+        return store, cluster, new_agent
+
+    def _wait_children(self, store, uuid, n, timeout=60):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rows = [r for r in store.list_runs(pipeline_uuid=uuid,
+                                               limit=500)
+                    if (r.get("meta") or {}).get("trial_index") is not None]
+            if len(rows) >= n:
+                return rows
+            time.sleep(0.05)
+        raise AssertionError(f"never saw {n} children")
+
+    def _wait_done(self, store, uuid, timeout=120):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if store.get_run(uuid)["status"] in ("succeeded", "failed",
+                                                 "stopped"):
+                return store.get_run(uuid)
+            time.sleep(0.05)
+        raise AssertionError(
+            f"sweep never finished: {store.get_run(uuid)['status']}")
+
+    def test_kill_mid_rung_successor_matches_simulation(self, tmp_path):
+        from polyaxon_tpu.api.store import StaleLeaseError
+
+        spec = _asha_crash_spec()
+        sim = _simulate_sweep(spec, "sweep-adopt-test")
+        store, cluster, new_agent = self._stack(tmp_path)
+        agent = new_agent()
+        try:
+            store.create_run("p", spec=spec, name="asha",
+                             uuid="sweep-adopt-test")
+            self._wait_children(store, "sweep-adopt-test", 2)
+            agent.hard_kill()
+            # the corpse's tuner replays its in-flight window: the
+            # write-ahead intent must bounce off the poisoned fence
+            with pytest.raises(StaleLeaseError):
+                agent.store.record_trial_intents("sweep-adopt-test", [{
+                    "trial_index": 999999, "params_hash": "corpse",
+                    "suggestion": {"params": {}, "meta": {}}}])
+            agent = new_agent()  # cold_start_resync adopts the sweep
+            final = self._wait_done(store, "sweep-adopt-test")
+            assert final["status"] == "succeeded", store.get_statuses(
+                "sweep-adopt-test")
+            _audit_against_sim(store, "sweep-adopt-test", sim)
+            assert not list(getattr(cluster, "duplicate_applies", []))
+        finally:
+            agent.stop()
+
+    def test_mid_window_intent_without_child_launches_exactly_once(
+            self, tmp_path):
+        """Crash BETWEEN intent commit and create_runs: the successor
+        must launch the recorded suggestion verbatim under the same
+        trial_index — never skip it, never re-draw it."""
+        spec = _asha_crash_spec(name="asha-window")
+        uuid = "sweep-window-test"
+        sim = _simulate_sweep(spec, uuid)
+        store, cluster, new_agent = self._stack(tmp_path)
+        # a dead driver's store truth: RUNNING pipeline + one committed
+        # intent, no child row yet
+        store.create_run("p", spec=spec, name="asha-window", uuid=uuid)
+        store.transition(uuid, "running", force=True)
+        store.record_trial_intents(uuid, [{
+            "trial_index": 0, "params_hash": sim[0]["hash"],
+            "suggestion": {"params": sim[0]["params"],
+                           "meta": sim[0]["meta"]}}])
+        agent = new_agent()
+        try:
+            final = self._wait_done(store, uuid)
+            assert final["status"] == "succeeded", store.get_statuses(uuid)
+            by_index = _audit_against_sim(store, uuid, sim)
+            # the recovered window launched the INTENT's params, and the
+            # replayed draw agreed with them (no hash-mismatch abort)
+            assert by_index[0]["inputs"] == pytest.approx(sim[0]["params"])
+        finally:
+            agent.stop()
+
+    def test_cold_restart_from_disk_truth(self, tmp_path):
+        """Process death AND store handle loss: a brand-new Store over
+        the same sqlite file (the failed-over primary's disk truth) is
+        all a successor needs to finish the sweep exactly."""
+        spec = _asha_crash_spec(name="asha-disk", seed=5)
+        uuid = "sweep-disk-test"
+        sim = _simulate_sweep(spec, uuid)
+        db = str(tmp_path / "store.db")
+        store1, cluster, new_agent = self._stack(tmp_path, store=Store(db))
+        agent = new_agent()
+        store1.create_run("p", spec=spec, name="asha-disk", uuid=uuid)
+        self._wait_children(store1, uuid, 2)
+        agent.hard_kill()
+        store2 = Store(db)  # fresh connection: cold-start scan only
+        _, _, new_agent2 = self._stack(tmp_path, store=store2)
+        agent2 = new_agent2()
+        try:
+            final = self._wait_done(store2, uuid)
+            assert final["status"] == "succeeded", store2.get_statuses(uuid)
+            _audit_against_sim(store2, uuid, sim)
+            assert not list(getattr(cluster, "duplicate_applies", []))
+        finally:
+            agent2.stop()
+
+    def test_exactly_once_intents_under_two_agent_fleet(self, tmp_path):
+        """2-agent sharded fleet: kill the agent OWNING the sweep's
+        shard; the survivor adopts and every trial_index still launches
+        exactly once (intents 1:1 with children, zero duplicate pods)."""
+        import time
+
+        from polyaxon_tpu.api.store import shard_index
+        from polyaxon_tpu.operator import FakeCluster
+
+        store = Store(":memory:")
+        cluster = FakeCluster(str(tmp_path / ".cluster"))
+
+        def new_agent():
+            return LocalAgent(store, str(tmp_path), backend="cluster",
+                              cluster=cluster, poll_interval=0.05,
+                              lease_ttl=0.4, num_shards=2,
+                              max_parallel=4).start()
+
+        uuid = "sweep-fleet-test"
+        shard = f"shard-{shard_index(uuid, 2)}"
+        spec = _asha_crash_spec(name="asha-fleet", concurrency=2,
+                                num_runs=4, seed=9)
+        fleet = [new_agent(), new_agent()]
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not any(
+                    shard in a._shard_leases for a in fleet):
+                time.sleep(0.05)
+            store.create_run("p", spec=spec, name="asha-fleet", uuid=uuid)
+            # wait for first blood, then kill the sweep's owner
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 60:
+                rows = [r for r in store.list_runs(pipeline_uuid=uuid,
+                                                   limit=500)
+                        if (r.get("meta") or {}).get("trial_index")
+                        is not None]
+                if rows:
+                    break
+                time.sleep(0.05)
+            victims = [a for a in fleet if shard in a._shard_leases]
+            assert victims, "no agent owns the sweep's shard"
+            victims[0].hard_kill()
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if store.get_run(uuid)["status"] in ("succeeded", "failed",
+                                                     "stopped"):
+                    break
+                time.sleep(0.05)
+            final = store.get_run(uuid)
+            assert final["status"] == "succeeded", store.get_statuses(uuid)
+            children = [r for r in store.list_runs(pipeline_uuid=uuid,
+                                                   limit=500)
+                        if (r.get("meta") or {}).get("trial_index")
+                        is not None]
+            idxs = sorted(int(r["meta"]["trial_index"]) for r in children)
+            assert idxs == list(range(len(children))), idxs
+            intents = {int(r["trial_index"]): r
+                       for r in store.list_trial_intents(uuid)}
+            assert sorted(intents) == idxs
+            for row in children:
+                it = intents[int(row["meta"]["trial_index"])]
+                assert it["state"] == "created" and \
+                    it["run_uuid"] == row["uuid"]
+            assert not list(getattr(cluster, "duplicate_applies", []))
+        finally:
+            for a in fleet:
+                if not a._dead:
+                    a.stop()
+
+
+class TestSweepLsCli:
+    def test_table_renders_rungs_trials_and_best(self, tmp_path, monkeypatch):
+        """`polyaxon sweep ls <uuid>` renders the durable trial meta —
+        rung ladder, per-trial rows with PBT lineage, the current best,
+        and any still-open write-ahead intent windows (local mode)."""
+        from click.testing import CliRunner
+
+        from polyaxon_tpu.cli.main import cli as plx_cli
+
+        (tmp_path / ".plx").mkdir()
+        store = Store(str(tmp_path / ".plx" / "db.sqlite"))
+        pipe = store.create_run("default", spec={"name": "sw"},
+                                name="sw", uuid="sweep-cli-test")
+        store.transition(pipe["uuid"], "running", force=True)
+        rows = [
+            # (index, rung, loss, parent)
+            (0, 0, 4.0, None), (1, 0, 2.0, None),
+            (2, 1, 1.5, None), (3, 1, None, None),
+        ]
+        child_uuids = {}
+        for idx, rung, loss, parent in rows:
+            meta = {"trial_index": idx, "rung": rung,
+                    "sweep_uuid": pipe["uuid"], "params_hash": f"h{idx}"}
+            if parent is not None:
+                meta["parent_trial"] = parent
+            c = store.create_run(
+                "default", spec={"name": f"t{idx}"}, name=f"t{idx}",
+                inputs={"x": float(idx)}, meta=meta,
+                pipeline_uuid=pipe["uuid"])
+            child_uuids[idx] = c["uuid"]
+            store.record_trial_intents(pipe["uuid"], [{
+                "trial_index": idx, "params_hash": f"h{idx}",
+                "suggestion": {"params": {"x": float(idx)}, "meta": meta},
+            }])
+            store.mark_trials_created(pipe["uuid"], [(idx, c["uuid"])])
+            if loss is not None:
+                store.merge_outputs(c["uuid"], {"loss": loss})
+                store.transition(c["uuid"], "succeeded", force=True)
+        # trial 3's window is re-opened: intent recorded, create pending —
+        # the CLI must surface it as an open window
+        store.record_trial_intents(pipe["uuid"], [{
+            "trial_index": 4, "params_hash": "h4",
+            "suggestion": {"params": {"x": 9.0}, "meta": {}},
+        }])
+        monkeypatch.chdir(tmp_path)
+        result = CliRunner().invoke(
+            plx_cli, ["sweep", "ls", pipe["uuid"]], catch_exceptions=False)
+        assert result.exit_code == 0, result.output
+        out = result.output
+        assert "trials=4" in out
+        # rung ladder with per-rung counts and best objective
+        assert "rung  trials  done  best" in out
+        assert "   0       2     2  2.0" in out
+        assert "   1       2     1  1.5" in out
+        # best row names the winning trial and its params
+        assert "best: trial 2 loss=1.5" in out
+        assert '"x": 2.0' in out
+        # the open write-ahead window is visible
+        assert "pending intent windows: [4]" in out

@@ -111,6 +111,15 @@ EXPECTED_FAMILIES = {
     "polyaxon_serve_cow_copies_total",
     "polyaxon_serve_spec_tokens_proposed_total",
     "polyaxon_serve_spec_tokens_accepted_total",
+    # crash-safe sweeps (ISSUE 19): write-ahead trial intents (store) and
+    # the tuner's trial/promotion/fork counters + per-agent live-trials
+    # gauge — registered at store/agent birth so a scrape answers "are
+    # sweeps healthy" before the first trial launches
+    "polyaxon_store_trial_intents_total",
+    "polyaxon_sweep_trials_total",
+    "polyaxon_sweep_promotions_total",
+    "polyaxon_pbt_forks_total",
+    "polyaxon_sweep_live_trials",
 }
 
 
