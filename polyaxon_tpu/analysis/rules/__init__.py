@@ -8,6 +8,7 @@ from .donation import DonationRule
 from .fence import FenceRule
 from .lockorder import LockOrderRule
 from .metrics_contract import MetricsContractRule
+from .slodrift import SloDriftRule
 
 ALL_RULES = (
     FenceRule,          # R1 — unfenced store writes (PR 4/6)
@@ -17,8 +18,9 @@ ALL_RULES = (
     MetricsContractRule,  # R5 — metrics contract drift (PR 5/7)
     DonationRule,       # R6 — donated-buffer reuse (PR 8)
     CrossShardRule,     # R7 — cross-shard verb in a held shard txn (PR 18)
+    SloDriftRule,       # R8 — SLO/alert contract drift (PR 20)
 )
 
 __all__ = ["ALL_RULES", "FenceRule", "LockOrderRule", "BlockingAsyncRule",
            "ClockRule", "MetricsContractRule", "DonationRule",
-           "CrossShardRule"]
+           "CrossShardRule", "SloDriftRule"]

@@ -40,6 +40,10 @@ WRITE_VERBS = frozenset({
     # created-marker written without the pipeline shard's fence lets a
     # dead driver keep planting windows a successor already owns
     "record_trial_intents", "mark_trials_created",
+    # SLO alert state machine (ISSUE 20): an alert transition written
+    # without the owning agent's fence double-fires / double-resolves
+    # across takeovers — exactly-once is the whole contract
+    "upsert_alert", "resolve_alert",
 })
 
 #: root-relative path prefixes where the discipline applies — the

@@ -1,0 +1,177 @@
+"""R8 — SLO/alert contract drift (ISSUE 20).
+
+Bug-class provenance (PR 20): the SLO engine evaluates burn rates
+against the history recorder, which only retains families on its
+allowlist — so an SLO spec (or allowlist entry) naming a family that no
+registration produces evaluates against permanent silence: burn 0,
+alert never fires, and nothing errors. The drift is invisible at
+runtime by construction (the "no data → burn 0" rule is deliberate:
+a freshly started recorder must not page). The second half of the
+contract is the fenced-verb list: the alert state machine's
+exactly-once guarantee rests on ``upsert_alert``/``resolve_alert``
+being fenced, so a file that defines those verbs next to a
+``_FENCED`` tuple or ``WRITE_VERBS`` set that omits them has silently
+opened the double-fire/double-resolve hole across agent takeovers.
+
+Checks:
+
+- every ``polyaxon_*`` family referenced by a ``*SLO_PACK*`` assignment
+  (dict keys ``family``/``bad_family``/``total_family``, snake or
+  camel) or a ``*ALLOWLIST*`` sequence assignment must be produced by
+  some registration in the analyzed tree, or contracted in
+  ``tests/test_obs.py``'s ``EXPECTED_FAMILIES``;
+- a file that defines ``def upsert_alert`` / ``def resolve_alert`` and
+  also assigns a ``_FENCED`` / ``WRITE_VERBS`` verb container must list
+  those verbs in EVERY such container in that file.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Project, Rule
+from .metrics_contract import (
+    _REGISTER_ATTRS, _name_parts, _parse_expected_families, _Registration,
+)
+
+#: dict keys inside an SLO spec that name a metric family (BaseSchema
+#: accepts both snake and camelCase on the wire)
+_FAMILY_KEYS = frozenset({
+    "family", "bad_family", "total_family", "badFamily", "totalFamily",
+})
+
+#: the fenced alert verbs (mirror of the ISSUE 20 FencedStore additions)
+_ALERT_VERBS = ("upsert_alert", "resolve_alert")
+
+#: assignment-target names that hold verb containers whose omission of
+#: an alert verb is the exactly-once hole
+_VERB_CONTAINERS = ("_FENCED", "WRITE_VERBS")
+
+
+def _target_names(node: ast.Assign) -> list:
+    out = []
+    for t in node.targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, ast.Attribute):
+            out.append(t.attr)
+    return out
+
+
+def _string_constants(node: ast.AST) -> list:
+    """Every string literal under ``node``, with its AST node for
+    location reporting."""
+    return [n for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+class SloDriftRule(Rule):
+    name = "slodrift"
+    title = "SLO spec / fenced alert verb contract drift"
+
+    def check(self, project: Project) -> list[Finding]:
+        regs = self._registrations(project)
+        expected = _parse_expected_families(
+            project.read_rootfile("tests", "test_obs.py"))
+        out: list[Finding] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            out.extend(self._check_families(sf, regs, expected))
+            out.extend(self._check_verbs(sf))
+        return out
+
+    def _registrations(self, project: Project) -> list:
+        """Same scan as R5: every ``.counter/.gauge/.histogram`` call
+        whose family literal starts ``polyaxon_`` (f-strings matched as
+        wildcards)."""
+        regs = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _REGISTER_ATTRS
+                        and node.args):
+                    continue
+                parts = _name_parts(node.args[0])
+                if parts is None:
+                    continue
+                head = next((p for p in parts if p is not None), "")
+                if not head.startswith("polyaxon_"):
+                    continue
+                regs.append(_Registration(
+                    sf, node, _REGISTER_ATTRS[node.func.attr], parts))
+        return regs
+
+    def _family_refs(self, sf) -> list:
+        """(family-string Constant node) references in SLO pack / history
+        allowlist assignments."""
+        refs = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = _target_names(node)
+            if any("SLO_PACK" in n for n in names):
+                for d in ast.walk(node.value):
+                    if not isinstance(d, ast.Dict):
+                        continue
+                    for k, v in zip(d.keys, d.values):
+                        if (isinstance(k, ast.Constant)
+                                and k.value in _FAMILY_KEYS
+                                and isinstance(v, ast.Constant)
+                                and isinstance(v.value, str)):
+                            refs.append(v)
+            elif any("ALLOWLIST" in n for n in names):
+                refs.extend(c for c in _string_constants(node.value)
+                            if c.value.startswith("polyaxon_"))
+        return refs
+
+    def _check_families(self, sf, regs, expected) -> list[Finding]:
+        out = []
+        for ref in self._family_refs(sf):
+            family = ref.value
+            if not family.startswith("polyaxon_"):
+                continue
+            if family in expected or any(r.matches(family) for r in regs):
+                continue
+            out.append(Finding(
+                rule=self.name, path=sf.rel, line=ref.lineno,
+                col=ref.col_offset,
+                message=(
+                    f"SLO/allowlist references family {family!r} but no "
+                    "registration produces it and EXPECTED_FAMILIES does "
+                    "not contract it — the recorder would hold permanent "
+                    "silence there, so burn stays 0 and the alert can "
+                    "never fire"),
+            ))
+        return out
+
+    def _check_verbs(self, sf) -> list[Finding]:
+        defined = {node.name for node in ast.walk(sf.tree)
+                   if isinstance(node, ast.FunctionDef)
+                   and node.name in _ALERT_VERBS}
+        if not defined:
+            return []
+        out = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [n for n in _target_names(node)
+                     if n in _VERB_CONTAINERS]
+            if not names:
+                continue
+            listed = {c.value for c in _string_constants(node.value)}
+            for verb in sorted(defined - listed):
+                out.append(Finding(
+                    rule=self.name, path=sf.rel, line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"alert verb {verb!r} is defined in this file "
+                        f"but missing from {names[0]} — unfenced alert "
+                        "transitions double-fire/double-resolve across "
+                        "agent takeovers (exactly-once is the ISSUE 20 "
+                        "contract)"),
+                ))
+        return out

@@ -6,6 +6,10 @@ Endpoints (all JSON unless noted):
     GET  /healthz
     GET  /metrics                                   Prometheus text exposition
     GET  /api/v1/stats                              JSON twin of /metrics + lease
+    GET  /api/v1/metrics/history                    ?family=&range=&at= ring-buffer
+                                                    history (fleet rollup)
+    GET  /api/v1/alerts                             ?state= alert table
+    GET  /api/v1/slo/status                         burn rates per SLO spec
     GET  /api/v1/{project}/runs/{uuid}/timeline     lifecycle + pod span trace
     GET|POST /api/v1/projects
     GET  /api/v1/projects/{project}
@@ -32,6 +36,7 @@ import asyncio
 import json
 import os
 import re
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -94,6 +99,23 @@ class ApiApp:
         self.auth_token = auth_token if auth_token is not None \
             else os.environ.get("PLX_AUTH_TOKEN")
         self._tokens_seen = False
+        # metrics history (ISSUE 20): the server process is long-lived,
+        # so it starts the registry recorder's sampler thread (Stores
+        # create the recorder idle — unit tests stay thread-free). The
+        # history endpoint and the SLO status handler both read it.
+        from ..obs.history import recorder_for
+        from ..obs.slo import default_slo_pack
+
+        self.recorder = recorder_for(
+            self.store.metrics,
+            interval_s=getattr(store, "record_interval_s", 10.0))
+        self.slo_specs = default_slo_pack()
+        # /metrics render cache (ISSUE 20 satellite): the recorder, the
+        # dashboard poll, and external scrapers each re-rendered the
+        # exposition per request — same registry lock, same string build.
+        # One render per min(1s, record_interval_s) serves all three.
+        self._scrape_ttl = min(1.0, self.recorder.interval_s)
+        self._scrape_cache: tuple = (float("-inf"), "")
         self.app = web.Application(
             middlewares=[*(extra_middlewares or []), self._auth_middleware,
                          self._rate_limit_middleware,
@@ -264,6 +286,9 @@ class ApiApp:
         r.add_get("/healthz", self.healthz)
         r.add_get("/metrics", self.metrics_endpoint)
         r.add_get("/api/v1/stats", self.get_stats)
+        r.add_get("/api/v1/metrics/history", self.metrics_history)
+        r.add_get("/api/v1/alerts", self.list_alerts)
+        r.add_get("/api/v1/slo/status", self.slo_status_endpoint)
         r.add_get("/", self.ui)
         r.add_get("/ui", self.ui)
         r.add_get("/api/v1/openapi.json", self.openapi)
@@ -314,15 +339,73 @@ class ApiApp:
     async def metrics_endpoint(self, request):
         """Prometheus text exposition of the control-plane registry
         (store counters + latency histograms, agent gauges, reaper/chaos
-        counters — docs/OBSERVABILITY.md lists every family)."""
-        reg = getattr(self.store, "metrics", None)
-        text = reg.render() if reg is not None else ""
+        counters — docs/OBSERVABILITY.md lists every family).
+
+        The encoded text is cached for ``min(1s, record_interval_s)``
+        (ISSUE 20): the recorder's sampler, the dashboard poll, and
+        external scrapers would otherwise each pay the registry lock and
+        the full string build per tick. A sub-TTL scrape may read a
+        render up to one interval old — within the recorder's own
+        resolution, so nothing downstream can tell."""
+        ts, text = self._scrape_cache
+        now = time.monotonic()
+        if now - ts >= self._scrape_ttl:
+            reg = getattr(self.store, "metrics", None)
+            text = reg.render() if reg is not None else ""
+            self._scrape_cache = (now, text)
         return web.Response(
             text=text,
             content_type="text/plain",
             charset="utf-8",
             headers={"X-Prometheus-Exposition": "0.0.4"},
         )
+
+    async def metrics_history(self, request):
+        """Ring-buffer history for one family (ISSUE 20): ``?family=``
+        (required), ``?range=`` seconds (default 3600), ``?at=`` lookback
+        seconds (history as it stood ``at`` seconds ago). Points are
+        ``[age_s, value]`` pairs, oldest first; the ``series`` list keeps
+        each reporter's labels + source, ``points`` is the fleet aggregate
+        (sum counters / max gauges — the shared-registry rule)."""
+        family = request.rel_url.query.get("family")
+        if not family:
+            return _json(
+                {"error": "family query parameter is required",
+                 "families": self.recorder.families()}, status=400)
+        try:
+            range_s = float(request.rel_url.query.get("range", 3600))
+            at = float(request.rel_url.query.get("at", 0))
+        except ValueError:
+            return _json({"error": "range/at must be numbers"}, status=400)
+        q = self.recorder.query(family, range_s, at=at)
+        if not q["series"]:
+            # empty is only a valid answer for a family the recorder COULD
+            # serve (allowlisted or registered, just not sampled yet —
+            # first tick lands interval_s after boot); anything else 404s
+            # with the recordable set so a typo'd dashboard query is loud
+            allow = self.recorder.allow
+            reg = getattr(self.store, "metrics", None)
+            known = ((allow is not None and family in allow)
+                     or (reg is not None and family in reg.families()))
+            if not known:
+                return _json(
+                    {"error": f"unknown family: {family}",
+                     "families": sorted(allow or self.recorder.families())},
+                    status=404)
+        return _json(q)
+
+    async def list_alerts(self, request):
+        """The alert table (``?state=`` filters), firing-first — the
+        dashboard panel's source and ``polyaxon alerts ls``."""
+        state = request.rel_url.query.get("state") or None
+        return _json({"alerts": self.store.list_alerts(state=state)})
+
+    async def slo_status_endpoint(self, request):
+        """Burn rates for the server's spec pack, computed by the SAME
+        ``slo_status`` the evaluator and the CLI use."""
+        from ..obs.slo import slo_status
+
+        return _json({"slos": slo_status(self.recorder, self.slo_specs)})
 
     async def get_stats(self, request):
         """JSON twin of /metrics: store counters, metric snapshot
@@ -850,6 +933,9 @@ class ApiApp:
         serve = body.get("serve")
         if not isinstance(serve, dict):
             serve = None  # malformed -> liveness-only, same as the rest
+        metrics = body.get("metrics")
+        if not isinstance(metrics, dict):
+            metrics = None  # ISSUE 20 history buffer, same degrade rule
         ok = self.store.heartbeat(
             request.match_info["uuid"],
             step=_int(body.get("step")),
@@ -857,7 +943,8 @@ class ApiApp:
             rollbacks=_int(body.get("rollbacks")),
             incarnation=(str(body["incarnation"])
                          if body.get("incarnation") else None),
-            serve=serve)
+            serve=serve,
+            metrics=metrics)
         return _json({"ok": True}) if ok else _not_found()
 
     async def stop_run(self, request):

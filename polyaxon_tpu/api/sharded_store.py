@@ -728,6 +728,11 @@ for _name in (
     "register_cluster", "get_cluster", "list_clusters", "delete_cluster",
     "get_cluster_map",
     "count_serve_retries",
+    # SLO alerts (ISSUE 20): fleet-scoped control-plane state like quotas
+    # — one alert table, regardless of how the run space is sharded (the
+    # evaluator's cross-shard fence is verified on its lease home by
+    # _split_fence, then stripped, exactly like a quota write)
+    "upsert_alert", "resolve_alert", "get_alert", "list_alerts",
 ):
     setattr(ShardedStore, _name, _meta_scoped(_name))
 del _name

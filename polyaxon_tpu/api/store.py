@@ -192,6 +192,30 @@ CREATE TABLE IF NOT EXISTS changelog (
     payload TEXT NOT NULL,
     created_at TEXT NOT NULL
 );
+-- SLO alert state machine (ISSUE 20): one row per alert name, written
+-- only through the fenced upsert_alert/resolve_alert verbs so alert
+-- edges are exactly-once across agent takeovers, like any run
+-- transition. pending_at restarts per episode (dwell timing);
+-- last_notified_at is the notification dedup/re-notify watermark and
+-- rides the SAME fenced write as the transition it announces.
+-- Replicated through the changelog: a promoted standby serves the alert
+-- table the primary committed.
+CREATE TABLE IF NOT EXISTS alerts (
+    name TEXT PRIMARY KEY,
+    slo TEXT,
+    state TEXT NOT NULL,
+    severity TEXT,
+    value REAL,
+    reason TEXT,
+    labels TEXT,
+    transitions INTEGER NOT NULL DEFAULT 0,
+    first_at TEXT NOT NULL,
+    pending_at TEXT,
+    fired_at TEXT,
+    resolved_at TEXT,
+    last_notified_at TEXT,
+    updated_at TEXT NOT NULL
+);
 INSERT OR IGNORE INTO counters (k, v) VALUES ('store_epoch', 0);
 INSERT OR IGNORE INTO counters (k, v) VALUES ('changelog_floor', 0);
 """
@@ -414,7 +438,7 @@ class Store(StoreBackend):
     check_same_thread), WAL so readers never block the writer."""
 
     def __init__(self, path: str = ":memory:", metrics=None,
-                 replicate: bool = True):
+                 replicate: bool = True, record_interval_s: float = 10.0):
         self.path = path
         self._local = threading.local()
         # serializes status transitions (read-check-insert-update must be
@@ -456,7 +480,14 @@ class Store(StoreBackend):
                       # counters vs the COUNT(*) slow path, plus how many
                       # reconciles found (and repaired) drift
                       "count_fast": 0, "count_slow": 0,
-                      "count_drift_repairs": 0}
+                      "count_drift_repairs": 0,
+                      # SLO alert state machine (ISSUE 20): one bump per
+                      # PERSISTED transition (dedup'd upserts don't count),
+                      # exported per target state — the chaos soak's
+                      # exactly-once-across-takeover check reads these
+                      "alert_transitions_pending": 0,
+                      "alert_transitions_firing": 0,
+                      "alert_transitions_resolved": 0}
         # per-project run-row counters behind the count_runs fast path:
         # lazily seeded from one GROUP BY, then maintained by the write
         # path (create_runs/delete_run) and INVALIDATED by replication
@@ -759,6 +790,38 @@ class Store(StoreBackend):
             self._cluster_cache[row_["name"]] = row_
             self._cluster_health[row_["name"]] = bool(row_["healthy"])
             self._register_cluster_gauges(row_["name"])
+        # SLO alerting (ISSUE 20): the firing gauge reads an in-memory
+        # count maintained by the alert verbs (and re-derived by
+        # changelog replay on a standby) — a scrape never pays a table
+        # walk. Families register from birth like every contracted name.
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                "SELECT COUNT(*) FROM alerts WHERE state='firing'"
+            ).fetchone()
+            self._alerts_firing = int(row[0]) if row else 0
+        self.metrics.gauge(
+            "polyaxon_alerts_firing",
+            "Alerts currently in the firing state",
+            value_fn=lambda p=peers: float(sum(
+                getattr(st, "_alerts_firing", 0) for st in p)))
+        for state_ in ("pending", "firing", "resolved"):
+            self.metrics.counter(
+                "polyaxon_alerts_transitions_total",
+                "Persisted alert state-machine transitions "
+                "(dedup'd same-state upserts do not count)",
+                labels={"state": state_},
+                value_fn=(lambda s_=state_, p=peers: sum(
+                    st.stats.get(f"alert_transitions_{s_}", 0)
+                    for st in p)))
+        # metrics history (ISSUE 20): the registry's ring-buffer recorder
+        # — one per registry (shared across failover peers, like the
+        # families). Created idle: long-lived processes (server, agent)
+        # start the sampler thread; unit-test stores stay thread-free.
+        from ..obs.history import recorder_for
+
+        self.record_interval_s = float(record_interval_s)
+        self.recorder = recorder_for(
+            self.metrics, interval_s=self.record_interval_s, start=False)
 
     # -- tenant quotas (ISSUE 15) ------------------------------------------
 
@@ -1960,6 +2023,20 @@ class Store(StoreBackend):
             with self._cluster_lock:
                 self._cluster_cache.pop(p["name"], None)
                 self._cluster_health.pop(p["name"], None)
+        elif op == "alert":
+            conn.execute(
+                f"INSERT OR REPLACE INTO alerts "
+                f"({','.join(self._ALERT_COLS)}) "
+                f"VALUES ({','.join('?' * len(self._ALERT_COLS))})",
+                [json.dumps(p.get(c)) if c == "labels"
+                 and p.get(c) is not None else p.get(c)
+                 for c in self._ALERT_COLS])
+            # re-derive the firing gauge from the table — replay order is
+            # commit order, so the count after each upsert is exact
+            row = conn.execute(
+                "SELECT COUNT(*) FROM alerts WHERE state='firing'"
+            ).fetchone()
+            self._alerts_firing = int(row[0]) if row else 0
         elif op == "promote":
             pass  # epoch adoption handled by the apply loop's max_epoch
         # unknown ops are skipped: a newer primary may log kinds an older
@@ -2458,7 +2535,8 @@ class Store(StoreBackend):
                   anomalies: Optional[dict] = None,
                   rollbacks: Optional[int] = None,
                   incarnation: Optional[str] = None,
-                  serve: Optional[dict] = None) -> bool:
+                  serve: Optional[dict] = None,
+                  metrics: Optional[dict] = None) -> bool:
         """Renew a run's liveness lease (zombie-reaper input). Cheap direct
         UPDATE — no listeners fire, no updated_at churn. Replicated (as a
         tiny heartbeat delta, not a whole row) so a promoted standby's
@@ -2468,7 +2546,14 @@ class Store(StoreBackend):
         PROGRESS are separate signals, so the stall-aware reaper can tell
         a wedged step (fresh beats, frozen step) from a dead executor.
         ``anomalies``/``rollbacks`` are cumulative pod counters, folded
-        into the ``polyaxon_train_*`` families by delta."""
+        into the ``polyaxon_train_*`` families by delta.
+
+        ``metrics`` (ISSUE 20) is a :class:`~polyaxon_tpu.obs.history.
+        SeriesBuffer` payload: the pod's recorded points, merged into
+        this store's history recorder under the run's source key — the
+        fleet-rollup half of ``GET /api/v1/metrics/history``. History is
+        process-local like the registry itself (not replicated): a
+        promoted standby rebuilds it from the beats that follow."""
         self._check_writable()
         with self._conn_ctx() as conn:
             now = _now()
@@ -2490,6 +2575,8 @@ class Store(StoreBackend):
                                         incarnation)
                 if serve is not None:
                     self._serve_account(uuid, serve, incarnation)
+                if metrics is not None:
+                    self.recorder.ingest(uuid[:12], metrics)
                 self._log_change(conn, "heartbeat", payload)
         return cur.rowcount > 0
 
@@ -2966,6 +3053,144 @@ class Store(StoreBackend):
             ).fetchall()
         return [json.loads(r[0]) for r in rows]
 
+    # -- SLO alerts (ISSUE 20) ---------------------------------------------
+
+    _ALERT_COLS = ("name", "slo", "state", "severity", "value", "reason",
+                   "labels", "transitions", "first_at", "pending_at",
+                   "fired_at", "resolved_at", "last_notified_at",
+                   "updated_at")
+
+    _ALERT_STATES = ("pending", "firing", "resolved")
+
+    def _row_to_alert(self, row) -> dict:
+        d = dict(zip(self._ALERT_COLS, row))
+        if d.get("labels"):
+            try:
+                d["labels"] = json.loads(d["labels"])
+            except (TypeError, ValueError):
+                d["labels"] = {}
+        else:
+            d["labels"] = {}
+        return d
+
+    def get_alert(self, name: str) -> Optional[dict]:
+        with self._conn_ctx() as conn:
+            row = conn.execute(
+                f"SELECT {','.join(self._ALERT_COLS)} FROM alerts "
+                "WHERE name=?", (name,)).fetchone()
+        return self._row_to_alert(row) if row else None
+
+    def list_alerts(self, state: Optional[str] = None) -> list[dict]:
+        """Alert rows, firing-first then most recently updated — the
+        order the dashboard panel and ``polyaxon alerts ls`` both show."""
+        with self._conn_ctx() as conn:
+            if state is not None:
+                rows = conn.execute(
+                    f"SELECT {','.join(self._ALERT_COLS)} FROM alerts "
+                    "WHERE state=? ORDER BY updated_at DESC",
+                    (state,)).fetchall()
+            else:
+                rows = conn.execute(
+                    f"SELECT {','.join(self._ALERT_COLS)} FROM alerts "
+                    "ORDER BY CASE state WHEN 'firing' THEN 0 "
+                    "WHEN 'pending' THEN 1 ELSE 2 END, updated_at DESC"
+                ).fetchall()
+        return [self._row_to_alert(r) for r in rows]
+
+    def upsert_alert(self, name: str, state: str, slo: Optional[str] = None,
+                     severity: Optional[str] = None,
+                     value: Optional[float] = None,
+                     reason: Optional[str] = None,
+                     labels: Optional[dict] = None,
+                     mark_notified: bool = False, fence=None) -> dict:
+        """Record an alert state — the SLO engine's one write verb.
+
+        Exactly-once semantics come from two properties: the write is
+        FENCED (a deposed evaluator's upsert dies in ``_check_fence``
+        like a stale run transition), and it is a DEDUP'D upsert — a
+        same-state write changes nothing, bumps no transition counter,
+        and logs no changelog record, so two well-behaved evaluators
+        racing the same observation converge on one persisted edge.
+        ``mark_notified`` stamps the notification watermark on the SAME
+        transaction as the transition it announces: a takeover between
+        "alert fired" and "notification recorded" re-notifies (at-least-
+        once paging), but can never record a notification that lost its
+        transition. Returns the row plus ``changed``."""
+        if state not in self._ALERT_STATES:
+            raise ValueError(
+                f"alert state must be one of {self._ALERT_STATES}, "
+                f"got {state!r}")
+        self._check_writable()
+        with self._transition_lock:
+            with self._conn_ctx() as conn:
+                self._check_fence(conn, fence)
+                row = conn.execute(
+                    f"SELECT {','.join(self._ALERT_COLS)} FROM alerts "
+                    "WHERE name=?", (name,)).fetchone()
+                cur = self._row_to_alert(row) if row else None
+                now = _now()
+                if cur is not None and cur["state"] == state:
+                    if mark_notified:
+                        conn.execute(
+                            "UPDATE alerts SET last_notified_at=?, "
+                            "value=COALESCE(?, value), updated_at=? "
+                            "WHERE name=?", (now, value, now, name))
+                        cur["last_notified_at"] = now
+                        cur["updated_at"] = now
+                        if value is not None:
+                            cur["value"] = value
+                    return {**cur, "changed": False}
+                new = {
+                    "name": name,
+                    "slo": slo if slo is not None
+                    else (cur or {}).get("slo"),
+                    "state": state,
+                    "severity": severity if severity is not None
+                    else (cur or {}).get("severity"),
+                    "value": value,
+                    "reason": reason,
+                    "labels": labels if labels is not None
+                    else (cur or {}).get("labels") or {},
+                    "transitions": ((cur or {}).get("transitions") or 0) + 1,
+                    "first_at": (cur or {}).get("first_at") or now,
+                    # pending_at restarts per episode: dwell timing must
+                    # measure THIS breach, not one resolved hours ago
+                    "pending_at": now if state == "pending"
+                    else (cur or {}).get("pending_at"),
+                    "fired_at": now if state == "firing"
+                    else (cur or {}).get("fired_at"),
+                    "resolved_at": now if state == "resolved"
+                    else (cur or {}).get("resolved_at"),
+                    "last_notified_at": now if mark_notified
+                    else (cur or {}).get("last_notified_at"),
+                    "updated_at": now,
+                }
+                conn.execute(
+                    f"INSERT OR REPLACE INTO alerts "
+                    f"({','.join(self._ALERT_COLS)}) "
+                    f"VALUES ({','.join('?' * len(self._ALERT_COLS))})",
+                    [json.dumps(new[c]) if c == "labels" else new[c]
+                     for c in self._ALERT_COLS])
+                if state == "firing":
+                    self._alerts_firing += 1
+                elif cur is not None and cur["state"] == "firing":
+                    self._alerts_firing -= 1
+                self.stats[f"alert_transitions_{state}"] += 1
+                if self._replicate:
+                    self._log_change(conn, "alert", new)
+                return {**new, "changed": True}
+
+    def resolve_alert(self, name: str, value: Optional[float] = None,
+                      reason: Optional[str] = None, fence=None) -> dict:
+        """Transition an alert to resolved. A missing row resolves to a
+        no-op (never creates a resolved ghost); an already-resolved row
+        dedups like any same-state upsert."""
+        cur = self.get_alert(name)
+        if cur is None:
+            return {"name": name, "state": None, "changed": False}
+        return self.upsert_alert(name, "resolved", value=value,
+                                 reason=reason, fence=fence)
+
 
 class FencedStore:
     """Write-fencing proxy over a :class:`Store` (or any store-shaped
@@ -3008,7 +3233,12 @@ class FencedStore:
                # is the sweep (pipeline) uuid, so the default resolver
                # fences them with the PIPELINE's shard lease — the same
                # lease that authorizes the tuner's create_runs
-               "record_trial_intents", "mark_trials_created")
+               "record_trial_intents", "mark_trials_created",
+               # SLO alert edges (ISSUE 20): first positional arg is the
+               # alert NAME — the default resolver hashes it onto a shard
+               # lease exactly like a run uuid, so a sharded fleet splits
+               # the alert space and a deposed evaluator's edge dies here
+               "upsert_alert", "resolve_alert")
 
     def __init__(self, inner, fence_source, on_stale=None):
         import inspect

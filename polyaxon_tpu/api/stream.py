@@ -65,7 +65,7 @@ from .store import CompactedLogError, StaleEpochError, Store
 #: intent, condition, config, token, lineage) is control-plane internals;
 #: ``condition`` is deliberately skipped: the run row of the same
 #: transition already carries the new status, on the same commit
-_FORWARD_OPS = {"run", "delete_run", "heartbeat"}
+_FORWARD_OPS = {"run", "delete_run", "heartbeat", "alert"}
 
 #: eviction reasons (the {reason} label values of
 #: polyaxon_stream_evictions_total)
@@ -271,6 +271,15 @@ class StreamHub:
                 self._projects.pop(payload["uuid"], None)
                 data = {"uuid": payload["uuid"], "project": project}
                 ev_type = "delete"
+            elif op == "alert":
+                # alert transitions (ISSUE 20) are fleet-scoped operator
+                # surface, not project data: project stays None, so the
+                # _visible rule delivers them to UNSCOPED watchers (the
+                # operator dashboard) and keeps them from project-scoped
+                # tokens — fleet health is not tenant data
+                project = None
+                data = payload
+                ev_type = "alert"
             else:  # heartbeat
                 project = self._project_of(payload["uuid"])
                 data = payload

@@ -113,6 +113,7 @@ UI_HTML = """<!DOCTYPE html>
 </header>
 <main>
   <section id="runs"><h2>Runs</h2>
+    <div id="alerts" class="muted" style="margin-bottom:6px"></div>
     <div id="clusters" class="muted" style="margin-bottom:6px"></div>
     <div id="quotas" class="muted" style="margin-bottom:6px"></div>
     <div id="cmpBar" class="muted">check ≥2 runs to compare
@@ -1072,9 +1073,56 @@ async function loadClusters() {
     }).join("");
   } catch (e) { el.innerHTML = ""; }
 }
+// alerts panel (ISSUE 20): SLO alert rows, firing-first, each with a
+// burn-rate sparkline from the ring-buffer history endpoint. Resolved
+// rows drop out; an empty table (or a scoped token's 403) hides the
+// panel entirely — most dashboards should never see it.
+const SPARK = "▁▂▃▄▅▆▇█";
+function sparkline(points) {
+  const tail = (points || []).slice(-24);
+  const vs = tail.map(p => p[1]).filter(v => typeof v === "number");
+  if (!vs.length) return "";
+  const lo = Math.min(...vs), hi = Math.max(...vs);
+  return tail.map(p => {
+    if (typeof p[1] !== "number") return " ";
+    const i = hi > lo
+      ? Math.round((p[1] - lo) / (hi - lo) * (SPARK.length - 1)) : 0;
+    return SPARK[i];
+  }).join("");
+}
+async function loadAlerts() {
+  const el = $("#alerts");
+  try {
+    const rows = ((await j("/api/v1/alerts")).alerts || [])
+      .filter(a => a.state !== "resolved");
+    if (!rows.length) { el.innerHTML = ""; return; }
+    // ONE history fetch covers every alert: burn gauges share a family
+    // and differ only by their {slo=...} label
+    let burns = [];
+    try {
+      burns = (await j("/api/v1/metrics/history" +
+                       "?family=polyaxon_slo_burn_rate&range=3600")).series || [];
+    } catch (e) {}
+    el.innerHTML = `<b>Alerts</b> ` + rows.map(a => {
+      const s = burns.find(b => (b.labels || {}).slo === a.slo);
+      const spark = sparkline(s && s.points);
+      const mark = a.state === "firing"
+        ? `<span style="color:#cd2b31" title="${esc(a.reason || "")}">` +
+          `&#9679; FIRING</span>`
+        : `<span style="color:#b98900" title="${esc(a.reason || "")}">` +
+          `&#9679; pending</span>`;
+      return `<span class="quota"><span class="qname">${esc(a.name)}` +
+        `</span> ${mark}` +
+        (a.severity ? ` ${esc(a.severity)}` : "") +
+        (typeof a.value === "number" ? ` burn ${a.value.toFixed(2)}` : "") +
+        (spark ? ` <span style="font-family:monospace">${spark}</span>` : "") +
+        `</span>`;
+    }).join("");
+  } catch (e) { el.innerHTML = ""; }
+}
 async function refresh() {
   try { await loadProjects(); await loadRuns(); await loadQuotas();
-        await loadClusters();
+        await loadClusters(); await loadAlerts();
         if (selected || compare) await render(); }
   catch (e) { $("#count").textContent = String(e); }
   // the stream subscribes per-project; a project picked/switched after
@@ -1195,7 +1243,7 @@ function onHeartbeat(d) {
       ["logs", "timeline", "metrics", "overview"].includes(tab))
     scheduleDetail();
 }
-let helloTimer = null, esProject = null;
+let helloTimer = null, esProject = null, alertTimer = null;
 function connectStream() {
   if (!window.EventSource) { refresh(); startPolling(); return; }
   if (es) { es.close(); es = null; }
@@ -1226,6 +1274,13 @@ function connectStream() {
   es.addEventListener("run", ev => { esFails = 0; onRunDelta(JSON.parse(ev.data)); });
   es.addEventListener("delete", ev => onRunDelete(JSON.parse(ev.data)));
   es.addEventListener("heartbeat", ev => onHeartbeat(JSON.parse(ev.data)));
+  // alert transitions (ISSUE 20) are rare fleet-scoped events: re-fetch
+  // the panel (one small GET) instead of patching state client-side —
+  // the table is tiny and the fetch dedups any burst via the coalescer
+  es.addEventListener("alert", () => {
+    if (alertTimer) return;
+    alertTimer = setTimeout(() => { alertTimer = null; loadAlerts(); }, 250);
+  });
   es.addEventListener("resync", () => {
     // an epoch rollover / store failover invalidated our position: full
     // resync — subscribe FRESH (a reconnect carrying the stale

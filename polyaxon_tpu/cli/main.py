@@ -1013,6 +1013,78 @@ def cluster_rm(name, yes, host):
 
 
 @cli.group()
+def alerts():
+    """SLO alert state (docs/OBSERVABILITY.md \"SLOs and alerting\")."""
+
+
+def _alert_backend(host):
+    """AlertClient when a host is configured, else the local store —
+    same hostless bootstrap idiom as quota administration."""
+    h = get_host(host)
+    if h:
+        from ..client import AlertClient
+
+        return AlertClient(h, auth_token=get_token(h))
+    from ..api.store import Store
+
+    return Store(os.path.join(".plx", "db.sqlite"))
+
+
+@alerts.command("ls")
+@click.option("--state", default=None,
+              help="filter: pending | firing | resolved")
+@click.option("--host", default=None)
+def alerts_ls(state, host):
+    """List alert rows, firing first."""
+    be = _alert_backend(host)
+    rows = be.list(state=state) if hasattr(be, "_req") \
+        else be.list_alerts(state=state)
+    if not rows:
+        click.echo("no alerts" + (f" in state {state!r}" if state else ""))
+        return
+    click.echo(f"{'alert':<32} {'state':<9} {'sev':<6} {'burn':>8} "
+               f"{'#':>3}  since")
+    for r in rows:
+        since = r.get("fired_at") or r.get("pending_at") or r["first_at"]
+        burn = r.get("value")
+        click.echo(
+            f"{r['name']:<32} {r['state']:<9} "
+            f"{r.get('severity') or '-':<6} "
+            f"{burn if burn is None else round(burn, 2):>8} "
+            f"{r.get('transitions') or 0:>3}  {since}")
+
+
+@cli.group()
+def slo():
+    """SLO burn-rate status (docs/OBSERVABILITY.md)."""
+
+
+@slo.command("status")
+@click.option("--host", default=None)
+def slo_status_cmd(host):
+    """Live fast/slow burn rates for every configured SLO."""
+    be = _alert_backend(host)
+    if hasattr(be, "_req"):
+        rows = be.slo_status()
+    else:
+        # hostless path evaluates the DEFAULT pack against the local
+        # store's (idle) recorder — burn 0 unless something samples it
+        from ..obs.slo import default_slo_pack, slo_status
+
+        rows = slo_status(be.recorder, default_slo_pack())
+    if not rows:
+        click.echo("no SLOs configured")
+        return
+    click.echo(f"{'slo':<24} {'kind':<8} {'objective':>9} "
+               f"{'fast burn':>10} {'slow burn':>10}  state")
+    for r in rows:
+        state = "BREACHING" if r.get("breaching") else "ok"
+        click.echo(
+            f"{r['name']:<24} {r['kind']:<8} {r['objective']:>9} "
+            f"{r['fast_burn']:>10} {r['slow_burn']:>10}  {state}")
+
+
+@cli.group()
 def token():
     """Mint / list / revoke API access tokens (admin)."""
 

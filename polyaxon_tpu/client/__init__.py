@@ -3,8 +3,8 @@ serve front — the request-path failover client for `kind: service`
 replica fleets (ISSUE 12)."""
 
 from .client import (
-    AgentClient, ApiError, BaseClient, ClusterClient, ProjectClient,
-    QuotaClient, RunClient, TokenClient,
+    AgentClient, AlertClient, ApiError, BaseClient, ClusterClient,
+    ProjectClient, QuotaClient, RunClient, TokenClient,
 )
 from .serve import (  # noqa: F401
     ServeFront, ServeUnavailableError, federated_endpoints,

@@ -251,6 +251,28 @@ class ClusterClient(BaseClient):
         return self._json("DELETE", f"/api/v1/clusters/{name}")
 
 
+class AlertClient(BaseClient):
+    """SLO alert + status surface (ISSUE 20, docs/OBSERVABILITY.md)."""
+
+    def list(self, state: Optional[str] = None) -> list[dict]:
+        """Alert rows, firing first; ``state`` filters to one state."""
+        path = "/api/v1/alerts"
+        if state:
+            path += f"?state={state}"
+        return self._json("GET", path).get("alerts", [])
+
+    def slo_status(self) -> list[dict]:
+        """Live burn rates for every configured SLO."""
+        return self._json("GET", "/api/v1/slo/status").get("slos", [])
+
+    def history(self, family: str, range_s: float = 3600.0,
+                at: float = 0.0) -> dict:
+        """Downsampled history for one metric family."""
+        return self._json(
+            "GET", f"/api/v1/metrics/history?family={family}"
+                   f"&range={range_s}&at={at}")
+
+
 class TokenClient(BaseClient):
     """Token administration (RBAC-lite): mint/list/revoke access tokens."""
 
@@ -525,11 +547,14 @@ class RunClient(BaseClient):
                   anomalies: Optional[dict] = None,
                   rollbacks: Optional[int] = None,
                   incarnation: Optional[str] = None,
-                  serve: Optional[dict] = None) -> dict:
+                  serve: Optional[dict] = None,
+                  metrics: Optional[dict] = None) -> dict:
         """Renew the run's liveness lease (see docs/RESILIENCE.md): an
         executor that stops heartbeating gets zombie-reaped by the agent.
         ``step`` reports training progress (ISSUE 8) — an executor whose
-        beats stay fresh while ``step`` freezes gets stall-reaped."""
+        beats stay fresh while ``step`` freezes gets stall-reaped.
+        ``metrics`` (ISSUE 20) is a drained ``SeriesBuffer`` payload the
+        server merges into its fleet-wide metrics history."""
         body: dict = {}
         if step is not None:
             body["step"] = int(step)
@@ -541,6 +566,8 @@ class RunClient(BaseClient):
             body["incarnation"] = str(incarnation)
         if serve is not None:
             body["serve"] = serve
+        if metrics is not None:
+            body["metrics"] = metrics
         return self._json("POST", self._rpath("/heartbeat", uuid=uuid),
                           json=body or None)
 

@@ -1,8 +1,17 @@
-"""Observability layer (ISSUE 5): hand-rolled Prometheus-style metrics
-(no client library dependency — the exposition format is a few lines of
-text) and the run-timeline assembler that joins control-plane lifecycle
-spans with pod-side training spans into one trace."""
+"""Observability layer (ISSUE 5, grown in ISSUE 20): hand-rolled
+Prometheus-style metrics (no client library dependency — the exposition
+format is a few lines of text), the run-timeline assembler that joins
+control-plane lifecycle spans with pod-side training spans into one
+trace, plus the metrics-history recorder and SLO/burn-rate alert engine
+that turn the families into judgments."""
 
+from .history import (
+    DEFAULT_ALLOWLIST,
+    DEFAULT_TIERS,
+    MetricsRecorder,
+    SeriesBuffer,
+    recorder_for,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -10,6 +19,15 @@ from .metrics import (
     MetricsRegistry,
     latency_buckets,
     parse_prometheus,
+)
+from .slo import (
+    ALERT_PREFIX,
+    AlertEngine,
+    DEFAULT_SLO_PACK,
+    burn_rate,
+    default_slo_pack,
+    load_slo_pack,
+    slo_status,
 )
 from .trace import build_timeline, lifecycle_spans, pod_spans
 
@@ -23,4 +41,16 @@ __all__ = [
     "build_timeline",
     "lifecycle_spans",
     "pod_spans",
+    "DEFAULT_ALLOWLIST",
+    "DEFAULT_TIERS",
+    "MetricsRecorder",
+    "SeriesBuffer",
+    "recorder_for",
+    "ALERT_PREFIX",
+    "AlertEngine",
+    "DEFAULT_SLO_PACK",
+    "burn_rate",
+    "default_slo_pack",
+    "load_slo_pack",
+    "slo_status",
 ]

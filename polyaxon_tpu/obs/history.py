@@ -1,0 +1,518 @@
+"""Metrics history (ISSUE 20 tentpole (1)): an embedded ring-buffer
+time-series recorder over the hand-rolled registry.
+
+``/metrics`` answers "what is true right now"; nothing in the obs layer
+(PR 5) could answer "TTFT p95 has been over budget for 10 minutes".
+:class:`MetricsRecorder` closes that gap in-tree, keeping the
+no-external-Prometheus philosophy (docs/OBSERVABILITY.md): a background
+sampler snapshots the local registry every ``interval_s`` into
+fixed-size per-family ring buffers, downsampled across tiers —
+10s x 360 slots (one hour) and 2m x 720 slots (a day) by default — so
+memory is O(families x slots), never O(uptime).
+
+Ring semantics: each tier slot is keyed by its absolute bucket index
+(``int(t / tier_interval)``); a write into a slot whose stamp is from an
+older lap resets it first, so wraparound can never serve a stale lap's
+value as fresh history. Within a bucket, counters keep the LAST sampled
+cumulative value (increase() is computed from positive consecutive
+deltas, so a store restart's counter reset clamps to zero instead of
+going negative) and gauges keep the bucket MAX (downsampling must not
+hide the spike an alert would have fired on). Histograms are decomposed
+into their cumulative ``count``/``sum``/per-``le`` bucket sub-series —
+enough to reconstruct "fraction of observations under threshold" over
+any recorded window, which is exactly what latency burn rates need.
+
+Fleet rollup: remote reporters (serve replicas, training pods — anything
+riding the heartbeat bridge) ship :class:`SeriesBuffer` payloads; the
+server-side recorder :meth:`ingest`\\ s them under a preserved ``source``
+key with their labels intact, and :meth:`query` aggregates across
+sources with the PR-7 shared-registry rule: counters SUM, gauges MAX.
+
+All recorder time is ``time.monotonic`` — history offsets are durations,
+and an NTP step must not tear a window in half. Query results carry
+``age_s`` offsets (seconds before "now"), never wall stamps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from array import array
+from typing import Any, Callable, Optional
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+
+#: (slot_interval_s, slot_count) per downsampling tier: 10s x 1h, 2m x 24h
+DEFAULT_TIERS = ((10.0, 360), (120.0, 720))
+
+#: families the sampler records (and reporters may ship) by default — a
+#: bound, curated set: recording every per-lease/per-tenant family the
+#: registry can mint would make recorder memory O(label cardinality).
+#: Analyzer R8 (slodrift) checks every name here against the
+#: EXPECTED_FAMILIES contract, so an allowlisted family can never be a
+#: typo that silently records nothing.
+DEFAULT_ALLOWLIST = (
+    "polyaxon_store_transactions_total",
+    "polyaxon_store_fence_rejections_total",
+    "polyaxon_store_write_seconds",
+    "polyaxon_store_degraded",
+    "polyaxon_store_epoch",
+    "polyaxon_schedule_latency_seconds",
+    "polyaxon_agent_queue_depth",
+    "polyaxon_agent_active_runs",
+    "polyaxon_agent_chips_in_use",
+    "polyaxon_agent_chip_utilization",
+    "polyaxon_serve_requests_total",
+    "polyaxon_serve_rejected_total",
+    "polyaxon_serve_running_requests",
+    "polyaxon_serve_waiting_requests",
+    "polyaxon_serve_kv_block_utilization",
+    "polyaxon_serve_ttft_seconds",
+    "polyaxon_train_anomalies_total",
+    "polyaxon_train_rollbacks_total",
+    "polyaxon_alerts_firing",
+    "polyaxon_slo_burn_rate",
+)
+
+#: hard cap on distinct (family, labels, source, part) series — a
+#: misbehaving reporter shipping unbounded label sets degrades to
+#: dropped series, never to unbounded server memory
+MAX_SERIES = 4096
+
+#: per-beat cap on shipped points per series (SeriesBuffer + ingest)
+MAX_SHIP_POINTS = 256
+
+
+def _labels_key(labels: Optional[dict]) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+class _Ring:
+    """One tier's fixed-size slot array, keyed by absolute bucket index."""
+
+    __slots__ = ("interval", "size", "vals", "stamps")
+
+    def __init__(self, interval: float, size: int):
+        self.interval = float(interval)
+        self.size = int(size)
+        self.vals = array("d", [0.0]) * self.size
+        # per-slot absolute bucket index; -1 = never written. The stamp
+        # is what makes wraparound safe: a slot left over from a previous
+        # lap fails the stamp check and reads as a gap, not as data.
+        self.stamps = array("q", [-1]) * self.size
+
+    def record(self, t: float, value: float, take_max: bool) -> None:
+        b = int(t / self.interval)
+        slot = b % self.size
+        if self.stamps[slot] != b:
+            self.stamps[slot] = b
+            self.vals[slot] = value
+        elif take_max:
+            if value > self.vals[slot]:
+                self.vals[slot] = value
+        else:
+            self.vals[slot] = value  # last-write (cumulative counters)
+
+    def window(self, now: float, range_s: float,
+               at: Optional[float] = None) -> list:
+        """``[(age_s, value | None), ...]`` oldest-first for the window
+        ending ``at`` seconds before now (lookback; default 0)."""
+        end_t = now - (at or 0.0)
+        end_b = int(end_t / self.interval)
+        n = min(self.size, max(int(range_s / self.interval), 1))
+        out = []
+        for b in range(end_b - n + 1, end_b + 1):
+            if b < 0:
+                continue
+            slot = b % self.size
+            ok = self.stamps[slot] == b
+            age = now - (b + 1) * self.interval
+            out.append((max(age, 0.0), self.vals[slot] if ok else None))
+        return out
+
+
+class _Series:
+    """One (family, labels, source, part) series across every tier."""
+
+    __slots__ = ("family", "labels", "source", "kind", "part", "bound",
+                 "rings")
+
+    def __init__(self, family: str, labels: dict, source: str, kind: str,
+                 part: str, bound: Optional[float], tiers) -> None:
+        self.family = family
+        self.labels = dict(labels or {})
+        self.source = source
+        self.kind = kind          # "counter" | "gauge"
+        self.part = part          # "value" | "count" | "sum" | "le"
+        self.bound = bound        # histogram bucket bound for part "le"
+        self.rings = [_Ring(i, n) for i, n in tiers]
+
+    def record(self, t: float, value: float) -> None:
+        take_max = self.kind == "gauge"
+        for ring in self.rings:
+            ring.record(t, value, take_max)
+
+
+def increase(points: list) -> float:
+    """Counter increase over a window of (age, cumulative) points: the
+    sum of POSITIVE consecutive deltas — a mid-window counter reset
+    (store restart) contributes zero instead of a negative cliff."""
+    total, prev = 0.0, None
+    for _, v in points:
+        if v is None:
+            continue
+        if prev is not None and v > prev:
+            total += v - prev
+        prev = v
+    return total
+
+
+class MetricsRecorder:
+    """Background sampler + ring store + fleet-rollup ingest.
+
+    One recorder per registry (see :func:`recorder_for`): every Store
+    peer sharing a registry shares the recorder, exactly like the
+    families themselves. ``clock`` is injectable for deterministic
+    tier/wraparound tests."""
+
+    def __init__(self, registry: MetricsRegistry,
+                 interval_s: float = 10.0,
+                 tiers=DEFAULT_TIERS,
+                 allowlist=DEFAULT_ALLOWLIST,
+                 clock: Callable[[], float] = time.monotonic):
+        self.registry = registry
+        self.interval_s = max(float(interval_s), 0.01)
+        self.tiers = tuple((float(i), int(n)) for i, n in tiers)
+        self.allow = set(allowlist) if allowlist is not None else None
+        self._clock = clock
+        self._series: dict[tuple, _Series] = {}
+        self._lock = threading.Lock()
+        #: overhead accounting for the <=1% acceptance check: the chaos
+        #: soak divides sample_seconds_total by wall elapsed
+        self.stats = {"samples": 0, "points": 0, "ingests": 0,
+                      "dropped_series": 0, "sample_seconds_total": 0.0}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "MetricsRecorder":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="metrics-recorder")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample()
+            except Exception:
+                pass  # sampling must never kill the host process
+
+    # -- recording ---------------------------------------------------------
+
+    def _get_series(self, family: str, labels: dict, source: str,
+                    kind: str, part: str = "value",
+                    bound: Optional[float] = None) -> Optional[_Series]:
+        key = (family, _labels_key(labels), source, part, bound)
+        s = self._series.get(key)
+        if s is None:
+            if len(self._series) >= MAX_SERIES:
+                self.stats["dropped_series"] += 1
+                return None
+            s = _Series(family, labels, source, kind, part, bound,
+                        self.tiers)
+            self._series[key] = s
+        return s
+
+    def observe(self, family: str, value: float, labels=None,
+                kind: str = "gauge", source: str = "local",
+                part: str = "value", bound: Optional[float] = None,
+                now: Optional[float] = None) -> None:
+        """Record one point directly (reporters and tests; the sampler
+        uses it too)."""
+        t = self._clock() if now is None else now
+        with self._lock:
+            s = self._get_series(family, labels or {}, source, kind,
+                                 part, bound)
+            if s is not None:
+                s.record(t, float(value))
+                self.stats["points"] += 1
+
+    def sample(self, now: Optional[float] = None) -> int:
+        """One sampler pass over the registry. Returns points recorded."""
+        t0 = time.perf_counter()
+        t = self._clock() if now is None else now
+        n = 0
+        for name, metrics in self.registry.families().items():
+            if self.allow is not None and name not in self.allow:
+                continue
+            for m in metrics:
+                labels = dict(getattr(m, "labels", None) or {})
+                if isinstance(m, Histogram):
+                    n += self._sample_histogram(name, labels, m, t)
+                    continue
+                kind = "counter" if isinstance(m, Counter) else "gauge"
+                try:
+                    v = float(m.value)
+                except Exception:
+                    continue  # a peer's value_fn died mid-teardown
+                if v != v:  # NaN never enters the rings
+                    continue
+                self.observe(name, v, labels=labels, kind=kind, now=t)
+                n += 1
+        self.stats["samples"] += 1
+        self.stats["sample_seconds_total"] += time.perf_counter() - t0
+        return n
+
+    def _sample_histogram(self, name: str, labels: dict, h: Histogram,
+                          t: float) -> int:
+        with h._lock:
+            counts = list(h._counts)
+            total = h.count
+            hsum = h.sum
+        bounds = h.bounds
+        cum = 0
+        with self._lock:
+            for i, b in enumerate(bounds):
+                cum += counts[i]
+                s = self._get_series(name, labels, "local", "counter",
+                                     part="le", bound=float(b))
+                if s is not None:
+                    s.record(t, float(cum))
+            for part, v in (("count", float(total)), ("sum", float(hsum))):
+                s = self._get_series(name, labels, "local", "counter",
+                                     part=part)
+                if s is not None:
+                    s.record(t, v)
+            self.stats["points"] += len(bounds) + 2
+        return len(bounds) + 2
+
+    # -- fleet rollup (heartbeat-shipped buffers) --------------------------
+
+    def ingest(self, source: str, payload: dict) -> int:
+        """Merge a reporter's shipped buffer. ``payload`` is the
+        :class:`SeriesBuffer` wire shape: ``{"series": [{"family",
+        "labels", "kind", "points": [[age_s, value], ...]}, ...]}``.
+        Points are re-stamped ``now - age_s`` on THIS process's monotonic
+        clock — reporters never ship wall time, so clock skew between
+        hosts shifts a series by network latency at worst."""
+        if not isinstance(payload, dict):
+            return 0
+        now = self._clock()
+        n = 0
+        for entry in (payload.get("series") or [])[:256]:
+            if not isinstance(entry, dict):
+                continue
+            family = entry.get("family")
+            if not isinstance(family, str) or not family:
+                continue
+            if self.allow is not None and family not in self.allow:
+                continue
+            labels = entry.get("labels")
+            labels = dict(labels) if isinstance(labels, dict) else {}
+            kind = "counter" if entry.get("kind") == "counter" else "gauge"
+            for pt in (entry.get("points") or [])[:MAX_SHIP_POINTS]:
+                try:
+                    age, value = float(pt[0]), float(pt[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if value != value or age < 0:
+                    continue
+                self.observe(family, value, labels=labels, kind=kind,
+                             source=str(source), now=now - age)
+                n += 1
+        if n:
+            self.stats["ingests"] += 1
+        return n
+
+    # -- queries -----------------------------------------------------------
+
+    def _tier_for(self, range_s: float) -> int:
+        for i, (interval, size) in enumerate(self.tiers):
+            if range_s <= interval * size:
+                return i
+        return len(self.tiers) - 1
+
+    def _family_series(self, family: str, labels=None) -> list:
+        want = _labels_key(labels) if labels is not None else None
+        out = []
+        for s in self._series.values():
+            if s.family != family or s.part not in ("value", "count"):
+                continue
+            if want is not None and _labels_key(s.labels) != want:
+                continue
+            out.append(s)
+        # histogram families expose their observation rate through the
+        # "count" sub-series; plain families through "value" — never mix
+        if any(s.part == "value" for s in out):
+            out = [s for s in out if s.part == "value"]
+        return out
+
+    def query(self, family: str, range_s: float,
+              at: Optional[float] = None, labels=None) -> dict:
+        """History document for one family: per-source series plus the
+        fleet aggregate (sum counters / max gauges per bucket — the PR-7
+        shared-registry rule applied across reporters)."""
+        range_s = max(float(range_s), 1.0)
+        at = max(float(at or 0.0), 0.0)
+        now = self._clock()
+        ti = self._tier_for(range_s + at)
+        interval = self.tiers[ti][0]
+        with self._lock:
+            members = self._family_series(family, labels)
+            kind = members[0].kind if members else "gauge"
+            series_docs, windows = [], []
+            for s in members:
+                pts = s.rings[ti].window(now, range_s, at)
+                windows.append(pts)
+                doc_pts = [[round(a, 3), v] for a, v in pts]
+                series_docs.append({"labels": s.labels, "source": s.source,
+                                    "points": doc_pts})
+            agg = []
+            if windows:
+                for i in range(len(windows[0])):
+                    vals = [w[i][1] for w in windows
+                            if i < len(w) and w[i][1] is not None]
+                    age = windows[0][i][0]
+                    if not vals:
+                        agg.append([round(age, 3), None])
+                    elif kind == "counter":
+                        agg.append([round(age, 3), sum(vals)])
+                    else:
+                        agg.append([round(age, 3), max(vals)])
+        return {"family": family, "kind": kind, "interval_s": interval,
+                "range_s": range_s, "at_s": at, "series": series_docs,
+                "points": agg}
+
+    def counter_increase(self, family: str, window_s: float,
+                         at: Optional[float] = None, labels=None) -> float:
+        """Summed increase across every source's series over the window
+        (counters sum across the fleet)."""
+        now = self._clock()
+        ti = self._tier_for(window_s + (at or 0.0))
+        with self._lock:
+            members = self._family_series(family, labels)
+            return sum(increase(s.rings[ti].window(now, window_s, at))
+                       for s in members if s.kind == "counter")
+
+    def gauge_points(self, family: str, window_s: float,
+                     at: Optional[float] = None, labels=None) -> list:
+        """Per-bucket MAX across sources over the window (gauges take
+        the max across the fleet); gaps are dropped."""
+        now = self._clock()
+        ti = self._tier_for(window_s + (at or 0.0))
+        out: dict[float, float] = {}
+        with self._lock:
+            for s in self._family_series(family, labels):
+                if s.kind != "gauge":
+                    continue
+                for age, v in s.rings[ti].window(now, window_s, at):
+                    if v is None:
+                        continue
+                    if age not in out or v > out[age]:
+                        out[age] = v
+        return sorted(out.items(), reverse=True)
+
+    def hist_window(self, family: str, threshold: float, window_s: float,
+                    at: Optional[float] = None,
+                    labels=None) -> tuple[float, float]:
+        """``(good, total)`` observation increases over the window for a
+        recorded histogram, where "good" is observations at or under
+        ``threshold`` — snapped to the nearest recorded bucket bound
+        (the exposition's resolution; docs/OBSERVABILITY.md)."""
+        now = self._clock()
+        ti = self._tier_for(window_s + (at or 0.0))
+        want = _labels_key(labels) if labels is not None else None
+        good = total = 0.0
+        with self._lock:
+            by_key: dict[tuple, list] = {}
+            for s in self._series.values():
+                if s.family != family or s.part not in ("le", "count"):
+                    continue
+                if want is not None and _labels_key(s.labels) != want:
+                    continue
+                by_key.setdefault((_labels_key(s.labels), s.source),
+                                  []).append(s)
+            for members in by_key.values():
+                counts = [s for s in members if s.part == "count"]
+                les = sorted((s for s in members if s.part == "le"),
+                             key=lambda s: s.bound)
+                if not counts or not les:
+                    continue
+                best = min(les, key=lambda s: abs(s.bound - threshold))
+                good += increase(best.rings[ti].window(now, window_s, at))
+                total += increase(
+                    counts[0].rings[ti].window(now, window_s, at))
+        return min(good, total), total
+
+    def families(self) -> list[str]:
+        with self._lock:
+            return sorted({s.family for s in self._series.values()})
+
+
+def recorder_for(registry: MetricsRegistry,
+                 interval_s: float = 10.0,
+                 start: bool = True, **kw: Any) -> MetricsRecorder:
+    """The registry's recorder singleton (the same attach-once idiom as
+    the Store's ``_store_sources`` peer list): every Store sharing a
+    registry shares one sampler thread and one ring set."""
+    rec = getattr(registry, "_recorder", None)
+    if rec is None:
+        rec = MetricsRecorder(registry, interval_s=interval_s, **kw)
+        registry._recorder = rec
+    if start:
+        rec.start()
+    return rec
+
+
+class SeriesBuffer:
+    """Client-side shipping buffer for the heartbeat bridge: reporters
+    (serve replicas, training pods) append points between beats and
+    attach :meth:`drain` to the next heartbeat's ``metrics`` field. The
+    wire shape carries AGES, not timestamps — the server re-stamps on
+    its own clock, so reporter clock skew cannot bend fleet history."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._points: dict[tuple, list] = {}
+        self._kinds: dict[tuple, str] = {}
+
+    def add(self, family: str, value: float, labels=None,
+            kind: str = "gauge") -> None:
+        key = (family, _labels_key(labels))
+        with self._lock:
+            pts = self._points.setdefault(key, [])
+            pts.append((self._clock(), float(value)))
+            del pts[:-MAX_SHIP_POINTS]
+            self._kinds[key] = kind
+
+    def drain(self) -> Optional[dict]:
+        """The accumulated buffer as an ``ingest``-shaped payload (ages
+        computed at drain time), clearing it. None when empty — callers
+        skip the heartbeat field entirely instead of shipping ``[]``."""
+        now = self._clock()
+        with self._lock:
+            if not self._points:
+                return None
+            series = []
+            for (family, lkey), pts in self._points.items():
+                series.append({
+                    "family": family,
+                    "labels": dict(lkey),
+                    "kind": self._kinds.get((family, lkey), "gauge"),
+                    "points": [[round(max(now - t, 0.0), 3), v]
+                               for t, v in pts],
+                })
+            self._points.clear()
+            self._kinds.clear()
+        return {"series": series}

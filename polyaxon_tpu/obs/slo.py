@@ -1,0 +1,280 @@
+"""SLO engine (ISSUE 20 tentpole (2)): burn-rate evaluation over the
+metrics-history recorder, driving a fenced, exactly-once alert state
+machine.
+
+The split of responsibilities:
+
+- :func:`burn_rate` / :func:`slo_status` are PURE reads over a
+  :class:`~polyaxon_tpu.obs.history.MetricsRecorder` — the API endpoint,
+  the CLI, and the evaluator all call the same math, so "what the
+  dashboard shows" and "what pages you" can never disagree.
+- :class:`AlertEngine` owns the pending→firing→resolved state machine.
+  It PERSISTS every transition through the store's fenced
+  ``upsert_alert``/``resolve_alert`` verbs, which makes alert edges
+  exactly-once across agent takeovers and store failover for free — a
+  deposed agent's write dies with ``StaleLeaseError`` exactly like a
+  stale run transition would (the PR-6 fencing contract). The engine
+  itself keeps NO authoritative state: everything it needs to decide
+  dedup, dwell, and re-notify is read back from the alert row, so a
+  takeover agent resumes mid-episode without double-notifying.
+
+Notification dedup lives in the row too: ``last_notified_at`` is stamped
+via ``mark_notified`` on the same fenced write that records the
+transition, so two agents racing a takeover cannot both win the notify
+(the loser's stamp never lands).
+
+Burn-rate convention (SRE workbook): ``burn = error_rate / (1 -
+objective)`` — burn 1.0 means the error budget is being spent exactly at
+the rate that exhausts it at the window's end; ``fast_burn: 14`` on a 5m
+window plus ``slow_burn: 6`` on 1h is the classic page-worthy pair. An
+alert needs BOTH windows breaching: fast alone is a blip, slow alone is
+old news.
+"""
+
+from __future__ import annotations
+
+import operator
+import time
+from typing import Callable, Iterable, List, Optional
+
+from ..resilience.heartbeat import age_seconds
+from ..schemas.slo import V1SLO, V1SLOPack
+from .history import MetricsRecorder
+
+_OPS = {">=": operator.ge, ">": operator.gt,
+        "<=": operator.le, "<": operator.lt}
+
+#: alert rows owned by the SLO engine are namespaced so operator-created
+#: annotations can never collide with an evaluator's state machine
+ALERT_PREFIX = "slo:"
+
+#: the in-tree default pack: serving TTFT + availability, store write
+#: latency + availability, training stability. Every family here must be
+#: a registered EXPECTED_FAMILIES name — analyzer R8 (slodrift) enforces
+#: it, so a pack typo fails CI instead of silently never firing.
+DEFAULT_SLO_PACK = [
+    {"name": "serve-ttft", "kind": "latency",
+     "family": "polyaxon_serve_ttft_seconds",
+     "threshold_s": 2.0, "objective": 0.95,
+     "description": "95% of serve requests reach first token within 2s"},
+    {"name": "serve-availability", "kind": "ratio",
+     "bad_family": "polyaxon_serve_rejected_total",
+     "total_family": "polyaxon_serve_requests_total",
+     "objective": 0.999,
+     "description": "99.9% of serve requests admitted (not shed)"},
+    {"name": "store-write-latency", "kind": "latency",
+     "family": "polyaxon_store_write_seconds",
+     "threshold_s": 0.25, "objective": 0.99,
+     "description": "99% of store write transactions commit within 250ms"},
+    {"name": "store-available", "kind": "gauge",
+     "family": "polyaxon_store_degraded",
+     "threshold": 1.0, "op": ">=", "objective": 0.99,
+     "fast_burn": 1.0, "slow_burn": 0.02,
+     "description": "store not running degraded (failover/read-only)"},
+    {"name": "train-stability", "kind": "events",
+     "family": "polyaxon_train_anomalies_total",
+     "budget_per_hour": 5.0, "objective": 0.99,
+     "fast_burn": 1.0, "slow_burn": 0.05,
+     "description": "fewer than 5 training anomalies (NaN/spike) per hour"},
+]
+
+
+def default_slo_pack() -> List[V1SLO]:
+    return [V1SLO.from_dict(d) for d in DEFAULT_SLO_PACK]
+
+
+def load_slo_pack(text: str) -> List[V1SLO]:
+    """Parse a YAML SLO pack (``slos: [...]``) via the schema layer."""
+    return list(V1SLOPack.from_yaml(text).slos)
+
+
+def burn_rate(recorder: MetricsRecorder, spec: V1SLO, window_s: float,
+              at: Optional[float] = None) -> float:
+    """Error-budget burn for one spec over one window. No recorded data
+    reads as burn 0 — absence of evidence never pages."""
+    if spec.kind == "latency":
+        good, total = recorder.hist_window(
+            spec.family, spec.threshold_s, window_s, at)
+        if total <= 0:
+            return 0.0
+        err = 1.0 - good / total
+        return err / (1.0 - spec.objective)
+    if spec.kind == "ratio":
+        total = recorder.counter_increase(spec.total_family, window_s, at)
+        if total <= 0:
+            return 0.0
+        bad = recorder.counter_increase(spec.bad_family, window_s, at)
+        err = min(bad / total, 1.0)
+        return err / (1.0 - spec.objective)
+    if spec.kind == "events":
+        n = recorder.counter_increase(spec.family, window_s, at)
+        rate_per_hour = n * 3600.0 / max(window_s, 1.0)
+        return rate_per_hour / spec.budget_per_hour
+    # gauge: fraction of recorded buckets in breach, against budget
+    pts = recorder.gauge_points(spec.family, window_s, at)
+    if not pts:
+        return 0.0
+    cmp = _OPS[spec.op]
+    frac = sum(1 for _, v in pts if cmp(v, spec.threshold)) / len(pts)
+    return frac / (1.0 - spec.objective)
+
+
+def slo_status(recorder: MetricsRecorder, specs: Iterable[V1SLO],
+               at: Optional[float] = None) -> List[dict]:
+    """Per-SLO burn summary — the one shape served by ``/api/v1/slo/
+    status``, ``polyaxon slo status``, and the dashboard panel."""
+    out = []
+    for spec in specs:
+        fast = burn_rate(recorder, spec, spec.fast_window_s, at)
+        slow = burn_rate(recorder, spec, spec.slow_window_s, at)
+        out.append({
+            "name": spec.name,
+            "kind": spec.kind,
+            "objective": spec.objective,
+            "severity": spec.severity,
+            "description": spec.description,
+            "fast_window_s": spec.fast_window_s,
+            "slow_window_s": spec.slow_window_s,
+            "fast_burn": round(fast, 4),
+            "slow_burn": round(slow, 4),
+            "fast_threshold": spec.fast_burn,
+            "slow_threshold": spec.slow_burn,
+            "breaching": fast >= spec.fast_burn and slow >= spec.slow_burn,
+        })
+    return out
+
+
+class AlertEngine:
+    """Evaluates a spec pack and drives persisted alert rows.
+
+    ``store`` is any object exposing ``get_alert``/``upsert_alert``/
+    ``resolve_alert`` — the agent passes its :class:`FencedStore` handle
+    so every write carries its lease fence. ``owns`` (optional) filters
+    which alert names THIS evaluator drives; the agent wires it to its
+    crc32 shard ownership so a sharded fleet splits the pack without
+    coordination, the same rule that splits runs.
+
+    ``notify`` receives one dict per user-visible edge (fired, re-notify,
+    resolved); the agent adapts it onto the webhook/slack hook path.
+    """
+
+    def __init__(self, store, recorder: MetricsRecorder,
+                 specs: Optional[Iterable[V1SLO]] = None,
+                 notify: Optional[Callable[[dict], None]] = None,
+                 owns: Optional[Callable[[str], bool]] = None,
+                 registry=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.store = store
+        self.recorder = recorder
+        self.specs = list(specs) if specs is not None else default_slo_pack()
+        self.notify = notify
+        self.owns = owns
+        self._clock = clock
+        self.stats = {"evaluations": 0, "notifications": 0}
+        self._gauges = {}
+        if registry is not None:
+            # from-birth registration: every spec's burn gauge exists at
+            # scrape time zero, even before the first evaluation
+            for spec in self.specs:
+                self._gauges[spec.name] = registry.gauge(
+                    "polyaxon_slo_burn_rate",
+                    "Fast-window error-budget burn rate per SLO "
+                    "(1.0 = budget exhausted exactly at window end)",
+                    labels={"slo": spec.name})
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate_once(self, at: Optional[float] = None) -> List[dict]:
+        """One pass over the pack. Raises ``StaleLeaseError`` out to the
+        caller when a fenced alert write loses a takeover race — the
+        agent loop already treats that as "stop driving, re-lease"."""
+        out = []
+        for spec in self.specs:
+            name = ALERT_PREFIX + spec.name
+            if self.owns is not None and not self.owns(name):
+                continue
+            fast = burn_rate(self.recorder, spec, spec.fast_window_s, at)
+            slow = burn_rate(self.recorder, spec, spec.slow_window_s, at)
+            g = self._gauges.get(spec.name)
+            if g is not None:
+                g.set(fast)
+            breach = (fast >= spec.fast_burn and slow >= spec.slow_burn)
+            out.append(self._step(spec, name, breach, fast, slow))
+        self.stats["evaluations"] += 1
+        return out
+
+    def _step(self, spec: V1SLO, name: str, breach: bool,
+              fast: float, slow: float) -> dict:
+        cur = self.store.get_alert(name)
+        state = cur.get("state") if cur else None
+        reason = (f"fast burn {fast:.2f} (>= {spec.fast_burn}), "
+                  f"slow burn {slow:.2f} (>= {spec.slow_burn})")
+        if not breach:
+            if state in ("pending", "firing"):
+                res = self.store.resolve_alert(
+                    name, value=fast, reason=f"fast burn {fast:.2f} "
+                    f"below {spec.fast_burn}")
+                # a pending episode that never fired resolves silently —
+                # nobody was paged, nobody needs an all-clear
+                if res.get("changed") and state == "firing":
+                    self._emit(spec, name, "resolved", fast)
+                return {"name": name, "state": "resolved", "fast": fast,
+                        "slow": slow}
+            return {"name": name, "state": "ok", "fast": fast,
+                    "slow": slow}
+
+        if state == "firing":
+            last = cur.get("last_notified_at")
+            age = age_seconds(last)
+            if age is not None and age >= spec.renotify_interval_s:
+                # still burning after a full re-notify interval: page
+                # again. mark_notified rides a fenced write, so only one
+                # agent can win the re-notify even mid-takeover.
+                self.store.upsert_alert(
+                    name, "firing", slo=spec.name, severity=spec.severity,
+                    value=fast, reason=reason, mark_notified=True)
+                self._emit(spec, name, "firing", fast, renotify=True)
+            return {"name": name, "state": "firing", "fast": fast,
+                    "slow": slow}
+
+        if state == "pending":
+            dwell = age_seconds(cur.get("pending_at")
+                                or cur.get("updated_at"))
+            if dwell is None or dwell < spec.for_s:
+                return {"name": name, "state": "pending", "fast": fast,
+                        "slow": slow}
+            res = self.store.upsert_alert(
+                name, "firing", slo=spec.name, severity=spec.severity,
+                value=fast, reason=reason, mark_notified=True)
+            if res.get("changed"):
+                self._emit(spec, name, "firing", fast)
+            return {"name": name, "state": "firing", "fast": fast,
+                    "slow": slow}
+
+        # fresh breach
+        if spec.for_s > 0:
+            self.store.upsert_alert(
+                name, "pending", slo=spec.name, severity=spec.severity,
+                value=fast, reason=reason)
+            return {"name": name, "state": "pending", "fast": fast,
+                    "slow": slow}
+        res = self.store.upsert_alert(
+            name, "firing", slo=spec.name, severity=spec.severity,
+            value=fast, reason=reason, mark_notified=True)
+        if res.get("changed"):
+            self._emit(spec, name, "firing", fast)
+        return {"name": name, "state": "firing", "fast": fast,
+                "slow": slow}
+
+    def _emit(self, spec: V1SLO, name: str, state: str, value: float,
+              renotify: bool = False) -> None:
+        self.stats["notifications"] += 1
+        if self.notify is None:
+            return
+        try:
+            self.notify({"alert": name, "slo": spec.name, "state": state,
+                         "severity": spec.severity, "value": round(value, 4),
+                         "description": spec.description or "",
+                         "renotify": renotify})
+        except Exception:
+            pass  # a broken webhook must never stall evaluation

@@ -157,6 +157,8 @@ class LocalAgent:
         region: Optional[str] = None,
         chip_type: Optional[str] = None,
         fed_clusters: Optional[dict] = None,
+        slo_specs: Optional[list] = None,
+        slo_eval_interval_s: float = 10.0,
     ):
         import uuid as uuid_mod
 
@@ -482,6 +484,33 @@ class LocalAgent:
         self._c_failovers = self.metrics.counter(
             "polyaxon_cluster_failovers_total",
             "Runs re-placed off a lost cluster onto survivors")
+        # -- SLO evaluation + metrics history (ISSUE 20) -------------------
+        # The evaluator rides the agent loop (no extra thread): every
+        # record_interval_s the registry is sampled into the history
+        # rings, every slo_eval_interval_s the pack is evaluated and
+        # alert edges are written THROUGH self.store — the fenced proxy —
+        # so a deposed agent's alert write dies exactly like its stale
+        # run transitions would. The ``owns`` filter hashes alert names
+        # onto the same crc32 shard partition as runs: a sharded fleet
+        # splits the pack with zero coordination, and a takeover moves an
+        # alert's evaluator with its shard. slo_eval_interval_s <= 0
+        # disables evaluation (the recorder keeps sampling).
+        from ..obs.history import recorder_for
+        from ..obs.slo import AlertEngine
+
+        self.recorder = recorder_for(
+            self.metrics,
+            interval_s=getattr(store, "record_interval_s", 10.0),
+            start=False)
+        self.slo_eval_interval_s = slo_eval_interval_s
+        self._slo_eval_last = float("-inf")
+        self._record_last = float("-inf")
+        self.slo_engine = None
+        if slo_eval_interval_s > 0:
+            self.slo_engine = AlertEngine(
+                self.store, self.recorder, specs=slo_specs,
+                notify=self._notify_alert, owns=self._owns_run,
+                registry=self.metrics)
         self.sidecar_interval = 1.0
         self._stop = threading.Event()
         self._wake = threading.Event()  # set by the watch thread
@@ -2322,6 +2351,49 @@ class LocalAgent:
                 target=self._post_hook, args=(url, payload), daemon=True,
             ).start()
 
+    def _notify_alert(self, event: dict) -> None:
+        """Alert notifications (ISSUE 20) ride the SAME webhook/slack
+        connection catalog as run hooks — fire-and-forget threads, every
+        hook-capable connection gets fleet alerts (they are operator
+        surface, not per-run config). Dedup already happened upstream:
+        the engine only emits on persisted transitions and re-notify
+        expiry, both recorded through fenced writes."""
+        for conn in self.connections.values():
+            if getattr(conn, "kind", None) not in ("webhook", "slack"):
+                continue
+            s = conn.schema_
+            url = (s.get("url") if isinstance(s, dict)
+                   else getattr(s, "url", None)) or ""
+            if not url:
+                continue
+            if conn.kind == "slack":
+                verb = ("RESOLVED" if event["state"] == "resolved"
+                        else "still FIRING" if event.get("renotify")
+                        else "FIRING")
+                payload = {"text": f"[{event['severity']}] "
+                                   f"{event['alert']} {verb} "
+                                   f"(burn {event['value']}): "
+                                   f"{event['description']}"}
+            else:
+                payload = dict(event)
+            threading.Thread(
+                target=self._post_hook, args=(url, payload), daemon=True,
+            ).start()
+
+    def _slo_tick(self) -> None:
+        """Recorder sampling + SLO evaluation on the agent loop, both
+        monotonic-rate-limited so a busy loop (0.2s wakes) pays nothing
+        between beats. Runs AFTER the scheduling pass: the families it
+        samples include the gauges that pass just updated."""
+        now = time.monotonic()
+        if now - self._record_last >= self.recorder.interval_s:
+            self._record_last = now
+            self.recorder.sample()
+        if (self.slo_engine is not None
+                and now - self._slo_eval_last >= self.slo_eval_interval_s):
+            self._slo_eval_last = now
+            self.slo_engine.evaluate_once()
+
     @staticmethod
     def _post_hook(url: str, payload: dict) -> None:
         import json as _json
@@ -2465,6 +2537,7 @@ class LocalAgent:
                     self._tick_dirty(dirty)
                 else:
                     self._idle_pass()
+                self._slo_tick()
             except StaleLeaseError:
                 # fenced out mid-pass: _on_stale_lease already demoted us;
                 # the pass's partial work is someone else's to redo

@@ -87,6 +87,7 @@ from .run import (
     V1Tuner,
     V1XGBoostJob,
 )
+from .slo import GAUGE_OPS, SLO_KINDS, V1SLO, V1SLOPack
 from .statuses import (
     DONE_STATUSES,
     RUNNABLE_STATUSES,

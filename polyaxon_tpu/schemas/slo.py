@@ -1,0 +1,126 @@
+"""SLO spec schemas (ISSUE 20): declarative objectives evaluated by
+``obs.slo`` against the metrics-history recorder.
+
+The spec follows the multi-window multi-burn-rate alerting shape (the
+SRE-workbook recipe): an alert fires only when BOTH a fast window (is it
+bad right now?) and a slow window (has it been bad long enough to spend
+real budget?) burn error budget faster than their thresholds. Four
+spec kinds cover the families the repo actually exports:
+
+- ``latency`` — fraction of histogram observations over ``threshold_s``
+  is the error rate (good = observations at or under the threshold).
+- ``ratio``   — ``bad_family`` / ``total_family`` counter increase ratio
+  (e.g. rejected / requests for serving availability).
+- ``events``  — ``family`` counter increase per hour vs
+  ``budget_per_hour`` (e.g. training NaN anomalies).
+- ``gauge``   — fraction of recorded buckets where the gauge breaches
+  ``threshold`` under ``op`` (e.g. ``polyaxon_store_degraded >= 1``).
+
+Burn rate is always ``error_rate / (1 - objective)`` (events use
+``rate / budget``), so a threshold like ``fast_burn: 14`` reads the
+standard way: the budget is being consumed 14x faster than break-even.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from pydantic import field_validator, model_validator
+
+from .base import BaseSchema
+
+SLO_KINDS = ("latency", "ratio", "events", "gauge")
+GAUGE_OPS = (">=", ">", "<=", "<")
+
+
+class V1SLO(BaseSchema):
+    """One service-level objective plus its burn-rate alert policy."""
+
+    name: str
+    kind: str = "ratio"
+    description: Optional[str] = None
+    severity: str = "page"
+
+    # target: e.g. 0.999 = 99.9% of events good / budget fraction 0.001
+    objective: float = 0.999
+
+    # kind-specific selectors
+    family: Optional[str] = None        # latency / events / gauge
+    bad_family: Optional[str] = None    # ratio numerator
+    total_family: Optional[str] = None  # ratio denominator
+    threshold_s: Optional[float] = None  # latency: good <= threshold_s
+    threshold: Optional[float] = None    # gauge comparison value
+    op: str = ">="                       # gauge comparison operator
+    budget_per_hour: Optional[float] = None  # events: allowed rate
+
+    # multi-window burn-rate policy
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+    fast_burn: float = 14.0
+    slow_burn: float = 6.0
+
+    # alert state machine knobs
+    for_s: float = 0.0                   # dwell before pending -> firing
+    renotify_interval_s: float = 3600.0  # re-notify while still firing
+
+    @field_validator("kind")
+    @classmethod
+    def _kind_known(cls, v: str) -> str:
+        if v not in SLO_KINDS:
+            raise ValueError(f"kind must be one of {SLO_KINDS}, got {v!r}")
+        return v
+
+    @field_validator("op")
+    @classmethod
+    def _op_known(cls, v: str) -> str:
+        if v not in GAUGE_OPS:
+            raise ValueError(f"op must be one of {GAUGE_OPS}, got {v!r}")
+        return v
+
+    @field_validator("objective")
+    @classmethod
+    def _objective_sane(cls, v: float) -> float:
+        if not (0.0 < v < 1.0):
+            raise ValueError("objective must be in (0, 1)")
+        return v
+
+    @model_validator(mode="after")
+    def _kind_fields(self) -> "V1SLO":
+        if self.kind == "latency":
+            if not self.family or self.threshold_s is None:
+                raise ValueError(
+                    "latency SLO needs family + threshold_s")
+        elif self.kind == "ratio":
+            if not self.bad_family or not self.total_family:
+                raise ValueError(
+                    "ratio SLO needs bad_family + total_family")
+        elif self.kind == "events":
+            if not self.family or not self.budget_per_hour:
+                raise ValueError(
+                    "events SLO needs family + budget_per_hour")
+        elif self.kind == "gauge":
+            if not self.family or self.threshold is None:
+                raise ValueError("gauge SLO needs family + threshold")
+        if self.slow_window_s < self.fast_window_s:
+            raise ValueError("slow_window_s must be >= fast_window_s")
+        return self
+
+    def families(self) -> List[str]:
+        """Every metric family this spec reads — the drift surface
+        analyzer R8 checks against the EXPECTED_FAMILIES contract."""
+        out = [f for f in (self.family, self.bad_family,
+                           self.total_family) if f]
+        return out
+
+
+class V1SLOPack(BaseSchema):
+    """A YAML-loadable bundle of SLOs (``polyaxon slo`` / agent config)."""
+
+    slos: List[V1SLO] = []
+
+    @model_validator(mode="after")
+    def _unique_names(self) -> "V1SLOPack":
+        names = [s.name for s in self.slos]
+        if len(names) != len(set(names)):
+            raise ValueError("duplicate SLO names in pack")
+        return self

@@ -251,6 +251,14 @@ class ServeReporter(threading.Thread):
         self.port = port
         self._stop = threading.Event()
         self._marker_drain = False
+        # metrics history (ISSUE 20): each beat also records this
+        # replica's health numbers into a SeriesBuffer and ships the
+        # drained points with the heartbeat — the server merges them
+        # into its fleet rollup keyed by the run's source. Points carry
+        # ages, so a spooled beat replayed after an outage still lands
+        # in the past where it was observed.
+        from ..obs.history import SeriesBuffer
+        self._series_buf = SeriesBuffer()
 
     def stop(self) -> None:
         self._stop.set()
@@ -290,8 +298,21 @@ class ServeReporter(threading.Thread):
         snap = self.engine.snapshot()
         obs = self.engine.drain_observations()
         payload = {**snap, **obs, "replica": self.replica}
+        labels = {"replica": str(self.replica)}
+        buf = self._series_buf
+        buf.add("polyaxon_serve_requests_total",
+                float(snap["requests_total"]), labels, kind="counter")
+        buf.add("polyaxon_serve_rejected_total",
+                float(snap["rejected_total"]), labels, kind="counter")
+        buf.add("polyaxon_serve_running_requests",
+                float(snap["running"]), labels)
+        buf.add("polyaxon_serve_waiting_requests",
+                float(snap["waiting"]), labels)
+        buf.add("polyaxon_serve_kv_block_utilization",
+                snap["kv_blocks_used"] / max(snap["kv_blocks_total"], 1),
+                labels)
         try:
-            self.tracked.heartbeat(serve=payload)
+            self.tracked.heartbeat(serve=payload, metrics=buf.drain())
         except Exception:
             pass  # spool/retry live inside tracking; never kill serving
         if self.replica == 0:

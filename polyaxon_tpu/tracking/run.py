@@ -180,7 +180,8 @@ class Run:
     def heartbeat(self, step: Optional[int] = None,
                   anomalies: Optional[dict] = None,
                   rollbacks: Optional[int] = None,
-                  serve: Optional[dict] = None) -> None:
+                  serve: Optional[dict] = None,
+                  metrics: Optional[dict] = None) -> None:
         """Renew this run's liveness lease (spooled through an outage so
         the post-failover reaper sees the replayed beats, not a corpse).
 
@@ -188,7 +189,14 @@ class Run:
         reaper watches: a pod whose heartbeats stay fresh while ``step``
         freezes is wedged, not healthy. ``anomalies``/``rollbacks`` are
         the pod's CUMULATIVE divergence-guard counters — the store turns
-        them into the ``polyaxon_train_*`` metric families by delta."""
+        them into the ``polyaxon_train_*`` metric families by delta.
+
+        ``metrics`` (ISSUE 20) is a drained
+        :class:`~polyaxon_tpu.obs.history.SeriesBuffer` payload: the
+        pod's local history points, merged into the server recorder's
+        fleet rollup. Points carry AGES, so spool replay after an outage
+        lands them in the past where they belong (at drain-time
+        accuracy), never stacked on \"now\"."""
         kw: dict[str, Any] = {}
         if step is not None:
             kw["step"] = int(step)
@@ -201,7 +209,9 @@ class Run:
             # instantaneous gauges + drained TTFT/inter-token samples; the
             # store deltas/aggregates per reporter incarnation
             kw["serve"] = dict(serve)
-        if anomalies or rollbacks or serve is not None:
+        if metrics is not None:
+            kw["metrics"] = dict(metrics)
+        if anomalies or rollbacks or serve is not None or metrics is not None:
             kw["incarnation"] = self.incarnation
         self._api("heartbeat", **kw)
 

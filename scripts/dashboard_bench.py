@@ -50,6 +50,14 @@ JOB_SPEC = {"run": {"kind": "job"}}
 #: regression tripwire, not a target)
 SMOKE_P95_BOUND_S = 2.0
 
+#: --history acceptance (ISSUE 20): the ring-buffer query must stay
+#: O(buffer) — its p95 while a 10k-run wave commits may not exceed the
+#: idle-table p95 by more than this ratio (or the absolute floor, so a
+#: microsecond-fast idle baseline can't fail the probe on scheduler
+#: jitter alone)
+HISTORY_P95_RATIO = 3.0
+HISTORY_P95_FLOOR_MS = 50.0
+
 
 class _RawWatcher(threading.Thread):
     """One SSE subscriber over the raw byte stream: records receive time
@@ -248,6 +256,99 @@ def run_bench(n_runs: int = 5000, watchers: int = 100,
         shutil.rmtree(art, ignore_errors=True)
 
 
+def run_history_probe(n_runs: int = 10000, probe_interval: float = 0.02,
+                      family: str = "polyaxon_store_transactions_total",
+                      baseline_s: float = 1.5) -> dict:
+    """The ISSUE 20 flat-p95 probe: hammer ``GET /api/v1/metrics/
+    history`` while a ``n_runs`` create wave commits through the same
+    store. The history endpoint reads fixed-size rings — its latency is
+    O(buffer), never O(runs) — so the during-wave p95 must stay within
+    ``HISTORY_P95_RATIO`` of the idle baseline (or the absolute floor).
+    A history query that scanned run rows (or serialized behind the bulk
+    writer) would blow the bound immediately at 10k rows."""
+    import tempfile
+
+    import requests
+
+    from polyaxon_tpu.api.server import ApiServer
+
+    art = tempfile.mkdtemp(prefix="plx-history-bench-")
+    srv = ApiServer(db_path=":memory:", artifacts_root=art, port=0)
+    srv.start()
+    store = srv.store
+    url = f"{srv.url}/api/v1/metrics/history"
+
+    def one_probe(samples: list) -> None:
+        t = time.monotonic()
+        r = requests.get(url, params={"family": family, "range": 3600},
+                         timeout=30)
+        r.raise_for_status()
+        samples.append(time.monotonic() - t)
+
+    try:
+        # prime the rings so the probe returns real points, not an empty
+        # series (the server's sampler thread ticks at production rate —
+        # too slow for a bench)
+        for _ in range(3):
+            store.recorder.sample()
+        baseline: list[float] = []
+        deadline = time.monotonic() + baseline_s
+        while time.monotonic() < deadline:
+            one_probe(baseline)
+            time.sleep(probe_interval)
+
+        wave: list[float] = []
+        stop = threading.Event()
+
+        def _probe_loop() -> None:
+            while not stop.is_set():
+                try:
+                    one_probe(wave)
+                except Exception:
+                    return  # a failed probe shows as a short sample list
+                time.sleep(probe_interval)
+
+        th = threading.Thread(target=_probe_loop, daemon=True)
+        th.start()
+        t0 = time.monotonic()
+        created = 0
+        # keep the wave committing until enough probes landed to make a
+        # p95 meaningful — a fast box finishing 2k creates in 250ms would
+        # otherwise starve the sample (the extra rows only sharpen the
+        # O(runs)-would-fail contrast); hard cap at 3x the ask
+        while created < n_runs or (len(wave) < 20
+                                   and created < 3 * n_runs):
+            batch = [{"spec": JOB_SPEC, "name": f"h{created + i}"}
+                     for i in range(500)]
+            store.create_runs("bench", batch)
+            created += len(batch)
+        wave_s = time.monotonic() - t0
+        stop.set()
+        th.join(timeout=5)
+
+        base_q, wave_q = _quantiles(baseline), _quantiles(wave)
+        bound_ms = max(base_q["p95_ms"] * HISTORY_P95_RATIO,
+                       HISTORY_P95_FLOOR_MS)
+        flat = (len(wave) >= 10 and wave_q["p95_ms"] is not None
+                and wave_q["p95_ms"] <= bound_ms)
+        return {
+            "runs": created,
+            "family": family,
+            "wave_s": round(wave_s, 2),
+            "baseline": base_q,
+            "during_wave": wave_q,
+            "probes_baseline": len(baseline),
+            "probes_during_wave": len(wave),
+            "p95_bound_ms": round(bound_ms, 2),
+            "flat_p95": flat,
+        }
+    finally:
+        srv.stop()
+        import shutil
+
+        shutil.rmtree(art, ignore_errors=True)
+
+
 def main() -> int:
     p = argparse.ArgumentParser("dashboard_bench", description=__doc__)
     p.add_argument("--runs", default="5000,10000",
@@ -259,10 +360,32 @@ def main() -> int:
     p.add_argument("--smoke", action="store_true",
                    help="tier-1 shape: 200 runs, 10 watchers, 60 deltas; "
                         f"exit 1 unless fan-out p95 < {SMOKE_P95_BOUND_S}s")
+    p.add_argument("--history", action="store_true",
+                   help="ISSUE 20 probe: poll GET /api/v1/metrics/history "
+                        "while a 10k-run create wave commits; exit 1 "
+                        "unless the query p95 stays flat (O(ring buffer), "
+                        f"<= {HISTORY_P95_RATIO}x the idle baseline or "
+                        f"{HISTORY_P95_FLOOR_MS}ms). With --smoke: a "
+                        "2k-run wave")
     p.add_argument("--out", default=None,
                    help="write the result rows as JSON (default for full "
                         "runs: bench_artifacts/dashboard_bench_r14.json)")
     args = p.parse_args()
+
+    if args.history:
+        row = run_history_probe(n_runs=2000 if args.smoke else 10000)
+        print(json.dumps({"history": row, "ok": row["flat_p95"]}))
+        if not args.smoke:
+            out = args.out or os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "bench_artifacts", "dashboard_history_r20.json")
+            os.makedirs(os.path.dirname(os.path.abspath(out)),
+                        exist_ok=True)
+            with open(out, "w", encoding="utf-8") as f:
+                json.dump({"row": row, "box": f"cpu x{os.cpu_count()}"},
+                          f, indent=2)
+            print(json.dumps({"artifact": out}))
+        return 0 if row["flat_p95"] else 1
 
     if args.smoke:
         row = run_bench(n_runs=200, watchers=10, transitions=60, rate=60.0)

@@ -1,0 +1,44 @@
+"""R8 reproducer (ISSUE 20): the two halves of SLO contract drift.
+
+(1) An SLO spec and a history allowlist naming families no registration
+produces — the recorder holds permanent silence for them, burn stays 0,
+and the alert can never fire (silently, by the deliberate "no data →
+burn 0" rule). (2) An alert verb defined next to a fenced-verb tuple
+that omits it — the exactly-once alert state machine loses its fence
+and double-fires across agent takeovers.
+"""
+
+
+def setup(reg):
+    reg.counter("polyaxon_obs_good_total", "completed units")
+    reg.gauge("polyaxon_obs_live_depth", "live queue depth")
+
+
+# BAD: bad_family was renamed in code but not here — the ratio SLO
+# evaluates bad/total against a family that never records
+CHAOS_SLO_PACK = [
+    {"name": "ghost-availability", "kind": "ratio", "objective": 0.999,
+     "bad_family": "polyaxon_obs_ghost_errors_total",
+     "total_family": "polyaxon_obs_good_total"},
+]
+
+# BAD: the allowlist retains a family that no longer exists — the ring
+# buffers it would fill are never written
+HISTORY_ALLOWLIST = (
+    "polyaxon_obs_live_depth",
+    "polyaxon_obs_vanished_queue_depth",
+)
+
+
+class MiniFencedStore:
+    # BAD: resolve_alert is defined below but missing here — a stale
+    # agent's resolve lands unfenced and races the successor's state
+    _FENCED = ("transition", "upsert_alert")
+
+
+def upsert_alert(name, state, fence=None):
+    return {"name": name, "state": state}
+
+
+def resolve_alert(name, fence=None):
+    return {"name": name, "state": "resolved"}

@@ -1,0 +1,35 @@
+"""R8 clean twin: every SLO/allowlist family is registered, and the
+fenced-verb containers list both alert verbs."""
+
+
+def setup(reg):
+    reg.counter("polyaxon_obs2_requests_total", "requests served")
+    reg.counter("polyaxon_obs2_errors_total", "requests failed")
+    reg.gauge("polyaxon_obs2_queue_depth", "admission queue depth")
+
+
+SERVE_SLO_PACK = [
+    {"name": "availability", "kind": "ratio", "objective": 0.999,
+     "bad_family": "polyaxon_obs2_errors_total",
+     "total_family": "polyaxon_obs2_requests_total"},
+]
+
+RECORD_ALLOWLIST = (
+    "polyaxon_obs2_requests_total",
+    "polyaxon_obs2_queue_depth",
+)
+
+
+class MiniFencedStore:
+    _FENCED = ("transition", "upsert_alert", "resolve_alert")
+
+
+WRITE_VERBS = frozenset({"transition", "upsert_alert", "resolve_alert"})
+
+
+def upsert_alert(name, state, fence=None):
+    return {"name": name, "state": state}
+
+
+def resolve_alert(name, fence=None):
+    return {"name": name, "state": "resolved"}

@@ -62,7 +62,7 @@ class TestLiveTree:
         assert analysis_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rule in ("fence", "lockorder", "asyncblock", "clock",
-                     "metrics", "donation", "crossshard"):
+                     "metrics", "donation", "crossshard", "slodrift"):
             assert rule in out
 
     def test_cli_rejects_unknown_rule(self):
@@ -106,6 +106,10 @@ BAD_CASES = [
     # would keep planting trials a successor already owns (the R1 fence
     # class extended to the hypertune/ path)
     ("fence", "hypertune/r19_unfenced_trial_create_bad.py", 4),
+    # ISSUE 20 SLOs: specs/allowlists naming families no registration
+    # produces (burn stays 0 forever, silently) + an alert verb missing
+    # from the fenced tuple (exactly-once across takeovers lost)
+    ("slodrift", "obs/r20_slo_drift_bad.py", 3),
 ]
 
 OK_TWINS = [
@@ -122,6 +126,7 @@ OK_TWINS = [
     "serve/r17_donated_spec_decode_ok.py",
     "api/r7_crossshard_txn_ok.py",
     "hypertune/r19_unfenced_trial_create_ok.py",
+    "obs/r20_slo_drift_ok.py",
 ]
 
 
@@ -220,7 +225,7 @@ class TestEngine:
                                         "by_rule"}
         assert set(data["rules"]) == {"fence", "lockorder", "asyncblock",
                                       "clock", "metrics", "donation",
-                                      "crossshard"}
+                                      "crossshard", "slodrift"}
 
     def test_clock_rule_scope_covers_the_stream_module(self):
         """ISSUE 14 satellite: api/stream.py (eviction write deadlines,

@@ -120,6 +120,12 @@ EXPECTED_FAMILIES = {
     "polyaxon_sweep_promotions_total",
     "polyaxon_pbt_forks_total",
     "polyaxon_sweep_live_trials",
+    # metrics history + SLO engine (ISSUE 20): the alert state machine's
+    # firing gauge + per-state transition counters (store birth) and the
+    # per-SLO fast-window burn gauge (AlertEngine birth)
+    "polyaxon_alerts_firing",
+    "polyaxon_alerts_transitions_total",
+    "polyaxon_slo_burn_rate",
 }
 
 

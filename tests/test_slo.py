@@ -1,0 +1,540 @@
+"""Tier-1 suite for the metrics-history recorder + SLO engine (ISSUE 20).
+
+Four layers, matching the acceptance checklist:
+
+- ring semantics: bucket keying, counter last-write vs gauge max
+  downsampling, wraparound serving gaps (never a stale lap's data), and
+  the counter-reset clamp in ``increase()``;
+- burn-rate math against hand-computed windows for all four spec kinds
+  (latency / ratio / events / gauge), on an injected monotonic clock;
+- the alert state machine: transition dedup, the ``for_s`` dwell,
+  re-notify intervals, and silent resolution of never-fired pendings;
+- exactly-once transitions under a two-agent lease takeover: the
+  deposed evaluator's fenced alert write dies with ``StaleLeaseError``
+  and the transition counters record each edge exactly once.
+"""
+
+import threading
+import time
+
+import pytest
+
+from polyaxon_tpu.api.store import FencedStore, StaleLeaseError, Store
+from polyaxon_tpu.obs.history import (
+    MetricsRecorder, SeriesBuffer, _Ring, increase, recorder_for,
+)
+from polyaxon_tpu.obs.metrics import MetricsRegistry
+from polyaxon_tpu.obs.slo import (
+    ALERT_PREFIX, AlertEngine, burn_rate, default_slo_pack, load_slo_pack,
+    slo_status,
+)
+from polyaxon_tpu.schemas import V1SLO
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _recorder(clock, tiers=((10.0, 360), (120.0, 720))) -> MetricsRecorder:
+    # allowlist=None: unit tests record arbitrary families directly
+    return MetricsRecorder(MetricsRegistry(), interval_s=1.0, tiers=tiers,
+                           allowlist=None, clock=clock)
+
+
+# -- ring semantics ----------------------------------------------------------
+
+
+class TestRing:
+    def test_counter_keeps_last_write_in_bucket(self):
+        r = _Ring(10.0, 8)
+        r.record(2.0, 5.0, take_max=False)
+        r.record(9.0, 3.0, take_max=False)  # same bucket, later sample
+        pts = r.window(now=12.0, range_s=20.0)
+        assert pts == [(2.0, 3.0), (0.0, None)]
+
+    def test_gauge_keeps_bucket_max(self):
+        r = _Ring(10.0, 8)
+        r.record(2.0, 5.0, take_max=True)
+        r.record(9.0, 3.0, take_max=True)  # lower later sample: max wins
+        pts = r.window(now=12.0, range_s=20.0)
+        assert pts == [(2.0, 5.0), (0.0, None)]
+
+    def test_unwritten_buckets_read_as_gaps(self):
+        r = _Ring(10.0, 8)
+        r.record(15.0, 7.0, take_max=False)  # bucket 1 only
+        pts = r.window(now=30.0, range_s=30.0)
+        assert pts == [(10.0, 7.0), (0.0, None), (0.0, None)]
+
+    def test_wraparound_never_serves_a_stale_lap(self):
+        r = _Ring(10.0, 4)  # 40s of history
+        r.record(5.0, 1.0, take_max=False)  # bucket 0 -> slot 0
+        # a full lap later, bucket 4 maps onto slot 0: the stamp check
+        # must report a gap, not the lap-old value 1.0
+        pts = r.window(now=49.0, range_s=10.0, at=0.0)
+        assert pts == [(0.0, None)]
+        r.record(45.0, 9.0, take_max=False)  # resets the slot for bucket 4
+        pts = r.window(now=49.0, range_s=10.0)
+        assert pts == [(0.0, 9.0)]
+
+    def test_increase_clamps_counter_resets(self):
+        # 0 -> 100 -> restart (drops to 3) -> 10: increases are 100 + 7;
+        # the reset contributes nothing instead of a negative cliff
+        pts = [(40.0, 0.0), (30.0, 100.0), (20.0, None), (10.0, 3.0),
+               (0.0, 10.0)]
+        assert increase(pts) == pytest.approx(107.0)
+
+    def test_recorder_downsamples_into_both_tiers(self):
+        clock = FakeClock(0.0)
+        rec = _recorder(clock, tiers=((10.0, 360), (120.0, 720)))
+        # one sample per 10s bucket for 6 minutes, values ramping up
+        for i in range(36):
+            rec.observe("polyaxon_x_depth", float(i), now=i * 10.0 + 5.0)
+        clock.t = 359.0
+        fine = rec.query("polyaxon_x_depth", range_s=60.0)
+        assert fine["interval_s"] == 10.0
+        assert [v for _, v in fine["points"]] == [30.0, 31.0, 32.0, 33.0,
+                                                  34.0, 35.0]
+        # the coarse tier kept the MAX of each 120s bucket (gauge rule)
+        coarse = rec.query("polyaxon_x_depth", range_s=7200.0)
+        assert coarse["interval_s"] == 120.0
+        vals = [v for _, v in coarse["points"] if v is not None]
+        assert vals == [11.0, 23.0, 35.0]
+
+    def test_series_cap_drops_instead_of_growing(self):
+        clock = FakeClock(0.0)
+        rec = _recorder(clock)
+        import polyaxon_tpu.obs.history as hist_mod
+
+        orig = hist_mod.MAX_SERIES
+        hist_mod.MAX_SERIES = 3
+        try:
+            rec_max = 3
+            for i in range(rec_max + 2):
+                rec.observe("polyaxon_x_total", 1.0,
+                            labels={"shard": str(i)}, kind="counter")
+        finally:
+            hist_mod.MAX_SERIES = orig
+        assert len(rec._series) == 3
+        assert rec.stats["dropped_series"] == 2
+
+
+# -- fleet rollup ------------------------------------------------------------
+
+
+class TestRollup:
+    def test_series_buffer_roundtrip_lands_aged_points(self):
+        pod_clock = FakeClock(1000.0)  # reporter clock, skewed arbitrarily
+        buf = SeriesBuffer(clock=pod_clock)
+        buf.add("polyaxon_x_queue", 4.0, labels={"replica": "0"})
+        pod_clock.advance(20.0)
+        buf.add("polyaxon_x_queue", 7.0, labels={"replica": "0"})
+        payload = buf.drain()
+        assert payload["series"][0]["points"][0][0] == pytest.approx(20.0)
+
+        srv_clock = FakeClock(500.0)  # entirely different clock domain
+        rec = _recorder(srv_clock)
+        assert rec.ingest("run-abc", payload) == 2
+        doc = rec.query("polyaxon_x_queue", range_s=60.0)
+        assert doc["series"][0]["source"] == "run-abc"
+        vals = [v for _, v in doc["points"] if v is not None]
+        assert vals == [4.0, 7.0]
+        assert buf.drain() is None  # drained buffers ship nothing
+
+    def test_counters_sum_and_gauges_max_across_sources(self):
+        clock = FakeClock(100.0)
+        rec = _recorder(clock)
+        for src, base in (("a", 10.0), ("b", 100.0)):
+            rec.observe("polyaxon_x_total", base, kind="counter",
+                        source=src, now=95.0)
+            rec.observe("polyaxon_x_gauge", base, kind="gauge",
+                        source=src, now=95.0)
+        doc = rec.query("polyaxon_x_total", range_s=30.0)
+        assert [v for _, v in doc["points"] if v is not None] == [110.0]
+        doc = rec.query("polyaxon_x_gauge", range_s=30.0)
+        assert [v for _, v in doc["points"] if v is not None] == [100.0]
+        # counter increases also sum across the fleet
+        rec.observe("polyaxon_x_total", 15.0, kind="counter", source="a",
+                    now=105.0)
+        rec.observe("polyaxon_x_total", 101.0, kind="counter", source="b",
+                    now=105.0)
+        assert rec.counter_increase("polyaxon_x_total", 30.0) == \
+            pytest.approx(6.0)
+
+    def test_ingest_rejects_junk_without_dying(self):
+        rec = _recorder(FakeClock(10.0))
+        assert rec.ingest("x", None) == 0
+        assert rec.ingest("x", {"series": [
+            {"family": "", "points": [[0, 1]]},
+            {"family": "polyaxon_ok", "points": [[0, float("nan")],
+                                                 [-5, 1.0], "junk",
+                                                 [1.0, 2.0]]},
+        ]}) == 1
+
+
+# -- burn-rate math ----------------------------------------------------------
+
+
+class TestBurnMath:
+    def _clock_rec(self):
+        clock = FakeClock(100.0)
+        return clock, _recorder(clock)
+
+    def test_ratio_burn_hand_computed(self):
+        _, rec = self._clock_rec()
+        # over the fast window: total 0 -> 1000, bad 0 -> 2.
+        # err = 2/1000 = 0.002; objective 99.9% -> budget 0.001 -> burn 2
+        for now, total, bad in ((55.0, 0.0, 0.0), (65.0, 1000.0, 2.0)):
+            rec.observe("polyaxon_t_total", total, kind="counter", now=now)
+            rec.observe("polyaxon_b_total", bad, kind="counter", now=now)
+        spec = V1SLO.from_dict({
+            "name": "avail", "kind": "ratio", "objective": 0.999,
+            "bad_family": "polyaxon_b_total",
+            "total_family": "polyaxon_t_total"})
+        assert burn_rate(rec, spec, 60.0) == pytest.approx(2.0)
+
+    def test_events_burn_hand_computed(self):
+        _, rec = self._clock_rec()
+        # 3 events in a 60s window = 180/hour; budget 5/hour -> burn 36
+        rec.observe("polyaxon_e_total", 0.0, kind="counter", now=55.0)
+        rec.observe("polyaxon_e_total", 3.0, kind="counter", now=65.0)
+        spec = V1SLO.from_dict({
+            "name": "ev", "kind": "events", "objective": 0.99,
+            "family": "polyaxon_e_total", "budget_per_hour": 5.0})
+        assert burn_rate(rec, spec, 60.0) == pytest.approx(36.0)
+
+    def test_latency_burn_hand_computed(self):
+        _, rec = self._clock_rec()
+        # 100 observations in-window, 90 under the 0.1s bound.
+        # err = 0.1; objective 95% -> budget 0.05 -> burn 2
+        for now, le, count in ((55.0, 0.0, 0.0), (65.0, 90.0, 100.0)):
+            rec.observe("polyaxon_l_seconds", le, kind="counter",
+                        part="le", bound=0.1, now=now)
+            rec.observe("polyaxon_l_seconds", count, kind="counter",
+                        part="count", now=now)
+        spec = V1SLO.from_dict({
+            "name": "lat", "kind": "latency", "objective": 0.95,
+            "family": "polyaxon_l_seconds", "threshold_s": 0.1})
+        assert burn_rate(rec, spec, 60.0) == pytest.approx(2.0)
+
+    def test_gauge_burn_is_breach_fraction_over_budget(self):
+        _, rec = self._clock_rec()
+        # 3 of 5 recorded buckets breaching (>= 1.0); objective 99% ->
+        # burn = 0.6 / 0.01 = 60
+        for now, v in ((55.0, 1.0), (65.0, 1.0), (75.0, 1.0),
+                       (85.0, 0.0), (95.0, 0.0)):
+            rec.observe("polyaxon_g_degraded", v, now=now)
+        spec = V1SLO.from_dict({
+            "name": "deg", "kind": "gauge", "objective": 0.99,
+            "family": "polyaxon_g_degraded", "threshold": 1.0, "op": ">="})
+        assert burn_rate(rec, spec, 60.0) == pytest.approx(60.0)
+
+    def test_no_data_reads_as_burn_zero(self):
+        _, rec = self._clock_rec()
+        for spec in default_slo_pack():
+            assert burn_rate(rec, spec, spec.fast_window_s) == 0.0
+
+    def test_slo_status_flags_dual_window_breach_only(self):
+        clock, rec = self._clock_rec()
+        spec = V1SLO.from_dict({
+            "name": "ev", "kind": "events", "objective": 0.99,
+            "family": "polyaxon_e_total", "budget_per_hour": 5.0,
+            "fast_window_s": 60.0, "slow_window_s": 600.0,
+            "fast_burn": 2.0, "slow_burn": 2.0})
+        # burst INSIDE the fast window but diluted across the slow
+        # window's budget: fast breaches, slow doesn't -> no page
+        rec.observe("polyaxon_e_total", 0.0, kind="counter", now=55.0)
+        rec.observe("polyaxon_e_total", 1.0, kind="counter", now=65.0)
+        (row,) = slo_status(rec, [spec])
+        assert row["fast_burn"] >= 2.0
+        assert row["slow_burn"] < 2.0
+        assert row["breaching"] is False
+
+    def test_yaml_pack_loads_through_the_schema_layer(self):
+        specs = load_slo_pack(
+            "slos:\n"
+            "  - name: api-availability\n"
+            "    kind: ratio\n"
+            "    objective: 0.999\n"
+            "    badFamily: polyaxon_b_total\n"
+            "    totalFamily: polyaxon_t_total\n"
+            "    forS: 30\n")
+        assert specs[0].name == "api-availability"
+        assert specs[0].for_s == 30.0
+
+    def test_duplicate_slo_names_rejected(self):
+        with pytest.raises(ValueError):
+            load_slo_pack(
+                "slos:\n"
+                "  - {name: a, kind: events, family: polyaxon_e_total,\n"
+                "     budget_per_hour: 1}\n"
+                "  - {name: a, kind: events, family: polyaxon_e_total,\n"
+                "     budget_per_hour: 2}\n")
+
+
+# -- alert state machine -----------------------------------------------------
+
+
+def _breaching_spec(**over) -> V1SLO:
+    d = {"name": "ev", "kind": "events", "objective": 0.99,
+         "family": "polyaxon_e_total", "budget_per_hour": 0.5,
+         "fast_window_s": 60.0, "slow_window_s": 120.0,
+         "fast_burn": 1.0, "slow_burn": 1.0, "for_s": 0.0}
+    d.update(over)
+    return V1SLO.from_dict(d)
+
+
+def _inject_events(rec, clock, n=5.0):
+    """Counter increase ``n`` inside both burn windows of the spec."""
+    rec.observe("polyaxon_e_total", 0.0, kind="counter",
+                now=clock.t - 15.0)
+    rec.observe("polyaxon_e_total", n, kind="counter", now=clock.t - 5.0)
+
+
+class TestAlertEngine:
+    def setup_method(self):
+        self.clock = FakeClock(1000.0)
+        self.store = Store(":memory:")
+        self.rec = _recorder(self.clock)
+        self.events = []
+
+    def _engine(self, spec, **kw):
+        return AlertEngine(self.store, self.rec, specs=[spec],
+                           notify=self.events.append, **kw)
+
+    def test_fire_dedup_resolve_cycle_is_exactly_once(self):
+        eng = self._engine(_breaching_spec())
+        _inject_events(self.rec, self.clock)
+        eng.evaluate_once()
+        eng.evaluate_once()  # still breaching: same-state, no second fire
+        assert [e["state"] for e in self.events] == ["firing"]
+        assert self.store.stats["alert_transitions_firing"] == 1
+        row = self.store.get_alert(ALERT_PREFIX + "ev")
+        assert row["state"] == "firing" and row["transitions"] == 1
+
+        # burn drains out of the windows -> resolved, notified once
+        self.clock.advance(300.0)
+        eng.evaluate_once()
+        eng.evaluate_once()
+        assert [e["state"] for e in self.events] == ["firing", "resolved"]
+        assert self.store.stats["alert_transitions_resolved"] == 1
+        assert self.store.get_alert(ALERT_PREFIX + "ev")["state"] == \
+            "resolved"
+
+    def test_firing_gauge_tracks_row_state(self):
+        reg = self.store.metrics
+        eng = self._engine(_breaching_spec())
+        _inject_events(self.rec, self.clock)
+        eng.evaluate_once()
+        assert self.store._alerts_firing == 1
+        g = reg.gauge("polyaxon_alerts_firing", "")
+        assert g.value == 1.0
+        self.clock.advance(300.0)
+        eng.evaluate_once()
+        assert g.value == 0.0
+
+    def test_renotify_interval_gates_repeat_pages(self):
+        # renotify 0: every evaluation while firing re-pages (marked
+        # renotify=True), but records NO new transition
+        eng = self._engine(_breaching_spec(renotify_interval_s=0.0))
+        _inject_events(self.rec, self.clock)
+        eng.evaluate_once()
+        eng.evaluate_once()
+        eng.evaluate_once()
+        states = [(e["state"], e["renotify"]) for e in self.events]
+        assert states == [("firing", False), ("firing", True),
+                          ("firing", True)]
+        assert self.store.stats["alert_transitions_firing"] == 1
+        # a long interval suppresses the repeat page entirely
+        self.events.clear()
+        eng2 = self._engine(_breaching_spec(name="ev2",
+                                            renotify_interval_s=3600.0))
+        _inject_events(self.rec, self.clock)
+        eng2.evaluate_once()
+        eng2.evaluate_once()
+        assert [e["renotify"] for e in self.events] == [False]
+
+    def test_dwell_holds_pending_then_fires(self):
+        eng = self._engine(_breaching_spec(for_s=0.15))
+        _inject_events(self.rec, self.clock)
+        eng.evaluate_once()
+        assert self.events == []  # pending pages nobody
+        assert self.store.get_alert(ALERT_PREFIX + "ev")["state"] == \
+            "pending"
+        eng.evaluate_once()  # dwell not yet served
+        assert self.store.get_alert(ALERT_PREFIX + "ev")["state"] == \
+            "pending"
+        time.sleep(0.2)  # pending_at is a wall stamp (cross-process row)
+        eng.evaluate_once()
+        assert [e["state"] for e in self.events] == ["firing"]
+        assert self.store.stats["alert_transitions_pending"] == 1
+        assert self.store.stats["alert_transitions_firing"] == 1
+
+    def test_pending_that_never_fired_resolves_silently(self):
+        eng = self._engine(_breaching_spec(for_s=3600.0))
+        _inject_events(self.rec, self.clock)
+        eng.evaluate_once()
+        assert self.store.get_alert(ALERT_PREFIX + "ev")["state"] == \
+            "pending"
+        self.clock.advance(300.0)  # breach gone before the dwell served
+        eng.evaluate_once()
+        assert self.store.get_alert(ALERT_PREFIX + "ev")["state"] == \
+            "resolved"
+        assert self.events == []  # nobody was paged, nobody gets all-clear
+
+    def test_owns_filter_partitions_the_pack(self):
+        specs = [_breaching_spec(name=f"ev{i}") for i in range(4)]
+        seen = []
+        eng = AlertEngine(self.store, self.rec, specs=specs,
+                          owns=lambda name: (seen.append(name),
+                                             name.endswith("2"))[1])
+        _inject_events(self.rec, self.clock)
+        out = eng.evaluate_once()
+        assert [r["name"] for r in out] == [ALERT_PREFIX + "ev2"]
+        assert len(seen) == 4
+
+    def test_burn_gauge_registers_from_birth(self):
+        reg = MetricsRegistry()
+        self._engine(_breaching_spec(), registry=reg)
+        text = reg.render()
+        assert 'polyaxon_slo_burn_rate{slo="ev"}' in text
+
+
+# -- exactly-once across a two-agent takeover --------------------------------
+
+
+class TestTakeoverExactlyOnce:
+    def test_deposed_evaluator_cannot_commit_or_notify(self):
+        clock = FakeClock(1000.0)
+        store = Store(":memory:")
+        rec = _recorder(clock)
+        spec = _breaching_spec()
+        rec.observe("polyaxon_e_total", 0.0, kind="counter", now=985.0)
+        rec.observe("polyaxon_e_total", 5.0, kind="counter", now=995.0)
+
+        lease1 = store.acquire_lease("agent", "a1", ttl=0.05)
+        f1 = FencedStore(store, lambda: ("agent", lease1["token"]))
+        time.sleep(0.1)  # a1 hard-killed; its lease expires
+        lease2 = store.acquire_lease("agent", "a2", ttl=30.0)
+        assert lease2 is not None and lease2["token"] > lease1["token"]
+        f2 = FencedStore(store, lambda: ("agent", lease2["token"]))
+
+        paged1, paged2 = [], []
+        eng1 = AlertEngine(f1, rec, specs=[spec], notify=paged1.append)
+        eng2 = AlertEngine(f2, rec, specs=[spec], notify=paged2.append)
+
+        # the corpse evaluates first: its fenced fire MUST die, recording
+        # no transition and paging nobody
+        with pytest.raises(StaleLeaseError):
+            eng1.evaluate_once()
+        assert paged1 == []
+        assert store.stats["alert_transitions_firing"] == 0
+        assert store.get_alert(ALERT_PREFIX + "ev") is None
+
+        # the successor fires the same breach exactly once
+        eng2.evaluate_once()
+        eng2.evaluate_once()
+        assert [e["state"] for e in paged2] == ["firing"]
+        assert store.stats["alert_transitions_firing"] == 1
+        assert store.stats["fence_rejections"] >= 1
+
+        # the corpse coming back mid-episode reads the row, writes
+        # nothing (same state, renotify interval unserved), pages nobody
+        eng1.evaluate_once()
+        assert paged1 == []
+        assert store.stats["alert_transitions_firing"] == 1
+        assert store.get_alert(ALERT_PREFIX + "ev")["transitions"] == 1
+
+    def test_resolve_race_is_also_single_shot(self):
+        clock = FakeClock(1000.0)
+        store = Store(":memory:")
+        rec = _recorder(clock)
+        spec = _breaching_spec()
+        rec.observe("polyaxon_e_total", 0.0, kind="counter", now=985.0)
+        rec.observe("polyaxon_e_total", 5.0, kind="counter", now=995.0)
+
+        lease1 = store.acquire_lease("agent", "a1", ttl=0.05)
+        f1 = FencedStore(store, lambda: ("agent", lease1["token"]))
+        eng1 = AlertEngine(f1, rec, specs=[spec],
+                           notify=lambda e: None)
+        eng1.evaluate_once()  # fires under a live lease
+        assert store.stats["alert_transitions_firing"] == 1
+
+        time.sleep(0.1)
+        lease2 = store.acquire_lease("agent", "a2", ttl=30.0)
+        f2 = FencedStore(store, lambda: ("agent", lease2["token"]))
+        paged2 = []
+        eng2 = AlertEngine(f2, rec, specs=[spec], notify=paged2.append)
+
+        clock.advance(300.0)  # breach clears: both would resolve
+        with pytest.raises(StaleLeaseError):
+            eng1.evaluate_once()
+        assert store.stats["alert_transitions_resolved"] == 0
+        eng2.evaluate_once()
+        eng2.evaluate_once()
+        assert [e["state"] for e in paged2] == ["resolved"]
+        assert store.stats["alert_transitions_resolved"] == 1
+
+
+# -- recorder lifecycle ------------------------------------------------------
+
+
+class TestRecorderLifecycle:
+    def test_recorder_for_is_a_registry_singleton(self):
+        reg = MetricsRegistry()
+        a = recorder_for(reg, start=False)
+        b = recorder_for(reg, start=False)
+        assert a is b and a._thread is None
+
+    def test_start_stop_sampler_thread(self):
+        reg = MetricsRegistry()
+        reg.gauge("polyaxon_agent_queue_depth", "x").set(3.0)
+        rec = MetricsRecorder(reg, interval_s=0.01)
+        rec.start()
+        try:
+            deadline = time.monotonic() + 2.0
+            while rec.stats["samples"] == 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+        finally:
+            rec.stop()
+        assert not rec._thread.is_alive()
+        doc = rec.query("polyaxon_agent_queue_depth", range_s=60.0)
+        assert any(v == 3.0 for _, v in doc["points"])
+
+    def test_sampler_skips_nan_and_offlist_families(self):
+        reg = MetricsRegistry()
+        reg.gauge("polyaxon_agent_queue_depth", "x").set(float("nan"))
+        reg.gauge("polyaxon_not_allowlisted", "x").set(1.0)
+        clock = FakeClock(50.0)
+        rec = MetricsRecorder(reg, interval_s=1.0, clock=clock)
+        rec.sample()
+        assert rec.families() == []
+
+    def test_concurrent_observe_and_query(self):
+        rec = _recorder(time.monotonic)
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                rec.observe("polyaxon_x_total", float(i), kind="counter")
+                i += 1
+
+        threads = [threading.Thread(target=writer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(50):
+                rec.query("polyaxon_x_total", range_s=60.0)
+                rec.counter_increase("polyaxon_x_total", 60.0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
